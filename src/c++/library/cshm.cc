@@ -1,0 +1,99 @@
+// C shim exposing POSIX shared-memory primitives to the Python client via
+// ctypes.  TPU-native rebuild of the role played by the reference's
+// libcshm.so (reference src/python/library/tritonclient/utils/shared_memory/
+// shared_memory.cc:74-79): create/open/map system shm regions that a
+// co-located inference server can register and read/write with zero
+// serialization.
+//
+// Error codes: 0 ok, -1 shm_open failed, -2 ftruncate failed, -3 mmap failed,
+// -4 munmap/close failed, -5 shm_unlink failed.
+
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+int
+TpuShmRegionCreate(
+    const char* shm_key, size_t byte_size, int* shm_fd_out, void** base_out)
+{
+  int fd = shm_open(shm_key, O_RDWR | O_CREAT, S_IRUSR | S_IWUSR);
+  if (fd == -1) {
+    return -1;
+  }
+  if (ftruncate(fd, (off_t)byte_size) == -1) {
+    close(fd);
+    return -2;
+  }
+  void* base =
+      mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return -3;
+  }
+  *shm_fd_out = fd;
+  *base_out = base;
+  return 0;
+}
+
+int
+TpuShmRegionOpen(
+    const char* shm_key, size_t byte_size, size_t offset, int* shm_fd_out,
+    void** base_out)
+{
+  int fd = shm_open(shm_key, O_RDWR, S_IRUSR | S_IWUSR);
+  if (fd == -1) {
+    return -1;
+  }
+  void* base = mmap(
+      nullptr, offset + byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return -3;
+  }
+  *shm_fd_out = fd;
+  *base_out = base;
+  return 0;
+}
+
+int
+TpuShmRegionSet(
+    void* base, size_t offset, size_t byte_size, const void* data)
+{
+  memcpy((char*)base + offset, data, byte_size);
+  return 0;
+}
+
+int
+TpuShmRegionGet(void* base, size_t offset, size_t byte_size, void* out)
+{
+  memcpy(out, (char*)base + offset, byte_size);
+  return 0;
+}
+
+int
+TpuShmRegionClose(int shm_fd, void* base, size_t byte_size)
+{
+  int rc = 0;
+  if (munmap(base, byte_size) == -1) {
+    rc = -4;
+  }
+  if (close(shm_fd) == -1) {
+    rc = -4;
+  }
+  return rc;
+}
+
+int
+TpuShmRegionUnlink(const char* shm_key)
+{
+  if (shm_unlink(shm_key) == -1) {
+    return -5;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+"""tpuserver — an in-process, TPU-native inference serving runtime.
+
+Plays the role the reference's ``triton_c_api`` backend plays (reference
+client_backend/triton_c_api/triton_loader.h:85-115: dlopen'd in-process
+``libtritonserver.so``): a full KServe-v2 server the client stack can talk to
+— over real HTTP and gRPC frontends or via direct in-process calls — without
+any external deployment.  Models execute as jitted JAX computations on
+whatever ``jax.devices()`` provides (TPU in production, CPU in tests), so the
+same runtime serves both the test suite and the TPU benchmarks.
+"""
+
+from tpuserver.core import InferenceServer, JaxModel, Model, TensorSpec
+
+__all__ = ["InferenceServer", "JaxModel", "Model", "TensorSpec"]

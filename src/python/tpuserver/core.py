@@ -1,0 +1,889 @@
+"""Core serving runtime: model registry, inference execution, shared-memory
+registries, statistics.
+
+Protocol-facing frontends live in ``tpuserver.http_frontend`` /
+``tpuserver.grpc_frontend``; this module is transport-agnostic and works on
+numpy/jax arrays.
+"""
+
+import base64
+import mmap
+import os
+import threading
+import time
+
+import numpy as np
+
+from tritonclient.utils import (
+    deserialize_bytes_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)
+
+SERVER_NAME = "tpu-triton-server"
+SERVER_VERSION = "0.1.0"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "model_repository(unload_dependents)",
+    "schedule_policy",
+    "model_configuration",
+    "system_shared_memory",
+    "cuda_shared_memory",
+    "xla_shared_memory",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+class TensorSpec:
+    """Declared input/output tensor: name, wire datatype, dims (-1 dynamic)."""
+
+    def __init__(self, name, datatype, shape):
+        self.name = name
+        self.datatype = datatype
+        self.shape = list(shape)
+
+    def as_metadata(self):
+        return {
+            "name": self.name,
+            "datatype": self.datatype,
+            "shape": list(self.shape),
+        }
+
+
+class RequestedOutput:
+    """Server-side view of one requested output and its delivery options."""
+
+    def __init__(self, name, binary_data=True, class_count=0,
+                 shm_region=None, shm_byte_size=0, shm_offset=0):
+        self.name = name
+        self.binary_data = binary_data
+        self.class_count = class_count
+        self.shm_region = shm_region
+        self.shm_byte_size = shm_byte_size
+        self.shm_offset = shm_offset
+
+
+class InferRequest:
+    """Transport-agnostic inference request."""
+
+    def __init__(self, model_name, model_version="", request_id="",
+                 inputs=None, requested_outputs=None, parameters=None):
+        self.model_name = model_name
+        self.model_version = model_version
+        self.id = request_id
+        self.inputs = inputs or {}  # name -> np.ndarray (BYTES as np.object_)
+        self.requested_outputs = requested_outputs  # list[RequestedOutput]|None
+        self.parameters = parameters or {}
+
+    @property
+    def sequence_id(self):
+        return self.parameters.get("sequence_id", 0)
+
+    @property
+    def sequence_start(self):
+        return bool(self.parameters.get("sequence_start", False))
+
+    @property
+    def sequence_end(self):
+        return bool(self.parameters.get("sequence_end", False))
+
+
+class InferResponse:
+    """Transport-agnostic inference response."""
+
+    def __init__(self, model_name, model_version, request_id, outputs,
+                 parameters=None):
+        self.model_name = model_name
+        self.model_version = model_version
+        self.id = request_id
+        # list of (TensorSpec-like dict name/datatype/shape, np.ndarray|None,
+        #          delivery dict) — array None when delivered via shm
+        self.outputs = outputs
+        self.parameters = parameters or {}
+
+
+class ServerError(Exception):
+    """Server-side error carrying an HTTP-ish status code."""
+
+    def __init__(self, msg, code=400):
+        super().__init__(msg)
+        self.code = code
+
+
+class Model:
+    """Base model: subclasses define specs and ``execute``.
+
+    ``execute(inputs, request)`` returns ``dict name -> np.ndarray``.
+    Decoupled models instead implement ``execute_stream`` yielding such dicts
+    (possibly zero or many — the decoupled contract).
+    Sequence models implement ``execute_sequence(inputs, state, request)``
+    returning ``(outputs, new_state)``.
+    """
+
+    name = "model"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 0
+    inputs = ()
+    outputs = ()
+    decoupled = False
+    sequence = False
+    ensemble_steps = None  # list of dicts for ensemble models
+    labels = None  # name -> list[str] classification labels
+    version = "1"
+
+    def config_dict(self):
+        cfg = {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": self.backend,
+            "max_batch_size": self.max_batch_size,
+            "input": [
+                {
+                    "name": t.name,
+                    "data_type": "TYPE_" + t.datatype,
+                    "dims": list(t.shape),
+                }
+                for t in self.inputs
+            ],
+            "output": [
+                {
+                    "name": t.name,
+                    "data_type": "TYPE_" + t.datatype,
+                    "dims": list(t.shape),
+                }
+                for t in self.outputs
+            ],
+            "instance_group": [{"name": self.name + "_0", "kind": "KIND_TPU",
+                                "count": 1}],
+            "version_policy": {"latest": {"num_versions": 1}},
+        }
+        if self.decoupled:
+            cfg["model_transaction_policy"] = {"decoupled": True}
+        if self.sequence:
+            cfg["sequence_batching"] = {
+                "max_sequence_idle_microseconds": 60000000,
+                "control_input": [
+                    {"name": "START",
+                     "control": [{"kind": "CONTROL_SEQUENCE_START",
+                                  "int32_false_true": [0, 1]}]},
+                    {"name": "END",
+                     "control": [{"kind": "CONTROL_SEQUENCE_END",
+                                  "int32_false_true": [0, 1]}]},
+                ],
+            }
+        if self.ensemble_steps is not None:
+            cfg["platform"] = "ensemble"
+            cfg["ensemble_scheduling"] = {"step": self.ensemble_steps}
+        return cfg
+
+    def metadata_dict(self):
+        return {
+            "name": self.name,
+            "versions": [self.version],
+            "platform": self.platform,
+            "inputs": [t.as_metadata() for t in self.inputs],
+            "outputs": [t.as_metadata() for t in self.outputs],
+        }
+
+    def execute(self, inputs, request):
+        raise NotImplementedError
+
+    def execute_stream(self, inputs, request):
+        raise NotImplementedError
+
+    def execute_sequence(self, inputs, state, request):
+        raise NotImplementedError
+
+    def warmup(self):
+        """Trigger compilation with representative shapes (optional)."""
+
+
+class JaxModel(Model):
+    """A model whose compute is a jitted JAX callable.
+
+    ``fn(**inputs) -> dict`` runs under ``jax.jit`` with static shapes; host
+    arrays are pushed with ``device_put`` and results fetched once.  Direct
+    ``jax.Array`` inputs (the in-process XLA-shm fast path) skip the host
+    push entirely.
+    """
+
+    def __init__(self):
+        self._jitted = None
+        self._lock = threading.Lock()
+
+    def jax_fn(self, **kwargs):
+        raise NotImplementedError
+
+    def _get_jitted(self):
+        if self._jitted is None:
+            with self._lock:
+                if self._jitted is None:
+                    import jax
+
+                    self._jitted = jax.jit(self.jax_fn)
+        return self._jitted
+
+    def execute(self, inputs, request):
+        import jax
+
+        fn = self._get_jitted()
+        dev_inputs = {}
+        for name, arr in inputs.items():
+            if isinstance(arr, jax.Array):
+                dev_inputs[name] = arr
+            else:
+                dev_inputs[name] = jax.device_put(arr)
+        out = fn(**dev_inputs)
+        return {k: np.asarray(v) for k, v in out.items()}
+
+
+class _SystemShmRegion:
+    def __init__(self, name, key, offset, byte_size):
+        self.name = name
+        self.key = key
+        self.offset = offset
+        self.byte_size = byte_size
+        path = "/dev/shm" + key if key.startswith("/") else "/dev/shm/" + key
+        self._fd = os.open(path, os.O_RDWR)
+        self._map = mmap.mmap(self._fd, offset + byte_size)
+
+    def read(self, offset, nbytes):
+        start = self.offset + offset
+        return bytes(self._map[start : start + nbytes])
+
+    def write(self, offset, data):
+        start = self.offset + offset
+        self._map[start : start + len(data)] = data
+
+    def close(self):
+        try:
+            self._map.close()
+        finally:
+            os.close(self._fd)
+
+
+class _XlaShmRegion:
+    """Server-side view of a registered XLA/TPU shared-memory region.
+
+    The raw handle (see tritonclient.utils.xla_shared_memory) names both a
+    host staging window (POSIX shm) and, when client and server share a
+    process, an in-process buffer registry slot holding live ``jax.Array``s —
+    the zero-host-copy fast path.
+    """
+
+    def __init__(self, name, raw_handle, device_ordinal, byte_size):
+        from tritonclient.utils import xla_shared_memory as xshm
+
+        self.name = name
+        self.device_ordinal = device_ordinal
+        self.byte_size = byte_size
+        self.handle = xshm.attach_from_raw_handle(raw_handle)
+
+    def read(self, offset, nbytes):
+        return self.handle.read_bytes(offset, nbytes)
+
+    def write(self, offset, data):
+        self.handle.write_bytes(offset, data)
+
+    def get_device_array(self, offset, datatype, shape):
+        """jax.Array view of the region contents (zero-copy in-process)."""
+        return self.handle.as_jax(offset, datatype, shape)
+
+    def put_device_array(self, offset, array):
+        return self.handle.put_jax(offset, array)
+
+    def close(self):
+        self.handle.detach()
+
+
+class _ModelStats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inference_count = 0
+        self.execution_count = 0
+        self.last_inference_ms = 0
+        self.success_count = 0
+        self.success_ns = 0
+        self.fail_count = 0
+        self.fail_ns = 0
+        self.queue_ns = 0
+        self.compute_input_ns = 0
+        self.compute_infer_ns = 0
+        self.compute_output_ns = 0
+
+    def record(self, batch, queue_ns, ci_ns, cf_ns, co_ns, ok=True):
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += 1
+                self.last_inference_ms = int(time.time() * 1000)
+                self.success_count += 1
+                self.success_ns += queue_ns + ci_ns + cf_ns + co_ns
+                self.queue_ns += queue_ns
+                self.compute_input_ns += ci_ns
+                self.compute_infer_ns += cf_ns
+                self.compute_output_ns += co_ns
+            else:
+                self.fail_count += 1
+                self.fail_ns += queue_ns + ci_ns + cf_ns + co_ns
+
+    def as_dict(self, name, version):
+        with self.lock:
+            def sd(count, ns):
+                return {"count": count, "ns": ns}
+
+            return {
+                "name": name,
+                "version": version,
+                "last_inference": self.last_inference_ms,
+                "inference_count": self.inference_count,
+                "execution_count": self.execution_count,
+                "inference_stats": {
+                    "success": sd(self.success_count, self.success_ns),
+                    "fail": sd(self.fail_count, self.fail_ns),
+                    "queue": sd(self.success_count, self.queue_ns),
+                    "compute_input": sd(self.success_count,
+                                        self.compute_input_ns),
+                    "compute_infer": sd(self.success_count,
+                                        self.compute_infer_ns),
+                    "compute_output": sd(self.success_count,
+                                         self.compute_output_ns),
+                    "cache_hit": sd(0, 0),
+                    "cache_miss": sd(0, 0),
+                },
+                "batch_stats": [],
+            }
+
+
+class InferenceServer:
+    """The serving core: models, shared memory, statistics, settings."""
+
+    def __init__(self, models=None):
+        self._models = {}  # name -> Model
+        self._ready = {}  # name -> bool
+        self._stats = {}  # name -> _ModelStats
+        self._lock = threading.Lock()
+        self._system_shm = {}
+        self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
+        self._xla_shm = {}
+        self._sequence_state = {}  # (model, seq_id) -> state
+        self._trace_settings = {
+            "trace_file": [""],
+            "trace_level": ["OFF"],
+            "trace_rate": ["1000"],
+            "trace_count": ["-1"],
+            "log_frequency": ["0"],
+        }
+        self._log_settings = {
+            "log_file": "",
+            "log_info": True,
+            "log_warning": True,
+            "log_error": True,
+            "log_verbose_level": 0,
+            "log_format": "default",
+        }
+        for m in models or []:
+            self.register_model(m)
+
+    # -- model repository --------------------------------------------------
+
+    def register_model(self, model, ready=True):
+        with self._lock:
+            self._models[model.name] = model
+            self._ready[model.name] = ready
+            self._stats.setdefault(model.name, _ModelStats())
+
+    def _get_model(self, name, version=""):
+        model = self._models.get(name)
+        if model is None:
+            raise ServerError(
+                "Request for unknown model: '{}' is not found".format(name),
+                code=404,
+            )
+        if version not in ("", model.version):
+            raise ServerError(
+                "Request for unknown model version: '{}' version {}".format(
+                    name, version
+                ),
+                code=404,
+            )
+        if not self._ready.get(name, False):
+            raise ServerError(
+                "Model '{}' is not ready".format(name), code=400
+            )
+        return model
+
+    def model_ready(self, name, version=""):
+        model = self._models.get(name)
+        return (
+            model is not None
+            and version in ("", model.version)
+            and self._ready.get(name, False)
+        )
+
+    def load_model(self, name):
+        if name not in self._models:
+            raise ServerError(
+                "failed to load '{}', no such model".format(name), code=400
+            )
+        self._ready[name] = True
+
+    def unload_model(self, name, unload_dependents=False):
+        if name not in self._models:
+            raise ServerError(
+                "failed to unload '{}', no such model".format(name), code=400
+            )
+        self._ready[name] = False
+        if unload_dependents:
+            model = self._models[name]
+            for step in model.ensemble_steps or []:
+                if step["model_name"] in self._models:
+                    self._ready[step["model_name"]] = False
+
+    def repository_index(self, ready_only=False):
+        out = []
+        for name, model in sorted(self._models.items()):
+            ready = self._ready.get(name, False)
+            if ready_only and not ready:
+                continue
+            out.append(
+                {
+                    "name": name,
+                    "version": model.version,
+                    "state": "READY" if ready else "UNAVAILABLE",
+                    "reason": "",
+                }
+            )
+        return out
+
+    # -- metadata ----------------------------------------------------------
+
+    def server_metadata(self):
+        return {
+            "name": SERVER_NAME,
+            "version": SERVER_VERSION,
+            "extensions": list(SERVER_EXTENSIONS),
+        }
+
+    def model_metadata(self, name, version=""):
+        return self._get_model(name, version).metadata_dict()
+
+    def model_config(self, name, version=""):
+        return self._get_model(name, version).config_dict()
+
+    def model_statistics(self, name="", version=""):
+        out = []
+        for mname, model in sorted(self._models.items()):
+            if name and mname != name:
+                continue
+            out.append(self._stats[mname].as_dict(mname, model.version))
+        if name and not out:
+            raise ServerError(
+                "Request for unknown model: '{}' is not found".format(name),
+                code=404,
+            )
+        return {"model_stats": out}
+
+    # -- settings ----------------------------------------------------------
+
+    def get_trace_settings(self, model_name=None):
+        return {"settings": dict(self._trace_settings)}
+
+    def update_trace_settings(self, model_name=None, settings=None):
+        for key, val in (settings or {}).items():
+            if val is None:
+                continue
+            self._trace_settings[key] = (
+                [str(v) for v in val] if isinstance(val, list) else [str(val)]
+            )
+        return self.get_trace_settings(model_name)
+
+    def get_log_settings(self):
+        return dict(self._log_settings)
+
+    def update_log_settings(self, settings):
+        for key, val in (settings or {}).items():
+            if key not in self._log_settings:
+                raise ServerError("unknown log setting '{}'".format(key))
+            self._log_settings[key] = val
+        return self.get_log_settings()
+
+    # -- shared memory -----------------------------------------------------
+
+    def register_system_shm(self, name, key, offset, byte_size):
+        if name in self._system_shm:
+            raise ServerError(
+                "shared memory region '{}' already in manager".format(name)
+            )
+        try:
+            self._system_shm[name] = _SystemShmRegion(
+                name, key, offset, byte_size
+            )
+        except OSError as e:
+            raise ServerError(
+                "unable to open shared memory region '{}': {}".format(name, e)
+            )
+
+    def unregister_system_shm(self, name=""):
+        if name:
+            region = self._system_shm.pop(name, None)
+            if region is not None:
+                region.close()
+        else:
+            for region in self._system_shm.values():
+                region.close()
+            self._system_shm.clear()
+
+    def system_shm_status(self, name=""):
+        regions = {}
+        for rname, r in self._system_shm.items():
+            if name and rname != name:
+                continue
+            regions[rname] = {
+                "name": rname,
+                "key": r.key,
+                "offset": r.offset,
+                "byte_size": r.byte_size,
+            }
+        return regions
+
+    def register_cuda_shm(self, name, raw_handle, device_id, byte_size):
+        raise ServerError(
+            "failed to register CUDA shared memory region '{}': no CUDA "
+            "devices on a TPU host (use xla shared memory)".format(name)
+        )
+
+    def unregister_cuda_shm(self, name=""):
+        self._cuda_shm.clear()
+
+    def cuda_shm_status(self, name=""):
+        return {}
+
+    def register_xla_shm(self, name, raw_handle, device_ordinal, byte_size):
+        if name in self._xla_shm:
+            raise ServerError(
+                "shared memory region '{}' already in manager".format(name)
+            )
+        try:
+            self._xla_shm[name] = _XlaShmRegion(
+                name, raw_handle, device_ordinal, byte_size
+            )
+        except Exception as e:
+            raise ServerError(
+                "unable to attach xla shared memory region '{}': {}".format(
+                    name, e
+                )
+            )
+
+    def unregister_xla_shm(self, name=""):
+        if name:
+            region = self._xla_shm.pop(name, None)
+            if region is not None:
+                region.close()
+        else:
+            for region in self._xla_shm.values():
+                region.close()
+            self._xla_shm.clear()
+
+    def xla_shm_status(self, name=""):
+        regions = {}
+        for rname, r in self._xla_shm.items():
+            if name and rname != name:
+                continue
+            regions[rname] = {
+                "name": rname,
+                "device_ordinal": r.device_ordinal,
+                "byte_size": r.byte_size,
+            }
+        return regions
+
+    def _shm_region(self, name):
+        region = self._system_shm.get(name) or self._xla_shm.get(name)
+        if region is None:
+            raise ServerError(
+                "Unable to find shared memory region: '{}'".format(name)
+            )
+        return region
+
+    def read_shm_input(self, region_name, byte_size, offset, datatype, shape):
+        """Materialize an input tensor from a registered shm region.
+
+        For XLA regions holding live device buffers this returns the
+        ``jax.Array`` itself — no host copy."""
+        region = self._shm_region(region_name)
+        if isinstance(region, _XlaShmRegion):
+            arr = region.get_device_array(offset, datatype, shape)
+            if arr is not None:
+                return arr
+        raw = region.read(offset, byte_size)
+        if datatype == "BYTES":
+            return deserialize_bytes_tensor(raw).reshape(
+                [s for s in shape]
+            )
+        np_dtype = triton_to_np_dtype(datatype)
+        return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+    def write_shm_output(self, region_name, offset, array, datatype):
+        """Write an output tensor into a registered shm region.
+
+        jax.Array outputs written to an in-process XLA region stay on device."""
+        region = self._shm_region(region_name)
+        if isinstance(region, _XlaShmRegion) and not isinstance(
+            array, np.ndarray
+        ):
+            if region.put_device_array(offset, array):
+                return
+        if datatype == "BYTES":
+            serialized = serialize_byte_tensor(np.asarray(array, dtype=object))
+            data = serialized.item() if serialized.size > 0 else b""
+        else:
+            data = np.ascontiguousarray(np.asarray(array)).tobytes()
+        region.write(offset, data)
+
+    # -- inference ---------------------------------------------------------
+
+    def infer(self, request):
+        """Execute one inference request; returns InferResponse.
+
+        Decoupled models are rejected here (use ``infer_stream``), matching
+        server behavior for non-streaming endpoints.
+        """
+        model = self._get_model(request.model_name, request.model_version)
+        if model.decoupled:
+            raise ServerError(
+                "model '{}' is a decoupled model: it can only be served over "
+                "the streaming endpoint".format(model.name)
+            )
+        return self._execute(model, request)
+
+    def infer_stream(self, request):
+        """Execute a (possibly decoupled) request; yields InferResponse(s)."""
+        model = self._get_model(request.model_name, request.model_version)
+        if not model.decoupled:
+            yield self._execute(model, request)
+            return
+        t0 = time.monotonic_ns()
+        inputs = dict(request.inputs)
+        t1 = time.monotonic_ns()
+        count = 0
+        for out in model.execute_stream(inputs, request):
+            count += 1
+            yield self._make_response(model, request, out,
+                                      mark_final=False)
+        t2 = time.monotonic_ns()
+        self._stats[model.name].record(
+            self._batch_of(model, inputs), 0, t1 - t0, t2 - t1, 0
+        )
+
+    def _batch_of(self, model, inputs):
+        if model.max_batch_size > 0 and inputs:
+            first = next(iter(inputs.values()))
+            return int(np.asarray(first).shape[0]) if np.asarray(
+                first
+            ).ndim > 0 else 1
+        return 1
+
+    def _execute(self, model, request):
+        stats = self._stats[model.name]
+        t_queue0 = time.monotonic_ns()
+        # compute_input: materialize shm-resident inputs already done by
+        # frontend; here validate presence.
+        t_ci0 = time.monotonic_ns()
+        inputs = dict(request.inputs)
+        declared = {t.name: t for t in model.inputs}
+        for t in model.inputs:
+            if t.name not in inputs:
+                raise ServerError(
+                    "expected {} inputs but got {} inputs for model '{}': "
+                    "missing '{}'".format(
+                        len(model.inputs), len(inputs), model.name, t.name
+                    )
+                )
+        for name in inputs:
+            if declared and name not in declared:
+                raise ServerError(
+                    "unexpected inference input '{}' for model '{}'".format(
+                        name, model.name
+                    )
+                )
+        t_cf0 = time.monotonic_ns()
+        try:
+            if model.ensemble_steps is not None:
+                outputs = self._execute_ensemble(model, inputs, request)
+            elif model.sequence:
+                outputs = self._execute_sequence(model, inputs, request)
+            else:
+                outputs = model.execute(inputs, request)
+        except ServerError:
+            stats.record(0, 0, 0, 0, 0, ok=False)
+            raise
+        except Exception as e:
+            stats.record(0, 0, 0, 0, 0, ok=False)
+            raise ServerError(
+                "inference failed for model '{}': {}".format(model.name, e),
+                code=500,
+            )
+        t_co0 = time.monotonic_ns()
+        resp = self._make_response(model, request, outputs)
+        t_end = time.monotonic_ns()
+        stats.record(
+            self._batch_of(model, inputs),
+            t_ci0 - t_queue0,
+            t_cf0 - t_ci0,
+            t_co0 - t_cf0,
+            t_end - t_co0,
+        )
+        return resp
+
+    def _execute_sequence(self, model, inputs, request):
+        if request.sequence_id == 0:
+            raise ServerError(
+                "inference request to model '{}' must specify a non-zero "
+                "sequence id".format(model.name)
+            )
+        key = (model.name, request.sequence_id)
+        if request.sequence_start:
+            state = None
+        else:
+            if key not in self._sequence_state:
+                raise ServerError(
+                    "inference request for sequence {} to model '{}' must "
+                    "specify the START flag on the first request of the "
+                    "sequence".format(request.sequence_id, model.name)
+                )
+            state = self._sequence_state[key]
+        outputs, new_state = model.execute_sequence(inputs, state, request)
+        if request.sequence_end:
+            self._sequence_state.pop(key, None)
+        else:
+            self._sequence_state[key] = new_state
+        return outputs
+
+    def _execute_ensemble(self, model, inputs, request):
+        tensors = dict(inputs)
+        for step in model.ensemble_steps:
+            sub = self._get_model(step["model_name"])
+            sub_inputs = {
+                model_in: tensors[ens_name]
+                for model_in, ens_name in step["input_map"].items()
+            }
+            sub_req = InferRequest(
+                sub.name, "", request.id, sub_inputs, None, request.parameters
+            )
+            sub_out = sub.execute(sub_inputs, sub_req)
+            for model_out, ens_name in step["output_map"].items():
+                tensors[ens_name] = sub_out[model_out]
+        return {
+            t.name: tensors[t.name] for t in model.outputs
+        }
+
+    def _classify(self, array, class_count, labels):
+        """Top-k classification strings 'value:index[:label]' per batch row."""
+        arr = np.asarray(array)
+        squeeze = arr.ndim == 1
+        mat = arr.reshape(1, -1) if squeeze else arr.reshape(arr.shape[0], -1)
+        k = min(class_count, mat.shape[-1])
+        idx = np.argsort(-mat, axis=-1)[:, :k]
+        rows = []
+        for r in range(mat.shape[0]):
+            row = []
+            for i in idx[r]:
+                entry = "{:f}:{}".format(float(mat[r, i]), int(i))
+                if labels is not None and int(i) < len(labels):
+                    entry += ":" + labels[int(i)]
+                row.append(entry.encode("utf-8"))
+            rows.append(row)
+        out = np.array(rows, dtype=np.object_)
+        if squeeze:
+            out = out.reshape(-1)
+        return out
+
+    def _make_response(self, model, request, outputs, mark_final=True):
+        declared = {t.name: t for t in model.outputs}
+        requested = request.requested_outputs
+        if requested:
+            wanted = []
+            for ro in requested:
+                if ro.name not in outputs:
+                    raise ServerError(
+                        "unexpected inference output '{}' for model "
+                        "'{}'".format(ro.name, model.name)
+                    )
+                wanted.append(ro)
+        else:
+            wanted = [RequestedOutput(name) for name in outputs]
+
+        resp_outputs = []
+        for ro in wanted:
+            array = outputs[ro.name]
+            spec = declared.get(ro.name)
+            if ro.class_count > 0:
+                labels = (model.labels or {}).get(ro.name)
+                array = self._classify(array, ro.class_count, labels)
+                datatype = "BYTES"
+            else:
+                datatype = spec.datatype if spec is not None else None
+                if datatype is None or datatype == "":
+                    datatype = _np_to_wire(array)
+            np_arr = np.asarray(array) if not hasattr(
+                array, "addressable_shards"
+            ) else array
+            shape = list(np.asarray(np_arr).shape) if isinstance(
+                np_arr, np.ndarray
+            ) else list(np_arr.shape)
+            delivery = {
+                "binary_data": ro.binary_data,
+                "shm_region": ro.shm_region,
+                "shm_byte_size": ro.shm_byte_size,
+                "shm_offset": ro.shm_offset,
+            }
+            if ro.shm_region is not None:
+                expected = (
+                    serialized_byte_size(np.asarray(np_arr, dtype=object))
+                    if datatype == "BYTES"
+                    else int(np.asarray(np_arr).nbytes)
+                )
+                if expected > ro.shm_byte_size:
+                    raise ServerError(
+                        "shared memory size specified with the request for "
+                        "output '{}' ({} bytes) should be at least {} "
+                        "bytes".format(ro.name, ro.shm_byte_size, expected)
+                    )
+                self.write_shm_output(
+                    ro.shm_region, ro.shm_offset, np_arr, datatype
+                )
+                resp_outputs.append(
+                    (
+                        {"name": ro.name, "datatype": datatype,
+                         "shape": shape},
+                        None,
+                        delivery,
+                    )
+                )
+            else:
+                resp_outputs.append(
+                    (
+                        {"name": ro.name, "datatype": datatype,
+                         "shape": shape},
+                        np.asarray(np_arr),
+                        delivery,
+                    )
+                )
+        return InferResponse(
+            model.name, model.version, request.id, resp_outputs
+        )
+
+
+def _np_to_wire(array):
+    from tritonclient.utils import np_to_triton_dtype
+
+    dt = np_to_triton_dtype(np.asarray(array).dtype)
+    return dt or "FP32"

@@ -1,0 +1,442 @@
+"""HTTP/REST frontend: serves the KServe-v2 protocol (with the binary-tensor
+extension) over a threaded stdlib HTTP server, delegating to
+``tpuserver.core.InferenceServer``."""
+
+import gzip
+import json
+import re
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from socketserver import ThreadingMixIn
+from urllib.parse import unquote, urlparse
+
+import numpy as np
+
+from tpuserver.core import (
+    InferenceServer,
+    InferRequest,
+    RequestedOutput,
+    ServerError,
+)
+from tritonclient.utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+_MODEL_URI = re.compile(
+    r"^/v2/models/(?P<model>[^/]+)(/versions/(?P<version>[^/]+))?"
+    r"(?P<rest>/.*)?$"
+)
+_SHM_URI = re.compile(
+    r"^/v2/(?P<kind>systemsharedmemory|cudasharedmemory|xlasharedmemory)"
+    r"(/region/(?P<region>[^/]+))?/(?P<verb>status|register|unregister)$"
+)
+_REPO_URI = re.compile(
+    r"^/v2/repository(/models/(?P<model>[^/]+)/(?P<verb>load|unload)|/index)$"
+)
+
+
+def _binary_from_array(array, datatype):
+    if datatype == "BYTES":
+        serialized = serialize_byte_tensor(array)
+        return serialized.item() if serialized.size > 0 else b""
+    if datatype == "BF16":
+        serialized = serialize_bf16_tensor(array)
+        return serialized.item() if serialized.size > 0 else b""
+    return np.ascontiguousarray(array).tobytes()
+
+
+def _array_from_binary(raw, datatype, shape):
+    if datatype == "BYTES":
+        return deserialize_bytes_tensor(raw).reshape(shape)
+    if datatype == "BF16":
+        return deserialize_bf16_tensor(raw).reshape(shape)
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise ServerError("unsupported datatype " + str(datatype))
+    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+
+def _array_from_json_data(data, datatype, shape):
+    if datatype == "BYTES":
+        flat = []
+        stack = [data]
+        while stack:
+            item = stack.pop()
+            if isinstance(item, list):
+                stack.extend(reversed(item))
+            else:
+                flat.append(
+                    item.encode("utf-8") if isinstance(item, str) else item
+                )
+        return np.array(flat, dtype=np.object_).reshape(shape)
+    np_dtype = triton_to_np_dtype(datatype)
+    return np.asarray(data, dtype=np_dtype).reshape(shape)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-triton-server"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    @property
+    def core(self):
+        return self.server.core
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _send(self, code, body=b"", headers=None, content_type="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for key, val in (headers or {}).items():
+            self.send_header(key, val)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj, code=200, headers=None):
+        self._send(code, json.dumps(obj).encode("utf-8"), headers)
+
+    def _send_error_json(self, msg, code=400):
+        self._send_json({"error": msg}, code)
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        encoding = self.headers.get("Content-Encoding")
+        if encoding == "gzip":
+            body = gzip.decompress(body)
+        elif encoding == "deflate":
+            body = zlib.decompress(body)
+        return body
+
+    # -- dispatch ---------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            self._route("GET")
+        except ServerError as e:
+            self._send_error_json(str(e), e.code)
+        except ValueError as e:
+            self._send_error_json("malformed request: {}".format(e), 400)
+        except Exception as e:  # pragma: no cover
+            self._send_error_json("internal error: {}".format(e), 500)
+
+    def do_POST(self):
+        try:
+            self._route("POST")
+        except ServerError as e:
+            self._send_error_json(str(e), e.code)
+        except ValueError as e:
+            self._send_error_json("malformed request: {}".format(e), 400)
+        except Exception as e:  # pragma: no cover
+            self._send_error_json("internal error: {}".format(e), 500)
+
+    def _route(self, method):
+        path = urlparse(self.path).path
+        core = self.core
+
+        if path == "/v2/health/live":
+            return self._send(200)
+        if path == "/v2/health/ready":
+            return self._send(200)
+        if path == "/v2" or path == "/v2/":
+            return self._send_json(core.server_metadata())
+        if path == "/v2/models/stats":
+            return self._send_json(core.model_statistics())
+        if path == "/v2/logging":
+            if method == "POST":
+                return self._send_json(
+                    core.update_log_settings(json.loads(self._read_body()))
+                )
+            return self._send_json(core.get_log_settings())
+        if path == "/v2/trace/setting":
+            if method == "POST":
+                return self._send_json(
+                    core.update_trace_settings(
+                        None, json.loads(self._read_body())
+                    )["settings"]
+                )
+            return self._send_json(core.get_trace_settings()["settings"])
+
+        m = _REPO_URI.match(path)
+        if m:
+            if m.group("verb") == "load":
+                core.load_model(unquote(m.group("model")))
+                return self._send_json({})
+            if m.group("verb") == "unload":
+                unload_dependents = False
+                body = self._read_body()
+                if body:
+                    params = json.loads(body).get("parameters", {})
+                    unload_dependents = params.get("unload_dependents", False)
+                core.unload_model(unquote(m.group("model")), unload_dependents)
+                return self._send_json({})
+            return self._send_json(core.repository_index())
+
+        m = _SHM_URI.match(path)
+        if m:
+            return self._route_shm(m)
+
+        m = _MODEL_URI.match(path)
+        if m:
+            model = unquote(m.group("model"))
+            version = m.group("version") or ""
+            rest = m.group("rest") or ""
+            if rest == "/ready":
+                if core.model_ready(model, version):
+                    return self._send(200)
+                return self._send(400)
+            if rest == "" or rest == "/":
+                return self._send_json(core.model_metadata(model, version))
+            if rest == "/config":
+                return self._send_json(core.model_config(model, version))
+            if rest == "/stats":
+                return self._send_json(core.model_statistics(model, version))
+            if rest == "/trace/setting":
+                if method == "POST":
+                    return self._send_json(
+                        core.update_trace_settings(
+                            model, json.loads(self._read_body())
+                        )["settings"]
+                    )
+                return self._send_json(
+                    core.get_trace_settings(model)["settings"]
+                )
+            if rest == "/infer" and method == "POST":
+                return self._route_infer(model, version)
+            if rest == "/generate" or rest == "/generate_stream":
+                raise ServerError(
+                    "generate endpoints not supported; use gRPC streaming"
+                )
+        raise ServerError("unknown endpoint: " + path, code=404)
+
+    def _route_shm(self, m):
+        core = self.core
+        kind = m.group("kind")
+        region = unquote(m.group("region")) if m.group("region") else ""
+        verb = m.group("verb")
+        if kind == "systemsharedmemory":
+            if verb == "status":
+                return self._send_json(core.system_shm_status(region))
+            if verb == "register":
+                req = json.loads(self._read_body())
+                core.register_system_shm(
+                    region, req["key"], req.get("offset", 0), req["byte_size"]
+                )
+                return self._send_json({})
+            core.unregister_system_shm(region)
+            return self._send_json({})
+        if kind == "cudasharedmemory":
+            if verb == "status":
+                return self._send_json(core.cuda_shm_status(region))
+            if verb == "register":
+                req = json.loads(self._read_body())
+                core.register_cuda_shm(
+                    region, req.get("raw_handle", {}).get("b64", ""),
+                    req.get("device_id", 0), req["byte_size"],
+                )
+                return self._send_json({})
+            core.unregister_cuda_shm(region)
+            return self._send_json({})
+        # xlasharedmemory
+        if verb == "status":
+            return self._send_json(core.xla_shm_status(region))
+        if verb == "register":
+            req = json.loads(self._read_body())
+            core.register_xla_shm(
+                region, req.get("raw_handle", {}).get("b64", ""),
+                req.get("device_ordinal", 0), req["byte_size"],
+            )
+            return self._send_json({})
+        core.unregister_xla_shm(region)
+        return self._send_json({})
+
+    # -- inference --------------------------------------------------------
+
+    def _route_infer(self, model, version):
+        core = self.core
+        body = self._read_body()
+        header_length = self.headers.get("Inference-Header-Content-Length")
+        if header_length is not None:
+            json_len = int(header_length)
+            request_json = json.loads(body[:json_len])
+            binary = body[json_len:]
+        else:
+            request_json = json.loads(body)
+            binary = b""
+
+        parameters = dict(request_json.get("parameters", {}))
+        binary_all_outputs = parameters.pop("binary_data_output", False)
+
+        try:
+            model_meta = core.model_metadata(model, version)
+        except ServerError:
+            model_meta = {"inputs": []}
+        declared_in = {
+            t["name"]: t for t in model_meta.get("inputs", [])
+        }
+
+        inputs = {}
+        offset = 0
+        for tin in request_json.get("inputs", []):
+            name = tin["name"]
+            datatype = tin.get("datatype") or declared_in.get(name, {}).get(
+                "datatype"
+            )
+            shape = tin["shape"]
+            tparams = tin.get("parameters", {})
+            if "shared_memory_region" in tparams:
+                inputs[name] = core.read_shm_input(
+                    tparams["shared_memory_region"],
+                    tparams.get("shared_memory_byte_size", 0),
+                    tparams.get("shared_memory_offset", 0),
+                    datatype,
+                    shape,
+                )
+            elif "binary_data_size" in tparams:
+                size = tparams["binary_data_size"]
+                raw = binary[offset : offset + size]
+                offset += size
+                inputs[name] = _array_from_binary(raw, datatype, shape)
+            elif "data" in tin:
+                inputs[name] = _array_from_json_data(
+                    tin["data"], datatype, shape
+                )
+            else:
+                raise ServerError(
+                    "input '{}' has no data and no shared-memory "
+                    "reference".format(name)
+                )
+
+        requested = None
+        if "outputs" in request_json:
+            requested = []
+            for tout in request_json["outputs"]:
+                oparams = tout.get("parameters", {})
+                requested.append(
+                    RequestedOutput(
+                        tout["name"],
+                        binary_data=oparams.get("binary_data", False)
+                        or binary_all_outputs,
+                        class_count=oparams.get("classification", 0),
+                        shm_region=oparams.get("shared_memory_region"),
+                        shm_byte_size=oparams.get(
+                            "shared_memory_byte_size", 0
+                        ),
+                        shm_offset=oparams.get("shared_memory_offset", 0),
+                    )
+                )
+
+        request = InferRequest(
+            model,
+            version,
+            request_json.get("id", ""),
+            inputs,
+            requested,
+            parameters,
+        )
+        response = core.infer(request)
+
+        # Assemble response: JSON header + binary section.
+        out_json = {
+            "model_name": response.model_name,
+            "model_version": response.model_version,
+            "outputs": [],
+        }
+        if response.id:
+            out_json["id"] = response.id
+        binary_parts = []
+        for spec, array, delivery in response.outputs:
+            entry = dict(spec)
+            oparams = {}
+            if array is None:
+                oparams["shared_memory_region"] = delivery["shm_region"]
+                oparams["shared_memory_byte_size"] = delivery["shm_byte_size"]
+                if delivery["shm_offset"]:
+                    oparams["shared_memory_offset"] = delivery["shm_offset"]
+            elif (requested is not None and delivery["binary_data"]) or (
+                requested is None and binary_all_outputs
+            ):
+                raw = _binary_from_array(array, spec["datatype"])
+                oparams["binary_data_size"] = len(raw)
+                binary_parts.append(raw)
+            else:
+                if spec["datatype"] == "BYTES":
+                    entry["data"] = [
+                        v.decode("utf-8", errors="replace")
+                        if isinstance(v, bytes)
+                        else str(v)
+                        for v in array.reshape(-1)
+                    ]
+                elif spec["datatype"] == "BF16":
+                    raise ServerError(
+                        "BF16 outputs require binary_data=true"
+                    )
+                else:
+                    entry["data"] = array.reshape(-1).tolist()
+            if oparams:
+                entry["parameters"] = oparams
+            out_json["outputs"].append(entry)
+
+        header = json.dumps(out_json).encode("utf-8")
+        headers = {}
+        if binary_parts:
+            payload = header + b"".join(binary_parts)
+            headers["Inference-Header-Content-Length"] = str(len(header))
+            content_type = "application/octet-stream"
+        else:
+            payload = header
+            content_type = "application/json"
+
+        accept_encoding = self.headers.get("Accept-Encoding", "")
+        if "gzip" in accept_encoding:
+            payload = gzip.compress(payload)
+            headers["Content-Encoding"] = "gzip"
+        elif "deflate" in accept_encoding:
+            payload = zlib.compress(payload)
+            headers["Content-Encoding"] = "deflate"
+        self._send(200, payload, headers, content_type)
+
+
+class HttpFrontend:
+    """Threaded HTTP server wrapper: ``start()``/``stop()``; ``port`` is
+    resolved after start (pass 0 to pick a free port)."""
+
+    def __init__(self, core, host="127.0.0.1", port=0, verbose=False):
+        self._core = core
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.core = core
+        self._httpd.verbose = verbose
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return "{}:{}".format(self._httpd.server_address[0], self.port)
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,28 @@
+"""Built-in model zoo for tpuserver.
+
+These play the role of the quick-start / QA models the reference's examples
+and tests run against (``simple`` add/sub, identity models, image
+classifiers, ensembles, sequence and decoupled models) — implemented as
+jitted JAX computations.
+"""
+
+from tpuserver.models.simple import (
+    IdentityBF16Model,
+    IdentityFP32Model,
+    IdentityStringModel,
+    SequenceAccumulateModel,
+    SimpleModel,
+    SimpleStringModel,
+)
+
+
+def default_models():
+    """The standard test-fixture model set."""
+    return [
+        SimpleModel(),
+        SimpleStringModel(),
+        IdentityFP32Model(),
+        IdentityBF16Model(),
+        IdentityStringModel(),
+        SequenceAccumulateModel(),
+    ]

@@ -1,0 +1,118 @@
+"""Small fixture models: the `simple` add/sub model from the Triton
+quick-start (2xINT32[16] -> sum/diff; reference docs/quick_start.md:75-108),
+identity models, and a stateful sequence model."""
+
+import numpy as np
+
+from tpuserver.core import JaxModel, Model, TensorSpec
+
+
+class SimpleModel(JaxModel):
+    """INPUT0+INPUT1 -> OUTPUT0, INPUT0-INPUT1 -> OUTPUT1 (INT32[1,16])."""
+
+    name = "simple"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 8
+    inputs = (
+        TensorSpec("INPUT0", "INT32", [16]),
+        TensorSpec("INPUT1", "INT32", [16]),
+    )
+    outputs = (
+        TensorSpec("OUTPUT0", "INT32", [16]),
+        TensorSpec("OUTPUT1", "INT32", [16]),
+    )
+
+    def jax_fn(self, INPUT0, INPUT1):
+        return {"OUTPUT0": INPUT0 + INPUT1, "OUTPUT1": INPUT0 - INPUT1}
+
+
+class SimpleStringModel(Model):
+    """BYTES add/sub model: string-encoded int32s in, string sums out
+    (mirror of the reference's simple_string fixture)."""
+
+    name = "simple_string"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 8
+    inputs = (
+        TensorSpec("INPUT0", "BYTES", [16]),
+        TensorSpec("INPUT1", "BYTES", [16]),
+    )
+    outputs = (
+        TensorSpec("OUTPUT0", "BYTES", [16]),
+        TensorSpec("OUTPUT1", "BYTES", [16]),
+    )
+
+    def execute(self, inputs, request):
+        in0 = np.array(
+            [int(v) for v in inputs["INPUT0"].reshape(-1)], dtype=np.int64
+        ).reshape(inputs["INPUT0"].shape)
+        in1 = np.array(
+            [int(v) for v in inputs["INPUT1"].reshape(-1)], dtype=np.int64
+        ).reshape(inputs["INPUT1"].shape)
+        add = in0 + in1
+        sub = in0 - in1
+        return {
+            "OUTPUT0": np.array(
+                [str(v).encode() for v in add.reshape(-1)], dtype=np.object_
+            ).reshape(add.shape),
+            "OUTPUT1": np.array(
+                [str(v).encode() for v in sub.reshape(-1)], dtype=np.object_
+            ).reshape(sub.shape),
+        }
+
+
+class IdentityFP32Model(JaxModel):
+    name = "identity_fp32"
+    max_batch_size = 0
+    inputs = (TensorSpec("INPUT0", "FP32", [-1, -1]),)
+    outputs = (TensorSpec("OUTPUT0", "FP32", [-1, -1]),)
+
+    def jax_fn(self, INPUT0):
+        return {"OUTPUT0": INPUT0}
+
+
+class IdentityBF16Model(JaxModel):
+    """BF16 passthrough — exercises the TPU-native bf16 wire path."""
+
+    name = "identity_bf16"
+    max_batch_size = 0
+    inputs = (TensorSpec("INPUT0", "BF16", [-1, -1]),)
+    outputs = (TensorSpec("OUTPUT0", "BF16", [-1, -1]),)
+
+    def jax_fn(self, INPUT0):
+        return {"OUTPUT0": INPUT0}
+
+
+class IdentityStringModel(Model):
+    name = "identity_string"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 0
+    inputs = (TensorSpec("INPUT0", "BYTES", [-1]),)
+    outputs = (TensorSpec("OUTPUT0", "BYTES", [-1]),)
+
+    def execute(self, inputs, request):
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+
+class SequenceAccumulateModel(Model):
+    """Stateful sequence model: running int32 sum per sequence id.
+
+    Exercises the sequence_id/sequence_start/sequence_end request controls
+    (reference common.h:177-194) end-to-end.
+    """
+
+    name = "sequence_accumulate"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 0
+    sequence = True
+    inputs = (TensorSpec("INPUT", "INT32", [1]),)
+    outputs = (TensorSpec("OUTPUT", "INT32", [1]),)
+
+    def execute_sequence(self, inputs, state, request):
+        acc = state if state is not None else np.zeros([1], dtype=np.int32)
+        acc = acc + inputs["INPUT"].astype(np.int32)
+        return {"OUTPUT": acc}, acc

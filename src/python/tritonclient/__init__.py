@@ -1,0 +1,17 @@
+"""TPU-native client stack for Triton Inference Server (KServe v2 protocol).
+
+A from-scratch implementation of the capabilities of the reference
+`triton-inference-server/client` repository, designed TPU-first: tensors may be
+numpy arrays *or* ``jax.Array``s, BF16 is a first-class dtype, and the CUDA
+shared-memory data plane is generalized into an XLA/TPU shared-memory data
+plane (``tritonclient.utils.xla_shared_memory``).
+
+Subpackages
+-----------
+``tritonclient.http``    sync HTTP/REST client (+ ``.aio`` asyncio variant)
+``tritonclient.grpc``    sync gRPC client (+ ``.aio`` asyncio variant)
+``tritonclient.utils``   dtype helpers, tensor (de)serialization, exceptions,
+                         and the shared-memory data planes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,95 @@
+"""Client-side timing and statistics.
+
+Python twin of the reference C++ ``RequestTimers`` (6-point nanosecond
+timestamps, common.h:523-603) and ``InferStat`` (common.h:94-115) so the
+Python clients expose the same request-timing observability the C++ library
+does.
+"""
+
+import threading
+import time
+
+
+class RequestTimers:
+    """Nanosecond timestamps for one request: REQUEST/SEND/RECV start+end."""
+
+    __slots__ = (
+        "request_start_ns",
+        "request_end_ns",
+        "send_start_ns",
+        "send_end_ns",
+        "recv_start_ns",
+        "recv_end_ns",
+    )
+
+    def __init__(self):
+        self.request_start_ns = 0
+        self.request_end_ns = 0
+        self.send_start_ns = 0
+        self.send_end_ns = 0
+        self.recv_start_ns = 0
+        self.recv_end_ns = 0
+
+    def request_start(self):
+        self.request_start_ns = time.monotonic_ns()
+
+    def request_end(self):
+        self.request_end_ns = time.monotonic_ns()
+
+    def send_start(self):
+        self.send_start_ns = time.monotonic_ns()
+
+    def send_end(self):
+        self.send_end_ns = time.monotonic_ns()
+
+    def recv_start(self):
+        self.recv_start_ns = time.monotonic_ns()
+
+    def recv_end(self):
+        self.recv_end_ns = time.monotonic_ns()
+
+    def request_duration_ns(self):
+        return self.request_end_ns - self.request_start_ns
+
+    def send_duration_ns(self):
+        return self.send_end_ns - self.send_start_ns
+
+    def recv_duration_ns(self):
+        return self.recv_end_ns - self.recv_start_ns
+
+
+class InferStat:
+    """Accumulated client-side statistics across requests (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.completed_request_count = 0
+        self.cumulative_total_request_time_ns = 0
+        self.cumulative_send_time_ns = 0
+        self.cumulative_receive_time_ns = 0
+        self.failed_request_count = 0
+
+    def update(self, timers, success=True):
+        with self._lock:
+            if success:
+                self.completed_request_count += 1
+                self.cumulative_total_request_time_ns += (
+                    timers.request_duration_ns()
+                )
+                self.cumulative_send_time_ns += timers.send_duration_ns()
+                self.cumulative_receive_time_ns += timers.recv_duration_ns()
+            else:
+                self.failed_request_count += 1
+
+    def __repr__(self):
+        return (
+            "InferStat(completed={}, failed={}, avg_request_us={:.1f})".format(
+                self.completed_request_count,
+                self.failed_request_count,
+                (
+                    self.cumulative_total_request_time_ns
+                    / max(1, self.completed_request_count)
+                )
+                / 1e3,
+            )
+        )

@@ -1,0 +1,18 @@
+"""Shared helpers for result objects across the HTTP and gRPC clients."""
+
+
+def result_as_jax(result, name, device=None):
+    """Convert ``result.as_numpy(name)`` into a ``jax.Array``.
+
+    jax is imported lazily so the clients stay importable (and fast to
+    import) on hosts without jax; bf16 numpy arrays (ml_dtypes) convert
+    natively with no widening.
+    """
+    np_array = result.as_numpy(name)
+    if np_array is None:
+        return None
+    import jax
+
+    if device is not None:
+        return jax.device_put(np_array, device)
+    return jax.numpy.asarray(np_array)

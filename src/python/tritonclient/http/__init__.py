@@ -1,0 +1,20 @@
+"""HTTP/REST client for the KServe-v2 protocol (sync; see ``.aio`` for
+asyncio).  Mirrors the surface of reference ``tritonclient.http``."""
+
+from tritonclient.http._client import (
+    InferAsyncRequest,
+    InferenceServerClient,
+    InferInput,
+    InferRequestedOutput,
+    InferResult,
+)
+from tritonclient.utils import InferenceServerException
+
+__all__ = [
+    "InferAsyncRequest",
+    "InferenceServerClient",
+    "InferenceServerException",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+]

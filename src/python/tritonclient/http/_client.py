@@ -1,0 +1,740 @@
+"""Sync HTTP/REST client for the KServe-v2 protocol.
+
+Re-implements the full surface of reference http/_client.py:94-1600.  The
+reference rides a geventhttpclient connection pool with gevent greenlets for
+``async_infer``; this implementation keeps the same semantics on a stdlib
+``http.client`` keep-alive connection pool plus a thread pool — no monkey
+patching, and it composes cleanly with jax (which gevent does not).
+"""
+
+import base64
+import json
+import queue
+import socket
+import ssl as ssl_module
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import quote, urlparse
+
+from tritonclient._auxiliary import InferStat, RequestTimers
+from tritonclient.http._infer_input import InferInput
+from tritonclient.http._infer_result import InferResult
+from tritonclient.http._requested_output import InferRequestedOutput
+from tritonclient.http._utils import (
+    _compress_request_body,
+    _get_error_message,
+    _get_inference_request,
+    _get_query_string,
+)
+from tritonclient.utils import InferenceServerException, raise_error
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "InferAsyncRequest",
+]
+
+
+class InferAsyncRequest:
+    """Handle for an in-flight ``async_infer`` request; ``get_result()``
+    blocks until the response arrives (reference http/_client.py:40-92)."""
+
+    def __init__(self, future, verbose=False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block=True, timeout=None):
+        """Get the InferResult (or raise the request's exception)."""
+        if not block and not self._future.done():
+            raise_error("request not yet completed")
+        return self._future.result(timeout=timeout)
+
+    def cancelled(self):
+        return self._future.cancelled()
+
+
+class _PooledConnection:
+    """A keep-alive HTTP/1.1 connection with raw send/recv helpers."""
+
+    def __init__(self, scheme, host, port, connection_timeout, network_timeout,
+                 ssl_context):
+        import http.client
+
+        self._network_timeout = network_timeout
+        if scheme == "https":
+            self._conn = http.client.HTTPSConnection(
+                host, port, timeout=connection_timeout, context=ssl_context
+            )
+        else:
+            self._conn = http.client.HTTPConnection(
+                host, port, timeout=connection_timeout
+            )
+
+    def request(self, method, path, body, headers):
+        if self._conn.sock is None:
+            self._conn.connect()
+        # Configure the socket before any bytes are written so NODELAY
+        # covers the (possibly large, binary-tensor) send path.
+        self._conn.sock.settimeout(self._network_timeout)
+        self._conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._conn.request(method, path, body=body, headers=headers)
+        resp = self._conn.getresponse()
+        resp_body = resp.read()
+        return resp.status, dict(resp.headers), resp_body
+
+    def close(self):
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+
+class InferenceServerClient:
+    """Client to the HTTP/REST endpoints of an inference server.
+
+    Parameters
+    ----------
+    url : str
+        ``host:port`` of the server (no scheme), e.g. ``"localhost:8000"``.
+    verbose : bool
+        If True print request/response details.
+    concurrency : int
+        Number of pooled connections (and worker threads for async_infer).
+    connection_timeout : float
+        Connect timeout in seconds.
+    network_timeout : float
+        Read timeout in seconds.
+    ssl : bool
+        Use HTTPS.
+    ssl_options : dict
+        Optional keys ``keyfile``, ``certfile``, ``ca_certs``.
+    insecure : bool
+        If True skip certificate verification.
+    ssl_context_factory : callable
+        Factory returning an ``ssl.SSLContext`` (overrides ssl_options).
+    """
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        concurrency=1,
+        connection_timeout=60.0,
+        network_timeout=60.0,
+        max_greenlets=None,
+        ssl=False,
+        ssl_options=None,
+        ssl_context_factory=None,
+        insecure=False,
+    ):
+        # Set first so close()/__del__ are safe even if __init__ raises below.
+        self._closed = True
+        if url.startswith("http://") or url.startswith("https://"):
+            raise_error("url should not include the scheme")
+        scheme = "https" if ssl else "http"
+        parsed = urlparse(scheme + "://" + url)
+        self._host = parsed.hostname
+        self._port = parsed.port or (443 if ssl else 80)
+        self._base_path = parsed.path.rstrip("/")
+        self._scheme = scheme
+        self._verbose = verbose
+        self._concurrency = max(1, concurrency)
+        self._connection_timeout = connection_timeout
+        self._network_timeout = network_timeout
+
+        self._ssl_context = None
+        if ssl:
+            if ssl_context_factory is not None:
+                self._ssl_context = ssl_context_factory()
+            else:
+                ctx = ssl_module.create_default_context()
+                if ssl_options:
+                    if "ca_certs" in ssl_options:
+                        ctx.load_verify_locations(ssl_options["ca_certs"])
+                    if "certfile" in ssl_options:
+                        ctx.load_cert_chain(
+                            ssl_options["certfile"],
+                            ssl_options.get("keyfile"),
+                        )
+                if insecure:
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl_module.CERT_NONE
+                self._ssl_context = ctx
+
+        self._pool = queue.LifoQueue()
+        for _ in range(self._concurrency):
+            self._pool.put(None)  # lazily created
+        self._executor = None
+        self._executor_lock = threading.Lock()
+        self._infer_stat = InferStat()
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, type_, value, traceback):
+        self.close()
+
+    def __del__(self):
+        self.close()
+
+    def close(self):
+        """Close the client: drain the pool and stop worker threads."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+        while True:
+            try:
+                conn = self._pool.get_nowait()
+            except queue.Empty:
+                break
+            if conn is not None:
+                conn.close()
+
+    # -- low-level transport ----------------------------------------------
+
+    def _new_connection(self):
+        return _PooledConnection(
+            self._scheme,
+            self._host,
+            self._port,
+            self._connection_timeout,
+            self._network_timeout,
+            self._ssl_context,
+        )
+
+    def _request(self, method, request_uri, body=None, headers=None,
+                 query_params=None):
+        path = self._base_path + "/" + request_uri
+        if query_params is not None:
+            path = path + "?" + _get_query_string(query_params)
+        if self._verbose:
+            print(f"{method} {path}, headers {headers}")
+        hdrs = dict(headers) if headers else {}
+        if body is not None and "Content-Length" not in hdrs:
+            hdrs["Content-Length"] = str(len(body))
+        import http.client as _http_client
+
+        conn = self._pool.get()
+        try:
+            fresh = conn is None
+            if fresh:
+                conn = self._new_connection()
+            try:
+                status, resp_headers, resp_body = conn.request(
+                    method, path, body, hdrs
+                )
+            except (ConnectionError, OSError,
+                    _http_client.HTTPException) as e:
+                conn.close()
+                # Retry exactly once, and only when the failure is a stale
+                # keep-alive connection (pooled conn, not a timeout): a
+                # timeout may mean the server already executed this —
+                # resending a non-idempotent infer would double-execute it.
+                if fresh or isinstance(e, socket.timeout):
+                    raise
+                conn = self._new_connection()
+                try:
+                    status, resp_headers, resp_body = conn.request(
+                        method, path, body, hdrs
+                    )
+                except Exception:
+                    conn.close()
+                    raise
+        except Exception:
+            self._pool.put(None)
+            raise
+        else:
+            self._pool.put(conn)
+        if self._verbose:
+            print(status, resp_headers)
+        return status, resp_headers, resp_body
+
+    def _get(self, request_uri, headers=None, query_params=None):
+        return self._request("GET", request_uri, None, headers, query_params)
+
+    def _post(self, request_uri, request_body, headers=None,
+              query_params=None):
+        return self._request(
+            "POST", request_uri, request_body, headers, query_params
+        )
+
+    @staticmethod
+    def _raise_if_error(status, response_body):
+        if status != 200:
+            raise InferenceServerException(
+                msg=_get_error_message(response_body),
+                status=str(status),
+            )
+
+    def _get_json(self, request_uri, headers=None, query_params=None):
+        status, _, body = self._get(request_uri, headers, query_params)
+        self._raise_if_error(status, body)
+        content = json.loads(body) if body else {}
+        if self._verbose:
+            print(content)
+        return content
+
+    def _post_json(self, request_uri, request=None, headers=None,
+                   query_params=None):
+        body = json.dumps(request).encode("utf-8") if request is not None else b""
+        status, _, resp_body = self._post(
+            request_uri, body, headers, query_params
+        )
+        self._raise_if_error(status, resp_body)
+        content = json.loads(resp_body) if resp_body else {}
+        if self._verbose:
+            print(content)
+        return content
+
+    # -- health / metadata -------------------------------------------------
+
+    def is_server_live(self, headers=None, query_params=None):
+        """Contact the server's liveness endpoint; returns bool."""
+        status, _, _ = self._get("v2/health/live", headers, query_params)
+        return status == 200
+
+    def is_server_ready(self, headers=None, query_params=None):
+        """Contact the server's readiness endpoint; returns bool."""
+        status, _, _ = self._get("v2/health/ready", headers, query_params)
+        return status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None,
+                       query_params=None):
+        """Contact the model's readiness endpoint; returns bool."""
+        if model_version:
+            uri = "v2/models/{}/versions/{}/ready".format(
+                quote(model_name), model_version
+            )
+        else:
+            uri = "v2/models/{}/ready".format(quote(model_name))
+        status, _, _ = self._get(uri, headers, query_params)
+        return status == 200
+
+    def get_server_metadata(self, headers=None, query_params=None):
+        """Get server metadata as a dict."""
+        return self._get_json("v2", headers, query_params)
+
+    def get_model_metadata(self, model_name, model_version="", headers=None,
+                           query_params=None):
+        """Get model metadata as a dict."""
+        if model_version:
+            uri = "v2/models/{}/versions/{}".format(
+                quote(model_name), model_version
+            )
+        else:
+            uri = "v2/models/{}".format(quote(model_name))
+        return self._get_json(uri, headers, query_params)
+
+    def get_model_config(self, model_name, model_version="", headers=None,
+                         query_params=None):
+        """Get model configuration as a dict."""
+        if model_version:
+            uri = "v2/models/{}/versions/{}/config".format(
+                quote(model_name), model_version
+            )
+        else:
+            uri = "v2/models/{}/config".format(quote(model_name))
+        return self._get_json(uri, headers, query_params)
+
+    # -- repository control ------------------------------------------------
+
+    def get_model_repository_index(self, headers=None, query_params=None):
+        """Get the index of the model repository (list of dicts)."""
+        return self._post_json(
+            "v2/repository/index", None, headers, query_params
+        )
+
+    def load_model(self, model_name, headers=None, query_params=None,
+                   config=None, files=None):
+        """Request the server to load or reload the model.
+
+        ``config`` is an optional JSON config string override; ``files`` maps
+        file paths to base64 content for repository override (reference
+        grpc_client.h:232-256 / http/_client.py load_model).
+        """
+        load_request = {}
+        if config is not None or files is not None:
+            load_request["parameters"] = {}
+        if config is not None:
+            load_request["parameters"]["config"] = config
+        if files is not None:
+            for path, content in files.items():
+                load_request["parameters"][path] = base64.b64encode(
+                    content
+                ).decode("utf-8")
+        self._post_json(
+            "v2/repository/models/{}/load".format(quote(model_name)),
+            load_request if load_request else None,
+            headers,
+            query_params,
+        )
+
+    def unload_model(self, model_name, headers=None, query_params=None,
+                     unload_dependents=False):
+        """Request the server to unload the model."""
+        unload_request = {
+            "parameters": {"unload_dependents": unload_dependents}
+        }
+        self._post_json(
+            "v2/repository/models/{}/unload".format(quote(model_name)),
+            unload_request,
+            headers,
+            query_params,
+        )
+
+    # -- statistics / trace / logging -------------------------------------
+
+    def get_inference_statistics(self, model_name="", model_version="",
+                                 headers=None, query_params=None):
+        """Get per-model inference statistics as a dict."""
+        if model_name:
+            if model_version:
+                uri = "v2/models/{}/versions/{}/stats".format(
+                    quote(model_name), model_version
+                )
+            else:
+                uri = "v2/models/{}/stats".format(quote(model_name))
+        else:
+            uri = "v2/models/stats"
+        return self._get_json(uri, headers, query_params)
+
+    def update_trace_settings(self, model_name=None, settings={},
+                              headers=None, query_params=None):
+        """Update trace settings (server-global or per-model)."""
+        if model_name is not None and model_name != "":
+            uri = "v2/models/{}/trace/setting".format(quote(model_name))
+        else:
+            uri = "v2/trace/setting"
+        return self._post_json(uri, settings, headers, query_params)
+
+    def get_trace_settings(self, model_name=None, headers=None,
+                           query_params=None):
+        """Get trace settings (server-global or per-model)."""
+        if model_name is not None and model_name != "":
+            uri = "v2/models/{}/trace/setting".format(quote(model_name))
+        else:
+            uri = "v2/trace/setting"
+        return self._get_json(uri, headers, query_params)
+
+    def update_log_settings(self, settings, headers=None, query_params=None):
+        """Update the server's log settings."""
+        return self._post_json("v2/logging", settings, headers, query_params)
+
+    def get_log_settings(self, headers=None, query_params=None):
+        """Get the server's log settings."""
+        return self._get_json("v2/logging", headers, query_params)
+
+    # -- shared memory -----------------------------------------------------
+
+    def get_system_shared_memory_status(self, region_name="", headers=None,
+                                        query_params=None):
+        """Get the status of registered system shared-memory regions."""
+        if region_name:
+            uri = "v2/systemsharedmemory/region/{}/status".format(
+                quote(region_name)
+            )
+        else:
+            uri = "v2/systemsharedmemory/status"
+        return self._get_json(uri, headers, query_params)
+
+    def register_system_shared_memory(self, name, key, byte_size, offset=0,
+                                      headers=None, query_params=None):
+        """Register a system (POSIX) shared-memory region with the server."""
+        register_request = {
+            "key": key,
+            "offset": offset,
+            "byte_size": byte_size,
+        }
+        self._post_json(
+            "v2/systemsharedmemory/region/{}/register".format(quote(name)),
+            register_request,
+            headers,
+            query_params,
+        )
+        if self._verbose:
+            print("Registered system shared memory with name '{}'".format(name))
+
+    def unregister_system_shared_memory(self, name="", headers=None,
+                                        query_params=None):
+        """Unregister one (or all, if name empty) system shm regions."""
+        if name:
+            uri = "v2/systemsharedmemory/region/{}/unregister".format(
+                quote(name)
+            )
+        else:
+            uri = "v2/systemsharedmemory/unregister"
+        self._post_json(uri, None, headers, query_params)
+        if self._verbose:
+            if name:
+                print(
+                    "Unregistered system shared memory with name '{}'".format(
+                        name
+                    )
+                )
+            else:
+                print("Unregistered all system shared memory regions")
+
+    def get_cuda_shared_memory_status(self, region_name="", headers=None,
+                                      query_params=None):
+        """Get the status of registered CUDA shared-memory regions."""
+        if region_name:
+            uri = "v2/cudasharedmemory/region/{}/status".format(
+                quote(region_name)
+            )
+        else:
+            uri = "v2/cudasharedmemory/status"
+        return self._get_json(uri, headers, query_params)
+
+    def register_cuda_shared_memory(self, name, raw_handle, device_id,
+                                    byte_size, headers=None,
+                                    query_params=None):
+        """Register a CUDA shared-memory region; ``raw_handle`` is the
+        base64-encoded serialized cudaIpcMemHandle_t."""
+        register_request = {
+            "raw_handle": {"b64": raw_handle.decode("utf-8")
+                           if isinstance(raw_handle, bytes) else raw_handle},
+            "device_id": device_id,
+            "byte_size": byte_size,
+        }
+        self._post_json(
+            "v2/cudasharedmemory/region/{}/register".format(quote(name)),
+            register_request,
+            headers,
+            query_params,
+        )
+        if self._verbose:
+            print("Registered cuda shared memory with name '{}'".format(name))
+
+    def unregister_cuda_shared_memory(self, name="", headers=None,
+                                      query_params=None):
+        """Unregister one (or all, if name empty) CUDA shm regions."""
+        if name:
+            uri = "v2/cudasharedmemory/region/{}/unregister".format(quote(name))
+        else:
+            uri = "v2/cudasharedmemory/unregister"
+        self._post_json(uri, None, headers, query_params)
+
+    def get_xla_shared_memory_status(self, region_name="", headers=None,
+                                     query_params=None):
+        """Get the status of registered XLA/TPU shared-memory regions.
+
+        TPU-native analogue of ``get_cuda_shared_memory_status`` (reference
+        http_client.h:411-442)."""
+        if region_name:
+            uri = "v2/xlasharedmemory/region/{}/status".format(
+                quote(region_name)
+            )
+        else:
+            uri = "v2/xlasharedmemory/status"
+        return self._get_json(uri, headers, query_params)
+
+    def register_xla_shared_memory(self, name, raw_handle, device_ordinal,
+                                   byte_size, headers=None, query_params=None):
+        """Register an XLA/TPU-HBM shared-memory region with the server.
+
+        ``raw_handle`` is the base64-encoded serialized XlaShmHandle produced
+        by ``tritonclient.utils.xla_shared_memory.get_raw_handle``."""
+        register_request = {
+            "raw_handle": {"b64": raw_handle.decode("utf-8")
+                           if isinstance(raw_handle, bytes) else raw_handle},
+            "device_ordinal": device_ordinal,
+            "byte_size": byte_size,
+        }
+        self._post_json(
+            "v2/xlasharedmemory/region/{}/register".format(quote(name)),
+            register_request,
+            headers,
+            query_params,
+        )
+        if self._verbose:
+            print("Registered xla shared memory with name '{}'".format(name))
+
+    def unregister_xla_shared_memory(self, name="", headers=None,
+                                     query_params=None):
+        """Unregister one (or all, if name empty) XLA/TPU shm regions."""
+        if name:
+            uri = "v2/xlasharedmemory/region/{}/unregister".format(quote(name))
+        else:
+            uri = "v2/xlasharedmemory/unregister"
+        self._post_json(uri, None, headers, query_params)
+
+    # -- inference ---------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs,
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Generate an inference request body without sending it (reference
+        http/_client.py:1207-1260).  Returns (body_bytes, header_length)."""
+        return _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(response_body, verbose=False, header_length=None,
+                            content_encoding=None):
+        """Parse a raw inference response body into an InferResult."""
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding
+        )
+
+    def _infer_uri(self, model_name, model_version):
+        if model_version:
+            return "v2/models/{}/versions/{}/infer".format(
+                quote(model_name), model_version
+            )
+        return "v2/models/{}/infer".format(quote(model_name))
+
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ):
+        """Run a synchronous inference; returns an InferResult.
+
+        Mirrors reference http/_client.py:1315-1462 (binary-tensor protocol,
+        optional gzip/deflate compression both ways).
+        """
+        timers = RequestTimers()
+        timers.request_start()
+        request_body, json_size = _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+
+        hdrs = dict(headers) if headers else {}
+        if request_compression_algorithm == "gzip":
+            hdrs["Content-Encoding"] = "gzip"
+            request_body = _compress_request_body("gzip", request_body)
+        elif request_compression_algorithm == "deflate":
+            hdrs["Content-Encoding"] = "deflate"
+            request_body = _compress_request_body("deflate", request_body)
+        if response_compression_algorithm == "gzip":
+            hdrs["Accept-Encoding"] = "gzip"
+        elif response_compression_algorithm == "deflate":
+            hdrs["Accept-Encoding"] = "deflate"
+        if json_size is not None:
+            hdrs["Inference-Header-Content-Length"] = str(json_size)
+        hdrs.setdefault("Content-Type", "application/octet-stream")
+
+        timers.send_start()
+        try:
+            status, resp_headers, response_body = self._post(
+                self._infer_uri(model_name, model_version),
+                request_body,
+                hdrs,
+                query_params,
+            )
+            timers.send_end()
+            self._raise_if_error(status, response_body)
+        except Exception:
+            self._infer_stat.update(timers, success=False)
+            raise
+
+        header_length = resp_headers.get("Inference-Header-Content-Length")
+        content_encoding = resp_headers.get("Content-Encoding")
+        timers.recv_start()
+        result = InferResult.from_response_body(
+            response_body,
+            self._verbose,
+            int(header_length) if header_length is not None else None,
+            content_encoding,
+        )
+        timers.recv_end()
+        timers.request_end()
+        self._infer_stat.update(timers, success=True)
+        return result
+
+    def async_infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ):
+        """Run inference on a worker thread; returns an InferAsyncRequest
+        whose ``get_result()`` blocks for the InferResult (reference
+        http/_client.py:1464-1600, gevent pool -> thread pool)."""
+        with self._executor_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._concurrency,
+                    thread_name_prefix="tritonclient-http",
+                )
+        future = self._executor.submit(
+            self.infer,
+            model_name,
+            inputs,
+            model_version,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            headers,
+            query_params,
+            request_compression_algorithm,
+            response_compression_algorithm,
+            parameters,
+        )
+        return InferAsyncRequest(future, self._verbose)
+
+    def get_inference_stat(self):
+        """Client-side accumulated InferStat for this client's requests."""
+        return self._infer_stat

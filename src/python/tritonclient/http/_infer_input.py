@@ -1,0 +1,195 @@
+"""Input tensor descriptor for the HTTP client.
+
+Re-implements reference http/_infer_input.py (binary-aware
+``set_data_from_numpy`` incl. BYTES and BF16, shared-memory references) with a
+TPU-first extension: any array-like — including ``jax.Array`` — is accepted;
+bf16 arrays are serialized natively via ml_dtypes instead of requiring the
+fp32-truncation path.
+"""
+
+import numpy as np
+
+from tritonclient.utils import (
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+
+class InferInput:
+    """An input tensor for an inference request.
+
+    Parameters
+    ----------
+    name : str
+        The name of the input whose data will be described by this object.
+    shape : list
+        The shape of the associated input.
+    datatype : str
+        The datatype of the associated input.
+    """
+
+    def __init__(self, name, shape, datatype):
+        self._name = name
+        self._shape = list(shape)
+        self._datatype = datatype
+        self._parameters = {}
+        self._data = None
+        self._raw_data = None
+
+    def name(self):
+        """Get the name of the input associated with this object."""
+        return self._name
+
+    def datatype(self):
+        """Get the datatype of the input associated with this object."""
+        return self._datatype
+
+    def shape(self):
+        """Get the shape of the input associated with this object."""
+        return self._shape
+
+    def set_shape(self, shape):
+        """Set the shape of the input."""
+        self._shape = list(shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor, binary_data=True):
+        """Set the tensor data from the specified array-like.
+
+        Accepts ``np.ndarray`` (as the reference does) and any array-like with
+        an ``__array__`` protocol — notably ``jax.Array``, which is fetched
+        from device exactly once here (and not at all when using the
+        shared-memory paths; see ``set_shared_memory`` /
+        ``tritonclient.utils.xla_shared_memory``).
+
+        Parameters
+        ----------
+        input_tensor : np.ndarray or jax.Array
+            The tensor data.
+        binary_data : bool
+            Whether the data should be sent in the binary section of the
+            request (True, default) or inline in the JSON header (False).
+        """
+        if not isinstance(input_tensor, np.ndarray):
+            try:
+                input_tensor = np.asarray(input_tensor)
+            except Exception:
+                raise_error("input_tensor must be a numpy array or array-like")
+
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        if self._datatype != dtype:
+            # BF16 tensors may legitimately arrive as fp32 (the reference's
+            # only path) or as native bf16 arrays.
+            if not (
+                self._datatype == "BF16"
+                and input_tensor.dtype in (np.float32, np.float16, np.float64)
+            ):
+                raise_error(
+                    "got unexpected datatype {} from numpy array, expected {}".format(
+                        dtype, self._datatype
+                    )
+                )
+        valid_shape = True
+        if len(self._shape) != len(input_tensor.shape):
+            valid_shape = False
+        else:
+            for i in range(len(self._shape)):
+                if self._shape[i] != input_tensor.shape[i]:
+                    valid_shape = False
+        if not valid_shape:
+            raise_error(
+                "got unexpected numpy array shape [{}], expected [{}]".format(
+                    str(input_tensor.shape)[1:-1], str(self._shape)[1:-1]
+                )
+            )
+
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+
+        if not binary_data:
+            self._parameters.pop("binary_data_size", None)
+            self._raw_data = None
+            if self._datatype == "BYTES":
+                self._data = []
+                try:
+                    if input_tensor.size > 0:
+                        for obj in np.nditer(
+                            input_tensor, flags=["refs_ok"], order="C"
+                        ):
+                            # We need to convert the object to string using
+                            # utf-8 encoding for non-binary JSON transport.
+                            if input_tensor.dtype == np.object_:
+                                if type(obj.item()) == bytes:
+                                    self._data.append(
+                                        str(obj.item(), encoding="utf-8")
+                                    )
+                                else:
+                                    self._data.append(str(obj.item()))
+                            else:
+                                self._data.append(str(obj.item(), encoding="utf-8"))
+                except UnicodeDecodeError:
+                    raise_error(
+                        f'Failed to encode "{obj.item()}" using UTF-8. Please '
+                        "use binary_data=True, if you want to pass a byte array."
+                    )
+            elif self._datatype == "BF16":
+                raise_error(
+                    "BF16 inputs must use binary_data=True (no JSON "
+                    "representation exists for BF16)"
+                )
+            else:
+                self._data = [val.item() for val in input_tensor.flatten()]
+        else:
+            self._data = None
+            if self._datatype == "BYTES":
+                serialized_output = serialize_byte_tensor(input_tensor)
+                if serialized_output.size > 0:
+                    self._raw_data = serialized_output.item()
+                else:
+                    self._raw_data = b""
+            elif self._datatype == "BF16":
+                serialized_output = serialize_bf16_tensor(input_tensor)
+                if serialized_output.size > 0:
+                    self._raw_data = serialized_output.item()
+                else:
+                    self._raw_data = b""
+            else:
+                expected_np = triton_to_np_dtype(self._datatype)
+                if expected_np is not None and input_tensor.dtype != expected_np:
+                    input_tensor = input_tensor.astype(expected_np)
+                self._raw_data = np.ascontiguousarray(input_tensor).tobytes()
+            self._parameters["binary_data_size"] = len(self._raw_data)
+        return self
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        """Set the tensor data to come from a registered shared-memory region
+        (system, CUDA, or XLA/TPU — the region name resolves server-side)."""
+        self._data = None
+        self._raw_data = None
+        self._parameters.pop("binary_data_size", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset != 0:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def _get_binary_data(self):
+        """The raw bytes for the binary section of the request, or None."""
+        return self._raw_data
+
+    def _get_tensor(self):
+        """The JSON-serializable dict describing this input."""
+        tensor = {
+            "name": self._name,
+            "shape": self._shape,
+            "datatype": self._datatype,
+        }
+        if self._parameters:
+            tensor["parameters"] = self._parameters
+        if self._data is not None:
+            tensor["data"] = self._data
+        return tensor

@@ -1,0 +1,133 @@
+"""Inference result wrapper for the HTTP client.
+
+Parses the v2 response with the binary-tensor extension: a JSON header of
+``Inference-Header-Content-Length`` bytes followed by concatenated raw output
+buffers (reference http/_infer_result.py).
+"""
+
+import json
+
+import numpy as np
+
+from tritonclient._result_base import result_as_jax
+from tritonclient.http._utils import _decompress_response_body
+from tritonclient.utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    raise_error,
+    triton_to_np_dtype,
+)
+
+
+class InferResult:
+    """An object holding the result of an inference request."""
+
+    def __init__(self, response_body, verbose=False, header_length=None,
+                 content_encoding=None):
+        if content_encoding is not None:
+            response_body = _decompress_response_body(
+                content_encoding, response_body
+            )
+        self._output_name_to_buffer_map = {}
+        if header_length is None:
+            content = response_body
+            self._buffer = None
+        else:
+            content = response_body[:header_length]
+            self._buffer = response_body[header_length:]
+            # Binary buffers appear in output order, each of
+            # parameters.binary_data_size bytes.
+        if verbose:
+            print("infer response header:", content)
+        try:
+            self._result = json.loads(content)
+        except ValueError as e:
+            raise_error(
+                "unable to parse inference response JSON: {}".format(e)
+            )
+        if self._buffer is not None:
+            offset = 0
+            for output in self._result.get("outputs", []):
+                parameters = output.get("parameters", {})
+                if "binary_data_size" in parameters:
+                    size = parameters["binary_data_size"]
+                    self._output_name_to_buffer_map[output["name"]] = (
+                        offset,
+                        size,
+                    )
+                    offset += size
+
+    @classmethod
+    def from_response_body(
+        cls, response_body, verbose=False, header_length=None,
+        content_encoding=None
+    ):
+        """Build an InferResult from a raw response body (the static-path
+        twin of the constructor, reference http/_client.py:1207-1313)."""
+        return cls(response_body, verbose, header_length, content_encoding)
+
+    def get_response(self):
+        """Get the parsed response JSON (dict)."""
+        return self._result
+
+    def get_output(self, name):
+        """Get the output dict for the named output, or None."""
+        for output in self._result.get("outputs", []):
+            if output["name"] == name:
+                return output
+        return None
+
+    def as_numpy(self, name):
+        """Get the tensor data for the named output as a numpy array (or None
+        if the output is absent or lives in shared memory)."""
+        output = self.get_output(name)
+        if output is None:
+            return None
+        shape = output.get("shape", [])
+        datatype = output["datatype"]
+        parameters = output.get("parameters", {})
+        if name in self._output_name_to_buffer_map:
+            offset, size = self._output_name_to_buffer_map[name]
+            raw = self._buffer[offset : offset + size]
+            if datatype == "BYTES":
+                np_array = deserialize_bytes_tensor(raw)
+            elif datatype == "BF16":
+                np_array = deserialize_bf16_tensor(raw)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise_error("unknown response datatype " + datatype)
+                np_array = np.frombuffer(raw, dtype=np_dtype)
+            return np_array.reshape(shape)
+        if "data" not in output:
+            # output resides in shared memory
+            return None
+        if datatype == "BYTES":
+            np_array = np.array(
+                [
+                    d.encode("utf-8") if isinstance(d, str) else d
+                    for d in _flatten(output["data"])
+                ],
+                dtype=np.object_,
+            )
+        else:
+            np_dtype = triton_to_np_dtype(datatype)
+            np_array = np.array(_flatten(output["data"]), dtype=np_dtype)
+        return np_array.reshape(shape)
+
+    def as_jax(self, name, device=None):
+        """TPU-first accessor: the named output as a ``jax.Array`` (committed
+        to ``device`` if given).  BF16 outputs arrive as native bfloat16."""
+        return result_as_jax(self, name, device)
+
+
+def _flatten(data):
+    out = []
+    stack = [data]
+    while stack:
+        item = stack.pop()
+        if isinstance(item, list):
+            stack.extend(reversed(item))
+        else:
+            out.append(item)
+    return out

@@ -1,0 +1,58 @@
+"""Requested-output descriptor for the HTTP client (reference
+http/_infer_requested_output.py)."""
+
+from tritonclient.utils import raise_error
+
+
+class InferRequestedOutput:
+    """An output tensor requested from an inference.
+
+    Parameters
+    ----------
+    name : str
+        The name of the output.
+    binary_data : bool
+        Whether the output should be returned in the binary section of the
+        response (True, default) or inline in the JSON header.
+    class_count : int
+        If non-zero, request the output as a classification of the top
+        ``class_count`` results (forces JSON, not binary).
+    """
+
+    def __init__(self, name, binary_data=True, class_count=0):
+        self._name = name
+        self._parameters = {}
+        if class_count != 0:
+            self._parameters["classification"] = class_count
+            binary_data = False
+        self._binary = binary_data
+        self._parameters["binary_data"] = binary_data
+
+    def name(self):
+        """Get the name of the output associated with this object."""
+        return self._name
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        """Make the server write this output into a registered shared-memory
+        region (system, CUDA, or XLA/TPU)."""
+        if "classification" in self._parameters:
+            raise_error("shared memory can't be set on classification output")
+        if self._binary:
+            self._parameters["binary_data"] = False
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset != 0:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def unset_shared_memory(self):
+        """Clear any shared-memory reference on this output."""
+        self._parameters["binary_data"] = self._binary
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+        return self
+
+    def _get_tensor(self):
+        """The JSON-serializable dict describing this requested output."""
+        return {"name": self._name, "parameters": self._parameters}

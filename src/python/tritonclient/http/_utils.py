@@ -1,0 +1,122 @@
+"""Request-body assembly for the HTTP/REST v2 protocol with the binary-tensor
+extension.  Re-implements the behavior of reference http/_utils.py:74-131."""
+
+import gzip
+import json
+import zlib
+
+from tritonclient.utils import raise_error
+
+
+def _get_query_string(query_params):
+    params = []
+    for key, value in query_params.items():
+        if isinstance(value, (list, tuple)):
+            for item in value:
+                params.append("%s=%s" % (key, item))
+        else:
+            params.append("%s=%s" % (key, value))
+    if params:
+        return "&".join(params)
+    return ""
+
+
+def _get_inference_request(
+    inputs,
+    request_id,
+    outputs,
+    sequence_id,
+    sequence_start,
+    sequence_end,
+    priority,
+    timeout,
+    custom_parameters=None,
+):
+    """Build the request body: JSON header + concatenated raw tensor data.
+
+    Returns (request_body_bytes, json_size_or_None); json_size is None when
+    there is no trailing binary section (pure-JSON request).
+    """
+    infer_request = {}
+    parameters = {}
+    if request_id != "":
+        infer_request["id"] = request_id
+    if sequence_id != 0 and sequence_id != "":
+        parameters["sequence_id"] = sequence_id
+        parameters["sequence_start"] = sequence_start
+        parameters["sequence_end"] = sequence_end
+    if priority != 0:
+        parameters["priority"] = priority
+    if timeout is not None:
+        parameters["timeout"] = timeout
+
+    infer_request["inputs"] = [this_input._get_tensor() for this_input in inputs]
+    if outputs:
+        infer_request["outputs"] = [
+            this_output._get_tensor() for this_output in outputs
+        ]
+    else:
+        # no outputs specified => server returns all outputs; request binary
+        # form of all outputs via parameter (reference http/_utils.py:92-98)
+        parameters["binary_data_output"] = True
+
+    if custom_parameters:
+        for key, value in custom_parameters.items():
+            if key in (
+                "sequence_id",
+                "sequence_start",
+                "sequence_end",
+                "priority",
+                "binary_data_output",
+            ):
+                raise_error(
+                    f"Parameter {key} is a reserved parameter and cannot be "
+                    "specified as a custom parameter"
+                )
+            parameters[key] = value
+    if parameters:
+        infer_request["parameters"] = parameters
+
+    request_json = json.dumps(infer_request).encode("utf-8")
+
+    binary_chunks = []
+    for this_input in inputs:
+        raw = this_input._get_binary_data()
+        if raw is not None:
+            binary_chunks.append(raw)
+
+    if not binary_chunks:
+        return request_json, None
+    return request_json + b"".join(binary_chunks), len(request_json)
+
+
+def _compress_request_body(algorithm, body):
+    if algorithm == "gzip":
+        return gzip.compress(body)
+    if algorithm == "deflate":
+        return zlib.compress(body)
+    raise_error(f"Unsupported compression algorithm: {algorithm}")
+
+
+def _decompress_response_body(encoding, body):
+    if encoding == "gzip":
+        return gzip.decompress(body)
+    if encoding == "deflate":
+        return zlib.decompress(body)
+    return body
+
+
+def _get_error_message(response_body):
+    """Extract the error message from a non-OK response body (JSON 'error'
+    field or the plain-text body itself, reference tests
+    test_inference_server_client.py:45-101)."""
+    if not response_body:
+        return "(empty response body)"
+    try:
+        decoded = response_body.decode("utf-8", errors="replace")
+        parsed = json.loads(decoded)
+        if isinstance(parsed, dict) and "error" in parsed:
+            return parsed["error"]
+        return decoded
+    except (ValueError, AttributeError):
+        return response_body.decode("utf-8", errors="replace")

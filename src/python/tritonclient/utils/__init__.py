@@ -1,0 +1,244 @@
+"""Dtype mapping, tensor (de)serialization, and error types.
+
+Re-implements the surface of the reference ``tritonclient.utils``
+(reference src/python/library/tritonclient/utils/__init__.py:66-346) with a
+TPU-first treatment of BF16: on TPU hosts ``ml_dtypes.bfloat16`` (the dtype
+jax arrays use) is the native in-memory representation, so BF16 tensors move
+to/from the wire without the fp32-truncation dance the reference requires.
+The fp32-based helpers are still provided for API parity.
+"""
+
+import struct
+
+import numpy as np
+
+try:  # ml_dtypes ships with jaxlib; gives numpy a real bfloat16 dtype.
+    import ml_dtypes
+
+    _BF16_NP = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes is present wherever jax is
+    ml_dtypes = None
+    _BF16_NP = None
+
+__all__ = [
+    "InferenceServerException",
+    "np_to_triton_dtype",
+    "triton_to_np_dtype",
+    "serialize_byte_tensor",
+    "deserialize_bytes_tensor",
+    "serialize_bf16_tensor",
+    "deserialize_bf16_tensor",
+    "serialized_byte_size",
+    "raise_error",
+]
+
+
+class InferenceServerException(Exception):
+    """Exception indicating a non-successful status from the server or client.
+
+    Mirrors reference utils/__init__.py:66-125.
+    """
+
+    def __init__(self, msg, status=None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self):
+        msg = super().__str__() if self._msg is None else self._msg
+        if self._status is not None:
+            msg = "[" + self._status + "] " + msg
+        return msg
+
+    def message(self):
+        """Get the exception message."""
+        return self._msg
+
+    def status(self):
+        """Get the status of the exception, or None."""
+        return self._status
+
+    def debug_details(self):
+        """Get the detailed information about the exception, or None."""
+        return self._debug_details
+
+
+def raise_error(msg):
+    """Raise an InferenceServerException with the given message."""
+    raise InferenceServerException(msg=msg)
+
+
+# Triton wire dtype string <-> numpy dtype. BF16 maps to ml_dtypes.bfloat16
+# (jax-native) rather than being unsupported-in-numpy as in the reference
+# (utils/__init__.py:128-185, where BF16 returns None).
+_TRITON_TO_NP = {
+    "BOOL": bool,
+    "INT8": np.int8,
+    "INT16": np.int16,
+    "INT32": np.int32,
+    "INT64": np.int64,
+    "UINT8": np.uint8,
+    "UINT16": np.uint16,
+    "UINT32": np.uint32,
+    "UINT64": np.uint64,
+    "FP16": np.float16,
+    "FP32": np.float32,
+    "FP64": np.float64,
+    "BYTES": np.object_,
+}
+
+
+def np_to_triton_dtype(np_dtype):
+    """Map a numpy (or ml_dtypes) dtype to the Triton wire dtype string."""
+    if np_dtype == bool:
+        return "BOOL"
+    elif np_dtype == np.int8:
+        return "INT8"
+    elif np_dtype == np.int16:
+        return "INT16"
+    elif np_dtype == np.int32:
+        return "INT32"
+    elif np_dtype == np.int64:
+        return "INT64"
+    elif np_dtype == np.uint8:
+        return "UINT8"
+    elif np_dtype == np.uint16:
+        return "UINT16"
+    elif np_dtype == np.uint32:
+        return "UINT32"
+    elif np_dtype == np.uint64:
+        return "UINT64"
+    elif np_dtype == np.float16:
+        return "FP16"
+    elif _BF16_NP is not None and np_dtype == _BF16_NP:
+        return "BF16"
+    elif np_dtype == np.float32:
+        return "FP32"
+    elif np_dtype == np.float64:
+        return "FP64"
+    elif np_dtype == np.object_ or np.dtype(np_dtype).type == np.bytes_ or (
+        np.dtype(np_dtype).type == np.str_
+    ):
+        return "BYTES"
+    return None
+
+
+def triton_to_np_dtype(dtype):
+    """Map a Triton wire dtype string to a numpy dtype.
+
+    ``BF16`` maps to ``ml_dtypes.bfloat16`` (TPU-native); the reference
+    returns None for BF16 (utils/__init__.py:180-182).
+    """
+    if dtype == "BF16":
+        return _BF16_NP
+    return _TRITON_TO_NP.get(dtype)
+
+
+def serialize_byte_tensor(input_tensor):
+    """Serialize a BYTES tensor into the 4-byte-length-prefixed flat buffer.
+
+    Row-major (C-order) traversal; each element is a little-endian uint32
+    length followed by the element bytes.  Mirrors reference
+    utils/__init__.py:188-240.
+
+    Returns a np.object_ scalar-less ``np.array`` wrapping the flat buffer
+    (so ``.item()`` / ``.tobytes()`` yield the bytes), matching the
+    reference's return convention.
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+
+    if (input_tensor.dtype != np.object_) and (
+        input_tensor.dtype.type != np.bytes_
+    ) and (input_tensor.dtype.type != np.str_):
+        raise_error("cannot serialize bytes tensor: invalid datatype")
+
+    flattened_ls = []
+    # C-order flatten so multidimensional BYTES tensors round-trip with the
+    # row-major layout the server expects.
+    for obj in np.nditer(input_tensor, flags=["refs_ok"], order="C"):
+        # If unicode, encode to utf-8; bytes pass through unchanged.
+        s = obj.item()
+        if type(s) == bytes:
+            b = s
+        else:
+            b = str(s).encode("utf-8")
+        flattened_ls.append(struct.pack("<I", len(b)))
+        flattened_ls.append(b)
+    flattened = b"".join(flattened_ls)
+    flattened_array = np.asarray(flattened, dtype=np.object_)
+    if not flattened_array.flags["C_CONTIGUOUS"]:
+        flattened_array = np.ascontiguousarray(flattened_array, dtype=np.object_)
+    return flattened_array
+
+
+def deserialize_bytes_tensor(encoded_tensor):
+    """Inverse of :func:`serialize_byte_tensor`: flat buffer -> 1-D np.object_
+    array of ``bytes``.  Mirrors reference utils/__init__.py:243-273."""
+    strs = []
+    offset = 0
+    val_buf = encoded_tensor
+    while offset < len(val_buf):
+        (length,) = struct.unpack_from("<I", val_buf, offset)
+        offset += 4
+        sb = struct.unpack_from("<{}s".format(length), val_buf, offset)[0]
+        offset += length
+        strs.append(sb)
+    return np.array(strs, dtype=np.object_)
+
+
+def serialize_bf16_tensor(input_tensor):
+    """Serialize an fp32/bf16 tensor to raw BF16 little-endian bytes.
+
+    The reference (utils/__init__.py:276-318) truncates fp32 bit patterns to
+    their upper 16 bits.  Here: if ml_dtypes is available the conversion is a
+    native astype (round-to-nearest-even, what the TPU itself does); tensors
+    already in bfloat16 are serialized zero-conversion.
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+
+    if _BF16_NP is not None:
+        if input_tensor.dtype == _BF16_NP:
+            arr = np.ascontiguousarray(input_tensor)
+        elif input_tensor.dtype in (np.float32, np.float16, np.float64):
+            arr = np.ascontiguousarray(input_tensor).astype(_BF16_NP)
+        else:
+            raise_error(
+                "cannot serialize bf16 tensor: invalid datatype "
+                + str(input_tensor.dtype)
+            )
+        return np.asarray(arr.tobytes(), dtype=np.object_)
+
+    # Fallback: bit-level truncation of fp32, as the reference does.
+    if input_tensor.dtype != np.float32:
+        raise_error("cannot serialize bf16 tensor: invalid datatype")
+    u32 = np.ascontiguousarray(input_tensor, dtype=np.float32).view(np.uint32)
+    u16 = (u32 >> 16).astype("<u2")
+    return np.asarray(u16.tobytes(), dtype=np.object_)
+
+
+def deserialize_bf16_tensor(encoded_tensor):
+    """Deserialize raw BF16 bytes.
+
+    With ml_dtypes present returns a 1-D ``bfloat16`` array (zero-copy view,
+    TPU/jax-native); otherwise widens to fp32 as the reference does
+    (utils/__init__.py:321-346).
+    """
+    if _BF16_NP is not None:
+        return np.frombuffer(encoded_tensor, dtype=_BF16_NP)
+    u16 = np.frombuffer(encoded_tensor, dtype="<u2")
+    return (u16.astype(np.uint32) << 16).view(np.float32)
+
+
+def serialized_byte_size(tensor_value):
+    """Byte size a tensor occupies on the wire (after BYTES/BF16 encoding)."""
+    if tensor_value.dtype == np.object_:
+        total = 0
+        for obj in np.nditer(tensor_value, flags=["refs_ok"], order="C"):
+            s = obj.item()
+            b = s if type(s) == bytes else str(s).encode("utf-8")
+            total += 4 + len(b)
+        return total
+    return tensor_value.nbytes

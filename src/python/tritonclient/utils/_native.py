@@ -1,0 +1,42 @@
+"""Locate (and if necessary build) the native helper libraries.
+
+The reference wheels bundle prebuilt ``libcshm.so``/``libccudashm.so``
+(reference setup.py:60-80); in this source tree the shims are compiled on
+first use with g++ and cached under ``build/lib``.
+"""
+
+import os
+import subprocess
+import threading
+
+_LOCK = threading.Lock()
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..", "..")
+)
+_BUILD_DIR = os.path.join(_REPO_ROOT, "build", "lib")
+
+
+def _source_path(*parts):
+    return os.path.join(_REPO_ROOT, "src", "c++", *parts)
+
+
+def load_or_build(lib_name, sources, extra_flags=()):
+    """Return a ctypes.CDLL for ``lib_name``, compiling it if needed."""
+    import ctypes
+
+    lib_path = os.path.join(_BUILD_DIR, lib_name)
+    with _LOCK:
+        srcs = [_source_path(*s) if isinstance(s, tuple) else s
+                for s in sources]
+        needs_build = not os.path.exists(lib_path) or any(
+            os.path.getmtime(s) > os.path.getmtime(lib_path) for s in srcs
+        )
+        if needs_build:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            cmd = (
+                ["g++", "-shared", "-fPIC", "-O2", "-o", lib_path]
+                + srcs
+                + list(extra_flags)
+            )
+            subprocess.run(cmd, check=True, capture_output=True)
+    return ctypes.CDLL(lib_path)

@@ -1,0 +1,51 @@
+"""CUDA shared-memory utilities — API-parity module.
+
+The reference implements this over the CUDA runtime
+(cuda_shared_memory/__init__.py:97-295).  This TPU-native stack targets hosts
+without CUDA; the module keeps the reference API importable and raises a
+descriptive error on use, pointing at ``tritonclient.utils.xla_shared_memory``
+(the TPU generalization of this data plane).  If a CUDA runtime is present
+(dual-accelerator host), the calls fail with the dlopen error instead.
+"""
+
+import ctypes
+import ctypes.util
+
+__all__ = [
+    "CudaSharedMemoryException",
+    "create_shared_memory_region",
+    "get_raw_handle",
+    "set_shared_memory_region",
+    "get_contents_as_numpy",
+    "allocated_shared_memory_regions",
+    "destroy_shared_memory_region",
+]
+
+
+class CudaSharedMemoryException(Exception):
+    """Exception indicating a CUDA shared-memory error."""
+
+
+def _unavailable(*_args, **_kwargs):
+    libcudart = ctypes.util.find_library("cudart")
+    if libcudart is None:
+        raise CudaSharedMemoryException(
+            "CUDA shared memory is unavailable: no CUDA runtime on this "
+            "host. On TPU hosts use tritonclient.utils.xla_shared_memory, "
+            "which provides the same region/handle workflow over TPU HBM."
+        )
+    raise CudaSharedMemoryException(
+        "CUDA shared memory support is not built into this TPU-native "
+        "client (found {}).".format(libcudart)
+    )
+
+
+create_shared_memory_region = _unavailable
+get_raw_handle = _unavailable
+set_shared_memory_region = _unavailable
+get_contents_as_numpy = _unavailable
+destroy_shared_memory_region = _unavailable
+
+
+def allocated_shared_memory_regions():
+    return []

@@ -1,0 +1,205 @@
+"""System (POSIX) shared-memory utilities.
+
+Mirrors the API of reference tritonclient/utils/shared_memory/__init__.py:
+94-287 — ctypes bindings over a small native shim (``libcshm.so``, built from
+src/c++/library/cshm.cc) providing shm_open/mmap-backed regions that a
+co-located server registers via ``register_system_shared_memory``.
+"""
+
+import ctypes
+
+import numpy as np
+
+from tritonclient.utils import (
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)
+from tritonclient.utils._native import load_or_build
+
+__all__ = [
+    "SharedMemoryException",
+    "SharedMemoryRegionHandle",
+    "create_shared_memory_region",
+    "set_shared_memory_region",
+    "get_contents_as_numpy",
+    "mapped_shared_memory_regions",
+    "destroy_shared_memory_region",
+]
+
+_cshm = load_or_build("libcshm.so", [("library", "cshm.cc")], ["-lrt"])
+_cshm.TpuShmRegionCreate.restype = ctypes.c_int
+_cshm.TpuShmRegionCreate.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_void_p),
+]
+_cshm.TpuShmRegionOpen.restype = ctypes.c_int
+_cshm.TpuShmRegionOpen.argtypes = [
+    ctypes.c_char_p,
+    ctypes.c_size_t,
+    ctypes.c_size_t,
+    ctypes.POINTER(ctypes.c_int),
+    ctypes.POINTER(ctypes.c_void_p),
+]
+_cshm.TpuShmRegionSet.restype = ctypes.c_int
+_cshm.TpuShmRegionSet.argtypes = [
+    ctypes.c_void_p,
+    ctypes.c_size_t,
+    ctypes.c_size_t,
+    ctypes.c_void_p,
+]
+_cshm.TpuShmRegionGet.restype = ctypes.c_int
+_cshm.TpuShmRegionGet.argtypes = [
+    ctypes.c_void_p,
+    ctypes.c_size_t,
+    ctypes.c_size_t,
+    ctypes.c_void_p,
+]
+_cshm.TpuShmRegionClose.restype = ctypes.c_int
+_cshm.TpuShmRegionClose.argtypes = [
+    ctypes.c_int,
+    ctypes.c_void_p,
+    ctypes.c_size_t,
+]
+_cshm.TpuShmRegionUnlink.restype = ctypes.c_int
+_cshm.TpuShmRegionUnlink.argtypes = [ctypes.c_char_p]
+
+_ERROR_STRINGS = {
+    -1: "unable to open/create shared memory region",
+    -2: "unable to size shared memory region",
+    -3: "unable to map shared memory region",
+    -4: "unable to unmap/close shared memory region",
+    -5: "unable to unlink shared memory region",
+}
+
+
+class SharedMemoryException(Exception):
+    """Exception indicating a shared-memory error."""
+
+    def __init__(self, err):
+        msg = _ERROR_STRINGS.get(err, str(err)) if isinstance(
+            err, int
+        ) else str(err)
+        self._msg = msg
+        super().__init__(msg)
+
+    def __str__(self):
+        return self._msg
+
+
+class SharedMemoryRegionHandle:
+    """Handle for a created/opened system shm region."""
+
+    def __init__(self, triton_shm_name, shm_key, shm_fd, base, byte_size,
+                 offset=0):
+        self.triton_shm_name = triton_shm_name
+        self.shm_key = shm_key
+        self.shm_fd = shm_fd
+        self.base = base
+        self.byte_size = byte_size
+        self.offset = offset
+        self.closed = False
+
+
+_mapped_regions = {}  # shm_key -> handle
+
+
+def create_shared_memory_region(triton_shm_name, shm_key, byte_size,
+                                create_only=False):
+    """Create (or open existing, unless ``create_only``) a system shm region.
+
+    Returns a SharedMemoryRegionHandle usable with the other functions here
+    and registrable via ``client.register_system_shared_memory(name, key,
+    byte_size)``.
+    """
+    fd = ctypes.c_int()
+    base = ctypes.c_void_p()
+    rc = _cshm.TpuShmRegionCreate(
+        shm_key.encode("utf-8"), byte_size, ctypes.byref(fd),
+        ctypes.byref(base)
+    )
+    if rc != 0:
+        raise SharedMemoryException(rc)
+    handle = SharedMemoryRegionHandle(
+        triton_shm_name, shm_key, fd.value, base.value, byte_size
+    )
+    _mapped_regions[shm_key] = handle
+    return handle
+
+
+def set_shared_memory_region(shm_handle, input_values, offset=0):
+    """Write the (list of) numpy/jax arrays consecutively into the region
+    starting at ``offset``; BYTES tensors use their serialized form."""
+    if not isinstance(input_values, (list, tuple)):
+        raise SharedMemoryException(
+            "input_values must be specified as a list/tuple of numpy arrays"
+        )
+    offset_current = offset
+    for input_value in input_values:
+        input_value = np.asarray(input_value)
+        if input_value.dtype == np.object_ or input_value.dtype.type in (
+            np.bytes_,
+            np.str_,
+        ):
+            serialized = serialize_byte_tensor(input_value)
+            data = serialized.item() if serialized.size > 0 else b""
+        else:
+            data = np.ascontiguousarray(input_value).tobytes()
+        if offset_current + len(data) > shm_handle.byte_size:
+            raise SharedMemoryException(
+                "unable to set shared memory region: data exceeds region size"
+            )
+        rc = _cshm.TpuShmRegionSet(
+            shm_handle.base, offset_current, len(data), data
+        )
+        if rc != 0:
+            raise SharedMemoryException(rc)
+        offset_current += len(data)
+
+
+def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
+    """Read a tensor of the given numpy datatype/shape out of the region."""
+    from tritonclient.utils import deserialize_bytes_tensor
+
+    np_dtype = np.dtype(datatype) if not isinstance(
+        datatype, np.dtype
+    ) else datatype
+    if np_dtype == np.object_:
+        nbytes = shm_handle.byte_size - offset
+        buf = (ctypes.c_char * nbytes)()
+        rc = _cshm.TpuShmRegionGet(shm_handle.base, offset, nbytes, buf)
+        if rc != 0:
+            raise SharedMemoryException(rc)
+        return deserialize_bytes_tensor(bytes(buf))[
+            : int(np.prod(shape))
+        ].reshape(shape)
+    count = int(np.prod(shape)) if len(shape) > 0 else 1
+    nbytes = count * np_dtype.itemsize
+    buf = (ctypes.c_char * nbytes)()
+    rc = _cshm.TpuShmRegionGet(shm_handle.base, offset, nbytes, buf)
+    if rc != 0:
+        raise SharedMemoryException(rc)
+    return np.frombuffer(bytes(buf), dtype=np_dtype).reshape(shape)
+
+
+def mapped_shared_memory_regions():
+    """List the shm keys of regions mapped in this process."""
+    return list(_mapped_regions.keys())
+
+
+def destroy_shared_memory_region(shm_handle):
+    """Unmap and unlink the region."""
+    if shm_handle.closed:
+        return
+    rc = _cshm.TpuShmRegionClose(
+        shm_handle.shm_fd, shm_handle.base, shm_handle.byte_size
+    )
+    shm_handle.closed = True
+    _mapped_regions.pop(shm_handle.shm_key, None)
+    rc2 = _cshm.TpuShmRegionUnlink(shm_handle.shm_key.encode("utf-8"))
+    if rc != 0:
+        raise SharedMemoryException(rc)
+    if rc2 != 0:
+        raise SharedMemoryException(rc2)

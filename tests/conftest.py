@@ -1,0 +1,41 @@
+import os
+import sys
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+# exercised without TPU hardware (set before jax import).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+if SRC_PY not in sys.path:
+    sys.path.insert(0, SRC_PY)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def server_core():
+    """A shared in-process server core with the fixture model zoo."""
+    from tpuserver.core import InferenceServer
+    from tpuserver.models import default_models
+
+    return InferenceServer(default_models())
+
+
+@pytest.fixture(scope="session")
+def http_server(server_core):
+    from tpuserver.http_frontend import HttpFrontend
+
+    frontend = HttpFrontend(server_core, port=0).start()
+    yield frontend
+    frontend.stop()
+
+
+@pytest.fixture(scope="session")
+def http_url(http_server):
+    return http_server.url

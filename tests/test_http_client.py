@@ -1,0 +1,254 @@
+"""End-to-end tests: tritonclient.http against the in-process tpuserver HTTP
+frontend (the 'minimum end-to-end slice' of SURVEY.md §7.4)."""
+
+import numpy as np
+import pytest
+
+import tritonclient.http as httpclient
+from tritonclient.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def client(http_url):
+    with httpclient.InferenceServerClient(http_url, concurrency=4) as c:
+        yield c
+
+
+def test_server_live_ready(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+
+
+def test_model_ready(client):
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("nonexistent_model")
+
+
+def test_server_metadata(client):
+    meta = client.get_server_metadata()
+    assert meta["name"] == "tpu-triton-server"
+    assert "xla_shared_memory" in meta["extensions"]
+
+
+def test_model_metadata(client):
+    meta = client.get_model_metadata("simple")
+    assert meta["name"] == "simple"
+    assert {t["name"] for t in meta["inputs"]} == {"INPUT0", "INPUT1"}
+
+
+def test_model_config(client):
+    cfg = client.get_model_config("simple")
+    assert cfg["name"] == "simple"
+    assert cfg["max_batch_size"] == 8
+
+
+def test_repository_index_and_load_unload(client):
+    index = client.get_model_repository_index()
+    names = {m["name"] for m in index}
+    assert "simple" in names
+    client.unload_model("simple")
+    assert not client.is_model_ready("simple")
+    client.load_model("simple")
+    assert client.is_model_ready("simple")
+
+
+def _simple_inputs(binary=True):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0, binary_data=binary)
+    inputs[1].set_data_from_numpy(in1, binary_data=binary)
+    return in0, in1, inputs
+
+
+def test_infer_simple_binary(client):
+    in0, in1, inputs = _simple_inputs(binary=True)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
+    ]
+    result = client.infer("simple", inputs, outputs=outputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_simple_json(client):
+    in0, in1, inputs = _simple_inputs(binary=False)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=False),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+    ]
+    result = client.infer("simple", inputs, outputs=outputs, request_id="42")
+    assert result.get_response()["id"] == "42"
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_default_outputs(client):
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer("simple", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+
+
+def test_infer_compression(client):
+    in0, in1, inputs = _simple_inputs()
+    for algo in ("gzip", "deflate"):
+        result = client.infer(
+            "simple",
+            inputs,
+            request_compression_algorithm=algo,
+            response_compression_algorithm=algo,
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_async_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    requests = [client.async_infer("simple", inputs) for _ in range(8)]
+    for req in requests:
+        result = req.get_result()
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_infer_string_model(client):
+    in0 = np.array([str(i).encode() for i in range(16)],
+                   dtype=np.object_).reshape(1, 16)
+    in1 = np.array([b"1"] * 16, dtype=np.object_).reshape(1, 16)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "BYTES"),
+        httpclient.InferInput("INPUT1", [1, 16], "BYTES"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    result = client.infer("simple_string", inputs)
+    out0 = result.as_numpy("OUTPUT0")
+    assert [int(v) for v in out0.reshape(-1)] == [i + 1 for i in range(16)]
+
+
+def test_infer_string_json_path(client):
+    arr = np.array(["alpha", "beta"], dtype=np.object_)
+    inp = httpclient.InferInput("INPUT0", [2], "BYTES")
+    inp.set_data_from_numpy(arr, binary_data=False)
+    out = httpclient.InferRequestedOutput("OUTPUT0", binary_data=False)
+    result = client.infer("identity_string", [inp], outputs=[out])
+    assert result.as_numpy("OUTPUT0").tolist() == [b"alpha", b"beta"]
+
+
+def test_infer_bf16(client):
+    import ml_dtypes
+
+    arr = np.array([[0.5, 1.5, -2.0, 8.0]], dtype=ml_dtypes.bfloat16)
+    inp = httpclient.InferInput("INPUT0", [1, 4], "BF16")
+    inp.set_data_from_numpy(arr)
+    result = client.infer("identity_bf16", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_infer_bf16_from_fp32(client):
+    arr = np.array([[0.5, 1.25]], dtype=np.float32)
+    inp = httpclient.InferInput("INPUT0", [1, 2], "BF16")
+    inp.set_data_from_numpy(arr)
+    result = client.infer("identity_bf16", [inp])
+    np.testing.assert_allclose(
+        result.as_numpy("OUTPUT0").astype(np.float32), arr, rtol=1e-2
+    )
+
+
+def test_infer_jax_input(client):
+    import jax.numpy as jnp
+
+    in0 = jnp.arange(16, dtype=jnp.int32).reshape(1, 16)
+    in1 = jnp.ones((1, 16), dtype=jnp.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    result = client.infer("simple", inputs)
+    out_jax = result.as_jax("OUTPUT0")
+    np.testing.assert_array_equal(
+        np.asarray(out_jax), np.asarray(in0 + in1)
+    )
+
+
+def test_infer_error_unknown_model(client):
+    in0, in1, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException) as exc:
+        client.infer("does_not_exist", inputs)
+    assert "unknown model" in str(exc.value)
+
+
+def test_infer_error_wrong_input_name(client):
+    inp = httpclient.InferInput("WRONG", [1, 16], "INT32")
+    inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.int32))
+    with pytest.raises(InferenceServerException):
+        client.infer("simple", [inp])
+
+
+def test_input_shape_validation():
+    inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    with pytest.raises(InferenceServerException):
+        inp.set_data_from_numpy(np.zeros((2, 16), dtype=np.int32))
+
+
+def test_input_dtype_validation():
+    inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    with pytest.raises(InferenceServerException):
+        inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.float32))
+
+
+def test_sequence_model(client):
+    total = 0
+    for i, (start, end) in enumerate([(True, False), (False, False),
+                                      (False, True)]):
+        val = i + 1
+        total += val
+        inp = httpclient.InferInput("INPUT", [1], "INT32")
+        inp.set_data_from_numpy(np.array([val], dtype=np.int32))
+        result = client.infer(
+            "sequence_accumulate",
+            [inp],
+            sequence_id=99,
+            sequence_start=start,
+            sequence_end=end,
+        )
+        assert result.as_numpy("OUTPUT")[0] == total
+
+
+def test_statistics(client):
+    stats = client.get_inference_statistics("simple")
+    entry = stats["model_stats"][0]
+    assert entry["name"] == "simple"
+    assert entry["inference_count"] > 0
+    assert entry["inference_stats"]["success"]["count"] > 0
+
+
+def test_trace_and_log_settings(client):
+    settings = client.get_trace_settings()
+    assert "trace_level" in settings
+    updated = client.update_trace_settings(
+        settings={"trace_level": ["TIMESTAMPS"]}
+    )
+    assert updated["trace_level"] == ["TIMESTAMPS"]
+    log = client.get_log_settings()
+    assert "log_verbose_level" in log
+    updated = client.update_log_settings({"log_verbose_level": 2})
+    assert updated["log_verbose_level"] == 2
+
+
+def test_generate_request_body_static():
+    in0 = np.zeros((1, 16), dtype=np.int32)
+    inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    inp.set_data_from_numpy(in0)
+    body, header_len = httpclient.InferenceServerClient.generate_request_body(
+        [inp]
+    )
+    assert header_len is not None
+    assert body[header_len:] == in0.tobytes()

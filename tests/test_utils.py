@@ -1,0 +1,101 @@
+"""Unit tests for tritonclient.utils: dtype mapping and tensor
+(de)serialization (modeled on the reference's utils coverage)."""
+
+import numpy as np
+import pytest
+
+from tritonclient.utils import (
+    InferenceServerException,
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    np_to_triton_dtype,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    serialized_byte_size,
+    triton_to_np_dtype,
+)
+
+
+@pytest.mark.parametrize(
+    "np_dtype,triton",
+    [
+        (bool, "BOOL"),
+        (np.int8, "INT8"),
+        (np.int16, "INT16"),
+        (np.int32, "INT32"),
+        (np.int64, "INT64"),
+        (np.uint8, "UINT8"),
+        (np.uint16, "UINT16"),
+        (np.uint32, "UINT32"),
+        (np.uint64, "UINT64"),
+        (np.float16, "FP16"),
+        (np.float32, "FP32"),
+        (np.float64, "FP64"),
+        (np.object_, "BYTES"),
+    ],
+)
+def test_dtype_roundtrip(np_dtype, triton):
+    assert np_to_triton_dtype(np_dtype) == triton
+    if triton != "BYTES":
+        assert triton_to_np_dtype(triton) == np_dtype
+
+
+def test_bf16_dtype_is_native():
+    import ml_dtypes
+
+    assert triton_to_np_dtype("BF16") == np.dtype(ml_dtypes.bfloat16)
+    assert np_to_triton_dtype(np.dtype(ml_dtypes.bfloat16)) == "BF16"
+
+
+def test_bytes_tensor_roundtrip():
+    arr = np.array([b"one", b"", b"three33", "four".encode()], dtype=np.object_)
+    enc = serialize_byte_tensor(arr).item()
+    # each element: 4-byte little-endian length prefix
+    assert enc[:4] == (3).to_bytes(4, "little")
+    dec = deserialize_bytes_tensor(enc)
+    assert dec.tolist() == [b"one", b"", b"three33", b"four"]
+    assert serialized_byte_size(arr) == len(enc)
+
+
+def test_bytes_tensor_multidim_c_order():
+    arr = np.array([[b"a", b"bb"], [b"ccc", b"dddd"]], dtype=np.object_)
+    dec = deserialize_bytes_tensor(serialize_byte_tensor(arr).item())
+    assert dec.tolist() == [b"a", b"bb", b"ccc", b"dddd"]
+
+
+def test_bytes_tensor_unicode():
+    arr = np.array(["héllo"], dtype=np.object_)
+    dec = deserialize_bytes_tensor(serialize_byte_tensor(arr).item())
+    assert dec[0].decode("utf-8") == "héllo"
+
+
+def test_empty_bytes_tensor():
+    arr = np.array([], dtype=np.object_)
+    assert serialize_byte_tensor(arr).size == 0
+
+
+def test_bf16_roundtrip_from_fp32():
+    arr = np.array([1.0, -2.5, 3.14159, 1e30], dtype=np.float32)
+    enc = serialize_bf16_tensor(arr).item()
+    assert len(enc) == 4 * 2
+    dec = deserialize_bf16_tensor(enc).astype(np.float32)
+    # bf16 has ~3 decimal digits
+    np.testing.assert_allclose(dec, arr, rtol=1e-2)
+
+
+def test_bf16_roundtrip_native():
+    import ml_dtypes
+
+    arr = np.array([0.5, 1.5, -8.0], dtype=ml_dtypes.bfloat16)
+    enc = serialize_bf16_tensor(arr).item()
+    dec = deserialize_bf16_tensor(enc)
+    assert dec.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(dec, arr)
+
+
+def test_exception_fields():
+    e = InferenceServerException("boom", status="400", debug_details="d")
+    assert e.message() == "boom"
+    assert e.status() == "400"
+    assert e.debug_details() == "d"
+    assert "[400] boom" == str(e)

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Regenerate Python protobuf stubs for the KServe-v2 wire protocol.
+# The gRPC service stub layer is hand-written (tritonclient/grpc/_service.py)
+# because grpcio-tools is not available in this image; only message classes
+# are generated here.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT=src/python/tritonclient/grpc
+protoc -Iproto --python_out="$OUT" proto/model_config.proto proto/grpc_service.proto
+# Make the generated import package-relative.
+sed -i 's/^import model_config_pb2 as/from . import model_config_pb2 as/' \
+  "$OUT/grpc_service_pb2.py"
+echo "generated: $OUT/{model_config_pb2.py,grpc_service_pb2.py}"
