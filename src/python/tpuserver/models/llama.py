@@ -1,0 +1,404 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+The flagship compute graph behind BASELINE config #5 ("Llama-3-8B decoupled
+streaming").  This is NOT a torch port: parameters are a plain pytree of
+``jnp.bfloat16`` arrays, the forward pass is pure einsum (MXU-shaped), all
+control flow is static or ``lax``-level, and scale-out is expressed only as
+``NamedSharding`` rules over a (dp, sp, tp) mesh — XLA inserts the
+collectives.  Long context runs as a ``shard_map`` ring-attention program
+over the ``sp`` axis (tpuserver/parallel/ring.py).
+
+Pieces:
+- ``LlamaConfig`` presets (``tiny`` test size → ``llama3_8b``)
+- ``init_params`` / ``param_specs`` (Megatron column/row tp split)
+- ``forward`` (teacher-forcing logits; dense or ring attention)
+- ``train_step`` factory (cross-entropy + optax adamw) for the multi-chip
+  dry-run
+- ``init_kv_cache`` / ``decode_step`` / ``prefill`` for token-by-token
+  serving (decoupled streaming)
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuserver.parallel.ring import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: object = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+
+def llama3_8b():
+    return LlamaConfig()
+
+
+def tiny(vocab=256):
+    """Test-size config: same graph, toy dims (multiples of 8 for sharding)."""
+    return LlamaConfig(
+        vocab=vocab, d_model=64, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=128, rope_theta=10000.0,
+    )
+
+
+# -- parameters --------------------------------------------------------------
+
+
+def init_params(key, cfg):
+    """Pytree of bf16 params: {embed, layers: [..], norm}."""
+    k_embed, k_out, *k_layers = jax.random.split(key, 2 + cfg.n_layers)
+    hd = cfg.head_dim
+
+    def dense(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)
+        ).astype(cfg.dtype)
+
+    layers = []
+    for kl in k_layers:
+        ks = jax.random.split(kl, 7)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((cfg.d_model,), cfg.dtype),
+                "wq": dense(ks[0], (cfg.d_model, cfg.n_heads * hd),
+                            cfg.d_model),
+                "wk": dense(ks[1], (cfg.d_model, cfg.n_kv_heads * hd),
+                            cfg.d_model),
+                "wv": dense(ks[2], (cfg.d_model, cfg.n_kv_heads * hd),
+                            cfg.d_model),
+                "wo": dense(ks[3], (cfg.n_heads * hd, cfg.d_model),
+                            cfg.n_heads * hd),
+                "mlp_norm": jnp.ones((cfg.d_model,), cfg.dtype),
+                "w_gate": dense(ks[4], (cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_up": dense(ks[5], (cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_down": dense(ks[6], (cfg.d_ff, cfg.d_model), cfg.d_ff),
+            }
+        )
+    return {
+        "embed": dense(k_embed, (cfg.vocab, cfg.d_model), cfg.d_model),
+        "layers": layers,
+        "norm": jnp.ones((cfg.d_model,), cfg.dtype),
+        "lm_head": dense(k_out, (cfg.d_model, cfg.vocab), cfg.d_model),
+    }
+
+
+def param_specs(cfg):
+    """PartitionSpec pytree: Megatron split — qkv/gate/up column-parallel on
+    tp, o/down row-parallel; embeddings sharded on vocab."""
+    layer = {
+        "attn_norm": P(),
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "norm": P(),
+        "lm_head": P(None, "tp"),
+    }
+
+
+# -- kernels -----------------------------------------------------------------
+
+
+def _rms_norm(x, w, eps):
+    xf = x.astype(jnp.float32)
+    scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding. x: [B, T, H, D]; positions: [T] or [B, T]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def _expand_kv(k, n_rep):
+    """GQA: repeat kv heads to full head count. [B,T,Hkv,D] -> [B,T,H,D]."""
+    if n_rep == 1:
+        return k
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+def _block(params, x, positions, cfg, attn_fn, n_heads=None, n_kv_heads=None,
+           reduce=None):
+    """One transformer block: x [B, T, Dm] -> [B, T, Dm].
+
+    The single source of the block math — dense forward, the tp-sharded
+    SPMD forward, bulk prefill and token decode all call this with different
+    ``attn_fn`` closures.  ``n_heads``/``n_kv_heads`` are the *local* head
+    counts (tp-sharded callers pass per-shard values); ``reduce`` is applied
+    to row-parallel matmul outputs (psum over tp in SPMD, identity here).
+    """
+    B, T, _ = x.shape
+    hd = cfg.head_dim
+    nh = n_heads if n_heads is not None else cfg.n_heads
+    nkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
+    red = reduce if reduce is not None else (lambda y: y)
+    h = _rms_norm(x, params["attn_norm"], cfg.norm_eps)
+    q = (h @ params["wq"]).reshape(B, T, nh, hd)
+    k = (h @ params["wk"]).reshape(B, T, nkv, hd)
+    v = (h @ params["wv"]).reshape(B, T, nkv, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = attn_fn(q, k, v)
+    x = x + red(attn.reshape(B, T, nh * hd) @ params["wo"])
+    h = _rms_norm(x, params["mlp_norm"], cfg.norm_eps)
+    gated = jax.nn.silu(h @ params["w_gate"]) * (h @ params["w_up"])
+    return x + red(gated @ params["w_down"])
+
+
+def forward(params, tokens, cfg):
+    """Teacher-forcing logits [B, T, vocab] (float32), single-shard attention
+    (for sharded execution use ``sharded_forward``)."""
+    B, T = tokens.shape
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    positions = jnp.arange(T)
+
+    def attn_fn(q, k, v):
+        return ring_attention(
+            q, _expand_kv(k, n_rep), _expand_kv(v, n_rep), causal=True
+        )
+
+    x = params["embed"][tokens]
+    for layer in params["layers"]:
+        x = _block(layer, x, positions, cfg, attn_fn)
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def sharded_forward(mesh, cfg):
+    """shard_map-wrapped forward: batch on dp, time on sp, weights on tp."""
+    from jax import shard_map
+
+    specs = param_specs(cfg)
+    fn = shard_map(
+        functools.partial(_forward_spmd, cfg=cfg),
+        mesh=mesh,
+        in_specs=(specs, P("dp", "sp")),
+        out_specs=P("dp", "sp", "tp"),
+        check_vma=False,
+    )
+    return fn
+
+
+def _forward_spmd(params, tokens, cfg):
+    # Inside shard_map each device holds a [B/dp, T/sp] token block and
+    # tp-sharded weights; tp matmul partial-sums are reduced explicitly.
+    B, T = tokens.shape
+    tp = lax.psum(1, "tp")
+    if cfg.n_kv_heads % tp != 0 or cfg.n_heads % tp != 0:
+        raise ValueError(
+            "tp={} must divide n_heads={} and n_kv_heads={} (KV-head "
+            "replication across tp is not supported)".format(
+                tp, cfg.n_heads, cfg.n_kv_heads
+            )
+        )
+    nh_loc = cfg.n_heads // tp
+    nkv_loc = cfg.n_kv_heads // tp
+    n_rep = nh_loc // nkv_loc
+    t0 = lax.axis_index("sp") * T
+    positions = t0 + jnp.arange(T)
+
+    def attn_fn(q, k, v):
+        return ring_attention(
+            q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
+            axis_name="sp", causal=True,
+        )
+
+    def psum_tp(y):
+        return lax.psum(y, "tp")
+
+    # embed is vocab-sharded on tp: gather local rows then psum.
+    vloc = params["embed"].shape[0]
+    voff = lax.axis_index("tp") * vloc
+    local = tokens - voff
+    hit = (local >= 0) & (local < vloc)
+    x = jnp.where(
+        hit[..., None],
+        params["embed"][jnp.clip(local, 0, vloc - 1)],
+        jnp.zeros((), params["embed"].dtype),
+    )
+    x = lax.psum(x, "tp")
+    for layer in params["layers"]:
+        x = _block(
+            layer, x, positions, cfg, attn_fn,
+            n_heads=nh_loc, n_kv_heads=nkv_loc, reduce=psum_tp,
+        )
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+# -- training (for the multi-chip dry-run and completeness) ------------------
+
+
+def make_train_step(mesh, cfg, learning_rate=3e-4):
+    """jit-compiled SPMD train step over (dp, sp, tp).
+
+    Loss is next-token cross-entropy; gradients/optimizer state inherit the
+    parameter sharding, batch is (dp, sp)-sharded; XLA inserts the psums.
+    Returns (step_fn, init_fn).
+    """
+    import optax
+
+    tx = optax.adamw(learning_rate)
+    pspecs = param_specs(cfg)
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspecs
+    )
+    batch_sh = NamedSharding(mesh, P("dp", "sp"))
+    fwd = sharded_forward(mesh, cfg)
+
+    def loss_fn(params, tokens, targets):
+        logits = fwd(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    def init_fn(key, tokens):
+        params = init_params(key, cfg)
+        params = jax.device_put(params, param_sh)
+        opt_state = tx.init(params)
+        return params, opt_state
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(param_sh, None, batch_sh, batch_sh),
+        donate_argnums=(0,),
+    )
+    def step_fn(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step_fn, init_fn
+
+
+# -- decode (serving) --------------------------------------------------------
+
+
+def init_kv_cache(cfg, batch, max_seq, dtype=None):
+    """[n_layers, 2, B, max_seq, n_kv_heads, head_dim] cache."""
+    dtype = dtype or cfg.dtype
+    return jnp.zeros(
+        (cfg.n_layers, 2, batch, max_seq, cfg.n_kv_heads, cfg.head_dim),
+        dtype,
+    )
+
+
+def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
+    """Shared decode/prefill body: run all blocks, writing new K/V into the
+    cache at ``write_pos`` and attending over cache[:lengths].
+
+    x: [B, T, Dm] embedded inputs. Returns (x_out, new_cache)."""
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    new_cache = cache
+
+    for i, layer in enumerate(params["layers"]):
+        def attn_fn(q, k, v, i=i):
+            nonlocal new_cache
+            new_cache = new_cache.at[i, 0].set(
+                lax.dynamic_update_slice_in_dim(
+                    new_cache[i, 0], k.astype(new_cache.dtype), write_pos,
+                    axis=1,
+                )
+            )
+            new_cache = new_cache.at[i, 1].set(
+                lax.dynamic_update_slice_in_dim(
+                    new_cache[i, 1], v.astype(new_cache.dtype), write_pos,
+                    axis=1,
+                )
+            )
+            return _attend_cached(
+                q, new_cache[i, 0], new_cache[i, 1], positions, lengths,
+                n_rep,
+            )
+
+        x = _block(layer, x, positions, cfg, attn_fn)
+    return x, new_cache
+
+
+def _attend_cached(q, cache_k, cache_v, q_pos, length, n_rep):
+    """q: [B, Tq, H, D] against cache [B, S, Hkv, D].
+
+    Masks cache positions >= ``length`` and (causally) > the query's own
+    global position ``q_pos`` [B, Tq]."""
+    k = _expand_kv(cache_k, n_rep)
+    v = _expand_kv(cache_v, n_rep)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k,
+        preferred_element_type=jnp.float32,
+    ) / np.sqrt(q.shape[-1])
+    k_idx = jnp.arange(k.shape[1])[None, None, None, :]
+    mask = (k_idx >= length) | (k_idx > q_pos[:, None, :, None])
+    s = jnp.where(mask, -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_step(params, cache, tokens, pos, cfg):
+    """One token of autoregressive decode.
+
+    tokens: [B] int32; pos: scalar int32 (current position, same for batch).
+    Returns (logits [B, vocab] fp32, updated cache).
+    """
+    B = tokens.shape[0]
+    positions = jnp.full((B, 1), pos)
+    x = params["embed"][tokens][:, None, :]  # [B, 1, Dm]
+    x, new_cache = _run_cached(
+        params, cache, x, positions, pos, pos + 1, cfg
+    )
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def prefill(params, cache, tokens, cfg):
+    """Bulk-run the prompt through the cache; returns (last logits, cache).
+
+    tokens: [B, T].  One batched pass — the [T, T] attention stays
+    MXU-shaped and K/V blocks land in the cache with a single
+    dynamic_update_slice per layer (not T sequential steps)."""
+    B, T = tokens.shape
+    positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
+    x = params["embed"][tokens]
+    x, new_cache = _run_cached(params, cache, x, positions, 0, T, cfg)
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache

@@ -1,0 +1,21 @@
+"""TPU parallelism toolkit for the serving runtime and its model zoo.
+
+The reference client has no model parallelism (SURVEY.md §2.7) — but this
+framework serves models *on* TPU, so scale-out is first-class here:
+
+- ``mesh``: device-mesh construction and named-axis sharding rules
+  (``dp`` data / ``sp`` sequence / ``tp`` tensor) for ``jax.jit`` /
+  ``shard_map`` programs.
+- ``ring``: ring attention — sequence/context parallelism over the ``sp``
+  axis using ``lax.ppermute`` so long contexts scale with the mesh while
+  K/V blocks ride the ICI ring.
+"""
+
+from tpuserver.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    make_mesh,
+    mesh_factorize,
+    named_sharding,
+    shard_params,
+)
+from tpuserver.parallel.ring import ring_attention  # noqa: F401

@@ -1,0 +1,90 @@
+"""Ring attention: exact attention over a sequence sharded on the ``sp``
+mesh axis.
+
+Each device holds one block of Q/K/V along time.  K/V blocks rotate around
+the ``sp`` ring with ``lax.ppermute`` while every device folds the visiting
+block into a numerically-stable online softmax (the flash-attention
+recurrence), so the full [T, T] score matrix never materializes and the
+communication is pure neighbor traffic on ICI.  With sp=1 this degrades to a
+single fold — plain fused attention.
+
+Used inside ``shard_map`` (see ``tpuserver.models.llama``); everything here
+is traced once per shape, control flow is ``lax.fori_loop``.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _fold_block(q, k, v, o, m, l, q_pos, k_pos, scale, causal):
+    """One online-softmax fold of a visiting K/V block.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; o: [B, Tq, H, D];
+    m, l: [B, H, Tq] running max / normalizer; positions are global indices
+    used for causal masking across blocks.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = k_pos[None, None, None, :] > q_pos[None, None, :, None]
+        s = jnp.where(mask, -jnp.inf, s)
+    m_blk = jnp.max(s, axis=-1)  # [B, H, Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # exp(-inf - -inf) guards: where a row saw no valid key yet, m_new stays
+    # -inf and the correction factor must be 0, not nan.
+    alpha = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, p)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name=None, causal=True, scale=None):
+    """Exact (optionally causal) attention; when ``axis_name`` is given the
+    time axis is assumed sharded over that mesh axis and K/V ride the ring.
+
+    q, k, v: [B, T_local, H, D] (kv heads already expanded to H).
+    Returns [B, T_local, H, D] in q.dtype.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D**0.5)
+    qf = q.astype(jnp.float32)
+
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+
+    if axis_name is None:
+        q_pos = jnp.arange(Tq)
+        k_pos = jnp.arange(Tk)
+        o, m, l = _fold_block(qf, k, v, o, m, l, q_pos, k_pos, scale, causal)
+    else:
+        sp = lax.psum(1, axis_name)
+        my = lax.axis_index(axis_name)
+        q_pos = my * Tq + jnp.arange(Tq)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def body(step, carry):
+            o, m, l, k_cur, v_cur = carry
+            # after `step` rotations we hold the block originally on
+            # device (my - step) mod sp
+            blk = (my - step) % sp
+            k_pos = blk * Tk + jnp.arange(Tk)
+            o, m, l = _fold_block(
+                qf, k_cur, v_cur, o, m, l, q_pos, k_pos, scale, causal
+            )
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            return o, m, l, k_nxt, v_nxt
+
+        o, m, l, _, _ = lax.fori_loop(0, sp, body, (o, m, l, k, v))
+
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (shouldn't happen)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)

@@ -182,8 +182,12 @@ class InferenceServerClient:
     def __del__(self):
         self.close()
 
-    def close(self):
-        """Close the client: drain the pool and stop worker threads."""
+    def close(self, _empty=queue.Empty):
+        """Close the client: drain the pool and stop worker threads.
+
+        ``queue.Empty`` is bound as a default so ``__del__`` during
+        interpreter shutdown (module globals already torn down) still works.
+        """
         if self._closed:
             return
         self._closed = True
@@ -192,7 +196,7 @@ class InferenceServerClient:
         while True:
             try:
                 conn = self._pool.get_nowait()
-            except queue.Empty:
+            except _empty:
                 break
             if conn is not None:
                 conn.close()

@@ -2,13 +2,20 @@ import os
 import sys
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
-# exercised without TPU hardware (set before jax import).
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# exercised without TPU hardware.  Forced — the session environment may
+# point JAX_PLATFORMS at a tunneled TPU (and the site hook re-asserts it
+# after env changes), but unit tests must be deterministic and leave the
+# chip free for benches; jax.config.update below wins over both.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_PY = os.path.join(REPO_ROOT, "src", "python")
