@@ -1,0 +1,85 @@
+"""Headline benchmark: sync HTTP infer/sec on the `simple` model, conc 1.
+
+Mirrors the reference's quick-start measurement (perf_analyzer -m simple,
+HTTP, concurrency 1 → 1407.84 infer/sec on the reference's GPU box;
+reference docs/quick_start.md:94-108, BASELINE.md).  The server is the
+in-process tpuserver HTTP frontend with the jax-backed `simple` add/sub
+model, the client is tritonclient.http — a full wire round-trip per
+request over a real socket.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import statistics
+import sys
+import time
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "src", "python"))
+
+BASELINE_INFER_PER_SEC = 1407.84  # reference quick_start.md:94
+
+
+def main():
+    import numpy as np
+
+    import tritonclient.http as httpclient
+    from tpuserver.core import InferenceServer
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models import default_models
+
+    core = InferenceServer(default_models())
+    frontend = HttpFrontend(core, port=0).start()
+    try:
+        client = httpclient.InferenceServerClient(
+            frontend.url.replace("http://", "")
+        )
+        in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.ones((1, 16), dtype=np.int32)
+        in0.set_data_from_numpy(a)
+        in1.set_data_from_numpy(b)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+            httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
+        ]
+
+        def one():
+            return client.infer("simple", [in0, in1], outputs=outputs)
+
+        # warmup (includes XLA compile of the model)
+        for _ in range(100):
+            result = one()
+        assert (result.as_numpy("OUTPUT0") == a + b).all()
+
+        # 3 measurement windows of >=1.5s, report the median rate
+        rates = []
+        for _ in range(3):
+            n = 0
+            t0 = time.perf_counter()
+            while True:
+                one()
+                n += 1
+                dt = time.perf_counter() - t0
+                if dt >= 1.5:
+                    break
+            rates.append(n / dt)
+        value = statistics.median(rates)
+        print(
+            json.dumps(
+                {
+                    "metric": "simple_http_sync_conc1_infer_per_sec",
+                    "value": round(value, 2),
+                    "unit": "infer/sec",
+                    "vs_baseline": round(value / BASELINE_INFER_PER_SEC, 4),
+                }
+            )
+        )
+    finally:
+        frontend.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -161,8 +161,13 @@ class Model:
                 }
                 for t in self.outputs
             ],
-            "instance_group": [{"name": self.name + "_0", "kind": "KIND_TPU",
-                                "count": 1}],
+            "instance_group": [{
+                "name": self.name + "_0",
+                "kind": "KIND_CPU"
+                if getattr(self, "device_kind", "tpu") == "cpu"
+                else "KIND_TPU",
+                "count": 1,
+            }],
             "version_policy": {"latest": {"num_versions": 1}},
         }
         if self.decoupled:
@@ -213,10 +218,19 @@ class JaxModel(Model):
     arrays are pushed with ``device_put`` and results fetched once.  Direct
     ``jax.Array`` inputs (the in-process XLA-shm fast path) skip the host
     push entirely.
+
+    ``device_kind`` picks the execution backend: ``"tpu"`` (default —
+    whatever jax's default platform is) for real networks, ``"cpu"`` for
+    trivial/control models where a per-request host<->HBM round trip would
+    cost orders of magnitude more than the compute (the analogue of the
+    reference's instance_group KIND_CPU).
     """
+
+    device_kind = "tpu"
 
     def __init__(self):
         self._jitted = None
+        self._device = None
         self._lock = threading.Lock()
 
     def jax_fn(self, **kwargs):
@@ -228,6 +242,11 @@ class JaxModel(Model):
                 if self._jitted is None:
                     import jax
 
+                    if self.device_kind == "cpu":
+                        try:
+                            self._device = jax.devices("cpu")[0]
+                        except RuntimeError:
+                            self._device = None
                     self._jitted = jax.jit(self.jax_fn)
         return self._jitted
 
@@ -237,8 +256,13 @@ class JaxModel(Model):
         fn = self._get_jitted()
         dev_inputs = {}
         for name, arr in inputs.items():
-            if isinstance(arr, jax.Array):
-                dev_inputs[name] = arr
+            if isinstance(arr, jax.Array) and self._device is None:
+                dev_inputs[name] = arr  # zero-copy: stays in HBM
+            elif self._device is not None:
+                # cpu-kind model: move everything (including device-resident
+                # shm arrays) to the host backend — jit rejects inputs
+                # committed to different platforms.
+                dev_inputs[name] = jax.device_put(arr, self._device)
             else:
                 dev_inputs[name] = jax.device_put(arr)
         out = fn(**dev_inputs)

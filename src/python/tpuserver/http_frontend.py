@@ -80,6 +80,9 @@ def _array_from_json_data(data, datatype, shape):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Send responses in one TCP segment where possible: without NODELAY the
+    # header/body writes interact with delayed ACKs for ~40ms stalls.
+    disable_nagle_algorithm = True
     server_version = "tpu-triton-server"
 
     def log_message(self, fmt, *args):  # quiet by default

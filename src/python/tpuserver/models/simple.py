@@ -10,6 +10,8 @@ from tpuserver.core import JaxModel, Model, TensorSpec
 class SimpleModel(JaxModel):
     """INPUT0+INPUT1 -> OUTPUT0, INPUT0-INPUT1 -> OUTPUT1 (INT32[1,16])."""
 
+    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
+
     name = "simple"
     platform = "jax"
     backend = "jax"
@@ -64,6 +66,7 @@ class SimpleStringModel(Model):
 
 
 class IdentityFP32Model(JaxModel):
+    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
     name = "identity_fp32"
     max_batch_size = 0
     inputs = (TensorSpec("INPUT0", "FP32", [-1, -1]),)
@@ -75,6 +78,8 @@ class IdentityFP32Model(JaxModel):
 
 class IdentityBF16Model(JaxModel):
     """BF16 passthrough — exercises the TPU-native bf16 wire path."""
+
+    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
 
     name = "identity_bf16"
     max_batch_size = 0
