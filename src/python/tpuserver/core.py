@@ -689,10 +689,21 @@ class InferenceServer:
         return self._execute(model, request)
 
     def infer_stream(self, request):
-        """Execute a (possibly decoupled) request; yields InferResponse(s)."""
+        """Execute a (possibly decoupled) request; yields InferResponse(s).
+
+        With the ``triton_enable_empty_final_response`` request parameter a
+        trailing empty response marked ``triton_final_response`` is emitted
+        so clients can detect completion of data-dependent-length streams.
+        """
+        want_final = bool(
+            request.parameters.get("triton_enable_empty_final_response")
+        )
         model = self._get_model(request.model_name, request.model_version)
         if not model.decoupled:
-            yield self._execute(model, request)
+            resp = self._execute(model, request)
+            if want_final:
+                resp.parameters["triton_final_response"] = True
+            yield resp
             return
         t0 = time.monotonic_ns()
         inputs = dict(request.inputs)
@@ -700,12 +711,20 @@ class InferenceServer:
         count = 0
         for out in model.execute_stream(inputs, request):
             count += 1
-            yield self._make_response(model, request, out,
-                                      mark_final=False)
+            resp = self._make_response(model, request, out,
+                                       mark_final=False)
+            if want_final:
+                resp.parameters["triton_final_response"] = False
+            yield resp
         t2 = time.monotonic_ns()
         self._stats[model.name].record(
             self._batch_of(model, inputs), 0, t1 - t0, t2 - t1, 0
         )
+        if want_final:
+            yield InferResponse(
+                model.name, model.version, request.id, [],
+                parameters={"triton_final_response": True},
+            )
 
     def _batch_of(self, model, inputs):
         if model.max_batch_size > 0 and inputs:

@@ -1,0 +1,447 @@
+"""gRPC frontend: serves the KServe-v2 GRPCInferenceService (including
+decoupled bidirectional streaming and the XLA shared-memory verbs) on a
+``grpc.server``, delegating to ``tpuserver.core.InferenceServer``.
+
+The service layer is a generic-handler table over the vendored pb2 messages
+(tritonclient/grpc/_service.py) — same wire protocol as the reference's
+generated stubs.
+"""
+
+from concurrent import futures
+
+import numpy as np
+
+import grpc
+
+from tpuserver.core import (
+    InferRequest,
+    RequestedOutput,
+    ServerError,
+    SERVER_EXTENSIONS,
+    SERVER_NAME,
+    SERVER_VERSION,
+)
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+from tpuserver.tensor_io import (
+    array_from_binary as _array_from_raw,
+    binary_from_array as _raw_from_array,
+)
+from tritonclient.utils import triton_to_np_dtype
+
+_TYPED_FIELDS = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents",
+    "INT16": "int_contents",
+    "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents",
+    "UINT16": "uint_contents",
+    "UINT32": "uint_contents",
+    "UINT64": "uint64_contents",
+    "FP32": "fp32_contents",
+    "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+
+def _param_value(p):
+    field = p.WhichOneof("parameter_choice")
+    return getattr(p, field) if field else None
+
+
+def _params_dict(param_map):
+    return {k: _param_value(v) for k, v in param_map.items()}
+
+
+
+
+class _CoreBridge:
+    """Protobuf <-> core translation + the RPC method implementations."""
+
+    def __init__(self, core):
+        self._core = core
+
+    # -- conversion --------------------------------------------------------
+
+    def _request_from_proto(self, request):
+        inputs = {}
+        raw_cursor = 0  # shm inputs do not consume raw_input_contents slots
+        for tensor in request.inputs:
+            shape = list(tensor.shape)
+            tparams = _params_dict(tensor.parameters)
+            if "shared_memory_region" in tparams:
+                inputs[tensor.name] = self._core.read_shm_input(
+                    tparams["shared_memory_region"],
+                    tparams.get("shared_memory_byte_size", 0),
+                    tparams.get("shared_memory_offset", 0),
+                    tensor.datatype,
+                    shape,
+                )
+            elif raw_cursor < len(request.raw_input_contents):
+                inputs[tensor.name] = _array_from_raw(
+                    request.raw_input_contents[raw_cursor], tensor.datatype,
+                    shape,
+                )
+                raw_cursor += 1
+            else:
+                field = _TYPED_FIELDS.get(tensor.datatype)
+                if field is None:
+                    raise ServerError(
+                        "input '{}' has no data".format(tensor.name)
+                    )
+                vals = list(getattr(tensor.contents, field))
+                if tensor.datatype == "BYTES":
+                    arr = np.array(vals, dtype=np.object_).reshape(shape)
+                else:
+                    arr = np.array(
+                        vals, dtype=triton_to_np_dtype(tensor.datatype)
+                    ).reshape(shape)
+                inputs[tensor.name] = arr
+        requested = None
+        if request.outputs:
+            requested = []
+            for out in request.outputs:
+                oparams = _params_dict(out.parameters)
+                requested.append(
+                    RequestedOutput(
+                        out.name,
+                        binary_data=True,
+                        class_count=oparams.get("classification", 0),
+                        shm_region=oparams.get("shared_memory_region"),
+                        shm_byte_size=oparams.get(
+                            "shared_memory_byte_size", 0
+                        ),
+                        shm_offset=oparams.get("shared_memory_offset", 0),
+                    )
+                )
+        return InferRequest(
+            request.model_name,
+            request.model_version,
+            request.id,
+            inputs,
+            requested,
+            _params_dict(request.parameters),
+        )
+
+    def _response_to_proto(self, resp):
+        out = pb.ModelInferResponse(
+            model_name=resp.model_name,
+            model_version=resp.model_version,
+            id=resp.id,
+        )
+        for key, value in (resp.parameters or {}).items():
+            if isinstance(value, bool):
+                out.parameters[key].bool_param = value
+            elif isinstance(value, int):
+                out.parameters[key].int64_param = value
+            else:
+                out.parameters[key].string_param = str(value)
+        for spec, array, delivery in resp.outputs:
+            tensor = out.outputs.add()
+            tensor.name = spec["name"]
+            tensor.datatype = spec["datatype"]
+            tensor.shape.extend(int(s) for s in spec["shape"])
+            if array is None:  # delivered via shared memory
+                tensor.parameters[
+                    "shared_memory_region"
+                ].string_param = delivery["shm_region"]
+                tensor.parameters[
+                    "shared_memory_byte_size"
+                ].int64_param = delivery["shm_byte_size"]
+                if delivery["shm_offset"]:
+                    tensor.parameters[
+                        "shared_memory_offset"
+                    ].int64_param = delivery["shm_offset"]
+                out.raw_output_contents.append(b"")
+            else:
+                out.raw_output_contents.append(
+                    _raw_from_array(array, spec["datatype"])
+                )
+        return out
+
+    # -- unary handlers ----------------------------------------------------
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def ModelReady(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self._core.model_ready(request.name, request.version)
+        )
+
+    def ServerMetadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name=SERVER_NAME,
+            version=SERVER_VERSION,
+            extensions=SERVER_EXTENSIONS,
+        )
+
+    def ModelMetadata(self, request, context):
+        md = self._core.model_metadata(request.name, request.version)
+        resp = pb.ModelMetadataResponse(
+            name=md["name"], versions=md["versions"], platform=md["platform"]
+        )
+        for t in md["inputs"]:
+            resp.inputs.add(
+                name=t["name"], datatype=t["datatype"], shape=t["shape"]
+            )
+        for t in md["outputs"]:
+            resp.outputs.add(
+                name=t["name"], datatype=t["datatype"], shape=t["shape"]
+            )
+        return resp
+
+    def ModelConfig(self, request, context):
+        from google.protobuf import json_format
+
+        cfg = self._core.model_config(request.name, request.version)
+        config = json_format.ParseDict(
+            cfg, pb.model__config__pb2.ModelConfig(),
+            ignore_unknown_fields=True,
+        )
+        return pb.ModelConfigResponse(config=config)
+
+    def ModelStatistics(self, request, context):
+        from google.protobuf import json_format
+
+        stats = self._core.model_statistics(request.name, request.version)
+        return json_format.ParseDict(
+            stats, pb.ModelStatisticsResponse(), ignore_unknown_fields=True
+        )
+
+    def RepositoryIndex(self, request, context):
+        resp = pb.RepositoryIndexResponse()
+        for entry in self._core.repository_index(ready_only=request.ready):
+            resp.models.add(**entry)
+        return resp
+
+    def RepositoryModelLoad(self, request, context):
+        self._core.load_model(request.model_name)
+        return pb.RepositoryModelLoadResponse()
+
+    def RepositoryModelUnload(self, request, context):
+        unload_dependents = False
+        p = request.parameters.get("unload_dependents")
+        if p is not None:
+            unload_dependents = bool(_param_value(p))
+        self._core.unload_model(request.model_name, unload_dependents)
+        return pb.RepositoryModelUnloadResponse()
+
+    # -- shared memory -----------------------------------------------------
+
+    def SystemSharedMemoryStatus(self, request, context):
+        resp = pb.SystemSharedMemoryStatusResponse()
+        for name, region in self._core.system_shm_status(
+            request.name
+        ).items():
+            resp.regions[name].name = region["name"]
+            resp.regions[name].key = region["key"]
+            resp.regions[name].offset = region["offset"]
+            resp.regions[name].byte_size = region["byte_size"]
+        return resp
+
+    def SystemSharedMemoryRegister(self, request, context):
+        self._core.register_system_shm(
+            request.name, request.key, request.offset, request.byte_size
+        )
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    def SystemSharedMemoryUnregister(self, request, context):
+        self._core.unregister_system_shm(request.name)
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    def CudaSharedMemoryStatus(self, request, context):
+        resp = pb.CudaSharedMemoryStatusResponse()
+        for name, region in self._core.cuda_shm_status(request.name).items():
+            resp.regions[name].name = region["name"]
+            resp.regions[name].device_id = region["device_id"]
+            resp.regions[name].byte_size = region["byte_size"]
+        return resp
+
+    def CudaSharedMemoryRegister(self, request, context):
+        self._core.register_cuda_shm(
+            request.name, request.raw_handle, request.device_id,
+            request.byte_size,
+        )
+        return pb.CudaSharedMemoryRegisterResponse()
+
+    def CudaSharedMemoryUnregister(self, request, context):
+        self._core.unregister_cuda_shm(request.name)
+        return pb.CudaSharedMemoryUnregisterResponse()
+
+    def XlaSharedMemoryStatus(self, request, context):
+        resp = pb.XlaSharedMemoryStatusResponse()
+        for name, region in self._core.xla_shm_status(request.name).items():
+            resp.regions[name].name = region["name"]
+            resp.regions[name].device_ordinal = region["device_ordinal"]
+            resp.regions[name].byte_size = region["byte_size"]
+        return resp
+
+    def XlaSharedMemoryRegister(self, request, context):
+        self._core.register_xla_shm(
+            request.name, request.raw_handle, request.device_ordinal,
+            request.byte_size,
+        )
+        return pb.XlaSharedMemoryRegisterResponse()
+
+    def XlaSharedMemoryUnregister(self, request, context):
+        self._core.unregister_xla_shm(request.name)
+        return pb.XlaSharedMemoryUnregisterResponse()
+
+    # -- settings ----------------------------------------------------------
+
+    def TraceSetting(self, request, context):
+        settings = {}
+        for key, val in request.settings.items():
+            settings[key] = list(val.value)
+        if settings:
+            result = self._core.update_trace_settings(
+                request.model_name or None, settings
+            )
+        else:
+            result = self._core.get_trace_settings(
+                request.model_name or None
+            )
+        resp = pb.TraceSettingResponse()
+        for key, values in result["settings"].items():
+            resp.settings[key].value.extend(values)
+        return resp
+
+    def LogSettings(self, request, context):
+        settings = {}
+        for key, val in request.settings.items():
+            field = val.WhichOneof("parameter_choice")
+            if field is not None:
+                settings[key] = getattr(val, field)
+        if settings:
+            result = self._core.update_log_settings(settings)
+        else:
+            result = self._core.get_log_settings()
+        resp = pb.LogSettingsResponse()
+        for key, value in result.items():
+            if isinstance(value, bool):
+                resp.settings[key].bool_param = value
+            elif isinstance(value, int):
+                resp.settings[key].uint32_param = value
+            else:
+                resp.settings[key].string_param = str(value)
+        return resp
+
+    # -- inference ---------------------------------------------------------
+
+    def ModelInfer(self, request, context):
+        core_request = self._request_from_proto(request)
+        resp = self._core.infer(core_request)
+        return self._response_to_proto(resp)
+
+    def ModelStreamInfer(self, request_iterator, context):
+        """Bidi stream: each request may yield 0..N responses (decoupled
+        models); errors are delivered in-band via error_message so the
+        stream survives bad requests (reference server semantics)."""
+        for request in request_iterator:
+            try:
+                core_request = self._request_from_proto(request)
+                for resp in self._core.infer_stream(core_request):
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=self._response_to_proto(resp)
+                    )
+            except ServerError as e:
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+            except Exception as e:
+                yield pb.ModelStreamInferResponse(
+                    error_message="unexpected error: {}".format(e)
+                )
+
+
+def _nbytes(datatype, shape):
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None or datatype == "BYTES":
+        return -1
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * np.dtype(np_dtype).itemsize
+
+
+def _wrap_unary(bridge, name):
+    method = getattr(bridge, name)
+
+    def handler(request, context):
+        try:
+            return method(request, context)
+        except ServerError as e:
+            context.abort(_status_code(e.code), str(e))
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    return handler
+
+
+def _status_code(http_code):
+    return {
+        400: grpc.StatusCode.INVALID_ARGUMENT,
+        404: grpc.StatusCode.NOT_FOUND,
+        500: grpc.StatusCode.INTERNAL,
+        501: grpc.StatusCode.UNIMPLEMENTED,
+    }.get(http_code, grpc.StatusCode.UNKNOWN)
+
+
+class GrpcFrontend:
+    """A grpc.server hosting the full GRPCInferenceService."""
+
+    def __init__(self, core, host="127.0.0.1", port=0, max_workers=8):
+        self._core = core
+        self._host = host
+        self._max_workers = max_workers
+        self._requested_port = port
+        self._server = None
+        self._port = None
+
+    def start(self):
+        bridge = _CoreBridge(self._core)
+        handlers = {}
+        for name, (req_cls, resp_cls, kind) in METHODS.items():
+            if kind == "unary":
+                handlers[name] = grpc.unary_unary_rpc_method_handler(
+                    _wrap_unary(bridge, name),
+                    request_deserializer=req_cls.FromString,
+                    response_serializer=resp_cls.SerializeToString,
+                )
+            else:
+                handlers[name] = grpc.stream_stream_rpc_method_handler(
+                    getattr(bridge, name),
+                    request_deserializer=req_cls.FromString,
+                    response_serializer=resp_cls.SerializeToString,
+                )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self._max_workers),
+            options=[
+                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1),
+            ],
+        )
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self._port = self._server.add_insecure_port(
+            "{}:{}".format(self._host, self._requested_port)
+        )
+        self._server.start()
+        return self
+
+    @property
+    def port(self):
+        return self._port
+
+    @property
+    def url(self):
+        return "{}:{}".format(self._host, self._port)
+
+    def stop(self, grace=None):
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None

@@ -13,6 +13,10 @@ from urllib.parse import unquote, urlparse
 
 import numpy as np
 
+from tpuserver.tensor_io import (
+    array_from_binary as _array_from_binary,
+    binary_from_array as _binary_from_array,
+)
 from tpuserver.core import (
     InferenceServer,
     InferRequest,
@@ -38,27 +42,6 @@ _SHM_URI = re.compile(
 _REPO_URI = re.compile(
     r"^/v2/repository(/models/(?P<model>[^/]+)/(?P<verb>load|unload)|/index)$"
 )
-
-
-def _binary_from_array(array, datatype):
-    if datatype == "BYTES":
-        serialized = serialize_byte_tensor(array)
-        return serialized.item() if serialized.size > 0 else b""
-    if datatype == "BF16":
-        serialized = serialize_bf16_tensor(array)
-        return serialized.item() if serialized.size > 0 else b""
-    return np.ascontiguousarray(array).tobytes()
-
-
-def _array_from_binary(raw, datatype, shape):
-    if datatype == "BYTES":
-        return deserialize_bytes_tensor(raw).reshape(shape)
-    if datatype == "BF16":
-        return deserialize_bf16_tensor(raw).reshape(shape)
-    np_dtype = triton_to_np_dtype(datatype)
-    if np_dtype is None:
-        raise ServerError("unsupported datatype " + str(datatype))
-    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
 
 
 def _array_from_json_data(data, datatype, shape):

@@ -10,6 +10,7 @@ from tpuserver.models.simple import (
     IdentityBF16Model,
     IdentityFP32Model,
     IdentityStringModel,
+    RepeatModel,
     SequenceAccumulateModel,
     SimpleModel,
     SimpleStringModel,
@@ -25,4 +26,5 @@ def default_models():
         IdentityBF16Model(),
         IdentityStringModel(),
         SequenceAccumulateModel(),
+        RepeatModel(),
     ]

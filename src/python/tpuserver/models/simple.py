@@ -121,3 +121,36 @@ class SequenceAccumulateModel(Model):
         acc = state if state is not None else np.zeros([1], dtype=np.int32)
         acc = acc + inputs["INPUT"].astype(np.int32)
         return {"OUTPUT": acc}, acc
+
+
+class RepeatModel(Model):
+    """Decoupled model: one request with IN int32[N] produces N streamed
+    responses of one element each, the i-th delayed by DELAY[i] usec; WAIT
+    delays stream start (mirror of the reference's repeat_int32 model driven
+    by simple_grpc_custom_repeat.py:78-105)."""
+
+    name = "repeat_int32"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 0
+    decoupled = True
+    inputs = (
+        TensorSpec("IN", "INT32", [-1]),
+        TensorSpec("DELAY", "UINT32", [-1]),
+        TensorSpec("WAIT", "UINT32", [1]),
+    )
+    outputs = (TensorSpec("OUT", "INT32", [1]),)
+
+    def execute_stream(self, inputs, request):
+        import time
+
+        values = np.asarray(inputs["IN"]).reshape(-1)
+        delays = np.asarray(inputs["DELAY"]).reshape(-1)
+        wait_us = int(np.asarray(inputs["WAIT"]).reshape(-1)[0])
+        if wait_us:
+            time.sleep(wait_us / 1e6)
+        for i, value in enumerate(values):
+            delay_us = int(delays[i]) if i < len(delays) else 0
+            if delay_us:
+                time.sleep(delay_us / 1e6)
+            yield {"OUT": np.array([value], dtype=np.int32)}

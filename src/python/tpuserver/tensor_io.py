@@ -1,0 +1,35 @@
+"""Wire-tensor (de)serialization shared by the HTTP and gRPC frontends:
+numpy <-> raw bytes for every KServe-v2 datatype incl. BYTES (4-byte length
+prefix) and BF16 (native ml_dtypes)."""
+
+import numpy as np
+
+from tpuserver.core import ServerError
+from tritonclient.utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+
+def binary_from_array(array, datatype):
+    if datatype == "BYTES":
+        serialized = serialize_byte_tensor(array)
+        return serialized.item() if serialized.size > 0 else b""
+    if datatype == "BF16":
+        serialized = serialize_bf16_tensor(array)
+        return serialized.item() if serialized.size > 0 else b""
+    return np.ascontiguousarray(array).tobytes()
+
+
+def array_from_binary(raw, datatype, shape):
+    if datatype == "BYTES":
+        return deserialize_bytes_tensor(raw).reshape(shape)
+    if datatype == "BF16":
+        return deserialize_bf16_tensor(raw).reshape(shape)
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise ServerError("unsupported datatype " + str(datatype))
+    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)

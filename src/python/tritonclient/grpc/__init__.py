@@ -1,0 +1,16 @@
+"""tritonclient.grpc — KServe-v2 gRPC client (sync; asyncio variant in
+``tritonclient.grpc.aio``)."""
+
+from tritonclient.grpc import model_config_pb2, grpc_service_pb2  # noqa: F401
+from tritonclient.grpc._client import (  # noqa: F401
+    InferenceServerClient,
+    KeepAliveOptions,
+)
+from tritonclient.grpc._infer_input import (  # noqa: F401
+    InferInput,
+    InferRequestedOutput,
+)
+from tritonclient.grpc._infer_result import InferResult  # noqa: F401
+from tritonclient.utils import InferenceServerException  # noqa: F401
+
+service_pb2 = grpc_service_pb2

@@ -1,0 +1,165 @@
+"""Input/requested-output tensor descriptors for the gRPC client.
+
+Protobuf-backed mirrors of the reference grpc/_infer_input.py /
+_requested_output.py, with the TPU-first extensions shared with the HTTP
+client: array-likes (incl. ``jax.Array``) accepted everywhere, native BF16
+via ml_dtypes, and ``set_shared_memory`` pointing at system or XLA regions.
+"""
+
+import numpy as np
+
+from tritonclient.utils import (
+    np_to_triton_dtype,
+    raise_error,
+    serialize_bf16_tensor,
+    serialize_byte_tensor,
+)
+
+from . import grpc_service_pb2 as pb
+
+
+def _set_parameter(param_map, key, value):
+    p = param_map[key]
+    if isinstance(value, bool):
+        p.bool_param = value
+    elif isinstance(value, int):
+        p.int64_param = value
+    elif isinstance(value, float):
+        p.double_param = value
+    elif isinstance(value, str):
+        p.string_param = value
+    else:
+        raise_error(
+            "unsupported parameter type {} for '{}'".format(
+                type(value), key
+            )
+        )
+
+
+def _clear_parameter(param_map, key):
+    if key in param_map:
+        del param_map[key]
+
+
+class InferInput:
+    """An input tensor for a gRPC inference request."""
+
+    def __init__(self, name, shape, datatype):
+        self._input = pb.ModelInferRequest.InferInputTensor()
+        self._input.name = name
+        self._input.shape.extend(int(s) for s in shape)
+        self._input.datatype = datatype
+        self._raw_content = None
+
+    def name(self):
+        return self._input.name
+
+    def datatype(self):
+        return self._input.datatype
+
+    def shape(self):
+        return list(self._input.shape)
+
+    def set_shape(self, shape):
+        del self._input.shape[:]
+        self._input.shape.extend(int(s) for s in shape)
+        return self
+
+    def set_data_from_numpy(self, input_tensor):
+        """Set tensor data from an array-like (np.ndarray or jax.Array —
+        fetched from device exactly once here)."""
+        if not isinstance(input_tensor, np.ndarray):
+            try:
+                input_tensor = np.asarray(input_tensor)
+            except Exception:
+                raise_error("input_tensor must be a numpy array or array-like")
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        if self._input.datatype == "BF16" or dtype == "BF16":
+            serialized = serialize_bf16_tensor(input_tensor)
+            self._raw_content = (
+                serialized.item() if serialized.size > 0 else b""
+            )
+        elif self._input.datatype == "BYTES":
+            serialized = serialize_byte_tensor(input_tensor)
+            self._raw_content = (
+                serialized.item() if serialized.size > 0 else b""
+            )
+        else:
+            if dtype is None:
+                raise_error(
+                    "unsupported numpy dtype {}".format(input_tensor.dtype)
+                )
+            if dtype != self._input.datatype:
+                raise_error(
+                    "got unexpected datatype {} from numpy array, expected "
+                    "{}".format(dtype, self._input.datatype)
+                )
+            self._raw_content = np.ascontiguousarray(input_tensor).tobytes()
+        self.set_shape(input_tensor.shape)
+        self._input.ClearField("contents")
+        _clear_parameter(self._input.parameters, "shared_memory_region")
+        _clear_parameter(self._input.parameters, "shared_memory_byte_size")
+        _clear_parameter(self._input.parameters, "shared_memory_offset")
+        return self
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        """Reference this input's data from a registered shared-memory
+        region (system or XLA/TPU)."""
+        self._raw_content = None
+        self._input.ClearField("contents")
+        _set_parameter(
+            self._input.parameters, "shared_memory_region", region_name
+        )
+        _set_parameter(
+            self._input.parameters, "shared_memory_byte_size", int(byte_size)
+        )
+        if offset:
+            _set_parameter(
+                self._input.parameters, "shared_memory_offset", int(offset)
+            )
+        return self
+
+    def _get_tensor(self):
+        return self._input
+
+    def _get_content(self):
+        return self._raw_content
+
+
+class InferRequestedOutput:
+    """A requested output for a gRPC inference request."""
+
+    def __init__(self, name, class_count=0):
+        self._output = pb.ModelInferRequest.InferRequestedOutputTensor()
+        self._output.name = name
+        if class_count:
+            _set_parameter(
+                self._output.parameters, "classification", int(class_count)
+            )
+
+    def name(self):
+        return self._output.name
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        """Deliver this output into a registered shared-memory region."""
+        self.unset_shared_memory()
+        _set_parameter(
+            self._output.parameters, "shared_memory_region", region_name
+        )
+        _set_parameter(
+            self._output.parameters, "shared_memory_byte_size", int(byte_size)
+        )
+        if offset:
+            _set_parameter(
+                self._output.parameters, "shared_memory_offset", int(offset)
+            )
+        return self
+
+    def unset_shared_memory(self):
+        _clear_parameter(self._output.parameters, "shared_memory_region")
+        _clear_parameter(self._output.parameters, "shared_memory_byte_size")
+        _clear_parameter(self._output.parameters, "shared_memory_offset")
+        return self
+
+    def _get_tensor(self):
+        return self._output

@@ -1,0 +1,82 @@
+"""Inference result wrapper for the gRPC client (reference grpc/_client.py
+InferResult), numpy/BF16/BYTES aware."""
+
+import numpy as np
+
+from tritonclient.utils import (
+    deserialize_bf16_tensor,
+    deserialize_bytes_tensor,
+    triton_to_np_dtype,
+)
+
+from . import grpc_service_pb2 as pb
+
+
+class InferResult:
+    """Wraps a ModelInferResponse and exposes numpy access to outputs."""
+
+    def __init__(self, result):
+        self._result = result
+
+    @classmethod
+    def from_response(cls, response):
+        return cls(response)
+
+    def as_numpy(self, name):
+        """The output tensor as a numpy array, or None if not present (e.g.
+        delivered via shared memory)."""
+        index = 0
+        for output in self._result.outputs:
+            if output.name == name:
+                shape = list(output.shape)
+                if "shared_memory_region" in output.parameters:
+                    # delivered via shared memory: read it from the region
+                    return None
+                if index < len(self._result.raw_output_contents):
+                    raw = self._result.raw_output_contents[index]
+                    if output.datatype == "BYTES":
+                        return deserialize_bytes_tensor(raw).reshape(shape)
+                    if output.datatype == "BF16":
+                        return deserialize_bf16_tensor(raw).reshape(shape)
+                    np_dtype = triton_to_np_dtype(output.datatype)
+                    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+                # typed contents fallback
+                c = output.contents
+                for field in (
+                    "bool_contents", "int_contents", "int64_contents",
+                    "uint_contents", "uint64_contents", "fp32_contents",
+                    "fp64_contents", "bytes_contents",
+                ):
+                    vals = getattr(c, field)
+                    if len(vals):
+                        if field == "bytes_contents":
+                            return np.array(
+                                list(vals), dtype=np.object_
+                            ).reshape(shape)
+                        np_dtype = triton_to_np_dtype(output.datatype)
+                        return np.array(vals, dtype=np_dtype).reshape(shape)
+                return None
+            index += 1
+        return None
+
+    def get_output(self, name, as_json=False):
+        """The InferOutputTensor protobuf (or dict) for ``name``."""
+        for output in self._result.outputs:
+            if output.name == name:
+                if as_json:
+                    from google.protobuf import json_format
+
+                    return json_format.MessageToDict(
+                        output, preserving_proto_field_name=True
+                    )
+                return output
+        return None
+
+    def get_response(self, as_json=False):
+        if as_json:
+            from google.protobuf import json_format
+
+            return json_format.MessageToDict(
+                self._result, preserving_proto_field_name=True
+            )
+        return self._result

@@ -1,0 +1,107 @@
+"""Bidirectional streaming machinery (reference grpc/_infer_stream.py:35-179).
+
+``_InferStream`` owns the ModelStreamInfer call: requests are fed from a
+queue through ``_RequestIterator`` (the gRPC request iterator), responses
+are drained by a daemon thread that invokes the user callback with
+``(InferResult | None, InferenceServerException | None)`` — decoupled
+models may produce zero or many responses per request.
+"""
+
+import queue
+import threading
+
+import grpc
+
+from tritonclient.utils import InferenceServerException
+
+from ._infer_result import InferResult
+from ._utils import get_error_grpc
+
+
+class _RequestIterator:
+    """Iterator over enqueued ModelInferRequest protos; blocks until the
+    stream is closed with a None sentinel."""
+
+    def __init__(self):
+        self._queue = queue.Queue()
+
+    def put(self, request):
+        self._queue.put(request)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        request = self._queue.get()
+        if request is None:
+            raise StopIteration
+        return request
+
+
+class _InferStream:
+    """One open ModelStreamInfer bidi stream."""
+
+    def __init__(self, callback, verbose=False):
+        self._callback = callback
+        self._verbose = verbose
+        self._request_iterator = _RequestIterator()
+        self._response_iterator = None
+        self._handler = None
+        self._active = True
+        self._enqueued = 0
+        self._received = 0
+        self._lock = threading.Lock()
+
+    def _init_handler(self, response_iterator):
+        self._response_iterator = response_iterator
+        self._handler = threading.Thread(
+            target=self._process_response, daemon=True
+        )
+        self._handler.start()
+
+    def _enqueue_request(self, request):
+        if not self._active:
+            raise InferenceServerException(
+                "The stream is no longer in valid state, the error detail "
+                "is reported through provided callback. A new stream should "
+                "be started after stopping the current stream."
+            )
+        with self._lock:
+            self._enqueued += 1
+        self._request_iterator.put(request)
+
+    def _process_response(self):
+        """[handler thread] deliver each stream response to the callback;
+        a dead stream surfaces the error once and deactivates."""
+        try:
+            for response in self._response_iterator:
+                if self._verbose:
+                    print(response)
+                with self._lock:
+                    self._received += 1
+                if response.error_message:
+                    self._callback(
+                        None,
+                        InferenceServerException(response.error_message),
+                    )
+                else:
+                    self._callback(
+                        InferResult(response.infer_response), None
+                    )
+        except grpc.RpcError as rpc_error:
+            self._active = False
+            if rpc_error.code() != grpc.StatusCode.CANCELLED:
+                self._callback(None, get_error_grpc(rpc_error))
+        except Exception as e:  # stream death must reach the user
+            self._active = False
+            self._callback(None, InferenceServerException(str(e)))
+
+    def close(self, cancel_requests=False):
+        """Close the stream: stop the request feed and join the reader."""
+        if cancel_requests and self._response_iterator is not None:
+            self._response_iterator.cancel()
+        self._request_iterator.put(None)
+        self._active = False
+        if self._handler is not None:
+            self._handler.join()
+            self._handler = None

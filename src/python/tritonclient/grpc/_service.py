@@ -1,0 +1,104 @@
+"""KServe-v2 gRPC service method table.
+
+grpcio-tools is not available in this environment, so instead of a generated
+``*_pb2_grpc.py`` the service layer is this explicit method registry used
+with ``grpc.Channel.unary_unary``/``stream_stream`` generic callables (and,
+server-side, ``grpc.method_handlers_generic_handler``).  Method set mirrors
+the reference's grpc_service.proto service block (reference
+src/c++/CMakeLists.txt fetches it from triton common), plus the
+XlaSharedMemory* verbs that generalize the CUDA-shm path for TPU.
+"""
+
+from . import grpc_service_pb2 as pb
+
+SERVICE = "inference.GRPCInferenceService"
+
+# name -> (request class, response class, kind) where kind is "unary" or
+# "stream" (bidi stream-stream).
+METHODS = {
+    "ServerLive": (pb.ServerLiveRequest, pb.ServerLiveResponse, "unary"),
+    "ServerReady": (pb.ServerReadyRequest, pb.ServerReadyResponse, "unary"),
+    "ModelReady": (pb.ModelReadyRequest, pb.ModelReadyResponse, "unary"),
+    "ServerMetadata": (
+        pb.ServerMetadataRequest, pb.ServerMetadataResponse, "unary"),
+    "ModelMetadata": (
+        pb.ModelMetadataRequest, pb.ModelMetadataResponse, "unary"),
+    "ModelInfer": (pb.ModelInferRequest, pb.ModelInferResponse, "unary"),
+    "ModelStreamInfer": (
+        pb.ModelInferRequest, pb.ModelStreamInferResponse, "stream"),
+    "ModelConfig": (pb.ModelConfigRequest, pb.ModelConfigResponse, "unary"),
+    "ModelStatistics": (
+        pb.ModelStatisticsRequest, pb.ModelStatisticsResponse, "unary"),
+    "RepositoryIndex": (
+        pb.RepositoryIndexRequest, pb.RepositoryIndexResponse, "unary"),
+    "RepositoryModelLoad": (
+        pb.RepositoryModelLoadRequest, pb.RepositoryModelLoadResponse,
+        "unary"),
+    "RepositoryModelUnload": (
+        pb.RepositoryModelUnloadRequest, pb.RepositoryModelUnloadResponse,
+        "unary"),
+    "SystemSharedMemoryStatus": (
+        pb.SystemSharedMemoryStatusRequest,
+        pb.SystemSharedMemoryStatusResponse, "unary"),
+    "SystemSharedMemoryRegister": (
+        pb.SystemSharedMemoryRegisterRequest,
+        pb.SystemSharedMemoryRegisterResponse, "unary"),
+    "SystemSharedMemoryUnregister": (
+        pb.SystemSharedMemoryUnregisterRequest,
+        pb.SystemSharedMemoryUnregisterResponse, "unary"),
+    "CudaSharedMemoryStatus": (
+        pb.CudaSharedMemoryStatusRequest, pb.CudaSharedMemoryStatusResponse,
+        "unary"),
+    "CudaSharedMemoryRegister": (
+        pb.CudaSharedMemoryRegisterRequest,
+        pb.CudaSharedMemoryRegisterResponse, "unary"),
+    "CudaSharedMemoryUnregister": (
+        pb.CudaSharedMemoryUnregisterRequest,
+        pb.CudaSharedMemoryUnregisterResponse, "unary"),
+    "XlaSharedMemoryStatus": (
+        pb.XlaSharedMemoryStatusRequest, pb.XlaSharedMemoryStatusResponse,
+        "unary"),
+    "XlaSharedMemoryRegister": (
+        pb.XlaSharedMemoryRegisterRequest,
+        pb.XlaSharedMemoryRegisterResponse, "unary"),
+    "XlaSharedMemoryUnregister": (
+        pb.XlaSharedMemoryUnregisterRequest,
+        pb.XlaSharedMemoryUnregisterResponse, "unary"),
+    "TraceSetting": (
+        pb.TraceSettingRequest, pb.TraceSettingResponse, "unary"),
+    "LogSettings": (pb.LogSettingsRequest, pb.LogSettingsResponse, "unary"),
+}
+
+
+def method_path(name):
+    return "/{}/{}".format(SERVICE, name)
+
+
+class ServiceStub:
+    """Callable-per-method stub built from a ``grpc.Channel``.
+
+    ``stub.ModelInfer(request, metadata=..., timeout=...)`` etc.;
+    ``stub.ModelInfer.future(...)`` works for async use because the
+    underlying grpc multicallables expose ``.future``.
+    """
+
+    def __init__(self, channel):
+        for name, (req_cls, resp_cls, kind) in METHODS.items():
+            if kind == "unary":
+                call = channel.unary_unary(
+                    method_path(name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                )
+            else:
+                call = channel.stream_stream(
+                    method_path(name),
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=resp_cls.FromString,
+                )
+            setattr(self, name, call)
+
+
+class AioServiceStub(ServiceStub):
+    """Same registry over a ``grpc.aio`` channel (multicallables are
+    awaitable / async-iterable there)."""

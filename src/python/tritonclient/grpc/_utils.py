@@ -1,0 +1,81 @@
+"""Shared request-assembly helpers for the sync and aio gRPC clients
+(reference grpc/_utils.py)."""
+
+import grpc
+
+from tritonclient.utils import InferenceServerException
+
+from . import grpc_service_pb2 as pb
+from ._infer_input import _set_parameter
+
+
+def raise_error_grpc(rpc_error):
+    """Map a grpc.RpcError to InferenceServerException."""
+    try:
+        msg = rpc_error.details()
+        code = rpc_error.code()
+        status = "StatusCode." + code.name if code is not None else None
+    except Exception:
+        msg = str(rpc_error)
+        status = None
+    raise InferenceServerException(msg=msg, status=status) from None
+
+
+def get_error_grpc(rpc_error):
+    try:
+        msg = rpc_error.details()
+        code = rpc_error.code()
+        status = "StatusCode." + code.name if code is not None else None
+    except Exception:
+        msg = str(rpc_error)
+        status = None
+    return InferenceServerException(msg=msg, status=status)
+
+
+def _get_inference_request(
+    model_name,
+    inputs,
+    model_version="",
+    request_id="",
+    outputs=None,
+    sequence_id=0,
+    sequence_start=False,
+    sequence_end=False,
+    priority=0,
+    timeout=None,
+    parameters=None,
+):
+    """Build a ModelInferRequest (reference _utils.py:64-110)."""
+    request = pb.ModelInferRequest()
+    request.model_name = model_name
+    request.model_version = model_version
+    if request_id:
+        request.id = request_id
+    for infer_input in inputs:
+        request.inputs.append(infer_input._get_tensor())
+        raw = infer_input._get_content()
+        if raw is not None:
+            request.raw_input_contents.append(raw)
+    for infer_output in outputs or []:
+        request.outputs.append(infer_output._get_tensor())
+    if sequence_id:
+        _set_parameter(request.parameters, "sequence_id", int(sequence_id))
+        _set_parameter(
+            request.parameters, "sequence_start", bool(sequence_start)
+        )
+        _set_parameter(request.parameters, "sequence_end", bool(sequence_end))
+    if priority:
+        _set_parameter(request.parameters, "priority", int(priority))
+    if timeout is not None:
+        _set_parameter(request.parameters, "timeout", int(timeout))
+    for key, value in (parameters or {}).items():
+        if key in (
+            "sequence_id", "sequence_start", "sequence_end", "priority",
+            "binary_data_output",
+        ):
+            raise InferenceServerException(
+                "parameter '{}' must be set through the dedicated "
+                "argument".format(key)
+            )
+        _set_parameter(request.parameters, key, value)
+    return request

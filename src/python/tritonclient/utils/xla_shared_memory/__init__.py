@@ -337,10 +337,23 @@ def set_shared_memory_region_from_jax(handle, arrays, offset=0):
     set_shared_memory_region(handle, list(arrays), offset)
 
 
+def _np_dtype_of(datatype):
+    """Accept a numpy dtype or a Triton wire datatype string ('INT32')."""
+    if isinstance(datatype, str):
+        resolved = triton_to_np_dtype(datatype)
+        if resolved is not None:
+            return np.dtype(resolved) if datatype != "BYTES" else np.dtype(
+                np.object_
+            )
+    return np.dtype(datatype)
+
+
 def get_contents_as_numpy(handle, datatype, shape, offset=0):
     """Read region contents as a numpy array (one device->host fetch when the
     segment is device-resident, mirroring the staging copy of reference
-    cuda_shared_memory.cc:160-179)."""
+    cuda_shared_memory.cc:160-179).  ``datatype`` may be a numpy dtype (as
+    in the reference cuda API) or a Triton datatype string."""
+    datatype = _np_dtype_of(datatype)
     root = handle._root()
     seg = root._segments.get(offset)
     if seg is not None:

@@ -1,0 +1,368 @@
+"""End-to-end tests: tritonclient.grpc against the in-process tpuserver gRPC
+frontend (full v2 surface incl. decoupled streaming and shared memory)."""
+
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+import tritonclient.grpc as grpcclient
+from tritonclient.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def grpc_server(server_core):
+    from tpuserver.grpc_frontend import GrpcFrontend
+
+    frontend = GrpcFrontend(server_core, port=0).start()
+    yield frontend
+    frontend.stop()
+
+
+@pytest.fixture(scope="module")
+def client(grpc_server):
+    with grpcclient.InferenceServerClient(grpc_server.url) as c:
+        yield c
+
+
+def test_server_live_ready(client):
+    assert client.is_server_live()
+    assert client.is_server_ready()
+
+
+def test_model_ready(client):
+    assert client.is_model_ready("simple")
+    assert not client.is_model_ready("nonexistent_model")
+
+
+def test_server_metadata(client):
+    meta = client.get_server_metadata()
+    assert meta.name == "tpu-triton-server"
+    assert "xla_shared_memory" in meta.extensions
+    as_json = client.get_server_metadata(as_json=True)
+    assert as_json["name"] == "tpu-triton-server"
+
+
+def test_model_metadata(client):
+    meta = client.get_model_metadata("simple")
+    assert meta.name == "simple"
+    assert {t.name for t in meta.inputs} == {"INPUT0", "INPUT1"}
+    assert list(meta.inputs[0].shape) == [16]
+
+
+def test_model_config(client):
+    cfg = client.get_model_config("simple").config
+    assert cfg.name == "simple"
+    assert cfg.max_batch_size == 8
+
+
+def test_repository_index_and_load_unload(client):
+    index = client.get_model_repository_index()
+    names = {m.name for m in index.models}
+    assert {"simple", "repeat_int32"} <= names
+    client.unload_model("simple")
+    assert not client.is_model_ready("simple")
+    client.load_model("simple")
+    assert client.is_model_ready("simple")
+
+
+def _simple_inputs():
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+def test_infer_simple(client):
+    in0, in1, inputs = _simple_inputs()
+    outputs = [
+        grpcclient.InferRequestedOutput("OUTPUT0"),
+        grpcclient.InferRequestedOutput("OUTPUT1"),
+    ]
+    result = client.infer("simple", inputs, outputs=outputs,
+                          request_id="42")
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), in0 - in1)
+    assert result.get_response().id == "42"
+    assert result.get_output("OUTPUT0").datatype == "INT32"
+    assert result.get_output("nope") is None
+
+
+def test_infer_default_outputs(client):
+    in0, in1, inputs = _simple_inputs()
+    result = client.infer("simple", inputs)
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_async_infer(client):
+    in0, in1, inputs = _simple_inputs()
+    done = queue.Queue()
+    client.async_infer(
+        "simple", inputs, lambda result, error: done.put((result, error))
+    )
+    result, error = done.get(timeout=10)
+    assert error is None
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+
+def test_infer_string_model(client):
+    data = np.array(
+        [str(i).encode("utf-8") for i in range(16)], dtype=np.object_
+    ).reshape(1, 16)
+    ones = np.array([b"1"] * 16, dtype=np.object_).reshape(1, 16)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "BYTES"),
+        grpcclient.InferInput("INPUT1", [1, 16], "BYTES"),
+    ]
+    inputs[0].set_data_from_numpy(data)
+    inputs[1].set_data_from_numpy(ones)
+    result = client.infer("simple_string", inputs)
+    out = result.as_numpy("OUTPUT0")
+    assert out.shape == (1, 16)
+    assert int(out[0, 3]) == 4
+
+
+def test_infer_bf16(client):
+    import ml_dtypes
+
+    arr = np.array([[1.5, -2.25, 3.0]], dtype=ml_dtypes.bfloat16)
+    inp = grpcclient.InferInput("INPUT0", [1, 3], "BF16")
+    inp.set_data_from_numpy(arr)
+    result = client.infer("identity_bf16", [inp])
+    out = result.as_numpy("OUTPUT0")
+    assert out.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(out.astype(np.float32),
+                                  arr.astype(np.float32))
+
+
+def test_infer_jax_input(client):
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(np.eye(4, dtype=np.float32))
+    inp = grpcclient.InferInput("INPUT0", [4, 4], "FP32")
+    inp.set_data_from_numpy(arr)
+    result = client.infer("identity_fp32", [inp])
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"),
+                                  np.eye(4, dtype=np.float32))
+
+
+def test_sequence_model(client):
+    values = [3, 5, 7]
+    total = 0
+    for i, v in enumerate(values):
+        inp = grpcclient.InferInput("INPUT", [1], "INT32")
+        inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+        result = client.infer(
+            "sequence_accumulate",
+            [inp],
+            sequence_id=99,
+            sequence_start=(i == 0),
+            sequence_end=(i == len(values) - 1),
+        )
+        total += v
+        assert int(result.as_numpy("OUTPUT")[0]) == total
+
+
+def test_infer_error_unknown_model(client):
+    in0, in1, inputs = _simple_inputs()
+    with pytest.raises(InferenceServerException, match="unknown model"):
+        client.infer("not_a_model", inputs)
+
+
+def test_infer_error_missing_input(client):
+    inp = grpcclient.InferInput("INPUT0", [1, 16], "INT32")
+    inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.int32))
+    with pytest.raises(InferenceServerException, match="missing"):
+        client.infer("simple", [inp])
+
+
+def test_statistics(client):
+    stats = client.get_inference_statistics("simple")
+    assert len(stats.model_stats) == 1
+    assert stats.model_stats[0].name == "simple"
+    assert stats.model_stats[0].inference_count >= 1
+
+
+def test_trace_and_log_settings(client):
+    ts = client.update_trace_settings(
+        settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "500"}
+    )
+    assert list(ts.settings["trace_level"].value) == ["TIMESTAMPS"]
+    ts2 = client.get_trace_settings()
+    assert list(ts2.settings["trace_rate"].value) == ["500"]
+    ls = client.update_log_settings({"log_verbose_level": 2})
+    assert ls.settings["log_verbose_level"].uint32_param == 2
+    ls2 = client.get_log_settings()
+    assert ls2.settings["log_verbose_level"].uint32_param == 2
+
+
+def test_stream_decoupled_repeat(client):
+    """One request to the decoupled repeat model → N streamed responses."""
+    values = np.array([10, 20, 30, 40], dtype=np.int32)
+    results = queue.Queue()
+    client.start_stream(lambda result, error: results.put((result, error)))
+    try:
+        inputs = [
+            grpcclient.InferInput("IN", [4], "INT32"),
+            grpcclient.InferInput("DELAY", [4], "UINT32"),
+            grpcclient.InferInput("WAIT", [1], "UINT32"),
+        ]
+        inputs[0].set_data_from_numpy(values)
+        inputs[1].set_data_from_numpy(np.zeros(4, dtype=np.uint32))
+        inputs[2].set_data_from_numpy(np.array([0], dtype=np.uint32))
+        client.async_stream_infer(
+            "repeat_int32", inputs, enable_empty_final_response=True
+        )
+        got = []
+        for _ in range(4):
+            result, error = results.get(timeout=10)
+            assert error is None
+            got.append(int(result.as_numpy("OUT")[0]))
+        assert got == [10, 20, 30, 40]
+        # completion marker: empty final response with the parameter set
+        final, error = results.get(timeout=10)
+        assert error is None
+        resp = final.get_response()
+        assert resp.parameters["triton_final_response"].bool_param is True
+        assert len(resp.outputs) == 0
+    finally:
+        client.stop_stream()
+
+
+def test_stream_non_decoupled_and_error(client):
+    """Streaming a regular model yields 1:1 responses; bad model names
+    surface as in-band errors without killing the stream."""
+    results = queue.Queue()
+    client.start_stream(lambda result, error: results.put((result, error)))
+    try:
+        in0, in1, inputs = _simple_inputs()
+        client.async_stream_infer("simple", inputs)
+        result, error = results.get(timeout=10)
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), in0 + in1)
+
+        client.async_stream_infer("not_a_model", inputs)
+        result, error = results.get(timeout=10)
+        assert result is None
+        assert isinstance(error, InferenceServerException)
+
+        # stream still alive after the error
+        client.async_stream_infer("simple", inputs)
+        result, error = results.get(timeout=10)
+        assert error is None
+    finally:
+        client.stop_stream()
+
+
+def test_system_shared_memory_roundtrip(client, grpc_server):
+    from tritonclient.utils import shared_memory as shm
+
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.full((1, 16), 2, dtype=np.int32)
+    byte_size = in0.nbytes
+    h_in = shm.create_shared_memory_region(
+        "grpc_in", "/grpc_shm_in", 2 * byte_size
+    )
+    h_out = shm.create_shared_memory_region(
+        "grpc_out", "/grpc_shm_out", 2 * byte_size
+    )
+    try:
+        shm.set_shared_memory_region(h_in, [in0, in1])
+        client.register_system_shared_memory(
+            "grpc_in", "/grpc_shm_in", 2 * byte_size
+        )
+        client.register_system_shared_memory(
+            "grpc_out", "/grpc_shm_out", 2 * byte_size
+        )
+        status = client.get_system_shared_memory_status()
+        assert set(status.regions) >= {"grpc_in", "grpc_out"}
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("grpc_in", byte_size)
+        inputs[1].set_shared_memory("grpc_in", byte_size, offset=byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("grpc_out", byte_size)
+        outputs[1].set_shared_memory("grpc_out", byte_size,
+                                     offset=byte_size)
+        result = client.infer("simple", inputs, outputs=outputs)
+        out0 = result.get_output("OUTPUT0")
+        assert result.as_numpy("OUTPUT0") is None or (
+            result.as_numpy("OUTPUT0").size == 0
+        )
+        sum_arr = shm.get_contents_as_numpy(
+            h_out, np.int32, [1, 16]
+        )
+        np.testing.assert_array_equal(sum_arr, in0 + in1)
+        diff = shm.get_contents_as_numpy(
+            h_out, np.int32, [1, 16], offset=byte_size
+        )
+        np.testing.assert_array_equal(diff, in0 - in1)
+    finally:
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(h_in)
+        shm.destroy_shared_memory_region(h_out)
+
+
+def test_xla_shared_memory_roundtrip(client, grpc_server):
+    """TPU-native path: jax.Array in, outputs into an XLA region — with the
+    in-process server this is the zero-host-copy plane."""
+    import jax.numpy as jnp
+
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    in0 = jnp.asarray(np.arange(16, dtype=np.int32).reshape(1, 16))
+    in1 = jnp.asarray(np.full((1, 16), 3, dtype=np.int32))
+    byte_size = 64
+    h_in = xshm.create_shared_memory_region("xla_in", 2 * byte_size)
+    h_out = xshm.create_shared_memory_region("xla_out", 2 * byte_size)
+    try:
+        client.register_xla_shared_memory(
+            "xla_in", xshm.get_raw_handle(h_in), 0, 2 * byte_size
+        )
+        client.register_xla_shared_memory(
+            "xla_out", xshm.get_raw_handle(h_out), 0, 2 * byte_size
+        )
+        xshm.set_shared_memory_region(h_in, [in0, in1])
+        status = client.get_xla_shared_memory_status()
+        assert set(status.regions) == {"xla_in", "xla_out"}
+
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("xla_in", byte_size)
+        inputs[1].set_shared_memory("xla_in", byte_size, offset=byte_size)
+        outputs = [
+            grpcclient.InferRequestedOutput("OUTPUT0"),
+            grpcclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("xla_out", byte_size)
+        outputs[1].set_shared_memory("xla_out", byte_size, offset=byte_size)
+        client.infer("simple", inputs, outputs=outputs)
+        out0 = xshm.get_contents_as_numpy(h_out, np.int32, [1, 16])
+        np.testing.assert_array_equal(out0, np.asarray(in0 + in1))
+        out_jax = xshm.get_contents_as_jax(h_out, "INT32", [1, 16])
+        np.testing.assert_array_equal(
+            np.asarray(out_jax), np.asarray(in0 + in1)
+        )
+    finally:
+        client.unregister_xla_shared_memory()
+        xshm.destroy_shared_memory_region(h_in)
+        xshm.destroy_shared_memory_region(h_out)
+
+
+def test_cuda_shared_memory_rejected(client):
+    with pytest.raises(InferenceServerException, match="no CUDA"):
+        client.register_cuda_shared_memory("cshm", b"handle", 0, 64)
