@@ -357,16 +357,6 @@ class _CoreBridge:
                 )
 
 
-def _nbytes(datatype, shape):
-    np_dtype = triton_to_np_dtype(datatype)
-    if np_dtype is None or datatype == "BYTES":
-        return -1
-    n = 1
-    for s in shape:
-        n *= int(s)
-    return n * np.dtype(np_dtype).itemsize
-
-
 def _wrap_unary(bridge, name):
     method = getattr(bridge, name)
 

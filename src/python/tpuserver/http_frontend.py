@@ -23,13 +23,7 @@ from tpuserver.core import (
     RequestedOutput,
     ServerError,
 )
-from tritonclient.utils import (
-    deserialize_bf16_tensor,
-    deserialize_bytes_tensor,
-    serialize_bf16_tensor,
-    serialize_byte_tensor,
-    triton_to_np_dtype,
-)
+from tritonclient.utils import triton_to_np_dtype
 
 _MODEL_URI = re.compile(
     r"^/v2/models/(?P<model>[^/]+)(/versions/(?P<version>[^/]+))?"
@@ -95,14 +89,21 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json({"error": msg}, code)
 
     def _read_body(self):
-        length = int(self.headers.get("Content-Length", 0))
-        body = self.rfile.read(length) if length else b""
-        encoding = self.headers.get("Content-Encoding")
-        if encoding == "gzip":
-            body = gzip.decompress(body)
-        elif encoding == "deflate":
-            body = zlib.decompress(body)
-        return body
+        """Read (once) and cache the request body.
+
+        Always called before responding — an unconsumed body would be
+        parsed as the start of the next request on this keep-alive socket.
+        """
+        if getattr(self, "_body", None) is None:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            encoding = self.headers.get("Content-Encoding")
+            if encoding == "gzip":
+                body = gzip.decompress(body)
+            elif encoding == "deflate":
+                body = zlib.decompress(body)
+            self._body = body
+        return self._body
 
     # -- dispatch ---------------------------------------------------------
 
@@ -118,6 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         try:
+            self._body = None
+            self._read_body()  # drain the socket before any response
             self._route("POST")
         except ServerError as e:
             self._send_error_json(str(e), e.code)

@@ -48,9 +48,6 @@ class _InferStream:
         self._response_iterator = None
         self._handler = None
         self._active = True
-        self._enqueued = 0
-        self._received = 0
-        self._lock = threading.Lock()
 
     def _init_handler(self, response_iterator):
         self._response_iterator = response_iterator
@@ -66,8 +63,6 @@ class _InferStream:
                 "is reported through provided callback. A new stream should "
                 "be started after stopping the current stream."
             )
-        with self._lock:
-            self._enqueued += 1
         self._request_iterator.put(request)
 
     def _process_response(self):
@@ -77,8 +72,6 @@ class _InferStream:
             for response in self._response_iterator:
                 if self._verbose:
                     print(response)
-                with self._lock:
-                    self._received += 1
                 if response.error_message:
                     self._callback(
                         None,

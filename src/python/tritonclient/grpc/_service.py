@@ -98,7 +98,3 @@ class ServiceStub:
                 )
             setattr(self, name, call)
 
-
-class AioServiceStub(ServiceStub):
-    """Same registry over a ``grpc.aio`` channel (multicallables are
-    awaitable / async-iterable there)."""

@@ -10,15 +10,8 @@ from ._infer_input import _set_parameter
 
 
 def raise_error_grpc(rpc_error):
-    """Map a grpc.RpcError to InferenceServerException."""
-    try:
-        msg = rpc_error.details()
-        code = rpc_error.code()
-        status = "StatusCode." + code.name if code is not None else None
-    except Exception:
-        msg = str(rpc_error)
-        status = None
-    raise InferenceServerException(msg=msg, status=status) from None
+    """Map a grpc.RpcError to InferenceServerException and raise it."""
+    raise get_error_grpc(rpc_error) from None
 
 
 def get_error_grpc(rpc_error):

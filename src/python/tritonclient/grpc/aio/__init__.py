@@ -1,0 +1,468 @@
+"""tritonclient.grpc.aio — asyncio gRPC client (reference
+grpc/aio/__init__.py:67-829).
+
+Same method surface as the sync client but awaitable, and ``stream_infer``
+is an async generator over the bidirectional ModelStreamInfer stream
+yielding ``(InferResult | None, InferenceServerException | None)`` —
+decoupled-model friendly (reference aio/__init__.py:729-829).
+"""
+
+import asyncio
+
+import grpc
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._client import KeepAliveOptions  # noqa: F401
+from tritonclient.grpc._infer_input import (  # noqa: F401
+    InferInput,
+    InferRequestedOutput,
+)
+from tritonclient.grpc._infer_result import InferResult
+from tritonclient.grpc._service import ServiceStub
+from tritonclient.grpc._utils import (
+    _get_inference_request,
+    get_error_grpc,
+    raise_error_grpc,
+)
+from tritonclient.utils import InferenceServerException, raise_error
+
+
+class InferenceServerClient:
+    """Asyncio client talking KServe-v2 over gRPC to ``url`` (host:port)."""
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        ssl=False,
+        root_certificates=None,
+        private_key=None,
+        certificate_chain=None,
+        creds=None,
+        keepalive_options=None,
+        channel_args=None,
+    ):
+        if keepalive_options is None:
+            keepalive_options = KeepAliveOptions()
+        options = [
+            ("grpc.max_send_message_length", -1),
+            ("grpc.max_receive_message_length", -1),
+            ("grpc.keepalive_time_ms", keepalive_options.keepalive_time_ms),
+            (
+                "grpc.keepalive_timeout_ms",
+                keepalive_options.keepalive_timeout_ms,
+            ),
+            (
+                "grpc.keepalive_permit_without_calls",
+                int(keepalive_options.keepalive_permit_without_calls),
+            ),
+            (
+                "grpc.http2.max_pings_without_data",
+                keepalive_options.http2_max_pings_without_data,
+            ),
+        ]
+        for arg in channel_args or []:
+            options.append(arg)
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(
+                url, creds, options=options
+            )
+        elif ssl:
+            rc = open(root_certificates, "rb").read() if (
+                root_certificates
+            ) else None
+            pk = open(private_key, "rb").read() if private_key else None
+            cc = open(certificate_chain, "rb").read() if (
+                certificate_chain
+            ) else None
+            credentials = grpc.ssl_channel_credentials(
+                root_certificates=rc, private_key=pk, certificate_chain=cc
+            )
+            self._channel = grpc.aio.secure_channel(
+                url, credentials, options=options
+            )
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._stub = ServiceStub(self._channel)
+        self._verbose = verbose
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb):
+        await self.close()
+
+    async def close(self):
+        await self._channel.close()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _metadata(self, headers):
+        if headers is None:
+            return None
+        return tuple(headers.items())
+
+    async def _call(self, name, request, headers=None, timeout=None):
+        if self._verbose:
+            print("{}, metadata {}\n{}".format(name, headers, request))
+        try:
+            response = await getattr(self._stub, name)(
+                request, metadata=self._metadata(headers), timeout=timeout
+            )
+            if self._verbose:
+                print(response)
+            return response
+        except grpc.RpcError as rpc_error:
+            raise_error_grpc(rpc_error)
+
+    @staticmethod
+    def _as_json(message, as_json):
+        if not as_json:
+            return message
+        from google.protobuf import json_format
+
+        return json_format.MessageToDict(
+            message, preserving_proto_field_name=True
+        )
+
+    # -- health / metadata / repository / settings -------------------------
+
+    async def is_server_live(self, headers=None, client_timeout=None):
+        r = await self._call(
+            "ServerLive", pb.ServerLiveRequest(), headers, client_timeout
+        )
+        return r.live
+
+    async def is_server_ready(self, headers=None, client_timeout=None):
+        r = await self._call(
+            "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+        )
+        return r.ready
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ):
+        r = await self._call(
+            "ModelReady",
+            pb.ModelReadyRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+        return r.ready
+
+    async def get_server_metadata(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "ServerMetadata", pb.ServerMetadataRequest(), headers,
+            client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelMetadata",
+            pb.ModelMetadataRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelConfig",
+            pb.ModelConfigRequest(name=model_name, version=model_version),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def get_model_repository_index(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "RepositoryIndex", pb.RepositoryIndexRequest(), headers,
+            client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def load_model(
+        self, model_name, headers=None, config=None, files=None,
+        client_timeout=None,
+    ):
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = config
+        for path, content in (files or {}).items():
+            request.parameters[path].bytes_param = content
+        await self._call(
+            "RepositoryModelLoad", request, headers, client_timeout
+        )
+
+    async def unload_model(
+        self, model_name, headers=None, unload_dependents=False,
+        client_timeout=None,
+    ):
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = (
+            unload_dependents
+        )
+        await self._call(
+            "RepositoryModelUnload", request, headers, client_timeout
+        )
+
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelStatistics",
+            pb.ModelStatisticsRequest(
+                name=model_name, version=model_version
+            ),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        request = pb.TraceSettingRequest(model_name=model_name or "")
+        for key, value in (settings or {}).items():
+            if value is None:
+                request.settings[key].Clear()
+            elif isinstance(value, (list, tuple)):
+                request.settings[key].value.extend(str(v) for v in value)
+            else:
+                request.settings[key].value.append(str(value))
+        r = await self._call(
+            "TraceSetting", request, headers, client_timeout
+        )
+        return self._as_json(r, as_json)
+
+    async def get_trace_settings(
+        self, model_name=None, headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "TraceSetting",
+            pb.TraceSettingRequest(model_name=model_name or ""),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def update_log_settings(
+        self, settings, headers=None, as_json=False, client_timeout=None
+    ):
+        request = pb.LogSettingsRequest()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            elif isinstance(value, str):
+                request.settings[key].string_param = value
+            else:
+                raise_error(
+                    "unsupported log setting type for '{}'".format(key)
+                )
+        r = await self._call("LogSettings", request, headers, client_timeout)
+        return self._as_json(r, as_json)
+
+    async def get_log_settings(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "LogSettings", pb.LogSettingsRequest(), headers, client_timeout
+        )
+        return self._as_json(r, as_json)
+
+    # -- shared memory -----------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "SystemSharedMemoryStatus",
+            pb.SystemSharedMemoryStatusRequest(name=region_name),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None,
+        client_timeout=None,
+    ):
+        await self._call(
+            "SystemSharedMemoryRegister",
+            pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            ),
+            headers, client_timeout,
+        )
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ):
+        await self._call(
+            "SystemSharedMemoryUnregister",
+            pb.SystemSharedMemoryUnregisterRequest(name=name),
+            headers, client_timeout,
+        )
+
+    async def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "CudaSharedMemoryStatus",
+            pb.CudaSharedMemoryStatusRequest(name=region_name),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None,
+        client_timeout=None,
+    ):
+        await self._call(
+            "CudaSharedMemoryRegister",
+            pb.CudaSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle, device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers, client_timeout,
+        )
+
+    async def unregister_cuda_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ):
+        await self._call(
+            "CudaSharedMemoryUnregister",
+            pb.CudaSharedMemoryUnregisterRequest(name=name),
+            headers, client_timeout,
+        )
+
+    async def get_xla_shared_memory_status(
+        self, region_name="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "XlaSharedMemoryStatus",
+            pb.XlaSharedMemoryStatusRequest(name=region_name),
+            headers, client_timeout,
+        )
+        return self._as_json(r, as_json)
+
+    async def register_xla_shared_memory(
+        self, name, raw_handle, device_ordinal, byte_size, headers=None,
+        client_timeout=None,
+    ):
+        await self._call(
+            "XlaSharedMemoryRegister",
+            pb.XlaSharedMemoryRegisterRequest(
+                name=name, raw_handle=raw_handle,
+                device_ordinal=device_ordinal, byte_size=byte_size,
+            ),
+            headers, client_timeout,
+        )
+
+    async def unregister_xla_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ):
+        await self._call(
+            "XlaSharedMemoryUnregister",
+            pb.XlaSharedMemoryUnregisterRequest(name=name),
+            headers, client_timeout,
+        )
+
+    # -- inference ---------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        parameters=None,
+    ):
+        request = _get_inference_request(
+            model_name=model_name,
+            inputs=inputs,
+            model_version=model_version,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            parameters=parameters,
+        )
+        response = await self._call(
+            "ModelInfer", request, headers, client_timeout
+        )
+        return InferResult(response)
+
+    async def stream_infer(
+        self,
+        inputs_iterator,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ):
+        """Async generator over ModelStreamInfer.
+
+        ``inputs_iterator`` is an async iterator of dicts with the ``infer``
+        kwargs (model_name, inputs, outputs, request_id, sequence_*,
+        enable_empty_final_response, ...); yields ``(result, error)`` pairs
+        as responses arrive (reference grpc/aio/__init__.py:729-829)."""
+
+        async def request_iterator():
+            async for kwargs in inputs_iterator:
+                if not isinstance(kwargs, dict):
+                    raise InferenceServerException(
+                        "inputs_iterator must yield dicts of infer args"
+                    )
+                enable_final = kwargs.pop(
+                    "enable_empty_final_response", False
+                )
+                request = _get_inference_request(**kwargs)
+                if enable_final:
+                    request.parameters[
+                        "triton_enable_empty_final_response"
+                    ].bool_param = True
+                yield request
+
+        try:
+            call = self._stub.ModelStreamInfer(
+                request_iterator(),
+                metadata=self._metadata(headers),
+                timeout=stream_timeout,
+                compression=compression_algorithm,
+            )
+            async for response in call:
+                if self._verbose:
+                    print(response)
+                if response.error_message:
+                    yield None, InferenceServerException(
+                        response.error_message
+                    )
+                else:
+                    yield InferResult(response.infer_response), None
+        except grpc.RpcError as rpc_error:
+            if rpc_error.code() != grpc.StatusCode.CANCELLED:
+                yield None, get_error_grpc(rpc_error)

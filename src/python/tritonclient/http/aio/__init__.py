@@ -1,0 +1,392 @@
+"""tritonclient.http.aio — asyncio HTTP client on aiohttp (reference
+http/aio/__init__.py:42-789).
+
+Shares the wire codec with the sync client: request bodies come from
+``_get_inference_request`` (JSON header + binary-tensor sections), responses
+are parsed by ``InferResult.from_response_body``.
+"""
+
+import gzip
+import zlib
+from urllib.parse import quote
+
+import aiohttp
+
+from tritonclient.http._infer_input import InferInput  # noqa: F401
+from tritonclient.http._infer_result import InferResult
+from tritonclient.http._requested_output import (  # noqa: F401
+    InferRequestedOutput,
+)
+from tritonclient.http._utils import _get_inference_request
+from tritonclient.utils import InferenceServerException, raise_error
+
+
+class InferenceServerClient:
+    """Asyncio client for the KServe-v2 HTTP protocol at ``url``
+    (host:port, no scheme) — full surface of the sync client, awaitable."""
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        conn_limit=100,
+        conn_timeout=60.0,
+        network_timeout=60.0,
+        ssl=False,
+        ssl_context=None,
+    ):
+        scheme = "https" if ssl else "http"
+        self._base_url = "{}://{}".format(scheme, url)
+        self._verbose = verbose
+        timeout = aiohttp.ClientTimeout(
+            connect=conn_timeout, total=network_timeout
+        )
+        connector = aiohttp.TCPConnector(
+            limit=conn_limit, ssl=ssl_context if ssl else False
+        )
+        self._session = aiohttp.ClientSession(
+            base_url=self._base_url, timeout=timeout, connector=connector
+        )
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, exc_type, exc, tb):
+        await self.close()
+
+    async def close(self):
+        await self._session.close()
+
+    # -- plumbing ----------------------------------------------------------
+
+    async def _get(self, uri, headers=None, query_params=None):
+        if self._verbose:
+            print("GET {}, headers {}".format(uri, headers))
+        async with self._session.get(
+            "/" + uri, headers=headers, params=query_params
+        ) as resp:
+            body = await resp.read()
+            return resp, body
+
+    async def _post(self, uri, body, headers=None, query_params=None):
+        if self._verbose:
+            print("POST {}, headers {}".format(uri, headers))
+        async with self._session.post(
+            "/" + uri, data=body, headers=headers, params=query_params
+        ) as resp:
+            rbody = await resp.read()
+            return resp, rbody
+
+    @staticmethod
+    def _raise_if_error(resp, body):
+        if resp.status >= 400:
+            error_msg = body.decode("utf-8", errors="replace")
+            try:
+                import json
+
+                error_msg = json.loads(error_msg)["error"]
+            except Exception:
+                pass
+            raise InferenceServerException(
+                msg=error_msg, status=str(resp.status)
+            )
+
+    async def _get_json(self, uri, headers=None, query_params=None):
+        resp, body = await self._get(uri, headers, query_params)
+        self._raise_if_error(resp, body)
+        import json
+
+        result = json.loads(body) if body else {}
+        if self._verbose:
+            print(result)
+        return result
+
+    async def _post_json(
+        self, uri, request=None, headers=None, query_params=None
+    ):
+        import json
+
+        body = json.dumps(request).encode("utf-8") if (
+            request is not None
+        ) else b""
+        resp, rbody = await self._post(uri, body, headers, query_params)
+        self._raise_if_error(resp, rbody)
+        result = json.loads(rbody) if rbody else {}
+        if self._verbose:
+            print(result)
+        return result
+
+    # -- health / metadata -------------------------------------------------
+
+    async def is_server_live(self, headers=None, query_params=None):
+        resp, body = await self._get("v2/health/live", headers, query_params)
+        return resp.status == 200
+
+    async def is_server_ready(self, headers=None, query_params=None):
+        resp, body = await self._get("v2/health/ready", headers, query_params)
+        return resp.status == 200
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = "v2/models/{}".format(quote(model_name))
+        if model_version:
+            uri += "/versions/{}".format(model_version)
+        resp, body = await self._get(uri + "/ready", headers, query_params)
+        return resp.status == 200
+
+    async def get_server_metadata(self, headers=None, query_params=None):
+        return await self._get_json("v2", headers, query_params)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = "v2/models/{}".format(quote(model_name))
+        if model_version:
+            uri += "/versions/{}".format(model_version)
+        return await self._get_json(uri, headers, query_params)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = "v2/models/{}".format(quote(model_name))
+        if model_version:
+            uri += "/versions/{}".format(model_version)
+        return await self._get_json(uri + "/config", headers, query_params)
+
+    # -- repository --------------------------------------------------------
+
+    async def get_model_repository_index(
+        self, headers=None, query_params=None
+    ):
+        return await self._post_json(
+            "v2/repository/index", {}, headers, query_params
+        )
+
+    async def load_model(
+        self, model_name, headers=None, query_params=None, config=None,
+        files=None,
+    ):
+        import base64
+
+        request = {}
+        if config is not None or files:
+            request["parameters"] = {}
+            if config is not None:
+                request["parameters"]["config"] = config
+            for path, content in (files or {}).items():
+                request["parameters"][path] = base64.b64encode(
+                    content
+                ).decode("utf-8")
+        await self._post_json(
+            "v2/repository/models/{}/load".format(quote(model_name)),
+            request, headers, query_params,
+        )
+
+    async def unload_model(
+        self, model_name, headers=None, query_params=None,
+        unload_dependents=False,
+    ):
+        await self._post_json(
+            "v2/repository/models/{}/unload".format(quote(model_name)),
+            {"parameters": {"unload_dependents": unload_dependents}},
+            headers, query_params,
+        )
+
+    # -- statistics / settings ---------------------------------------------
+
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None,
+        query_params=None,
+    ):
+        if model_name:
+            uri = "v2/models/{}".format(quote(model_name))
+            if model_version:
+                uri += "/versions/{}".format(model_version)
+            uri += "/stats"
+        else:
+            uri = "v2/models/stats"
+        return await self._get_json(uri, headers, query_params)
+
+    async def update_trace_settings(
+        self, model_name=None, settings=None, headers=None, query_params=None
+    ):
+        uri = "v2{}/trace/setting".format(
+            "/models/" + quote(model_name) if model_name else ""
+        )
+        return await self._post_json(
+            uri, settings or {}, headers, query_params
+        )
+
+    async def get_trace_settings(
+        self, model_name=None, headers=None, query_params=None
+    ):
+        uri = "v2{}/trace/setting".format(
+            "/models/" + quote(model_name) if model_name else ""
+        )
+        return await self._get_json(uri, headers, query_params)
+
+    async def update_log_settings(
+        self, settings, headers=None, query_params=None
+    ):
+        return await self._post_json(
+            "v2/logging", settings, headers, query_params
+        )
+
+    async def get_log_settings(self, headers=None, query_params=None):
+        return await self._get_json("v2/logging", headers, query_params)
+
+    # -- shared memory -----------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        uri = "v2/systemsharedmemory"
+        if region_name:
+            uri += "/region/{}".format(quote(region_name))
+        return await self._get_json(uri + "/status", headers, query_params)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ):
+        await self._post_json(
+            "v2/systemsharedmemory/region/{}/register".format(quote(name)),
+            {"key": key, "offset": offset, "byte_size": byte_size},
+            headers, query_params,
+        )
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/systemsharedmemory"
+        if name:
+            uri += "/region/{}".format(quote(name))
+        await self._post_json(uri + "/unregister", {}, headers, query_params)
+
+    async def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        uri = "v2/cudasharedmemory"
+        if region_name:
+            uri += "/region/{}".format(quote(region_name))
+        return await self._get_json(uri + "/status", headers, query_params)
+
+    async def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None,
+        query_params=None,
+    ):
+        await self._post_json(
+            "v2/cudasharedmemory/region/{}/register".format(quote(name)),
+            {
+                "raw_handle": {
+                    "b64": raw_handle.decode("utf-8")
+                    if isinstance(raw_handle, bytes)
+                    else raw_handle
+                },
+                "device_id": device_id,
+                "byte_size": byte_size,
+            },
+            headers, query_params,
+        )
+
+    async def unregister_cuda_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/cudasharedmemory"
+        if name:
+            uri += "/region/{}".format(quote(name))
+        await self._post_json(uri + "/unregister", {}, headers, query_params)
+
+    async def get_xla_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        uri = "v2/xlasharedmemory"
+        if region_name:
+            uri += "/region/{}".format(quote(region_name))
+        return await self._get_json(uri + "/status", headers, query_params)
+
+    async def register_xla_shared_memory(
+        self, name, raw_handle, device_ordinal, byte_size, headers=None,
+        query_params=None,
+    ):
+        await self._post_json(
+            "v2/xlasharedmemory/region/{}/register".format(quote(name)),
+            {
+                "raw_handle": {
+                    "b64": raw_handle.decode("utf-8")
+                    if isinstance(raw_handle, bytes)
+                    else raw_handle
+                },
+                "device_ordinal": device_ordinal,
+                "byte_size": byte_size,
+            },
+            headers, query_params,
+        )
+
+    async def unregister_xla_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/xlasharedmemory"
+        if name:
+            uri += "/region/{}".format(quote(name))
+        await self._post_json(uri + "/unregister", {}, headers, query_params)
+
+    # -- inference ---------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ):
+        """Asynchronous inference; awaitable, returns InferResult."""
+        body, json_size = _get_inference_request(
+            inputs=inputs,
+            request_id=request_id,
+            outputs=outputs,
+            sequence_id=sequence_id,
+            sequence_start=sequence_start,
+            sequence_end=sequence_end,
+            priority=priority,
+            timeout=timeout,
+            custom_parameters=parameters,
+        )
+        headers = dict(headers or {})
+        if json_size is not None:
+            headers["Inference-Header-Content-Length"] = str(json_size)
+        if request_compression_algorithm == "gzip":
+            headers["Content-Encoding"] = "gzip"
+            body = gzip.compress(body)
+        elif request_compression_algorithm == "deflate":
+            headers["Content-Encoding"] = "deflate"
+            body = zlib.compress(body)
+        if response_compression_algorithm:
+            headers["Accept-Encoding"] = response_compression_algorithm
+
+        if model_version:
+            uri = "v2/models/{}/versions/{}/infer".format(
+                quote(model_name), model_version
+            )
+        else:
+            uri = "v2/models/{}/infer".format(quote(model_name))
+        resp, rbody = await self._post(uri, body, headers, query_params)
+        self._raise_if_error(resp, rbody)
+        header_length = resp.headers.get("Inference-Header-Content-Length")
+        # aiohttp decompresses Content-Encoding transparently
+        return InferResult.from_response_body(
+            rbody,
+            self._verbose,
+            int(header_length) if header_length is not None else None,
+        )

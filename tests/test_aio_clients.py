@@ -1,0 +1,207 @@
+"""End-to-end tests for the asyncio clients (tritonclient.http.aio and
+tritonclient.grpc.aio) against the in-process frontends.
+
+No pytest-asyncio in the image, so each test drives its own event loop via
+asyncio.run."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tritonclient.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def grpc_server(server_core):
+    from tpuserver.grpc_frontend import GrpcFrontend
+
+    frontend = GrpcFrontend(server_core, port=0).start()
+    yield frontend
+    frontend.stop()
+
+
+def _simple_inputs(mod):
+    in0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    in1 = np.ones((1, 16), dtype=np.int32)
+    inputs = [
+        mod.InferInput("INPUT0", [1, 16], "INT32"),
+        mod.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(in0)
+    inputs[1].set_data_from_numpy(in1)
+    return in0, in1, inputs
+
+
+# -- http.aio ---------------------------------------------------------------
+
+
+def test_http_aio_health_and_metadata(http_url):
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(http_url) as c:
+            assert await c.is_server_live()
+            assert await c.is_server_ready()
+            assert await c.is_model_ready("simple")
+            meta = await c.get_server_metadata()
+            assert meta["name"] == "tpu-triton-server"
+            model_meta = await c.get_model_metadata("simple")
+            assert model_meta["name"] == "simple"
+            cfg = await c.get_model_config("simple")
+            assert cfg["max_batch_size"] == 8
+            index = await c.get_model_repository_index()
+            assert any(m["name"] == "simple" for m in index)
+            stats = await c.get_inference_statistics("simple")
+            assert stats["model_stats"][0]["name"] == "simple"
+
+    asyncio.run(run())
+
+
+def test_http_aio_infer(http_url):
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(http_url) as c:
+            in0, in1, inputs = _simple_inputs(aioclient)
+            outputs = [
+                aioclient.InferRequestedOutput("OUTPUT0"),
+                aioclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            result = await c.infer("simple", inputs, outputs=outputs)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), in0 + in1
+            )
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT1"), in0 - in1
+            )
+
+    asyncio.run(run())
+
+
+def test_http_aio_infer_concurrent(http_url):
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(http_url) as c:
+            in0, in1, inputs = _simple_inputs(aioclient)
+            results = await asyncio.gather(
+                *[c.infer("simple", inputs) for _ in range(8)]
+            )
+            for result in results:
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), in0 + in1
+                )
+
+    asyncio.run(run())
+
+
+def test_http_aio_error(http_url):
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(http_url) as c:
+            in0, in1, inputs = _simple_inputs(aioclient)
+            with pytest.raises(InferenceServerException, match="unknown"):
+                await c.infer("not_a_model", inputs)
+
+    asyncio.run(run())
+
+
+# -- grpc.aio ---------------------------------------------------------------
+
+
+def test_grpc_aio_health_and_metadata(grpc_server):
+    import tritonclient.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as c:
+            assert await c.is_server_live()
+            assert await c.is_server_ready()
+            assert await c.is_model_ready("simple")
+            meta = await c.get_server_metadata()
+            assert meta.name == "tpu-triton-server"
+            ts = await c.get_trace_settings()
+            assert "trace_level" in ts.settings
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_infer(grpc_server):
+    import tritonclient.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as c:
+            in0, in1, inputs = _simple_inputs(aioclient)
+            result = await c.infer("simple", inputs, request_id="7")
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), in0 + in1
+            )
+            assert result.get_response().id == "7"
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_stream_infer_decoupled(grpc_server):
+    import tritonclient.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as c:
+            values = np.array([1, 2, 3], dtype=np.int32)
+
+            async def requests():
+                inputs = [
+                    aioclient.InferInput("IN", [3], "INT32"),
+                    aioclient.InferInput("DELAY", [3], "UINT32"),
+                    aioclient.InferInput("WAIT", [1], "UINT32"),
+                ]
+                inputs[0].set_data_from_numpy(values)
+                inputs[1].set_data_from_numpy(np.zeros(3, dtype=np.uint32))
+                inputs[2].set_data_from_numpy(
+                    np.array([0], dtype=np.uint32)
+                )
+                yield {
+                    "model_name": "repeat_int32",
+                    "inputs": inputs,
+                    "enable_empty_final_response": True,
+                }
+
+            got = []
+            saw_final = False
+            async for result, error in c.stream_infer(requests()):
+                assert error is None
+                resp = result.get_response()
+                if (
+                    "triton_final_response" in resp.parameters
+                    and resp.parameters["triton_final_response"].bool_param
+                ):
+                    saw_final = True
+                    break
+                got.append(int(result.as_numpy("OUT")[0]))
+            assert got == [1, 2, 3]
+            assert saw_final
+
+    asyncio.run(run())
+
+
+def test_grpc_aio_stream_infer_error_in_band(grpc_server):
+    import tritonclient.grpc.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(grpc_server.url) as c:
+
+            async def requests():
+                inputs = [
+                    aioclient.InferInput("INPUT0", [1, 16], "INT32"),
+                ]
+                inputs[0].set_data_from_numpy(
+                    np.zeros((1, 16), dtype=np.int32)
+                )
+                yield {"model_name": "not_a_model", "inputs": inputs}
+
+            async for result, error in c.stream_infer(requests()):
+                assert result is None
+                assert isinstance(error, InferenceServerException)
+                break
+
+    asyncio.run(run())
