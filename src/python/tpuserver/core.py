@@ -424,6 +424,9 @@ class InferenceServer:
             self._models[model.name] = model
             self._ready[model.name] = ready
             self._stats.setdefault(model.name, _ModelStats())
+        attach = getattr(model, "attach_server", None)
+        if attach is not None:
+            attach(self)
 
     def _get_model(self, name, version=""):
         model = self._models.get(name)
@@ -709,13 +712,23 @@ class InferenceServer:
         inputs = dict(request.inputs)
         t1 = time.monotonic_ns()
         count = 0
-        for out in model.execute_stream(inputs, request):
-            count += 1
-            resp = self._make_response(model, request, out,
-                                       mark_final=False)
-            if want_final:
-                resp.parameters["triton_final_response"] = False
-            yield resp
+        try:
+            for out in model.execute_stream(inputs, request):
+                count += 1
+                resp = self._make_response(model, request, out,
+                                           mark_final=False)
+                if want_final:
+                    resp.parameters["triton_final_response"] = False
+                yield resp
+        except ServerError:
+            self._stats[model.name].record(0, 0, 0, 0, 0, ok=False)
+            raise
+        except Exception as e:
+            self._stats[model.name].record(0, 0, 0, 0, 0, ok=False)
+            raise ServerError(
+                "inference failed for model '{}': {}".format(model.name, e),
+                code=500,
+            )
         t2 = time.monotonic_ns()
         self._stats[model.name].record(
             self._batch_of(model, inputs), 0, t1 - t0, t2 - t1, 0

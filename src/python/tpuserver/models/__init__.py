@@ -28,3 +28,29 @@ def default_models():
         SequenceAccumulateModel(),
         RepeatModel(),
     ]
+
+
+def serving_models(include_vision=True, include_bert=True,
+                   include_llama=True, llama_cfg=None):
+    """The heavyweight serving zoo for the BASELINE configs (#2-#5):
+    ResNet-50 / DenseNet-121, the BERT ensemble, and decoupled llama
+    generation.  Separate from ``default_models`` so unit tests stay fast."""
+    models = []
+    if include_vision:
+        from tpuserver.models.vision import DenseNet121Model, ResNet50Model
+
+        models += [ResNet50Model(), DenseNet121Model()]
+    if include_bert:
+        from tpuserver.models.bert import (
+            BertEncoderModel,
+            BertEnsembleModel,
+            BertTokenizerModel,
+        )
+
+        models += [BertTokenizerModel(), BertEncoderModel(),
+                   BertEnsembleModel()]
+    if include_llama:
+        from tpuserver.models.llama_serving import LlamaGenerateModel
+
+        models.append(LlamaGenerateModel(cfg=llama_cfg))
+    return models

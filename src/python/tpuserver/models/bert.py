@@ -1,0 +1,218 @@
+"""BERT-base ensemble family (BASELINE config #4: tokenizer -> encoder).
+
+Mirrors the reference's ensemble pattern (model_config ensemble_scheduling,
+reference model_parser.h ENSEMBLE scheduler type): a host-side tokenizer
+model (BYTES -> INT32 ids, KIND_CPU) feeding a TPU encoder (bidirectional
+transformer, bf16, learned positions, GELU MLP) that emits a pooled
+embedding.  The tokenizer is a hash-based wordpiece-lite — the bench
+exercises protocol + ensemble scheduling + device round trip, not MLM
+accuracy.
+"""
+
+import threading
+
+import numpy as np
+
+from tpuserver.core import JaxModel, Model, TensorSpec
+
+SEQ_LEN = 128
+VOCAB = 30522  # bert-base vocab size
+D_MODEL = 768
+N_LAYERS = 12
+N_HEADS = 12
+D_FF = 3072
+
+
+class BertTokenizerModel(Model):
+    """TEXT (BYTES [1]) -> INPUT_IDS/ATTENTION_MASK (INT32 [SEQ_LEN]).
+
+    Whitespace split + stable hash into the vocab (ids 1000+ so specials
+    stay clear); [CLS]=101 / [SEP]=102 framing like wordpiece."""
+
+    name = "bert_tokenizer"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 8
+    inputs = (TensorSpec("TEXT", "BYTES", [1]),)
+    outputs = (
+        TensorSpec("INPUT_IDS", "INT32", [SEQ_LEN]),
+        TensorSpec("ATTENTION_MASK", "INT32", [SEQ_LEN]),
+    )
+
+    def execute(self, inputs, request):
+        import zlib
+
+        texts = np.asarray(inputs["TEXT"]).reshape(-1)
+        ids = np.zeros((len(texts), SEQ_LEN), dtype=np.int32)
+        mask = np.zeros((len(texts), SEQ_LEN), dtype=np.int32)
+        for row, raw in enumerate(texts):
+            text = raw.decode("utf-8") if isinstance(raw, bytes) else str(raw)
+            tokens = [101]  # [CLS]
+            for word in text.lower().split():
+                tokens.append(
+                    1000 + (zlib.crc32(word.encode("utf-8")) % (VOCAB - 1100))
+                )
+                if len(tokens) >= SEQ_LEN - 1:
+                    break
+            tokens.append(102)  # [SEP]
+            ids[row, : len(tokens)] = tokens
+            mask[row, : len(tokens)] = 1
+        batched = np.asarray(inputs["TEXT"]).ndim > 1
+        if not batched:
+            return {"INPUT_IDS": ids[0], "ATTENTION_MASK": mask[0]}
+        return {"INPUT_IDS": ids, "ATTENTION_MASK": mask}
+
+
+class BertEncoderModel(JaxModel):
+    """INPUT_IDS/ATTENTION_MASK -> POOLED [D_MODEL] (CLS-token tanh head),
+    bf16 on TPU."""
+
+    name = "bert_encoder"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 8
+    inputs = (
+        TensorSpec("INPUT_IDS", "INT32", [SEQ_LEN]),
+        TensorSpec("ATTENTION_MASK", "INT32", [SEQ_LEN]),
+    )
+    outputs = (TensorSpec("POOLED", "FP32", [D_MODEL]),)
+
+    def __init__(self, seed=0):
+        super().__init__()
+        self._params = None
+        self._seed = seed
+        self._params_lock = threading.Lock()
+
+    def _get_params(self):
+        if self._params is not None:
+            return self._params
+        with self._params_lock:
+            if self._params is None:
+                import jax
+                import jax.numpy as jnp
+
+                key = jax.random.PRNGKey(self._seed)
+
+                def dense(key, shape, fan_in):
+                    return (
+                        jax.random.normal(key, shape, jnp.float32)
+                        / np.sqrt(fan_in)
+                    ).astype(jnp.bfloat16)
+
+                keys = iter(jax.random.split(key, 16 + 8 * N_LAYERS))
+                layers = []
+                for _ in range(N_LAYERS):
+                    layers.append(
+                        {
+                            "wq": dense(next(keys), (D_MODEL, D_MODEL),
+                                        D_MODEL),
+                            "wk": dense(next(keys), (D_MODEL, D_MODEL),
+                                        D_MODEL),
+                            "wv": dense(next(keys), (D_MODEL, D_MODEL),
+                                        D_MODEL),
+                            "wo": dense(next(keys), (D_MODEL, D_MODEL),
+                                        D_MODEL),
+                            "ln1": jnp.ones((D_MODEL,), jnp.bfloat16),
+                            "w_in": dense(next(keys), (D_MODEL, D_FF),
+                                          D_MODEL),
+                            "w_out": dense(next(keys), (D_FF, D_MODEL),
+                                           D_FF),
+                            "ln2": jnp.ones((D_MODEL,), jnp.bfloat16),
+                        }
+                    )
+                self._params = {
+                    "tok_embed": dense(next(keys), (VOCAB, D_MODEL),
+                                       D_MODEL),
+                    "pos_embed": dense(next(keys), (SEQ_LEN, D_MODEL),
+                                       D_MODEL),
+                    "layers": layers,
+                    "pool_w": dense(next(keys), (D_MODEL, D_MODEL), D_MODEL),
+                }
+        return self._params
+
+    def jax_fn(self, INPUT_IDS, ATTENTION_MASK):
+        import jax
+        import jax.numpy as jnp
+
+        params = self._get_params()
+        ids = INPUT_IDS
+        mask = ATTENTION_MASK
+        squeeze = ids.ndim == 1
+        if squeeze:
+            ids = ids[None, :]
+            mask = mask[None, :]
+        B, T = ids.shape
+        hd = D_MODEL // N_HEADS
+        x = params["tok_embed"][ids] + params["pos_embed"][None, :T]
+        bias = jnp.where(
+            mask[:, None, None, :] > 0, 0.0, -1e9
+        ).astype(jnp.float32)
+
+        def ln(x, g):
+            xf = x.astype(jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+            return ((xf - mu) * jax.lax.rsqrt(var + 1e-12)).astype(
+                x.dtype
+            ) * g
+
+        for layer in params["layers"]:
+            h = ln(x, layer["ln1"])
+            q = (h @ layer["wq"]).reshape(B, T, N_HEADS, hd)
+            k = (h @ layer["wk"]).reshape(B, T, N_HEADS, hd)
+            v = (h @ layer["wv"]).reshape(B, T, N_HEADS, hd)
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k,
+                preferred_element_type=jnp.float32,
+            ) / np.sqrt(hd)
+            p = jax.nn.softmax(s + bias, axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd", p.astype(x.dtype), v
+            ).reshape(B, T, D_MODEL)
+            x = x + attn @ layer["wo"]
+            h = ln(x, layer["ln2"])
+            x = x + jax.nn.gelu(h @ layer["w_in"]) @ layer["w_out"]
+        pooled = jnp.tanh(
+            (x[:, 0, :] @ params["pool_w"]).astype(jnp.float32)
+        )
+        if squeeze:
+            pooled = pooled[0]
+        return {"POOLED": pooled}
+
+    def warmup(self):
+        self.execute(
+            {
+                "INPUT_IDS": np.zeros((1, SEQ_LEN), np.int32),
+                "ATTENTION_MASK": np.ones((1, SEQ_LEN), np.int32),
+            },
+            None,
+        )
+
+
+class BertEnsembleModel(Model):
+    """TEXT -> POOLED via tokenizer + encoder (ensemble_scheduling steps,
+    the shape the reference's perf_analyzer calls ENSEMBLE)."""
+
+    name = "bert_ensemble"
+    platform = "ensemble"
+    backend = ""
+    max_batch_size = 8
+    inputs = (TensorSpec("TEXT", "BYTES", [1]),)
+    outputs = (TensorSpec("POOLED", "FP32", [D_MODEL]),)
+    ensemble_steps = [
+        {
+            "model_name": "bert_tokenizer",
+            "model_version": -1,
+            "input_map": {"TEXT": "TEXT"},
+            "output_map": {
+                "INPUT_IDS": "ids",
+                "ATTENTION_MASK": "mask",
+            },
+        },
+        {
+            "model_name": "bert_encoder",
+            "model_version": -1,
+            "input_map": {"INPUT_IDS": "ids", "ATTENTION_MASK": "mask"},
+            "output_map": {"POOLED": "POOLED"},
+        },
+    ]

@@ -1,0 +1,163 @@
+"""Decoupled llama generation serving model (BASELINE config #5: token-by-
+token generate streaming with TPU-shm KV handles).
+
+One request carries the prompt ids; the model prefillls the KV cache in one
+batched pass, then streams one sampled token per response over the
+decoupled channel (ModelStreamInfer).  Generation runs as a jitted
+decode_step per token — static shapes, cache donated, so steady-state cost
+is one device dispatch per token.
+
+KV-cache persistence: a request parameter ``kv_cache_region`` naming a
+registered XLA shared-memory region makes the model park the finished KV
+cache (a device-resident ``jax.Array``) in that region and, on a follow-up
+request with the same parameter and ``kv_cache_resume=True``, continue
+generation from it without re-prefilling — the TPU-shm analogue of the
+reference's CUDA-shm tensor passing, applied to generation state.
+"""
+
+import threading
+
+import numpy as np
+
+from tpuserver.core import Model, TensorSpec
+from tpuserver.models import llama
+
+
+class LlamaGenerateModel(Model):
+    """PROMPT_IDS int32[-1], MAX_TOKENS int32[1] -> stream of
+    (TOKEN int32[1], LOGPROB fp32[1]) responses."""
+
+    name = "llama_generate"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 0
+    decoupled = True
+    inputs = (
+        TensorSpec("PROMPT_IDS", "INT32", [-1]),
+        TensorSpec("MAX_TOKENS", "INT32", [1]),
+    )
+    outputs = (
+        TensorSpec("TOKEN", "INT32", [1]),
+        TensorSpec("LOGPROB", "FP32", [1]),
+    )
+
+    def __init__(self, cfg=None, max_seq=512, server=None):
+        self._cfg = cfg or llama.tiny(vocab=2048)
+        self._max_seq = max_seq
+        self._server = server  # for kv_cache_region xla-shm lookups
+        self._params = None
+        self._prefill = None
+        self._decode = None
+        self._lock = threading.Lock()
+
+    def attach_server(self, server):
+        self._server = server
+
+    def _ensure_compiled(self):
+        if self._decode is not None:
+            return
+        with self._lock:
+            if self._decode is None:
+                import functools
+
+                import jax
+
+                self._params = llama.init_params(
+                    jax.random.PRNGKey(0), self._cfg
+                )
+                self._prefill = jax.jit(
+                    functools.partial(llama.prefill, cfg=self._cfg)
+                )
+                self._decode = jax.jit(
+                    functools.partial(llama.decode_step, cfg=self._cfg),
+                    donate_argnums=(1,),
+                )
+
+    def warmup(self):
+        self._ensure_compiled()
+
+    def _kv_region(self, request):
+        from tpuserver.core import ServerError
+
+        name = request.parameters.get("kv_cache_region")
+        if not name:
+            return None
+        region = (
+            self._server._xla_shm.get(name) if self._server is not None
+            else None
+        )
+        if region is None:
+            raise ServerError(
+                "Unable to find xla shared memory region: '{}'".format(name)
+            )
+        return region
+
+    def execute_stream(self, inputs, request):
+        import jax
+        import jax.numpy as jnp
+
+        self._ensure_compiled()
+        prompt = np.asarray(inputs["PROMPT_IDS"]).reshape(-1).astype(np.int32)
+        max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).reshape(-1)[0])
+        if len(prompt) == 0:
+            raise ValueError("PROMPT_IDS must be non-empty")
+
+        region = self._kv_region(request)
+        resume = bool(request.parameters.get("kv_cache_resume")) and (
+            region is not None
+        )
+        pos = 0
+        cache = None
+        if resume:
+            parked = region.handle.get_jax_segment(0)
+            if parked is not None:
+                # decode_step donates its cache argument; copy so the parked
+                # array in the region registry stays valid even if this
+                # stream dies mid-generation.
+                cache = jnp.copy(parked)
+                pos = int(request.parameters.get("kv_cache_position", 0))
+        if cache is None:
+            cache = llama.init_kv_cache(self._cfg, 1, self._max_seq)
+            pos = 0
+        if pos + len(prompt) + max_tokens > self._max_seq:
+            raise ValueError(
+                "position ({}) + prompt ({}) + max_tokens ({}) exceeds max "
+                "sequence {}".format(
+                    pos, len(prompt), max_tokens, self._max_seq
+                )
+            )
+
+        tokens = jnp.asarray(prompt)[None, :]
+        if pos == 0:
+            logits, cache = self._prefill(self._params, cache, tokens)
+            pos = len(prompt)
+        else:
+            # resumed: feed the new prompt tokens one at a time from pos
+            for t in range(len(prompt)):
+                logits, cache = self._decode(
+                    self._params, cache, tokens[:, t], pos
+                )
+                pos += 1
+
+        for i in range(max_tokens):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            token_id = int(token[0])
+            yield {
+                "TOKEN": np.array([token_id], dtype=np.int32),
+                "LOGPROB": np.array(
+                    [float(logp[0, token_id])], dtype=np.float32
+                ),
+            }
+            # the trailing decode only matters if another token follows or
+            # the cache is being parked for resumption
+            if i + 1 < max_tokens or region is not None:
+                logits, cache = self._decode(
+                    self._params, cache, token, pos
+                )
+                pos += 1
+
+        if region is not None:
+            # park the device-resident cache in the XLA region (zero-copy
+            # in-process; host-staged cross-process)
+            region.put_device_array(0, cache)

@@ -177,6 +177,12 @@ class XlaShmHandle:
         host_arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
         return jax.device_put(host_arr, _device(root.device_ordinal))
 
+    def get_jax_segment(self, offset):
+        """Public accessor: the device-resident ``jax.Array`` parked at
+        ``offset``, or None when the slot holds no live segment."""
+        seg = self._root()._segments.get(offset)
+        return seg[0] if seg is not None else None
+
     def put_jax(self, offset, array):
         """Store a device array at ``offset``.  Returns True if it could stay
         on device (in-process), False if the caller must write bytes."""

@@ -1,0 +1,170 @@
+"""Tests for the BASELINE-config serving zoo: vision (ResNet-50 /
+DenseNet-121), the BERT ensemble, and decoupled llama generation with
+KV-cache parking in XLA shm."""
+
+import numpy as np
+import pytest
+
+from tpuserver.core import InferenceServer, InferRequest, RequestedOutput
+
+
+@pytest.fixture(scope="module")
+def zoo_core():
+    from tpuserver.models import default_models, serving_models
+    from tpuserver.models import llama
+
+    models = default_models() + serving_models(
+        llama_cfg=llama.tiny(vocab=512)
+    )
+    return InferenceServer(models)
+
+
+def _infer(core, model, inputs, requested=None):
+    return core.infer(
+        InferRequest(model, inputs=inputs, requested_outputs=requested)
+    )
+
+
+def _out(resp, name):
+    for spec, array, delivery in resp.outputs:
+        if spec["name"] == name:
+            return spec, array
+    return None, None
+
+
+def test_resnet50_forward(zoo_core):
+    img = np.random.RandomState(0).rand(1, 224, 224, 3).astype(np.float32)
+    resp = _infer(zoo_core, "resnet50", {"INPUT": img})
+    spec, probs = _out(resp, "OUTPUT")
+    assert spec["shape"] == [1, 1000]
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-3)
+
+
+def test_resnet50_classification_output(zoo_core):
+    img = np.random.RandomState(1).rand(1, 224, 224, 3).astype(np.float32)
+    resp = _infer(
+        zoo_core, "resnet50", {"INPUT": img},
+        [RequestedOutput("OUTPUT", class_count=3)],
+    )
+    spec, classes = _out(resp, "OUTPUT")
+    assert spec["datatype"] == "BYTES"
+    assert classes.shape == (1, 3)
+    # "value:index:label" formatting with our class_<i> labels
+    first = classes[0, 0].decode("utf-8")
+    parts = first.split(":")
+    assert len(parts) == 3 and parts[2].startswith("class_")
+
+
+def test_densenet121_forward(zoo_core):
+    img = np.random.RandomState(2).rand(1, 224, 224, 3).astype(np.float32)
+    resp = _infer(zoo_core, "densenet121", {"INPUT": img})
+    spec, probs = _out(resp, "OUTPUT")
+    assert spec["shape"] == [1, 1000]
+    np.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-3)
+
+
+def test_bert_ensemble(zoo_core):
+    text = np.array([b"hello tpu world"], dtype=np.object_)
+    resp = _infer(zoo_core, "bert_ensemble", {"TEXT": text})
+    spec, pooled = _out(resp, "POOLED")
+    assert pooled.shape == (768,)
+    assert np.isfinite(pooled).all()
+    # deterministic per text, sensitive to text
+    resp2 = _infer(zoo_core, "bert_ensemble", {"TEXT": text})
+    np.testing.assert_array_equal(_out(resp2, "POOLED")[1], pooled)
+    other = np.array([b"a different sentence"], dtype=np.object_)
+    resp3 = _infer(zoo_core, "bert_ensemble", {"TEXT": other})
+    assert not np.array_equal(_out(resp3, "POOLED")[1], pooled)
+
+
+def test_bert_tokenizer_shapes(zoo_core):
+    text = np.array([b"one two three"], dtype=np.object_)
+    resp = _infer(zoo_core, "bert_tokenizer", {"TEXT": text})
+    _, ids = _out(resp, "INPUT_IDS")
+    _, mask = _out(resp, "ATTENTION_MASK")
+    assert ids.shape == (128,)
+    assert ids[0] == 101  # [CLS]
+    assert mask.sum() == 5  # CLS + 3 words + SEP
+
+
+def test_llama_generate_stream(zoo_core):
+    prompt = np.array([1, 2, 3, 4], dtype=np.int32)
+    req = InferRequest(
+        "llama_generate",
+        inputs={
+            "PROMPT_IDS": prompt,
+            "MAX_TOKENS": np.array([5], dtype=np.int32),
+        },
+    )
+    tokens = []
+    for resp in zoo_core.infer_stream(req):
+        _, tok = _out(resp, "TOKEN")
+        _, logp = _out(resp, "LOGPROB")
+        tokens.append(int(tok[0]))
+        assert logp[0] <= 0.0
+    assert len(tokens) == 5
+    # greedy decode is deterministic
+    tokens2 = [
+        int(_out(r, "TOKEN")[1][0]) for r in zoo_core.infer_stream(req)
+    ]
+    assert tokens2 == tokens
+
+
+def test_llama_generate_kv_cache_region(zoo_core):
+    """Park the KV cache in an XLA shm region, resume without re-prefill."""
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    cache_handle = xshm.create_shared_memory_region("kv_park", 1 << 20)
+    try:
+        raw = xshm.get_raw_handle(cache_handle)
+        zoo_core.register_xla_shm("kv_park", raw, 0, 1 << 20)
+        prompt = np.array([5, 6, 7], dtype=np.int32)
+        req = InferRequest(
+            "llama_generate",
+            inputs={
+                "PROMPT_IDS": prompt,
+                "MAX_TOKENS": np.array([4], dtype=np.int32),
+            },
+            parameters={"kv_cache_region": "kv_park"},
+        )
+        first = [
+            int(_out(r, "TOKEN")[1][0]) for r in zoo_core.infer_stream(req)
+        ]
+        assert len(first) == 4
+        # the region now holds a device-resident cache segment
+        assert cache_handle.get_jax_segment(0) is not None
+
+        # continue from the parked cache: feed the generated tokens back
+        req2 = InferRequest(
+            "llama_generate",
+            inputs={
+                "PROMPT_IDS": np.array(first[-1:], dtype=np.int32),
+                "MAX_TOKENS": np.array([3], dtype=np.int32),
+            },
+            parameters={
+                "kv_cache_region": "kv_park",
+                "kv_cache_resume": True,
+                "kv_cache_position": 3 + 4,
+            },
+        )
+        second = [
+            int(_out(r, "TOKEN")[1][0]) for r in zoo_core.infer_stream(req2)
+        ]
+        assert len(second) == 3
+    finally:
+        zoo_core.unregister_xla_shm("kv_park")
+        xshm.destroy_shared_memory_region(cache_handle)
+
+
+def test_llama_generate_rejects_overflow(zoo_core):
+    from tpuserver.core import ServerError
+
+    req = InferRequest(
+        "llama_generate",
+        inputs={
+            "PROMPT_IDS": np.arange(500, dtype=np.int32),
+            "MAX_TOKENS": np.array([100], dtype=np.int32),
+        },
+    )
+    with pytest.raises(ServerError, match="exceeds"):
+        list(zoo_core.infer_stream(req))
