@@ -1,0 +1,160 @@
+// System shared-memory infer on the `simple` model over HTTP (role of
+// reference src/c++/examples/simple_http_shm_client.cc): inputs written
+// directly into a POSIX shm region, outputs delivered into another, no
+// tensor bytes on the wire.
+
+#include <unistd.h>
+
+#include <cstring>
+#include <iostream>
+
+#include "http_client.h"
+#include "shm_utils.h"
+
+namespace tc = tc;
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8000");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose),
+      "unable to create http client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  // input region holds INPUT0 then INPUT1; output region OUTPUT0, OUTPUT1
+  const char* kInputKey = "/simple_http_shm_input";
+  const char* kOutputKey = "/simple_http_shm_output";
+  client->UnregisterSystemSharedMemory("simple_input");
+  client->UnregisterSystemSharedMemory("simple_output");
+
+  int input_fd, output_fd;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(kInputKey, 2 * kTensorBytes, &input_fd),
+      "creating input region");
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(
+          kOutputKey, 2 * kTensorBytes, &output_fd),
+      "creating output region");
+  void* input_base;
+  void* output_base;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(input_fd, 0, 2 * kTensorBytes, &input_base),
+      "mapping input region");
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(output_fd, 0, 2 * kTensorBytes, &output_base),
+      "mapping output region");
+
+  int32_t* input0_data = reinterpret_cast<int32_t*>(input_base);
+  int32_t* input1_data = input0_data + 16;
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+    input1_data[i] = 1;
+  }
+
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "simple_input", kInputKey, 2 * kTensorBytes),
+      "registering input region");
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "simple_output", kOutputKey, 2 * kTensorBytes),
+      "registering output region");
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  std::vector<int64_t> shape{1, 16};
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", shape, "INT32"),
+      "creating INPUT0");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0);
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", shape, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input1_ptr(input1);
+  FAIL_IF_ERR(
+      input0_ptr->SetSharedMemory("simple_input", kTensorBytes, 0),
+      "INPUT0 shm");
+  FAIL_IF_ERR(
+      input1_ptr->SetSharedMemory(
+          "simple_input", kTensorBytes, kTensorBytes),
+      "INPUT1 shm");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"), "OUTPUT0");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0);
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1, "OUTPUT1"), "OUTPUT1");
+  std::shared_ptr<tc::InferRequestedOutput> output1_ptr(output1);
+  FAIL_IF_ERR(
+      output0_ptr->SetSharedMemory("simple_output", kTensorBytes, 0),
+      "OUTPUT0 shm");
+  FAIL_IF_ERR(
+      output1_ptr->SetSharedMemory(
+          "simple_output", kTensorBytes, kTensorBytes),
+      "OUTPUT1 shm");
+
+  tc::InferOptions options("simple");
+  std::vector<tc::InferInput*> inputs = {input0_ptr.get(),
+                                         input1_ptr.get()};
+  std::vector<const tc::InferRequestedOutput*> outputs = {
+      output0_ptr.get(), output1_ptr.get()};
+
+  tc::InferResult* result;
+  FAIL_IF_ERR(client->Infer(&result, options, inputs, outputs), "infer");
+  FAIL_IF_ERR(result->RequestStatus(), "inference failed");
+  delete result;
+
+  int32_t* sum = reinterpret_cast<int32_t*>(output_base);
+  int32_t* diff = sum + 16;
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != input0_data[i] + input1_data[i] ||
+        diff[i] != input0_data[i] - input1_data[i]) {
+      std::cerr << "error: incorrect shm result at " << i << std::endl;
+      exit(1);
+    }
+  }
+  std::cout << "shm infer OK" << std::endl;
+
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("simple_input"),
+      "unregister input");
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("simple_output"),
+      "unregister output");
+  tc::UnmapSharedMemory(input_base, 2 * kTensorBytes);
+  tc::UnmapSharedMemory(output_base, 2 * kTensorBytes);
+  tc::CloseSharedMemory(input_fd);
+  tc::CloseSharedMemory(output_fd);
+  tc::UnlinkSharedMemoryRegion(kInputKey);
+  tc::UnlinkSharedMemoryRegion(kOutputKey);
+  return 0;
+}

@@ -1,0 +1,174 @@
+#include "common.h"
+
+#include <ostream>
+
+namespace tc {
+
+const Error Error::Success = Error();
+
+std::ostream&
+operator<<(std::ostream& out, const Error& err)
+{
+  if (err.IsOk()) {
+    out << "OK";
+  } else {
+    out << err.Message();
+  }
+  return out;
+}
+
+//==============================================================================
+
+Error
+InferInput::Create(
+    InferInput** infer_input, const std::string& name,
+    const std::vector<int64_t>& dims, const std::string& datatype)
+{
+  *infer_input = new InferInput(name, dims, datatype);
+  return Error::Success;
+}
+
+InferInput::InferInput(
+    const std::string& name, const std::vector<int64_t>& dims,
+    const std::string& datatype)
+    : name_(name), shape_(dims), datatype_(datatype)
+{
+}
+
+Error
+InferInput::Reset()
+{
+  bufs_.clear();
+  str_bufs_.clear();
+  total_byte_size_ = 0;
+  cursor_ = 0;
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+Error
+InferInput::AppendRaw(const uint8_t* input, size_t input_byte_size)
+{
+  if (!shm_name_.empty()) {
+    return Error(
+        "The input '" + name_ +
+        "' is referencing shared memory; can not append raw data");
+  }
+  bufs_.emplace_back(input, input_byte_size);
+  total_byte_size_ += input_byte_size;
+  return Error::Success;
+}
+
+Error
+InferInput::AppendRaw(const std::vector<uint8_t>& input)
+{
+  return AppendRaw(input.data(), input.size());
+}
+
+Error
+InferInput::AppendFromString(const std::vector<std::string>& input)
+{
+  // serialize as 4-byte little-endian length + bytes, owned by this object
+  str_bufs_.emplace_back();
+  std::string& serialized = str_bufs_.back();
+  for (const auto& s : input) {
+    uint32_t len = (uint32_t)s.size();
+    serialized.append(reinterpret_cast<const char*>(&len), 4);
+    serialized.append(s);
+  }
+  return AppendRaw(
+      reinterpret_cast<const uint8_t*>(serialized.data()),
+      serialized.size());
+}
+
+Error
+InferInput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset)
+{
+  if (!bufs_.empty()) {
+    return Error(
+        "The input '" + name_ +
+        "' already has raw data; can not reference shared memory");
+  }
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error
+InferInput::PrepareForRequest()
+{
+  cursor_ = 0;
+  return Error::Success;
+}
+
+Error
+InferInput::GetNext(
+    const uint8_t** buf, size_t* input_bytes, bool* end_of_input)
+{
+  if (cursor_ < bufs_.size()) {
+    *buf = bufs_[cursor_].first;
+    *input_bytes = bufs_[cursor_].second;
+    ++cursor_;
+  } else {
+    *buf = nullptr;
+    *input_bytes = 0;
+  }
+  *end_of_input = (cursor_ >= bufs_.size());
+  return Error::Success;
+}
+
+//==============================================================================
+
+Error
+InferRequestedOutput::Create(
+    InferRequestedOutput** infer_output, const std::string& name,
+    const size_t class_count)
+{
+  *infer_output = new InferRequestedOutput(name, class_count);
+  return Error::Success;
+}
+
+InferRequestedOutput::InferRequestedOutput(
+    const std::string& name, const size_t class_count)
+    : name_(name), class_count_(class_count)
+{
+}
+
+Error
+InferRequestedOutput::SetSharedMemory(
+    const std::string& region_name, size_t byte_size, size_t offset)
+{
+  shm_name_ = region_name;
+  shm_byte_size_ = byte_size;
+  shm_offset_ = offset;
+  return Error::Success;
+}
+
+Error
+InferRequestedOutput::UnsetSharedMemory()
+{
+  shm_name_.clear();
+  shm_byte_size_ = 0;
+  shm_offset_ = 0;
+  return Error::Success;
+}
+
+//==============================================================================
+
+void
+InferenceServerClient::UpdateInferStat(const RequestTimers& timer)
+{
+  infer_stat_.completed_request_count++;
+  infer_stat_.cumulative_total_request_time_ns += timer.Duration(
+      RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+  infer_stat_.cumulative_send_time_ns += timer.Duration(
+      RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+  infer_stat_.cumulative_receive_time_ns += timer.Duration(
+      RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+}
+
+}  // namespace tc

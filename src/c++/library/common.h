@@ -1,0 +1,270 @@
+// Common client types: Error, request options, tensor descriptors, result
+// interface, timing.  Re-design of the reference C++ client core
+// (reference src/c++/library/common.h:62-628) for the TPU-native stack —
+// same public surface, fresh implementation, no CUDA anywhere: the
+// device-memory plane is XLA shared memory (region names + serialized
+// handles), never raw device pointers.
+
+#pragma once
+
+#include <chrono>
+#include <deque>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace tc {
+
+//==============================================================================
+// Error status returned by all client calls (reference common.h:62-84).
+//
+class Error {
+ public:
+  Error() : ok_(true) {}
+  explicit Error(const std::string& msg) : ok_(false), msg_(msg) {}
+
+  static const Error Success;
+
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+
+ private:
+  bool ok_;
+  std::string msg_;
+};
+
+std::ostream& operator<<(std::ostream&, const Error&);
+
+//==============================================================================
+// Per-request options (reference common.h:159-222).
+//
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name)
+      : model_name_(model_name), model_version_(""), request_id_(""),
+        sequence_id_(0), sequence_start_(false), sequence_end_(false),
+        priority_(0), server_timeout_us_(0), client_timeout_us_(0)
+  {
+  }
+
+  std::string model_name_;
+  std::string model_version_;
+  std::string request_id_;
+  uint64_t sequence_id_;
+  bool sequence_start_;
+  bool sequence_end_;
+  uint64_t priority_;
+  // server-side timeout parameter; 0 = none
+  uint64_t server_timeout_us_;
+  // client-side socket deadline; 0 = none
+  uint64_t client_timeout_us_;
+};
+
+//==============================================================================
+// Client-side aggregate statistics (reference common.h:94-115).
+//
+struct InferStat {
+  size_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
+};
+
+//==============================================================================
+// Six-point per-request timer (reference common.h:523-603).
+//
+class RequestTimers {
+ public:
+  enum class Kind {
+    REQUEST_START,
+    REQUEST_END,
+    SEND_START,
+    SEND_END,
+    RECV_START,
+    RECV_END,
+    COUNT_
+  };
+
+  RequestTimers() { Reset(); }
+
+  void Reset()
+  {
+    for (auto& t : stamps_) {
+      t = 0;
+    }
+  }
+
+  void CaptureTimestamp(Kind kind)
+  {
+    stamps_[(size_t)kind] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+
+  uint64_t Timestamp(Kind kind) const { return stamps_[(size_t)kind]; }
+
+  uint64_t Duration(Kind start, Kind end) const
+  {
+    uint64_t s = stamps_[(size_t)start], e = stamps_[(size_t)end];
+    return (s == 0 || e == 0 || e < s) ? 0 : e - s;
+  }
+
+ private:
+  uint64_t stamps_[(size_t)Kind::COUNT_];
+};
+
+//==============================================================================
+// An input tensor (reference common.h:228-367).  Data is referenced, not
+// copied: AppendRaw keeps (ptr, size) pairs and the transport scatter-
+// gathers them onto the wire; SetSharedMemory references a registered
+// region instead of carrying bytes.
+//
+class InferInput {
+ public:
+  static Error Create(
+      InferInput** infer_input, const std::string& name,
+      const std::vector<int64_t>& dims, const std::string& datatype);
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  Error SetShape(const std::vector<int64_t>& dims)
+  {
+    shape_ = dims;
+    return Error::Success;
+  }
+
+  Error Reset();
+  Error AppendRaw(const uint8_t* input, size_t input_byte_size);
+  Error AppendRaw(const std::vector<uint8_t>& input);
+  // BYTES convenience: 4-byte length-prefixed serialization
+  Error AppendFromString(const std::vector<std::string>& input);
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+  size_t TotalByteSize() const { return total_byte_size_; }
+
+  // Scatter-gather iteration over the raw buffers (reference
+  // common.h:350-360): resets then returns each (buf, len) chunk.
+  Error PrepareForRequest();
+  Error GetNext(const uint8_t** buf, size_t* input_bytes, bool* end_of_input);
+
+ private:
+  InferInput(
+      const std::string& name, const std::vector<int64_t>& dims,
+      const std::string& datatype);
+
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  size_t total_byte_size_ = 0;
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  // owned storage for AppendFromString; deque: growth never moves
+  // existing elements, so (ptr,size) entries in bufs_ stay valid
+  std::deque<std::string> str_bufs_;
+  size_t cursor_ = 0;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// A requested output (reference common.h:373-445).
+//
+class InferRequestedOutput {
+ public:
+  static Error Create(
+      InferRequestedOutput** infer_output, const std::string& name,
+      const size_t class_count = 0);
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+  bool BinaryData() const { return binary_data_; }
+  Error SetBinaryData(bool binary_data)
+  {
+    binary_data_ = binary_data;
+    return Error::Success;
+  }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0);
+  Error UnsetSharedMemory();
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  InferRequestedOutput(const std::string& name, const size_t class_count);
+
+  std::string name_;
+  size_t class_count_;
+  bool binary_data_ = true;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+//==============================================================================
+// Result interface (reference common.h:451-518).
+//
+class InferResult {
+ public:
+  virtual ~InferResult() = default;
+
+  virtual Error ModelName(std::string* name) const = 0;
+  virtual Error ModelVersion(std::string* version) const = 0;
+  virtual Error Id(std::string* id) const = 0;
+  virtual Error Shape(
+      const std::string& output_name,
+      std::vector<int64_t>* shape) const = 0;
+  virtual Error Datatype(
+      const std::string& output_name, std::string* datatype) const = 0;
+  virtual Error RawData(
+      const std::string& output_name, const uint8_t** buf,
+      size_t* byte_size) const = 0;
+  // BYTES tensor deserialization (4-byte length prefix)
+  virtual Error StringData(
+      const std::string& output_name,
+      std::vector<std::string>* string_result) const = 0;
+  virtual std::string DebugString() const = 0;
+  virtual Error RequestStatus() const = 0;
+};
+
+using OnCompleteFn = std::function<void(InferResult*)>;
+
+//==============================================================================
+// Shared base: stat aggregation (reference common.h:120-154).
+//
+class InferenceServerClient {
+ public:
+  explicit InferenceServerClient(bool verbose)
+      : verbose_(verbose), exiting_(false)
+  {
+  }
+  virtual ~InferenceServerClient() = default;
+
+  Error ClientInferStat(InferStat* infer_stat) const
+  {
+    *infer_stat = infer_stat_;
+    return Error::Success;
+  }
+
+ protected:
+  void UpdateInferStat(const RequestTimers& timer);
+
+  bool verbose_;
+  bool exiting_;
+  InferStat infer_stat_;
+};
+
+}  // namespace tc

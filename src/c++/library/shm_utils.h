@@ -1,0 +1,29 @@
+// POSIX shared-memory helpers for the C++ client examples/tools
+// (reference src/c++/library/shm_utils.{h,cc}:37-105).
+
+#pragma once
+
+#include <string>
+
+#include "common.h"
+
+namespace tc {
+
+// Create a shared-memory region (shm_open + ftruncate); returns its fd.
+Error CreateSharedMemoryRegion(
+    const std::string& shm_key, size_t byte_size, int* shm_fd);
+
+// Map byte_size bytes at offset of an open region into *shm_addr.
+Error MapSharedMemory(
+    int shm_fd, size_t offset, size_t byte_size, void** shm_addr);
+
+// Close a region fd.
+Error CloseSharedMemory(int shm_fd);
+
+// Remove the named region from the system.
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+
+// Unmap a previously mapped window.
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace tc

@@ -1,0 +1,387 @@
+#include "tjson.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tc {
+namespace json {
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+  std::string* error;
+
+  void SkipWs()
+  {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool Fail(const std::string& msg)
+  {
+    if (error->empty()) {
+      *error = msg;
+    }
+    return false;
+  }
+
+  bool ParseValue(ValuePtr* out)
+  {
+    SkipWs();
+    if (p >= end) {
+      return Fail("unexpected end of input");
+    }
+    switch (*p) {
+      case '{':
+        return ParseObject(out);
+      case '[':
+        return ParseArray(out);
+      case '"': {
+        std::string s;
+        if (!ParseString(&s)) {
+          return false;
+        }
+        *out = std::make_shared<Value>(s);
+        return true;
+      }
+      case 't':
+        if (end - p >= 4 && strncmp(p, "true", 4) == 0) {
+          p += 4;
+          *out = std::make_shared<Value>(true);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'f':
+        if (end - p >= 5 && strncmp(p, "false", 5) == 0) {
+          p += 5;
+          *out = std::make_shared<Value>(false);
+          return true;
+        }
+        return Fail("invalid literal");
+      case 'n':
+        if (end - p >= 4 && strncmp(p, "null", 4) == 0) {
+          p += 4;
+          *out = std::make_shared<Value>();
+          return true;
+        }
+        return Fail("invalid literal");
+      default:
+        return ParseNumber(out);
+    }
+  }
+
+  bool ParseString(std::string* out)
+  {
+    if (*p != '"') {
+      return Fail("expected string");
+    }
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= end) {
+          return Fail("bad escape");
+        }
+        switch (*p) {
+          case '"':
+            out->push_back('"');
+            break;
+          case '\\':
+            out->push_back('\\');
+            break;
+          case '/':
+            out->push_back('/');
+            break;
+          case 'b':
+            out->push_back('\b');
+            break;
+          case 'f':
+            out->push_back('\f');
+            break;
+          case 'n':
+            out->push_back('\n');
+            break;
+          case 'r':
+            out->push_back('\r');
+            break;
+          case 't':
+            out->push_back('\t');
+            break;
+          case 'u': {
+            if (end - p < 5) {
+              return Fail("bad \\u escape");
+            }
+            unsigned code = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              code <<= 4;
+              if (c >= '0' && c <= '9')
+                code |= (unsigned)(c - '0');
+              else if (c >= 'a' && c <= 'f')
+                code |= (unsigned)(c - 'a' + 10);
+              else if (c >= 'A' && c <= 'F')
+                code |= (unsigned)(c - 'A' + 10);
+              else
+                return Fail("bad \\u escape");
+            }
+            p += 4;
+            // UTF-8 encode (BMP only; surrogate pairs unsupported — the v2
+            // protocol carries tensor data in binary sections, not JSON)
+            if (code < 0x80) {
+              out->push_back((char)code);
+            } else if (code < 0x800) {
+              out->push_back((char)(0xC0 | (code >> 6)));
+              out->push_back((char)(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back((char)(0xE0 | (code >> 12)));
+              out->push_back((char)(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back((char)(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            return Fail("bad escape");
+        }
+        ++p;
+      } else {
+        out->push_back(*p);
+        ++p;
+      }
+    }
+    if (p >= end) {
+      return Fail("unterminated string");
+    }
+    ++p;  // closing quote
+    return true;
+  }
+
+  bool ParseNumber(ValuePtr* out)
+  {
+    const char* start = p;
+    if (p < end && (*p == '-' || *p == '+')) {
+      ++p;
+    }
+    bool is_double = false;
+    while (p < end &&
+           (isdigit((unsigned char)*p) || *p == '.' || *p == 'e' ||
+            *p == 'E' || *p == '-' || *p == '+')) {
+      if (*p == '.' || *p == 'e' || *p == 'E') {
+        is_double = true;
+      }
+      ++p;
+    }
+    if (p == start) {
+      return Fail("invalid number");
+    }
+    std::string tok(start, p - start);
+    try {
+      if (is_double) {
+        *out = std::make_shared<Value>(std::stod(tok));
+      } else {
+        *out = std::make_shared<Value>((int64_t)std::stoll(tok));
+      }
+    }
+    catch (...) {
+      return Fail("invalid number '" + tok + "'");
+    }
+    return true;
+  }
+
+  bool ParseObject(ValuePtr* out)
+  {
+    ++p;  // '{'
+    auto obj = Value::MakeObject();
+    SkipWs();
+    if (p < end && *p == '}') {
+      ++p;
+      *out = obj;
+      return true;
+    }
+    while (true) {
+      SkipWs();
+      std::string key;
+      if (!ParseString(&key)) {
+        return false;
+      }
+      SkipWs();
+      if (p >= end || *p != ':') {
+        return Fail("expected ':'");
+      }
+      ++p;
+      ValuePtr v;
+      if (!ParseValue(&v)) {
+        return false;
+      }
+      obj->Set(key, v);
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == '}') {
+        ++p;
+        *out = obj;
+        return true;
+      }
+      return Fail("expected ',' or '}'");
+    }
+  }
+
+  bool ParseArray(ValuePtr* out)
+  {
+    ++p;  // '['
+    auto arr = Value::MakeArray();
+    SkipWs();
+    if (p < end && *p == ']') {
+      ++p;
+      *out = arr;
+      return true;
+    }
+    while (true) {
+      ValuePtr v;
+      if (!ParseValue(&v)) {
+        return false;
+      }
+      arr->Append(v);
+      SkipWs();
+      if (p < end && *p == ',') {
+        ++p;
+        continue;
+      }
+      if (p < end && *p == ']') {
+        ++p;
+        *out = arr;
+        return true;
+      }
+      return Fail("expected ',' or ']'");
+    }
+  }
+};
+
+void
+EscapeTo(const std::string& s, std::string* out)
+{
+  out->push_back('"');
+  for (char c : s) {
+    switch (c) {
+      case '"':
+        out->append("\\\"");
+        break;
+      case '\\':
+        out->append("\\\\");
+        break;
+      case '\n':
+        out->append("\\n");
+        break;
+      case '\r':
+        out->append("\\r");
+        break;
+      case '\t':
+        out->append("\\t");
+        break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out->append(buf);
+        } else {
+          out->push_back(c);
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void
+SerializeTo(const Value& v, std::string* out)
+{
+  switch (v.type()) {
+    case Type::Null:
+      out->append("null");
+      break;
+    case Type::Bool:
+      out->append(v.AsBool() ? "true" : "false");
+      break;
+    case Type::Int:
+      out->append(std::to_string(v.AsInt()));
+      break;
+    case Type::Double: {
+      double d = v.AsDouble();
+      if (std::isfinite(d)) {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%.17g", d);
+        out->append(buf);
+      } else {
+        out->append("null");
+      }
+      break;
+    }
+    case Type::String:
+      EscapeTo(v.AsString(), out);
+      break;
+    case Type::Array: {
+      out->push_back('[');
+      bool first = true;
+      for (const auto& e : v.Elements()) {
+        if (!first) {
+          out->push_back(',');
+        }
+        first = false;
+        SerializeTo(*e, out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Type::Object: {
+      out->push_back('{');
+      bool first = true;
+      for (const auto& kv : v.Members()) {
+        if (!first) {
+          out->push_back(',');
+        }
+        first = false;
+        EscapeTo(kv.first, out);
+        out->push_back(':');
+        SerializeTo(*kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+std::string
+Value::Serialize() const
+{
+  std::string out;
+  SerializeTo(*this, &out);
+  return out;
+}
+
+ValuePtr
+Parse(const std::string& text, std::string* error)
+{
+  std::string err;
+  Parser parser{text.data(), text.data() + text.size(), &err};
+  ValuePtr v;
+  if (!parser.ParseValue(&v)) {
+    if (error) {
+      *error = err.empty() ? "parse error" : err;
+    }
+    return nullptr;
+  }
+  if (error) {
+    error->clear();
+  }
+  return v;
+}
+
+}  // namespace json
+}  // namespace tc

@@ -1,0 +1,134 @@
+// Minimal self-contained JSON DOM: parse + serialize.
+//
+// Plays the role of the reference's TritonJson/rapidjson layer
+// (reference src/c++/library/json_utils.{h,cc}) — neither rapidjson nor
+// nlohmann ships in this environment, and the KServe-v2 JSON surface is
+// small enough that a compact DOM keeps the client dependency-free.
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace tc {
+namespace json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+class Value {
+ public:
+  Value() : type_(Type::Null) {}
+  explicit Value(bool b) : type_(Type::Bool), bool_(b) {}
+  explicit Value(int64_t i) : type_(Type::Int), int_(i) {}
+  explicit Value(uint64_t i) : type_(Type::Int), int_((int64_t)i) {}
+  explicit Value(int i) : type_(Type::Int), int_(i) {}
+  explicit Value(double d) : type_(Type::Double), double_(d) {}
+  explicit Value(const std::string& s) : type_(Type::String), str_(s) {}
+  explicit Value(const char* s) : type_(Type::String), str_(s) {}
+
+  static ValuePtr MakeObject() {
+    auto v = std::make_shared<Value>();
+    v->type_ = Type::Object;
+    return v;
+  }
+  static ValuePtr MakeArray() {
+    auto v = std::make_shared<Value>();
+    v->type_ = Type::Array;
+    return v;
+  }
+
+  Type type() const { return type_; }
+  bool IsNull() const { return type_ == Type::Null; }
+  bool IsNumber() const
+  {
+    return type_ == Type::Int || type_ == Type::Double;
+  }
+
+  bool AsBool() const { return bool_; }
+  int64_t AsInt() const
+  {
+    return type_ == Type::Double ? (int64_t)double_ : int_;
+  }
+  double AsDouble() const
+  {
+    return type_ == Type::Int ? (double)int_ : double_;
+  }
+  const std::string& AsString() const { return str_; }
+
+  // object access
+  ValuePtr Get(const std::string& key) const
+  {
+    auto it = members_.find(key);
+    return it == members_.end() ? nullptr : it->second;
+  }
+  bool Has(const std::string& key) const
+  {
+    return members_.count(key) > 0;
+  }
+  void Set(const std::string& key, ValuePtr v) { members_[key] = v; }
+  void Set(const std::string& key, const std::string& s)
+  {
+    members_[key] = std::make_shared<Value>(s);
+  }
+  void Set(const std::string& key, const char* s)
+  {
+    members_[key] = std::make_shared<Value>(s);
+  }
+  void Set(const std::string& key, int64_t i)
+  {
+    members_[key] = std::make_shared<Value>(i);
+  }
+  void Set(const std::string& key, uint64_t i)
+  {
+    members_[key] = std::make_shared<Value>(i);
+  }
+  void Set(const std::string& key, int i)
+  {
+    members_[key] = std::make_shared<Value>(i);
+  }
+  void Set(const std::string& key, double d)
+  {
+    members_[key] = std::make_shared<Value>(d);
+  }
+  void Set(const std::string& key, bool b)
+  {
+    members_[key] = std::make_shared<Value>(b);
+  }
+  const std::map<std::string, ValuePtr>& Members() const
+  {
+    return members_;
+  }
+
+  // array access
+  void Append(ValuePtr v) { elements_.push_back(v); }
+  size_t Size() const { return elements_.size(); }
+  ValuePtr At(size_t i) const
+  {
+    return i < elements_.size() ? elements_[i] : nullptr;
+  }
+  const std::vector<ValuePtr>& Elements() const { return elements_; }
+
+  std::string Serialize() const;
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double double_ = 0.0;
+  std::string str_;
+  std::vector<ValuePtr> elements_;
+  std::map<std::string, ValuePtr> members_;
+};
+
+// Parse JSON text; returns nullptr and sets *error on failure.
+ValuePtr Parse(const std::string& text, std::string* error);
+
+}  // namespace json
+}  // namespace tc

@@ -1,0 +1,214 @@
+// Unit tests for the C++ client library pieces that need no server:
+// tjson parse/serialize round trips, InferInput scatter-gather and BYTES
+// serialization, request-body generation, response-body parsing
+// (role of reference src/c++/tests + perf_analyzer doctest harness —
+// no gtest/doctest in this image, so a minimal assert harness).
+
+#include <cstdio>
+#include <cstring>
+
+#include "http_client.h"
+#include "tjson.h"
+
+static int failures = 0;
+static int checks = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    ++checks;                                                         \
+    if (!(cond)) {                                                    \
+      ++failures;                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_OK(err)                                                  \
+  do {                                                                 \
+    ++checks;                                                          \
+    tc::Error e_ = (err);                                              \
+    if (!e_.IsOk()) {                                                  \
+      ++failures;                                                      \
+      fprintf(                                                         \
+          stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,              \
+          e_.Message().c_str());                                       \
+    }                                                                  \
+  } while (0)
+
+static void
+TestJsonRoundTrip()
+{
+  std::string err;
+  auto v = tc::json::Parse(
+      "{\"a\": 1, \"b\": [true, null, 2.5, \"x\\ny\"], \"c\": {\"d\": "
+      "-7}}",
+      &err);
+  CHECK(v != nullptr);
+  CHECK(v->Get("a")->AsInt() == 1);
+  CHECK(v->Get("b")->Size() == 4);
+  CHECK(v->Get("b")->At(0)->AsBool());
+  CHECK(v->Get("b")->At(1)->IsNull());
+  CHECK(v->Get("b")->At(2)->AsDouble() == 2.5);
+  CHECK(v->Get("b")->At(3)->AsString() == "x\ny");
+  CHECK(v->Get("c")->Get("d")->AsInt() == -7);
+
+  // serialize -> reparse
+  auto v2 = tc::json::Parse(v->Serialize(), &err);
+  CHECK(v2 != nullptr);
+  CHECK(v2->Get("c")->Get("d")->AsInt() == -7);
+
+  // errors
+  CHECK(tc::json::Parse("{", &err) == nullptr);
+  CHECK(!err.empty());
+  CHECK(tc::json::Parse("[1, 2", &err) == nullptr);
+  CHECK(tc::json::Parse("nope", &err) == nullptr);
+  // unicode escape
+  auto u = tc::json::Parse("\"\\u00e9\"", &err);
+  CHECK(u != nullptr && u->AsString() == "\xc3\xa9");
+}
+
+static void
+TestInferInputScatterGather()
+{
+  tc::InferInput* raw;
+  CHECK_OK(tc::InferInput::Create(&raw, "IN", {2, 4}, "INT32"));
+  std::unique_ptr<tc::InferInput> input(raw);
+  int32_t a[4] = {1, 2, 3, 4};
+  int32_t b[4] = {5, 6, 7, 8};
+  CHECK_OK(input->AppendRaw((uint8_t*)a, sizeof(a)));
+  CHECK_OK(input->AppendRaw((uint8_t*)b, sizeof(b)));
+  CHECK(input->TotalByteSize() == 32);
+
+  CHECK_OK(input->PrepareForRequest());
+  const uint8_t* buf;
+  size_t len;
+  bool end = false;
+  CHECK_OK(input->GetNext(&buf, &len, &end));
+  CHECK(buf == (uint8_t*)a && len == 16 && !end);
+  CHECK_OK(input->GetNext(&buf, &len, &end));
+  CHECK(buf == (uint8_t*)b && len == 16 && end);
+
+  // shm exclusivity
+  CHECK(!input->SetSharedMemory("region", 32).IsOk());
+  CHECK_OK(input->Reset());
+  CHECK_OK(input->SetSharedMemory("region", 32));
+  CHECK(input->IsSharedMemory());
+  CHECK(!input->AppendRaw((uint8_t*)a, 4).IsOk());
+}
+
+static void
+TestBytesSerialization()
+{
+  tc::InferInput* raw;
+  CHECK_OK(tc::InferInput::Create(&raw, "S", {2}, "BYTES"));
+  std::unique_ptr<tc::InferInput> input(raw);
+  CHECK_OK(input->AppendFromString({"ab", "cdef"}));
+  CHECK(input->TotalByteSize() == 4 + 2 + 4 + 4);
+  CHECK_OK(input->PrepareForRequest());
+  const uint8_t* buf;
+  size_t len;
+  bool end;
+  CHECK_OK(input->GetNext(&buf, &len, &end));
+  uint32_t l0;
+  memcpy(&l0, buf, 4);
+  CHECK(l0 == 2 && memcmp(buf + 4, "ab", 2) == 0);
+}
+
+static void
+TestGenerateRequestBody()
+{
+  tc::InferInput* in_raw;
+  CHECK_OK(tc::InferInput::Create(&in_raw, "INPUT0", {1, 4}, "INT32"));
+  std::unique_ptr<tc::InferInput> input(in_raw);
+  int32_t data[4] = {9, 8, 7, 6};
+  CHECK_OK(input->AppendRaw((uint8_t*)data, sizeof(data)));
+
+  tc::InferRequestedOutput* out_raw;
+  CHECK_OK(tc::InferRequestedOutput::Create(&out_raw, "OUTPUT0"));
+  std::unique_ptr<tc::InferRequestedOutput> output(out_raw);
+
+  tc::InferOptions options("simple");
+  options.request_id_ = "req-1";
+  options.sequence_id_ = 42;
+  options.sequence_start_ = true;
+
+  std::vector<uint8_t> body;
+  size_t header_length;
+  CHECK_OK(tc::InferenceServerHttpClient::GenerateRequestBody(
+      &body, &header_length, options, {input.get()}, {output.get()}));
+  CHECK(body.size() == header_length + sizeof(data));
+  CHECK(memcmp(body.data() + header_length, data, sizeof(data)) == 0);
+
+  std::string err;
+  auto doc = tc::json::Parse(
+      std::string((const char*)body.data(), header_length), &err);
+  CHECK(doc != nullptr);
+  CHECK(doc->Get("id")->AsString() == "req-1");
+  CHECK(doc->Get("parameters")->Get("sequence_id")->AsInt() == 42);
+  auto in0 = doc->Get("inputs")->At(0);
+  CHECK(in0->Get("name")->AsString() == "INPUT0");
+  CHECK(
+      in0->Get("parameters")->Get("binary_data_size")->AsInt() ==
+      (int64_t)sizeof(data));
+}
+
+static void
+TestParseResponseBody()
+{
+  // response: JSON header + one binary INT32[4] section
+  int32_t payload[4] = {10, 20, 30, 40};
+  std::string header =
+      "{\"model_name\":\"simple\",\"model_version\":\"1\",\"id\":\"7\","
+      "\"outputs\":[{\"name\":\"OUTPUT0\",\"datatype\":\"INT32\","
+      "\"shape\":[1,4],\"parameters\":{\"binary_data_size\":16}}]}";
+  std::vector<uint8_t> body(header.begin(), header.end());
+  body.insert(
+      body.end(), (uint8_t*)payload, (uint8_t*)payload + sizeof(payload));
+
+  tc::InferResult* result;
+  CHECK_OK(tc::InferenceServerHttpClient::ParseResponseBody(
+      &result, body, header.size()));
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  std::string name, version, id, datatype;
+  CHECK_OK(result->ModelName(&name));
+  CHECK(name == "simple");
+  CHECK_OK(result->Id(&id));
+  CHECK(id == "7");
+  std::vector<int64_t> shape;
+  CHECK_OK(result->Shape("OUTPUT0", &shape));
+  CHECK(shape.size() == 2 && shape[0] == 1 && shape[1] == 4);
+  CHECK_OK(result->Datatype("OUTPUT0", &datatype));
+  CHECK(datatype == "INT32");
+  const uint8_t* buf;
+  size_t byte_size;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &byte_size));
+  CHECK(byte_size == 16);
+  CHECK(memcmp(buf, payload, 16) == 0);
+  CHECK(!result->RawData("NOPE", &buf, &byte_size).IsOk());
+  CHECK_OK(result->RequestStatus());
+}
+
+static void
+TestErrorResponse()
+{
+  std::string header = "{\"error\":\"model not found\"}";
+  std::vector<uint8_t> body(header.begin(), header.end());
+  tc::InferResult* result;
+  CHECK_OK(tc::InferenceServerHttpClient::ParseResponseBody(
+      &result, body, header.size()));
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  CHECK(!result->RequestStatus().IsOk());
+  CHECK(result->RequestStatus().Message() == "model not found");
+}
+
+int
+main()
+{
+  TestJsonRoundTrip();
+  TestInferInputScatterGather();
+  TestBytesSerialization();
+  TestGenerateRequestBody();
+  TestParseResponseBody();
+  TestErrorResponse();
+  printf("%d checks, %d failures\n", checks, failures);
+  return failures == 0 ? 0 : 1;
+}

@@ -640,6 +640,17 @@ class InferenceServer:
             )
         return region
 
+    def xla_shm_region(self, name):
+        """Public lookup of a registered XLA region (for models that park
+        device state in shm, e.g. llama KV caches); raises ServerError when
+        unknown."""
+        region = self._xla_shm.get(name)
+        if region is None:
+            raise ServerError(
+                "Unable to find xla shared memory region: '{}'".format(name)
+            )
+        return region
+
     def read_shm_input(self, region_name, byte_size, offset, datatype, shape):
         """Materialize an input tensor from a registered shm region.
 

@@ -82,15 +82,12 @@ class LlamaGenerateModel(Model):
         name = request.parameters.get("kv_cache_region")
         if not name:
             return None
-        region = (
-            self._server._xla_shm.get(name) if self._server is not None
-            else None
-        )
-        if region is None:
+        if self._server is None:
             raise ServerError(
-                "Unable to find xla shared memory region: '{}'".format(name)
+                "model '{}' has no server attached; kv_cache_region "
+                "requires a registered XLA shm region".format(self.name)
             )
-        return region
+        return self._server.xla_shm_region(name)
 
     def execute_stream(self, inputs, request):
         import jax
@@ -111,11 +108,16 @@ class LlamaGenerateModel(Model):
         if resume:
             parked = region.handle.get_jax_segment(0)
             if parked is not None:
+                if "kv_cache_position" not in request.parameters:
+                    raise ValueError(
+                        "kv_cache_resume requires kv_cache_position (the "
+                        "sequence position the parked cache was left at)"
+                    )
                 # decode_step donates its cache argument; copy so the parked
                 # array in the region registry stays valid even if this
                 # stream dies mid-generation.
                 cache = jnp.copy(parked)
-                pos = int(request.parameters.get("kv_cache_position", 0))
+                pos = int(request.parameters["kv_cache_position"])
         if cache is None:
             cache = llama.init_kv_cache(self._cfg, 1, self._max_seq)
             pos = 0

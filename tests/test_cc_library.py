@@ -1,0 +1,56 @@
+"""Build + run the C++ client library tests and examples against the
+in-process HTTP frontend (the C++ tier of SURVEY.md §7.5)."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "build", "cc")
+
+
+@pytest.fixture(scope="module")
+def cc_build():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "src", "c++"), "-B", BUILD,
+         "-G", "Ninja"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", BUILD], check=True, capture_output=True
+    )
+    return BUILD
+
+
+def test_cc_unit_tests(cc_build):
+    result = subprocess.run(
+        [os.path.join(cc_build, "cc_unit_tests")],
+        capture_output=True, text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "0 failures" in result.stdout
+
+
+def test_cc_simple_http_infer_client(cc_build, http_server):
+    result = subprocess.run(
+        [os.path.join(cc_build, "simple_http_infer_client"), "-u",
+         http_server.url.replace("http://", "")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "sync infer OK" in result.stdout
+    assert "async infer OK" in result.stdout
+
+
+def test_cc_simple_http_shm_client(cc_build, http_server):
+    result = subprocess.run(
+        [os.path.join(cc_build, "simple_http_shm_client"), "-u",
+         http_server.url.replace("http://", "")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "shm infer OK" in result.stdout
