@@ -3,70 +3,114 @@
 Mirrors the reference's quick-start measurement (perf_analyzer -m simple,
 HTTP, concurrency 1 → 1407.84 infer/sec on the reference's GPU box;
 reference docs/quick_start.md:94-108, BASELINE.md).  The server is the
-in-process tpuserver HTTP frontend with the jax-backed `simple` add/sub
-model, the client is tritonclient.http — a full wire round-trip per
-request over a real socket.
+in-process tpuserver HTTP frontend with the `simple` add/sub model; the
+driver is this framework's C++ perf_analyzer (built on the raw-socket
+client library) — a full wire round-trip per request over a real socket,
+measured with the reference's stability-window methodology.  Falls back to
+the Python client loop when the native toolchain is unavailable.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
 import json
+import os
+import shutil
 import statistics
+import subprocess
 import sys
 import time
-import os
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "src", "python"))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
 
 BASELINE_INFER_PER_SEC = 1407.84  # reference quick_start.md:94
 
 
-def main():
+def _build_cc():
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        return None
+    build = os.path.join(REPO, "build", "cc")
+    try:
+        subprocess.run(
+            ["cmake", "-S", os.path.join(REPO, "src", "c++"), "-B", build,
+             "-G", "Ninja"],
+            check=True, capture_output=True, timeout=300,
+        )
+        subprocess.run(
+            ["ninja", "-C", build, "perf_analyzer"],
+            check=True, capture_output=True, timeout=600,
+        )
+    except Exception:
+        return None
+    path = os.path.join(build, "perf_analyzer")
+    return path if os.path.exists(path) else None
+
+
+def _bench_native(perf_analyzer, url):
+    csv_path = os.path.join(REPO, "build", "bench_simple.csv")
+    result = subprocess.run(
+        [perf_analyzer, "-m", "simple", "-u", url, "-p", "1500",
+         "--max-trials", "8", "-f", csv_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    if result.returncode != 0:
+        return None
+    with open(csv_path) as f:
+        lines = f.read().strip().splitlines()
+    if len(lines) < 2:
+        return None
+    return float(lines[1].split(",")[1])
+
+
+def _bench_python(url):
     import numpy as np
 
     import tritonclient.http as httpclient
+
+    client = httpclient.InferenceServerClient(url)
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.ones((1, 16), dtype=np.int32)
+    in0.set_data_from_numpy(a)
+    in1.set_data_from_numpy(b)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
+    ]
+    for _ in range(100):
+        result = client.infer("simple", [in0, in1], outputs=outputs)
+    assert (result.as_numpy("OUTPUT0") == a + b).all()
+    rates = []
+    for _ in range(3):
+        n = 0
+        t0 = time.perf_counter()
+        while True:
+            client.infer("simple", [in0, in1], outputs=outputs)
+            n += 1
+            dt = time.perf_counter() - t0
+            if dt >= 1.5:
+                break
+        rates.append(n / dt)
+    client.close()
+    return statistics.median(rates)
+
+
+def main():
     from tpuserver.core import InferenceServer
     from tpuserver.http_frontend import HttpFrontend
     from tpuserver.models import default_models
 
     core = InferenceServer(default_models())
     frontend = HttpFrontend(core, port=0).start()
+    url = frontend.url.replace("http://", "")
     try:
-        client = httpclient.InferenceServerClient(
-            frontend.url.replace("http://", "")
-        )
-        in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
-        in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
-        a = np.arange(16, dtype=np.int32).reshape(1, 16)
-        b = np.ones((1, 16), dtype=np.int32)
-        in0.set_data_from_numpy(a)
-        in1.set_data_from_numpy(b)
-        outputs = [
-            httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
-            httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
-        ]
-
-        def one():
-            return client.infer("simple", [in0, in1], outputs=outputs)
-
-        # warmup (includes XLA compile of the model)
-        for _ in range(100):
-            result = one()
-        assert (result.as_numpy("OUTPUT0") == a + b).all()
-
-        # 3 measurement windows of >=1.5s, report the median rate
-        rates = []
-        for _ in range(3):
-            n = 0
-            t0 = time.perf_counter()
-            while True:
-                one()
-                n += 1
-                dt = time.perf_counter() - t0
-                if dt >= 1.5:
-                    break
-            rates.append(n / dt)
-        value = statistics.median(rates)
+        value = None
+        perf_analyzer = _build_cc()
+        if perf_analyzer is not None:
+            value = _bench_native(perf_analyzer, url)
+        if value is None:
+            value = _bench_python(url)
         print(
             json.dumps(
                 {

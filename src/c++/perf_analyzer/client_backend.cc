@@ -1,0 +1,227 @@
+#include "client_backend.h"
+
+#include "http_client.h"
+
+namespace pa {
+
+// Triton-HTTP backend: wraps the client library
+// (reference client_backend/triton/triton_client_backend.{h,cc}).
+class TritonHttpBackend : public ClientBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    auto* b = new TritonHttpBackend();
+    tc::Error err = tc::InferenceServerHttpClient::Create(
+        &b->client_, config.url, config.verbose, config.concurrency);
+    if (!err.IsOk()) {
+      delete b;
+      return err;
+    }
+    backend->reset(b);
+    return tc::Error::Success;
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    return client_->IsServerReady(ready);
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    return client_->ModelMetadata(
+        metadata_json, model_name, model_version);
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    return client_->ModelConfig(config_json, model_name, model_version);
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) override
+  {
+    return client_->ModelInferenceStatistics(stats_json, model_name);
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    std::vector<std::unique_ptr<tc::InferInput>> owned_inputs;
+    std::vector<std::unique_ptr<tc::InferRequestedOutput>> owned_outputs;
+    std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    tc::Error err =
+        BuildRequest(request, &owned_inputs, &owned_outputs, &inputs,
+                     &outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    tc::InferOptions options(request.model_name);
+    FillOptions(request, &options);
+    tc::InferResult* raw_result = nullptr;
+    err = client_->Infer(&raw_result, options, inputs, outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    Convert(raw_result, request, result);
+    delete raw_result;
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback, const BackendInferRequest& request) override
+  {
+    // buffers must outlive the wire write: own them in shared state bound
+    // into the completion lambda
+    auto owned_inputs =
+        std::make_shared<std::vector<std::unique_ptr<tc::InferInput>>>();
+    auto owned_outputs = std::make_shared<
+        std::vector<std::unique_ptr<tc::InferRequestedOutput>>>();
+    std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    tc::Error err = BuildRequest(
+        request, owned_inputs.get(), owned_outputs.get(), &inputs,
+        &outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    tc::InferOptions options(request.model_name);
+    FillOptions(request, &options);
+    // only the output names are needed at completion; don't copy the
+    // (possibly large) input payloads into the lambda
+    std::vector<std::string> output_names = request.requested_outputs;
+    return client_->AsyncInfer(
+        [callback, owned_inputs, owned_outputs,
+         output_names](tc::InferResult* raw_result) {
+          BackendInferResult result;
+          ConvertOutputs(raw_result, output_names, &result);
+          delete raw_result;
+          callback(std::move(result));
+        },
+        options, inputs, outputs);
+  }
+
+  tc::Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key,
+      size_t byte_size) override
+  {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+  tc::Error UnregisterSystemSharedMemory(const std::string& name) override
+  {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+  tc::Error RegisterXlaSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      size_t byte_size, int device_ordinal) override
+  {
+    return client_->RegisterXlaSharedMemory(
+        name, raw_handle, byte_size, device_ordinal);
+  }
+  tc::Error UnregisterXlaSharedMemory(const std::string& name) override
+  {
+    return client_->UnregisterXlaSharedMemory(name);
+  }
+
+ private:
+  static void FillOptions(
+      const BackendInferRequest& request, tc::InferOptions* options)
+  {
+    options->model_version_ = request.model_version;
+    options->request_id_ = request.request_id;
+    options->sequence_id_ = request.sequence_id;
+    options->sequence_start_ = request.sequence_start;
+    options->sequence_end_ = request.sequence_end;
+  }
+
+  static tc::Error BuildRequest(
+      const BackendInferRequest& request,
+      std::vector<std::unique_ptr<tc::InferInput>>* owned_inputs,
+      std::vector<std::unique_ptr<tc::InferRequestedOutput>>* owned_outputs,
+      std::vector<tc::InferInput*>* inputs,
+      std::vector<const tc::InferRequestedOutput*>* outputs)
+  {
+    for (const auto& in : request.inputs) {
+      tc::InferInput* input;
+      tc::Error err =
+          tc::InferInput::Create(&input, in.name, in.shape, in.datatype);
+      if (!err.IsOk()) {
+        return err;
+      }
+      owned_inputs->emplace_back(input);
+      if (!in.shm_region.empty()) {
+        input->SetSharedMemory(
+            in.shm_region, in.shm_byte_size, in.shm_offset);
+      } else {
+        input->AppendRaw(in.data.data(), in.data.size());
+      }
+      inputs->push_back(input);
+    }
+    for (const auto& name : request.requested_outputs) {
+      tc::InferRequestedOutput* output;
+      tc::Error err = tc::InferRequestedOutput::Create(&output, name);
+      if (!err.IsOk()) {
+        return err;
+      }
+      owned_outputs->emplace_back(output);
+      outputs->push_back(output);
+    }
+    return tc::Error::Success;
+  }
+
+  static void Convert(
+      tc::InferResult* raw, const BackendInferRequest& request,
+      BackendInferResult* result)
+  {
+    ConvertOutputs(raw, request.requested_outputs, result);
+  }
+
+  static void ConvertOutputs(
+      tc::InferResult* raw, const std::vector<std::string>& output_names,
+      BackendInferResult* result)
+  {
+    result->status = raw->RequestStatus();
+    raw->Id(&result->request_id);
+    if (!result->status.IsOk()) {
+      return;
+    }
+    for (const auto& name : output_names) {
+      const uint8_t* buf;
+      size_t len;
+      if (raw->RawData(name, &buf, &len).IsOk()) {
+        result->outputs[name].assign(buf, buf + len);
+      }
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client_;
+};
+
+tc::Error
+ClientBackendFactory::Create(
+    std::shared_ptr<ClientBackend>* backend,
+    const BackendFactoryConfig& config)
+{
+  switch (config.kind) {
+    case BackendKind::TRITON_HTTP:
+      return TritonHttpBackend::Create(backend, config);
+    case BackendKind::TRITON_GRPC:
+      return tc::Error(
+          "the C++ gRPC backend requires grpc++ headers not present in "
+          "this build environment; use the HTTP backend (same v2 "
+          "semantics) or the Python gRPC client");
+    case BackendKind::MOCK:
+      return tc::Error(
+          "mock backend is constructed directly in tests");
+  }
+  return tc::Error("unknown backend kind");
+}
+
+}  // namespace pa

@@ -1,0 +1,114 @@
+// Backend abstraction: load managers issue requests through this neutral
+// interface so the harness runs identically against a live server, an
+// in-process one, or a mock (reference client_backend/client_backend.h:
+// 250-620).
+
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"  // tc::Error et al. from the client library
+#include "perf_utils.h"
+
+namespace pa {
+
+// Neutral request/response record used by the harness.
+struct BackendInferRequest {
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  uint64_t sequence_id = 0;
+  bool sequence_start = false;
+  bool sequence_end = false;
+  // name -> (datatype, shape, bytes) — bytes empty when shm-resident
+  struct Input {
+    std::string name;
+    std::string datatype;
+    std::vector<int64_t> shape;
+    std::vector<uint8_t> data;
+    std::string shm_region;
+    size_t shm_byte_size = 0;
+    size_t shm_offset = 0;
+  };
+  std::vector<Input> inputs;
+  std::vector<std::string> requested_outputs;
+};
+
+struct BackendInferResult {
+  tc::Error status;
+  std::string request_id;
+  // output name -> raw bytes (empty when delivered via shm)
+  std::map<std::string, std::vector<uint8_t>> outputs;
+};
+
+using BackendCallback = std::function<void(BackendInferResult&&)>;
+
+// Statistics a backend can report about itself (mock uses this to expose
+// call accounting to tests; reference mock_client_backend.h:126-589).
+struct BackendStats {
+  size_t infer_calls = 0;
+  size_t async_infer_calls = 0;
+  size_t shm_register_calls = 0;
+};
+
+class ClientBackend {
+ public:
+  virtual ~ClientBackend() = default;
+
+  virtual tc::Error ServerReady(bool* ready) = 0;
+  virtual tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) = 0;
+  virtual tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) = 0;
+  virtual tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) = 0;
+
+  virtual tc::Error Infer(
+      BackendInferResult* result, const BackendInferRequest& request) = 0;
+  virtual tc::Error AsyncInfer(
+      BackendCallback callback, const BackendInferRequest& request) = 0;
+
+  virtual tc::Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size)
+  {
+    return tc::Error("shared memory not supported by this backend");
+  }
+  virtual tc::Error UnregisterSystemSharedMemory(const std::string& name)
+  {
+    return tc::Error::Success;
+  }
+  virtual tc::Error RegisterXlaSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      size_t byte_size, int device_ordinal)
+  {
+    return tc::Error("xla shared memory not supported by this backend");
+  }
+  virtual tc::Error UnregisterXlaSharedMemory(const std::string& name)
+  {
+    return tc::Error::Success;
+  }
+
+  virtual BackendStats Stats() { return BackendStats(); }
+};
+
+struct BackendFactoryConfig {
+  BackendKind kind = BackendKind::TRITON_HTTP;
+  std::string url = "localhost:8000";
+  bool verbose = false;
+  int concurrency = 16;  // async worker threads for the http backend
+};
+
+class ClientBackendFactory {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config);
+};
+
+}  // namespace pa

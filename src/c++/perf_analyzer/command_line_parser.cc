@@ -1,0 +1,313 @@
+#include "command_line_parser.h"
+
+#include <getopt.h>
+
+#include <cstring>
+#include <sstream>
+
+namespace pa {
+
+namespace {
+
+enum LongOptIds {
+  OPT_MEASUREMENT_MODE = 1000,
+  OPT_MEASUREMENT_REQUEST_COUNT,
+  OPT_REQUEST_DISTRIBUTION,
+  OPT_REQUEST_INTERVALS,
+  OPT_REQUEST_RATE_RANGE,
+  OPT_CONCURRENCY_RANGE,
+  OPT_SHARED_MEMORY,
+  OPT_OUTPUT_SHM_SIZE,
+  OPT_SEQUENCE_LENGTH,
+  OPT_SEQUENCE_LENGTH_VARIATION,
+  OPT_STABILITY_PCT,
+  OPT_MAX_TRIALS,
+  OPT_INPUT_DATA,
+  OPT_SEED,
+  OPT_NUM_THREADS,
+  OPT_SERVICE_KIND,
+};
+
+const struct option kLongOptions[] = {
+    {"help", no_argument, nullptr, 'h'},
+    {"verbose", no_argument, nullptr, 'v'},
+    {"model-name", required_argument, nullptr, 'm'},
+    {"model-version", required_argument, nullptr, 'x'},
+    {"url", required_argument, nullptr, 'u'},
+    {"batch-size", required_argument, nullptr, 'b'},
+    {"concurrency-range", required_argument, nullptr,
+     OPT_CONCURRENCY_RANGE},
+    {"request-rate-range", required_argument, nullptr,
+     OPT_REQUEST_RATE_RANGE},
+    {"request-distribution", required_argument, nullptr,
+     OPT_REQUEST_DISTRIBUTION},
+    {"request-intervals", required_argument, nullptr,
+     OPT_REQUEST_INTERVALS},
+    {"measurement-interval", required_argument, nullptr, 'p'},
+    {"measurement-mode", required_argument, nullptr,
+     OPT_MEASUREMENT_MODE},
+    {"measurement-request-count", required_argument, nullptr,
+     OPT_MEASUREMENT_REQUEST_COUNT},
+    {"stability-percentage", required_argument, nullptr,
+     OPT_STABILITY_PCT},
+    {"max-trials", required_argument, nullptr, OPT_MAX_TRIALS},
+    {"async", no_argument, nullptr, 'a'},
+    {"sync", no_argument, nullptr, 1999},
+    {"zero-input", no_argument, nullptr, 'z'},
+    {"input-data", required_argument, nullptr, OPT_INPUT_DATA},
+    {"sequence-length", required_argument, nullptr, OPT_SEQUENCE_LENGTH},
+    {"sequence-length-variation", required_argument, nullptr,
+     OPT_SEQUENCE_LENGTH_VARIATION},
+    {"shared-memory", required_argument, nullptr, OPT_SHARED_MEMORY},
+    {"output-shared-memory-size", required_argument, nullptr,
+     OPT_OUTPUT_SHM_SIZE},
+    {"latency-report-file", required_argument, nullptr, 'f'},
+    {"random-seed", required_argument, nullptr, OPT_SEED},
+    {"num-threads", required_argument, nullptr, OPT_NUM_THREADS},
+    {"service-kind", required_argument, nullptr, OPT_SERVICE_KIND},
+    {"concurrency", required_argument, nullptr, 'c'},
+    {"request-rate", required_argument, nullptr, 2000},
+    {nullptr, 0, nullptr, 0},
+};
+
+bool
+ParseRange(
+    const std::string& arg, double* start, double* end, double* step,
+    std::string* error)
+{
+  // start[:end[:step]]
+  *end = 0;
+  *step = 1;
+  std::istringstream ss(arg);
+  std::string tok;
+  int i = 0;
+  while (std::getline(ss, tok, ':')) {
+    double v = atof(tok.c_str());
+    if (i == 0) {
+      *start = *end = v;
+    } else if (i == 1) {
+      *end = v;
+    } else if (i == 2) {
+      *step = v;
+    } else {
+      *error = "too many fields in range '" + arg + "'";
+      return false;
+    }
+    ++i;
+  }
+  if (i == 0) {
+    *error = "empty range";
+    return false;
+  }
+  if (*step <= 0) {
+    *error = "range step must be positive";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+std::string
+CLParser::Usage()
+{
+  return
+      "Usage: perf_analyzer -m <model> [options]\n"
+      "  -m/--model-name <name>          model to profile (required)\n"
+      "  -x/--model-version <ver>        model version\n"
+      "  -u/--url <host:port>            server url (default "
+      "localhost:8000)\n"
+      "  --service-kind <kind>           triton_http (default)\n"
+      "  -v/--verbose                    verbose output\n"
+      "  -a/--async                      async request issuance\n"
+      "  -b/--batch-size <n>             batch size (default 1)\n"
+      "  -z/--zero-input                 zero-filled input data\n"
+      "  --input-data <file.json>        JSON request payloads\n"
+      "  --concurrency-range <s:e:st>    sweep concurrency\n"
+      "  -c/--concurrency <n>            single concurrency level\n"
+      "  --request-rate-range <s:e:st>   sweep request rate\n"
+      "  --request-rate <r>              single request rate\n"
+      "  --request-distribution <d>      constant|poisson\n"
+      "  --request-intervals <file>      custom interval schedule (usec "
+      "per line)\n"
+      "  -p/--measurement-interval <ms>  window length (default 5000)\n"
+      "  --measurement-mode <mode>       time_windows|count_windows\n"
+      "  --measurement-request-count <n> requests per count window\n"
+      "  --stability-percentage <pct>    stability threshold (default "
+      "10)\n"
+      "  --max-trials <n>                max windows per level\n"
+      "  --sequence-length <n>           drive sequence models\n"
+      "  --sequence-length-variation <p> +- pct sequence length\n"
+      "  --shared-memory <type>          none|system|xla\n"
+      "  --output-shared-memory-size <n> output region bytes\n"
+      "  -f/--latency-report-file <csv>  CSV report path\n"
+      "  --random-seed <n>               data/schedule seed\n"
+      "  --num-threads <n>               rate-mode sender threads\n";
+}
+
+bool
+CLParser::Parse(
+    int argc, char** argv, PerfAnalyzerParameters* params,
+    std::string* error)
+{
+  optind = 1;  // reset for repeated calls (tests)
+  int opt;
+  while ((opt = getopt_long(
+              argc, argv, "hvam:x:u:b:p:c:f:z", kLongOptions, nullptr)) !=
+         -1) {
+    switch (opt) {
+      case 'h':
+        params->usage_requested = true;
+        return true;
+      case 'v':
+        params->verbose = true;
+        break;
+      case 'a':
+        params->async = true;
+        break;
+      case 1999:  // --sync
+        params->async = false;
+        break;
+      case 'm':
+        params->model_name = optarg;
+        break;
+      case 'x':
+        params->model_version = optarg;
+        break;
+      case 'u':
+        params->url = optarg;
+        break;
+      case 'b':
+        params->batch_size = atoi(optarg);
+        if (params->batch_size < 1) {
+          *error = "batch size must be >= 1";
+          return false;
+        }
+        break;
+      case 'z':
+        params->zero_input = true;
+        break;
+      case 'c':
+        params->concurrency_start = params->concurrency_end =
+            (size_t)atoi(optarg);
+        break;
+      case 2000: {  // --request-rate
+        params->request_rate_start = params->request_rate_end =
+            atof(optarg);
+        break;
+      }
+      case 'p':
+        params->measurement_window_ms = (uint64_t)atoll(optarg);
+        break;
+      case 'f':
+        params->latency_report_file = optarg;
+        break;
+      case OPT_CONCURRENCY_RANGE: {
+        double s, e, st;
+        if (!ParseRange(optarg, &s, &e, &st, error)) {
+          return false;
+        }
+        params->concurrency_start = (size_t)s;
+        params->concurrency_end = (size_t)e;
+        params->concurrency_step = (size_t)st;
+        break;
+      }
+      case OPT_REQUEST_RATE_RANGE: {
+        if (!ParseRange(
+                optarg, &params->request_rate_start,
+                &params->request_rate_end, &params->request_rate_step,
+                error)) {
+          return false;
+        }
+        break;
+      }
+      case OPT_REQUEST_DISTRIBUTION:
+        if (strcmp(optarg, "poisson") == 0) {
+          params->request_distribution = Distribution::POISSON;
+        } else if (strcmp(optarg, "constant") == 0) {
+          params->request_distribution = Distribution::CONSTANT;
+        } else {
+          *error = std::string("unknown request distribution ") + optarg;
+          return false;
+        }
+        break;
+      case OPT_REQUEST_INTERVALS:
+        params->request_intervals_path = optarg;
+        break;
+      case OPT_MEASUREMENT_MODE:
+        if (strcmp(optarg, "count_windows") == 0) {
+          params->count_windows = true;
+        } else if (strcmp(optarg, "time_windows") == 0) {
+          params->count_windows = false;
+        } else {
+          *error = std::string("unknown measurement mode ") + optarg;
+          return false;
+        }
+        break;
+      case OPT_MEASUREMENT_REQUEST_COUNT:
+        params->measurement_request_count = (uint64_t)atoll(optarg);
+        break;
+      case OPT_STABILITY_PCT:
+        params->stability_threshold_pct = atof(optarg);
+        break;
+      case OPT_MAX_TRIALS:
+        params->max_trials = (size_t)atoi(optarg);
+        break;
+      case OPT_INPUT_DATA:
+        params->input_data_path = optarg;
+        break;
+      case OPT_SEQUENCE_LENGTH:
+        params->use_sequences = true;
+        params->sequence_length = (size_t)atoi(optarg);
+        break;
+      case OPT_SEQUENCE_LENGTH_VARIATION:
+        params->sequence_length_variation = atof(optarg);
+        break;
+      case OPT_SHARED_MEMORY:
+        if (strcmp(optarg, "system") == 0) {
+          params->shared_memory = SharedMemoryType::SYSTEM;
+        } else if (strcmp(optarg, "xla") == 0) {
+          params->shared_memory = SharedMemoryType::XLA;
+        } else if (strcmp(optarg, "none") == 0) {
+          params->shared_memory = SharedMemoryType::NONE;
+        } else {
+          *error = std::string("unknown shared memory type ") + optarg;
+          return false;
+        }
+        break;
+      case OPT_OUTPUT_SHM_SIZE:
+        params->output_shm_size = (size_t)atoll(optarg);
+        break;
+      case OPT_SEED:
+        params->seed = (uint32_t)atoi(optarg);
+        break;
+      case OPT_NUM_THREADS:
+        params->num_threads = (size_t)atoi(optarg);
+        break;
+      case OPT_SERVICE_KIND:
+        if (strcmp(optarg, "triton_http") == 0 ||
+            strcmp(optarg, "triton") == 0) {
+          params->kind = BackendKind::TRITON_HTTP;
+        } else {
+          *error = std::string("unsupported service kind ") + optarg;
+          return false;
+        }
+        break;
+      default:
+        *error = "unknown option";
+        return false;
+    }
+  }
+  if (!params->usage_requested && params->model_name.empty()) {
+    *error = "-m/--model-name is required";
+    return false;
+  }
+  if (params->request_rate_start > 0 && params->concurrency_start > 1) {
+    *error =
+        "cannot use concurrency and request rate modes together";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace pa

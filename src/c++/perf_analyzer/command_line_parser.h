@@ -1,0 +1,68 @@
+// getopt_long option surface -> PerfAnalyzerParameters
+// (reference command_line_parser.{h,cc}:706-759 — the load-shaping,
+// measurement, model and transport options; CUDA-shm options map to
+// XLA-shm).
+
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "perf_utils.h"
+
+namespace pa {
+
+struct PerfAnalyzerParameters {
+  std::string model_name;
+  std::string model_version;
+  std::string url = "localhost:8000";
+  BackendKind kind = BackendKind::TRITON_HTTP;
+  bool verbose = false;
+  bool async = false;
+  int batch_size = 1;
+  bool zero_input = false;
+  std::string input_data_path;  // JSON file of request payloads
+
+  // concurrency sweep
+  size_t concurrency_start = 1;
+  size_t concurrency_end = 1;
+  size_t concurrency_step = 1;
+  // request-rate sweep (0 = concurrency mode)
+  double request_rate_start = 0.0;
+  double request_rate_end = 0.0;
+  double request_rate_step = 1.0;
+  Distribution request_distribution = Distribution::CONSTANT;
+  std::string request_intervals_path;  // custom-interval mode
+
+  uint64_t measurement_window_ms = 5000;
+  bool count_windows = false;
+  uint64_t measurement_request_count = 50;
+  double stability_threshold_pct = 10.0;
+  size_t max_trials = 10;
+
+  bool use_sequences = false;
+  size_t sequence_length = 20;
+  double sequence_length_variation = 20.0;
+
+  SharedMemoryType shared_memory = SharedMemoryType::NONE;
+  size_t output_shm_size = 102400;
+
+  std::string latency_report_file;  // CSV path
+  uint32_t seed = 17;
+  size_t num_threads = 2;  // rate-mode sender threads
+
+  bool usage_requested = false;
+};
+
+class CLParser {
+ public:
+  // Parses argv; returns false (with *error set) on invalid input.
+  static bool Parse(
+      int argc, char** argv, PerfAnalyzerParameters* params,
+      std::string* error);
+
+  static std::string Usage();
+};
+
+}  // namespace pa

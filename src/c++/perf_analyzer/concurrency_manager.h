@@ -1,0 +1,62 @@
+// Concurrency mode: keep N requests in flight (reference
+// concurrency_manager.{h,cc} + concurrency_worker.{h,cc}).
+//
+// Each concurrency slot is one worker thread driving a sync InferContext
+// loop (the async-multiplexed variant of the reference collapses to this
+// on a thread-per-slot design; slots are cheap at the scales a single
+// host drives).
+
+#pragma once
+
+#include <condition_variable>
+
+#include "load_manager.h"
+
+namespace pa {
+
+class ConcurrencyManager : public LoadManager {
+ public:
+  using LoadManager::LoadManager;
+
+  // Reconfigure to `level` in-flight requests (reference
+  // ChangeConcurrencyLevel, concurrency_manager.h:90).
+  tc::Error ChangeConcurrencyLevel(size_t level)
+  {
+    StopWorkers();
+    // finish any open sequences before the level switch
+    if (sequence_manager_ != nullptr) {
+      for (auto& flags : sequence_manager_->CompleteOngoing()) {
+        auto ctx = MakeContext(0);
+        BackendInferRequest req = ctx->BuildRequest();
+        req.sequence_id = flags.sequence_id;
+        req.sequence_start = false;
+        req.sequence_end = true;
+        BackendInferResult result;
+        backend_->Infer(&result, req);
+      }
+    }
+    for (size_t slot = 0; slot < level; ++slot) {
+      auto ctx = MakeContext(slot);
+      bool use_async = config_.async;
+      threads_.emplace_back([this, ctx, use_async] {
+        while (!stop_.load(std::memory_order_relaxed)) {
+          if (use_async) {
+            // one outstanding request per slot via the async client path
+            ctx->SendAsyncRequest();
+            sent_requests_++;
+            while (ctx->Inflight() > 0 &&
+                   !stop_.load(std::memory_order_relaxed)) {
+              std::this_thread::sleep_for(std::chrono::microseconds(50));
+            }
+          } else {
+            ctx->SendSyncRequest();
+            sent_requests_++;
+          }
+        }
+      });
+    }
+    return tc::Error::Success;
+  }
+};
+
+}  // namespace pa

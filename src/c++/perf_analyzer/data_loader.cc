@@ -1,0 +1,168 @@
+#include "data_loader.h"
+
+#include <cstring>
+
+namespace pa {
+
+namespace {
+
+std::string
+Key(const std::string& name, size_t stream, size_t step)
+{
+  return name + ":" + std::to_string(stream) + ":" + std::to_string(step);
+}
+
+void
+FillRandom(std::vector<uint8_t>* data, std::mt19937* rng)
+{
+  std::uniform_int_distribution<int> dist(0, 255);
+  for (auto& b : *data) {
+    b = (uint8_t)dist(*rng);
+  }
+}
+
+}  // namespace
+
+tc::Error
+DataLoader::GenerateData(
+    const std::vector<ModelTensor>& inputs, bool zero_data, size_t streams,
+    size_t steps, int batch_size, uint32_t seed)
+{
+  std::mt19937 rng(seed);
+  streams_ = streams;
+  steps_ = steps;
+  for (const auto& input : inputs) {
+    int64_t elem_size = ByteSize(input.datatype);
+    int64_t count = ElementCount(input.shape);
+    for (size_t stream = 0; stream < streams; ++stream) {
+      for (size_t step = 0; step < steps; ++step) {
+        std::vector<uint8_t> payload;
+        if (elem_size < 0) {
+          // BYTES: batch_size * count entries of 4-byte len + "pa_data"
+          static const char kStr[] = "pa_data";
+          uint32_t len = sizeof(kStr) - 1;
+          for (int64_t i = 0; i < count * batch_size; ++i) {
+            payload.insert(
+                payload.end(), (uint8_t*)&len, (uint8_t*)&len + 4);
+            payload.insert(
+                payload.end(), (const uint8_t*)kStr,
+                (const uint8_t*)kStr + len);
+          }
+        } else {
+          payload.resize((size_t)(count * elem_size * batch_size));
+          if (!zero_data) {
+            FillRandom(&payload, &rng);
+          }
+        }
+        data_[Key(input.name, stream, step)] = std::move(payload);
+      }
+    }
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
+DataLoader::ReadDataFromJson(
+    const std::vector<ModelTensor>& inputs, const std::string& json_text,
+    int batch_size)
+{
+  std::string parse_err;
+  auto doc = tc::json::Parse(json_text, &parse_err);
+  if (doc == nullptr) {
+    return tc::Error("failed to parse input data JSON: " + parse_err);
+  }
+  auto data = doc->Get("data");
+  if (data == nullptr) {
+    return tc::Error("input data JSON missing 'data' array");
+  }
+  streams_ = 1;
+  steps_ = data->Size();
+  for (size_t step = 0; step < data->Size(); ++step) {
+    auto entry = data->At(step);
+    for (const auto& input : inputs) {
+      auto values = entry->Get(input.name);
+      if (values == nullptr) {
+        return tc::Error(
+            "missing data for input '" + input.name + "' at step " +
+            std::to_string(step));
+      }
+      int64_t elem_size = ByteSize(input.datatype);
+      std::vector<uint8_t> payload;
+      // flatten nested arrays of numbers (or strings for BYTES)
+      std::vector<tc::json::ValuePtr> stack{values};
+      std::vector<tc::json::ValuePtr> flat;
+      // breadth-preserving DFS flatten
+      std::function<void(const tc::json::ValuePtr&)> walk =
+          [&](const tc::json::ValuePtr& v) {
+            if (v->type() == tc::json::Type::Array) {
+              for (const auto& e : v->Elements()) {
+                walk(e);
+              }
+            } else {
+              flat.push_back(v);
+            }
+          };
+      walk(values);
+      for (const auto& v : flat) {
+        if (elem_size < 0) {
+          const std::string& s = v->AsString();
+          uint32_t len = (uint32_t)s.size();
+          payload.insert(
+              payload.end(), (uint8_t*)&len, (uint8_t*)&len + 4);
+          payload.insert(payload.end(), s.begin(), s.end());
+        } else if (
+            input.datatype == "FP32") {
+          float f = (float)v->AsDouble();
+          payload.insert(
+              payload.end(), (uint8_t*)&f, (uint8_t*)&f + 4);
+        } else if (input.datatype == "FP64") {
+          double d = v->AsDouble();
+          payload.insert(
+              payload.end(), (uint8_t*)&d, (uint8_t*)&d + 8);
+        } else if (
+            input.datatype == "INT64" || input.datatype == "UINT64") {
+          int64_t i = v->AsInt();
+          payload.insert(
+              payload.end(), (uint8_t*)&i, (uint8_t*)&i + 8);
+        } else if (
+            input.datatype == "INT32" || input.datatype == "UINT32") {
+          int32_t i = (int32_t)v->AsInt();
+          payload.insert(
+              payload.end(), (uint8_t*)&i, (uint8_t*)&i + 4);
+        } else if (
+            input.datatype == "INT16" || input.datatype == "UINT16") {
+          int16_t i = (int16_t)v->AsInt();
+          payload.insert(
+              payload.end(), (uint8_t*)&i, (uint8_t*)&i + 2);
+        } else if (
+            input.datatype == "INT8" || input.datatype == "UINT8" ||
+            input.datatype == "BOOL") {
+          int8_t i = (int8_t)v->AsInt();
+          payload.push_back((uint8_t)i);
+        } else {
+          return tc::Error(
+              "unsupported datatype in JSON data: " + input.datatype);
+        }
+      }
+      data_[Key(input.name, 0, step)] = std::move(payload);
+    }
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
+DataLoader::GetInputData(
+    const std::string& input_name, size_t stream, size_t step,
+    const std::vector<uint8_t>** data) const
+{
+  auto it = data_.find(Key(input_name, stream, step));
+  if (it == data_.end()) {
+    return tc::Error(
+        "no data for input '" + input_name + "' stream " +
+        std::to_string(stream) + " step " + std::to_string(step));
+  }
+  *data = &it->second;
+  return tc::Error::Success;
+}
+
+}  // namespace pa

@@ -1,0 +1,46 @@
+// Request tensor data: synthetic (random/zero) or user-supplied JSON
+// (reference data_loader.{h,cc}:71-97).
+
+#pragma once
+
+#include <map>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "model_parser.h"
+
+namespace pa {
+
+class DataLoader {
+ public:
+  // Generate synthetic data for every model input: `streams` independent
+  // data streams of `steps` request payloads each (sequence models walk a
+  // stream across requests).
+  tc::Error GenerateData(
+      const std::vector<ModelTensor>& inputs, bool zero_data,
+      size_t streams = 1, size_t steps = 1, int batch_size = 1,
+      uint32_t seed = 17);
+
+  // Load user data from a JSON document of the reference's input-data
+  // format: {"data": [{"INPUT0": [..], ...}, ...]} — one entry per step.
+  tc::Error ReadDataFromJson(
+      const std::vector<ModelTensor>& inputs, const std::string& json_text,
+      int batch_size = 1);
+
+  size_t StreamCount() const { return streams_; }
+  size_t StepCount() const { return steps_; }
+
+  // raw payload for (stream, step, input)
+  tc::Error GetInputData(
+      const std::string& input_name, size_t stream, size_t step,
+      const std::vector<uint8_t>** data) const;
+
+ private:
+  size_t streams_ = 0;
+  size_t steps_ = 0;
+  // key: input name + ":" + stream + ":" + step
+  std::map<std::string, std::vector<uint8_t>> data_;
+};
+
+}  // namespace pa

@@ -1,0 +1,105 @@
+#include "infer_context.h"
+
+namespace pa {
+
+BackendInferRequest
+InferContext::BuildRequest()
+{
+  BackendInferRequest request;
+  request.model_name = parser_->ModelName();
+  request.model_version = parser_->ModelVersion();
+  request.request_id = std::to_string(++request_counter_);
+
+  size_t step = step_;
+  step_ = (step_ + 1) % (data_loader_->StepCount() > 0
+                             ? data_loader_->StepCount()
+                             : 1);
+  for (const auto& input : parser_->Inputs()) {
+    BackendInferRequest::Input in;
+    in.name = input.name;
+    in.datatype = input.datatype;
+    if (parser_->MaxBatchSize() > 0) {
+      in.shape.push_back(batch_size_);
+    }
+    for (int64_t d : input.shape) {
+      in.shape.push_back(d < 0 ? 1 : d);
+    }
+    if (shm_layout_ != nullptr) {
+      auto it = shm_layout_->inputs.find(input.name);
+      if (it != shm_layout_->inputs.end()) {
+        in.shm_region = shm_layout_->region_name;
+        in.shm_offset = it->second.first;
+        in.shm_byte_size = it->second.second;
+      }
+    }
+    if (in.shm_region.empty()) {
+      const std::vector<uint8_t>* data = nullptr;
+      if (data_loader_->GetInputData(input.name, 0, step, &data).IsOk()) {
+        in.data = *data;
+      }
+    }
+    request.inputs.push_back(std::move(in));
+  }
+  for (const auto& output : parser_->Outputs()) {
+    request.requested_outputs.push_back(output.name);
+  }
+  if (sequence_manager_ != nullptr) {
+    auto flags = sequence_manager_->Next(seq_slot_);
+    request.sequence_id = flags.sequence_id;
+    request.sequence_start = flags.start;
+    request.sequence_end = flags.end;
+  }
+  return request;
+}
+
+void
+InferContext::Record(
+    uint64_t start_ns, uint64_t end_ns, bool ok, bool delayed)
+{
+  std::lock_guard<std::mutex> lk(thread_stat_->mu);
+  thread_stat_->records.push_back({start_ns, end_ns, ok, delayed});
+}
+
+void
+InferContext::SendSyncRequest()
+{
+  BackendInferRequest request = BuildRequest();
+  BackendInferResult result;
+  uint64_t start = NowNs();
+  tc::Error err = backend_->Infer(&result, request);
+  uint64_t end = NowNs();
+  bool ok = err.IsOk() && result.status.IsOk();
+  if (!err.IsOk()) {
+    std::lock_guard<std::mutex> lk(thread_stat_->mu);
+    thread_stat_->status = err;
+  }
+  Record(start, end, ok, false);
+}
+
+void
+InferContext::SendAsyncRequest(bool delayed)
+{
+  BackendInferRequest request = BuildRequest();
+  uint64_t start = NowNs();
+  thread_stat_->inflight++;
+  auto thread_stat = thread_stat_;
+  tc::Error err = backend_->AsyncInfer(
+      [thread_stat, start, delayed](BackendInferResult&& result) {
+        uint64_t end = NowNs();
+        {
+          std::lock_guard<std::mutex> lk(thread_stat->mu);
+          thread_stat->records.push_back(
+              {start, end, result.status.IsOk(), delayed});
+        }
+        thread_stat->inflight--;
+      },
+      request);
+  if (!err.IsOk()) {
+    thread_stat_->inflight--;
+    std::lock_guard<std::mutex> lk(thread_stat_->mu);
+    thread_stat_->status = err;
+    thread_stat_->records.push_back({start, NowNs(), false, delayed});
+  }
+}
+
+}  // namespace pa

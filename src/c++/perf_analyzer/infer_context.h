@@ -1,0 +1,90 @@
+// Per-context request issuance + timestamp accounting
+// (reference infer_context.{h,cc}:43-260, load_worker pieces).
+
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include <map>
+
+#include "client_backend.h"
+#include "data_loader.h"
+#include "model_parser.h"
+#include "sequence_manager.h"
+
+namespace pa {
+
+// System-shm layout shared by the load manager and its contexts: where
+// each input's step-0 payload lives inside the registered region
+// (reference infer_data_manager_shm.h:56-123).
+struct ShmLayout {
+  std::string region_name;
+  // input name -> (offset, byte_size)
+  std::map<std::string, std::pair<size_t, size_t>> inputs;
+};
+
+// One completed request's timing record.
+struct RequestRecord {
+  uint64_t start_ns = 0;
+  uint64_t end_ns = 0;
+  bool success = false;
+  bool delayed = false;  // rate mode: fired behind schedule
+};
+
+// Shared between a worker thread and the profiler (reference
+// infer_context.h:43-64).
+struct ThreadStat {
+  std::mutex mu;
+  std::vector<RequestRecord> records;
+  tc::Error status = tc::Error::Success;
+  std::atomic<size_t> inflight{0};
+};
+
+class InferContext {
+ public:
+  InferContext(
+      std::shared_ptr<ClientBackend> backend,
+      std::shared_ptr<ModelParser> parser,
+      std::shared_ptr<DataLoader> data_loader,
+      std::shared_ptr<SequenceManager> sequence_manager,
+      std::shared_ptr<ThreadStat> thread_stat, int batch_size,
+      size_t seq_slot = 0,
+      std::shared_ptr<const ShmLayout> shm_layout = nullptr)
+      : backend_(std::move(backend)), parser_(std::move(parser)),
+        data_loader_(std::move(data_loader)),
+        sequence_manager_(std::move(sequence_manager)),
+        thread_stat_(std::move(thread_stat)), batch_size_(batch_size),
+        seq_slot_(seq_slot), shm_layout_(std::move(shm_layout))
+  {
+  }
+
+  // Build the request for the context's current step (+sequence position).
+  BackendInferRequest BuildRequest();
+
+  // Synchronous send; records timing into the thread stat.
+  void SendSyncRequest();
+
+  // Asynchronous send; completion recorded on the backend's thread.
+  void SendAsyncRequest(bool delayed = false);
+
+  size_t Inflight() const { return thread_stat_->inflight.load(); }
+
+ private:
+  void Record(uint64_t start_ns, uint64_t end_ns, bool ok, bool delayed);
+
+  std::shared_ptr<ClientBackend> backend_;
+  std::shared_ptr<ModelParser> parser_;
+  std::shared_ptr<DataLoader> data_loader_;
+  std::shared_ptr<SequenceManager> sequence_manager_;
+  std::shared_ptr<ThreadStat> thread_stat_;
+  int batch_size_;
+  size_t seq_slot_ = 0;
+  std::shared_ptr<const ShmLayout> shm_layout_;
+  size_t step_ = 0;
+  uint64_t request_counter_ = 0;
+};
+
+}  // namespace pa

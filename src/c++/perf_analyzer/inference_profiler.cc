@@ -1,0 +1,255 @@
+#include "inference_profiler.h"
+
+#include <algorithm>
+#include <cmath>
+#include <thread>
+
+#include "tjson.h"
+
+namespace pa {
+
+namespace {
+
+uint64_t
+Percentile(std::vector<uint64_t>& sorted, double pct)
+{
+  if (sorted.empty()) {
+    return 0;
+  }
+  size_t idx = (size_t)std::ceil(pct / 100.0 * sorted.size());
+  if (idx > 0) {
+    --idx;
+  }
+  if (idx >= sorted.size()) {
+    idx = sorted.size() - 1;
+  }
+  return sorted[idx];
+}
+
+}  // namespace
+
+ClientSideStats
+InferenceProfiler::SummarizeRecords(
+    const std::vector<RequestRecord>& records, uint64_t window_ns)
+{
+  ClientSideStats stats;
+  std::vector<uint64_t> latencies;
+  uint64_t total = 0;
+  for (const auto& r : records) {
+    if (!r.success) {
+      stats.failed_request_count++;
+      continue;
+    }
+    if (r.delayed) {
+      stats.delayed_request_count++;
+    }
+    uint64_t lat = r.end_ns - r.start_ns;
+    latencies.push_back(lat);
+    total += lat;
+    stats.request_count++;
+  }
+  if (stats.request_count == 0) {
+    return stats;
+  }
+  std::sort(latencies.begin(), latencies.end());
+  stats.avg_latency_ns = total / stats.request_count;
+  stats.p50_ns = Percentile(latencies, 50);
+  stats.p90_ns = Percentile(latencies, 90);
+  stats.p95_ns = Percentile(latencies, 95);
+  stats.p99_ns = Percentile(latencies, 99);
+  double mean = (double)stats.avg_latency_ns;
+  double var = 0;
+  for (uint64_t lat : latencies) {
+    var += ((double)lat - mean) * ((double)lat - mean);
+  }
+  stats.std_ns = (uint64_t)std::sqrt(var / (double)latencies.size());
+  if (window_ns > 0) {
+    stats.infer_per_sec =
+        (double)stats.request_count / ((double)window_ns / 1e9);
+  }
+  return stats;
+}
+
+tc::Error
+InferenceProfiler::QueryServerStats(ServerSideStats* stats)
+{
+  *stats = ServerSideStats();
+  std::string stats_json;
+  tc::Error err =
+      backend_->ModelStatistics(&stats_json, parser_->ModelName());
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::string parse_err;
+  auto doc = tc::json::Parse(stats_json, &parse_err);
+  if (doc == nullptr) {
+    return tc::Error("failed to parse server statistics: " + parse_err);
+  }
+  auto model_stats = doc->Get("model_stats");
+  if (model_stats == nullptr || model_stats->Size() == 0) {
+    return tc::Error("no model_stats in server statistics");
+  }
+  auto entry = model_stats->At(0);
+  auto get_u64 = [](const tc::json::ValuePtr& v, const char* key) {
+    auto f = v ? v->Get(key) : nullptr;
+    return f ? (uint64_t)f->AsInt() : 0ull;
+  };
+  stats->inference_count = get_u64(entry, "inference_count");
+  stats->execution_count = get_u64(entry, "execution_count");
+  auto infer_stats = entry->Get("inference_stats");
+  if (infer_stats != nullptr) {
+    auto dur = [&](const char* key) {
+      auto d = infer_stats->Get(key);
+      return d ? get_u64(d, "ns") : 0ull;
+    };
+    stats->queue_ns = dur("queue");
+    stats->compute_input_ns = dur("compute_input");
+    stats->compute_infer_ns = dur("compute_infer");
+    stats->compute_output_ns = dur("compute_output");
+    auto success = infer_stats->Get("success");
+    stats->success_count = success ? get_u64(success, "count") : 0;
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
+InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
+{
+  std::vector<ClientSideStats> windows;
+  ServerSideStats server_begin;
+  bool have_server_stats = QueryServerStats(&server_begin).IsOk();
+  sent_in_window_ = 0;
+  manager_->GetAndResetNumSentRequests();
+  // discard completions from before this level's windows (previous
+  // level's tail, worker spin-up)
+  manager_->SwapRequestRecords();
+
+  for (size_t trial = 0;
+       trial < config_.max_trials && !early_exit.load(); ++trial) {
+    uint64_t window_start = NowNs();
+    if (config_.count_windows) {
+      // wait until the target request count completes (reference
+      // count-window measurement mode)
+      while (true) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        size_t n = 0;
+        {
+          auto err = manager_->CheckHealth();
+          if (!err.IsOk()) {
+            return err;
+          }
+        }
+        // peek without swap: approximate by time accumulation; swap below
+        if ((NowNs() - window_start) / 1000000 >=
+            config_.measurement_window_ms) {
+          break;
+        }
+        n = manager_->GetAndResetNumSentRequests();
+        sent_in_window_ += n;
+        if (sent_in_window_ >= config_.measurement_request_count) {
+          break;
+        }
+        if (early_exit.load()) {
+          break;
+        }
+      }
+      sent_in_window_ = 0;
+    } else {
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(config_.measurement_window_ms));
+    }
+    uint64_t window_ns = NowNs() - window_start;
+    auto records = manager_->SwapRequestRecords();
+    tc::Error err = manager_->CheckHealth();
+    if (!err.IsOk()) {
+      return err;
+    }
+    auto window_stats = SummarizeRecords(records, window_ns);
+    if (window_stats.request_count == 0) {
+      continue;
+    }
+    windows.push_back(window_stats);
+    if (config_.verbose) {
+      printf(
+          "  window %zu: %.1f infer/sec, avg %.0f usec\n", windows.size(),
+          window_stats.infer_per_sec,
+          window_stats.avg_latency_ns / 1e3);
+    }
+    // stability: last 3 windows within threshold on throughput + latency
+    if (windows.size() >= 3) {
+      bool stable = true;
+      const auto& last = windows[windows.size() - 1];
+      for (size_t i = windows.size() - 3; i < windows.size(); ++i) {
+        const auto& w = windows[i];
+        double tput_dev =
+            std::fabs(w.infer_per_sec - last.infer_per_sec) /
+            (last.infer_per_sec > 0 ? last.infer_per_sec : 1.0);
+        double lat_dev =
+            std::fabs(
+                (double)w.avg_latency_ns - (double)last.avg_latency_ns) /
+            (last.avg_latency_ns > 0 ? (double)last.avg_latency_ns : 1.0);
+        if (tput_dev > config_.stability_threshold_pct / 100.0 ||
+            lat_dev > config_.stability_threshold_pct / 100.0) {
+          stable = false;
+          break;
+        }
+      }
+      if (stable) {
+        status->stabilized = true;
+        break;
+      }
+    }
+  }
+  if (windows.empty()) {
+    return tc::Error(
+        "no requests completed within the measurement windows");
+  }
+  // merge the last up-to-3 windows (reference MergePerfStatusReports)
+  size_t first = windows.size() >= 3 ? windows.size() - 3 : 0;
+  ClientSideStats merged;
+  double tput_sum = 0;
+  uint64_t lat_sum = 0;
+  for (size_t i = first; i < windows.size(); ++i) {
+    const auto& w = windows[i];
+    merged.request_count += w.request_count;
+    merged.delayed_request_count += w.delayed_request_count;
+    merged.failed_request_count += w.failed_request_count;
+    tput_sum += w.infer_per_sec;
+    lat_sum += w.avg_latency_ns;
+    merged.p50_ns = w.p50_ns;  // representative: last window percentiles
+    merged.p90_ns = w.p90_ns;
+    merged.p95_ns = w.p95_ns;
+    merged.p99_ns = w.p99_ns;
+    merged.std_ns = w.std_ns;
+  }
+  size_t n = windows.size() - first;
+  merged.infer_per_sec = tput_sum / (double)n;
+  merged.avg_latency_ns = lat_sum / n;
+  status->client_stats = merged;
+
+  if (have_server_stats) {
+    ServerSideStats server_end;
+    if (QueryServerStats(&server_end).IsOk()) {
+      auto delta = [](uint64_t a, uint64_t b) {
+        return b >= a ? b - a : 0;
+      };
+      status->server_stats.inference_count =
+          delta(server_begin.inference_count, server_end.inference_count);
+      status->server_stats.execution_count =
+          delta(server_begin.execution_count, server_end.execution_count);
+      status->server_stats.queue_ns =
+          delta(server_begin.queue_ns, server_end.queue_ns);
+      status->server_stats.compute_input_ns = delta(
+          server_begin.compute_input_ns, server_end.compute_input_ns);
+      status->server_stats.compute_infer_ns = delta(
+          server_begin.compute_infer_ns, server_end.compute_infer_ns);
+      status->server_stats.compute_output_ns = delta(
+          server_begin.compute_output_ns, server_end.compute_output_ns);
+      status->server_stats.success_count =
+          delta(server_begin.success_count, server_end.success_count);
+    }
+  }
+  return tc::Error::Success;
+}
+
+}  // namespace pa

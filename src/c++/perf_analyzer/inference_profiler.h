@@ -1,0 +1,89 @@
+// Measurement machinery: windows, stability detection, percentiles,
+// server-stat deltas (reference inference_profiler.{h,cc}:97-1097).
+
+#pragma once
+
+#include <functional>
+#include <memory>
+
+#include "load_manager.h"
+
+namespace pa {
+
+struct ClientSideStats {
+  uint64_t request_count = 0;
+  uint64_t delayed_request_count = 0;
+  uint64_t failed_request_count = 0;
+  double infer_per_sec = 0.0;
+  uint64_t avg_latency_ns = 0;
+  uint64_t p50_ns = 0;
+  uint64_t p90_ns = 0;
+  uint64_t p95_ns = 0;
+  uint64_t p99_ns = 0;
+  uint64_t std_ns = 0;
+};
+
+struct ServerSideStats {
+  uint64_t inference_count = 0;
+  uint64_t execution_count = 0;
+  uint64_t queue_ns = 0;
+  uint64_t compute_input_ns = 0;
+  uint64_t compute_infer_ns = 0;
+  uint64_t compute_output_ns = 0;
+  uint64_t success_count = 0;
+};
+
+// One stable measurement at a load level (reference PerfStatus,
+// inference_profiler.h:97-162).
+struct PerfStatus {
+  size_t concurrency = 0;
+  double request_rate = 0.0;
+  ClientSideStats client_stats;
+  ServerSideStats server_stats;
+  bool on_sequence_model = false;
+  bool stabilized = false;
+};
+
+struct ProfilerConfig {
+  uint64_t measurement_window_ms = 5000;
+  // count-based windows (reference --measurement-mode count_windows)
+  bool count_windows = false;
+  uint64_t measurement_request_count = 50;
+  size_t max_trials = 10;
+  double stability_threshold_pct = 10.0;
+  bool verbose = false;
+};
+
+class InferenceProfiler {
+ public:
+  InferenceProfiler(
+      std::shared_ptr<ClientBackend> backend,
+      std::shared_ptr<ModelParser> parser, LoadManager* manager,
+      const ProfilerConfig& config)
+      : backend_(std::move(backend)), parser_(std::move(parser)),
+        manager_(manager), config_(config)
+  {
+  }
+
+  // Measure at the current load level until 3 consecutive windows agree
+  // within the stability threshold on both throughput and avg latency
+  // (reference DetermineStability, inference_profiler.cc:780-833), or
+  // max_trials windows pass.
+  tc::Error ProfileCurrentLevel(PerfStatus* status);
+
+  // Compute client stats from a window of records (public for unit tests;
+  // the reference exposes the same via friend-test hooks).
+  static ClientSideStats SummarizeRecords(
+      const std::vector<RequestRecord>& records, uint64_t window_ns);
+
+ private:
+  tc::Error QueryServerStats(ServerSideStats* stats);
+
+  std::shared_ptr<ClientBackend> backend_;
+  std::shared_ptr<ModelParser> parser_;
+  LoadManager* manager_;
+  ProfilerConfig config_;
+  size_t sent_in_window_ = 0;
+};
+
+}  // namespace pa

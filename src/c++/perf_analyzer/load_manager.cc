@@ -1,0 +1,76 @@
+#include "load_manager.h"
+
+#include <cstring>
+
+#include "shm_utils.h"
+
+namespace pa {
+
+namespace {
+const char kShmKey[] = "/pa_input_data";
+const char kShmRegion[] = "pa_input_data";
+}  // namespace
+
+tc::Error
+LoadManager::SetupSystemShm()
+{
+  // one region holding every input's step-0 payload back to back
+  // (reference InferDataManagerShm::CreateMemoryRegion)
+  auto layout = std::make_shared<ShmLayout>();
+  layout->region_name = kShmRegion;
+  size_t total = 0;
+  for (const auto& input : parser_->Inputs()) {
+    const std::vector<uint8_t>* data = nullptr;
+    tc::Error err = data_loader_->GetInputData(input.name, 0, 0, &data);
+    if (!err.IsOk()) {
+      return err;
+    }
+    layout->inputs[input.name] = {total, data->size()};
+    total += data->size();
+  }
+  if (total == 0) {
+    return tc::Error("no input data to place in shared memory");
+  }
+  tc::Error err = tc::CreateSharedMemoryRegion(kShmKey, total, &shm_fd_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  err = tc::MapSharedMemory(shm_fd_, 0, total, &shm_base_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  shm_total_ = total;
+  for (const auto& input : parser_->Inputs()) {
+    const std::vector<uint8_t>* data = nullptr;
+    data_loader_->GetInputData(input.name, 0, 0, &data);
+    auto& slot = layout->inputs[input.name];
+    memcpy((uint8_t*)shm_base_ + slot.first, data->data(), slot.second);
+  }
+  backend_->UnregisterSystemSharedMemory(kShmRegion);
+  err = backend_->RegisterSystemSharedMemory(kShmRegion, kShmKey, total);
+  if (!err.IsOk()) {
+    return err;
+  }
+  shm_layout_ = layout;
+  return tc::Error::Success;
+}
+
+void
+LoadManager::TeardownSystemShm()
+{
+  if (shm_layout_ != nullptr) {
+    backend_->UnregisterSystemSharedMemory(kShmRegion);
+    shm_layout_.reset();
+  }
+  if (shm_base_ != nullptr) {
+    tc::UnmapSharedMemory(shm_base_, shm_total_);
+    shm_base_ = nullptr;
+  }
+  if (shm_fd_ >= 0) {
+    tc::CloseSharedMemory(shm_fd_);
+    tc::UnlinkSharedMemoryRegion(kShmKey);
+    shm_fd_ = -1;
+  }
+}
+
+}  // namespace pa

@@ -1,0 +1,175 @@
+// Load-manager base: owns the data loader, workers, and the timestamp
+// plumbing the profiler swaps out each measurement window
+// (reference load_manager.{h,cc}:63-167).
+
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <thread>
+#include <vector>
+
+#include "infer_context.h"
+
+namespace pa {
+
+struct LoadManagerConfig {
+  int batch_size = 1;
+  SharedMemoryType shared_memory = SharedMemoryType::NONE;
+  bool zero_input = false;
+  std::string input_data_json;  // empty -> synthetic
+  bool async = false;
+  bool use_sequences = false;
+  size_t sequence_length = 20;
+  double sequence_length_variation = 20.0;
+  uint32_t seed = 17;
+};
+
+class LoadManager {
+ public:
+  LoadManager(
+      std::shared_ptr<ClientBackend> backend,
+      std::shared_ptr<ModelParser> parser, const LoadManagerConfig& config)
+      : backend_(std::move(backend)), parser_(std::move(parser)),
+        config_(config)
+  {
+  }
+
+  virtual ~LoadManager()
+  {
+    StopWorkers();
+    TeardownSystemShm();
+  }
+
+  tc::Error InitManager()
+  {
+    data_loader_ = std::make_shared<DataLoader>();
+    tc::Error err;
+    if (!config_.input_data_json.empty()) {
+      err = data_loader_->ReadDataFromJson(
+          parser_->Inputs(), config_.input_data_json, config_.batch_size);
+    } else {
+      err = data_loader_->GenerateData(
+          parser_->Inputs(), config_.zero_input, 1, 1, config_.batch_size,
+          config_.seed);
+    }
+    if (!err.IsOk()) {
+      return err;
+    }
+    if (config_.shared_memory == SharedMemoryType::SYSTEM) {
+      err = SetupSystemShm();
+    } else if (config_.shared_memory == SharedMemoryType::XLA) {
+      err = tc::Error(
+          "xla shared memory regions are owned by the Python "
+          "tritonclient.utils.xla_shared_memory utility (TPU HBM is not "
+          "addressable from this process); use --shared-memory system "
+          "here or the Python harness for the on-device plane");
+    }
+    return err;
+  }
+
+
+  // Swap out all accumulated request records (one measurement window).
+  std::vector<RequestRecord> SwapRequestRecords()
+  {
+    std::vector<RequestRecord> out;
+    {
+      std::lock_guard<std::mutex> lk(retired_mu_);
+      out.swap(retired_records_);
+    }
+    for (auto& stat : thread_stats_) {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      out.insert(out.end(), stat->records.begin(), stat->records.end());
+      stat->records.clear();
+    }
+    return out;
+  }
+
+  size_t GetAndResetNumSentRequests()
+  {
+    return sent_requests_.exchange(0);
+  }
+
+  tc::Error CheckHealth()
+  {
+    if (!retired_status_.IsOk()) {
+      return retired_status_;
+    }
+    for (auto& stat : thread_stats_) {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      if (!stat->status.IsOk()) {
+        return stat->status;
+      }
+    }
+    return tc::Error::Success;
+  }
+
+  virtual void StopWorkers()
+  {
+    stop_.store(true);
+    for (auto& t : threads_) {
+      if (t.joinable()) {
+        t.join();
+      }
+    }
+    threads_.clear();
+    stop_.store(false);
+    // retire the finished level's stats so window swaps and health checks
+    // stay proportional to the current level; unswapped records are kept
+    // for the next SwapRequestRecords (the profiler discards pre-window
+    // leftovers itself at each level start)
+    for (auto& stat : thread_stats_) {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      if (!stat->status.IsOk()) {
+        retired_status_ = stat->status;
+      }
+      std::lock_guard<std::mutex> lk2(retired_mu_);
+      retired_records_.insert(
+          retired_records_.end(), stat->records.begin(),
+          stat->records.end());
+      stat->records.clear();
+    }
+    thread_stats_.clear();
+  }
+
+ protected:
+  tc::Error SetupSystemShm();
+  void TeardownSystemShm();
+
+  std::shared_ptr<InferContext> MakeContext(size_t seq_slot)
+  {
+    auto stat = std::make_shared<ThreadStat>();
+    thread_stats_.push_back(stat);
+    std::shared_ptr<SequenceManager> seq;
+    if (config_.use_sequences) {
+      if (sequence_manager_ == nullptr) {
+        sequence_manager_ = std::make_shared<SequenceManager>(
+            64, config_.sequence_length,
+            config_.sequence_length_variation, config_.seed);
+      }
+      seq = sequence_manager_;
+    }
+    return std::make_shared<InferContext>(
+        backend_, parser_, data_loader_, seq, stat, config_.batch_size,
+        seq_slot, shm_layout_);
+  }
+
+  std::shared_ptr<ClientBackend> backend_;
+  std::shared_ptr<ModelParser> parser_;
+  LoadManagerConfig config_;
+  std::shared_ptr<DataLoader> data_loader_;
+  std::shared_ptr<SequenceManager> sequence_manager_;
+  std::vector<std::shared_ptr<ThreadStat>> thread_stats_;
+  std::vector<std::thread> threads_;
+  std::atomic<bool> stop_{false};
+  std::atomic<size_t> sent_requests_{0};
+  std::shared_ptr<ShmLayout> shm_layout_;
+  std::mutex retired_mu_;
+  std::vector<RequestRecord> retired_records_;
+  tc::Error retired_status_ = tc::Error::Success;
+  void* shm_base_ = nullptr;
+  int shm_fd_ = -1;
+  size_t shm_total_ = 0;
+};
+
+}  // namespace pa

@@ -1,0 +1,58 @@
+// perf_analyzer entry point (reference main.cc:31-46): two-stage SIGINT —
+// first Ctrl-C requests a graceful drain, second aborts.
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <iostream>
+
+#include "perf_analyzer.h"
+
+namespace {
+
+void
+SignalHandler(int)
+{
+  if (pa::early_exit.load()) {
+    _exit(130);
+  }
+  pa::early_exit.store(true);
+  fprintf(stderr, "\nsignal received: finishing current measurement "
+                  "(Ctrl-C again to abort)\n");
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  pa::PerfAnalyzerParameters params;
+  std::string error;
+  if (!pa::CLParser::Parse(argc, argv, &params, &error)) {
+    std::cerr << "error: " << error << "\n" << pa::CLParser::Usage();
+    return 1;
+  }
+  if (params.usage_requested) {
+    std::cout << pa::CLParser::Usage();
+    return 0;
+  }
+  signal(SIGINT, SignalHandler);
+
+  pa::PerfAnalyzer analyzer(params);
+  tc::Error err = analyzer.CreateAnalyzerObjects();
+  if (!err.IsOk()) {
+    std::cerr << "error: " << err << std::endl;
+    return 1;
+  }
+  err = analyzer.Profile();
+  if (!err.IsOk()) {
+    std::cerr << "error: " << err << std::endl;
+    return 1;
+  }
+  err = analyzer.WriteReport();
+  if (!err.IsOk()) {
+    std::cerr << "error: " << err << std::endl;
+    return 1;
+  }
+  return 0;
+}

@@ -1,0 +1,188 @@
+// In-process fake server for unit tests: configurable delays/failures,
+// async responses on detached threads, call accounting
+// (reference client_backend/mock_client_backend.h:126-589 — the pattern
+// that lets the whole load-generation stack be tested with no server).
+
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "client_backend.h"
+
+namespace pa {
+
+class MockClientBackend : public ClientBackend {
+ public:
+  struct Config {
+    uint64_t response_delay_us = 0;
+    // per-call statuses consumed round-robin; empty = always success
+    std::vector<bool> return_statuses;
+    std::string metadata_json =
+        "{\"name\":\"mock\",\"inputs\":[{\"name\":\"INPUT0\","
+        "\"datatype\":\"INT32\",\"shape\":[16]}],"
+        "\"outputs\":[{\"name\":\"OUTPUT0\",\"datatype\":\"INT32\","
+        "\"shape\":[16]}]}";
+    std::string config_json =
+        "{\"name\":\"mock\",\"max_batch_size\":8}";
+  };
+
+  MockClientBackend();
+  explicit MockClientBackend(Config config);
+
+  ~MockClientBackend() override
+  {
+    // drain detached async responders
+    while (async_inflight_.load() > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    *ready = true;
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string&,
+      const std::string&) override
+  {
+    *metadata_json = config_.metadata_json;
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string&,
+      const std::string&) override
+  {
+    *config_json = config_.config_json;
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string&) override
+  {
+    size_t count = stats_.infer_calls + stats_.async_infer_calls;
+    *stats_json =
+        "{\"model_stats\":[{\"name\":\"mock\",\"inference_count\":" +
+        std::to_string(count) +
+        ",\"execution_count\":" + std::to_string(count) +
+        ",\"inference_stats\":{\"success\":{\"count\":" +
+        std::to_string(count) +
+        ",\"ns\":1000},\"queue\":{\"count\":1,\"ns\":100},"
+        "\"compute_input\":{\"count\":1,\"ns\":100},"
+        "\"compute_infer\":{\"count\":1,\"ns\":700},"
+        "\"compute_output\":{\"count\":1,\"ns\":100}}}]}";
+    return tc::Error::Success;
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stats_.infer_calls++;
+      RecordSequence(request);
+    }
+    if (config_.response_delay_us > 0) {
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(config_.response_delay_us));
+    }
+    result->status = NextStatus();
+    result->request_id = request.request_id;
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback, const BackendInferRequest& request) override
+  {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stats_.async_infer_calls++;
+      RecordSequence(request);
+    }
+    async_inflight_++;
+    uint64_t delay_us = config_.response_delay_us;
+    auto status = NextStatus();
+    std::string request_id = request.request_id;
+    std::thread([this, callback, delay_us, status, request_id] {
+      if (delay_us > 0) {
+        std::this_thread::sleep_for(std::chrono::microseconds(delay_us));
+      }
+      BackendInferResult result;
+      result.status = status;
+      result.request_id = request_id;
+      callback(std::move(result));
+      async_inflight_--;
+    }).detach();
+    return tc::Error::Success;
+  }
+
+  tc::Error RegisterSystemSharedMemory(
+      const std::string&, const std::string&, size_t) override
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stats_.shm_register_calls++;
+    return tc::Error::Success;
+  }
+
+  BackendStats Stats() override
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    return stats_;
+  }
+
+  // sequence correctness accounting: (id, start, end) per request
+  struct SeqRecord {
+    uint64_t id;
+    bool start;
+    bool end;
+  };
+  std::vector<SeqRecord> SequenceRecords()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    return seq_records_;
+  }
+
+ private:
+  void RecordSequence(const BackendInferRequest& request)
+  {
+    if (request.sequence_id != 0) {
+      seq_records_.push_back(
+          {request.sequence_id, request.sequence_start,
+           request.sequence_end});
+    }
+  }
+
+  tc::Error NextStatus()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (config_.return_statuses.empty()) {
+      return tc::Error::Success;
+    }
+    bool ok = config_.return_statuses[status_cursor_ %
+                                      config_.return_statuses.size()];
+    status_cursor_++;
+    return ok ? tc::Error::Success : tc::Error("mock failure");
+  }
+
+  Config config_;
+  std::mutex mu_;
+  BackendStats stats_;
+  std::vector<SeqRecord> seq_records_;
+  size_t status_cursor_ = 0;
+  std::atomic<int> async_inflight_{0};
+};
+
+inline MockClientBackend::MockClientBackend() : config_(Config()) {}
+inline MockClientBackend::MockClientBackend(Config config)
+    : config_(std::move(config))
+{
+}
+
+}  // namespace pa

@@ -1,0 +1,84 @@
+#include "model_parser.h"
+
+namespace pa {
+
+namespace {
+
+std::vector<ModelTensor>
+ParseTensors(const tc::json::ValuePtr& arr, bool strip_batch, int max_batch)
+{
+  std::vector<ModelTensor> out;
+  if (arr == nullptr) {
+    return out;
+  }
+  for (const auto& t : arr->Elements()) {
+    ModelTensor tensor;
+    auto name = t->Get("name");
+    auto datatype = t->Get("datatype");
+    auto shape = t->Get("shape");
+    tensor.name = name ? name->AsString() : "";
+    tensor.datatype = datatype ? datatype->AsString() : "FP32";
+    if (shape != nullptr) {
+      for (const auto& d : shape->Elements()) {
+        tensor.shape.push_back(d->AsInt());
+      }
+    }
+    // metadata shapes include the batch dim for batching models
+    if (strip_batch && max_batch > 0 && !tensor.shape.empty()) {
+      tensor.shape.erase(tensor.shape.begin());
+    }
+    out.push_back(std::move(tensor));
+  }
+  return out;
+}
+
+}  // namespace
+
+tc::Error
+ModelParser::Init(
+    ClientBackend* backend, const std::string& model_name,
+    const std::string& model_version)
+{
+  model_name_ = model_name;
+  model_version_ = model_version;
+
+  std::string config_json;
+  tc::Error err =
+      backend->ModelConfig(&config_json, model_name, model_version);
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::string parse_err;
+  auto config = tc::json::Parse(config_json, &parse_err);
+  if (config == nullptr) {
+    return tc::Error("failed to parse model config: " + parse_err);
+  }
+  auto mbs = config->Get("max_batch_size");
+  max_batch_size_ = mbs ? (int)mbs->AsInt() : 0;
+  if (config->Has("ensemble_scheduling")) {
+    scheduler_ = SchedulerType::ENSEMBLE;
+  } else if (config->Has("sequence_batching")) {
+    scheduler_ = SchedulerType::SEQUENCE;
+  } else if (config->Has("dynamic_batching")) {
+    scheduler_ = SchedulerType::DYNAMIC;
+  }
+  auto txn = config->Get("model_transaction_policy");
+  if (txn != nullptr && txn->Get("decoupled") != nullptr) {
+    decoupled_ = txn->Get("decoupled")->AsBool();
+  }
+
+  std::string metadata_json;
+  err = backend->ModelMetadata(&metadata_json, model_name, model_version);
+  if (!err.IsOk()) {
+    return err;
+  }
+  auto metadata = tc::json::Parse(metadata_json, &parse_err);
+  if (metadata == nullptr) {
+    return tc::Error("failed to parse model metadata: " + parse_err);
+  }
+  inputs_ = ParseTensors(metadata->Get("inputs"), false, max_batch_size_);
+  outputs_ = ParseTensors(metadata->Get("outputs"), false, max_batch_size_);
+  return tc::Error::Success;
+}
+
+}  // namespace pa

@@ -1,0 +1,70 @@
+// Digest model metadata/config JSON into the harness's view of the model
+// (reference model_parser.{h,cc}:39-142).
+
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_backend.h"
+#include "tjson.h"
+
+namespace pa {
+
+struct ModelTensor {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> shape;  // without batch dim
+  bool is_shape_dynamic() const
+  {
+    for (int64_t d : shape) {
+      if (d < 0) {
+        return true;
+      }
+    }
+    return false;
+  }
+};
+
+enum class SchedulerType { NONE, DYNAMIC, SEQUENCE, ENSEMBLE };
+
+class ModelParser {
+ public:
+  tc::Error Init(
+      ClientBackend* backend, const std::string& model_name,
+      const std::string& model_version);
+
+  const std::string& ModelName() const { return model_name_; }
+  const std::string& ModelVersion() const { return model_version_; }
+  int MaxBatchSize() const { return max_batch_size_; }
+  SchedulerType Scheduler() const { return scheduler_; }
+  bool IsDecoupled() const { return decoupled_; }
+  const std::vector<ModelTensor>& Inputs() const { return inputs_; }
+  const std::vector<ModelTensor>& Outputs() const { return outputs_; }
+
+  // direct init for tests (no backend round-trip)
+  void InitDirect(
+      const std::string& name, int max_batch_size,
+      std::vector<ModelTensor> inputs, std::vector<ModelTensor> outputs,
+      SchedulerType scheduler = SchedulerType::NONE)
+  {
+    model_name_ = name;
+    max_batch_size_ = max_batch_size;
+    inputs_ = std::move(inputs);
+    outputs_ = std::move(outputs);
+    scheduler_ = scheduler;
+  }
+
+ private:
+  std::string model_name_;
+  std::string model_version_;
+  int max_batch_size_ = 0;
+  SchedulerType scheduler_ = SchedulerType::NONE;
+  bool decoupled_ = false;
+  std::vector<ModelTensor> inputs_;
+  std::vector<ModelTensor> outputs_;
+};
+
+}  // namespace pa

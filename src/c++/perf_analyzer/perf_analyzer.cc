@@ -1,0 +1,175 @@
+#include "perf_analyzer.h"
+
+#include <fstream>
+#include <sstream>
+
+namespace pa {
+
+namespace {
+
+tc::Error
+ReadFile(const std::string& path, std::string* contents)
+{
+  std::ifstream f(path);
+  if (!f) {
+    return tc::Error("unable to read file " + path);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  *contents = ss.str();
+  return tc::Error::Success;
+}
+
+}  // namespace
+
+tc::Error
+PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
+{
+  if (backend != nullptr) {
+    backend_ = backend;
+  } else {
+    BackendFactoryConfig config;
+    config.kind = params_.kind;
+    config.url = params_.url;
+    config.verbose = params_.verbose;
+    tc::Error err = ClientBackendFactory::Create(&backend_, config);
+    if (!err.IsOk()) {
+      return err;
+    }
+  }
+
+  parser_ = std::make_shared<ModelParser>();
+  tc::Error err = parser_->Init(
+      backend_.get(), params_.model_name, params_.model_version);
+  if (!err.IsOk()) {
+    return err;
+  }
+  if (parser_->Scheduler() == SchedulerType::SEQUENCE &&
+      !params_.use_sequences) {
+    params_.use_sequences = true;
+  }
+
+  LoadManagerConfig lm_config;
+  lm_config.batch_size = params_.batch_size;
+  lm_config.shared_memory = params_.shared_memory;
+  lm_config.zero_input = params_.zero_input;
+  lm_config.async = params_.async;
+  lm_config.use_sequences = params_.use_sequences;
+  lm_config.sequence_length = params_.sequence_length;
+  lm_config.sequence_length_variation =
+      params_.sequence_length_variation;
+  lm_config.seed = params_.seed;
+  if (!params_.input_data_path.empty()) {
+    err = ReadFile(params_.input_data_path, &lm_config.input_data_json);
+    if (!err.IsOk()) {
+      return err;
+    }
+  }
+
+  if (!params_.request_intervals_path.empty()) {
+    auto* mgr = new CustomLoadManager(
+        backend_, parser_, lm_config, params_.request_distribution,
+        params_.num_threads);
+    manager_.reset(mgr);
+  } else if (params_.request_rate_start > 0) {
+    manager_.reset(new RequestRateManager(
+        backend_, parser_, lm_config, params_.request_distribution,
+        params_.num_threads));
+  } else {
+    manager_.reset(new ConcurrencyManager(backend_, parser_, lm_config));
+  }
+  err = manager_->InitManager();
+  if (!err.IsOk()) {
+    return err;
+  }
+
+  ProfilerConfig prof_config;
+  prof_config.measurement_window_ms = params_.measurement_window_ms;
+  prof_config.count_windows = params_.count_windows;
+  prof_config.measurement_request_count =
+      params_.measurement_request_count;
+  prof_config.max_trials = params_.max_trials;
+  prof_config.stability_threshold_pct = params_.stability_threshold_pct;
+  prof_config.verbose = params_.verbose;
+  profiler_.reset(new InferenceProfiler(
+      backend_, parser_, manager_.get(), prof_config));
+  return tc::Error::Success;
+}
+
+tc::Error
+PerfAnalyzer::Profile()
+{
+  if (!params_.request_intervals_path.empty()) {
+    auto* mgr = static_cast<CustomLoadManager*>(manager_.get());
+    std::string intervals;
+    tc::Error err = ReadFile(params_.request_intervals_path, &intervals);
+    if (!err.IsOk()) {
+      return err;
+    }
+    err = mgr->InitCustomIntervals(intervals);
+    if (!err.IsOk()) {
+      return err;
+    }
+    PerfStatus status;
+    err = profiler_->ProfileCurrentLevel(&status);
+    mgr->StopWorkers();
+    if (!err.IsOk()) {
+      return err;
+    }
+    results_.push_back(status);
+    return tc::Error::Success;
+  }
+  if (params_.request_rate_start > 0) {
+    auto* mgr = static_cast<RequestRateManager*>(manager_.get());
+    for (double rate = params_.request_rate_start;
+         rate <= params_.request_rate_end + 1e-9 && !early_exit.load();
+         rate += params_.request_rate_step) {
+      tc::Error err = mgr->ChangeRequestRate(rate);
+      if (!err.IsOk()) {
+        return err;
+      }
+      PerfStatus status;
+      status.request_rate = rate;
+      err = profiler_->ProfileCurrentLevel(&status);
+      if (!err.IsOk()) {
+        mgr->StopWorkers();
+        return err;
+      }
+      results_.push_back(status);
+    }
+    mgr->StopWorkers();
+    return tc::Error::Success;
+  }
+  auto* mgr = static_cast<ConcurrencyManager*>(manager_.get());
+  for (size_t conc = params_.concurrency_start;
+       conc <= params_.concurrency_end && !early_exit.load();
+       conc += params_.concurrency_step) {
+    tc::Error err = mgr->ChangeConcurrencyLevel(conc);
+    if (!err.IsOk()) {
+      return err;
+    }
+    PerfStatus status;
+    status.concurrency = conc;
+    err = profiler_->ProfileCurrentLevel(&status);
+    if (!err.IsOk()) {
+      mgr->StopWorkers();
+      return err;
+    }
+    results_.push_back(status);
+  }
+  mgr->StopWorkers();
+  return tc::Error::Success;
+}
+
+tc::Error
+PerfAnalyzer::WriteReport()
+{
+  ReportWriter::WriteSummary(results_, ConcurrencyMode());
+  if (!params_.latency_report_file.empty()) {
+    return ReportWriter::WriteCsvFile(
+        params_.latency_report_file, results_, ConcurrencyMode());
+  }
+  return tc::Error::Success;
+}
+
+}  // namespace pa

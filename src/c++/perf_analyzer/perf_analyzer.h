@@ -1,0 +1,51 @@
+// Orchestrator: factory -> parser -> load manager -> profiler -> report
+// (reference perf_analyzer.{h,cc}:70-425).
+
+#pragma once
+
+#include <memory>
+
+#include "command_line_parser.h"
+#include "concurrency_manager.h"
+#include "inference_profiler.h"
+#include "report_writer.h"
+#include "request_rate_manager.h"
+
+namespace pa {
+
+class PerfAnalyzer {
+ public:
+  explicit PerfAnalyzer(const PerfAnalyzerParameters& params)
+      : params_(params)
+  {
+  }
+
+  // Build backend/parser/manager/profiler (reference
+  // CreateAnalyzerObjects); a pre-built backend may be injected (tests).
+  tc::Error CreateAnalyzerObjects(
+      std::shared_ptr<ClientBackend> backend = nullptr);
+
+  // Sweep the load range, profiling each level (reference Profile).
+  tc::Error Profile();
+
+  // Summaries to stdout (+ CSV when requested).
+  tc::Error WriteReport();
+
+  const std::vector<PerfStatus>& Results() const { return results_; }
+
+ private:
+  bool ConcurrencyMode() const
+  {
+    return params_.request_rate_start <= 0 &&
+           params_.request_intervals_path.empty();
+  }
+
+  PerfAnalyzerParameters params_;
+  std::shared_ptr<ClientBackend> backend_;
+  std::shared_ptr<ModelParser> parser_;
+  std::unique_ptr<LoadManager> manager_;
+  std::unique_ptr<InferenceProfiler> profiler_;
+  std::vector<PerfStatus> results_;
+};
+
+}  // namespace pa

@@ -1,0 +1,46 @@
+#include "perf_utils.h"
+
+#include <chrono>
+
+namespace pa {
+
+std::atomic<bool> early_exit{false};
+
+uint64_t
+NowNs()
+{
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int64_t
+ByteSize(const std::string& datatype)
+{
+  if (datatype == "BOOL" || datatype == "INT8" || datatype == "UINT8") {
+    return 1;
+  }
+  if (datatype == "INT16" || datatype == "UINT16" || datatype == "FP16" ||
+      datatype == "BF16") {
+    return 2;
+  }
+  if (datatype == "INT32" || datatype == "UINT32" || datatype == "FP32") {
+    return 4;
+  }
+  if (datatype == "INT64" || datatype == "UINT64" || datatype == "FP64") {
+    return 8;
+  }
+  return -1;  // BYTES
+}
+
+int64_t
+ElementCount(const std::vector<int64_t>& shape)
+{
+  int64_t count = 1;
+  for (int64_t d : shape) {
+    count *= (d < 0 ? 1 : d);
+  }
+  return count;
+}
+
+}  // namespace pa

@@ -1,0 +1,61 @@
+// Shared enums + helpers (reference src/c++/perf_analyzer/perf_utils.h:56-155).
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace pa {
+
+enum class BackendKind { TRITON_HTTP, TRITON_GRPC, MOCK };
+enum class SharedMemoryType { NONE, SYSTEM, XLA };
+enum class Distribution { POISSON, CONSTANT };
+
+// Two-stage SIGINT support: set by the signal handler, polled by the
+// profiler loops so the current measurement drains and the report still
+// writes (reference perf_analyzer.cc:39-53).
+extern std::atomic<bool> early_exit;
+
+// nanosecond steady-clock timestamp
+uint64_t NowNs();
+
+// bytes per element for a wire datatype; -1 for BYTES (variable)
+int64_t ByteSize(const std::string& datatype);
+
+// total element count of a shape (dynamic dims treated as 1)
+int64_t ElementCount(const std::vector<int64_t>& shape);
+
+// Inter-request interval generator (reference perf_utils.h:152-155):
+// POISSON draws exponential gaps around the target rate, CONSTANT is the
+// fixed reciprocal.
+class ScheduleDistribution {
+ public:
+  ScheduleDistribution(Distribution dist, double rate_per_sec, uint32_t seed)
+      : dist_(dist), rate_(rate_per_sec), rng_(seed),
+        exp_(rate_per_sec > 0 ? rate_per_sec : 1.0)
+  {
+  }
+
+  // next inter-request gap in nanoseconds
+  uint64_t NextGapNs()
+  {
+    if (rate_ <= 0) {
+      return 0;
+    }
+    if (dist_ == Distribution::CONSTANT) {
+      return (uint64_t)(1e9 / rate_);
+    }
+    return (uint64_t)(exp_(rng_) * 1e9);
+  }
+
+ private:
+  Distribution dist_;
+  double rate_;
+  std::mt19937 rng_;
+  std::exponential_distribution<double> exp_;
+};
+
+}  // namespace pa

@@ -1,0 +1,111 @@
+#include "report_writer.h"
+
+#include <cstdio>
+#include <fstream>
+#include <sstream>
+
+namespace pa {
+
+void
+ReportWriter::WriteSummary(
+    const std::vector<PerfStatus>& results, bool concurrency_mode)
+{
+  for (const auto& status : results) {
+    const auto& c = status.client_stats;
+    if (concurrency_mode) {
+      printf("Request concurrency: %zu\n", status.concurrency);
+    } else {
+      printf("Request rate: %.2f\n", status.request_rate);
+    }
+    printf("  Client:\n");
+    printf("    Request count: %llu\n",
+           (unsigned long long)c.request_count);
+    printf("    Throughput: %.4g infer/sec\n", c.infer_per_sec);
+    if (c.delayed_request_count > 0) {
+      printf("    Delayed Request Count: %llu\n",
+             (unsigned long long)c.delayed_request_count);
+    }
+    if (c.failed_request_count > 0) {
+      printf("    Failed request count: %llu\n",
+             (unsigned long long)c.failed_request_count);
+    }
+    printf("    Avg latency: %llu usec (standard deviation %llu usec)\n",
+           (unsigned long long)(c.avg_latency_ns / 1000),
+           (unsigned long long)(c.std_ns / 1000));
+    printf("    p50 latency: %llu usec\n",
+           (unsigned long long)(c.p50_ns / 1000));
+    printf("    p90 latency: %llu usec\n",
+           (unsigned long long)(c.p90_ns / 1000));
+    printf("    p95 latency: %llu usec\n",
+           (unsigned long long)(c.p95_ns / 1000));
+    printf("    p99 latency: %llu usec\n",
+           (unsigned long long)(c.p99_ns / 1000));
+    const auto& s = status.server_stats;
+    if (s.inference_count > 0) {
+      uint64_t n = s.success_count > 0 ? s.success_count : 1;
+      printf("  Server:\n");
+      printf("    Inference count: %llu\n",
+             (unsigned long long)s.inference_count);
+      printf("    Execution count: %llu\n",
+             (unsigned long long)s.execution_count);
+      printf(
+          "    Avg request latency: queue %llu usec, compute input %llu "
+          "usec, compute infer %llu usec, compute output %llu usec\n",
+          (unsigned long long)(s.queue_ns / n / 1000),
+          (unsigned long long)(s.compute_input_ns / n / 1000),
+          (unsigned long long)(s.compute_infer_ns / n / 1000),
+          (unsigned long long)(s.compute_output_ns / n / 1000));
+    }
+    printf("\n");
+  }
+}
+
+std::string
+ReportWriter::GenerateCsv(
+    const std::vector<PerfStatus>& results, bool concurrency_mode)
+{
+  std::ostringstream out;
+  out << (concurrency_mode ? "Concurrency" : "Request Rate")
+      << ",Inferences/Second,Client Send,"
+      << "Network+Server Send/Recv,Server Queue,Server Compute Input,"
+      << "Server Compute Infer,Server Compute Output,Client Recv,"
+      << "p50 latency,p90 latency,p95 latency,p99 latency\n";
+  for (const auto& status : results) {
+    const auto& c = status.client_stats;
+    const auto& s = status.server_stats;
+    uint64_t n = s.success_count > 0 ? s.success_count : 1;
+    uint64_t server_total_us = (s.queue_ns + s.compute_input_ns +
+                                s.compute_infer_ns + s.compute_output_ns) /
+                               n / 1000;
+    uint64_t avg_us = c.avg_latency_ns / 1000;
+    uint64_t network_us =
+        avg_us > server_total_us ? avg_us - server_total_us : 0;
+    if (concurrency_mode) {
+      out << status.concurrency;
+    } else {
+      out << status.request_rate;
+    }
+    out << "," << c.infer_per_sec << ",0," << network_us << ","
+        << (s.queue_ns / n / 1000) << "," << (s.compute_input_ns / n / 1000)
+        << "," << (s.compute_infer_ns / n / 1000) << ","
+        << (s.compute_output_ns / n / 1000) << ",0,"
+        << (c.p50_ns / 1000) << "," << (c.p90_ns / 1000) << ","
+        << (c.p95_ns / 1000) << "," << (c.p99_ns / 1000) << "\n";
+  }
+  return out.str();
+}
+
+tc::Error
+ReportWriter::WriteCsvFile(
+    const std::string& path, const std::vector<PerfStatus>& results,
+    bool concurrency_mode)
+{
+  std::ofstream f(path);
+  if (!f) {
+    return tc::Error("unable to open csv file " + path);
+  }
+  f << GenerateCsv(results, concurrency_mode);
+  return tc::Error::Success;
+}
+
+}  // namespace pa

@@ -1,0 +1,28 @@
+// stdout summary + CSV export (reference report_writer.cc:73-260).
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "inference_profiler.h"
+
+namespace pa {
+
+class ReportWriter {
+ public:
+  // Print the reference-style per-level summary block.
+  static void WriteSummary(
+      const std::vector<PerfStatus>& results, bool concurrency_mode);
+
+  // CSV with the reference's column schema
+  // (docs/measurements_metrics.md:103).
+  static std::string GenerateCsv(
+      const std::vector<PerfStatus>& results, bool concurrency_mode);
+
+  static tc::Error WriteCsvFile(
+      const std::string& path, const std::vector<PerfStatus>& results,
+      bool concurrency_mode);
+};
+
+}  // namespace pa

@@ -1,0 +1,135 @@
+// Request-rate mode: fire requests on a precomputed schedule
+// (reference request_rate_manager.{h,cc}, rate_schedule.h,
+// request_rate_worker.cc:102-119).
+
+#pragma once
+
+#include <cmath>
+
+#include "load_manager.h"
+
+namespace pa {
+
+class RequestRateManager : public LoadManager {
+ public:
+  RequestRateManager(
+      std::shared_ptr<ClientBackend> backend,
+      std::shared_ptr<ModelParser> parser, const LoadManagerConfig& config,
+      Distribution distribution = Distribution::CONSTANT,
+      size_t num_threads = 2)
+      : LoadManager(std::move(backend), std::move(parser), config),
+        distribution_(distribution), num_threads_(num_threads)
+  {
+  }
+
+  // Rebuild the schedule for `rate` requests/sec and restart workers
+  // (reference ChangeRequestRate / GenerateSchedule).
+  tc::Error ChangeRequestRate(double rate)
+  {
+    StopWorkers();
+    GenerateSchedule(rate);
+    StartWorkers();
+    return tc::Error::Success;
+  }
+
+  // For CustomLoadManager: replay explicit inter-request intervals.
+  tc::Error SetScheduleFromIntervals(
+      const std::vector<uint64_t>& intervals_ns)
+  {
+    StopWorkers();
+    schedule_ = intervals_ns;
+    StartWorkers();
+    return tc::Error::Success;
+  }
+
+  const std::vector<uint64_t>& Schedule() const { return schedule_; }
+
+ protected:
+  void GenerateSchedule(double rate)
+  {
+    // one cycle of gaps, replayed round-robin (reference RateSchedule)
+    schedule_.clear();
+    ScheduleDistribution dist(distribution_, rate, config_.seed);
+    size_t entries = (size_t)std::max(8.0, std::ceil(rate));
+    for (size_t i = 0; i < entries; ++i) {
+      schedule_.push_back(dist.NextGapNs());
+    }
+  }
+
+  void StartWorkers()
+  {
+    // worker w fires schedule slots w, w+N, w+2N... against its own
+    // context (async so one slow response can't stall the schedule)
+    start_ns_ = NowNs();
+    for (size_t w = 0; w < num_threads_; ++w) {
+      auto ctx = MakeContext(w);
+      threads_.emplace_back([this, ctx, w] {
+        uint64_t next = start_ns_;
+        size_t slot = 0;
+        // accumulate gaps for slots below our first
+        for (size_t i = 0; i < w && !schedule_.empty(); ++i) {
+          next += schedule_[slot % schedule_.size()];
+          ++slot;
+        }
+        while (!stop_.load(std::memory_order_relaxed)) {
+          uint64_t now = NowNs();
+          bool delayed = now > next + 2000000;  // >2ms behind schedule
+          if (now < next) {
+            // SleepIfNecessary (reference request_rate_worker.cc:102)
+            std::this_thread::sleep_for(
+                std::chrono::nanoseconds(next - now));
+          }
+          if (stop_.load(std::memory_order_relaxed)) {
+            break;
+          }
+          ctx->SendAsyncRequest(delayed);
+          sent_requests_++;
+          for (size_t i = 0; i < num_threads_ && !schedule_.empty();
+               ++i) {
+            next += schedule_[slot % schedule_.size()];
+            ++slot;
+          }
+        }
+      });
+    }
+  }
+
+  Distribution distribution_;
+  size_t num_threads_;
+  std::vector<uint64_t> schedule_;
+  uint64_t start_ns_ = 0;
+};
+
+//==============================================================================
+// Custom-interval mode: replay a user-supplied intervals file
+// (reference custom_load_manager.{h,cc}).
+class CustomLoadManager : public RequestRateManager {
+ public:
+  using RequestRateManager::RequestRateManager;
+
+  tc::Error InitCustomIntervals(const std::string& intervals_text)
+  {
+    // file of one interval per line, in microseconds
+    std::vector<uint64_t> intervals;
+    size_t pos = 0;
+    while (pos < intervals_text.size()) {
+      size_t eol = intervals_text.find('\n', pos);
+      if (eol == std::string::npos) {
+        eol = intervals_text.size();
+      }
+      std::string line = intervals_text.substr(pos, eol - pos);
+      pos = eol + 1;
+      if (line.empty()) {
+        continue;
+      }
+      intervals.push_back((uint64_t)strtoull(line.c_str(), nullptr, 10) *
+                          1000ull);
+    }
+    if (intervals.empty()) {
+      return tc::Error("no intervals found in custom intervals data");
+    }
+    return SetScheduleFromIntervals(intervals);
+  }
+};
+
+}  // namespace pa

@@ -1,0 +1,104 @@
+// Sequence-id allocation and per-request sequence flags for stateful
+// sequence models (reference sequence_manager.{h,cc}:46-210).
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <random>
+#include <vector>
+
+namespace pa {
+
+class SequenceManager {
+ public:
+  // `concurrent` independent sequences; each restarts after
+  // `sequence_length` (+- variation pct) requests.
+  SequenceManager(
+      size_t concurrent, size_t sequence_length,
+      double length_variation_pct = 0.0, uint32_t seed = 33)
+      : states_(concurrent), base_length_(sequence_length),
+        variation_pct_(length_variation_pct), rng_(seed)
+  {
+    for (size_t i = 0; i < states_.size(); ++i) {
+      states_[i].id = next_id_++;
+      states_[i].remaining = DrawLength();
+      states_[i].drawn = states_[i].remaining;
+    }
+  }
+
+  struct Flags {
+    uint64_t sequence_id;
+    bool start;
+    bool end;
+  };
+
+  // Advance sequence slot `slot` by one request.
+  Flags Next(size_t slot)
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto& st = states_[slot % states_.size()];
+    Flags flags;
+    flags.start = (st.remaining == DrawnLengthOf(st));
+    st.remaining--;
+    flags.end = (st.remaining == 0);
+    flags.sequence_id = st.id;
+    if (flags.end) {
+      st.id = next_id_++;
+      st.remaining = DrawLength();
+      st.drawn = st.remaining;
+    }
+    return flags;
+  }
+
+  // Force-close all open sequences; returns flags for each still-open one
+  // (reference CompleteOngoingSequences, concurrency_worker.cc:206-215).
+  std::vector<Flags> CompleteOngoing()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<Flags> out;
+    for (auto& st : states_) {
+      if (st.remaining != DrawnLengthOf(st)) {
+        out.push_back({st.id, false, true});
+        st.id = next_id_++;
+        st.remaining = DrawLength();
+        st.drawn = st.remaining;
+      }
+    }
+    return out;
+  }
+
+ private:
+  struct State {
+    uint64_t id = 0;
+    size_t remaining = 0;
+    size_t drawn = 0;
+  };
+
+  size_t DrawLength()
+  {
+    if (variation_pct_ <= 0.0) {
+      return base_length_;
+    }
+    double lo = base_length_ * (1.0 - variation_pct_ / 100.0);
+    double hi = base_length_ * (1.0 + variation_pct_ / 100.0);
+    std::uniform_real_distribution<double> dist(lo, hi);
+    size_t len = (size_t)dist(rng_);
+    return len == 0 ? 1 : len;
+  }
+
+  size_t DrawnLengthOf(const State& st)
+  {
+    return st.drawn != 0 ? st.drawn : base_length_;
+  }
+
+  std::mutex mu_;
+  std::vector<State> states_;
+  size_t base_length_;
+  double variation_pct_;
+  std::mt19937 rng_;
+  uint64_t next_id_ = 1;
+};
+
+}  // namespace pa

@@ -1,0 +1,381 @@
+// perf_analyzer unit tests, mock-backend-first: everything runs without a
+// server (role of the reference's doctest suite,
+// perf_analyzer_unit_tests.cc:37-39 + test_*.cc).
+
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <set>
+
+#include "command_line_parser.h"
+#include "concurrency_manager.h"
+#include "inference_profiler.h"
+#include "mock_client_backend.h"
+#include "perf_analyzer.h"
+#include "report_writer.h"
+#include "request_rate_manager.h"
+
+static int failures = 0;
+static int checks = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    ++checks;                                                         \
+    if (!(cond)) {                                                    \
+      ++failures;                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    }                                                                 \
+  } while (0)
+
+using namespace pa;
+
+// -- CLI parsing (reference test_command_line_parser.cc) --------------------
+
+static void
+TestCliDefaults()
+{
+  const char* argv[] = {"perf_analyzer", "-m", "simple"};
+  PerfAnalyzerParameters params;
+  std::string error;
+  CHECK(CLParser::Parse(3, (char**)argv, &params, &error));
+  CHECK(params.model_name == "simple");
+  CHECK(params.url == "localhost:8000");
+  CHECK(params.batch_size == 1);
+  CHECK(params.measurement_window_ms == 5000);
+  CHECK(params.stability_threshold_pct == 10.0);
+  CHECK(params.concurrency_start == 1 && params.concurrency_end == 1);
+}
+
+static void
+TestCliMissingModel()
+{
+  const char* argv[] = {"perf_analyzer"};
+  PerfAnalyzerParameters params;
+  std::string error;
+  CHECK(!CLParser::Parse(1, (char**)argv, &params, &error));
+  CHECK(error.find("model-name") != std::string::npos);
+}
+
+static void
+TestCliRanges()
+{
+  const char* argv[] = {
+      "perf_analyzer", "-m", "m", "--concurrency-range", "2:8:2",
+      "--measurement-mode", "count_windows", "--shared-memory", "xla",
+      "--request-distribution", "poisson"};
+  PerfAnalyzerParameters params;
+  std::string error;
+  CHECK(CLParser::Parse(11, (char**)argv, &params, &error));
+  CHECK(params.concurrency_start == 2);
+  CHECK(params.concurrency_end == 8);
+  CHECK(params.concurrency_step == 2);
+  CHECK(params.count_windows);
+  CHECK(params.shared_memory == SharedMemoryType::XLA);
+  CHECK(params.request_distribution == Distribution::POISSON);
+
+  const char* bad[] = {
+      "perf_analyzer", "-m", "m", "--concurrency-range", "2:8:0"};
+  PerfAnalyzerParameters p2;
+  CHECK(!CLParser::Parse(5, (char**)bad, &p2, &error));
+}
+
+// -- schedule distribution (reference test_request_rate_manager.cc) --------
+
+static void
+TestScheduleDistribution()
+{
+  ScheduleDistribution constant(Distribution::CONSTANT, 100.0, 1);
+  CHECK(constant.NextGapNs() == 10000000ull);
+  CHECK(constant.NextGapNs() == 10000000ull);
+
+  ScheduleDistribution poisson(Distribution::POISSON, 1000.0, 1);
+  double total = 0;
+  const int kSamples = 20000;
+  for (int i = 0; i < kSamples; ++i) {
+    total += (double)poisson.NextGapNs();
+  }
+  double mean_us = total / kSamples / 1000.0;
+  CHECK(std::fabs(mean_us - 1000.0) < 50.0);  // ~1ms mean gap
+}
+
+// -- profiler math (reference test_inference_profiler.cc) -------------------
+
+static void
+TestSummarizeRecords()
+{
+  std::vector<RequestRecord> records;
+  // 100 successes with latencies 1..100 ms
+  for (uint64_t i = 1; i <= 100; ++i) {
+    records.push_back({0, i * 1000000, true, false});
+  }
+  records.push_back({0, 1, false, false});  // one failure
+  auto stats =
+      InferenceProfiler::SummarizeRecords(records, 1000000000ull);
+  CHECK(stats.request_count == 100);
+  CHECK(stats.failed_request_count == 1);
+  CHECK(stats.infer_per_sec == 100.0);
+  CHECK(stats.avg_latency_ns == 50500000ull);
+  CHECK(stats.p50_ns == 50000000ull);
+  CHECK(stats.p90_ns == 90000000ull);
+  CHECK(stats.p95_ns == 95000000ull);
+  CHECK(stats.p99_ns == 99000000ull);
+}
+
+// -- model parser -----------------------------------------------------------
+
+static void
+TestModelParser()
+{
+  MockClientBackend backend;
+  ModelParser parser;
+  CHECK(parser.Init(&backend, "mock", "").IsOk());
+  CHECK(parser.ModelName() == "mock");
+  CHECK(parser.MaxBatchSize() == 8);
+  CHECK(parser.Inputs().size() == 1);
+  CHECK(parser.Inputs()[0].name == "INPUT0");
+  CHECK(parser.Inputs()[0].datatype == "INT32");
+  CHECK(parser.Outputs().size() == 1);
+  CHECK(parser.Scheduler() == SchedulerType::NONE);
+}
+
+// -- data loader ------------------------------------------------------------
+
+static void
+TestDataLoader()
+{
+  std::vector<ModelTensor> inputs = {
+      {"INPUT0", "INT32", {16}}, {"STR", "BYTES", {2}}};
+  DataLoader loader;
+  CHECK(loader.GenerateData(inputs, false, 1, 2, 1).IsOk());
+  const std::vector<uint8_t>* data;
+  CHECK(loader.GetInputData("INPUT0", 0, 0, &data).IsOk());
+  CHECK(data->size() == 64);
+  CHECK(loader.GetInputData("STR", 0, 1, &data).IsOk());
+  CHECK(data->size() == 2 * (4 + 7));  // 2x len-prefixed "pa_data"
+  CHECK(!loader.GetInputData("NOPE", 0, 0, &data).IsOk());
+
+  DataLoader json_loader;
+  CHECK(json_loader
+            .ReadDataFromJson(
+                {{"INPUT0", "INT32", {4}}},
+                "{\"data\": [{\"INPUT0\": [1, 2, 3, 4]}]}")
+            .IsOk());
+  CHECK(json_loader.GetInputData("INPUT0", 0, 0, &data).IsOk());
+  CHECK(data->size() == 16);
+  int32_t vals[4];
+  memcpy(vals, data->data(), 16);
+  CHECK(vals[0] == 1 && vals[3] == 4);
+}
+
+// -- sequence manager -------------------------------------------------------
+
+static void
+TestSequenceManager()
+{
+  SequenceManager mgr(2, 3, 0.0);
+  // slot 0: 3-long sequence then a new id
+  auto f1 = mgr.Next(0);
+  CHECK(f1.start && !f1.end);
+  auto f2 = mgr.Next(0);
+  CHECK(!f2.start && !f2.end);
+  CHECK(f2.sequence_id == f1.sequence_id);
+  auto f3 = mgr.Next(0);
+  CHECK(f3.end);
+  auto f4 = mgr.Next(0);
+  CHECK(f4.start);
+  CHECK(f4.sequence_id != f1.sequence_id);
+  // slot 1 is independent
+  auto g1 = mgr.Next(1);
+  CHECK(g1.start);
+  CHECK(g1.sequence_id != f4.sequence_id);
+  // CompleteOngoing closes the open ones
+  auto open = mgr.CompleteOngoing();
+  CHECK(open.size() == 2);  // f4 started slot 0; g1 started slot 1
+  for (const auto& f : open) {
+    CHECK(f.end);
+  }
+}
+
+// -- concurrency manager against the mock (reference
+//    test_concurrency_manager.cc) ------------------------------------------
+
+static void
+TestConcurrencyManagerAgainstMock()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 1000});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  ConcurrencyManager manager(backend, parser, config);
+  CHECK(manager.InitManager().IsOk());
+  CHECK(manager.ChangeConcurrencyLevel(4).IsOk());
+  std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  manager.StopWorkers();
+  auto records = manager.SwapRequestRecords();
+  // 4 workers x ~1ms per request x 200ms window: expect roughly 800,
+  // definitely in (100, 1600)
+  CHECK(records.size() > 100);
+  CHECK(records.size() < 1600);
+  for (const auto& r : records) {
+    CHECK(r.success);
+    CHECK(r.end_ns > r.start_ns);
+  }
+  CHECK(backend->Stats().infer_calls >= records.size());
+}
+
+static void
+TestConcurrencyManagerFailuresSurface()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{
+          .response_delay_us = 100,
+          .return_statuses = {true, false}});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  ConcurrencyManager manager(backend, parser, config);
+  CHECK(manager.InitManager().IsOk());
+  CHECK(manager.ChangeConcurrencyLevel(2).IsOk());
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  manager.StopWorkers();
+  auto records = manager.SwapRequestRecords();
+  size_t failed = 0;
+  for (const auto& r : records) {
+    failed += r.success ? 0 : 1;
+  }
+  CHECK(failed > 0);
+}
+
+// -- request rate manager ---------------------------------------------------
+
+static void
+TestRequestRateManagerAgainstMock()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 100});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  RequestRateManager manager(
+      backend, parser, config, Distribution::CONSTANT, 2);
+  CHECK(manager.InitManager().IsOk());
+  CHECK(manager.ChangeRequestRate(500.0).IsOk());
+  std::this_thread::sleep_for(std::chrono::milliseconds(400));
+  manager.StopWorkers();
+  // wait for async completions to land
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  auto records = manager.SwapRequestRecords();
+  // 500/sec over 0.4s -> ~200; allow wide margin for scheduling jitter
+  CHECK(records.size() > 100);
+  CHECK(records.size() < 350);
+}
+
+// -- sequences flow through the load manager --------------------------------
+
+static void
+TestSequencesThroughManager()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 100});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  config.use_sequences = true;
+  config.sequence_length = 4;
+  config.sequence_length_variation = 0.0;
+  ConcurrencyManager manager(backend, parser, config);
+  CHECK(manager.InitManager().IsOk());
+  CHECK(manager.ChangeConcurrencyLevel(2).IsOk());
+  std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  manager.StopWorkers();
+  auto seq_records = backend->SequenceRecords();
+  CHECK(!seq_records.empty());
+  // per sequence id: exactly one start, one end, in order
+  std::map<uint64_t, std::vector<MockClientBackend::SeqRecord>> by_id;
+  for (const auto& r : seq_records) {
+    by_id[r.id].push_back(r);
+  }
+  size_t complete = 0;
+  for (const auto& kv : by_id) {
+    const auto& seq = kv.second;
+    CHECK(seq.front().start);
+    for (size_t i = 1; i < seq.size(); ++i) {
+      CHECK(!seq[i].start);
+    }
+    if (seq.back().end) {
+      ++complete;
+      CHECK(seq.size() == 4);
+    }
+  }
+  CHECK(complete > 0);
+}
+
+// -- end-to-end profile against the mock ------------------------------------
+
+static void
+TestProfilerEndToEndWithMock()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 500});
+  PerfAnalyzerParameters params;
+  params.model_name = "mock";
+  params.measurement_window_ms = 100;
+  params.max_trials = 5;
+  params.stability_threshold_pct = 50.0;  // fast convergence for the test
+  PerfAnalyzer analyzer(params);
+  CHECK(analyzer.CreateAnalyzerObjects(backend).IsOk());
+  CHECK(analyzer.Profile().IsOk());
+  CHECK(analyzer.Results().size() == 1);
+  const auto& status = analyzer.Results()[0];
+  CHECK(status.concurrency == 1);
+  CHECK(status.client_stats.request_count > 50);
+  CHECK(status.client_stats.infer_per_sec > 100);
+  CHECK(status.client_stats.avg_latency_ns > 400000);
+  CHECK(status.server_stats.inference_count > 0);
+}
+
+// -- report writer (reference test_report_writer.cc) ------------------------
+
+static void
+TestReportWriterCsv()
+{
+  PerfStatus status;
+  status.concurrency = 2;
+  status.client_stats.infer_per_sec = 1234.5;
+  status.client_stats.avg_latency_ns = 800000;
+  status.client_stats.p50_ns = 700000;
+  status.client_stats.p90_ns = 880000;
+  status.client_stats.p95_ns = 920000;
+  status.client_stats.p99_ns = 1000000;
+  status.server_stats.success_count = 10;
+  status.server_stats.queue_ns = 410000;
+  status.server_stats.compute_infer_ns = 2570000;
+  std::string csv = ReportWriter::GenerateCsv({status}, true);
+  CHECK(csv.find("Concurrency,Inferences/Second") == 0);
+  CHECK(csv.find("2,1234.5,0,") != std::string::npos);
+  CHECK(csv.find(",41,") != std::string::npos);   // queue usec
+  CHECK(csv.find(",257,") != std::string::npos);  // compute infer usec
+  CHECK(csv.find(",700,880,920,1000") != std::string::npos);
+}
+
+int
+main()
+{
+  TestCliDefaults();
+  TestCliMissingModel();
+  TestCliRanges();
+  TestScheduleDistribution();
+  TestSummarizeRecords();
+  TestModelParser();
+  TestDataLoader();
+  TestSequenceManager();
+  TestConcurrencyManagerAgainstMock();
+  TestConcurrencyManagerFailuresSurface();
+  TestRequestRateManagerAgainstMock();
+  TestSequencesThroughManager();
+  TestProfilerEndToEndWithMock();
+  TestReportWriterCsv();
+  printf("%d checks, %d failures\n", checks, failures);
+  return failures == 0 ? 0 : 1;
+}

@@ -54,3 +54,31 @@ def test_cc_simple_http_shm_client(cc_build, http_server):
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "shm infer OK" in result.stdout
+
+
+def test_perf_analyzer_unit_tests(cc_build):
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer_unit_tests")],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "0 failures" in result.stdout
+
+
+def test_perf_analyzer_e2e(cc_build, http_server):
+    """perf_analyzer drives the live server: one concurrency level, short
+    windows, CSV out (the quick-start measurement end-to-end)."""
+    csv_path = os.path.join(cc_build, "test_pa.csv")
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "simple", "-u",
+         http_server.url.replace("http://", ""), "-p", "300",
+         "--max-trials", "4", "--stability-percentage", "50",
+         "-f", csv_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput:" in result.stdout
+    with open(csv_path) as f:
+        header, row = f.read().strip().splitlines()[:2]
+    assert header.startswith("Concurrency,Inferences/Second")
+    assert float(row.split(",")[1]) > 50  # sane throughput over loopback
