@@ -1,0 +1,149 @@
+// Decoupled bidirectional streaming: one request to the repeat_int32
+// model yields N streamed responses (role of reference
+// src/c++/examples/simple_grpc_custom_repeat.cc).
+//
+// Usage: simple_grpc_custom_repeat [-v] [-u host:port] [-r repeat_count]
+
+#include <unistd.h>
+
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int repeat_count = 8;
+
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:r:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      case 'r':
+        repeat_count = atoi(optarg);
+        break;
+      default:
+        std::cerr << "usage: " << argv[0]
+                  << " [-v] [-u host:port] [-r repeat_count]" << std::endl;
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  // collect streamed responses
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> received;
+  FAIL_IF_ERR(
+      client->StartStream([&](tc::InferResult* result) {
+        tc::Error status = result->RequestStatus();
+        if (!status.IsOk()) {
+          std::cerr << "error: stream response: " << status << std::endl;
+          delete result;
+          exit(1);
+        }
+        const uint8_t* buf;
+        size_t byte_size;
+        FAIL_IF_ERR(result->RawData("OUT", &buf, &byte_size), "OUT data");
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          received.push_back(*reinterpret_cast<const int32_t*>(buf));
+        }
+        cv.notify_all();
+        delete result;
+      }),
+      "starting stream");
+
+  std::vector<int32_t> in_data(repeat_count);
+  std::vector<uint32_t> delay_data(repeat_count);
+  for (int i = 0; i < repeat_count; ++i) {
+    in_data[i] = i;
+    delay_data[i] = 1000;  // 1 ms between responses
+  }
+  uint32_t wait_data = 500;
+
+  tc::InferInput* in;
+  tc::InferInput* delay;
+  tc::InferInput* wait;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&in, "IN", {repeat_count}, "INT32"),
+      "creating IN");
+  std::shared_ptr<tc::InferInput> in_ptr(in);
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&delay, "DELAY", {repeat_count}, "UINT32"),
+      "creating DELAY");
+  std::shared_ptr<tc::InferInput> delay_ptr(delay);
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&wait, "WAIT", {1}, "UINT32"), "creating WAIT");
+  std::shared_ptr<tc::InferInput> wait_ptr(wait);
+
+  FAIL_IF_ERR(
+      in_ptr->AppendRaw(
+          reinterpret_cast<uint8_t*>(in_data.data()),
+          in_data.size() * sizeof(int32_t)),
+      "setting IN");
+  FAIL_IF_ERR(
+      delay_ptr->AppendRaw(
+          reinterpret_cast<uint8_t*>(delay_data.data()),
+          delay_data.size() * sizeof(uint32_t)),
+      "setting DELAY");
+  FAIL_IF_ERR(
+      wait_ptr->AppendRaw(
+          reinterpret_cast<uint8_t*>(&wait_data), sizeof(uint32_t)),
+      "setting WAIT");
+
+  tc::InferOptions options("repeat_int32");
+  std::vector<tc::InferInput*> inputs = {in_ptr.get(), delay_ptr.get(),
+                                         wait_ptr.get()};
+
+  FAIL_IF_ERR(
+      client->AsyncStreamInfer(options, inputs), "stream infer request");
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(30), [&]() {
+          return received.size() >= (size_t)repeat_count;
+        })) {
+      std::cerr << "error: timed out waiting for " << repeat_count
+                << " responses (got " << received.size() << ")" << std::endl;
+      exit(1);
+    }
+  }
+
+  FAIL_IF_ERR(client->StopStream(), "stopping stream");
+
+  for (int i = 0; i < repeat_count; ++i) {
+    if (received[i] != in_data[i]) {
+      std::cerr << "error: response " << i << " = " << received[i]
+                << ", expected " << in_data[i] << std::endl;
+      exit(1);
+    }
+  }
+  std::cout << "stream infer OK: " << received.size() << " responses"
+            << std::endl;
+  return 0;
+}

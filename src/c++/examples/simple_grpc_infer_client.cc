@@ -1,0 +1,174 @@
+// Sync + async infer on the `simple` add/sub model over gRPC
+// (role of reference src/c++/examples/simple_grpc_infer_client.cc /
+// simple_grpc_async_infer_client.cc).
+//
+// Usage: simple_grpc_infer_client [-v] [-u host:port]
+
+#include <unistd.h>
+
+#include <condition_variable>
+#include <iostream>
+#include <mutex>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
+                  << std::endl;
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server liveness");
+  if (!live) {
+    std::cerr << "error: server is not live" << std::endl;
+    exit(1);
+  }
+  bool ready = false;
+  FAIL_IF_ERR(client->IsModelReady(&ready, "simple"), "model readiness");
+  if (!ready) {
+    std::cerr << "error: model 'simple' is not ready" << std::endl;
+    exit(1);
+  }
+
+  inference::ModelMetadataResponse metadata;
+  FAIL_IF_ERR(client->ModelMetadata(&metadata, "simple"), "model metadata");
+  if (metadata.inputs_size() != 2 || metadata.outputs_size() != 2) {
+    std::cerr << "error: unexpected model metadata" << std::endl;
+    exit(1);
+  }
+
+  std::vector<int32_t> input0_data(16);
+  std::vector<int32_t> input1_data(16);
+  for (size_t i = 0; i < 16; ++i) {
+    input0_data[i] = (int32_t)i;
+    input1_data[i] = 1;
+  }
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  std::vector<int64_t> shape{1, 16};
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", shape, "INT32"),
+      "creating INPUT0");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0);
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", shape, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input1_ptr(input1);
+
+  FAIL_IF_ERR(
+      input0_ptr->AppendRaw(
+          reinterpret_cast<uint8_t*>(input0_data.data()),
+          input0_data.size() * sizeof(int32_t)),
+      "setting INPUT0 data");
+  FAIL_IF_ERR(
+      input1_ptr->AppendRaw(
+          reinterpret_cast<uint8_t*>(input1_data.data()),
+          input1_data.size() * sizeof(int32_t)),
+      "setting INPUT1 data");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0);
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::shared_ptr<tc::InferRequestedOutput> output1_ptr(output1);
+
+  tc::InferOptions options("simple");
+  std::vector<tc::InferInput*> inputs = {input0_ptr.get(),
+                                         input1_ptr.get()};
+  std::vector<const tc::InferRequestedOutput*> outputs = {
+      output0_ptr.get(), output1_ptr.get()};
+
+  auto validate = [&](tc::InferResult* result) {
+    FAIL_IF_ERR(result->RequestStatus(), "inference failed");
+    const uint8_t* buf;
+    size_t byte_size;
+    FAIL_IF_ERR(result->RawData("OUTPUT0", &buf, &byte_size),
+                "OUTPUT0 raw data");
+    const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+    FAIL_IF_ERR(result->RawData("OUTPUT1", &buf, &byte_size),
+                "OUTPUT1 raw data");
+    const int32_t* diff = reinterpret_cast<const int32_t*>(buf);
+    for (size_t i = 0; i < 16; ++i) {
+      if (sum[i] != input0_data[i] + input1_data[i] ||
+          diff[i] != input0_data[i] - input1_data[i]) {
+        std::cerr << "error: incorrect result at " << i << std::endl;
+        exit(1);
+      }
+    }
+  };
+
+  // sync
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(&result, options, inputs, outputs), "sync infer");
+  validate(result);
+  delete result;
+  std::cout << "sync infer OK" << std::endl;
+
+  // async
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  FAIL_IF_ERR(
+      client->AsyncInfer(
+          [&](tc::InferResult* result) {
+            validate(result);
+            delete result;
+            {
+              std::lock_guard<std::mutex> lk(mu);
+              done = true;
+            }
+            cv.notify_one();
+          },
+          options, inputs, outputs),
+      "async infer");
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+  std::cout << "async infer OK" << std::endl;
+
+  tc::InferStat stat;
+  client->ClientInferStat(&stat);
+  std::cout << "completed " << stat.completed_request_count
+            << " requests" << std::endl;
+  return 0;
+}

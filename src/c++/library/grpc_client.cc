@@ -1,0 +1,1019 @@
+#include "grpc_client.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <map>
+
+namespace tc {
+
+namespace {
+
+constexpr const char kService[] = "inference.GRPCInferenceService";
+
+// ---------------------------------------------------------------------------
+// Channel cache: channels to one url are shared across clients up to a
+// share count (reference grpc_client.cc:78-145).
+//
+struct CachedChannel {
+  std::shared_ptr<h2::GrpcChannel> channel;
+  int use_count = 0;
+};
+
+std::mutex channel_cache_mu_;
+std::map<std::string, std::vector<CachedChannel>> channel_cache_;
+
+int
+MaxShareCount()
+{
+  const char* env = std::getenv("TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT");
+  if (env != nullptr) {
+    try {
+      int v = std::stoi(env);
+      return (v < 1) ? 1 : v;
+    }
+    catch (...) {
+    }
+  }
+  return 6;
+}
+
+Error
+AcquireChannel(
+    std::shared_ptr<h2::GrpcChannel>* channel, const std::string& url,
+    bool verbose)
+{
+  std::lock_guard<std::mutex> lk(channel_cache_mu_);
+  auto& entries = channel_cache_[url];
+  const int max_share = MaxShareCount();
+  for (auto& e : entries) {
+    if (e.use_count < max_share && e.channel->Alive()) {
+      e.use_count++;
+      *channel = e.channel;
+      return Error::Success;
+    }
+  }
+  std::shared_ptr<h2::GrpcChannel> fresh;
+  Error err = h2::GrpcChannel::Create(&fresh, url, verbose);
+  if (!err.IsOk()) {
+    return err;
+  }
+  entries.push_back(CachedChannel{fresh, 1});
+  *channel = std::move(fresh);
+  return Error::Success;
+}
+
+void
+ReleaseChannel(const std::shared_ptr<h2::GrpcChannel>& channel)
+{
+  std::lock_guard<std::mutex> lk(channel_cache_mu_);
+  auto it = channel_cache_.find(channel->Url());
+  if (it == channel_cache_.end()) {
+    return;
+  }
+  auto& entries = it->second;
+  for (auto eit = entries.begin(); eit != entries.end(); ++eit) {
+    if (eit->channel == channel) {
+      if (--eit->use_count <= 0) {
+        entries.erase(eit);
+      }
+      break;
+    }
+  }
+  if (entries.empty()) {
+    channel_cache_.erase(it);
+  }
+}
+
+}  // namespace
+
+//==============================================================================
+// InferResultGrpc
+
+Error
+InferResultGrpc::Create(
+    InferResult** infer_result,
+    std::shared_ptr<inference::ModelInferResponse> response)
+{
+  *infer_result = new InferResultGrpc(std::move(response));
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::Create(
+    InferResult** infer_result,
+    std::shared_ptr<inference::ModelStreamInferResponse> stream_response)
+{
+  auto* result = new InferResultGrpc(std::shared_ptr<
+                                     inference::ModelInferResponse>(
+      stream_response, stream_response->mutable_infer_response()));
+  result->stream_response_ = std::move(stream_response);
+  if (!result->stream_response_->error_message().empty()) {
+    result->status_ = Error(result->stream_response_->error_message());
+  }
+  *infer_result = result;
+  return Error::Success;
+}
+
+InferResultGrpc::InferResultGrpc(
+    std::shared_ptr<inference::ModelInferResponse> response)
+    : response_(std::move(response))
+{
+}
+
+Error
+InferResultGrpc::Output(
+    const std::string& name,
+    const inference::ModelInferResponse::InferOutputTensor** tensor,
+    size_t* index) const
+{
+  for (int i = 0; i < response_->outputs_size(); ++i) {
+    if (response_->outputs(i).name() == name) {
+      *tensor = &response_->outputs(i);
+      *index = i;
+      return Error::Success;
+    }
+  }
+  return Error("output '" + name + "' not found in result");
+}
+
+Error
+InferResultGrpc::ModelName(std::string* name) const
+{
+  *name = response_->model_name();
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::ModelVersion(std::string* version) const
+{
+  *version = response_->model_version();
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::Id(std::string* id) const
+{
+  *id = response_->id();
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::Shape(
+    const std::string& output_name, std::vector<int64_t>* shape) const
+{
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = Output(output_name, &tensor, &index);
+  if (!err.IsOk()) {
+    return err;
+  }
+  shape->assign(tensor->shape().begin(), tensor->shape().end());
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::Datatype(
+    const std::string& output_name, std::string* datatype) const
+{
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = Output(output_name, &tensor, &index);
+  if (!err.IsOk()) {
+    return err;
+  }
+  *datatype = tensor->datatype();
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::RawData(
+    const std::string& output_name, const uint8_t** buf,
+    size_t* byte_size) const
+{
+  const inference::ModelInferResponse::InferOutputTensor* tensor;
+  size_t index;
+  Error err = Output(output_name, &tensor, &index);
+  if (!err.IsOk()) {
+    return err;
+  }
+  if (static_cast<int>(index) >= response_->raw_output_contents_size()) {
+    return Error(
+        "output '" + output_name +
+        "' has no raw data (shared-memory output or typed contents)");
+  }
+  const std::string& raw = response_->raw_output_contents(index);
+  *buf = reinterpret_cast<const uint8_t*>(raw.data());
+  *byte_size = raw.size();
+  return Error::Success;
+}
+
+Error
+InferResultGrpc::StringData(
+    const std::string& output_name,
+    std::vector<std::string>* string_result) const
+{
+  const uint8_t* buf;
+  size_t byte_size;
+  Error err = RawData(output_name, &buf, &byte_size);
+  if (!err.IsOk()) {
+    return err;
+  }
+  string_result->clear();
+  size_t pos = 0;
+  while (pos + 4 <= byte_size) {
+    uint32_t len;
+    std::memcpy(&len, buf + pos, 4);
+    pos += 4;
+    if (pos + len > byte_size) {
+      return Error("malformed BYTES tensor in output '" + output_name + "'");
+    }
+    string_result->emplace_back(
+        reinterpret_cast<const char*>(buf + pos), len);
+    pos += len;
+  }
+  return Error::Success;
+}
+
+std::string
+InferResultGrpc::DebugString() const
+{
+  return response_->ShortDebugString();
+}
+
+Error
+InferResultGrpc::RequestStatus() const
+{
+  return status_;
+}
+
+//==============================================================================
+// InferenceServerGrpcClient
+
+Error
+InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client,
+    const std::string& server_url, bool verbose, bool use_ssl,
+    const SslOptions& ssl_options, const KeepAliveOptions& keepalive_options)
+{
+  (void)ssl_options;
+  (void)keepalive_options;
+  if (use_ssl) {
+    return Error(
+        "SSL is not supported by the in-tree h2 transport; terminate TLS in "
+        "a local proxy or use the insecure port");
+  }
+  std::shared_ptr<h2::GrpcChannel> channel;
+  Error err = AcquireChannel(&channel, server_url, verbose);
+  if (!err.IsOk()) {
+    return err;
+  }
+  client->reset(new InferenceServerGrpcClient(std::move(channel), verbose));
+  return Error::Success;
+}
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(
+    std::shared_ptr<h2::GrpcChannel> channel, bool verbose)
+    : InferenceServerClient(verbose), channel_(std::move(channel))
+{
+  worker_ = std::thread(&InferenceServerGrpcClient::DispatchWorker, this);
+}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient()
+{
+  StopStream();
+  {
+    std::lock_guard<std::mutex> lk(worker_mu_);
+    worker_exit_ = true;
+  }
+  worker_cv_.notify_all();
+  if (worker_.joinable()) {
+    worker_.join();
+  }
+  ReleaseChannel(channel_);
+}
+
+void
+InferenceServerGrpcClient::DispatchWorker()
+{
+  for (;;) {
+    std::function<void()> fn;
+    {
+      std::unique_lock<std::mutex> lk(worker_mu_);
+      worker_cv_.wait(
+          lk, [&]() { return worker_exit_ || !worker_queue_.empty(); });
+      if (worker_queue_.empty()) {
+        if (worker_exit_) {
+          return;
+        }
+        continue;
+      }
+      fn = std::move(worker_queue_.front());
+      worker_queue_.pop_front();
+    }
+    fn();
+  }
+}
+
+void
+InferenceServerGrpcClient::EnqueueCallback(std::function<void()> fn)
+{
+  {
+    std::lock_guard<std::mutex> lk(worker_mu_);
+    worker_queue_.push_back(std::move(fn));
+  }
+  worker_cv_.notify_all();
+}
+
+template <typename Req, typename Resp>
+Error
+InferenceServerGrpcClient::Rpc(
+    const std::string& method, const Req& request, Resp* response,
+    uint64_t timeout_us)
+{
+  std::string serialized;
+  if (!request.SerializeToString(&serialized)) {
+    return Error("failed to serialize " + method + " request");
+  }
+  std::string out;
+  Error err = channel_->Unary(kService, method, serialized, &out, timeout_us);
+  if (!err.IsOk()) {
+    return err;
+  }
+  if (!response->ParseFromString(out)) {
+    return Error("failed to parse " + method + " response");
+  }
+  if (verbose_) {
+    std::cerr << method << ": " << response->ShortDebugString() << std::endl;
+  }
+  return Error::Success;
+}
+
+Error
+InferenceServerGrpcClient::IsServerLive(bool* live)
+{
+  inference::ServerLiveRequest request;
+  inference::ServerLiveResponse response;
+  Error err = Rpc("ServerLive", request, &response);
+  if (err.IsOk()) {
+    *live = response.live();
+  }
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::IsServerReady(bool* ready)
+{
+  inference::ServerReadyRequest request;
+  inference::ServerReadyResponse response;
+  Error err = Rpc("ServerReady", request, &response);
+  if (err.IsOk()) {
+    *ready = response.ready();
+  }
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version)
+{
+  inference::ModelReadyRequest request;
+  request.set_name(model_name);
+  request.set_version(model_version);
+  inference::ModelReadyResponse response;
+  Error err = Rpc("ModelReady", request, &response);
+  if (err.IsOk()) {
+    *ready = response.ready();
+  }
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* server_metadata)
+{
+  inference::ServerMetadataRequest request;
+  return Rpc("ServerMetadata", request, server_metadata);
+}
+
+Error
+InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* model_metadata,
+    const std::string& model_name, const std::string& model_version)
+{
+  inference::ModelMetadataRequest request;
+  request.set_name(model_name);
+  request.set_version(model_version);
+  return Rpc("ModelMetadata", request, model_metadata);
+}
+
+Error
+InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* model_config,
+    const std::string& model_name, const std::string& model_version)
+{
+  inference::ModelConfigRequest request;
+  request.set_name(model_name);
+  request.set_version(model_version);
+  return Rpc("ModelConfig", request, model_config);
+}
+
+Error
+InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* repository_index)
+{
+  inference::RepositoryIndexRequest request;
+  return Rpc("RepositoryIndex", request, repository_index);
+}
+
+Error
+InferenceServerGrpcClient::LoadModel(
+    const std::string& model_name, const std::string& config)
+{
+  inference::RepositoryModelLoadRequest request;
+  request.set_model_name(model_name);
+  if (!config.empty()) {
+    (*request.mutable_parameters())["config"].set_string_param(config);
+  }
+  inference::RepositoryModelLoadResponse response;
+  return Rpc("RepositoryModelLoad", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnloadModel(const std::string& model_name)
+{
+  inference::RepositoryModelUnloadRequest request;
+  request.set_model_name(model_name);
+  inference::RepositoryModelUnloadResponse response;
+  return Rpc("RepositoryModelUnload", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* infer_stat,
+    const std::string& model_name, const std::string& model_version)
+{
+  inference::ModelStatisticsRequest request;
+  request.set_name(model_name);
+  request.set_version(model_version);
+  return Rpc("ModelStatistics", request, infer_stat);
+}
+
+Error
+InferenceServerGrpcClient::UpdateTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings)
+{
+  inference::TraceSettingRequest request;
+  request.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*request.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) {
+      value.add_value(v);
+    }
+  }
+  return Rpc("TraceSetting", request, response);
+}
+
+Error
+InferenceServerGrpcClient::GetTraceSettings(
+    inference::TraceSettingResponse* settings, const std::string& model_name)
+{
+  inference::TraceSettingRequest request;
+  request.set_model_name(model_name);
+  return Rpc("TraceSetting", request, settings);
+}
+
+Error
+InferenceServerGrpcClient::UpdateLogSettings(
+    inference::LogSettingsResponse* response,
+    const std::map<std::string, std::string>& settings)
+{
+  inference::LogSettingsRequest request;
+  for (const auto& kv : settings) {
+    auto& value = (*request.mutable_settings())[kv.first];
+    if (kv.second == "true" || kv.second == "false") {
+      value.set_bool_param(kv.second == "true");
+    } else if (
+        !kv.second.empty() &&
+        kv.second.find_first_not_of("0123456789") == std::string::npos) {
+      value.set_uint32_param(
+          static_cast<uint32_t>(std::stoul(kv.second)));
+    } else {
+      value.set_string_param(kv.second);
+    }
+  }
+  return Rpc("LogSettings", request, response);
+}
+
+Error
+InferenceServerGrpcClient::GetLogSettings(
+    inference::LogSettingsResponse* settings)
+{
+  inference::LogSettingsRequest request;
+  return Rpc("LogSettings", request, settings);
+}
+
+Error
+InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset)
+{
+  inference::SystemSharedMemoryRegisterRequest request;
+  request.set_name(name);
+  request.set_key(key);
+  request.set_offset(offset);
+  request.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse response;
+  return Rpc("SystemSharedMemoryRegister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name)
+{
+  inference::SystemSharedMemoryUnregisterRequest request;
+  request.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse response;
+  return Rpc("SystemSharedMemoryUnregister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* status)
+{
+  inference::SystemSharedMemoryStatusRequest request;
+  return Rpc("SystemSharedMemoryStatus", request, status);
+}
+
+Error
+InferenceServerGrpcClient::RegisterXlaSharedMemory(
+    const std::string& name, const std::string& raw_handle, size_t byte_size,
+    int device_ordinal)
+{
+  inference::XlaSharedMemoryRegisterRequest request;
+  request.set_name(name);
+  request.set_raw_handle(raw_handle);
+  request.set_device_ordinal(device_ordinal);
+  request.set_byte_size(byte_size);
+  inference::XlaSharedMemoryRegisterResponse response;
+  return Rpc("XlaSharedMemoryRegister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnregisterXlaSharedMemory(const std::string& name)
+{
+  inference::XlaSharedMemoryUnregisterRequest request;
+  request.set_name(name);
+  inference::XlaSharedMemoryUnregisterResponse response;
+  return Rpc("XlaSharedMemoryUnregister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::XlaSharedMemoryStatus(
+    inference::XlaSharedMemoryStatusResponse* status)
+{
+  inference::XlaSharedMemoryStatusRequest request;
+  return Rpc("XlaSharedMemoryStatus", request, status);
+}
+
+Error
+InferenceServerGrpcClient::RegisterCudaSharedMemory(
+    const std::string& name, const std::string& raw_handle, size_t byte_size,
+    int device_id)
+{
+  inference::CudaSharedMemoryRegisterRequest request;
+  request.set_name(name);
+  request.set_raw_handle(raw_handle);
+  request.set_device_id(device_id);
+  request.set_byte_size(byte_size);
+  inference::CudaSharedMemoryRegisterResponse response;
+  return Rpc("CudaSharedMemoryRegister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnregisterCudaSharedMemory(const std::string& name)
+{
+  inference::CudaSharedMemoryUnregisterRequest request;
+  request.set_name(name);
+  inference::CudaSharedMemoryUnregisterResponse response;
+  return Rpc("CudaSharedMemoryUnregister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::CudaSharedMemoryStatus(
+    inference::CudaSharedMemoryStatusResponse* status)
+{
+  inference::CudaSharedMemoryStatusRequest request;
+  return Rpc("CudaSharedMemoryStatus", request, status);
+}
+
+Error
+InferenceServerGrpcClient::PreRunProcessing(
+    inference::ModelInferRequest* request, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  request->Clear();
+  request->set_model_name(options.model_name_);
+  request->set_model_version(options.model_version_);
+  request->set_id(options.request_id_);
+
+  auto& params = *request->mutable_parameters();
+  if (options.sequence_id_ != 0) {
+    params["sequence_id"].set_uint64_param(options.sequence_id_);
+    params["sequence_start"].set_bool_param(options.sequence_start_);
+    params["sequence_end"].set_bool_param(options.sequence_end_);
+  }
+  if (options.priority_ != 0) {
+    params["priority"].set_uint64_param(options.priority_);
+  }
+  if (options.server_timeout_us_ != 0) {
+    params["timeout"].set_int64_param(options.server_timeout_us_);
+  }
+
+  // 2 GB protobuf guard (reference grpc_client.cc:1345-1353)
+  size_t total = 0;
+  for (const auto* input : inputs) {
+    total += input->TotalByteSize();
+  }
+  if (total > 0x7fffffffull) {
+    return Error(
+        "inputs exceed the 2 GB protobuf limit; use shared memory for "
+        "requests this large");
+  }
+
+  for (auto* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (int64_t dim : input->Shape()) {
+      tensor->add_shape(dim);
+    }
+    if (input->IsSharedMemory()) {
+      auto& tp = *tensor->mutable_parameters();
+      tp["shared_memory_region"].set_string_param(input->SharedMemoryName());
+      tp["shared_memory_byte_size"].set_int64_param(
+          input->SharedMemoryByteSize());
+      if (input->SharedMemoryOffset() != 0) {
+        tp["shared_memory_offset"].set_int64_param(
+            input->SharedMemoryOffset());
+      }
+    } else {
+      std::string* raw = request->add_raw_input_contents();
+      raw->reserve(input->TotalByteSize());
+      input->PrepareForRequest();
+      const uint8_t* buf;
+      size_t len;
+      bool end;
+      while (input->GetNext(&buf, &len, &end).IsOk()) {
+        if (buf != nullptr) {
+          raw->append(reinterpret_cast<const char*>(buf), len);
+        }
+        if (end) {
+          break;
+        }
+      }
+    }
+  }
+
+  for (const auto* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto& tp = *tensor->mutable_parameters();
+    if (output->ClassCount() > 0) {
+      tp["classification"].set_int64_param(output->ClassCount());
+    }
+    if (output->IsSharedMemory()) {
+      tp["shared_memory_region"].set_string_param(output->SharedMemoryName());
+      tp["shared_memory_byte_size"].set_int64_param(
+          output->SharedMemoryByteSize());
+      if (output->SharedMemoryOffset() != 0) {
+        tp["shared_memory_offset"].set_int64_param(
+            output->SharedMemoryOffset());
+      }
+    }
+  }
+  return Error::Success;
+}
+
+Error
+InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  RequestTimers timer;
+  timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  timer.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  Error err = PreRunProcessing(&sync_request_, options, inputs, outputs);
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::string serialized;
+  if (!sync_request_.SerializeToString(&serialized)) {
+    return Error("failed to serialize ModelInfer request");
+  }
+  timer.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+
+  std::string out;
+  err = channel_->Unary(
+      kService, "ModelInfer", serialized, &out, options.client_timeout_us_);
+  if (!err.IsOk()) {
+    return err;
+  }
+
+  timer.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+  auto response = std::make_shared<inference::ModelInferResponse>();
+  if (!response->ParseFromString(out)) {
+    return Error("failed to parse ModelInfer response");
+  }
+  timer.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+  timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+  {
+    std::lock_guard<std::mutex> lk(stat_mu_);
+    UpdateInferStat(timer);
+  }
+  if (verbose_) {
+    std::cerr << "ModelInfer: " << response->ShortDebugString() << std::endl;
+  }
+  return InferResultGrpc::Create(result, std::move(response));
+}
+
+Error
+InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInfer");
+  }
+  auto request = std::make_shared<inference::ModelInferRequest>();
+  Error err = PreRunProcessing(request.get(), options, inputs, outputs);
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::string serialized;
+  if (!request->SerializeToString(&serialized)) {
+    return Error("failed to serialize ModelInfer request");
+  }
+
+  auto timer = std::make_shared<RequestTimers>();
+  timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  timer->CaptureTimestamp(RequestTimers::Kind::SEND_START);
+
+  auto response_buf = std::make_shared<std::string>();
+  h2::GrpcCall call;
+  err = channel_->StartCall(
+      &call, kService, "ModelInfer",
+      [response_buf](std::string&& msg) { *response_buf = std::move(msg); },
+      [this, callback, timer, response_buf](
+          Error e, int status, std::string message) {
+        // completion runs on the reader thread; hand the user callback to
+        // the dispatch worker (role of the reference's AsyncTransfer
+        // thread, grpc_client.cc:1483-1527)
+        EnqueueCallback([this, callback, timer, response_buf, e, status,
+                         message]() {
+          InferResult* result = nullptr;
+          auto response = std::make_shared<inference::ModelInferResponse>();
+          Error final_err = e;
+          if (final_err.IsOk() && status != 0) {
+            final_err = Error(
+                message.empty() ? ("grpc-status " + std::to_string(status))
+                                : message);
+          }
+          if (final_err.IsOk() &&
+              !response->ParseFromString(*response_buf)) {
+            final_err = Error("failed to parse ModelInfer response");
+          }
+          timer->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+          timer->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+          timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+          if (final_err.IsOk()) {
+            std::lock_guard<std::mutex> lk(stat_mu_);
+            UpdateInferStat(*timer);
+          }
+          InferResultGrpc::Create(&result, std::move(response));
+          static_cast<InferResultGrpc*>(result)->SetRequestStatus(final_err);
+          callback(result);
+        });
+      },
+      options.client_timeout_us_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  err = call.Write(serialized, /*end_of_calls=*/true);
+  timer->CaptureTimestamp(RequestTimers::Kind::SEND_END);
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::InferMulti(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs)
+{
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("'options' must be of size 1 or match 'inputs'");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("'outputs' must be empty or match 'inputs'");
+  }
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const auto& opt = (options.size() == 1) ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    InferResult* result = nullptr;
+    Error err = Infer(&result, opt, inputs[i], outs);
+    if (!err.IsOk()) {
+      for (auto* r : *results) {
+        delete r;
+      }
+      results->clear();
+      return err;
+    }
+    results->push_back(result);
+  }
+  return Error::Success;
+}
+
+Error
+InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs)
+{
+  if (callback == nullptr) {
+    return Error("callback must not be null for AsyncInferMulti");
+  }
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("'options' must be of size 1 or match 'inputs'");
+  }
+  if (!outputs.empty() && outputs.size() != inputs.size()) {
+    return Error("'outputs' must be empty or match 'inputs'");
+  }
+  const size_t n = inputs.size();
+  struct MultiState {
+    std::mutex mu;
+    std::vector<InferResult*> results;
+    size_t pending;
+    OnMultiCompleteFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(n, nullptr);
+  state->pending = n;
+  state->callback = std::move(callback);
+  for (size_t i = 0; i < n; ++i) {
+    const auto& opt = (options.size() == 1) ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty() ? kNoOutputs : outputs[i];
+    Error err = AsyncInfer(
+        [state, i](InferResult* result) {
+          bool fire = false;
+          {
+            std::lock_guard<std::mutex> lk(state->mu);
+            state->results[i] = result;
+            fire = (--state->pending == 0);
+          }
+          if (fire) {
+            state->callback(state->results);
+          }
+        },
+        opt, inputs[i], outs);
+    if (!err.IsOk()) {
+      return err;
+    }
+  }
+  return Error::Success;
+}
+
+Error
+InferenceServerGrpcClient::StartStream(
+    OnCompleteFn stream_callback, bool enable_stats,
+    uint64_t stream_timeout_us)
+{
+  if (stream_callback == nullptr) {
+    return Error("callback must not be null for StartStream");
+  }
+  std::lock_guard<std::mutex> lk(stream_mu_);
+  if (stream_call_ != nullptr) {
+    return Error("stream is already active");
+  }
+  stream_callback_ = std::move(stream_callback);
+  stream_enable_stats_ = enable_stats;
+  stream_done_ = false;
+  stream_status_ = Error::Success;
+  stream_timers_.clear();
+
+  auto call = std::make_unique<h2::GrpcCall>();
+  Error err = channel_->StartCall(
+      call.get(), kService, "ModelStreamInfer",
+      [this](std::string&& msg) {
+        auto response = std::make_shared<inference::ModelStreamInferResponse>();
+        if (!response->ParseFromString(msg)) {
+          return;  // a malformed frame is surfaced via stream close
+        }
+        EnqueueCallback([this, response]() {
+          RequestTimers timer;
+          bool have_timer = false;
+          {
+            std::lock_guard<std::mutex> slk(stream_mu_);
+            if (!stream_timers_.empty()) {
+              timer = stream_timers_.front();
+              stream_timers_.pop_front();
+              have_timer = true;
+            }
+          }
+          if (have_timer && stream_enable_stats_ &&
+              response->error_message().empty()) {
+            timer.CaptureTimestamp(RequestTimers::Kind::RECV_START);
+            timer.CaptureTimestamp(RequestTimers::Kind::RECV_END);
+            timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+            std::lock_guard<std::mutex> lk2(stat_mu_);
+            UpdateInferStat(timer);
+          }
+          InferResult* result = nullptr;
+          InferResultGrpc::Create(&result, response);
+          stream_callback_(result);
+        });
+      },
+      [this](Error e, int status, std::string message) {
+        std::lock_guard<std::mutex> slk(stream_mu_);
+        stream_done_ = true;
+        if (!e.IsOk()) {
+          stream_status_ = e;
+        } else if (status != 0) {
+          stream_status_ = Error(
+              message.empty() ? ("grpc-status " + std::to_string(status))
+                              : message);
+        }
+        stream_cv_.notify_all();
+      },
+      stream_timeout_us);
+  if (!err.IsOk()) {
+    return err;
+  }
+  stream_call_ = std::move(call);
+  return Error::Success;
+}
+
+Error
+InferenceServerGrpcClient::StopStream()
+{
+  std::unique_lock<std::mutex> lk(stream_mu_);
+  if (stream_call_ == nullptr) {
+    return Error::Success;
+  }
+  stream_call_->WritesDone();
+  if (!stream_cv_.wait_for(
+          lk, std::chrono::seconds(10), [&]() { return stream_done_; })) {
+    stream_call_->Cancel();
+    stream_cv_.wait_for(
+        lk, std::chrono::seconds(2), [&]() { return stream_done_; });
+  }
+  Error status = stream_status_;
+  stream_call_.reset();
+  stream_callback_ = nullptr;
+  return status;
+}
+
+Error
+InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  inference::ModelInferRequest request;
+  Error err = PreRunProcessing(&request, options, inputs, outputs);
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::string serialized;
+  if (!request.SerializeToString(&serialized)) {
+    return Error("failed to serialize stream request");
+  }
+  RequestTimers timer;
+  timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
+  timer.CaptureTimestamp(RequestTimers::Kind::SEND_START);
+  std::lock_guard<std::mutex> lk(stream_mu_);
+  if (stream_call_ == nullptr) {
+    return Error("stream is not active; call StartStream first");
+  }
+  if (stream_done_) {
+    return Error(
+        stream_status_.IsOk() ? "stream has ended" : stream_status_.Message());
+  }
+  err = stream_call_->Write(serialized, /*end_of_calls=*/false);
+  if (!err.IsOk()) {
+    return err;
+  }
+  timer.CaptureTimestamp(RequestTimers::Kind::SEND_END);
+  if (stream_enable_stats_) {
+    stream_timers_.push_back(timer);
+  }
+  return Error::Success;
+}
+
+}  // namespace tc

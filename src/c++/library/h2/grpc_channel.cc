@@ -1,0 +1,348 @@
+#include "grpc_channel.h"
+
+#include <cstring>
+
+namespace tc {
+namespace h2 {
+
+namespace {
+
+Error
+ParseHostPort(const std::string& url, std::string* host, int* port)
+{
+  std::string u = url;
+  // tolerate scheme prefixes
+  auto scheme = u.find("://");
+  if (scheme != std::string::npos) {
+    u = u.substr(scheme + 3);
+  }
+  auto slash = u.find('/');
+  if (slash != std::string::npos) {
+    u = u.substr(0, slash);
+  }
+  auto colon = u.rfind(':');
+  if (colon == std::string::npos) {
+    *host = u;
+    *port = 8001;
+    return Error::Success;
+  }
+  *host = u.substr(0, colon);
+  try {
+    *port = std::stoi(u.substr(colon + 1));
+  }
+  catch (...) {
+    return Error("invalid port in url '" + url + "'");
+  }
+  return Error::Success;
+}
+
+}  // namespace
+
+std::string
+PercentDecode(const std::string& in)
+{
+  std::string out;
+  out.reserve(in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size() && isxdigit(in[i + 1]) &&
+        isxdigit(in[i + 2])) {
+      out.push_back(static_cast<char>(
+          std::stoi(in.substr(i + 1, 2), nullptr, 16)));
+      i += 2;
+    } else {
+      out.push_back(in[i]);
+    }
+  }
+  return out;
+}
+
+//==============================================================================
+// GrpcCall
+
+struct GrpcCall::State {
+  std::shared_ptr<H2Connection> conn;
+  int32_t stream_id = 0;
+
+  // reader-thread state: gRPC message reassembly
+  std::string recv_buf;
+  GrpcCall::OnMessage on_message;
+  GrpcCall::OnDone on_done;
+
+  std::mutex mu;
+  bool done = false;
+  bool status_seen = false;
+  int grpc_status = -1;
+  std::string grpc_message;
+
+  void ScanStatus(const std::vector<Header>& headers)
+  {
+    std::lock_guard<std::mutex> lk(mu);
+    for (const auto& h : headers) {
+      if (h.name == "grpc-status") {
+        status_seen = true;
+        try {
+          grpc_status = std::stoi(h.value);
+        }
+        catch (...) {
+          grpc_status = 2;  // UNKNOWN
+        }
+      } else if (h.name == "grpc-message") {
+        grpc_message = PercentDecode(h.value);
+      }
+    }
+  }
+
+  // Reader thread: append data, emit complete length-prefixed messages.
+  Error ConsumeData(const uint8_t* data, size_t len)
+  {
+    recv_buf.append(reinterpret_cast<const char*>(data), len);
+    size_t off = 0;
+    while (recv_buf.size() - off >= 5) {
+      const uint8_t* p =
+          reinterpret_cast<const uint8_t*>(recv_buf.data()) + off;
+      const uint8_t compressed = p[0];
+      const uint32_t msg_len = (static_cast<uint32_t>(p[1]) << 24) |
+                               (static_cast<uint32_t>(p[2]) << 16) |
+                               (static_cast<uint32_t>(p[3]) << 8) | p[4];
+      if (recv_buf.size() - off - 5 < msg_len) {
+        break;
+      }
+      if (compressed != 0) {
+        return Error(
+            "received compressed gRPC message but no compression was "
+            "negotiated");
+      }
+      if (on_message) {
+        on_message(recv_buf.substr(off + 5, msg_len));
+      }
+      off += 5 + msg_len;
+    }
+    if (off > 0) {
+      recv_buf.erase(0, off);
+    }
+    return Error::Success;
+  }
+
+  void Finish(const Error& transport_err)
+  {
+    OnDone cb;
+    Error err;
+    int status;
+    std::string message;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      if (done) {
+        return;
+      }
+      done = true;
+      cb = on_done;
+      if (!transport_err.IsOk()) {
+        err = transport_err;
+        status = -1;
+      } else if (!status_seen) {
+        err = Error("stream closed without grpc-status");
+        status = -1;
+      } else {
+        err = Error::Success;
+        status = grpc_status;
+      }
+      message = grpc_message;
+    }
+    if (cb) {
+      cb(err, status, message);
+    }
+  }
+};
+
+Error
+GrpcCall::Write(const std::string& serialized, bool end_of_calls)
+{
+  if (!state_) {
+    return Error("call not started");
+  }
+  if (serialized.size() > 0x7fffffffull) {
+    // role of the reference's 2 GB protobuf guard (grpc_client.cc:1345-1353)
+    return Error("gRPC message exceeds 2 GB limit");
+  }
+  std::string framed;
+  framed.reserve(5 + serialized.size());
+  framed.push_back('\0');
+  const uint32_t len = static_cast<uint32_t>(serialized.size());
+  framed.push_back(static_cast<char>((len >> 24) & 0xff));
+  framed.push_back(static_cast<char>((len >> 16) & 0xff));
+  framed.push_back(static_cast<char>((len >> 8) & 0xff));
+  framed.push_back(static_cast<char>(len & 0xff));
+  framed += serialized;
+  return state_->conn->SendData(
+      state_->stream_id, reinterpret_cast<const uint8_t*>(framed.data()),
+      framed.size(), end_of_calls);
+}
+
+Error
+GrpcCall::WritesDone()
+{
+  if (!state_) {
+    return Error("call not started");
+  }
+  return state_->conn->SendData(state_->stream_id, nullptr, 0, true);
+}
+
+Error
+GrpcCall::Cancel()
+{
+  if (!state_) {
+    return Error("call not started");
+  }
+  return state_->conn->CancelStream(state_->stream_id);
+}
+
+//==============================================================================
+// GrpcChannel
+
+Error
+GrpcChannel::Create(
+    std::shared_ptr<GrpcChannel>* channel, const std::string& url,
+    bool verbose)
+{
+  std::string host;
+  int port = 0;
+  Error err = ParseHostPort(url, &host, &port);
+  if (!err.IsOk()) {
+    return err;
+  }
+  auto ch = std::shared_ptr<GrpcChannel>(new GrpcChannel(url));
+  err = H2Connection::Connect(&ch->conn_, host, port, verbose);
+  if (!err.IsOk()) {
+    return err;
+  }
+  *channel = std::move(ch);
+  return Error::Success;
+}
+
+Error
+GrpcChannel::StartCall(
+    GrpcCall* call, const std::string& service, const std::string& method,
+    GrpcCall::OnMessage on_message, GrpcCall::OnDone on_done,
+    uint64_t timeout_us, const std::vector<Header>& extra_headers)
+{
+  auto state = std::make_shared<GrpcCall::State>();
+  state->conn = conn_;
+  state->on_message = std::move(on_message);
+  state->on_done = std::move(on_done);
+
+  std::vector<Header> headers = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", "/" + service + "/" + method},
+      {":authority", conn_->Authority()},
+      {"te", "trailers"},
+      {"content-type", "application/grpc"},
+      {"user-agent", "tpu-triton-client-cc-h2"},
+  };
+  if (timeout_us > 0) {
+    headers.push_back({"grpc-timeout", std::to_string(timeout_us) + "u"});
+  }
+  for (const auto& h : extra_headers) {
+    headers.push_back(h);
+  }
+
+  StreamHandler handler;
+  handler.on_headers = [state](std::vector<Header>&& hs) {
+    // trailers-only responses carry grpc-status here
+    state->ScanStatus(hs);
+  };
+  handler.on_data = [state](const uint8_t* data, size_t len) {
+    Error err = state->ConsumeData(data, len);
+    if (!err.IsOk()) {
+      state->conn->CancelStream(state->stream_id);
+      state->Finish(err);
+    }
+  };
+  handler.on_trailers = [state](std::vector<Header>&& hs) {
+    state->ScanStatus(hs);
+  };
+  handler.on_close = [state](Error err) { state->Finish(err); };
+
+  int32_t stream_id = 0;
+  Error err = conn_->StartStream(
+      &stream_id, headers, std::move(handler), /*end_stream=*/false);
+  if (!err.IsOk()) {
+    return err;
+  }
+  state->stream_id = stream_id;
+  call->state_ = std::move(state);
+  return Error::Success;
+}
+
+Error
+GrpcChannel::Unary(
+    const std::string& service, const std::string& method,
+    const std::string& request, std::string* response, uint64_t timeout_us,
+    const std::vector<Header>& extra_headers)
+{
+  struct Sync {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    Error err;
+    int status = -1;
+    std::string status_message;
+    std::string response;
+  };
+  auto sync = std::make_shared<Sync>();
+
+  GrpcCall call;
+  Error err = StartCall(
+      &call, service, method,
+      [sync](std::string&& msg) {
+        std::lock_guard<std::mutex> lk(sync->mu);
+        sync->response = std::move(msg);
+      },
+      [sync](Error e, int status, std::string message) {
+        std::lock_guard<std::mutex> lk(sync->mu);
+        sync->err = e;
+        sync->status = status;
+        sync->status_message = std::move(message);
+        sync->done = true;
+        sync->cv.notify_all();
+      },
+      timeout_us, extra_headers);
+  if (!err.IsOk()) {
+    return err;
+  }
+  err = call.Write(request, /*end_of_calls=*/true);
+  if (!err.IsOk()) {
+    return err;
+  }
+
+  std::unique_lock<std::mutex> lk(sync->mu);
+  if (timeout_us > 0) {
+    // client-side deadline on top of the grpc-timeout header
+    if (!sync->cv.wait_for(
+            lk, std::chrono::microseconds(timeout_us + 100000),
+            [&]() { return sync->done; })) {
+      lk.unlock();
+      call.Cancel();
+      return Error("Deadline Exceeded");
+    }
+  } else {
+    sync->cv.wait(lk, [&]() { return sync->done; });
+  }
+  if (!sync->err.IsOk()) {
+    return sync->err;
+  }
+  if (sync->status != 0) {
+    std::string msg = sync->status_message.empty()
+                          ? ("grpc-status " + std::to_string(sync->status))
+                          : sync->status_message;
+    if (sync->status == 4) {
+      msg = "Deadline Exceeded: " + msg;
+    }
+    return Error(msg);
+  }
+  *response = std::move(sync->response);
+  return Error::Success;
+}
+
+}  // namespace h2
+}  // namespace tc

@@ -1,0 +1,735 @@
+#include "h2_client.h"
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <cstring>
+
+namespace tc {
+namespace h2 {
+
+namespace {
+
+constexpr uint8_t kFrameData = 0x0;
+constexpr uint8_t kFrameHeaders = 0x1;
+constexpr uint8_t kFrameRstStream = 0x3;
+constexpr uint8_t kFrameSettings = 0x4;
+constexpr uint8_t kFramePushPromise = 0x5;
+constexpr uint8_t kFramePing = 0x6;
+constexpr uint8_t kFrameGoaway = 0x7;
+constexpr uint8_t kFrameWindowUpdate = 0x8;
+constexpr uint8_t kFrameContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;  // DATA/HEADERS
+constexpr uint8_t kFlagAck = 0x1;        // SETTINGS/PING
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+constexpr uint16_t kSettingsEnablePush = 0x2;
+
+// Our receive windows: per-stream via SETTINGS, connection via an
+// immediate WINDOW_UPDATE after the preface.
+constexpr int64_t kStreamRecvWindow = 4 << 20;
+constexpr int64_t kConnRecvWindowBoost = (32 << 20) - 65535;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+void
+PutUint32(uint8_t* p, uint32_t v)
+{
+  p[0] = (v >> 24) & 0xff;
+  p[1] = (v >> 16) & 0xff;
+  p[2] = (v >> 8) & 0xff;
+  p[3] = v & 0xff;
+}
+
+uint32_t
+GetUint32(const uint8_t* p)
+{
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+}  // namespace
+
+Error
+H2Connection::Connect(
+    std::shared_ptr<H2Connection>* connection, const std::string& host,
+    int port, bool verbose)
+{
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_str = std::to_string(port);
+  int rc = getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Error(
+        "failed to resolve " + host + ": " + std::string(gai_strerror(rc)));
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      continue;
+    }
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    return Error(
+        "unable to connect to " + host + ":" + port_str + ": " +
+        std::string(strerror(errno)));
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  auto conn = std::shared_ptr<H2Connection>(
+      new H2Connection(fd, host + ":" + port_str, verbose));
+
+  // preface + SETTINGS(ENABLE_PUSH=0, INITIAL_WINDOW_SIZE) + connection
+  // WINDOW_UPDATE, written before the reader starts.
+  std::vector<uint8_t> settings;
+  auto put_setting = [&settings](uint16_t id, uint32_t value) {
+    settings.push_back((id >> 8) & 0xff);
+    settings.push_back(id & 0xff);
+    size_t at = settings.size();
+    settings.resize(at + 4);
+    PutUint32(settings.data() + at, value);
+  };
+  put_setting(kSettingsEnablePush, 0);
+  put_setting(kSettingsInitialWindowSize, kStreamRecvWindow);
+
+  if (::send(fd, kPreface, sizeof(kPreface) - 1, MSG_NOSIGNAL) !=
+      static_cast<ssize_t>(sizeof(kPreface) - 1)) {
+    return Error("failed to send h2 preface: " + std::string(strerror(errno)));
+  }
+  Error err = conn->SendFrame(
+      kFrameSettings, 0, 0, settings.data(), settings.size());
+  if (!err.IsOk()) {
+    return err;
+  }
+  uint8_t wu[4];
+  PutUint32(wu, kConnRecvWindowBoost);
+  err = conn->SendFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+  if (!err.IsOk()) {
+    return err;
+  }
+
+  conn->reader_ = std::thread(&H2Connection::ReaderLoop, conn.get());
+  *connection = std::move(conn);
+  return Error::Success;
+}
+
+H2Connection::H2Connection(int fd, const std::string& authority, bool verbose)
+    : fd_(fd), authority_(authority), verbose_(verbose)
+{
+}
+
+H2Connection::~H2Connection()
+{
+  Shutdown();
+}
+
+void
+H2Connection::Shutdown()
+{
+  if (!dead_.exchange(true)) {
+    dead_reason_ = "connection shut down";
+    // best-effort GOAWAY
+    uint8_t payload[8] = {0};
+    SendFrameRaw(kFrameGoaway, 0, 0, payload, 8);
+  }
+  ::shutdown(fd_, SHUT_RDWR);
+  if (reader_.joinable()) {
+    if (std::this_thread::get_id() == reader_.get_id()) {
+      reader_.detach();
+    } else {
+      reader_.join();
+    }
+  }
+  FailAll(Error("connection closed"));
+  window_cv_.notify_all();
+  ping_cv_.notify_all();
+}
+
+Error
+H2Connection::SendFrame(
+    uint8_t type, uint8_t flags, int32_t stream_id, const uint8_t* payload,
+    size_t len)
+{
+  std::lock_guard<std::mutex> lk(write_mu_);
+  return SendFrameRaw(type, flags, stream_id, payload, len);
+}
+
+Error
+H2Connection::SendFrameRaw(
+    uint8_t type, uint8_t flags, int32_t stream_id, const uint8_t* payload,
+    size_t len)
+{
+  uint8_t hdr[9];
+  hdr[0] = (len >> 16) & 0xff;
+  hdr[1] = (len >> 8) & 0xff;
+  hdr[2] = len & 0xff;
+  hdr[3] = type;
+  hdr[4] = flags;
+  PutUint32(hdr + 5, static_cast<uint32_t>(stream_id));
+  struct iovec iov[2];
+  iov[0].iov_base = hdr;
+  iov[0].iov_len = 9;
+  iov[1].iov_base = const_cast<uint8_t*>(payload);
+  iov[1].iov_len = len;
+  size_t total = 9 + len;
+  size_t sent = 0;
+  int iov_at = 0;
+  struct msghdr msg;
+  while (sent < total) {
+    std::memset(&msg, 0, sizeof(msg));
+    msg.msg_iov = iov + iov_at;
+    msg.msg_iovlen = 2 - iov_at;
+    ssize_t n = ::sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) {
+        continue;
+      }
+      return Error("h2 send failed: " + std::string(strerror(errno)));
+    }
+    sent += n;
+    // advance iovecs
+    size_t adv = n;
+    while (adv > 0 && iov_at < 2) {
+      if (adv >= iov[iov_at].iov_len) {
+        adv -= iov[iov_at].iov_len;
+        iov[iov_at].iov_len = 0;
+        ++iov_at;
+      } else {
+        iov[iov_at].iov_base =
+            static_cast<uint8_t*>(iov[iov_at].iov_base) + adv;
+        iov[iov_at].iov_len -= adv;
+        adv = 0;
+      }
+    }
+  }
+  return Error::Success;
+}
+
+Error
+H2Connection::ReadExact(uint8_t* buf, size_t len)
+{
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::read(fd_, buf + got, len - got);
+    if (n == 0) {
+      return Error("h2 connection closed by peer");
+    }
+    if (n < 0) {
+      if (errno == EINTR) {
+        continue;
+      }
+      return Error("h2 read failed: " + std::string(strerror(errno)));
+    }
+    got += n;
+  }
+  return Error::Success;
+}
+
+Error
+H2Connection::StartStream(
+    int32_t* stream_id, const std::vector<Header>& headers,
+    StreamHandler handler, bool end_stream)
+{
+  if (dead_.load()) {
+    return Error("h2 connection is down: " + dead_reason_);
+  }
+  std::vector<uint8_t> block;
+  encoder_.EncodeBlock(headers, &block);
+
+  std::lock_guard<std::mutex> wlk(write_mu_);
+  const int32_t id = next_stream_id_;
+  next_stream_id_ += 2;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    Stream s;
+    s.handler = std::move(handler);
+    s.send_window = peer_initial_window_;
+    streams_.emplace(id, std::move(s));
+  }
+  size_t max_chunk = peer_max_frame_size_;
+  // HEADERS (+ CONTINUATION when the block exceeds one frame)
+  size_t off = 0;
+  bool first = true;
+  do {
+    size_t chunk = std::min(block.size() - off, max_chunk);
+    uint8_t type = first ? kFrameHeaders : kFrameContinuation;
+    uint8_t flags = 0;
+    if (first && end_stream) {
+      flags |= kFlagEndStream;
+    }
+    if (off + chunk == block.size()) {
+      flags |= kFlagEndHeaders;
+    }
+    Error err = SendFrameRaw(type, flags, id, block.data() + off, chunk);
+    if (!err.IsOk()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      streams_.erase(id);
+      return err;
+    }
+    off += chunk;
+    first = false;
+  } while (off < block.size());
+  *stream_id = id;
+  return Error::Success;
+}
+
+Error
+H2Connection::SendData(
+    int32_t stream_id, const uint8_t* data, size_t len, bool end_stream)
+{
+  size_t off = 0;
+  do {
+    size_t chunk = 0;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      window_cv_.wait(lk, [&]() {
+        if (dead_.load()) {
+          return true;
+        }
+        auto it = streams_.find(stream_id);
+        if (it == streams_.end()) {
+          return true;  // stream was reset
+        }
+        if (off >= len) {
+          return true;  // zero-length end-of-stream frame needs no window
+        }
+        return conn_send_window_ > 0 && it->second.send_window > 0;
+      });
+      if (dead_.load()) {
+        return Error("h2 connection is down: " + dead_reason_);
+      }
+      auto it = streams_.find(stream_id);
+      if (it == streams_.end()) {
+        return Error("stream closed by peer before request was sent");
+      }
+      if (len > off) {
+        chunk = std::min(
+            {len - off, static_cast<size_t>(conn_send_window_),
+             static_cast<size_t>(it->second.send_window),
+             peer_max_frame_size_});
+        conn_send_window_ -= chunk;
+        it->second.send_window -= chunk;
+      }
+    }
+    const bool last = (off + chunk >= len);
+    uint8_t flags = (last && end_stream) ? kFlagEndStream : 0;
+    Error err = SendFrame(kFrameData, flags, stream_id, data + off, chunk);
+    if (!err.IsOk()) {
+      return err;
+    }
+    off += chunk;
+  } while (off < len);
+  return Error::Success;
+}
+
+Error
+H2Connection::CancelStream(int32_t stream_id)
+{
+  uint8_t payload[4];
+  PutUint32(payload, 0x8);  // CANCEL
+  Error err = SendFrame(kFrameRstStream, 0, stream_id, payload, 4);
+  CloseStream(stream_id, Error("stream cancelled"));
+  return err;
+}
+
+Error
+H2Connection::Ping(int64_t timeout_ms)
+{
+  uint64_t my_ping;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    my_ping = ++ping_counter_;
+  }
+  uint8_t payload[8];
+  for (int i = 0; i < 8; ++i) {
+    payload[i] = (my_ping >> (8 * (7 - i))) & 0xff;
+  }
+  Error err = SendFrame(kFramePing, 0, 0, payload, 8);
+  if (!err.IsOk()) {
+    return err;
+  }
+  std::unique_lock<std::mutex> lk(mu_);
+  bool ok = ping_cv_.wait_for(
+      lk, std::chrono::milliseconds(timeout_ms),
+      [&]() { return dead_.load() || last_ping_ack_ >= my_ping; });
+  if (dead_.load()) {
+    return Error("h2 connection is down: " + dead_reason_);
+  }
+  if (!ok) {
+    return Error("h2 ping timed out");
+  }
+  return Error::Success;
+}
+
+void
+H2Connection::ReaderLoop()
+{
+  std::vector<uint8_t> payload;
+  for (;;) {
+    uint8_t hdr[9];
+    Error err = ReadExact(hdr, 9);
+    if (!err.IsOk()) {
+      if (!dead_.exchange(true)) {
+        dead_reason_ = err.Message();
+      }
+      FailAll(Error("h2 connection lost: " + dead_reason_));
+      window_cv_.notify_all();
+      ping_cv_.notify_all();
+      return;
+    }
+    const size_t len = (static_cast<size_t>(hdr[0]) << 16) |
+                       (static_cast<size_t>(hdr[1]) << 8) | hdr[2];
+    const uint8_t type = hdr[3];
+    const uint8_t flags = hdr[4];
+    const int32_t stream_id =
+        static_cast<int32_t>(GetUint32(hdr + 5) & 0x7fffffff);
+    payload.resize(len);
+    if (len > 0) {
+      err = ReadExact(payload.data(), len);
+      if (!err.IsOk()) {
+        if (!dead_.exchange(true)) {
+          dead_reason_ = err.Message();
+        }
+        FailAll(Error("h2 connection lost: " + dead_reason_));
+        window_cv_.notify_all();
+        ping_cv_.notify_all();
+        return;
+      }
+    }
+
+    switch (type) {
+      case kFrameData: {
+        const uint8_t* data = payload.data();
+        size_t data_len = len;
+        if (flags & kFlagPadded) {
+          if (data_len < 1) {
+            break;
+          }
+          uint8_t pad = data[0];
+          data += 1;
+          data_len -= 1;
+          data_len = (pad <= data_len) ? data_len - pad : 0;
+        }
+        StreamHandler handler;
+        bool deliver = false;
+        bool closed = false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) {
+            deliver = true;
+            handler = it->second.handler;
+            if (flags & kFlagEndStream) {
+              it->second.remote_closed = true;
+              closed = true;
+            }
+          }
+        }
+        if (deliver && data_len > 0 && handler.on_data) {
+          handler.on_data(data, data_len);
+        }
+        // replenish both windows for the full payload (padding included)
+        if (len > 0) {
+          uint8_t wu[4];
+          PutUint32(wu, static_cast<uint32_t>(len));
+          SendFrame(kFrameWindowUpdate, 0, 0, wu, 4);
+          if (deliver && !closed) {
+            SendFrame(kFrameWindowUpdate, 0, stream_id, wu, 4);
+          }
+        }
+        if (closed) {
+          CloseStream(stream_id, Error::Success);
+        }
+        break;
+      }
+      case kFrameHeaders: {
+        const uint8_t* block = payload.data();
+        size_t block_len = len;
+        if (flags & kFlagPadded) {
+          if (block_len < 1) {
+            break;
+          }
+          uint8_t pad = block[0];
+          block += 1;
+          block_len -= 1;
+          block_len = (pad <= block_len) ? block_len - pad : 0;
+        }
+        if (flags & kFlagPriority) {
+          if (block_len < 5) {
+            break;
+          }
+          block += 5;
+          block_len -= 5;
+        }
+        std::vector<uint8_t> copy(block, block + block_len);
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) {
+            it->second.header_block = std::move(copy);
+            it->second.header_block_end_stream =
+                (flags & kFlagEndStream) != 0;
+          }
+        }
+        if (flags & kFlagEndHeaders) {
+          DeliverHeaderBlock(stream_id);
+        }
+        break;
+      }
+      case kFrameContinuation: {
+        bool complete = (flags & kFlagEndHeaders) != 0;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = streams_.find(stream_id);
+          if (it != streams_.end()) {
+            it->second.header_block.insert(
+                it->second.header_block.end(), payload.begin(), payload.end());
+          }
+        }
+        if (complete) {
+          DeliverHeaderBlock(stream_id);
+        }
+        break;
+      }
+      case kFrameSettings:
+        HandleSettings(payload.data(), len, flags);
+        break;
+      case kFramePing: {
+        if (len != 8) {
+          break;
+        }
+        if (flags & kFlagAck) {
+          uint64_t v = 0;
+          for (int i = 0; i < 8; ++i) {
+            v = (v << 8) | payload[i];
+          }
+          std::lock_guard<std::mutex> lk(mu_);
+          if (v > last_ping_ack_) {
+            last_ping_ack_ = v;
+          }
+          ping_cv_.notify_all();
+        } else {
+          SendFrame(kFramePing, kFlagAck, 0, payload.data(), 8);
+        }
+        break;
+      }
+      case kFrameWindowUpdate:
+        HandleWindowUpdate(stream_id, payload.data(), len);
+        break;
+      case kFrameRstStream: {
+        uint32_t code = (len >= 4) ? GetUint32(payload.data()) : 0;
+        CloseStream(
+            stream_id,
+            Error("stream reset by server (h2 error " + std::to_string(code) +
+                  ")"));
+        break;
+      }
+      case kFrameGoaway: {
+        uint32_t last_id = (len >= 4) ? (GetUint32(payload.data()) & 0x7fffffff) : 0;
+        uint32_t code = (len >= 8) ? GetUint32(payload.data() + 4) : 0;
+        std::string debug;
+        if (len > 8) {
+          debug.assign(
+              reinterpret_cast<const char*>(payload.data() + 8), len - 8);
+        }
+        if (!dead_.exchange(true)) {
+          dead_reason_ = "server sent GOAWAY (error " + std::to_string(code) +
+                         (debug.empty() ? "" : ", " + debug) + ")";
+        }
+        // fail streams the server will not process
+        std::vector<int32_t> doomed;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (const auto& kv : streams_) {
+            if (static_cast<uint32_t>(kv.first) > last_id || code != 0) {
+              doomed.push_back(kv.first);
+            }
+          }
+        }
+        for (int32_t id : doomed) {
+          CloseStream(id, Error(dead_reason_));
+        }
+        window_cv_.notify_all();
+        ping_cv_.notify_all();
+        break;
+      }
+      case kFramePushPromise:
+        // pushes are disabled via SETTINGS; ignore defensively
+        break;
+      default:
+        break;
+    }
+  }
+}
+
+void
+H2Connection::HandleSettings(const uint8_t* p, size_t len, uint8_t flags)
+{
+  if (flags & kFlagAck) {
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (size_t off = 0; off + 6 <= len; off += 6) {
+      uint16_t id = (static_cast<uint16_t>(p[off]) << 8) | p[off + 1];
+      uint32_t value = GetUint32(p + off + 2);
+      switch (id) {
+        case kSettingsInitialWindowSize: {
+          int64_t delta =
+              static_cast<int64_t>(value) - peer_initial_window_;
+          peer_initial_window_ = value;
+          for (auto& kv : streams_) {
+            kv.second.send_window += delta;
+          }
+          break;
+        }
+        case kSettingsMaxFrameSize:
+          if (value >= 16384 && value <= (1u << 24) - 1) {
+            peer_max_frame_size_ = value;
+          }
+          break;
+        default:
+          break;
+      }
+    }
+  }
+  window_cv_.notify_all();
+  SendFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
+}
+
+void
+H2Connection::HandleWindowUpdate(
+    int32_t stream_id, const uint8_t* p, size_t len)
+{
+  if (len < 4) {
+    return;
+  }
+  uint32_t inc = GetUint32(p) & 0x7fffffff;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (stream_id == 0) {
+      conn_send_window_ += inc;
+    } else {
+      auto it = streams_.find(stream_id);
+      if (it != streams_.end()) {
+        it->second.send_window += inc;
+      }
+    }
+  }
+  window_cv_.notify_all();
+}
+
+void
+H2Connection::DeliverHeaderBlock(int32_t stream_id)
+{
+  std::vector<uint8_t> block;
+  bool end_stream = false;
+  bool saw_headers_before = false;
+  StreamHandler handler;
+  bool found = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = streams_.find(stream_id);
+    if (it != streams_.end()) {
+      found = true;
+      block = std::move(it->second.header_block);
+      it->second.header_block.clear();
+      end_stream = it->second.header_block_end_stream;
+      saw_headers_before = it->second.saw_headers;
+      it->second.saw_headers = true;
+      handler = it->second.handler;
+      if (end_stream) {
+        it->second.remote_closed = true;
+      }
+    }
+  }
+  // The HPACK dynamic table is connection-level state: decode even for
+  // unknown streams to keep the decoder in sync.
+  std::vector<Header> headers;
+  Error err = decoder_.DecodeBlock(block.data(), block.size(), &headers);
+  if (!found) {
+    return;
+  }
+  if (!err.IsOk()) {
+    CloseStream(stream_id, err);
+    return;
+  }
+  if (!saw_headers_before) {
+    if (handler.on_headers) {
+      handler.on_headers(std::move(headers));
+    }
+  } else {
+    if (handler.on_trailers) {
+      handler.on_trailers(std::move(headers));
+    }
+  }
+  if (end_stream) {
+    CloseStream(stream_id, Error::Success);
+  }
+}
+
+void
+H2Connection::CloseStream(int32_t stream_id, const Error& err)
+{
+  StreamHandler handler;
+  bool found = false;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = streams_.find(stream_id);
+    if (it != streams_.end()) {
+      handler = it->second.handler;
+      streams_.erase(it);
+      found = true;
+    }
+  }
+  if (found) {
+    window_cv_.notify_all();
+    if (handler.on_close) {
+      handler.on_close(err);
+    }
+  }
+}
+
+void
+H2Connection::FailAll(const Error& err)
+{
+  std::vector<StreamHandler> handlers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : streams_) {
+      handlers.push_back(kv.second.handler);
+    }
+    streams_.clear();
+  }
+  for (auto& h : handlers) {
+    if (h.on_close) {
+      h.on_close(err);
+    }
+  }
+}
+
+}  // namespace h2
+}  // namespace tc

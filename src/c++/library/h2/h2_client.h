@@ -1,0 +1,132 @@
+// Self-contained client-side HTTP/2 (h2c, RFC 9113) connection.
+//
+// The reference gRPC client delegates transport to grpc++'s channel
+// (reference src/c++/library/grpc_client.cc:78-145); this image has no
+// grpc++/nghttp2 headers, so the TPU-native stack speaks HTTP/2 directly
+// over a POSIX socket: connection preface + SETTINGS exchange, HPACK
+// header blocks (h2/hpack.h), multiplexed streams, both-direction flow
+// control, PING/GOAWAY handling, and a reader thread that dispatches
+// frames to per-stream handlers.  This is the substrate for the gRPC
+// channel (grpc_channel.h) — unary and bidirectional-streaming calls are
+// both just h2 streams.
+
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "hpack.h"
+
+namespace tc {
+namespace h2 {
+
+// Per-stream event callbacks, invoked on the connection reader thread.
+// Handlers must not issue blocking calls on the same connection.
+struct StreamHandler {
+  std::function<void(std::vector<Header>&&)> on_headers;
+  std::function<void(const uint8_t*, size_t)> on_data;
+  std::function<void(std::vector<Header>&&)> on_trailers;
+  // Terminal: stream fully closed (ok) or failed (error / RST / GOAWAY).
+  std::function<void(Error)> on_close;
+};
+
+class H2Connection {
+ public:
+  static Error Connect(
+      std::shared_ptr<H2Connection>* connection, const std::string& host,
+      int port, bool verbose = false);
+
+  ~H2Connection();
+  H2Connection(const H2Connection&) = delete;
+  H2Connection& operator=(const H2Connection&) = delete;
+
+  // Open a stream: send HEADERS (END_STREAM when no body follows).
+  Error StartStream(
+      int32_t* stream_id, const std::vector<Header>& headers,
+      StreamHandler handler, bool end_stream);
+
+  // Send body bytes on an open stream; blocks while the peer's flow-
+  // control window is exhausted. end_stream half-closes our side.
+  Error SendData(
+      int32_t stream_id, const uint8_t* data, size_t len, bool end_stream);
+
+  // Abort a stream (RST_STREAM CANCEL). The stream's on_close fires once.
+  Error CancelStream(int32_t stream_id);
+
+  // Liveness probe: h2 PING round-trip within timeout_ms.
+  Error Ping(int64_t timeout_ms);
+
+  bool Alive() const { return !dead_.load(); }
+  const std::string& Authority() const { return authority_; }
+
+  // Graceful shutdown: GOAWAY + close socket + join reader.
+  void Shutdown();
+
+ private:
+  H2Connection(int fd, const std::string& authority, bool verbose);
+
+  struct Stream {
+    StreamHandler handler;
+    bool saw_headers = false;       // response HEADERS delivered
+    bool remote_closed = false;     // peer sent END_STREAM
+    int64_t send_window = 0;
+    // CONTINUATION reassembly
+    std::vector<uint8_t> header_block;
+    bool header_block_end_stream = false;
+  };
+
+  void ReaderLoop();
+  Error SendFrame(
+      uint8_t type, uint8_t flags, int32_t stream_id, const uint8_t* payload,
+      size_t len);
+  // caller holds write_mu_ (or is single-threaded during setup/teardown)
+  Error SendFrameRaw(
+      uint8_t type, uint8_t flags, int32_t stream_id, const uint8_t* payload,
+      size_t len);
+  Error ReadExact(uint8_t* buf, size_t len);
+  void HandleSettings(const uint8_t* p, size_t len, uint8_t flags);
+  void HandleWindowUpdate(int32_t stream_id, const uint8_t* p, size_t len);
+  void HandleHeadersPayload(
+      int32_t stream_id, std::vector<uint8_t>&& block, bool end_stream);
+  void DeliverHeaderBlock(int32_t stream_id);
+  void CloseStream(int32_t stream_id, const Error& err);
+  void FailAll(const Error& err);
+
+  int fd_;
+  std::string authority_;
+  bool verbose_;
+  std::atomic<bool> dead_{false};
+  std::string dead_reason_;
+
+  std::thread reader_;
+  HpackEncoder encoder_;
+  HpackDecoder decoder_;  // reader thread only
+
+  std::mutex write_mu_;   // socket writes + next_stream_id_
+  int32_t next_stream_id_ = 1;
+
+  std::mutex mu_;         // streams_, windows, settings, ping
+  std::condition_variable window_cv_;
+  std::map<int32_t, Stream> streams_;
+  int64_t conn_send_window_ = 65535;
+  int64_t peer_initial_window_ = 65535;
+  size_t peer_max_frame_size_ = 16384;
+  uint64_t ping_counter_ = 0;
+  uint64_t last_ping_ack_ = 0;
+  std::condition_variable ping_cv_;
+
+  // receive-side flow control replenishment accounting
+  int64_t recv_since_update_ = 0;
+};
+
+}  // namespace h2
+}  // namespace tc

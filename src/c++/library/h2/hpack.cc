@@ -1,0 +1,435 @@
+#include "hpack.h"
+
+#include <dlfcn.h>
+
+#include <cstring>
+#include <mutex>
+
+namespace tc {
+namespace h2 {
+
+namespace {
+
+// RFC 7541 Appendix A static table (1-based).
+const Header kStaticTable[] = {
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+};
+constexpr size_t kStaticTableSize =
+    sizeof(kStaticTable) / sizeof(kStaticTable[0]);
+
+// ---------------------------------------------------------------------------
+// dlopen'd nghttp2 hd_inflate API (only these five symbols; all operate on
+// an opaque inflater pointer plus the simple nghttp2_nv struct, so the ABI
+// exposure is minimal and has been stable across libnghttp2.so.14).
+//
+struct Nghttp2Nv {
+  uint8_t* name;
+  uint8_t* value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+};
+
+constexpr int kNghttp2InflateFinal = 0x01;
+constexpr int kNghttp2InflateEmit = 0x02;
+
+struct Nghttp2Api {
+  int (*inflate_new)(void** inflater_ptr) = nullptr;
+  long (*inflate_hd2)(
+      void* inflater, Nghttp2Nv* nv_out, int* inflate_flags,
+      const uint8_t* in, size_t inlen, int in_final) = nullptr;
+  int (*inflate_end_headers)(void* inflater) = nullptr;
+  void (*inflate_del)(void* inflater) = nullptr;
+  bool ok = false;
+};
+
+const Nghttp2Api& GetNghttp2()
+{
+  static Nghttp2Api api;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    void* lib = dlopen("libnghttp2.so.14", RTLD_NOW | RTLD_LOCAL);
+    if (lib == nullptr) {
+      lib = dlopen("libnghttp2.so", RTLD_NOW | RTLD_LOCAL);
+    }
+    if (lib == nullptr) {
+      return;
+    }
+    api.inflate_new = reinterpret_cast<int (*)(void**)>(
+        dlsym(lib, "nghttp2_hd_inflate_new"));
+    api.inflate_hd2 =
+        reinterpret_cast<long (*)(void*, Nghttp2Nv*, int*, const uint8_t*,
+                                  size_t, int)>(
+            dlsym(lib, "nghttp2_hd_inflate_hd2"));
+    api.inflate_end_headers = reinterpret_cast<int (*)(void*)>(
+        dlsym(lib, "nghttp2_hd_inflate_end_headers"));
+    api.inflate_del = reinterpret_cast<void (*)(void*)>(
+        dlsym(lib, "nghttp2_hd_inflate_del"));
+    api.ok = api.inflate_new != nullptr && api.inflate_hd2 != nullptr &&
+             api.inflate_end_headers != nullptr && api.inflate_del != nullptr;
+  });
+  return api;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// integers
+
+void
+EncodeInteger(
+    uint64_t value, int prefix_bits, uint8_t first_byte_flags,
+    std::vector<uint8_t>* out)
+{
+  const uint64_t max_prefix = (1ull << prefix_bits) - 1;
+  if (value < max_prefix) {
+    out->push_back(first_byte_flags | static_cast<uint8_t>(value));
+    return;
+  }
+  out->push_back(first_byte_flags | static_cast<uint8_t>(max_prefix));
+  value -= max_prefix;
+  while (value >= 128) {
+    out->push_back(static_cast<uint8_t>((value & 0x7f) | 0x80));
+    value >>= 7;
+  }
+  out->push_back(static_cast<uint8_t>(value));
+}
+
+bool
+DecodeInteger(
+    const uint8_t* data, size_t len, size_t* pos, int prefix_bits,
+    uint64_t* value)
+{
+  if (*pos >= len) {
+    return false;
+  }
+  const uint64_t max_prefix = (1ull << prefix_bits) - 1;
+  uint64_t v = data[(*pos)++] & max_prefix;
+  if (v < max_prefix) {
+    *value = v;
+    return true;
+  }
+  int shift = 0;
+  for (;;) {
+    if (*pos >= len || shift > 56) {
+      return false;
+    }
+    uint8_t b = data[(*pos)++];
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    shift += 7;
+    if ((b & 0x80) == 0) {
+      break;
+    }
+  }
+  *value = v;
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// encoder
+
+namespace {
+
+void
+EncodeRawString(const std::string& s, std::vector<uint8_t>* out)
+{
+  // length with 7-bit prefix, H bit clear (no Huffman)
+  EncodeInteger(s.size(), 7, 0x00, out);
+  out->insert(out->end(), s.begin(), s.end());
+}
+
+}  // namespace
+
+void
+HpackEncoder::EncodeBlock(
+    const std::vector<Header>& headers, std::vector<uint8_t>* out) const
+{
+  for (const auto& h : headers) {
+    size_t name_index = 0;
+    size_t exact_index = 0;
+    for (size_t i = 0; i < kStaticTableSize; ++i) {
+      if (kStaticTable[i].name == h.name) {
+        if (name_index == 0) {
+          name_index = i + 1;
+        }
+        if (kStaticTable[i].value == h.value) {
+          exact_index = i + 1;
+          break;
+        }
+      }
+    }
+    if (exact_index != 0) {
+      // indexed header field: 1xxxxxxx
+      EncodeInteger(exact_index, 7, 0x80, out);
+    } else if (name_index != 0) {
+      // literal without indexing, indexed name: 0000xxxx
+      EncodeInteger(name_index, 4, 0x00, out);
+      EncodeRawString(h.value, out);
+    } else {
+      // literal without indexing, new name
+      out->push_back(0x00);
+      EncodeRawString(h.name, out);
+      EncodeRawString(h.value, out);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// decoder
+
+HpackDecoder::HpackDecoder(bool use_nghttp2)
+{
+  const auto& api = GetNghttp2();
+  if (use_nghttp2 && api.ok) {
+    void* inflater = nullptr;
+    if (api.inflate_new(&inflater) == 0) {
+      inflater_ = inflater;
+    }
+  }
+}
+
+HpackDecoder::~HpackDecoder()
+{
+  if (inflater_ != nullptr) {
+    GetNghttp2().inflate_del(inflater_);
+  }
+}
+
+Error
+HpackDecoder::DecodeBlock(
+    const uint8_t* data, size_t len, std::vector<Header>* out)
+{
+  if (inflater_ == nullptr) {
+    return DecodeBlockFallback(data, len, out);
+  }
+  const auto& api = GetNghttp2();
+  const uint8_t* pos = data;
+  size_t remaining = len;
+  for (;;) {
+    Nghttp2Nv nv;
+    int flags = 0;
+    long rv = api.inflate_hd2(inflater_, &nv, &flags, pos, remaining, 1);
+    if (rv < 0) {
+      return Error(
+          "HPACK decode failed (nghttp2 rc " + std::to_string(rv) + ")");
+    }
+    pos += rv;
+    remaining -= static_cast<size_t>(rv);
+    if (flags & kNghttp2InflateEmit) {
+      out->push_back(
+          Header{std::string(reinterpret_cast<char*>(nv.name), nv.namelen),
+                 std::string(reinterpret_cast<char*>(nv.value), nv.valuelen)});
+    }
+    if (flags & kNghttp2InflateFinal) {
+      api.inflate_end_headers(inflater_);
+      return Error::Success;
+    }
+    if (remaining == 0 && (flags & kNghttp2InflateEmit) == 0) {
+      return Error("HPACK decode stalled before end of block");
+    }
+  }
+}
+
+const Header*
+HpackDecoder::TableLookup(uint64_t index)
+{
+  if (index == 0) {
+    return nullptr;
+  }
+  if (index <= kStaticTableSize) {
+    return &kStaticTable[index - 1];
+  }
+  size_t dyn_index = index - kStaticTableSize - 1;
+  if (dyn_index >= dyn_.size()) {
+    return nullptr;
+  }
+  return &dyn_[dyn_index];
+}
+
+void
+HpackDecoder::DynInsert(const Header& h)
+{
+  const size_t entry_bytes = h.name.size() + h.value.size() + 32;
+  dyn_.push_front(h);
+  dyn_bytes_ += entry_bytes;
+  while (dyn_bytes_ > dyn_max_ && !dyn_.empty()) {
+    const Header& old = dyn_.back();
+    dyn_bytes_ -= old.name.size() + old.value.size() + 32;
+    dyn_.pop_back();
+  }
+  if (dyn_.empty()) {
+    dyn_bytes_ = 0;
+  }
+}
+
+Error
+HpackDecoder::ReadString(
+    const uint8_t* data, size_t len, size_t* pos, std::string* out)
+{
+  if (*pos >= len) {
+    return Error("HPACK string truncated");
+  }
+  const bool huffman = (data[*pos] & 0x80) != 0;
+  uint64_t slen = 0;
+  if (!DecodeInteger(data, len, pos, 7, &slen)) {
+    return Error("HPACK string length truncated");
+  }
+  if (*pos + slen > len) {
+    return Error("HPACK string body truncated");
+  }
+  if (huffman) {
+    return Error(
+        "peer sent a Huffman-coded header literal and libnghttp2 is not "
+        "available to decode it");
+  }
+  out->assign(reinterpret_cast<const char*>(data + *pos), slen);
+  *pos += slen;
+  return Error::Success;
+}
+
+Error
+HpackDecoder::DecodeBlockFallback(
+    const uint8_t* data, size_t len, std::vector<Header>* out)
+{
+  size_t pos = 0;
+  while (pos < len) {
+    const uint8_t b = data[pos];
+    if (b & 0x80) {
+      // indexed header field
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 7, &index)) {
+        return Error("HPACK indexed field truncated");
+      }
+      const Header* h = TableLookup(index);
+      if (h == nullptr) {
+        return Error("HPACK index " + std::to_string(index) + " out of range");
+      }
+      out->push_back(*h);
+    } else if (b & 0x40) {
+      // literal with incremental indexing (6-bit name index)
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 6, &index)) {
+        return Error("HPACK literal truncated");
+      }
+      Header h;
+      if (index != 0) {
+        const Header* t = TableLookup(index);
+        if (t == nullptr) {
+          return Error("HPACK name index out of range");
+        }
+        h.name = t->name;
+      } else {
+        Error err = ReadString(data, len, &pos, &h.name);
+        if (!err.IsOk()) {
+          return err;
+        }
+      }
+      Error err = ReadString(data, len, &pos, &h.value);
+      if (!err.IsOk()) {
+        return err;
+      }
+      DynInsert(h);
+      out->push_back(h);
+    } else if (b & 0x20) {
+      // dynamic table size update
+      uint64_t size = 0;
+      if (!DecodeInteger(data, len, &pos, 5, &size)) {
+        return Error("HPACK table-size update truncated");
+      }
+      dyn_max_ = size;
+      while (dyn_bytes_ > dyn_max_ && !dyn_.empty()) {
+        const Header& old = dyn_.back();
+        dyn_bytes_ -= old.name.size() + old.value.size() + 32;
+        dyn_.pop_back();
+      }
+    } else {
+      // literal without indexing / never indexed (4-bit name index)
+      uint64_t index = 0;
+      if (!DecodeInteger(data, len, &pos, 4, &index)) {
+        return Error("HPACK literal truncated");
+      }
+      Header h;
+      if (index != 0) {
+        const Header* t = TableLookup(index);
+        if (t == nullptr) {
+          return Error("HPACK name index out of range");
+        }
+        h.name = t->name;
+      } else {
+        Error err = ReadString(data, len, &pos, &h.name);
+        if (!err.IsOk()) {
+          return err;
+        }
+      }
+      Error err = ReadString(data, len, &pos, &h.value);
+      if (!err.IsOk()) {
+        return err;
+      }
+      out->push_back(h);
+    }
+  }
+  return Error::Success;
+}
+
+}  // namespace h2
+}  // namespace tc

@@ -1,0 +1,89 @@
+// HPACK (RFC 7541) header codec for the self-contained HTTP/2 transport.
+//
+// The reference's gRPC client rides grpc++ and never sees HPACK; this
+// image has no grpc++ headers, so the TPU-native stack carries its own
+// HTTP/2 layer (h2_client.{h,cc}) and this codec.
+//
+// Encoder: emits indexed fields for exact static-table matches and
+// literal-without-indexing otherwise — never Huffman, never dynamic-table
+// inserts.  Both are always legal for a sender and keep the encoder
+// state-free (one less thing to corrupt across streams).
+//
+// Decoder: a conformant peer may use Huffman coding and dynamic-table
+// inserts, so decoding needs the full protocol.  When libnghttp2 is
+// present (runtime .so only in this image — no headers) its tiny, ABI-
+// stable hd_inflate API is dlopen'd for the job; otherwise a self-
+// contained fallback decoder handles everything except Huffman-coded
+// literals (rejected with a clear error).
+
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc {
+namespace h2 {
+
+struct Header {
+  std::string name;
+  std::string value;
+};
+
+// Append an HPACK-coded integer with the given prefix size to `out`.
+// `first_byte_flags` carries the pattern bits above the prefix.
+void EncodeInteger(
+    uint64_t value, int prefix_bits, uint8_t first_byte_flags,
+    std::vector<uint8_t>* out);
+
+// Decode an HPACK integer; advances *pos. Returns false on truncation.
+bool DecodeInteger(
+    const uint8_t* data, size_t len, size_t* pos, int prefix_bits,
+    uint64_t* value);
+
+class HpackEncoder {
+ public:
+  // Encode a complete header block (no CONTINUATION splitting here; the
+  // frame layer handles that).
+  void EncodeBlock(
+      const std::vector<Header>& headers, std::vector<uint8_t>* out) const;
+};
+
+class HpackDecoder {
+ public:
+  // use_nghttp2=false forces the self-contained fallback decoder (tests)
+  explicit HpackDecoder(bool use_nghttp2 = true);
+  ~HpackDecoder();
+  HpackDecoder(const HpackDecoder&) = delete;
+  HpackDecoder& operator=(const HpackDecoder&) = delete;
+
+  // Decode one complete header block (after CONTINUATION reassembly).
+  // The decoder is stateful across blocks on one connection (dynamic
+  // table); use one instance per connection, reader thread only.
+  Error DecodeBlock(
+      const uint8_t* data, size_t len, std::vector<Header>* out);
+
+  // True when the nghttp2 inflater backs this decoder (test hook).
+  bool UsingNghttp2() const { return inflater_ != nullptr; }
+
+ private:
+  Error DecodeBlockFallback(
+      const uint8_t* data, size_t len, std::vector<Header>* out);
+  Error ReadString(
+      const uint8_t* data, size_t len, size_t* pos, std::string* out);
+  const Header* TableLookup(uint64_t index);
+  void DynInsert(const Header& h);
+
+  void* inflater_ = nullptr;  // nghttp2_hd_inflater*, when available
+
+  // fallback dynamic table (newest first, per RFC 7541 §2.3.2)
+  std::deque<Header> dyn_;
+  size_t dyn_bytes_ = 0;
+  size_t dyn_max_ = 4096;
+};
+
+}  // namespace h2
+}  // namespace tc

@@ -1,5 +1,8 @@
 #include "client_backend.h"
 
+#include <google/protobuf/util/json_util.h>
+
+#include "grpc_client.h"
 #include "http_client.h"
 
 namespace pa {
@@ -204,6 +207,224 @@ class TritonHttpBackend : public ClientBackend {
   std::unique_ptr<tc::InferenceServerHttpClient> client_;
 };
 
+// Triton-gRPC backend: wraps the gRPC client library (role of the gRPC
+// path in reference client_backend/triton/triton_client_backend.{h,cc}).
+// Metadata/config/statistics come back as protobuf and are converted to
+// JSON so the model parser sees one format for both protocols.
+class TritonGrpcBackend : public ClientBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    auto* b = new TritonGrpcBackend();
+    tc::Error err = tc::InferenceServerGrpcClient::Create(
+        &b->client_, config.url, config.verbose);
+    if (!err.IsOk()) {
+      delete b;
+      return err;
+    }
+    backend->reset(b);
+    return tc::Error::Success;
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    return client_->IsServerReady(ready);
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    inference::ModelMetadataResponse metadata;
+    tc::Error err =
+        client_->ModelMetadata(&metadata, model_name, model_version);
+    if (!err.IsOk()) {
+      return err;
+    }
+    return ToJson(metadata, metadata_json);
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    inference::ModelConfigResponse config;
+    tc::Error err = client_->ModelConfig(&config, model_name, model_version);
+    if (!err.IsOk()) {
+      return err;
+    }
+    // the parser expects the bare config object, not the RPC wrapper
+    return ToJson(config.config(), config_json);
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) override
+  {
+    inference::ModelStatisticsResponse stats;
+    tc::Error err = client_->ModelInferenceStatistics(&stats, model_name);
+    if (!err.IsOk()) {
+      return err;
+    }
+    return ToJson(stats, stats_json);
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    std::vector<std::unique_ptr<tc::InferInput>> owned_inputs;
+    std::vector<std::unique_ptr<tc::InferRequestedOutput>> owned_outputs;
+    std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    tc::Error err = BuildRequest(
+        request, &owned_inputs, &owned_outputs, &inputs, &outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    tc::InferOptions options(request.model_name);
+    FillOptions(request, &options);
+    tc::InferResult* raw_result = nullptr;
+    err = client_->Infer(&raw_result, options, inputs, outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    ConvertOutputs(raw_result, request.requested_outputs, result);
+    delete raw_result;
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback, const BackendInferRequest& request) override
+  {
+    auto owned_inputs =
+        std::make_shared<std::vector<std::unique_ptr<tc::InferInput>>>();
+    auto owned_outputs = std::make_shared<
+        std::vector<std::unique_ptr<tc::InferRequestedOutput>>>();
+    std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    tc::Error err = BuildRequest(
+        request, owned_inputs.get(), owned_outputs.get(), &inputs, &outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    tc::InferOptions options(request.model_name);
+    FillOptions(request, &options);
+    std::vector<std::string> output_names = request.requested_outputs;
+    return client_->AsyncInfer(
+        [callback, owned_inputs, owned_outputs,
+         output_names](tc::InferResult* raw_result) {
+          BackendInferResult result;
+          ConvertOutputs(raw_result, output_names, &result);
+          delete raw_result;
+          callback(std::move(result));
+        },
+        options, inputs, outputs);
+  }
+
+  tc::Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key,
+      size_t byte_size) override
+  {
+    return client_->RegisterSystemSharedMemory(name, key, byte_size);
+  }
+  tc::Error UnregisterSystemSharedMemory(const std::string& name) override
+  {
+    return client_->UnregisterSystemSharedMemory(name);
+  }
+  tc::Error RegisterXlaSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      size_t byte_size, int device_ordinal) override
+  {
+    return client_->RegisterXlaSharedMemory(
+        name, raw_handle, byte_size, device_ordinal);
+  }
+  tc::Error UnregisterXlaSharedMemory(const std::string& name) override
+  {
+    return client_->UnregisterXlaSharedMemory(name);
+  }
+
+ private:
+  static tc::Error ToJson(
+      const google::protobuf::Message& message, std::string* json)
+  {
+    google::protobuf::util::JsonPrintOptions options;
+    options.preserve_proto_field_names = true;
+    json->clear();
+    auto status =
+        google::protobuf::util::MessageToJsonString(message, json, options);
+    if (!status.ok()) {
+      return tc::Error("protobuf -> json conversion failed");
+    }
+    return tc::Error::Success;
+  }
+
+  static void FillOptions(
+      const BackendInferRequest& request, tc::InferOptions* options)
+  {
+    options->model_version_ = request.model_version;
+    options->request_id_ = request.request_id;
+    options->sequence_id_ = request.sequence_id;
+    options->sequence_start_ = request.sequence_start;
+    options->sequence_end_ = request.sequence_end;
+  }
+
+  static tc::Error BuildRequest(
+      const BackendInferRequest& request,
+      std::vector<std::unique_ptr<tc::InferInput>>* owned_inputs,
+      std::vector<std::unique_ptr<tc::InferRequestedOutput>>* owned_outputs,
+      std::vector<tc::InferInput*>* inputs,
+      std::vector<const tc::InferRequestedOutput*>* outputs)
+  {
+    for (const auto& in : request.inputs) {
+      tc::InferInput* input;
+      tc::Error err =
+          tc::InferInput::Create(&input, in.name, in.shape, in.datatype);
+      if (!err.IsOk()) {
+        return err;
+      }
+      owned_inputs->emplace_back(input);
+      if (!in.shm_region.empty()) {
+        input->SetSharedMemory(in.shm_region, in.shm_byte_size, in.shm_offset);
+      } else {
+        input->AppendRaw(in.data.data(), in.data.size());
+      }
+      inputs->push_back(input);
+    }
+    for (const auto& name : request.requested_outputs) {
+      tc::InferRequestedOutput* output;
+      tc::Error err = tc::InferRequestedOutput::Create(&output, name);
+      if (!err.IsOk()) {
+        return err;
+      }
+      owned_outputs->emplace_back(output);
+      outputs->push_back(output);
+    }
+    return tc::Error::Success;
+  }
+
+  static void ConvertOutputs(
+      tc::InferResult* raw, const std::vector<std::string>& output_names,
+      BackendInferResult* result)
+  {
+    result->status = raw->RequestStatus();
+    raw->Id(&result->request_id);
+    if (!result->status.IsOk()) {
+      return;
+    }
+    for (const auto& name : output_names) {
+      const uint8_t* buf;
+      size_t len;
+      if (raw->RawData(name, &buf, &len).IsOk()) {
+        result->outputs[name].assign(buf, buf + len);
+      }
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client_;
+};
+
 tc::Error
 ClientBackendFactory::Create(
     std::shared_ptr<ClientBackend>* backend,
@@ -213,10 +434,7 @@ ClientBackendFactory::Create(
     case BackendKind::TRITON_HTTP:
       return TritonHttpBackend::Create(backend, config);
     case BackendKind::TRITON_GRPC:
-      return tc::Error(
-          "the C++ gRPC backend requires grpc++ headers not present in "
-          "this build environment; use the HTTP backend (same v2 "
-          "semantics) or the Python gRPC client");
+      return TritonGrpcBackend::Create(backend, config);
     case BackendKind::MOCK:
       return tc::Error(
           "mock backend is constructed directly in tests");

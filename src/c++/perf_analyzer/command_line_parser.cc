@@ -65,6 +65,7 @@ const struct option kLongOptions[] = {
     {"random-seed", required_argument, nullptr, OPT_SEED},
     {"num-threads", required_argument, nullptr, OPT_NUM_THREADS},
     {"service-kind", required_argument, nullptr, OPT_SERVICE_KIND},
+    {"protocol", required_argument, nullptr, 'i'},
     {"concurrency", required_argument, nullptr, 'c'},
     {"request-rate", required_argument, nullptr, 2000},
     {nullptr, 0, nullptr, 0},
@@ -153,7 +154,7 @@ CLParser::Parse(
   optind = 1;  // reset for repeated calls (tests)
   int opt;
   while ((opt = getopt_long(
-              argc, argv, "hvam:x:u:b:p:c:f:z", kLongOptions, nullptr)) !=
+              argc, argv, "hvam:x:u:b:p:c:f:zi:", kLongOptions, nullptr)) !=
          -1) {
     switch (opt) {
       case 'h':
@@ -176,6 +177,18 @@ CLParser::Parse(
         break;
       case 'u':
         params->url = optarg;
+        params->url_specified = true;
+        break;
+      case 'i':
+        if (strcmp(optarg, "http") == 0 || strcmp(optarg, "HTTP") == 0) {
+          params->kind = BackendKind::TRITON_HTTP;
+        } else if (
+            strcmp(optarg, "grpc") == 0 || strcmp(optarg, "gRPC") == 0) {
+          params->kind = BackendKind::TRITON_GRPC;
+        } else {
+          *error = std::string("unknown protocol ") + optarg;
+          return false;
+        }
         break;
       case 'b':
         params->batch_size = atoi(optarg);
@@ -301,6 +314,9 @@ CLParser::Parse(
   if (!params->usage_requested && params->model_name.empty()) {
     *error = "-m/--model-name is required";
     return false;
+  }
+  if (!params->url_specified && params->kind == BackendKind::TRITON_GRPC) {
+    params->url = "localhost:8001";
   }
   if (params->request_rate_start > 0 && params->concurrency_start > 1) {
     *error =

@@ -17,6 +17,7 @@ struct PerfAnalyzerParameters {
   std::string model_name;
   std::string model_version;
   std::string url = "localhost:8000";
+  bool url_specified = false;  // -u given; else default follows protocol
   BackendKind kind = BackendKind::TRITON_HTTP;
   bool verbose = false;
   bool async = false;

@@ -1,0 +1,191 @@
+// Unit tests for the gRPC client tier that need no server: HPACK integer
+// and header-block codecs (both the nghttp2-backed and fallback decode
+// paths), grpc-message percent decoding, and ModelInferRequest protobuf
+// assembly. The wire-level integration tests live in
+// tests/test_cc_grpc.py against a real grpcio server.
+
+#include <cstdio>
+#include <cstring>
+
+#include "grpc_channel.h"
+#include "grpc_service.pb.h"
+#include "hpack.h"
+
+static int failures = 0;
+static int checks = 0;
+
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    ++checks;                                                         \
+    if (!(cond)) {                                                    \
+      ++failures;                                                     \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    }                                                                 \
+  } while (0)
+
+#define CHECK_OK(err)                                                  \
+  do {                                                                 \
+    ++checks;                                                          \
+    tc::Error e_ = (err);                                              \
+    if (!e_.IsOk()) {                                                  \
+      ++failures;                                                      \
+      fprintf(                                                         \
+          stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,              \
+          e_.Message().c_str());                                       \
+    }                                                                  \
+  } while (0)
+
+using tc::h2::DecodeInteger;
+using tc::h2::EncodeInteger;
+using tc::h2::Header;
+using tc::h2::HpackDecoder;
+using tc::h2::HpackEncoder;
+
+static void
+TestIntegerCodec()
+{
+  // RFC 7541 C.1 examples + boundaries
+  const uint64_t values[] = {0, 1, 9, 10, 30, 31, 32, 127, 128, 1337,
+                             16383, 16384, 0xffffffffull};
+  for (int prefix = 4; prefix <= 8; ++prefix) {
+    for (uint64_t v : values) {
+      std::vector<uint8_t> buf;
+      EncodeInteger(v, prefix, 0, &buf);
+      size_t pos = 0;
+      uint64_t out = 0;
+      CHECK(DecodeInteger(buf.data(), buf.size(), &pos, prefix, &out));
+      CHECK(out == v);
+      CHECK(pos == buf.size());
+    }
+  }
+  // the RFC's worked example: 1337 with 5-bit prefix -> 1f 9a 0a
+  std::vector<uint8_t> buf;
+  EncodeInteger(1337, 5, 0, &buf);
+  CHECK(buf.size() == 3);
+  CHECK(buf[0] == 0x1f && buf[1] == 0x9a && buf[2] == 0x0a);
+}
+
+static void
+RoundTrip(HpackDecoder* decoder)
+{
+  HpackEncoder encoder;
+  std::vector<Header> in = {
+      {":method", "POST"},        // exact static match
+      {":scheme", "http"},        // exact static match
+      {":path", "/inference.GRPCInferenceService/ModelInfer"},
+      {":authority", "localhost:8001"},
+      {"te", "trailers"},
+      {"content-type", "application/grpc"},
+      {"grpc-timeout", "1000000u"},
+      {"x-empty", ""},
+  };
+  std::vector<uint8_t> block;
+  encoder.EncodeBlock(in, &block);
+  std::vector<Header> out;
+  CHECK_OK(decoder->DecodeBlock(block.data(), block.size(), &out));
+  CHECK(out.size() == in.size());
+  for (size_t i = 0; i < in.size() && i < out.size(); ++i) {
+    CHECK(out[i].name == in[i].name);
+    CHECK(out[i].value == in[i].value);
+  }
+}
+
+static void
+TestHpackRoundTripNghttp2()
+{
+  HpackDecoder decoder;
+  if (!decoder.UsingNghttp2()) {
+    fprintf(stderr, "note: libnghttp2 unavailable, skipping\n");
+    return;
+  }
+  RoundTrip(&decoder);
+}
+
+static void
+TestHpackRoundTripFallback()
+{
+  HpackDecoder decoder(/*use_nghttp2=*/false);
+  CHECK(!decoder.UsingNghttp2());
+  RoundTrip(&decoder);
+}
+
+static void
+TestHpackFallbackDynamicTable()
+{
+  // hand-encoded: literal WITH incremental indexing (new name), then an
+  // indexed reference to the dynamic entry (index 62 = static size + 1)
+  HpackDecoder decoder(/*use_nghttp2=*/false);
+  std::vector<uint8_t> block;
+  block.push_back(0x40);  // literal w/ incremental indexing, new name
+  block.push_back(11);    // name len
+  const char* name = "grpc-status";
+  block.insert(block.end(), name, name + 11);
+  block.push_back(1);
+  block.push_back('0');
+  block.push_back(0x80 | 62);  // indexed: first dynamic entry
+  std::vector<Header> out;
+  CHECK_OK(decoder.DecodeBlock(block.data(), block.size(), &out));
+  CHECK(out.size() == 2);
+  CHECK(out[0].name == out[1].name);
+  CHECK(out[0].value == "0" && out[1].value == "0");
+}
+
+static void
+TestHpackFallbackRejectsHuffman()
+{
+  HpackDecoder decoder(/*use_nghttp2=*/false);
+  // literal w/o indexing, new name, Huffman bit set on name
+  std::vector<uint8_t> block = {0x00, 0x83, 0xaa, 0xbb, 0xcc};
+  std::vector<Header> out;
+  tc::Error err = decoder.DecodeBlock(block.data(), block.size(), &out);
+  CHECK(!err.IsOk());
+  CHECK(err.Message().find("Huffman") != std::string::npos);
+}
+
+static void
+TestPercentDecode()
+{
+  CHECK(tc::h2::PercentDecode("model%20not%20found") == "model not found");
+  CHECK(tc::h2::PercentDecode("plain") == "plain");
+  CHECK(tc::h2::PercentDecode("trailing%2") == "trailing%2");
+  CHECK(tc::h2::PercentDecode("%41%42") == "AB");
+}
+
+static void
+TestModelInferRequestProto()
+{
+  inference::ModelInferRequest request;
+  request.set_model_name("simple");
+  auto* input = request.add_inputs();
+  input->set_name("INPUT0");
+  input->set_datatype("INT32");
+  input->add_shape(1);
+  input->add_shape(16);
+  std::string raw(64, '\x01');
+  request.add_raw_input_contents(raw);
+  (*request.mutable_parameters())["sequence_id"].set_uint64_param(42);
+
+  std::string serialized;
+  CHECK(request.SerializeToString(&serialized));
+  inference::ModelInferRequest parsed;
+  CHECK(parsed.ParseFromString(serialized));
+  CHECK(parsed.model_name() == "simple");
+  CHECK(parsed.inputs_size() == 1);
+  CHECK(parsed.inputs(0).shape(1) == 16);
+  CHECK(parsed.raw_input_contents(0).size() == 64);
+  CHECK(parsed.parameters().at("sequence_id").uint64_param() == 42);
+}
+
+int
+main()
+{
+  TestIntegerCodec();
+  TestHpackRoundTripNghttp2();
+  TestHpackRoundTripFallback();
+  TestHpackFallbackDynamicTable();
+  TestHpackFallbackRejectsHuffman();
+  TestPercentDecode();
+  TestModelInferRequestProto();
+  printf("%d checks, %d failures\n", checks, failures);
+  return failures == 0 ? 0 : 1;
+}
