@@ -1,5 +1,6 @@
 #include "grpc_client.h"
 
+#include <chrono>
 #include <cstdlib>
 #include <cstring>
 #include <iostream>
@@ -257,31 +258,66 @@ InferenceServerGrpcClient::Create(
     const SslOptions& ssl_options, const KeepAliveOptions& keepalive_options)
 {
   (void)ssl_options;
-  (void)keepalive_options;
   if (use_ssl) {
     return Error(
         "SSL is not supported by the in-tree h2 transport; terminate TLS in "
-        "a local proxy or use the insecure port");
+        "a local proxy (e.g. stunnel/envoy) or use the insecure port");
   }
   std::shared_ptr<h2::GrpcChannel> channel;
   Error err = AcquireChannel(&channel, server_url, verbose);
   if (!err.IsOk()) {
     return err;
   }
-  client->reset(new InferenceServerGrpcClient(std::move(channel), verbose));
+  client->reset(new InferenceServerGrpcClient(
+      std::move(channel), verbose, keepalive_options));
   return Error::Success;
 }
 
 InferenceServerGrpcClient::InferenceServerGrpcClient(
-    std::shared_ptr<h2::GrpcChannel> channel, bool verbose)
-    : InferenceServerClient(verbose), channel_(std::move(channel))
+    std::shared_ptr<h2::GrpcChannel> channel, bool verbose,
+    const KeepAliveOptions& keepalive_options)
+    : InferenceServerClient(verbose), channel_(std::move(channel)),
+      keepalive_options_(keepalive_options)
 {
   worker_ = std::thread(&InferenceServerGrpcClient::DispatchWorker, this);
+  if (keepalive_options_.keepalive_time_ms > 0 &&
+      keepalive_options_.keepalive_time_ms < INT32_MAX) {
+    keepalive_thread_ =
+        std::thread(&InferenceServerGrpcClient::KeepAliveWorker, this);
+  }
 }
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient()
 {
   StopStream();
+  {
+    std::lock_guard<std::mutex> lk(keepalive_mu_);
+    keepalive_exit_ = true;
+  }
+  keepalive_cv_.notify_all();
+  if (keepalive_thread_.joinable()) {
+    keepalive_thread_.join();
+  }
+  // Cancel and drain in-flight AsyncInfer calls: their completions run on
+  // the h2 reader thread and enqueue onto this client's worker — neither
+  // may happen after teardown.  Cancel outside async_mu_ (CancelStream
+  // fires on_close synchronously, which re-enters async_mu_).
+  std::vector<h2::GrpcCall> pending;
+  {
+    std::lock_guard<std::mutex> lk(async_mu_);
+    for (auto& kv : outstanding_calls_) {
+      pending.push_back(kv.second);
+    }
+  }
+  for (auto& call : pending) {
+    call.Cancel();
+  }
+  {
+    std::unique_lock<std::mutex> lk(async_mu_);
+    async_cv_.wait_for(lk, std::chrono::seconds(10), [&]() {
+      return outstanding_async_ == 0;
+    });
+  }
   {
     std::lock_guard<std::mutex> lk(worker_mu_);
     worker_exit_ = true;
@@ -291,6 +327,54 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient()
     worker_.join();
   }
   ReleaseChannel(channel_);
+}
+
+void
+InferenceServerGrpcClient::KeepAliveWorker()
+{
+  uint64_t last_activity = call_activity_.load();
+  int pings_without_data = 0;
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(keepalive_mu_);
+      keepalive_cv_.wait_for(
+          lk,
+          std::chrono::milliseconds(keepalive_options_.keepalive_time_ms),
+          [&]() { return keepalive_exit_; });
+      if (keepalive_exit_) {
+        return;
+      }
+    }
+    if (!channel_->Alive()) {
+      return;
+    }
+    const uint64_t activity = call_activity_.load();
+    if (activity != last_activity) {
+      last_activity = activity;
+      pings_without_data = 0;
+    } else if (!keepalive_options_.keepalive_permit_without_calls) {
+      continue;  // idle and not permitted to ping without calls
+    }
+    // gRPC semantics: 0 means unlimited pings without data; a positive
+    // cap avoids the server's GOAWAY(too_many_pings) protection
+    const int max_pings = keepalive_options_.http2_max_pings_without_data;
+    if (max_pings > 0 && pings_without_data >= max_pings) {
+      continue;
+    }
+    Error err = channel_->Ping(keepalive_options_.keepalive_timeout_ms);
+    if (err.IsOk()) {
+      keepalive_pings_.fetch_add(1);
+      ++pings_without_data;
+    } else if (channel_->Alive()) {
+      // missed ack on a connection that still looks up: the peer is
+      // unreachable (half-dead link) — declare death so in-flight RPCs
+      // fail fast instead of hanging forever
+      channel_->Shutdown();
+      return;
+    } else {
+      return;  // connection already torn down
+    }
+  }
 }
 
 void
@@ -335,6 +419,7 @@ InferenceServerGrpcClient::Rpc(
   if (!request.SerializeToString(&serialized)) {
     return Error("failed to serialize " + method + " request");
   }
+  call_activity_.fetch_add(1);
   std::string out;
   Error err = channel_->Unary(kService, method, serialized, &out, timeout_us);
   if (!err.IsOk()) {
@@ -717,6 +802,7 @@ InferenceServerGrpcClient::Infer(
   }
   timer.CaptureTimestamp(RequestTimers::Kind::SEND_END);
 
+  call_activity_.fetch_add(1);
   std::string out;
   err = channel_->Unary(
       kService, "ModelInfer", serialized, &out, options.client_timeout_us_);
@@ -764,49 +850,101 @@ InferenceServerGrpcClient::AsyncInfer(
   timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
   timer->CaptureTimestamp(RequestTimers::Kind::SEND_START);
 
+  call_activity_.fetch_add(1);
+  uint64_t call_id;
+  {
+    std::lock_guard<std::mutex> lk(async_mu_);
+    call_id = next_async_id_++;
+    ++outstanding_async_;
+  }
+  // Exactly-once report claim: either the completion path fires the user
+  // callback, or a Write failure is returned to the caller — whoever
+  // flips this first owns the report (they can race when the server
+  // resets the stream immediately after StartCall).
+  auto reported = std::make_shared<std::atomic<bool>>(false);
+
   auto response_buf = std::make_shared<std::string>();
   h2::GrpcCall call;
+  {
+    // Track before StartCall: on_done may fire on the reader thread
+    // before StartCall even returns, and it must find (and erase) the
+    // entry rather than race a later insertion.
+    std::lock_guard<std::mutex> lk(async_mu_);
+    outstanding_calls_.emplace(call_id, call);
+  }
   err = channel_->StartCall(
       &call, kService, "ModelInfer",
       [response_buf](std::string&& msg) { *response_buf = std::move(msg); },
-      [this, callback, timer, response_buf](
+      [this, callback, timer, response_buf, call_id, reported](
           Error e, int status, std::string message) {
         // completion runs on the reader thread; hand the user callback to
         // the dispatch worker (role of the reference's AsyncTransfer
         // thread, grpc_client.cc:1483-1527)
-        EnqueueCallback([this, callback, timer, response_buf, e, status,
-                         message]() {
-          InferResult* result = nullptr;
-          auto response = std::make_shared<inference::ModelInferResponse>();
-          Error final_err = e;
-          if (final_err.IsOk() && status != 0) {
-            final_err = Error(
-                message.empty() ? ("grpc-status " + std::to_string(status))
-                                : message);
-          }
-          if (final_err.IsOk() &&
-              !response->ParseFromString(*response_buf)) {
-            final_err = Error("failed to parse ModelInfer response");
-          }
-          timer->CaptureTimestamp(RequestTimers::Kind::RECV_START);
-          timer->CaptureTimestamp(RequestTimers::Kind::RECV_END);
-          timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
-          if (final_err.IsOk()) {
-            std::lock_guard<std::mutex> lk(stat_mu_);
-            UpdateInferStat(*timer);
-          }
-          InferResultGrpc::Create(&result, std::move(response));
-          static_cast<InferResultGrpc*>(result)->SetRequestStatus(final_err);
-          callback(result);
-        });
+        if (!reported->exchange(true)) {
+          EnqueueCallback([this, callback, timer, response_buf, e, status,
+                           message]() {
+            InferResult* result = nullptr;
+            auto response = std::make_shared<inference::ModelInferResponse>();
+            Error final_err = e;
+            if (final_err.IsOk() && status != 0) {
+              final_err = Error(
+                  message.empty() ? ("grpc-status " + std::to_string(status))
+                                  : message);
+            }
+            if (final_err.IsOk() &&
+                !response->ParseFromString(*response_buf)) {
+              final_err = Error("failed to parse ModelInfer response");
+            }
+            timer->CaptureTimestamp(RequestTimers::Kind::RECV_START);
+            timer->CaptureTimestamp(RequestTimers::Kind::RECV_END);
+            timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
+            if (final_err.IsOk()) {
+              std::lock_guard<std::mutex> lk(stat_mu_);
+              UpdateInferStat(*timer);
+            }
+            InferResultGrpc::Create(&result, std::move(response));
+            static_cast<InferResultGrpc*>(result)->SetRequestStatus(final_err);
+            callback(result);
+          });
+        }
+        // last touch of `this` on the completion path: the destructor
+        // blocks on outstanding_async_ before tearing anything down
+        std::lock_guard<std::mutex> lk(async_mu_);
+        outstanding_calls_.erase(call_id);
+        --outstanding_async_;
+        async_cv_.notify_all();
       },
       options.client_timeout_us_);
   if (!err.IsOk()) {
+    std::lock_guard<std::mutex> lk(async_mu_);
+    outstanding_calls_.erase(call_id);
+    --outstanding_async_;
+    async_cv_.notify_all();
     return err;
+  }
+  {
+    // fill in the now-started call; skip if on_done already erased it
+    std::lock_guard<std::mutex> lk(async_mu_);
+    auto it = outstanding_calls_.find(call_id);
+    if (it != outstanding_calls_.end()) {
+      it->second = call;
+    }
   }
   err = call.Write(serialized, /*end_of_calls=*/true);
   timer->CaptureTimestamp(RequestTimers::Kind::SEND_END);
-  return err;
+  if (!err.IsOk()) {
+    if (reported->exchange(true)) {
+      // on_done won the race (e.g. immediate server reset): the outcome
+      // is already being delivered via the callback — don't ALSO report
+      // an error here or the request would be double-handled
+      return Error::Success;
+    }
+    // we own the report: deliver via this return value; cancel wakes
+    // on_close which cleans up the tracking entry without re-firing
+    call.Cancel();
+    return err;
+  }
+  return Error::Success;
 }
 
 Error
@@ -860,6 +998,7 @@ InferenceServerGrpcClient::AsyncInferMulti(
     std::mutex mu;
     std::vector<InferResult*> results;
     size_t pending;
+    bool failed = false;  // caller was given an error return instead
     OnMultiCompleteFn callback;
   };
   auto state = std::make_shared<MultiState>();
@@ -873,17 +1012,39 @@ InferenceServerGrpcClient::AsyncInferMulti(
     Error err = AsyncInfer(
         [state, i](InferResult* result) {
           bool fire = false;
+          bool cleanup = false;
           {
             std::lock_guard<std::mutex> lk(state->mu);
             state->results[i] = result;
-            fire = (--state->pending == 0);
+            if (--state->pending == 0) {
+              (state->failed ? cleanup : fire) = true;
+            }
           }
           if (fire) {
             state->callback(state->results);
+          } else if (cleanup) {
+            for (auto* r : state->results) {
+              delete r;
+            }
           }
         },
         opt, inputs[i], outs);
     if (!err.IsOk()) {
+      // slots [i, n) will never produce callbacks; account for them so
+      // the already-dispatched results are still freed, and suppress the
+      // multi-callback — the caller is getting this error return instead
+      bool cleanup = false;
+      {
+        std::lock_guard<std::mutex> lk(state->mu);
+        state->failed = true;
+        state->pending -= (n - i);
+        cleanup = (state->pending == 0);
+      }
+      if (cleanup) {
+        for (auto* r : state->results) {
+          delete r;
+        }
+      }
       return err;
     }
   }
@@ -935,9 +1096,20 @@ InferenceServerGrpcClient::StartStream(
             std::lock_guard<std::mutex> lk2(stat_mu_);
             UpdateInferStat(timer);
           }
+          // StopStream clears stream_callback_ as soon as stream_done_ is
+          // observed; messages already queued here must not invoke a null
+          // std::function — snapshot under stream_mu_ and skip when gone.
+          OnCompleteFn cb;
+          {
+            std::lock_guard<std::mutex> slk(stream_mu_);
+            cb = stream_callback_;
+          }
+          if (cb == nullptr) {
+            return;
+          }
           InferResult* result = nullptr;
           InferResultGrpc::Create(&result, response);
-          stream_callback_(result);
+          cb(result);
         });
       },
       [this](Error e, int status, std::string message) {
@@ -1005,6 +1177,7 @@ InferenceServerGrpcClient::AsyncStreamInfer(
     return Error(
         stream_status_.IsOk() ? "stream has ended" : stream_status_.Message());
   }
+  call_activity_.fetch_add(1);
   err = stream_call_->Write(serialized, /*end_of_calls=*/false);
   if (!err.IsOk()) {
     return err;

@@ -16,8 +16,10 @@
 
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <thread>
@@ -33,7 +35,11 @@ using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>)>;
 //==============================================================================
 // SSL/keepalive option structs (API parity, reference grpc_client.h:43-82).
 // TLS is not supported by the in-tree h2 transport; Create fails when
-// use_ssl is requested.  Keepalive maps onto h2 PING.
+// use_ssl is requested.  Keepalive maps onto h2 PING: a keepalive thread
+// pings every keepalive_time_ms (when < INT32_MAX) and treats a missed
+// ack within keepalive_timeout_ms as connection death; pings pause after
+// http2_max_pings_without_data consecutive pings with no intervening
+// calls, mirroring gRPC's too_many_pings protection.
 //
 struct SslOptions {
   std::string root_certificates;
@@ -206,9 +212,14 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::vector<const InferRequestedOutput*>& outputs =
           std::vector<const InferRequestedOutput*>());
 
+  // Observability hook for the keepalive path (tests assert pings flow):
+  // number of keepalive PING round-trips acknowledged by the server.
+  uint64_t KeepAlivePingCount() const { return keepalive_pings_.load(); }
+
  private:
   InferenceServerGrpcClient(
-      std::shared_ptr<h2::GrpcChannel> channel, bool verbose);
+      std::shared_ptr<h2::GrpcChannel> channel, bool verbose,
+      const KeepAliveOptions& keepalive_options);
 
   template <typename Req, typename Resp>
   Error Rpc(
@@ -224,6 +235,7 @@ class InferenceServerGrpcClient : public InferenceServerClient {
 
   void DispatchWorker();
   void EnqueueCallback(std::function<void()> fn);
+  void KeepAliveWorker();
 
   std::shared_ptr<h2::GrpcChannel> channel_;
   // reused protobuf for sync Infer (reference's protobuf-reuse
@@ -249,6 +261,24 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   bool stream_done_ = false;
   Error stream_status_;
   std::condition_variable stream_cv_;
+
+  // in-flight AsyncInfer tracking: the destructor cancels and drains
+  // these before tearing down the dispatch worker, so reader-thread
+  // completions never touch a destroyed client.
+  std::mutex async_mu_;
+  std::condition_variable async_cv_;
+  size_t outstanding_async_ = 0;
+  uint64_t next_async_id_ = 0;
+  std::map<uint64_t, h2::GrpcCall> outstanding_calls_;
+
+  // keepalive (h2 PING) worker
+  KeepAliveOptions keepalive_options_;
+  std::thread keepalive_thread_;
+  std::mutex keepalive_mu_;
+  std::condition_variable keepalive_cv_;
+  bool keepalive_exit_ = false;
+  std::atomic<uint64_t> keepalive_pings_{0};
+  std::atomic<uint64_t> call_activity_{0};  // bumped per issued call
 
   std::mutex stat_mu_;
 };

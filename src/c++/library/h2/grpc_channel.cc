@@ -38,6 +38,36 @@ ParseHostPort(const std::string& url, std::string* host, int* port)
 
 }  // namespace
 
+// gRPC caps the grpc-timeout TimeoutValue at 8 decimal digits; pick the
+// finest unit that fits (the reference inherits this scaling from grpc++'s
+// set_deadline, reference grpc_client.cc:1031).  Rounds up so the deadline
+// is never shortened.
+std::string
+EncodeGrpcTimeout(uint64_t timeout_us)
+{
+  struct Unit {
+    char suffix;
+    uint64_t per_us;
+  };
+  constexpr uint64_t kMax = 99999999;  // 8 digits
+  constexpr Unit kUnits[] = {
+      {'u', 1},
+      {'m', 1000},
+      {'S', 1000000},
+      {'M', 60ull * 1000000},
+      {'H', 3600ull * 1000000},
+  };
+  for (const auto& u : kUnits) {
+    // ceil-divide without the +(per_us-1) addition: timeout_us near
+    // UINT64_MAX must not wrap to a tiny deadline
+    uint64_t v = timeout_us / u.per_us + (timeout_us % u.per_us != 0);
+    if (v <= kMax) {
+      return std::to_string(v) + u.suffix;
+    }
+  }
+  return std::to_string(kMax) + 'H';
+}
+
 std::string
 PercentDecode(const std::string& in)
 {
@@ -240,7 +270,7 @@ GrpcChannel::StartCall(
       {"user-agent", "tpu-triton-client-cc-h2"},
   };
   if (timeout_us > 0) {
-    headers.push_back({"grpc-timeout", std::to_string(timeout_us) + "u"});
+    headers.push_back({"grpc-timeout", EncodeGrpcTimeout(timeout_us)});
   }
   for (const auto& h : extra_headers) {
     headers.push_back(h);

@@ -71,6 +71,14 @@ class GrpcChannel {
 
   bool Alive() const { return conn_ && conn_->Alive(); }
   Error Ping(int64_t timeout_ms) { return conn_->Ping(timeout_ms); }
+  // Declare the connection dead: fail all in-flight calls and close the
+  // socket (keepalive uses this when a PING ack is missed).
+  void Shutdown()
+  {
+    if (conn_) {
+      conn_->Shutdown();
+    }
+  }
   const std::string& Url() const { return url_; }
 
  private:
@@ -82,6 +90,10 @@ class GrpcChannel {
 
 // Decode gRPC's percent-encoded grpc-message trailer value.
 std::string PercentDecode(const std::string& in);
+
+// Encode a grpc-timeout header value: finest unit keeping the number
+// within the spec's 8-digit cap (u/m/S/M/H), rounding up.
+std::string EncodeGrpcTimeout(uint64_t timeout_us);
 
 }  // namespace h2
 }  // namespace tc

@@ -492,6 +492,13 @@ H2Connection::ReaderLoop()
             it->second.header_block = std::move(copy);
             it->second.header_block_end_stream =
                 (flags & kFlagEndStream) != 0;
+          } else {
+            // The HPACK dynamic table is connection-level state: blocks
+            // for streams we already closed (e.g. trailers arriving after
+            // a CancelStream) still carry table inserts, so they must
+            // reach the decoder or every later RPC on this connection
+            // decodes garbage.  Buffer them for DeliverHeaderBlock.
+            orphan_header_blocks_[stream_id] = std::move(copy);
           }
         }
         if (flags & kFlagEndHeaders) {
@@ -507,6 +514,9 @@ H2Connection::ReaderLoop()
           if (it != streams_.end()) {
             it->second.header_block.insert(
                 it->second.header_block.end(), payload.begin(), payload.end());
+          } else {
+            auto& blk = orphan_header_blocks_[stream_id];
+            blk.insert(blk.end(), payload.begin(), payload.end());
           }
         }
         if (complete) {
@@ -666,6 +676,17 @@ H2Connection::DeliverHeaderBlock(int32_t stream_id)
       }
     }
   }
+  if (!found) {
+    // Closed/unknown stream: the block was buffered in
+    // orphan_header_blocks_ by the HEADERS/CONTINUATION cases (and/or
+    // moved there by CloseStream mid-reassembly).
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = orphan_header_blocks_.find(stream_id);
+    if (it != orphan_header_blocks_.end()) {
+      block = std::move(it->second);
+      orphan_header_blocks_.erase(it);
+    }
+  }
   // The HPACK dynamic table is connection-level state: decode even for
   // unknown streams to keep the decoder in sync.
   std::vector<Header> headers;
@@ -701,6 +722,13 @@ H2Connection::CloseStream(int32_t stream_id, const Error& err)
     auto it = streams_.find(stream_id);
     if (it != streams_.end()) {
       handler = it->second.handler;
+      if (!it->second.header_block.empty()) {
+        // mid-reassembly close (e.g. CancelStream between HEADERS and
+        // CONTINUATION): keep the partial block so the orphan path can
+        // finish reassembly and keep the HPACK table in sync
+        orphan_header_blocks_[stream_id] =
+            std::move(it->second.header_block);
+      }
       streams_.erase(it);
       found = true;
     }

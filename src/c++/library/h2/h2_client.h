@@ -110,6 +110,11 @@ class H2Connection {
   std::thread reader_;
   HpackEncoder encoder_;
   HpackDecoder decoder_;  // reader thread only
+  // Header blocks for streams no longer in streams_ (reset/cancelled);
+  // reassembled and fed to decoder_ to keep the connection-level HPACK
+  // dynamic table in sync.  Guarded by mu_ (CloseStream may move a
+  // partial block here from any thread).
+  std::map<int32_t, std::vector<uint8_t>> orphan_header_blocks_;
 
   std::mutex write_mu_;   // socket writes + next_stream_id_
   int32_t next_stream_id_ = 1;

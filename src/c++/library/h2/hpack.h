@@ -13,8 +13,9 @@
 // inserts, so decoding needs the full protocol.  When libnghttp2 is
 // present (runtime .so only in this image — no headers) its tiny, ABI-
 // stable hd_inflate API is dlopen'd for the job; otherwise a self-
-// contained fallback decoder handles everything except Huffman-coded
-// literals (rejected with a clear error).
+// contained fallback decoder handles the full protocol including RFC
+// 7541 Appendix B Huffman-coded literals (gRPC C-core Huffman-encodes;
+// wire compatibility must not depend on the peer's encoder choices).
 
 #pragma once
 
@@ -43,6 +44,10 @@ void EncodeInteger(
 bool DecodeInteger(
     const uint8_t* data, size_t len, size_t* pos, int prefix_bits,
     uint64_t* value);
+
+// Decode an RFC 7541 Appendix B Huffman-coded string.  Returns false on
+// a non-prefix bit sequence, explicit EOS, or invalid padding.
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out);
 
 class HpackEncoder {
  public:

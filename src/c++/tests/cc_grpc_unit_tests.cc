@@ -4,6 +4,7 @@
 // assembly. The wire-level integration tests live in
 // tests/test_cc_grpc.py against a real grpcio server.
 
+#include <cmath>
 #include <cstdio>
 #include <cstring>
 
@@ -131,15 +132,101 @@ TestHpackFallbackDynamicTable()
 }
 
 static void
-TestHpackFallbackRejectsHuffman()
+TestHuffmanDecode()
 {
+  // RFC 7541 Appendix C worked examples
+  struct Vec {
+    std::vector<uint8_t> coded;
+    const char* text;
+  };
+  const Vec vecs[] = {
+      {{0xf1, 0xe3, 0xc2, 0xe5, 0xf2, 0x3a, 0x6b, 0xa0, 0xab, 0x90, 0xf4,
+        0xff},
+       "www.example.com"},
+      {{0xa8, 0xeb, 0x10, 0x64, 0x9c, 0xbf}, "no-cache"},
+      {{0x25, 0xa8, 0x49, 0xe9, 0x5b, 0xa9, 0x7d, 0x7f}, "custom-key"},
+      {{0x25, 0xa8, 0x49, 0xe9, 0x5b, 0xb8, 0xe8, 0xb4, 0xbf},
+       "custom-value"},
+      {{0x64, 0x02}, "302"},
+      {{0xae, 0xc3, 0x77, 0x1a, 0x4b}, "private"},
+      {{0x9d, 0x29, 0xad, 0x17, 0x18, 0x63, 0xc7, 0x8f, 0x0b, 0x97, 0xc8,
+        0xe9, 0xae, 0x82, 0xae, 0x43, 0xd3},
+       "https://www.example.com"},
+  };
+  for (const auto& v : vecs) {
+    std::string out;
+    CHECK(tc::h2::HuffmanDecode(v.coded.data(), v.coded.size(), &out));
+    CHECK(out == v.text);
+  }
+  // '0' is code 00000 (5 bits): 0x07 pads with ones (valid), 0x00 pads
+  // with zeros (invalid), 0xff alone is 8 bits of padding (invalid)
+  std::string out;
+  out.clear();
+  const uint8_t ok_pad[] = {0x07};
+  CHECK(tc::h2::HuffmanDecode(ok_pad, 1, &out) && out == "0");
+  out.clear();
+  const uint8_t bad_pad[] = {0x00};
+  CHECK(!tc::h2::HuffmanDecode(bad_pad, 1, &out));
+  out.clear();
+  const uint8_t long_pad[] = {0xff};
+  CHECK(!tc::h2::HuffmanDecode(long_pad, 1, &out));
+  out.clear();
+  CHECK(tc::h2::HuffmanDecode(nullptr, 0, &out) && out.empty());
+}
+
+static void
+TestHpackFallbackHuffmanBlock()
+{
+  // RFC 7541 C.6.1: full response header block, Huffman-coded literals
+  // WITH incremental indexing — exercises Huffman + dynamic inserts in
+  // the fallback decoder (the path a gRPC C-core peer produces).
   HpackDecoder decoder(/*use_nghttp2=*/false);
-  // literal w/o indexing, new name, Huffman bit set on name
-  std::vector<uint8_t> block = {0x00, 0x83, 0xaa, 0xbb, 0xcc};
+  const uint8_t block[] = {
+      0x48, 0x82, 0x64, 0x02, 0x58, 0x85, 0xae, 0xc3, 0x77, 0x1a, 0x4b,
+      0x61, 0x96, 0xd0, 0x7a, 0xbe, 0x94, 0x10, 0x54, 0xd4, 0x44, 0xa8,
+      0x20, 0x05, 0x95, 0x04, 0x0b, 0x81, 0x66, 0xe0, 0x82, 0xa6, 0x2d,
+      0x1b, 0xff, 0x6e, 0x91, 0x9d, 0x29, 0xad, 0x17, 0x18, 0x63, 0xc7,
+      0x8f, 0x0b, 0x97, 0xc8, 0xe9, 0xae, 0x82, 0xae, 0x43, 0xd3};
   std::vector<Header> out;
-  tc::Error err = decoder.DecodeBlock(block.data(), block.size(), &out);
-  CHECK(!err.IsOk());
-  CHECK(err.Message().find("Huffman") != std::string::npos);
+  CHECK_OK(decoder.DecodeBlock(block, sizeof(block), &out));
+  CHECK(out.size() == 4);
+  if (out.size() == 4) {
+    CHECK(out[0].name == ":status" && out[0].value == "302");
+    CHECK(out[1].name == "cache-control" && out[1].value == "private");
+    CHECK(
+        out[2].name == "date" &&
+        out[2].value == "Mon, 21 Oct 2013 20:13:21 GMT");
+    CHECK(
+        out[3].name == "location" &&
+        out[3].value == "https://www.example.com");
+  }
+  // dynamic entries must now be referenceable (62 = newest = location)
+  const uint8_t indexed[] = {0x80 | 62};
+  std::vector<Header> out2;
+  CHECK_OK(decoder.DecodeBlock(indexed, 1, &out2));
+  CHECK(out2.size() == 1 && out2[0].name == "location");
+}
+
+static void
+TestEncodeGrpcTimeout()
+{
+  using tc::h2::EncodeGrpcTimeout;
+  CHECK(EncodeGrpcTimeout(1) == "1u");
+  CHECK(EncodeGrpcTimeout(99999999) == "99999999u");
+  // >= 100 seconds in us exceeds 8 digits -> scale to ms (rounded up)
+  CHECK(EncodeGrpcTimeout(100000000) == "100000m");
+  CHECK(EncodeGrpcTimeout(100000001) == "100001m");
+  // and onward through S/M/H
+  CHECK(EncodeGrpcTimeout(99999999ull * 1000) == "99999999m");
+  CHECK(EncodeGrpcTimeout(100000000ull * 1000) == "100000S");
+  const uint64_t us_per_hour = 3600ull * 1000000;
+  CHECK(EncodeGrpcTimeout(24 * us_per_hour) == "86400000m");
+  // 200000 h = 7.2e8 seconds (9 digits) -> scales to minutes
+  CHECK(EncodeGrpcTimeout(200000ull * us_per_hour) == "12000000M");
+  for (int i = 0; i < 9; ++i) {
+    // every encoding stays within 8 digits + unit
+    CHECK(EncodeGrpcTimeout(7ull * (uint64_t)std::pow(10, i)).size() <= 9);
+  }
 }
 
 static void
@@ -183,7 +270,9 @@ main()
   TestHpackRoundTripNghttp2();
   TestHpackRoundTripFallback();
   TestHpackFallbackDynamicTable();
-  TestHpackFallbackRejectsHuffman();
+  TestHuffmanDecode();
+  TestHpackFallbackHuffmanBlock();
+  TestEncodeGrpcTimeout();
   TestPercentDecode();
   TestModelInferRequestProto();
   printf("%d checks, %d failures\n", checks, failures);

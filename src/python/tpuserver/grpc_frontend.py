@@ -412,6 +412,12 @@ class GrpcFrontend:
             options=[
                 ("grpc.max_send_message_length", -1),
                 ("grpc.max_receive_message_length", -1),
+                # tolerate client-side keepalive pings (role of Triton's
+                # --grpc-keepalive-* server flags): no ping strikes, any
+                # ping interval accepted even without in-flight data
+                ("grpc.http2.max_ping_strikes", 0),
+                ("grpc.http2.min_recv_ping_interval_without_data_ms", 10),
+                ("grpc.keepalive_permit_without_calls", 1),
             ],
         )
         self._server.add_generic_rpc_handlers(

@@ -7,6 +7,7 @@ jitted JAX computations.
 """
 
 from tpuserver.models.simple import (
+    DelayedIdentityModel,
     IdentityBF16Model,
     IdentityFP32Model,
     IdentityStringModel,
@@ -25,6 +26,7 @@ def default_models():
         IdentityFP32Model(),
         IdentityBF16Model(),
         IdentityStringModel(),
+        DelayedIdentityModel(),
         SequenceAccumulateModel(),
         RepeatModel(),
     ]

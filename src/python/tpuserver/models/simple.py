@@ -123,6 +123,32 @@ class SequenceAccumulateModel(Model):
         return {"OUTPUT": acc}, acc
 
 
+class DelayedIdentityModel(Model):
+    """INT32 passthrough that sleeps DELAY_US[0] microseconds (or the
+    ``delay_us`` request parameter) before responding — fixture for
+    client-timeout / cancellation paths (role of the reference's delayed
+    custom_identity_int32 used by client_timeout_test.cc)."""
+
+    name = "delayed_identity"
+    platform = "python"
+    backend = "python"
+    max_batch_size = 0
+    inputs = (
+        TensorSpec("INPUT0", "INT32", [-1]),
+        TensorSpec("DELAY_US", "UINT32", [1]),
+    )
+    outputs = (TensorSpec("OUTPUT0", "INT32", [-1]),)
+
+    def execute(self, inputs, request):
+        import time
+
+        delay_us = int(np.asarray(inputs["DELAY_US"]).reshape(-1)[0])
+        delay_us = max(delay_us, int(request.parameters.get("delay_us", 0)))
+        if delay_us:
+            time.sleep(delay_us / 1e6)
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+
 class RepeatModel(Model):
     """Decoupled model: one request with IN int32[N] produces N streamed
     responses of one element each, the i-th delayed by DELAY[i] usec; WAIT

@@ -68,6 +68,18 @@ def test_cc_simple_grpc_custom_repeat(cc_build, grpc_url):
     assert "stream infer OK: 6 responses" in result.stdout
 
 
+def test_cc_grpc_keepalive(cc_build, grpc_url):
+    """KeepAliveOptions drive h2 PINGs: the counter only advances on
+    server-acknowledged round-trips against the stock grpcio server."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "simple_grpc_keepalive_client"),
+         "-u", grpc_url, "-t", "50"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "keepalive OK" in result.stdout
+
+
 def test_perf_analyzer_grpc(cc_build, grpc_url, tmp_path):
     csv = tmp_path / "grpc.csv"
     result = subprocess.run(
