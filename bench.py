@@ -24,6 +24,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(REPO, "src", "python"))
 
 BASELINE_INFER_PER_SEC = 1407.84  # reference quick_start.md:94
+BASELINE_P50_USEC = 690  # reference quick_start.md:96
 
 
 def _build_cc():
@@ -46,12 +47,13 @@ def _build_cc():
     return path if os.path.exists(path) else None
 
 
-def _bench_native(perf_analyzer, url):
+def _native_once(perf_analyzer, url, window_ms):
+    """One perf_analyzer run; returns (infer/sec, p50_usec) or None."""
     csv_path = os.path.join(REPO, "build", "bench_simple.csv")
     result = subprocess.run(
-        [perf_analyzer, "-m", "simple", "-u", url, "-p", "1500",
-         "--max-trials", "8", "-f", csv_path],
-        capture_output=True, text=True, timeout=120,
+        [perf_analyzer, "-m", "simple", "-u", url, "-p", str(window_ms),
+         "--max-trials", "10", "-f", csv_path],
+        capture_output=True, text=True, timeout=180,
     )
     if result.returncode != 0:
         return None
@@ -59,7 +61,30 @@ def _bench_native(perf_analyzer, url):
         lines = f.read().strip().splitlines()
     if len(lines) < 2:
         return None
-    return float(lines[1].split(",")[1])
+    cols = lines[1].split(",")
+    return float(cols[1]), float(cols[9])
+
+
+def _bench_native(perf_analyzer, url):
+    """Median of 5 measured runs after a warmup pass.
+
+    The reference's stability methodology (3 windows within +-10%,
+    quick_start.md:94-108) still leaves a run-to-run noise band on a
+    shared host; the reported figure is the median of 5 independent
+    measurements with 3 s windows, after one discarded warmup run.
+    """
+    if _native_once(perf_analyzer, url, 1000) is None:  # warmup/smoke
+        return None
+    runs = []
+    for _ in range(5):
+        r = _native_once(perf_analyzer, url, 3000)
+        if r is not None:
+            runs.append(r)
+    if len(runs) < 3:
+        return None
+    rates = sorted(r[0] for r in runs)
+    p50s = sorted(r[1] for r in runs)
+    return rates[len(rates) // 2], p50s[len(p50s) // 2]
 
 
 def _bench_python(url):
@@ -82,18 +107,23 @@ def _bench_python(url):
         result = client.infer("simple", [in0, in1], outputs=outputs)
     assert (result.as_numpy("OUTPUT0") == a + b).all()
     rates = []
-    for _ in range(3):
+    lat = []
+    for _ in range(5):
         n = 0
         t0 = time.perf_counter()
         while True:
+            t1 = time.perf_counter()
             client.infer("simple", [in0, in1], outputs=outputs)
+            lat.append(time.perf_counter() - t1)
             n += 1
             dt = time.perf_counter() - t0
             if dt >= 1.5:
                 break
         rates.append(n / dt)
     client.close()
-    return statistics.median(rates)
+    lat.sort()
+    p50_usec = lat[len(lat) // 2] * 1e6
+    return statistics.median(rates), p50_usec
 
 
 def main():
@@ -105,12 +135,13 @@ def main():
     frontend = HttpFrontend(core, port=0).start()
     url = frontend.url.replace("http://", "")
     try:
-        value = None
+        measured = None
         perf_analyzer = _build_cc()
         if perf_analyzer is not None:
-            value = _bench_native(perf_analyzer, url)
-        if value is None:
-            value = _bench_python(url)
+            measured = _bench_native(perf_analyzer, url)
+        if measured is None:
+            measured = _bench_python(url)
+        value, p50_usec = measured
         print(
             json.dumps(
                 {
@@ -118,6 +149,8 @@ def main():
                     "value": round(value, 2),
                     "unit": "infer/sec",
                     "vs_baseline": round(value / BASELINE_INFER_PER_SEC, 4),
+                    "p50_usec": round(p50_usec, 1),
+                    "p50_vs_baseline": round(p50_usec / BASELINE_P50_USEC, 4),
                 }
             )
         )

@@ -4,17 +4,21 @@ identity models, and a stateful sequence model."""
 
 import numpy as np
 
-from tpuserver.core import JaxModel, Model, TensorSpec
+from tpuserver.core import Model, TensorSpec
 
 
-class SimpleModel(JaxModel):
-    """INPUT0+INPUT1 -> OUTPUT0, INPUT0-INPUT1 -> OUTPUT1 (INT32[1,16])."""
+class SimpleModel(Model):
+    """INPUT0+INPUT1 -> OUTPUT0, INPUT0-INPUT1 -> OUTPUT1 (INT32[1,16]).
 
-    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
+    Plain numpy, not a JaxModel: the op is ~2us and the request round trip
+    ~300us, so per-request jax dispatch/device_put would multiply the
+    serving cost of this latency-benchmark fixture several-fold (the
+    analogue of the reference's instance_group KIND_CPU placement for the
+    quick-start `simple` model)."""
 
     name = "simple"
-    platform = "jax"
-    backend = "jax"
+    platform = "python"
+    backend = "python"
     max_batch_size = 8
     inputs = (
         TensorSpec("INPUT0", "INT32", [16]),
@@ -25,8 +29,10 @@ class SimpleModel(JaxModel):
         TensorSpec("OUTPUT1", "INT32", [16]),
     )
 
-    def jax_fn(self, INPUT0, INPUT1):
-        return {"OUTPUT0": INPUT0 + INPUT1, "OUTPUT1": INPUT0 - INPUT1}
+    def execute(self, inputs, request):
+        in0 = np.asarray(inputs["INPUT0"])
+        in1 = np.asarray(inputs["INPUT1"])
+        return {"OUTPUT0": in0 + in1, "OUTPUT1": in0 - in1}
 
 
 class SimpleStringModel(Model):
@@ -65,29 +71,31 @@ class SimpleStringModel(Model):
         }
 
 
-class IdentityFP32Model(JaxModel):
-    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
+class IdentityFP32Model(Model):
+    # passthrough: numpy, for the same latency reason as SimpleModel
     name = "identity_fp32"
+    platform = "python"
+    backend = "python"
     max_batch_size = 0
     inputs = (TensorSpec("INPUT0", "FP32", [-1, -1]),)
     outputs = (TensorSpec("OUTPUT0", "FP32", [-1, -1]),)
 
-    def jax_fn(self, INPUT0):
-        return {"OUTPUT0": INPUT0}
+    def execute(self, inputs, request):
+        return {"OUTPUT0": inputs["INPUT0"]}
 
 
-class IdentityBF16Model(JaxModel):
+class IdentityBF16Model(Model):
     """BF16 passthrough — exercises the TPU-native bf16 wire path."""
 
-    device_kind = "cpu"  # trivial op: host round-trip would dwarf compute
-
     name = "identity_bf16"
+    platform = "python"
+    backend = "python"
     max_batch_size = 0
     inputs = (TensorSpec("INPUT0", "BF16", [-1, -1]),)
     outputs = (TensorSpec("OUTPUT0", "BF16", [-1, -1]),)
 
-    def jax_fn(self, INPUT0):
-        return {"OUTPUT0": INPUT0}
+    def execute(self, inputs, request):
+        return {"OUTPUT0": inputs["INPUT0"]}
 
 
 class IdentityStringModel(Model):

@@ -180,7 +180,12 @@ class InferenceServerClient:
         self.close()
 
     def __del__(self):
-        self.close()
+        try:
+            self.close()
+        except Exception:
+            # interpreter shutdown: queue internals may already be torn
+            # down (queue.Empty raises through a half-collected module)
+            pass
 
     def close(self, _empty=queue.Empty):
         """Close the client: drain the pool and stop worker threads.
