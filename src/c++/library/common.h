@@ -61,6 +61,11 @@ struct InferOptions {
   uint64_t server_timeout_us_;
   // client-side socket deadline; 0 = none
   uint64_t client_timeout_us_;
+  // ask a decoupled model for a trailing empty response marked
+  // triton_final_response, so data-dependent-length streams have a
+  // detectable end (KServe v2 parameter; reference uses the same flag
+  // in its streaming clients)
+  bool triton_enable_empty_final_response_ = false;
 };
 
 //==============================================================================

@@ -717,6 +717,9 @@ InferenceServerGrpcClient::PreRunProcessing(
   if (options.server_timeout_us_ != 0) {
     params["timeout"].set_int64_param(options.server_timeout_us_);
   }
+  if (options.triton_enable_empty_final_response_) {
+    params["triton_enable_empty_final_response"].set_bool_param(true);
+  }
 
   // 2 GB protobuf guard (reference grpc_client.cc:1345-1353)
   size_t total = 0;

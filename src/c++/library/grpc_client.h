@@ -87,6 +87,24 @@ class InferResultGrpc : public InferResult {
   const inference::ModelInferResponse& Response() const { return *response_; }
   void SetRequestStatus(const Error& status) { status_ = status; }
 
+  // True when the response carries triton_final_response=true, or when
+  // it carries no final marker at all (unary / non-decoupled responses
+  // are implicitly final).
+  bool IsFinalResponse() const
+  {
+    auto it = response_->parameters().find("triton_final_response");
+    if (it == response_->parameters().end()) {
+      return true;
+    }
+    return it->second.bool_param();
+  }
+  // True when the final marker parameter is present (decoupled streams
+  // requested with triton_enable_empty_final_response).
+  bool HasFinalMarker() const
+  {
+    return response_->parameters().count("triton_final_response") > 0;
+  }
+
  private:
   InferResultGrpc(std::shared_ptr<inference::ModelInferResponse> response);
   Error Output(

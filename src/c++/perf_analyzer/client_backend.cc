@@ -133,6 +133,14 @@ class TritonHttpBackend : public ClientBackend {
     return client_->UnregisterXlaSharedMemory(name);
   }
 
+  tc::Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+      override
+  {
+    std::string response;
+    return client_->UpdateTraceSettings(&response, "", settings);
+  }
+
  private:
   static void FillOptions(
       const BackendInferRequest& request, tc::InferOptions* options)
@@ -343,6 +351,51 @@ class TritonGrpcBackend : public ClientBackend {
   tc::Error UnregisterXlaSharedMemory(const std::string& name) override
   {
     return client_->UnregisterXlaSharedMemory(name);
+  }
+
+  tc::Error StartStream(BackendCallback stream_callback) override
+  {
+    return client_->StartStream(
+        [stream_callback](tc::InferResult* raw_result) {
+          auto* grpc_result = static_cast<tc::InferResultGrpc*>(raw_result);
+          BackendInferResult result;
+          result.status = raw_result->RequestStatus();
+          raw_result->Id(&result.request_id);
+          result.final_response = grpc_result->IsFinalResponse();
+          delete raw_result;
+          stream_callback(std::move(result));
+        },
+        /*enable_stats=*/false);
+  }
+
+  tc::Error StopStream() override { return client_->StopStream(); }
+
+  tc::Error StreamInfer(const BackendInferRequest& request) override
+  {
+    std::vector<std::unique_ptr<tc::InferInput>> owned_inputs;
+    std::vector<std::unique_ptr<tc::InferRequestedOutput>> owned_outputs;
+    std::vector<tc::InferInput*> inputs;
+    std::vector<const tc::InferRequestedOutput*> outputs;
+    tc::Error err = BuildRequest(
+        request, &owned_inputs, &owned_outputs, &inputs, &outputs);
+    if (!err.IsOk()) {
+      return err;
+    }
+    tc::InferOptions options(request.model_name);
+    FillOptions(request, &options);
+    options.triton_enable_empty_final_response_ =
+        request.enable_empty_final_response;
+    // AsyncStreamInfer serializes the request before returning, so the
+    // stack-owned input buffers are safe to release afterwards
+    return client_->AsyncStreamInfer(options, inputs, outputs);
+  }
+
+  tc::Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+      override
+  {
+    inference::TraceSettingResponse response;
+    return client_->UpdateTraceSettings(&response, "", settings);
   }
 
  private:

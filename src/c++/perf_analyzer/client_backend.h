@@ -36,6 +36,9 @@ struct BackendInferRequest {
   };
   std::vector<Input> inputs;
   std::vector<std::string> requested_outputs;
+  // streaming to a decoupled model: ask for the trailing empty response
+  // marked triton_final_response so the stream end is detectable
+  bool enable_empty_final_response = false;
 };
 
 struct BackendInferResult {
@@ -43,6 +46,8 @@ struct BackendInferResult {
   std::string request_id;
   // output name -> raw bytes (empty when delivered via shm)
   std::map<std::string, std::vector<uint8_t>> outputs;
+  // streaming: false for intermediate decoupled responses
+  bool final_response = true;
 };
 
 using BackendCallback = std::function<void(BackendInferResult&&)>;
@@ -52,6 +57,7 @@ using BackendCallback = std::function<void(BackendInferResult&&)>;
 struct BackendStats {
   size_t infer_calls = 0;
   size_t async_infer_calls = 0;
+  size_t stream_infer_calls = 0;
   size_t shm_register_calls = 0;
 };
 
@@ -73,6 +79,28 @@ class ClientBackend {
       BackendInferResult* result, const BackendInferRequest& request) = 0;
   virtual tc::Error AsyncInfer(
       BackendCallback callback, const BackendInferRequest& request) = 0;
+
+  // Bidirectional-stream issuance (decoupled models; reference
+  // client_backend.h:335-466 StartStream/AsyncStreamInfer).  The stream
+  // callback fires once per response, with final_response marking
+  // request completion.
+  virtual tc::Error StartStream(BackendCallback stream_callback)
+  {
+    return tc::Error("streaming is not supported by this backend");
+  }
+  virtual tc::Error StopStream() { return tc::Error::Success; }
+  virtual tc::Error StreamInfer(const BackendInferRequest& request)
+  {
+    return tc::Error("streaming is not supported by this backend");
+  }
+
+  // Forward trace settings to the server (reference
+  // triton_client_backend.cc:447-509 trace push).
+  virtual tc::Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+  {
+    return tc::Error("trace settings are not supported by this backend");
+  }
 
   virtual tc::Error RegisterSystemSharedMemory(
       const std::string& name, const std::string& key, size_t byte_size)

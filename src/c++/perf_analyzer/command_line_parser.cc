@@ -26,6 +26,24 @@ enum LongOptIds {
   OPT_SEED,
   OPT_NUM_THREADS,
   OPT_SERVICE_KIND,
+  OPT_BINARY_SEARCH,
+  OPT_PERCENTILE,
+  OPT_WARMUP_REQUEST_COUNT,
+  OPT_STREAMING,
+  OPT_START_SEQUENCE_ID,
+  OPT_SEQUENCE_ID_RANGE,
+  OPT_STRING_LENGTH,
+  OPT_STRING_DATA,
+  OPT_TRACE_FILE,
+  OPT_TRACE_LEVEL,
+  OPT_TRACE_RATE,
+  OPT_TRACE_COUNT,
+  OPT_LOG_FREQUENCY,
+  OPT_COLLECT_METRICS,
+  OPT_METRICS_URL,
+  OPT_METRICS_INTERVAL,
+  OPT_VERBOSE_CSV,
+  OPT_ENABLE_MPI,
 };
 
 const struct option kLongOptions[] = {
@@ -68,6 +86,30 @@ const struct option kLongOptions[] = {
     {"protocol", required_argument, nullptr, 'i'},
     {"concurrency", required_argument, nullptr, 'c'},
     {"request-rate", required_argument, nullptr, 2000},
+    {"latency-threshold", required_argument, nullptr, 'l'},
+    {"binary-search", no_argument, nullptr, OPT_BINARY_SEARCH},
+    {"percentile", required_argument, nullptr, OPT_PERCENTILE},
+    {"warmup-request-count", required_argument, nullptr,
+     OPT_WARMUP_REQUEST_COUNT},
+    {"streaming", no_argument, nullptr, OPT_STREAMING},
+    {"start-sequence-id", required_argument, nullptr,
+     OPT_START_SEQUENCE_ID},
+    {"sequence-id-range", required_argument, nullptr,
+     OPT_SEQUENCE_ID_RANGE},
+    {"string-length", required_argument, nullptr, OPT_STRING_LENGTH},
+    {"string-data", required_argument, nullptr, OPT_STRING_DATA},
+    {"trace-file", required_argument, nullptr, OPT_TRACE_FILE},
+    {"trace-level", required_argument, nullptr, OPT_TRACE_LEVEL},
+    {"trace-rate", required_argument, nullptr, OPT_TRACE_RATE},
+    {"trace-count", required_argument, nullptr, OPT_TRACE_COUNT},
+    {"log-frequency", required_argument, nullptr, OPT_LOG_FREQUENCY},
+    {"collect-metrics", no_argument, nullptr, OPT_COLLECT_METRICS},
+    {"metrics-url", required_argument, nullptr, OPT_METRICS_URL},
+    {"metrics-interval", required_argument, nullptr,
+     OPT_METRICS_INTERVAL},
+    {"verbose-csv", no_argument, nullptr, OPT_VERBOSE_CSV},
+    {"enable-mpi", no_argument, nullptr, OPT_ENABLE_MPI},
+    {"max-threads", required_argument, nullptr, 2001},
     {nullptr, 0, nullptr, 0},
 };
 
@@ -141,9 +183,33 @@ CLParser::Usage()
       "  --sequence-length-variation <p> +- pct sequence length\n"
       "  --shared-memory <type>          none|system|xla\n"
       "  --output-shared-memory-size <n> output region bytes\n"
+      "  -l/--latency-threshold <ms>     stop the sweep when latency "
+      "exceeds\n"
+      "  --binary-search                 binary (not linear) concurrency/"
+      "rate search\n"
+      "  --percentile <n>                use p<n> latency for stability "
+      "and -l\n"
+      "  --warmup-request-count <n>      discarded warmup requests per "
+      "level\n"
+      "  --streaming                     issue over a gRPC bidi stream\n"
+      "  --start-sequence-id <n>         first sequence id\n"
+      "  --sequence-id-range <n>         sequence id pool size\n"
+      "  --string-length <n>             synthetic BYTES element length\n"
+      "  --string-data <s>               fixed BYTES element value\n"
+      "  --trace-file <path>             forward trace settings to server\n"
+      "  --trace-level <lvl>             TIMESTAMPS|TENSORS|OFF\n"
+      "  --trace-rate <n>                trace 1/n requests\n"
+      "  --trace-count <n>               stop tracing after n\n"
+      "  --log-frequency <n>             trace log flush frequency\n"
+      "  --collect-metrics               scrape server Prometheus metrics\n"
+      "  --metrics-url <url>             metrics endpoint (default "
+      "<url>/metrics)\n"
+      "  --metrics-interval <ms>         scrape interval (default 1000)\n"
+      "  --verbose-csv                   extra CSV columns\n"
+      "  --enable-mpi                    multi-process measurement barrier\n"
       "  -f/--latency-report-file <csv>  CSV report path\n"
       "  --random-seed <n>               data/schedule seed\n"
-      "  --num-threads <n>               rate-mode sender threads\n";
+      "  --num-threads/--max-threads <n> rate-mode sender threads\n";
 }
 
 bool
@@ -154,8 +220,8 @@ CLParser::Parse(
   optind = 1;  // reset for repeated calls (tests)
   int opt;
   while ((opt = getopt_long(
-              argc, argv, "hvam:x:u:b:p:c:f:zi:", kLongOptions, nullptr)) !=
-         -1) {
+              argc, argv, "hvam:x:u:b:p:c:f:zi:l:t:", kLongOptions,
+              nullptr)) != -1) {
     switch (opt) {
       case 'h':
         params->usage_requested = true;
@@ -295,7 +361,75 @@ CLParser::Parse(
         params->seed = (uint32_t)atoi(optarg);
         break;
       case OPT_NUM_THREADS:
+      case 2001:  // --max-threads (reference alias)
         params->num_threads = (size_t)atoi(optarg);
+        break;
+      case 'l':
+        params->latency_threshold_ms = (uint64_t)atoll(optarg);
+        break;
+      case 't':  // legacy concurrency alias (reference -t)
+        params->concurrency_start = params->concurrency_end =
+            (size_t)atoi(optarg);
+        break;
+      case OPT_BINARY_SEARCH:
+        params->binary_search = true;
+        break;
+      case OPT_PERCENTILE: {
+        int p = atoi(optarg);
+        if (p < 1 || p > 99) {
+          *error = "--percentile must be in [1, 99]";
+          return false;
+        }
+        params->percentile = (size_t)p;
+        break;
+      }
+      case OPT_WARMUP_REQUEST_COUNT:
+        params->warmup_request_count = (size_t)atoll(optarg);
+        break;
+      case OPT_STREAMING:
+        params->streaming = true;
+        break;
+      case OPT_START_SEQUENCE_ID:
+        params->start_sequence_id = (uint64_t)atoll(optarg);
+        break;
+      case OPT_SEQUENCE_ID_RANGE:
+        params->sequence_id_range = (uint64_t)atoll(optarg);
+        break;
+      case OPT_STRING_LENGTH:
+        params->string_length = (size_t)atoll(optarg);
+        break;
+      case OPT_STRING_DATA:
+        params->string_data = optarg;
+        break;
+      case OPT_TRACE_FILE:
+        params->trace_file = optarg;
+        break;
+      case OPT_TRACE_LEVEL:
+        params->trace_level = optarg;
+        break;
+      case OPT_TRACE_RATE:
+        params->trace_rate = (uint64_t)atoll(optarg);
+        break;
+      case OPT_TRACE_COUNT:
+        params->trace_count = (uint64_t)atoll(optarg);
+        break;
+      case OPT_LOG_FREQUENCY:
+        params->log_frequency = (uint64_t)atoll(optarg);
+        break;
+      case OPT_COLLECT_METRICS:
+        params->collect_metrics = true;
+        break;
+      case OPT_METRICS_URL:
+        params->metrics_url = optarg;
+        break;
+      case OPT_METRICS_INTERVAL:
+        params->metrics_interval_ms = (uint64_t)atoll(optarg);
+        break;
+      case OPT_VERBOSE_CSV:
+        params->verbose_csv = true;
+        break;
+      case OPT_ENABLE_MPI:
+        params->enable_mpi = true;
         break;
       case OPT_SERVICE_KIND:
         if (strcmp(optarg, "triton_http") == 0 ||
@@ -321,6 +455,24 @@ CLParser::Parse(
   if (params->request_rate_start > 0 && params->concurrency_start > 1) {
     *error =
         "cannot use concurrency and request rate modes together";
+    return false;
+  }
+  if (params->binary_search) {
+    if (params->latency_threshold_ms == 0) {
+      *error = "--binary-search requires --latency-threshold";
+      return false;
+    }
+    bool has_range = params->concurrency_end > params->concurrency_start ||
+                     params->request_rate_end > params->request_rate_start;
+    if (!has_range) {
+      *error =
+          "--binary-search requires a range (--concurrency-range or "
+          "--request-rate-range with end > start)";
+      return false;
+    }
+  }
+  if (params->streaming && params->kind != BackendKind::TRITON_GRPC) {
+    *error = "--streaming requires -i grpc";
     return false;
   }
   return true;

@@ -42,12 +42,48 @@ struct PerfAnalyzerParameters {
   double stability_threshold_pct = 10.0;
   size_t max_trials = 10;
 
+  // sweep termination + search mode (reference -l / --binary-search,
+  // inference_profiler.h:243-297): 0 = no latency limit
+  uint64_t latency_threshold_ms = 0;
+  bool binary_search = false;
+  // stability checks use p<N> latency instead of average when nonzero
+  // (reference --percentile)
+  size_t percentile = 0;
+  // requests issued and discarded before the first window per level
+  size_t warmup_request_count = 0;
+
+  // gRPC bidi-stream issuance (reference --streaming)
+  bool streaming = false;
+
   bool use_sequences = false;
   size_t sequence_length = 20;
   double sequence_length_variation = 20.0;
+  uint64_t start_sequence_id = 1;
+  uint64_t sequence_id_range = 0;  // 0 = unbounded
+
+  // synthetic BYTES input shaping (reference --string-length/--string-data)
+  size_t string_length = 128;
+  std::string string_data;
 
   SharedMemoryType shared_memory = SharedMemoryType::NONE;
   size_t output_shm_size = 102400;
+
+  // server-side trace forwarding (reference command_line_parser.cc:750-754)
+  std::string trace_file;
+  std::string trace_level;
+  uint64_t trace_rate = 0;
+  uint64_t trace_count = 0;
+  uint64_t log_frequency = 0;
+
+  // Prometheus metrics collection (reference --collect-metrics et al.)
+  bool collect_metrics = false;
+  std::string metrics_url;  // default: http://<url>/metrics
+  uint64_t metrics_interval_ms = 1000;
+
+  bool verbose_csv = false;
+
+  // multi-process coordination (reference --enable-mpi, mpi_utils.h:32-83)
+  bool enable_mpi = false;
 
   std::string latency_report_file;  // CSV path
   uint32_t seed = 17;

@@ -38,9 +38,21 @@ class ConcurrencyManager : public LoadManager {
     for (size_t slot = 0; slot < level; ++slot) {
       auto ctx = MakeContext(slot);
       bool use_async = config_.async;
-      threads_.emplace_back([this, ctx, use_async] {
+      bool use_stream = config_.streaming;
+      bool decoupled = config_.decoupled;
+      auto tracker = stream_tracker_;
+      threads_.emplace_back(
+          [this, ctx, use_async, use_stream, decoupled, tracker] {
         while (!stop_.load(std::memory_order_relaxed)) {
-          if (use_async) {
+          if (use_stream) {
+            // one outstanding request per slot over the shared stream
+            ctx->SendStreamRequest(tracker, decoupled);
+            sent_requests_++;
+            while (ctx->Inflight() > 0 &&
+                   !stop_.load(std::memory_order_relaxed)) {
+              std::this_thread::sleep_for(std::chrono::microseconds(50));
+            }
+          } else if (use_async) {
             // one outstanding request per slot via the async client path
             ctx->SendAsyncRequest();
             sent_requests_++;

@@ -2,13 +2,19 @@
 
 namespace pa {
 
+namespace {
+// Request ids are correlation keys on shared streams (StreamTracker), so
+// they must be unique across every context in the process.
+std::atomic<uint64_t> g_request_id{0};
+}  // namespace
+
 BackendInferRequest
 InferContext::BuildRequest()
 {
   BackendInferRequest request;
   request.model_name = parser_->ModelName();
   request.model_version = parser_->ModelVersion();
-  request.request_id = std::to_string(++request_counter_);
+  request.request_id = std::to_string(g_request_id.fetch_add(1) + 1);
 
   size_t step = step_;
   step_ = (step_ + 1) % (data_loader_->StepCount() > 0
@@ -74,6 +80,28 @@ InferContext::SendSyncRequest()
     thread_stat_->status = err;
   }
   Record(start, end, ok, false);
+}
+
+void
+InferContext::SendStreamRequest(
+    const std::shared_ptr<StreamTracker>& tracker, bool decoupled,
+    bool delayed)
+{
+  BackendInferRequest request = BuildRequest();
+  request.enable_empty_final_response = decoupled;
+  uint64_t start = NowNs();
+  thread_stat_->inflight++;
+  tracker->Register(
+      request.request_id,
+      StreamTracker::Pending{start, delayed, 0, thread_stat_});
+  tc::Error err = backend_->StreamInfer(request);
+  if (!err.IsOk()) {
+    tracker->Remove(request.request_id);
+    thread_stat_->inflight--;
+    std::lock_guard<std::mutex> lk(thread_stat_->mu);
+    thread_stat_->status = err;
+    thread_stat_->records.push_back({start, NowNs(), false, delayed, 0});
+  }
 }
 
 void

@@ -32,6 +32,9 @@ struct RequestRecord {
   uint64_t end_ns = 0;
   bool success = false;
   bool delayed = false;  // rate mode: fired behind schedule
+  // responses received for this request: 1 for unary, >= 1 on decoupled
+  // streams (0 is treated as 1 for compatibility)
+  uint64_t response_count = 0;
 };
 
 // Shared between a worker thread and the profiler (reference
@@ -41,6 +44,75 @@ struct ThreadStat {
   std::vector<RequestRecord> records;
   tc::Error status = tc::Error::Success;
   std::atomic<size_t> inflight{0};
+};
+
+// Correlates stream responses (which arrive on the backend's stream
+// callback, identified only by request id) back to the issuing context's
+// timing state.  One tracker per load manager; installed as the backend
+// stream callback by StartStream.
+class StreamTracker {
+ public:
+  struct Pending {
+    uint64_t start_ns = 0;
+    bool delayed = false;
+    uint64_t response_count = 0;
+    std::shared_ptr<ThreadStat> thread_stat;
+  };
+
+  void Register(const std::string& id, Pending pending)
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.emplace(id, std::move(pending));
+  }
+
+  // Stream callback body: route one response; on the final response the
+  // request record is written to the owning thread's stats.
+  void OnResponse(BackendInferResult&& result)
+  {
+    std::shared_ptr<ThreadStat> stat;
+    RequestRecord record;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pending_.find(result.request_id);
+      if (it == pending_.end()) {
+        return;  // response for a request from a previous level
+      }
+      auto& p = it->second;
+      p.response_count++;
+      if (!result.final_response && result.status.IsOk()) {
+        return;  // intermediate decoupled response
+      }
+      record = {p.start_ns, NowNs(), result.status.IsOk(), p.delayed,
+                p.response_count};
+      stat = p.thread_stat;
+      pending_.erase(it);
+    }
+    {
+      std::lock_guard<std::mutex> lk(stat->mu);
+      if (!result.status.IsOk()) {
+        stat->status = result.status;
+      }
+      stat->records.push_back(record);
+    }
+    stat->inflight--;
+  }
+
+  size_t PendingCount()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    return pending_.size();
+  }
+
+  // Drop a pending entry (send-failure path: no response will come).
+  void Remove(const std::string& id)
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.erase(id);
+  }
+
+ private:
+  std::mutex mu_;
+  std::map<std::string, Pending> pending_;
 };
 
 class InferContext {
@@ -70,6 +142,12 @@ class InferContext {
   // Asynchronous send; completion recorded on the backend's thread.
   void SendAsyncRequest(bool delayed = false);
 
+  // Stream send: issues over the backend's bidi stream; completion is
+  // routed through the tracker on the stream callback.
+  void SendStreamRequest(
+      const std::shared_ptr<StreamTracker>& tracker,
+      bool decoupled, bool delayed = false);
+
   size_t Inflight() const { return thread_stat_->inflight.load(); }
 
  private:
@@ -84,7 +162,6 @@ class InferContext {
   size_t seq_slot_ = 0;
   std::shared_ptr<const ShmLayout> shm_layout_;
   size_t step_ = 0;
-  uint64_t request_counter_ = 0;
 };
 
 }  // namespace pa

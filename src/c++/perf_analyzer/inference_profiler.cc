@@ -4,6 +4,7 @@
 #include <cmath>
 #include <thread>
 
+#include "metrics_manager.h"
 #include "tjson.h"
 
 namespace pa {
@@ -30,7 +31,8 @@ Percentile(std::vector<uint64_t>& sorted, double pct)
 
 ClientSideStats
 InferenceProfiler::SummarizeRecords(
-    const std::vector<RequestRecord>& records, uint64_t window_ns)
+    const std::vector<RequestRecord>& records, uint64_t window_ns,
+    size_t percentile)
 {
   ClientSideStats stats;
   std::vector<uint64_t> latencies;
@@ -47,6 +49,7 @@ InferenceProfiler::SummarizeRecords(
     latencies.push_back(lat);
     total += lat;
     stats.request_count++;
+    stats.response_count += (r.response_count > 0) ? r.response_count : 1;
   }
   if (stats.request_count == 0) {
     return stats;
@@ -57,6 +60,9 @@ InferenceProfiler::SummarizeRecords(
   stats.p90_ns = Percentile(latencies, 90);
   stats.p95_ns = Percentile(latencies, 95);
   stats.p99_ns = Percentile(latencies, 99);
+  stats.stability_latency_ns =
+      (percentile > 0) ? Percentile(latencies, (double)percentile)
+                       : stats.avg_latency_ns;
   double mean = (double)stats.avg_latency_ns;
   double var = 0;
   for (uint64_t lat : latencies) {
@@ -71,12 +77,12 @@ InferenceProfiler::SummarizeRecords(
 }
 
 tc::Error
-InferenceProfiler::QueryServerStats(ServerSideStats* stats)
+InferenceProfiler::QueryServerStats(
+    ServerSideStats* stats, const std::string& model_name)
 {
   *stats = ServerSideStats();
   std::string stats_json;
-  tc::Error err =
-      backend_->ModelStatistics(&stats_json, parser_->ModelName());
+  tc::Error err = backend_->ModelStatistics(&stats_json, model_name);
   if (!err.IsOk()) {
     return err;
   }
@@ -116,12 +122,41 @@ tc::Error
 InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
 {
   std::vector<ClientSideStats> windows;
+
+  // warmup: let the level issue-and-discard requests before measuring
+  // (reference --warmup-request-count)
+  if (config_.warmup_request_count > 0) {
+    size_t warmed = 0;
+    uint64_t warmup_start = NowNs();
+    manager_->GetAndResetNumSentRequests();
+    while (warmed < config_.warmup_request_count && !early_exit.load() &&
+           (NowNs() - warmup_start) < 60ull * 1000000000ull) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+      warmed += manager_->GetAndResetNumSentRequests();
+      tc::Error err = manager_->CheckHealth();
+      if (!err.IsOk()) {
+        return err;
+      }
+    }
+  }
+
   ServerSideStats server_begin;
-  bool have_server_stats = QueryServerStats(&server_begin).IsOk();
+  bool have_server_stats =
+      QueryServerStats(&server_begin, parser_->ModelName()).IsOk();
+  std::map<std::string, ServerSideStats> composing_begin;
+  for (const auto& composing : parser_->ComposingModels()) {
+    ServerSideStats s;
+    if (QueryServerStats(&s, composing).IsOk()) {
+      composing_begin[composing] = s;
+    }
+  }
+  if (metrics_ != nullptr) {
+    metrics_->StartNewMeasurement();
+  }
   sent_in_window_ = 0;
   manager_->GetAndResetNumSentRequests();
   // discard completions from before this level's windows (previous
-  // level's tail, worker spin-up)
+  // level's tail, worker spin-up, warmup)
   manager_->SwapRequestRecords();
 
   for (size_t trial = 0;
@@ -164,9 +199,23 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
     if (!err.IsOk()) {
       return err;
     }
-    auto window_stats = SummarizeRecords(records, window_ns);
+    auto window_stats =
+        SummarizeRecords(records, window_ns, config_.percentile);
     if (window_stats.request_count == 0) {
       continue;
+    }
+    // client overhead: share of worker wall-time spent outside requests
+    // (reference overhead pct; meaningful in concurrency mode where
+    // workers issue back-to-back)
+    size_t workers = manager_->WorkerCount();
+    if (workers > 0 && window_ns > 0) {
+      uint64_t busy = 0;
+      for (const auto& r : records) {
+        busy += (r.end_ns > r.start_ns) ? r.end_ns - r.start_ns : 0;
+      }
+      double util = (double)busy / ((double)window_ns * (double)workers);
+      window_stats.overhead_pct =
+          100.0 * std::max(0.0, 1.0 - std::min(util, 1.0));
     }
     windows.push_back(window_stats);
     if (config_.verbose) {
@@ -175,7 +224,8 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
           window_stats.infer_per_sec,
           window_stats.avg_latency_ns / 1e3);
     }
-    // stability: last 3 windows within threshold on throughput + latency
+    // stability: last 3 windows within threshold on throughput + the
+    // stability latency metric (avg, or p<N> with --percentile)
     if (windows.size() >= 3) {
       bool stable = true;
       const auto& last = windows[windows.size() - 1];
@@ -186,8 +236,11 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
             (last.infer_per_sec > 0 ? last.infer_per_sec : 1.0);
         double lat_dev =
             std::fabs(
-                (double)w.avg_latency_ns - (double)last.avg_latency_ns) /
-            (last.avg_latency_ns > 0 ? (double)last.avg_latency_ns : 1.0);
+                (double)w.stability_latency_ns -
+                (double)last.stability_latency_ns) /
+            (last.stability_latency_ns > 0
+                 ? (double)last.stability_latency_ns
+                 : 1.0);
         if (tput_dev > config_.stability_threshold_pct / 100.0 ||
             lat_dev > config_.stability_threshold_pct / 100.0) {
           stable = false;
@@ -209,13 +262,18 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
   ClientSideStats merged;
   double tput_sum = 0;
   uint64_t lat_sum = 0;
+  uint64_t stab_sum = 0;
+  double overhead_sum = 0;
   for (size_t i = first; i < windows.size(); ++i) {
     const auto& w = windows[i];
     merged.request_count += w.request_count;
     merged.delayed_request_count += w.delayed_request_count;
     merged.failed_request_count += w.failed_request_count;
+    merged.response_count += w.response_count;
     tput_sum += w.infer_per_sec;
     lat_sum += w.avg_latency_ns;
+    stab_sum += w.stability_latency_ns;
+    overhead_sum += w.overhead_pct;
     merged.p50_ns = w.p50_ns;  // representative: last window percentiles
     merged.p90_ns = w.p90_ns;
     merged.p95_ns = w.p95_ns;
@@ -225,29 +283,38 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
   size_t n = windows.size() - first;
   merged.infer_per_sec = tput_sum / (double)n;
   merged.avg_latency_ns = lat_sum / n;
+  merged.stability_latency_ns = stab_sum / n;
+  merged.overhead_pct = overhead_sum / (double)n;
   status->client_stats = merged;
 
+  auto delta_stats = [](const ServerSideStats& a, const ServerSideStats& b) {
+    auto delta = [](uint64_t x, uint64_t y) { return y >= x ? y - x : 0; };
+    ServerSideStats d;
+    d.inference_count = delta(a.inference_count, b.inference_count);
+    d.execution_count = delta(a.execution_count, b.execution_count);
+    d.queue_ns = delta(a.queue_ns, b.queue_ns);
+    d.compute_input_ns = delta(a.compute_input_ns, b.compute_input_ns);
+    d.compute_infer_ns = delta(a.compute_infer_ns, b.compute_infer_ns);
+    d.compute_output_ns = delta(a.compute_output_ns, b.compute_output_ns);
+    d.success_count = delta(a.success_count, b.success_count);
+    return d;
+  };
   if (have_server_stats) {
     ServerSideStats server_end;
-    if (QueryServerStats(&server_end).IsOk()) {
-      auto delta = [](uint64_t a, uint64_t b) {
-        return b >= a ? b - a : 0;
-      };
-      status->server_stats.inference_count =
-          delta(server_begin.inference_count, server_end.inference_count);
-      status->server_stats.execution_count =
-          delta(server_begin.execution_count, server_end.execution_count);
-      status->server_stats.queue_ns =
-          delta(server_begin.queue_ns, server_end.queue_ns);
-      status->server_stats.compute_input_ns = delta(
-          server_begin.compute_input_ns, server_end.compute_input_ns);
-      status->server_stats.compute_infer_ns = delta(
-          server_begin.compute_infer_ns, server_end.compute_infer_ns);
-      status->server_stats.compute_output_ns = delta(
-          server_begin.compute_output_ns, server_end.compute_output_ns);
-      status->server_stats.success_count =
-          delta(server_begin.success_count, server_end.success_count);
+    if (QueryServerStats(&server_end, parser_->ModelName()).IsOk()) {
+      status->server_stats = delta_stats(server_begin, server_end);
     }
+  }
+  // ensemble: per-composing-model deltas (reference ensemble stat merge)
+  for (const auto& kv : composing_begin) {
+    ServerSideStats end;
+    if (QueryServerStats(&end, kv.first).IsOk()) {
+      status->composing_server_stats[kv.first] =
+          delta_stats(kv.second, end);
+    }
+  }
+  if (metrics_ != nullptr) {
+    status->metrics = metrics_->MeasurementAverages();
   }
   return tc::Error::Success;
 }

@@ -14,6 +14,7 @@ struct ClientSideStats {
   uint64_t request_count = 0;
   uint64_t delayed_request_count = 0;
   uint64_t failed_request_count = 0;
+  uint64_t response_count = 0;  // > request_count for decoupled streams
   double infer_per_sec = 0.0;
   uint64_t avg_latency_ns = 0;
   uint64_t p50_ns = 0;
@@ -21,6 +22,12 @@ struct ClientSideStats {
   uint64_t p95_ns = 0;
   uint64_t p99_ns = 0;
   uint64_t std_ns = 0;
+  // p<N> when the profiler runs with --percentile N, else avg latency;
+  // the value stability checks and -l compare against
+  uint64_t stability_latency_ns = 0;
+  // share of worker wall-time not spent inside requests (reference
+  // "overhead pct"): client-side bookkeeping between requests
+  double overhead_pct = 0.0;
 };
 
 struct ServerSideStats {
@@ -40,6 +47,12 @@ struct PerfStatus {
   double request_rate = 0.0;
   ClientSideStats client_stats;
   ServerSideStats server_stats;
+  // per-composing-model server stats for ensembles (reference
+  // inference_profiler.cc:868-1097)
+  std::map<std::string, ServerSideStats> composing_server_stats;
+  // scraped Prometheus metrics averaged over the measurement
+  // (metrics_manager.h); empty unless --collect-metrics
+  std::map<std::string, double> metrics;
   bool on_sequence_model = false;
   bool stabilized = false;
 };
@@ -51,6 +64,10 @@ struct ProfilerConfig {
   uint64_t measurement_request_count = 50;
   size_t max_trials = 10;
   double stability_threshold_pct = 10.0;
+  // stability/threshold latency metric: p<N> when nonzero, else average
+  size_t percentile = 0;
+  // requests discarded before the first window of each level
+  size_t warmup_request_count = 0;
   bool verbose = false;
 };
 
@@ -72,17 +89,28 @@ class InferenceProfiler {
   tc::Error ProfileCurrentLevel(PerfStatus* status);
 
   // Compute client stats from a window of records (public for unit tests;
-  // the reference exposes the same via friend-test hooks).
+  // the reference exposes the same via friend-test hooks).  `percentile`
+  // selects the stability latency metric (0 = average).
   static ClientSideStats SummarizeRecords(
-      const std::vector<RequestRecord>& records, uint64_t window_ns);
+      const std::vector<RequestRecord>& records, uint64_t window_ns,
+      size_t percentile = 0);
+
+  // Optional Prometheus scraper; when set, per-measurement averages are
+  // attached to PerfStatus::metrics.
+  void SetMetricsManager(std::shared_ptr<class MetricsManager> metrics)
+  {
+    metrics_ = std::move(metrics);
+  }
 
  private:
-  tc::Error QueryServerStats(ServerSideStats* stats);
+  tc::Error QueryServerStats(
+      ServerSideStats* stats, const std::string& model_name);
 
   std::shared_ptr<ClientBackend> backend_;
   std::shared_ptr<ModelParser> parser_;
   LoadManager* manager_;
   ProfilerConfig config_;
+  std::shared_ptr<class MetricsManager> metrics_;
   size_t sent_in_window_ = 0;
 };
 

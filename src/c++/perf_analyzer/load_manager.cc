@@ -1,5 +1,7 @@
 #include "load_manager.h"
 
+#include <unistd.h>
+
 #include <cstring>
 
 #include "shm_utils.h"
@@ -9,6 +11,42 @@ namespace pa {
 namespace {
 const char kShmKey[] = "/pa_input_data";
 const char kShmRegion[] = "pa_input_data";
+const char kXlaShmKey[] = "/xlashm_pa_input";
+const char kXlaShmRegion[] = "pa_xla_input_data";
+
+// standard base64 (the raw xla-shm handle is base64'd JSON, mirroring the
+// reference's base64'd cudaIpcMemHandle_t, cuda_shared_memory.cc:98-127)
+std::string
+Base64Encode(const std::string& in)
+{
+  static const char kTable[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 3 <= in.size()) {
+    uint32_t v = ((uint8_t)in[i] << 16) | ((uint8_t)in[i + 1] << 8) |
+                 (uint8_t)in[i + 2];
+    out.push_back(kTable[(v >> 18) & 63]);
+    out.push_back(kTable[(v >> 12) & 63]);
+    out.push_back(kTable[(v >> 6) & 63]);
+    out.push_back(kTable[v & 63]);
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = (uint8_t)in[i] << 16;
+    out.push_back(kTable[(v >> 18) & 63]);
+    out.push_back(kTable[(v >> 12) & 63]);
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = ((uint8_t)in[i] << 16) | ((uint8_t)in[i + 1] << 8);
+    out.push_back(kTable[(v >> 18) & 63]);
+    out.push_back(kTable[(v >> 12) & 63]);
+    out.push_back(kTable[(v >> 6) & 63]);
+    out += "=";
+  }
+  return out;
+}
 }  // namespace
 
 tc::Error
@@ -55,9 +93,88 @@ LoadManager::SetupSystemShm()
   return tc::Error::Success;
 }
 
+tc::Error
+LoadManager::SetupXlaShm()
+{
+  // Same input layout as the system-shm path, but the region registers
+  // through the XLA plane: this process creates the region's host
+  // staging window and serializes an XlaShmHandle-compatible raw handle
+  // {uuid, shm_key, byte_size, device_ordinal}; the server's
+  // attach_from_raw_handle opens the window cross-process and stages
+  // tensors to TPU HBM on use (tritonclient/utils/xla_shared_memory).
+  auto layout = std::make_shared<ShmLayout>();
+  layout->region_name = kXlaShmRegion;
+  size_t total = 0;
+  for (const auto& input : parser_->Inputs()) {
+    const std::vector<uint8_t>* data = nullptr;
+    tc::Error err = data_loader_->GetInputData(input.name, 0, 0, &data);
+    if (!err.IsOk()) {
+      return err;
+    }
+    layout->inputs[input.name] = {total, data->size()};
+    total += data->size();
+  }
+  if (total == 0) {
+    return tc::Error("no input data to place in xla shared memory");
+  }
+  tc::Error err = tc::CreateSharedMemoryRegion(kXlaShmKey, total, &shm_fd_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  err = tc::MapSharedMemory(shm_fd_, 0, total, &shm_base_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  shm_total_ = total;
+  for (const auto& input : parser_->Inputs()) {
+    const std::vector<uint8_t>* data = nullptr;
+    data_loader_->GetInputData(input.name, 0, 0, &data);
+    auto& slot = layout->inputs[input.name];
+    memcpy((uint8_t*)shm_base_ + slot.first, data->data(), slot.second);
+  }
+  std::string handle_json =
+      std::string("{\"uuid\": \"pa") + std::to_string(getpid()) +
+      "\", \"shm_key\": \"" + kXlaShmKey +
+      "\", \"byte_size\": " + std::to_string(total) +
+      ", \"device_ordinal\": " +
+      std::to_string(config_.xla_device_ordinal) + "}";
+  backend_->UnregisterXlaSharedMemory(kXlaShmRegion);
+  err = backend_->RegisterXlaSharedMemory(
+      kXlaShmRegion, Base64Encode(handle_json), total,
+      config_.xla_device_ordinal);
+  if (!err.IsOk()) {
+    return err;
+  }
+  xla_shm_registered_ = true;
+  shm_layout_ = layout;
+  return tc::Error::Success;
+}
+
+void
+LoadManager::TeardownXlaShm()
+{
+  if (xla_shm_registered_) {
+    backend_->UnregisterXlaSharedMemory(kXlaShmRegion);
+    xla_shm_registered_ = false;
+    shm_layout_.reset();
+    if (shm_base_ != nullptr) {
+      tc::UnmapSharedMemory(shm_base_, shm_total_);
+      shm_base_ = nullptr;
+    }
+    if (shm_fd_ >= 0) {
+      tc::CloseSharedMemory(shm_fd_);
+      tc::UnlinkSharedMemoryRegion(kXlaShmKey);
+      shm_fd_ = -1;
+    }
+  }
+}
+
 void
 LoadManager::TeardownSystemShm()
 {
+  if (xla_shm_registered_) {
+    return;  // region fields belong to the XLA plane (TeardownXlaShm)
+  }
   if (shm_layout_ != nullptr) {
     backend_->UnregisterSystemSharedMemory(kShmRegion);
     shm_layout_.reset();

@@ -19,10 +19,16 @@ struct LoadManagerConfig {
   bool zero_input = false;
   std::string input_data_json;  // empty -> synthetic
   bool async = false;
+  // issue over the backend's bidi stream (gRPC only); decoupled models
+  // get the empty-final-response marker so completion is detectable
+  bool streaming = false;
+  bool decoupled = false;
   bool use_sequences = false;
   size_t sequence_length = 20;
   double sequence_length_variation = 20.0;
   uint32_t seed = 17;
+  // XLA-shm regions attach to this device on the server side
+  int xla_device_ordinal = 0;
 };
 
 class LoadManager {
@@ -38,7 +44,11 @@ class LoadManager {
   virtual ~LoadManager()
   {
     StopWorkers();
+    if (stream_tracker_ != nullptr) {
+      backend_->StopStream();
+    }
     TeardownSystemShm();
+    TeardownXlaShm();
   }
 
   tc::Error InitManager()
@@ -59,11 +69,18 @@ class LoadManager {
     if (config_.shared_memory == SharedMemoryType::SYSTEM) {
       err = SetupSystemShm();
     } else if (config_.shared_memory == SharedMemoryType::XLA) {
-      err = tc::Error(
-          "xla shared memory regions are owned by the Python "
-          "tritonclient.utils.xla_shared_memory utility (TPU HBM is not "
-          "addressable from this process); use --shared-memory system "
-          "here or the Python harness for the on-device plane");
+      err = SetupXlaShm();
+    }
+    if (!err.IsOk()) {
+      return err;
+    }
+    if (config_.streaming) {
+      stream_tracker_ = std::make_shared<StreamTracker>();
+      auto tracker = stream_tracker_;
+      err = backend_->StartStream(
+          [tracker](BackendInferResult&& result) {
+            tracker->OnResponse(std::move(result));
+          });
     }
     return err;
   }
@@ -89,6 +106,9 @@ class LoadManager {
   {
     return sent_requests_.exchange(0);
   }
+
+  // Active worker threads at the current load level (overhead-pct math).
+  size_t WorkerCount() const { return threads_.size(); }
 
   tc::Error CheckHealth()
   {
@@ -135,6 +155,12 @@ class LoadManager {
  protected:
   tc::Error SetupSystemShm();
   void TeardownSystemShm();
+  // XLA/TPU shared memory from a non-JAX process: create the region's
+  // host staging window (POSIX shm, the cross-process half of an
+  // XlaShmHandle) and register it with a handle the server's
+  // xla_shared_memory.attach_from_raw_handle understands.
+  tc::Error SetupXlaShm();
+  void TeardownXlaShm();
 
   std::shared_ptr<InferContext> MakeContext(size_t seq_slot)
   {
@@ -159,6 +185,7 @@ class LoadManager {
   LoadManagerConfig config_;
   std::shared_ptr<DataLoader> data_loader_;
   std::shared_ptr<SequenceManager> sequence_manager_;
+  std::shared_ptr<StreamTracker> stream_tracker_;
   std::vector<std::shared_ptr<ThreadStat>> thread_stats_;
   std::vector<std::thread> threads_;
   std::atomic<bool> stop_{false};
@@ -170,6 +197,7 @@ class LoadManager {
   void* shm_base_ = nullptr;
   int shm_fd_ = -1;
   size_t shm_total_ = 0;
+  bool xla_shm_registered_ = false;
 };
 
 }  // namespace pa

@@ -1,0 +1,264 @@
+#include "metrics_manager.h"
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+
+namespace pa {
+
+namespace {
+
+// Minimal blocking HTTP/1.0 GET (Connection: close framing keeps the
+// read loop trivial; a metrics scrape every second doesn't need a pool).
+tc::Error
+HttpGet(
+    const std::string& host, int port, const std::string& path,
+    std::string* body)
+{
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc =
+      getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
+  if (rc != 0) {
+    return tc::Error(
+        "metrics: failed to resolve " + host + ": " + gai_strerror(rc));
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      continue;
+    }
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    return tc::Error("metrics: unable to connect to " + host);
+  }
+  std::string request = "GET " + path +
+                        " HTTP/1.0\r\nHost: " + host +
+                        "\r\nConnection: close\r\n\r\n";
+  if (::send(fd, request.data(), request.size(), MSG_NOSIGNAL) !=
+      (ssize_t)request.size()) {
+    close(fd);
+    return tc::Error("metrics: send failed");
+  }
+  std::string response;
+  char buf[8192];
+  ssize_t n;
+  while ((n = ::read(fd, buf, sizeof(buf))) > 0) {
+    response.append(buf, n);
+  }
+  close(fd);
+  size_t header_end = response.find("\r\n\r\n");
+  if (header_end == std::string::npos) {
+    return tc::Error("metrics: malformed HTTP response");
+  }
+  if (response.find("200") == std::string::npos ||
+      response.find("200") > response.find("\r\n")) {
+    return tc::Error(
+        "metrics: non-200 response: " +
+        response.substr(0, response.find("\r\n")));
+  }
+  *body = response.substr(header_end + 4);
+  return tc::Error::Success;
+}
+
+void
+SplitUrl(const std::string& url, std::string* host, int* port,
+         std::string* path)
+{
+  std::string u = url;
+  auto scheme = u.find("://");
+  if (scheme != std::string::npos) {
+    u = u.substr(scheme + 3);
+  }
+  auto slash = u.find('/');
+  *path = (slash == std::string::npos) ? "/metrics" : u.substr(slash);
+  if (slash != std::string::npos) {
+    u = u.substr(0, slash);
+  }
+  auto colon = u.rfind(':');
+  if (colon == std::string::npos) {
+    *host = u;
+    *port = 8002;  // reference Triton metrics port
+  } else {
+    *host = u.substr(0, colon);
+    *port = atoi(u.c_str() + colon + 1);
+  }
+}
+
+}  // namespace
+
+bool
+IsRelevantMetric(const std::string& name)
+{
+  // the accelerator/host gauges the report cares about (reference parses
+  // nv_gpu_utilization / nv_gpu_power_usage / nv_gpu_memory_*; the TPU
+  // server exports tpu_* and process_* analogues)
+  static const char* kPrefixes[] = {"nv_", "tpu_", "process_"};
+  for (const char* p : kPrefixes) {
+    if (name.rfind(p, 0) == 0) {
+      return true;
+    }
+  }
+  return name.find("utilization") != std::string::npos ||
+         name.find("duty") != std::string::npos ||
+         name.find("memory") != std::string::npos ||
+         name.find("power") != std::string::npos;
+}
+
+MetricsSnapshot
+ParsePrometheusText(const std::string& body)
+{
+  MetricsSnapshot snap;
+  std::istringstream ss(body);
+  std::string line;
+  while (std::getline(ss, line)) {
+    if (line.empty() || line[0] == '#') {
+      continue;
+    }
+    // name{labels} value [timestamp]   |   name value [timestamp]
+    size_t value_at = line.find_last_of(' ');
+    if (value_at == std::string::npos) {
+      continue;
+    }
+    std::string name = line.substr(0, value_at);
+    std::string value_str = line.substr(value_at + 1);
+    // a trailing timestamp makes the tail non-numeric-value; try the
+    // previous token too
+    char* end = nullptr;
+    double value = strtod(value_str.c_str(), &end);
+    if (end == value_str.c_str()) {
+      continue;
+    }
+    // strip possible trailing timestamp: "name{l} 3.4 1700000000"
+    size_t prev_space = name.find_last_of(' ');
+    if (prev_space != std::string::npos &&
+        name.find('}') != std::string::npos &&
+        prev_space > name.find('}')) {
+      value = strtod(name.c_str() + prev_space + 1, nullptr);
+      name = name.substr(0, prev_space);
+    } else if (
+        prev_space != std::string::npos &&
+        name.find('{') == std::string::npos) {
+      value = strtod(name.c_str() + prev_space + 1, nullptr);
+      name = name.substr(0, prev_space);
+    }
+    snap[name] = value;
+  }
+  return snap;
+}
+
+tc::Error
+MetricsManager::ScrapeOnce(MetricsSnapshot* out)
+{
+  std::string host, path;
+  int port = 0;
+  SplitUrl(url_, &host, &port, &path);
+  std::string body;
+  tc::Error err = HttpGet(host, port, path, &body);
+  if (!err.IsOk()) {
+    return err;
+  }
+  *out = ParsePrometheusText(body);
+  return tc::Error::Success;
+}
+
+tc::Error
+MetricsManager::Start()
+{
+  MetricsSnapshot snap;
+  tc::Error err = ScrapeOnce(&snap);
+  if (!err.IsOk()) {
+    return err;  // fail fast when the endpoint is absent
+  }
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& kv : snap) {
+      if (IsRelevantMetric(kv.first)) {
+        acc_[kv.first] = {kv.second, 1};
+      }
+    }
+  }
+  thread_ = std::thread(&MetricsManager::Loop, this);
+  return tc::Error::Success;
+}
+
+void
+MetricsManager::Stop()
+{
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    exit_ = true;
+  }
+  cv_.notify_all();
+  if (thread_.joinable()) {
+    thread_.join();
+  }
+}
+
+void
+MetricsManager::Loop()
+{
+  for (;;) {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait_for(lk, std::chrono::milliseconds(interval_ms_), [&]() {
+        return exit_;
+      });
+      if (exit_) {
+        return;
+      }
+    }
+    MetricsSnapshot snap;
+    if (!ScrapeOnce(&snap).IsOk()) {
+      continue;  // transient failure: keep polling
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& kv : snap) {
+      if (!IsRelevantMetric(kv.first)) {
+        continue;
+      }
+      auto& slot = acc_[kv.first];
+      slot.first += kv.second;
+      slot.second += 1;
+    }
+  }
+}
+
+void
+MetricsManager::StartNewMeasurement()
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  acc_.clear();
+}
+
+MetricsSnapshot
+MetricsManager::MeasurementAverages()
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  MetricsSnapshot out;
+  for (const auto& kv : acc_) {
+    if (kv.second.second > 0) {
+      out[kv.first] = kv.second.first / (double)kv.second.second;
+    }
+  }
+  return out;
+}
+
+}  // namespace pa

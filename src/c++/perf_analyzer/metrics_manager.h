@@ -1,0 +1,71 @@
+// Background Prometheus scraper: polls the server's /metrics endpoint on
+// an interval thread and averages gauges over each measurement
+// (reference metrics_manager.h:44-91 + the parse in
+// triton_client_backend.cc:386-445; GPU gauges map to the TPU/process
+// gauges tpuserver exports).
+
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace pa {
+
+// One scrape: metric name (labels folded in as name{label}) -> value.
+using MetricsSnapshot = std::map<std::string, double>;
+
+// Parse Prometheus text exposition format into a snapshot (exposed for
+// unit tests).  Only gauge/counter sample lines are read; HELP/TYPE
+// comments are skipped.
+MetricsSnapshot ParsePrometheusText(const std::string& body);
+
+// Accelerator/host gauges worth reporting (nv_*/tpu_*/process_* and
+// utilization/duty/memory/power names).
+bool IsRelevantMetric(const std::string& name);
+
+class MetricsManager {
+ public:
+  // url: "host:port/path" or "http://host:port/path"
+  MetricsManager(const std::string& url, uint64_t interval_ms)
+      : url_(url), interval_ms_(interval_ms)
+  {
+  }
+
+  ~MetricsManager() { Stop(); }
+
+  // Spawn the scrape thread; first scrape happens immediately so short
+  // measurements still see at least one sample.
+  tc::Error Start();
+  void Stop();
+
+  // Begin a measurement: discard accumulated samples.
+  void StartNewMeasurement();
+
+  // Average of each metric over the samples since StartNewMeasurement.
+  MetricsSnapshot MeasurementAverages();
+
+  // Scrape once, synchronously (also used by the thread; public for
+  // tests and for --collect-metrics validation at startup).
+  tc::Error ScrapeOnce(MetricsSnapshot* out);
+
+ private:
+  void Loop();
+
+  std::string url_;
+  uint64_t interval_ms_;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  bool exit_ = false;
+  // accumulated sums + counts since the last StartNewMeasurement
+  std::map<std::string, std::pair<double, size_t>> acc_;
+};
+
+}  // namespace pa

@@ -21,6 +21,13 @@ class MockClientBackend : public ClientBackend {
     uint64_t response_delay_us = 0;
     // per-call statuses consumed round-robin; empty = always success
     std::vector<bool> return_statuses;
+    // stream responses per StreamInfer (last one is final) — models a
+    // decoupled server when > 1
+    size_t stream_responses_per_request = 1;
+    // serialize sync Infer calls: latency then grows with offered
+    // concurrency (a capacity-1 server), which latency-threshold /
+    // binary-search tests need
+    bool serialize_requests = false;
     std::string metadata_json =
         "{\"name\":\"mock\",\"inputs\":[{\"name\":\"INPUT0\","
         "\"datatype\":\"INT32\",\"shape\":[16]}],"
@@ -90,8 +97,14 @@ class MockClientBackend : public ClientBackend {
       RecordSequence(request);
     }
     if (config_.response_delay_us > 0) {
-      std::this_thread::sleep_for(
-          std::chrono::microseconds(config_.response_delay_us));
+      if (config_.serialize_requests) {
+        std::lock_guard<std::mutex> lk(serial_mu_);
+        std::this_thread::sleep_for(
+            std::chrono::microseconds(config_.response_delay_us));
+      } else {
+        std::this_thread::sleep_for(
+            std::chrono::microseconds(config_.response_delay_us));
+      }
     }
     result->status = NextStatus();
     result->request_id = request.request_id;
@@ -129,6 +142,91 @@ class MockClientBackend : public ClientBackend {
     std::lock_guard<std::mutex> lk(mu_);
     stats_.shm_register_calls++;
     return tc::Error::Success;
+  }
+
+  tc::Error RegisterXlaSharedMemory(
+      const std::string&, const std::string& raw_handle, size_t,
+      int) override
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stats_.shm_register_calls++;
+    last_xla_raw_handle_ = raw_handle;
+    return tc::Error::Success;
+  }
+  tc::Error UnregisterXlaSharedMemory(const std::string&) override
+  {
+    return tc::Error::Success;
+  }
+
+  tc::Error StartStream(BackendCallback stream_callback) override
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stream_callback_ = std::move(stream_callback);
+    return tc::Error::Success;
+  }
+
+  tc::Error StopStream() override
+  {
+    while (async_inflight_.load() > 0) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    stream_callback_ = nullptr;
+    return tc::Error::Success;
+  }
+
+  tc::Error StreamInfer(const BackendInferRequest& request) override
+  {
+    BackendCallback cb;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (stream_callback_ == nullptr) {
+        return tc::Error("stream not started");
+      }
+      cb = stream_callback_;
+      stats_.stream_infer_calls++;
+      RecordSequence(request);
+    }
+    async_inflight_++;
+    uint64_t delay_us = config_.response_delay_us;
+    size_t responses = config_.stream_responses_per_request;
+    auto status = NextStatus();
+    std::string request_id = request.request_id;
+    std::thread([this, cb, delay_us, responses, status, request_id] {
+      for (size_t i = 0; i < responses; ++i) {
+        if (delay_us > 0) {
+          std::this_thread::sleep_for(std::chrono::microseconds(delay_us));
+        }
+        BackendInferResult result;
+        result.status = status;
+        result.request_id = request_id;
+        result.final_response = (i + 1 == responses);
+        cb(std::move(result));
+      }
+      async_inflight_--;
+    }).detach();
+    return tc::Error::Success;
+  }
+
+  tc::Error UpdateTraceSettings(
+      const std::map<std::string, std::vector<std::string>>& settings)
+      override
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    last_trace_settings_ = settings;
+    return tc::Error::Success;
+  }
+
+  std::map<std::string, std::vector<std::string>> LastTraceSettings()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    return last_trace_settings_;
+  }
+
+  std::string LastXlaRawHandle()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    return last_xla_raw_handle_;
   }
 
   BackendStats Stats() override
@@ -173,10 +271,14 @@ class MockClientBackend : public ClientBackend {
 
   Config config_;
   std::mutex mu_;
+  std::mutex serial_mu_;
   BackendStats stats_;
   std::vector<SeqRecord> seq_records_;
   size_t status_cursor_ = 0;
   std::atomic<int> async_inflight_{0};
+  BackendCallback stream_callback_;
+  std::map<std::string, std::vector<std::string>> last_trace_settings_;
+  std::string last_xla_raw_handle_;
 };
 
 inline MockClientBackend::MockClientBackend() : config_(Config()) {}

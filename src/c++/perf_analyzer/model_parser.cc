@@ -57,6 +57,17 @@ ModelParser::Init(
   max_batch_size_ = mbs ? (int)mbs->AsInt() : 0;
   if (config->Has("ensemble_scheduling")) {
     scheduler_ = SchedulerType::ENSEMBLE;
+    // composing models, for per-step server-stat merging (reference
+    // inference_profiler.cc:868-1097 ensemble stat handling)
+    auto steps = config->Get("ensemble_scheduling")->Get("step");
+    if (steps != nullptr) {
+      for (const auto& step : steps->Elements()) {
+        auto name = step->Get("model_name");
+        if (name != nullptr) {
+          composing_models_.push_back(name->AsString());
+        }
+      }
+    }
   } else if (config->Has("sequence_batching")) {
     scheduler_ = SchedulerType::SEQUENCE;
   } else if (config->Has("dynamic_batching")) {

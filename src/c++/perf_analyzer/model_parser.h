@@ -43,6 +43,11 @@ class ModelParser {
   bool IsDecoupled() const { return decoupled_; }
   const std::vector<ModelTensor>& Inputs() const { return inputs_; }
   const std::vector<ModelTensor>& Outputs() const { return outputs_; }
+  // ensemble composing-model names (empty for non-ensembles)
+  const std::vector<std::string>& ComposingModels() const
+  {
+    return composing_models_;
+  }
 
   // direct init for tests (no backend round-trip)
   void InitDirect(
@@ -65,6 +70,7 @@ class ModelParser {
   bool decoupled_ = false;
   std::vector<ModelTensor> inputs_;
   std::vector<ModelTensor> outputs_;
+  std::vector<std::string> composing_models_;
 };
 
 }  // namespace pa

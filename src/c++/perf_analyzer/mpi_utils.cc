@@ -1,0 +1,275 @@
+#include "mpi_utils.h"
+
+#include <dlfcn.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace pa {
+
+namespace {
+
+// persistent peer sockets for the TCP barrier (rank 0: one per peer;
+// other ranks: the single connection to rank 0)
+std::vector<int> g_peer_fds;
+
+tc::Error
+ReadByte(int fd)
+{
+  char b;
+  ssize_t n;
+  do {
+    n = ::read(fd, &b, 1);
+  } while (n < 0 && errno == EINTR);
+  if (n != 1) {
+    return tc::Error("coordination peer disconnected");
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
+WriteByte(int fd)
+{
+  char b = 1;
+  ssize_t n;
+  do {
+    n = ::send(fd, &b, 1, MSG_NOSIGNAL);
+  } while (n < 0 && errno == EINTR);
+  if (n != 1) {
+    return tc::Error("coordination peer disconnected");
+  }
+  return tc::Error::Success;
+}
+
+}  // namespace
+
+MPIDriver::~MPIDriver()
+{
+  Finalize();
+}
+
+tc::Error
+MPIDriver::Init()
+{
+  if (!enabled_) {
+    return tc::Error::Success;
+  }
+  // Prefer MPI under mpirun (OMPI_COMM_WORLD_SIZE / PMI_SIZE set by the
+  // launcher); else the TCP env contract.
+  if (std::getenv("OMPI_COMM_WORLD_SIZE") != nullptr ||
+      std::getenv("PMI_SIZE") != nullptr) {
+    tc::Error err = InitLibMpi();
+    if (err.IsOk()) {
+      return tc::Error::Success;
+    }
+    // fall through to TCP when libmpi is unusable
+  }
+  if (std::getenv("PA_COORD_SIZE") != nullptr) {
+    return InitTcp();
+  }
+  return tc::Error(
+      "--enable-mpi requires an MPI launcher (mpirun) with libmpi, or "
+      "the TCP coordination env: PA_COORD_RANK, PA_COORD_SIZE, "
+      "PA_COORD_ADDR=host:port");
+}
+
+tc::Error
+MPIDriver::InitLibMpi()
+{
+  lib_ = dlopen("libmpi.so", RTLD_NOW | RTLD_GLOBAL);
+  if (lib_ == nullptr) {
+    lib_ = dlopen("libmpi.so.40", RTLD_NOW | RTLD_GLOBAL);
+  }
+  if (lib_ == nullptr) {
+    return tc::Error("libmpi not found");
+  }
+  auto init = reinterpret_cast<int (*)(void*, void*)>(dlsym(lib_, "MPI_Init"));
+  auto comm_rank = reinterpret_cast<int (*)(void*, int*)>(
+      dlsym(lib_, "MPI_Comm_rank"));
+  auto comm_size = reinterpret_cast<int (*)(void*, int*)>(
+      dlsym(lib_, "MPI_Comm_size"));
+  mpi_barrier_ =
+      reinterpret_cast<int (*)(void*)>(dlsym(lib_, "MPI_Barrier"));
+  // OpenMPI ABI: MPI_Comm is a pointer and MPI_COMM_WORLD a data symbol.
+  // (MPICH's integer-handle ABI would need a different call shape; on
+  // hosts without OpenMPI the TCP barrier below is the supported path.)
+  mpi_comm_world_ = dlsym(lib_, "ompi_mpi_comm_world");
+  if (init == nullptr || comm_rank == nullptr || comm_size == nullptr ||
+      mpi_barrier_ == nullptr || mpi_comm_world_ == nullptr) {
+    return tc::Error("libmpi missing required symbols (OpenMPI ABI)");
+  }
+  if (init(nullptr, nullptr) != 0) {
+    return tc::Error("MPI_Init failed");
+  }
+  comm_rank(mpi_comm_world_, &rank_);
+  comm_size(mpi_comm_world_, &world_size_);
+  using_mpi_ = true;
+  active_ = world_size_ > 1;
+  return tc::Error::Success;
+}
+
+tc::Error
+MPIDriver::InitTcp()
+{
+  const char* rank_env = std::getenv("PA_COORD_RANK");
+  const char* size_env = std::getenv("PA_COORD_SIZE");
+  const char* addr_env = std::getenv("PA_COORD_ADDR");
+  if (rank_env == nullptr || size_env == nullptr || addr_env == nullptr) {
+    return tc::Error(
+        "TCP coordination needs PA_COORD_RANK, PA_COORD_SIZE and "
+        "PA_COORD_ADDR");
+  }
+  rank_ = atoi(rank_env);
+  world_size_ = atoi(size_env);
+  coord_addr_ = addr_env;
+  if (world_size_ < 2) {
+    active_ = false;
+    return tc::Error::Success;
+  }
+  std::string host = coord_addr_;
+  int port = 0;
+  auto colon = host.rfind(':');
+  if (colon == std::string::npos) {
+    return tc::Error("PA_COORD_ADDR must be host:port");
+  }
+  port = atoi(host.c_str() + colon + 1);
+  host = host.substr(0, colon);
+
+  if (rank_ == 0) {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    struct sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(port);
+    if (bind(listen_fd_, (struct sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(listen_fd_, world_size_) != 0) {
+      return tc::Error(
+          "coordination bind/listen failed on port " + std::to_string(port));
+    }
+    for (int i = 1; i < world_size_; ++i) {
+      int fd = accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        return tc::Error("coordination accept failed");
+      }
+      int nd = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+      g_peer_fds.push_back(fd);
+    }
+  } else {
+    struct addrinfo hints;
+    std::memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    if (getaddrinfo(
+            host.c_str(), std::to_string(port).c_str(), &hints, &res) != 0) {
+      return tc::Error("coordination resolve failed for " + host);
+    }
+    int fd = -1;
+    // retry for up to ~10 s: rank 0 may not be listening yet
+    for (int attempt = 0; attempt < 100 && fd < 0; ++attempt) {
+      for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) {
+          continue;
+        }
+        if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          break;
+        }
+        close(fd);
+        fd = -1;
+      }
+      if (fd < 0) {
+        usleep(100000);
+      }
+    }
+    freeaddrinfo(res);
+    if (fd < 0) {
+      return tc::Error("unable to reach coordination rank 0 at " +
+                       coord_addr_);
+    }
+    int nd = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+    g_peer_fds.push_back(fd);
+  }
+  active_ = true;
+  return tc::Error::Success;
+}
+
+tc::Error
+MPIDriver::Barrier()
+{
+  if (!active_) {
+    return tc::Error::Success;
+  }
+  if (using_mpi_) {
+    if (mpi_barrier_(mpi_comm_world_) != 0) {
+      return tc::Error("MPI_Barrier failed");
+    }
+    return tc::Error::Success;
+  }
+  return TcpBarrier();
+}
+
+tc::Error
+MPIDriver::TcpBarrier()
+{
+  ++barrier_seq_;
+  if (rank_ == 0) {
+    // gather: one byte from every peer; release: one byte back
+    for (int fd : g_peer_fds) {
+      tc::Error err = ReadByte(fd);
+      if (!err.IsOk()) {
+        return err;
+      }
+    }
+    for (int fd : g_peer_fds) {
+      tc::Error err = WriteByte(fd);
+      if (!err.IsOk()) {
+        return err;
+      }
+    }
+  } else {
+    tc::Error err = WriteByte(g_peer_fds[0]);
+    if (!err.IsOk()) {
+      return err;
+    }
+    err = ReadByte(g_peer_fds[0]);
+    if (!err.IsOk()) {
+      return err;
+    }
+  }
+  return tc::Error::Success;
+}
+
+void
+MPIDriver::Finalize()
+{
+  if (using_mpi_ && lib_ != nullptr) {
+    auto finalize = reinterpret_cast<int (*)()>(dlsym(lib_, "MPI_Finalize"));
+    if (finalize != nullptr) {
+      finalize();
+    }
+    using_mpi_ = false;
+  }
+  for (int fd : g_peer_fds) {
+    close(fd);
+  }
+  g_peer_fds.clear();
+  if (listen_fd_ >= 0) {
+    close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  active_ = false;
+}
+
+}  // namespace pa

@@ -1,0 +1,60 @@
+// Multi-process measurement coordination (reference mpi_utils.h:32-83):
+// barriers around Profile so N perf_analyzer processes measure the same
+// interval.  Two transports:
+//
+// - dlopen'd libmpi (when present and launched under mpirun): the
+//   reference's design — MPI_Init/Barrier/Finalize resolved at runtime so
+//   the binary carries no MPI link dependency.
+// - TCP fallback: a tiny rank-0-hosted barrier server, configured via
+//   PA_COORD_RANK / PA_COORD_SIZE / PA_COORD_ADDR environment variables
+//   (idiomatic on TPU pod VMs, where MPI is typically absent and the
+//   JAX-style coordinator-address pattern is the norm).
+
+#pragma once
+
+#include <string>
+
+#include "common.h"
+
+namespace pa {
+
+class MPIDriver {
+ public:
+  explicit MPIDriver(bool enabled) : enabled_(enabled) {}
+  ~MPIDriver();
+
+  // Resolve the transport (libmpi else TCP env) and initialize.
+  tc::Error Init();
+
+  bool IsMPIRun() const { return active_; }
+  int Rank() const { return rank_; }
+  int WorldSize() const { return world_size_; }
+
+  // Block until every process reaches the barrier.
+  tc::Error Barrier();
+
+  void Finalize();
+
+ private:
+  tc::Error InitLibMpi();
+  tc::Error InitTcp();
+  tc::Error TcpBarrier();
+
+  bool enabled_ = false;
+  bool active_ = false;
+  bool using_mpi_ = false;
+  int rank_ = 0;
+  int world_size_ = 1;
+
+  // libmpi symbols
+  void* lib_ = nullptr;
+  int (*mpi_barrier_)(void*) = nullptr;
+  void* mpi_comm_world_ = nullptr;
+
+  // tcp coordination
+  std::string coord_addr_;
+  int listen_fd_ = -1;  // rank 0 only
+  uint64_t barrier_seq_ = 0;
+};
+
+}  // namespace pa

@@ -49,11 +49,47 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
     params_.use_sequences = true;
   }
 
+  // decoupled models can only be driven over the stream
+  if (parser_->IsDecoupled() && !params_.streaming) {
+    return tc::Error(
+        "model '" + params_.model_name +
+        "' is decoupled: use --streaming with -i grpc");
+  }
+
+  // forward trace settings before load starts (reference
+  // command_line_parser.cc:750-754 trace forwarding)
+  if (!params_.trace_file.empty() || !params_.trace_level.empty() ||
+      params_.trace_rate > 0 || params_.trace_count > 0 ||
+      params_.log_frequency > 0) {
+    std::map<std::string, std::vector<std::string>> settings;
+    if (!params_.trace_file.empty()) {
+      settings["trace_file"] = {params_.trace_file};
+    }
+    if (!params_.trace_level.empty()) {
+      settings["trace_level"] = {params_.trace_level};
+    }
+    if (params_.trace_rate > 0) {
+      settings["trace_rate"] = {std::to_string(params_.trace_rate)};
+    }
+    if (params_.trace_count > 0) {
+      settings["trace_count"] = {std::to_string(params_.trace_count)};
+    }
+    if (params_.log_frequency > 0) {
+      settings["log_frequency"] = {std::to_string(params_.log_frequency)};
+    }
+    err = backend_->UpdateTraceSettings(settings);
+    if (!err.IsOk()) {
+      return err;
+    }
+  }
+
   LoadManagerConfig lm_config;
   lm_config.batch_size = params_.batch_size;
   lm_config.shared_memory = params_.shared_memory;
   lm_config.zero_input = params_.zero_input;
   lm_config.async = params_.async;
+  lm_config.streaming = params_.streaming;
+  lm_config.decoupled = parser_->IsDecoupled();
   lm_config.use_sequences = params_.use_sequences;
   lm_config.sequence_length = params_.sequence_length;
   lm_config.sequence_length_variation =
@@ -90,14 +126,58 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
       params_.measurement_request_count;
   prof_config.max_trials = params_.max_trials;
   prof_config.stability_threshold_pct = params_.stability_threshold_pct;
+  prof_config.percentile = params_.percentile;
+  prof_config.warmup_request_count = params_.warmup_request_count;
   prof_config.verbose = params_.verbose;
   profiler_.reset(new InferenceProfiler(
       backend_, parser_, manager_.get(), prof_config));
-  return tc::Error::Success;
+
+  if (params_.collect_metrics) {
+    std::string metrics_url = params_.metrics_url;
+    if (metrics_url.empty()) {
+      metrics_url = params_.url + "/metrics";
+    }
+    metrics_ = std::make_shared<MetricsManager>(
+        metrics_url, params_.metrics_interval_ms);
+    err = metrics_->Start();
+    if (!err.IsOk()) {
+      return err;
+    }
+    profiler_->SetMetricsManager(metrics_);
+  }
+
+  mpi_ = std::make_shared<MPIDriver>(params_.enable_mpi);
+  return mpi_->Init();
+}
+
+bool
+PerfAnalyzer::ExceedsLatencyThreshold(const PerfStatus& status) const
+{
+  if (params_.latency_threshold_ms == 0) {
+    return false;
+  }
+  return status.client_stats.stability_latency_ns / 1000000.0 >
+         (double)params_.latency_threshold_ms;
 }
 
 tc::Error
 PerfAnalyzer::Profile()
+{
+  // multi-process runs measure the same interval (reference
+  // perf_analyzer.cc:353-368 MPIBarrierWorld around Profile)
+  tc::Error barrier_err = mpi_ ? mpi_->Barrier() : tc::Error::Success;
+  if (!barrier_err.IsOk()) {
+    return barrier_err;
+  }
+  tc::Error err = ProfileSweep();
+  if (mpi_) {
+    mpi_->Barrier();
+  }
+  return err;
+}
+
+tc::Error
+PerfAnalyzer::ProfileSweep()
 {
   if (!params_.request_intervals_path.empty()) {
     auto* mgr = static_cast<CustomLoadManager*>(manager_.get());
@@ -121,44 +201,68 @@ PerfAnalyzer::Profile()
   }
   if (params_.request_rate_start > 0) {
     auto* mgr = static_cast<RequestRateManager*>(manager_.get());
-    for (double rate = params_.request_rate_start;
-         rate <= params_.request_rate_end + 1e-9 && !early_exit.load();
-         rate += params_.request_rate_step) {
+    auto profile_rate = [&](double rate, PerfStatus* status) {
       tc::Error err = mgr->ChangeRequestRate(rate);
       if (!err.IsOk()) {
         return err;
       }
-      PerfStatus status;
-      status.request_rate = rate;
-      err = profiler_->ProfileCurrentLevel(&status);
-      if (!err.IsOk()) {
-        mgr->StopWorkers();
-        return err;
+      status->request_rate = rate;
+      err = profiler_->ProfileCurrentLevel(status);
+      if (err.IsOk()) {
+        results_.push_back(*status);
       }
-      results_.push_back(status);
+      return err;
+    };
+    tc::Error err = tc::Error::Success;
+    if (params_.binary_search) {
+      err = BinarySearch<double>(
+          params_.request_rate_start, params_.request_rate_end,
+          params_.request_rate_step, profile_rate);
+    } else {
+      for (double rate = params_.request_rate_start;
+           rate <= params_.request_rate_end + 1e-9 && !early_exit.load();
+           rate += params_.request_rate_step) {
+        PerfStatus status;
+        err = profile_rate(rate, &status);
+        if (!err.IsOk() || ExceedsLatencyThreshold(status)) {
+          break;
+        }
+      }
     }
     mgr->StopWorkers();
-    return tc::Error::Success;
+    return err;
   }
   auto* mgr = static_cast<ConcurrencyManager*>(manager_.get());
-  for (size_t conc = params_.concurrency_start;
-       conc <= params_.concurrency_end && !early_exit.load();
-       conc += params_.concurrency_step) {
+  auto profile_conc = [&](size_t conc, PerfStatus* status) {
     tc::Error err = mgr->ChangeConcurrencyLevel(conc);
     if (!err.IsOk()) {
       return err;
     }
-    PerfStatus status;
-    status.concurrency = conc;
-    err = profiler_->ProfileCurrentLevel(&status);
-    if (!err.IsOk()) {
-      mgr->StopWorkers();
-      return err;
+    status->concurrency = conc;
+    err = profiler_->ProfileCurrentLevel(status);
+    if (err.IsOk()) {
+      results_.push_back(*status);
     }
-    results_.push_back(status);
+    return err;
+  };
+  tc::Error err = tc::Error::Success;
+  if (params_.binary_search) {
+    err = BinarySearch<size_t>(
+        params_.concurrency_start, params_.concurrency_end,
+        params_.concurrency_step, profile_conc);
+  } else {
+    for (size_t conc = params_.concurrency_start;
+         conc <= params_.concurrency_end && !early_exit.load();
+         conc += params_.concurrency_step) {
+      PerfStatus status;
+      err = profile_conc(conc, &status);
+      if (!err.IsOk() || ExceedsLatencyThreshold(status)) {
+        break;
+      }
+    }
   }
   mgr->StopWorkers();
-  return tc::Error::Success;
+  return err;
 }
 
 tc::Error
@@ -167,7 +271,8 @@ PerfAnalyzer::WriteReport()
   ReportWriter::WriteSummary(results_, ConcurrencyMode());
   if (!params_.latency_report_file.empty()) {
     return ReportWriter::WriteCsvFile(
-        params_.latency_report_file, results_, ConcurrencyMode());
+        params_.latency_report_file, results_, ConcurrencyMode(),
+        params_.verbose_csv);
   }
   return tc::Error::Success;
 }

@@ -8,6 +8,8 @@
 #include "command_line_parser.h"
 #include "concurrency_manager.h"
 #include "inference_profiler.h"
+#include "metrics_manager.h"
+#include "mpi_utils.h"
 #include "report_writer.h"
 #include "request_rate_manager.h"
 
@@ -40,11 +42,56 @@ class PerfAnalyzer {
            params_.request_intervals_path.empty();
   }
 
+  tc::Error ProfileSweep();
+  bool ExceedsLatencyThreshold(const PerfStatus& status) const;
+
+  // Binary search for the highest load level whose latency stays under
+  // --latency-threshold (reference inference_profiler.h:243-297): probe
+  // both ends, then bisect until the bracket narrows to `step`.
+  template <typename T>
+  tc::Error BinarySearch(
+      T start, T end, T step,
+      const std::function<tc::Error(T, PerfStatus*)>& profile)
+  {
+    PerfStatus status;
+    tc::Error err = profile(start, &status);
+    if (!err.IsOk()) {
+      return err;
+    }
+    if (ExceedsLatencyThreshold(status)) {
+      return tc::Error::Success;  // minimum load already over threshold
+    }
+    err = profile(end, &status);
+    if (!err.IsOk()) {
+      return err;
+    }
+    if (!ExceedsLatencyThreshold(status)) {
+      return tc::Error::Success;  // maximum load fits
+    }
+    T lo = start;
+    T hi = end;
+    while (hi - lo > step && !early_exit.load()) {
+      T mid = lo + (hi - lo) / 2;
+      err = profile(mid, &status);
+      if (!err.IsOk()) {
+        return err;
+      }
+      if (ExceedsLatencyThreshold(status)) {
+        hi = mid;
+      } else {
+        lo = mid;
+      }
+    }
+    return tc::Error::Success;
+  }
+
   PerfAnalyzerParameters params_;
   std::shared_ptr<ClientBackend> backend_;
   std::shared_ptr<ModelParser> parser_;
   std::unique_ptr<LoadManager> manager_;
   std::unique_ptr<InferenceProfiler> profiler_;
+  std::shared_ptr<MetricsManager> metrics_;
+  std::shared_ptr<MPIDriver> mpi_;
   std::vector<PerfStatus> results_;
 };
 

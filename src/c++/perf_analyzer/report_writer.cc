@@ -1,5 +1,6 @@
 #include "report_writer.h"
 
+#include <algorithm>
 #include <cstdio>
 #include <fstream>
 #include <sstream>
@@ -40,6 +41,13 @@ ReportWriter::WriteSummary(
            (unsigned long long)(c.p95_ns / 1000));
     printf("    p99 latency: %llu usec\n",
            (unsigned long long)(c.p99_ns / 1000));
+    if (c.response_count > c.request_count) {
+      printf("    Response count: %llu (decoupled stream)\n",
+             (unsigned long long)c.response_count);
+    }
+    if (c.overhead_pct > 0) {
+      printf("    Client overhead: %.1f%%\n", c.overhead_pct);
+    }
     const auto& s = status.server_stats;
     if (s.inference_count > 0) {
       uint64_t n = s.success_count > 0 ? s.success_count : 1;
@@ -56,20 +64,58 @@ ReportWriter::WriteSummary(
           (unsigned long long)(s.compute_infer_ns / n / 1000),
           (unsigned long long)(s.compute_output_ns / n / 1000));
     }
+    for (const auto& kv : status.composing_server_stats) {
+      const auto& cs = kv.second;
+      uint64_t n = cs.success_count > 0 ? cs.success_count : 1;
+      printf("  Composing model %s:\n", kv.first.c_str());
+      printf("    Inference count: %llu\n",
+             (unsigned long long)cs.inference_count);
+      printf(
+          "    Avg request latency: queue %llu usec, compute infer %llu "
+          "usec\n",
+          (unsigned long long)(cs.queue_ns / n / 1000),
+          (unsigned long long)(cs.compute_infer_ns / n / 1000));
+    }
+    if (!status.metrics.empty()) {
+      printf("  Server metrics (avg over measurement):\n");
+      for (const auto& kv : status.metrics) {
+        printf("    %s: %g\n", kv.first.c_str(), kv.second);
+      }
+    }
     printf("\n");
   }
 }
 
 std::string
 ReportWriter::GenerateCsv(
-    const std::vector<PerfStatus>& results, bool concurrency_mode)
+    const std::vector<PerfStatus>& results, bool concurrency_mode,
+    bool verbose)
 {
+  // union of scraped metric names across levels, for stable columns
+  std::vector<std::string> metric_names;
+  if (verbose) {
+    for (const auto& status : results) {
+      for (const auto& kv : status.metrics) {
+        if (std::find(metric_names.begin(), metric_names.end(), kv.first) ==
+            metric_names.end()) {
+          metric_names.push_back(kv.first);
+        }
+      }
+    }
+  }
   std::ostringstream out;
   out << (concurrency_mode ? "Concurrency" : "Request Rate")
       << ",Inferences/Second,Client Send,"
       << "Network+Server Send/Recv,Server Queue,Server Compute Input,"
       << "Server Compute Infer,Server Compute Output,Client Recv,"
-      << "p50 latency,p90 latency,p95 latency,p99 latency\n";
+      << "p50 latency,p90 latency,p95 latency,p99 latency";
+  if (verbose) {
+    out << ",Avg latency,Client Overhead Pct,Responses/Second";
+    for (const auto& name : metric_names) {
+      out << "," << name;
+    }
+  }
+  out << "\n";
   for (const auto& status : results) {
     const auto& c = status.client_stats;
     const auto& s = status.server_stats;
@@ -90,7 +136,24 @@ ReportWriter::GenerateCsv(
         << "," << (s.compute_infer_ns / n / 1000) << ","
         << (s.compute_output_ns / n / 1000) << ",0,"
         << (c.p50_ns / 1000) << "," << (c.p90_ns / 1000) << ","
-        << (c.p95_ns / 1000) << "," << (c.p99_ns / 1000) << "\n";
+        << (c.p95_ns / 1000) << "," << (c.p99_ns / 1000);
+    if (verbose) {
+      double responses_per_sec =
+          c.request_count > 0
+              ? c.infer_per_sec * ((double)c.response_count /
+                                   (double)c.request_count)
+              : 0.0;
+      out << "," << avg_us << "," << c.overhead_pct << ","
+          << responses_per_sec;
+      for (const auto& name : metric_names) {
+        auto it = status.metrics.find(name);
+        out << ",";
+        if (it != status.metrics.end()) {
+          out << it->second;
+        }
+      }
+    }
+    out << "\n";
   }
   return out.str();
 }
@@ -98,13 +161,13 @@ ReportWriter::GenerateCsv(
 tc::Error
 ReportWriter::WriteCsvFile(
     const std::string& path, const std::vector<PerfStatus>& results,
-    bool concurrency_mode)
+    bool concurrency_mode, bool verbose)
 {
   std::ofstream f(path);
   if (!f) {
     return tc::Error("unable to open csv file " + path);
   }
-  f << GenerateCsv(results, concurrency_mode);
+  f << GenerateCsv(results, concurrency_mode, verbose);
   return tc::Error::Success;
 }
 

@@ -16,13 +16,16 @@ class ReportWriter {
       const std::vector<PerfStatus>& results, bool concurrency_mode);
 
   // CSV with the reference's column schema
-  // (docs/measurements_metrics.md:103).
+  // (docs/measurements_metrics.md:103); verbose adds avg latency,
+  // overhead pct, response throughput and any scraped metric columns
+  // (reference --verbose-csv).
   static std::string GenerateCsv(
-      const std::vector<PerfStatus>& results, bool concurrency_mode);
+      const std::vector<PerfStatus>& results, bool concurrency_mode,
+      bool verbose = false);
 
   static tc::Error WriteCsvFile(
       const std::string& path, const std::vector<PerfStatus>& results,
-      bool concurrency_mode);
+      bool concurrency_mode, bool verbose = false);
 };
 
 }  // namespace pa

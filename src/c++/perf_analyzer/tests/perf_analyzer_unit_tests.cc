@@ -79,6 +79,72 @@ TestCliRanges()
   CHECK(!CLParser::Parse(5, (char**)bad, &p2, &error));
 }
 
+static void
+TestCliBackHalf()
+{
+  // the reference's remaining option surface: search, stability metric,
+  // streaming, trace forwarding, metrics collection
+  const char* argv[] = {
+      "perf_analyzer", "-m", "m", "-i", "grpc",
+      "--concurrency-range", "1:32:1", "-l", "50", "--binary-search",
+      "--percentile", "99", "--warmup-request-count", "10",
+      "--streaming", "--trace-file", "/tmp/t.json", "--trace-level",
+      "TIMESTAMPS", "--trace-rate", "100", "--collect-metrics",
+      "--metrics-interval", "250", "--verbose-csv", "--enable-mpi",
+      "--string-length", "64", "--start-sequence-id", "7",
+      "--sequence-id-range", "100"};
+  PerfAnalyzerParameters params;
+  std::string error;
+  CHECK(CLParser::Parse(29, (char**)argv, &params, &error));
+  CHECK(params.latency_threshold_ms == 50);
+  CHECK(params.binary_search);
+  CHECK(params.percentile == 99);
+  CHECK(params.warmup_request_count == 10);
+  CHECK(params.streaming);
+  CHECK(params.trace_file == "/tmp/t.json");
+  CHECK(params.trace_level == "TIMESTAMPS");
+  CHECK(params.trace_rate == 100);
+  CHECK(params.collect_metrics);
+  CHECK(params.metrics_interval_ms == 250);
+  CHECK(params.verbose_csv);
+  CHECK(params.enable_mpi);
+  CHECK(params.string_length == 64);
+  CHECK(params.start_sequence_id == 7);
+  CHECK(params.sequence_id_range == 100);
+
+  // --binary-search without -l is invalid
+  const char* bad1[] = {
+      "perf_analyzer", "-m", "m", "--concurrency-range", "1:8",
+      "--binary-search"};
+  PerfAnalyzerParameters p1;
+  CHECK(!CLParser::Parse(6, (char**)bad1, &p1, &error));
+  CHECK(error.find("latency-threshold") != std::string::npos);
+
+  // --binary-search without a range is invalid
+  const char* bad2[] = {
+      "perf_analyzer", "-m", "m", "-l", "10", "--binary-search"};
+  PerfAnalyzerParameters p2;
+  CHECK(!CLParser::Parse(6, (char**)bad2, &p2, &error));
+  CHECK(error.find("range") != std::string::npos);
+
+  // --streaming requires grpc
+  const char* bad3[] = {"perf_analyzer", "-m", "m", "--streaming"};
+  PerfAnalyzerParameters p3;
+  CHECK(!CLParser::Parse(4, (char**)bad3, &p3, &error));
+  CHECK(error.find("grpc") != std::string::npos);
+
+  // --percentile bounds
+  const char* bad4[] = {"perf_analyzer", "-m", "m", "--percentile", "101"};
+  PerfAnalyzerParameters p4;
+  CHECK(!CLParser::Parse(5, (char**)bad4, &p4, &error));
+
+  // legacy -t concurrency alias
+  const char* legacy[] = {"perf_analyzer", "-m", "m", "-t", "6"};
+  PerfAnalyzerParameters p5;
+  CHECK(CLParser::Parse(5, (char**)legacy, &p5, &error));
+  CHECK(p5.concurrency_start == 6 && p5.concurrency_end == 6);
+}
+
 // -- schedule distribution (reference test_request_rate_manager.cc) --------
 
 static void
