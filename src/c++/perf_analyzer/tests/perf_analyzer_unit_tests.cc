@@ -95,7 +95,8 @@ TestCliBackHalf()
       "--sequence-id-range", "100"};
   PerfAnalyzerParameters params;
   std::string error;
-  CHECK(CLParser::Parse(29, (char**)argv, &params, &error));
+  CHECK(CLParser::Parse(
+      sizeof(argv) / sizeof(argv[0]), (char**)argv, &params, &error));
   CHECK(params.latency_threshold_ms == 50);
   CHECK(params.binary_search);
   CHECK(params.percentile == 99);
@@ -431,6 +432,7 @@ main()
   TestCliDefaults();
   TestCliMissingModel();
   TestCliRanges();
+  TestCliBackHalf();
   TestScheduleDistribution();
   TestSummarizeRecords();
   TestModelParser();
