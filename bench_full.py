@@ -1,0 +1,423 @@
+"""Full benchmark: all five BASELINE.md target configs.
+
+Mirrors `BASELINE.json`'s target list (see BASELINE.md "Target metric"):
+
+  1. simple add/sub model, HTTP, sync, concurrency 1          (infer/sec, p50)
+  2. ResNet-50 over GRPC — in-band vs system-shm vs XLA-shm   (infer/sec, p50)
+  3. DenseNet-121 over GRPC with an XLA (TPU HBM) shm region  (infer/sec, p50)
+  4. BERT-base ensemble (tokenizer → encoder), async GRPC
+     streaming, pipelined                                     (infer/sec)
+  5. Llama decoupled token-by-token generation with the KV
+     cache parked in an XLA shm region                        (tokens/sec)
+
+Each config prints ONE JSON line:
+  {"config": N, "metric": "...", "value": X, "unit": "...",
+   "vs_baseline": Y|null, ...}
+
+The reference publishes baselines only for configs 1 (1407.84 infer/sec,
+p50 690 usec — quick_start.md:94-108) and ResNet-50-shaped serving (165.8
+infer/sec TF-Serving gRPC / 159.8 TorchServe HTTP — benchmarking.md:121-204);
+the other configs report vs_baseline against the closest of those or null.
+
+Usage:  python bench_full.py [--configs 1,2,3,4,5] [--quick]
+`--quick` shrinks windows for smoke runs (not for reported numbers).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+import numpy as np  # noqa: E402
+
+from bench import BASELINE_INFER_PER_SEC, BASELINE_P50_USEC  # noqa: E402
+
+BASELINES = {
+    "simple_http": BASELINE_INFER_PER_SEC,   # quick_start.md:94
+    "simple_http_p50": BASELINE_P50_USEC,    # quick_start.md:96
+    "resnet50_grpc": 165.8,      # benchmarking.md:121-129 (TF-Serving gRPC)
+    "densenet_grpc": 159.8,      # benchmarking.md:196-204 (TorchServe HTTP)
+}
+
+
+def _measure(call, window_s, windows, warmup=20):
+    """Median infer/sec over `windows` timed windows + overall p50 latency.
+
+    The reference's methodology is 3 stable windows (perf_analyzer
+    stability-percentage, inference_profiler.cc:780-833); here each window
+    is fixed-duration and the reported rate is the median across windows.
+    """
+    for _ in range(warmup):
+        call()
+    rates, lats = [], []
+    for _ in range(windows):
+        n = 0
+        t0 = time.perf_counter()
+        while True:
+            t1 = time.perf_counter()
+            call()
+            lats.append(time.perf_counter() - t1)
+            n += 1
+            dt = time.perf_counter() - t0
+            if dt >= window_s:
+                break
+        rates.append(n / dt)
+    lats.sort()
+    p50 = lats[len(lats) // 2] * 1e6
+    return statistics.median(rates), p50
+
+
+def _emit(config, metric, value, unit, baseline_key=None, **extra):
+    base = BASELINES.get(baseline_key) if baseline_key else None
+    line = {
+        "config": config,
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / base, 4) if base else None,
+    }
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+    return line
+
+
+# ---------------------------------------------------------------------------
+# config 1: simple model, HTTP, sync, concurrency 1
+# ---------------------------------------------------------------------------
+
+def bench_simple_http(http_url, window_s, windows):
+    import tritonclient.http as httpclient
+
+    client = httpclient.InferenceServerClient(http_url)
+    a = np.arange(16, dtype=np.int32).reshape(1, 16)
+    b = np.full((1, 16), 2, dtype=np.int32)
+    in0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+    in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
+    in0.set_data_from_numpy(a, binary_data=True)
+    in1.set_data_from_numpy(b, binary_data=True)
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
+    ]
+    result = client.infer("simple", [in0, in1], outputs=outputs)
+    assert (result.as_numpy("OUTPUT0") == a + b).all()
+    rate, p50 = _measure(
+        lambda: client.infer("simple", [in0, in1], outputs=outputs),
+        window_s, windows,
+    )
+    client.close()
+    return _emit(1, "simple_http_sync_conc1", rate, "infer/sec",
+                 "simple_http", p50_usec=round(p50, 1),
+                 p50_vs_baseline=round(p50 / BASELINES["simple_http_p50"], 4))
+
+
+# ---------------------------------------------------------------------------
+# configs 2/3: vision models over GRPC, in-band vs system shm vs XLA shm
+# ---------------------------------------------------------------------------
+
+def _vision_call_inband(client, grpcclient, model, img):
+    inp = grpcclient.InferInput("INPUT", list(img.shape), "FP32")
+    inp.set_data_from_numpy(img)
+    out = grpcclient.InferRequestedOutput("OUTPUT")
+
+    def call():
+        client.infer(model, [inp], outputs=[out])
+    return call, lambda: None
+
+
+def _vision_call_system_shm(client, grpcclient, model, img):
+    from tritonclient.utils import shared_memory as shm
+
+    in_bytes, out_bytes = img.nbytes, 1000 * 4
+    region_in, region_out = model + "_in", model + "_out"
+    h_in = shm.create_shared_memory_region(
+        region_in, "/" + region_in, in_bytes)
+    h_out = shm.create_shared_memory_region(
+        region_out, "/" + region_out, out_bytes)
+    shm.set_shared_memory_region(h_in, [img])
+    client.register_system_shared_memory(region_in, "/" + region_in, in_bytes)
+    client.register_system_shared_memory(
+        region_out, "/" + region_out, out_bytes)
+    inp = grpcclient.InferInput("INPUT", list(img.shape), "FP32")
+    inp.set_shared_memory(region_in, in_bytes)
+    out = grpcclient.InferRequestedOutput("OUTPUT")
+    out.set_shared_memory(region_out, out_bytes)
+
+    def call():
+        client.infer(model, [inp], outputs=[out])
+
+    def cleanup():
+        client.unregister_system_shared_memory(region_in)
+        client.unregister_system_shared_memory(region_out)
+        shm.destroy_shared_memory_region(h_in)
+        shm.destroy_shared_memory_region(h_out)
+    return call, cleanup
+
+
+def _vision_call_xla_shm(client, grpcclient, model, img):
+    import jax.numpy as jnp
+
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    in_bytes, out_bytes = img.nbytes, 1000 * 4
+    region_in, region_out = model + "_xin", model + "_xout"
+    h_in = xshm.create_shared_memory_region(region_in, in_bytes)
+    h_out = xshm.create_shared_memory_region(region_out, out_bytes)
+    client.register_xla_shared_memory(
+        region_in, xshm.get_raw_handle(h_in), 0, in_bytes)
+    client.register_xla_shared_memory(
+        region_out, xshm.get_raw_handle(h_out), 0, out_bytes)
+    xshm.set_shared_memory_region_from_jax(h_in, [jnp.asarray(img)])
+    inp = grpcclient.InferInput("INPUT", list(img.shape), "FP32")
+    inp.set_shared_memory(region_in, in_bytes)
+    out = grpcclient.InferRequestedOutput("OUTPUT")
+    out.set_shared_memory(region_out, out_bytes)
+
+    def call():
+        client.infer(model, [inp], outputs=[out])
+
+    def cleanup():
+        client.unregister_xla_shared_memory(region_in)
+        client.unregister_xla_shared_memory(region_out)
+        xshm.destroy_shared_memory_region(h_in)
+        xshm.destroy_shared_memory_region(h_out)
+    return call, cleanup
+
+
+def bench_vision(grpc_url, config, model, modes, window_s, windows):
+    import tritonclient.grpc as grpcclient
+
+    client = grpcclient.InferenceServerClient(grpc_url)
+    img = np.random.RandomState(0).rand(1, 224, 224, 3).astype(np.float32)
+    baseline_key = "resnet50_grpc" if model == "resnet50" else "densenet_grpc"
+    makers = {
+        "inband": _vision_call_inband,
+        "system_shm": _vision_call_system_shm,
+        "xla_shm": _vision_call_xla_shm,
+    }
+    results = {}
+    for mode in modes:
+        call, cleanup = makers[mode](client, grpcclient, model, img)
+        try:
+            call()  # smoke + compile
+            rate, p50 = _measure(call, window_s, windows, warmup=5)
+        finally:
+            cleanup()
+        results[mode] = _emit(
+            config, "{}_grpc_{}".format(model, mode), rate, "infer/sec",
+            baseline_key, p50_usec=round(p50, 1))
+    if "system_shm" in results and "xla_shm" in results:
+        delta = (results["xla_shm"]["value"] /
+                 results["system_shm"]["value"])
+        print(json.dumps({
+            "config": config,
+            "metric": "{}_xla_shm_vs_system_shm".format(model),
+            "value": round(delta, 4), "unit": "ratio", "vs_baseline": None,
+        }), flush=True)
+    client.close()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# config 4: BERT ensemble, async GRPC streaming, pipelined
+# ---------------------------------------------------------------------------
+
+def bench_bert_stream(grpc_url, window_s, windows):
+    import queue
+
+    import tritonclient.grpc as grpcclient
+
+    client = grpcclient.InferenceServerClient(grpc_url)
+    done = queue.Queue()
+    client.start_stream(lambda result, error: done.put((result, error)))
+    texts = [
+        np.array([m], dtype=np.object_)
+        for m in (b"the quick brown fox", b"jumps over the lazy dog",
+                  b"benchmarking bert on tpu", b"streaming ensemble path")
+    ]
+    inputs = []
+    for t in texts:
+        inp = grpcclient.InferInput("TEXT", [1], "BYTES")
+        inp.set_data_from_numpy(t)
+        inputs.append(inp)
+
+    def issue(i):
+        client.async_stream_infer("bert_ensemble", [inputs[i % len(inputs)]])
+
+    # prime/compile
+    issue(0)
+    result, error = done.get(timeout=120)
+    assert error is None, repr(error)
+
+    rates = []
+    lat = []
+    inflight_target = 8
+    for _ in range(windows):
+        inflight = 0
+        completed = 0
+        t0 = time.perf_counter()
+        sent_at = {}
+        seq = 0
+        while True:
+            while inflight < inflight_target:
+                sent_at[seq] = time.perf_counter()
+                issue(seq)
+                seq += 1
+                inflight += 1
+            result, error = done.get(timeout=120)
+            assert error is None, repr(error)
+            completed += 1
+            inflight -= 1
+            lat.append(time.perf_counter() - sent_at.pop(completed - 1, t0))
+            dt = time.perf_counter() - t0
+            if dt >= window_s:
+                break
+        # drain
+        while inflight:
+            result, error = done.get(timeout=120)
+            assert error is None, repr(error)
+            inflight -= 1
+        rates.append(completed / dt)
+    client.stop_stream()
+    client.close()
+    lat.sort()
+    return _emit(4, "bert_ensemble_grpc_stream_pipelined",
+                 statistics.median(rates), "infer/sec", None,
+                 p50_usec=round(lat[len(lat) // 2] * 1e6, 1))
+
+
+# ---------------------------------------------------------------------------
+# config 5: llama decoupled generation, tokens/sec, KV parked in XLA shm
+# ---------------------------------------------------------------------------
+
+def bench_llama_stream(grpc_url, windows, max_tokens=64):
+    import queue
+
+    import tritonclient.grpc as grpcclient
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    client = grpcclient.InferenceServerClient(grpc_url)
+    kv = xshm.create_shared_memory_region("bench_kv", 8 << 20)
+    client.register_xla_shared_memory(
+        "bench_kv", xshm.get_raw_handle(kv), 0, 8 << 20)
+
+    responses = queue.Queue()
+    client.start_stream(lambda result, error: responses.put((result, error)))
+    prompt = np.array([1, 5, 9, 13, 17, 21, 25, 29], dtype=np.int32)
+    p_in = grpcclient.InferInput("PROMPT_IDS", [len(prompt)], "INT32")
+    p_in.set_data_from_numpy(prompt)
+    m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+    m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
+
+    def generate(park):
+        params = {"kv_cache_region": "bench_kv"} if park else None
+        t0 = time.perf_counter()
+        first = None
+        n = 0
+        client.async_stream_infer(
+            "llama_generate", [p_in, m_in],
+            enable_empty_final_response=True, parameters=params)
+        while True:
+            result, error = responses.get(timeout=300)
+            assert error is None, error
+            resp = result.get_response()
+            if resp.parameters.get(
+                    "triton_final_response") and resp.parameters[
+                    "triton_final_response"].bool_param:
+                break
+            if first is None:
+                first = time.perf_counter() - t0
+            n += 1
+        return n / (time.perf_counter() - t0), first
+
+    generate(False)  # compile/warmup
+    rates, ttfts = [], []
+    for _ in range(windows):
+        r, ttft = generate(True)
+        rates.append(r)
+        ttfts.append(ttft)
+    client.stop_stream()
+    client.unregister_xla_shared_memory("bench_kv")
+    xshm.destroy_shared_memory_region(kv)
+    client.close()
+    return _emit(5, "llama_decoupled_stream", statistics.median(rates),
+                 "tokens/sec", None,
+                 ttft_ms=round(statistics.median(ttfts) * 1e3, 1),
+                 max_tokens=max_tokens)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    wanted = {int(c) for c in args.configs.split(",")}
+    window_s = 0.5 if args.quick else 2.0
+    windows = 2 if args.quick else 5
+
+    from tpuserver.core import InferenceServer
+    from tpuserver.grpc_frontend import GrpcFrontend
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models import default_models, serving_models
+
+    need_zoo = wanted & {2, 3, 4, 5}
+    models = default_models()
+    if need_zoo:
+        models += serving_models(
+            include_vision=bool(wanted & {2, 3}),
+            include_bert=4 in wanted,
+            include_llama=5 in wanted,
+        )
+    core = InferenceServer(models)
+    http = HttpFrontend(core, port=0).start()
+    grpc_f = GrpcFrontend(core, port=0).start()
+    grpc_url = "127.0.0.1:{}".format(grpc_f.port)
+    http_url = http.url.replace("http://", "")
+    failures = []
+    try:
+        if 1 in wanted:
+            try:
+                bench_simple_http(http_url, window_s, windows)
+            except Exception as e:
+                failures.append((1, e))
+        if 2 in wanted:
+            try:
+                bench_vision(grpc_url, 2, "resnet50",
+                             ["inband", "system_shm", "xla_shm"],
+                             window_s, windows)
+            except Exception as e:  # keep later configs running
+                failures.append((2, e))
+        if 3 in wanted:
+            try:
+                bench_vision(grpc_url, 3, "densenet121", ["xla_shm"],
+                             window_s, windows)
+            except Exception as e:
+                failures.append((3, e))
+        if 4 in wanted:
+            try:
+                bench_bert_stream(grpc_url, window_s, windows)
+            except Exception as e:
+                failures.append((4, e))
+        if 5 in wanted:
+            try:
+                bench_llama_stream(grpc_url, windows,
+                                   max_tokens=16 if args.quick else 64)
+            except Exception as e:
+                failures.append((5, e))
+    finally:
+        grpc_f.stop()
+        http.stop()
+    for config, err in failures:
+        print(json.dumps({"config": config, "error": str(err)}),
+              file=sys.stderr, flush=True)
+    if failures:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -753,9 +753,13 @@ class InferenceServer:
     def _batch_of(self, model, inputs):
         if model.max_batch_size > 0 and inputs:
             first = next(iter(inputs.values()))
-            return int(np.asarray(first).shape[0]) if np.asarray(
-                first
-            ).ndim > 0 else 1
+            # .shape/.ndim are metadata on numpy and jax arrays alike;
+            # np.asarray here would force a device→host transfer when the
+            # input is a device-resident jax.Array from an XLA shm region.
+            shape = getattr(first, "shape", None)
+            if shape is None:
+                shape = np.asarray(first).shape
+            return int(shape[0]) if len(shape) > 0 else 1
         return 1
 
     def _execute(self, model, request):
@@ -903,9 +907,7 @@ class InferenceServer:
             np_arr = np.asarray(array) if not hasattr(
                 array, "addressable_shards"
             ) else array
-            shape = list(np.asarray(np_arr).shape) if isinstance(
-                np_arr, np.ndarray
-            ) else list(np_arr.shape)
+            shape = list(np_arr.shape)
             delivery = {
                 "binary_data": ro.binary_data,
                 "shm_region": ro.shm_region,
@@ -913,10 +915,13 @@ class InferenceServer:
                 "shm_offset": ro.shm_offset,
             }
             if ro.shm_region is not None:
+                # .nbytes is metadata on both numpy and jax arrays; avoid
+                # np.asarray here — it would force a device→host transfer
+                # for outputs that stay device-resident in an XLA region.
                 expected = (
                     serialized_byte_size(np.asarray(np_arr, dtype=object))
                     if datatype == "BYTES"
-                    else int(np.asarray(np_arr).nbytes)
+                    else int(np_arr.nbytes)
                 )
                 if expected > ro.shm_byte_size:
                     raise ServerError(
