@@ -201,25 +201,34 @@ def bench_vision(grpc_url, config, model, modes, window_s, windows):
         "xla_shm": _vision_call_xla_shm,
     }
     results = {}
-    for mode in modes:
-        call, cleanup = makers[mode](client, grpcclient, model, img)
-        try:
-            call()  # smoke + compile
-            rate, p50 = _measure(call, window_s, windows, warmup=5)
-        finally:
-            cleanup()
-        results[mode] = _emit(
-            config, "{}_grpc_{}".format(model, mode), rate, "infer/sec",
-            baseline_key, p50_usec=round(p50, 1))
-    if "system_shm" in results and "xla_shm" in results:
-        delta = (results["xla_shm"]["value"] /
-                 results["system_shm"]["value"])
-        print(json.dumps({
-            "config": config,
-            "metric": "{}_xla_shm_vs_system_shm".format(model),
-            "value": round(delta, 4), "unit": "ratio", "vs_baseline": None,
-        }), flush=True)
-    client.close()
+    try:
+        for mode in modes:
+            try:
+                call, cleanup = makers[mode](client, grpcclient, model, img)
+            except Exception:
+                # partial setup may have registered regions; drop them all
+                client.unregister_system_shared_memory()
+                client.unregister_xla_shared_memory()
+                raise
+            try:
+                call()  # smoke + compile
+                rate, p50 = _measure(call, window_s, windows, warmup=5)
+            finally:
+                cleanup()
+            results[mode] = _emit(
+                config, "{}_grpc_{}".format(model, mode), rate,
+                "infer/sec", baseline_key, p50_usec=round(p50, 1))
+        if "system_shm" in results and "xla_shm" in results:
+            delta = (results["xla_shm"]["value"] /
+                     results["system_shm"]["value"])
+            print(json.dumps({
+                "config": config,
+                "metric": "{}_xla_shm_vs_system_shm".format(model),
+                "value": round(delta, 4), "unit": "ratio",
+                "vs_baseline": None,
+            }), flush=True)
+    finally:
+        client.close()
     return results
 
 

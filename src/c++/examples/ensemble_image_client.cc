@@ -1,0 +1,120 @@
+// Drive the `image_ensemble` model (preprocess -> ResNet-50 ensemble
+// scheduling): raw uint8 pixels in, top-k classes out (role of reference
+// src/c++/examples/ensemble_image_client.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url;
+  std::string protocol = "http";
+  size_t topk = 3;
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:i:c:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      case 'i':
+        protocol = optarg;
+        break;
+      case 'c':
+        topk = (size_t)atoi(optarg);
+        break;
+      default:
+        std::cerr << "usage: " << argv[0]
+                  << " [-v] [-u url] [-i http|grpc] [-c classes]"
+                  << std::endl;
+        exit(1);
+    }
+  }
+  for (auto& ch : protocol) {
+    ch = tolower(ch);
+  }
+  if (url.empty()) {
+    url = (protocol == "grpc") ? "localhost:8001" : "localhost:8000";
+  }
+
+  // deterministic synthetic uint8 image
+  std::vector<uint8_t> pixels(224 * 224 * 3);
+  uint32_t state = 99;
+  for (auto& p : pixels) {
+    state = state * 1664525u + 1013904223u;
+    p = state >> 24;
+  }
+
+  tc::InferInput* input;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(
+          &input, "RAW_IMAGE", {1, 224, 224, 3}, "UINT8"),
+      "creating RAW_IMAGE");
+  std::shared_ptr<tc::InferInput> input_ptr(input);
+  FAIL_IF_ERR(input_ptr->AppendRaw(pixels), "setting RAW_IMAGE data");
+
+  tc::InferRequestedOutput* output;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output, "OUTPUT", topk),
+      "creating OUTPUT");
+  std::shared_ptr<tc::InferRequestedOutput> output_ptr(output);
+
+  tc::InferOptions options("image_ensemble");
+  tc::InferResult* result = nullptr;
+  if (protocol == "grpc") {
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    FAIL_IF_ERR(
+        tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+        "creating grpc client");
+    FAIL_IF_ERR(
+        client->Infer(
+            &result, options, {input_ptr.get()}, {output_ptr.get()}),
+        "infer");
+  } else {
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    FAIL_IF_ERR(
+        tc::InferenceServerHttpClient::Create(&client, url, verbose),
+        "creating http client");
+    FAIL_IF_ERR(
+        client->Infer(
+            &result, options, {input_ptr.get()}, {output_ptr.get()}),
+        "infer");
+  }
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  std::vector<std::string> entries;
+  FAIL_IF_ERR(
+      result_ptr->StringData("OUTPUT", &entries), "parsing class output");
+  if (entries.size() != topk) {
+    std::cerr << "error: expected " << topk << " classes, got "
+              << entries.size() << std::endl;
+    exit(1);
+  }
+  for (const auto& entry : entries) {
+    std::cout << "    " << entry << std::endl;
+  }
+  std::cout << "ensemble image client OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,384 @@
+// Image classification client: PPM/synthetic input, NONE/VGG/INCEPTION
+// scaling, batching, sync/async/streaming issue over HTTP or gRPC,
+// classification postprocess (role of reference
+// src/c++/examples/image_client.cc:64-120; OpenCV replaced by a
+// dependency-free PPM reader + nearest-neighbor resample).
+
+#include <getopt.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+enum class ScaleType { NONE, VGG, INCEPTION };
+
+struct Image {
+  std::string name;
+  int height = 0;
+  int width = 0;
+  std::vector<uint8_t> pixels;  // HWC uint8
+};
+
+Image
+ReadPPM(const std::string& path)
+{
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::cerr << "error: cannot open " << path << std::endl;
+    exit(1);
+  }
+  std::string magic;
+  f >> magic;
+  if (magic != "P6") {
+    std::cerr << "error: " << path << " is not a binary PPM (P6)"
+              << std::endl;
+    exit(1);
+  }
+  int width, height, maxval;
+  // skip comments
+  auto next_int = [&]() {
+    int value;
+    while (!(f >> value)) {
+      if (f.eof() || f.bad()) {
+        std::cerr << "error: truncated or malformed PPM header in "
+                  << path << std::endl;
+        exit(1);
+      }
+      f.clear();
+      std::string comment;
+      std::getline(f, comment);
+    }
+    return value;
+  };
+  width = next_int();
+  height = next_int();
+  maxval = next_int();
+  f.get();  // single whitespace after maxval
+  if (maxval != 255) {
+    std::cerr << "error: only maxval=255 PPM supported" << std::endl;
+    exit(1);
+  }
+  Image img;
+  img.name = path;
+  img.width = width;
+  img.height = height;
+  img.pixels.resize((size_t)width * height * 3);
+  f.read((char*)img.pixels.data(), img.pixels.size());
+  return img;
+}
+
+Image
+Synthetic(int index)
+{
+  Image img;
+  img.name = "synthetic_" + std::to_string(index);
+  img.width = 224;
+  img.height = 224;
+  img.pixels.resize(224 * 224 * 3);
+  uint32_t state = 12345 + index;  // deterministic LCG pixels
+  for (auto& p : img.pixels) {
+    state = state * 1664525u + 1013904223u;
+    p = state >> 24;
+  }
+  return img;
+}
+
+// nearest-neighbor resample to 224x224 + scaling -> FP32 CHW? no: NHWC
+std::vector<float>
+Preprocess(const Image& img, ScaleType scaling)
+{
+  constexpr int kSize = 224;
+  std::vector<float> out((size_t)kSize * kSize * 3);
+  for (int y = 0; y < kSize; ++y) {
+    int sy = (int)((int64_t)y * img.height / kSize);
+    for (int x = 0; x < kSize; ++x) {
+      int sx = (int)((int64_t)x * img.width / kSize);
+      const uint8_t* src =
+          &img.pixels[((size_t)sy * img.width + sx) * 3];
+      float* dst = &out[((size_t)y * kSize + x) * 3];
+      for (int c = 0; c < 3; ++c) {
+        float v = (float)src[c];
+        switch (scaling) {
+          case ScaleType::INCEPTION:
+            v = v / 127.5f - 1.0f;
+            break;
+          case ScaleType::VGG: {
+            static const float kMean[3] = {123.68f, 116.78f, 103.94f};
+            v = v - kMean[c];
+            break;
+          }
+          case ScaleType::NONE:
+            break;
+        }
+        dst[c] = v;
+      }
+    }
+  }
+  return out;
+}
+
+void
+PrintClasses(
+    const std::string& image_name, tc::InferResult* result,
+    const std::string& output_name, size_t batch_index, size_t classes)
+{
+  std::vector<std::string> entries;
+  FAIL_IF_ERR(
+      result->StringData(output_name, &entries), "parsing class output");
+  std::cout << "Image '" << image_name << "':" << std::endl;
+  for (size_t c = 0; c < classes; ++c) {
+    size_t idx = batch_index * classes + c;
+    if (idx < entries.size()) {
+      std::cout << "    " << entries[idx] << std::endl;
+    }
+  }
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  bool async_mode = false;
+  bool streaming = false;
+  int batch_size = 1;
+  size_t topk = 1;
+  int synthetic = 0;
+  std::string scaling_str = "NONE";
+  std::string protocol = "http";
+  std::string model_name = "resnet50";
+  std::string url;
+
+  static struct option long_opts[] = {
+      {"streaming", no_argument, nullptr, 1},
+      {"synthetic", required_argument, nullptr, 2},
+      {nullptr, 0, nullptr, 0}};
+  int opt;
+  while ((opt = getopt_long(
+              argc, argv, "vab:c:s:i:u:m:", long_opts, nullptr)) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'a':
+        async_mode = true;
+        break;
+      case 'b':
+        batch_size = atoi(optarg);
+        break;
+      case 'c':
+        topk = (size_t)atoi(optarg);
+        break;
+      case 's':
+        scaling_str = optarg;
+        break;
+      case 'i':
+        protocol = optarg;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      case 'm':
+        model_name = optarg;
+        break;
+      case 1:
+        streaming = true;
+        break;
+      case 2:
+        synthetic = atoi(optarg);
+        break;
+      default:
+        std::cerr
+            << "usage: " << argv[0]
+            << " [-v] [-a] [--streaming] [-b batch] [-c classes]"
+            << " [-s NONE|VGG|INCEPTION] [-i http|grpc] [-u url]"
+            << " [-m model] [--synthetic N | image.ppm ...]" << std::endl;
+        exit(1);
+    }
+  }
+  for (auto& ch : protocol) {
+    ch = tolower(ch);
+  }
+  ScaleType scaling = ScaleType::NONE;
+  if (scaling_str == "VGG") {
+    scaling = ScaleType::VGG;
+  } else if (scaling_str == "INCEPTION") {
+    scaling = ScaleType::INCEPTION;
+  }
+  if (url.empty()) {
+    url = (protocol == "grpc") ? "localhost:8001" : "localhost:8000";
+  }
+  if (streaming && protocol != "grpc") {
+    std::cerr << "error: streaming requires -i grpc" << std::endl;
+    exit(1);
+  }
+
+  std::vector<Image> images;
+  if (synthetic > 0) {
+    for (int i = 0; i < synthetic; ++i) {
+      images.push_back(Synthetic(i));
+    }
+  } else {
+    for (int i = optind; i < argc; ++i) {
+      images.push_back(ReadPPM(argv[i]));
+    }
+  }
+  if (images.empty()) {
+    std::cerr << "error: no input images (files or --synthetic N)"
+              << std::endl;
+    exit(1);
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  if (protocol == "grpc") {
+    FAIL_IF_ERR(
+        tc::InferenceServerGrpcClient::Create(&grpc_client, url, verbose),
+        "creating grpc client");
+  } else {
+    FAIL_IF_ERR(
+        tc::InferenceServerHttpClient::Create(&http_client, url, verbose),
+        "creating http client");
+  }
+
+  // streaming-mode response hand-off (one in-flight request at a time)
+  std::mutex stream_mu;
+  std::condition_variable stream_cv;
+  tc::InferResult* stream_result = nullptr;
+
+  auto infer_batch =
+      [&](const std::vector<const Image*>& chunk) -> tc::InferResult* {
+    std::vector<float> batch;
+    for (const Image* img : chunk) {
+      auto pixels = Preprocess(*img, scaling);
+      batch.insert(batch.end(), pixels.begin(), pixels.end());
+    }
+    tc::InferInput* input;
+    FAIL_IF_ERR(
+        tc::InferInput::Create(
+            &input, "INPUT", {(int64_t)chunk.size(), 224, 224, 3},
+            "FP32"),
+        "creating INPUT");
+    std::shared_ptr<tc::InferInput> input_ptr(input);
+    FAIL_IF_ERR(
+        input_ptr->AppendRaw(
+            (const uint8_t*)batch.data(), batch.size() * sizeof(float)),
+        "setting INPUT data");
+    tc::InferRequestedOutput* output;
+    FAIL_IF_ERR(
+        tc::InferRequestedOutput::Create(&output, "OUTPUT", topk),
+        "creating OUTPUT");
+    std::shared_ptr<tc::InferRequestedOutput> output_ptr(output);
+    tc::InferOptions options(model_name);
+
+    tc::InferResult* result = nullptr;
+    if (streaming) {
+      FAIL_IF_ERR(
+          grpc_client->AsyncStreamInfer(
+              options, {input_ptr.get()}, {output_ptr.get()}),
+          "stream infer");
+      // stream callback set up by caller fills `result` via capture
+      std::unique_lock<std::mutex> lk(stream_mu);
+      stream_cv.wait_for(lk, std::chrono::seconds(300), [&] {
+        return stream_result != nullptr;
+      });
+      result = stream_result;
+      stream_result = nullptr;
+    } else if (async_mode) {
+      std::mutex mu;
+      std::condition_variable cv;
+      tc::InferResult* async_result = nullptr;
+      bool done = false;
+      auto cb = [&](tc::InferResult* r) {
+        std::lock_guard<std::mutex> lk(mu);
+        async_result = r;
+        done = true;
+        cv.notify_all();
+      };
+      if (protocol == "grpc") {
+        FAIL_IF_ERR(
+            grpc_client->AsyncInfer(
+                cb, options, {input_ptr.get()}, {output_ptr.get()}),
+            "async infer");
+      } else {
+        FAIL_IF_ERR(
+            http_client->AsyncInfer(
+                cb, options, {input_ptr.get()}, {output_ptr.get()}),
+            "async infer");
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv.wait_for(lk, std::chrono::seconds(300), [&] { return done; });
+      result = async_result;
+    } else if (protocol == "grpc") {
+      FAIL_IF_ERR(
+          grpc_client->Infer(
+              &result, options, {input_ptr.get()}, {output_ptr.get()}),
+          "infer");
+    } else {
+      FAIL_IF_ERR(
+          http_client->Infer(
+              &result, options, {input_ptr.get()}, {output_ptr.get()}),
+          "infer");
+    }
+    return result;
+  };
+
+  // streaming shares one callback across requests
+  if (streaming) {
+    FAIL_IF_ERR(
+        grpc_client->StartStream([&](tc::InferResult* r) {
+          std::lock_guard<std::mutex> lk(stream_mu);
+          stream_result = r;
+          stream_cv.notify_all();
+        }),
+        "starting stream");
+  }
+
+  for (size_t start = 0; start < images.size();
+       start += (size_t)batch_size) {
+    std::vector<const Image*> chunk;
+    for (size_t i = start;
+         i < images.size() && i < start + (size_t)batch_size; ++i) {
+      chunk.push_back(&images[i]);
+    }
+    tc::InferResult* result = infer_batch(chunk);
+    if (result == nullptr) {
+      std::cerr << "error: no result" << std::endl;
+      exit(1);
+    }
+    std::unique_ptr<tc::InferResult> result_ptr(result);
+    FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+    for (size_t i = 0; i < chunk.size(); ++i) {
+      PrintClasses(chunk[i]->name, result_ptr.get(), "OUTPUT", i, topk);
+    }
+  }
+  if (streaming) {
+    grpc_client->StopStream();
+  }
+  std::cout << "image client OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,142 @@
+// Reuse InferInput / InferRequestedOutput / client objects across many
+// requests on both protocols — the allocation-free steady-state pattern
+// (role of reference src/c++/examples/reuse_infer_objects_client.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+void
+Validate(
+    tc::InferResult* result, const std::vector<int32_t>& in0,
+    const std::vector<int32_t>& in1)
+{
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+  const uint8_t* buf;
+  size_t len;
+  FAIL_IF_ERR(result_ptr->RawData("OUTPUT0", &buf, &len), "OUTPUT0");
+  const int32_t* sums = (const int32_t*)buf;
+  for (size_t i = 0; i < in0.size(); ++i) {
+    if (sums[i] != in0[i] + in1[i]) {
+      std::cerr << "error: incorrect sum at " << i << std::endl;
+      exit(1);
+    }
+  }
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string http_url("localhost:8000");
+  std::string grpc_url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:g:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        http_url = optarg;
+        break;
+      case 'g':
+        grpc_url = optarg;
+        break;
+      default:
+        std::cerr << "usage: " << argv[0]
+                  << " [-v] [-u http_url] [-g grpc_url]" << std::endl;
+        exit(1);
+    }
+  }
+
+  std::vector<int32_t> input0_data(16), input1_data(16, 1);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+  }
+
+  // objects created once, reused for every request below
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  tc::InferRequestedOutput* output0;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0);
+  tc::InferOptions options("simple");
+
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&http_client, http_url,
+                                            verbose),
+      "creating http client");
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&grpc_client, grpc_url,
+                                            verbose),
+      "creating grpc client");
+
+  for (int iteration = 0; iteration < 4; ++iteration) {
+    for (auto& v : input0_data) {
+      v += iteration;
+    }
+    // Reset + refill the same input objects
+    FAIL_IF_ERR(input0_ptr->Reset(), "resetting INPUT0");
+    FAIL_IF_ERR(input1_ptr->Reset(), "resetting INPUT1");
+    FAIL_IF_ERR(
+        input0_ptr->AppendRaw(
+            (const uint8_t*)input0_data.data(),
+            input0_data.size() * sizeof(int32_t)),
+        "INPUT0 data");
+    FAIL_IF_ERR(
+        input1_ptr->AppendRaw(
+            (const uint8_t*)input1_data.data(),
+            input1_data.size() * sizeof(int32_t)),
+        "INPUT1 data");
+
+    tc::InferResult* result = nullptr;
+    FAIL_IF_ERR(
+        http_client->Infer(
+            &result, options, {input0_ptr.get(), input1_ptr.get()},
+            {output0_ptr.get()}),
+        "http infer");
+    Validate(result, input0_data, input1_data);
+
+    result = nullptr;
+    FAIL_IF_ERR(
+        grpc_client->Infer(
+            &result, options, {input0_ptr.get(), input1_ptr.get()},
+            {output0_ptr.get()}),
+        "grpc infer");
+    Validate(result, input0_data, input1_data);
+  }
+
+  std::cout << "reuse infer objects OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,98 @@
+// Infer with custom request id, priority and per-request options (role
+// of reference simple_grpc_custom_args_client.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  std::vector<int32_t> input0_data(16), input1_data(16, 4);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+  }
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  input0_ptr->AppendRaw(
+      (const uint8_t*)input0_data.data(),
+      input0_data.size() * sizeof(int32_t));
+  input1_ptr->AppendRaw(
+      (const uint8_t*)input1_data.data(),
+      input1_data.size() * sizeof(int32_t));
+
+  tc::InferOptions options("simple");
+  options.request_id_ = "custom-args-1";
+  options.priority_ = 42;
+  options.server_timeout_us_ = 10 * 1000 * 1000;
+
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, {input0_ptr.get(), input1_ptr.get()}),
+      "infer");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  std::string id;
+  FAIL_IF_ERR(result_ptr->Id(&id), "response id");
+  if (id != "custom-args-1") {
+    std::cerr << "error: request id not echoed (got '" << id << "')"
+              << std::endl;
+    exit(1);
+  }
+  const uint8_t* buf;
+  size_t len;
+  FAIL_IF_ERR(result_ptr->RawData("OUTPUT0", &buf, &len), "OUTPUT0 data");
+  const int32_t* sums = (const int32_t*)buf;
+  for (int i = 0; i < 16; ++i) {
+    if (sums[i] != input0_data[i] + input1_data[i]) {
+      std::cerr << "error: incorrect sum at " << i << std::endl;
+      exit(1);
+    }
+  }
+  std::cout << "custom args OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,98 @@
+// Health, metadata, config, repository-index and statistics queries over
+// gRPC (role of reference simple_grpc_health_metadata.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server liveness");
+  bool ready = false;
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server readiness");
+  bool model_ready = false;
+  FAIL_IF_ERR(
+      client->IsModelReady(&model_ready, "simple"), "model readiness");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "error: server/model not ready" << std::endl;
+    exit(1);
+  }
+
+  inference::ServerMetadataResponse server_metadata;
+  FAIL_IF_ERR(client->ServerMetadata(&server_metadata), "server metadata");
+  std::cout << "server: " << server_metadata.name() << " "
+            << server_metadata.version() << std::endl;
+
+  inference::ModelMetadataResponse model_metadata;
+  FAIL_IF_ERR(
+      client->ModelMetadata(&model_metadata, "simple"), "model metadata");
+  if (model_metadata.name() != "simple" ||
+      model_metadata.inputs_size() != 2) {
+    std::cerr << "error: unexpected model metadata" << std::endl;
+    exit(1);
+  }
+
+  inference::ModelConfigResponse model_config;
+  FAIL_IF_ERR(
+      client->ModelConfig(&model_config, "simple"), "model config");
+  if (model_config.config().name() != "simple") {
+    std::cerr << "error: unexpected model config" << std::endl;
+    exit(1);
+  }
+
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  bool found = false;
+  for (const auto& m : index.models()) {
+    if (m.name() == "simple") {
+      found = true;
+    }
+  }
+  if (!found) {
+    std::cerr << "error: 'simple' not in repository index" << std::endl;
+    exit(1);
+  }
+
+  inference::ModelStatisticsResponse stats;
+  FAIL_IF_ERR(
+      client->ModelInferenceStatistics(&stats, "simple"), "statistics");
+
+  std::cout << "health metadata OK" << std::endl;
+  return 0;
+}

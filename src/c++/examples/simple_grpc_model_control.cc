@@ -1,0 +1,96 @@
+// Explicit model load/unload over gRPC (role of reference
+// simple_grpc_model_control.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  FAIL_IF_ERR(client->UnloadModel("simple"), "unloading model");
+  bool ready = true;
+  FAIL_IF_ERR(client->IsModelReady(&ready, "simple"), "model readiness");
+  if (ready) {
+    std::cerr << "error: model still ready after unload" << std::endl;
+    exit(1);
+  }
+
+  // infer must fail while unloaded
+  std::vector<int32_t> data(16, 1);
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  input0_ptr->AppendRaw(
+      (const uint8_t*)data.data(), data.size() * sizeof(int32_t));
+  input1_ptr->AppendRaw(
+      (const uint8_t*)data.data(), data.size() * sizeof(int32_t));
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  tc::Error err = client->Infer(
+      &result, options, {input0_ptr.get(), input1_ptr.get()});
+  if (err.IsOk() && result != nullptr &&
+      result->RequestStatus().IsOk()) {
+    std::cerr << "error: infer succeeded on unloaded model" << std::endl;
+    exit(1);
+  }
+  delete result;
+
+  FAIL_IF_ERR(client->LoadModel("simple"), "loading model");
+  FAIL_IF_ERR(client->IsModelReady(&ready, "simple"), "model readiness");
+  if (!ready) {
+    std::cerr << "error: model not ready after load" << std::endl;
+    exit(1);
+  }
+  result = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, {input0_ptr.get(), input1_ptr.get()}),
+      "infer after load");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  std::cout << "model control OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,128 @@
+// Stateful sequences over the gRPC bidi stream: two interleaved
+// sequences on one stream against `sequence_accumulate` (role of
+// reference simple_grpc_sequence_stream_infer_client.cc).
+
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  const std::vector<int32_t> values{11, 7, 5, 3, 2, 0, 1};
+  const uint64_t seq0 = 5007, seq1 = 5008;
+  const size_t expected_total = values.size() * 2;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  size_t received = 0;
+  std::map<std::string, int32_t> results;
+  FAIL_IF_ERR(
+      client->StartStream([&](tc::InferResult* result) {
+        std::unique_ptr<tc::InferResult> result_ptr(result);
+        if (result_ptr->RequestStatus().IsOk()) {
+          std::string id;
+          result_ptr->Id(&id);
+          const uint8_t* buf;
+          size_t len;
+          result_ptr->RawData("OUTPUT", &buf, &len);
+          std::lock_guard<std::mutex> lk(mu);
+          results[id] = *(const int32_t*)buf;
+        }
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          ++received;
+        }
+        cv.notify_all();
+      }),
+      "starting stream");
+
+  for (size_t i = 0; i < values.size(); ++i) {
+    for (auto& seq : std::vector<std::pair<uint64_t, int32_t>>{
+             {seq0, values[i]}, {seq1, -values[i]}}) {
+      tc::InferInput* input;
+      FAIL_IF_ERR(
+          tc::InferInput::Create(&input, "INPUT", {1}, "INT32"),
+          "creating INPUT");
+      std::shared_ptr<tc::InferInput> input_ptr(input);
+      FAIL_IF_ERR(
+          input_ptr->AppendRaw(
+              (const uint8_t*)&seq.second, sizeof(int32_t)),
+          "appending INPUT");
+      tc::InferOptions options("sequence_accumulate");
+      options.sequence_id_ = seq.first;
+      options.sequence_start_ = (i == 0);
+      options.sequence_end_ = (i == values.size() - 1);
+      options.request_id_ =
+          std::to_string(seq.first) + "_" + std::to_string(i);
+      FAIL_IF_ERR(
+          client->AsyncStreamInfer(options, {input_ptr.get()}),
+          "stream infer");
+    }
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(30), [&] {
+          return received >= expected_total;
+        })) {
+      std::cerr << "error: timed out waiting for stream responses"
+                << std::endl;
+      exit(1);
+    }
+  }
+  FAIL_IF_ERR(client->StopStream(), "stopping stream");
+
+  int32_t total = 0;
+  for (auto v : values) {
+    total += v;
+  }
+  const std::string last = "_" + std::to_string(values.size() - 1);
+  if (results[std::to_string(seq0) + last] != total ||
+      results[std::to_string(seq1) + last] != -total) {
+    std::cerr << "error: wrong accumulated values" << std::endl;
+    exit(1);
+  }
+  std::cout << "sequence " << seq0 << ": " << total << std::endl;
+  std::cout << "sequence " << seq1 << ": " << -total << std::endl;
+  std::cout << "sequence stream OK" << std::endl;
+  return 0;
+}

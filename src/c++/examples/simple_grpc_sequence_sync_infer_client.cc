@@ -1,0 +1,100 @@
+// Stateful sequences with synchronous infer over gRPC: two interleaved
+// sequences against `sequence_accumulate` (role of reference
+// simple_grpc_sequence_sync_infer_client.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+int32_t
+Send(
+    tc::InferenceServerGrpcClient* client, uint64_t sequence_id,
+    int32_t value, bool start, bool end)
+{
+  tc::InferInput* input;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input, "INPUT", {1}, "INT32"),
+      "creating INPUT");
+  std::shared_ptr<tc::InferInput> input_ptr(input);
+  FAIL_IF_ERR(
+      input_ptr->AppendRaw((const uint8_t*)&value, sizeof(value)),
+      "appending INPUT");
+  tc::InferOptions options("sequence_accumulate");
+  options.sequence_id_ = sequence_id;
+  options.sequence_start_ = start;
+  options.sequence_end_ = end;
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(&result, options, {input_ptr.get()}), "infer");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+  const uint8_t* buf;
+  size_t len;
+  FAIL_IF_ERR(result_ptr->RawData("OUTPUT", &buf, &len), "OUTPUT data");
+  return *(const int32_t*)buf;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  const std::vector<int32_t> values{11, 7, 5, 3, 2, 0, 1};
+  const uint64_t seq0 = 6007, seq1 = 6008;
+  int32_t acc0 = 0, acc1 = 0;
+  for (size_t i = 0; i < values.size(); ++i) {
+    bool start = (i == 0);
+    bool end = (i == values.size() - 1);
+    acc0 = Send(client.get(), seq0, values[i], start, end);
+    acc1 = Send(client.get(), seq1, -values[i], start, end);
+  }
+  int32_t total = 0;
+  for (auto v : values) {
+    total += v;
+  }
+  std::cout << "sequence " << seq0 << ": " << acc0 << std::endl;
+  std::cout << "sequence " << seq1 << ": " << acc1 << std::endl;
+  if (acc0 != total || acc1 != -total) {
+    std::cerr << "error: wrong accumulated values" << std::endl;
+    exit(1);
+  }
+  std::cout << "sequence sync OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,158 @@
+// System shared-memory infer on the `simple` model over gRPC (role of
+// reference src/c++/examples/simple_grpc_shm_client.cc).
+
+#include <unistd.h>
+
+#include <cstring>
+#include <iostream>
+
+#include "grpc_client.h"
+#include "shm_utils.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url, verbose),
+      "unable to create grpc client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  const char* kInputKey = "/simple_grpc_shm_input";
+  const char* kOutputKey = "/simple_grpc_shm_output";
+  client->UnregisterSystemSharedMemory("simple_input");
+  client->UnregisterSystemSharedMemory("simple_output");
+
+  int input_fd, output_fd;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(kInputKey, 2 * kTensorBytes, &input_fd),
+      "creating input region");
+  void* input_base;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(input_fd, 0, 2 * kTensorBytes, &input_base),
+      "mapping input region");
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(kOutputKey, 2 * kTensorBytes, &output_fd),
+      "creating output region");
+  void* output_base;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(output_fd, 0, 2 * kTensorBytes, &output_base),
+      "mapping output region");
+
+  int32_t* input_data = (int32_t*)input_base;
+  for (int i = 0; i < 16; ++i) {
+    input_data[i] = i;
+    input_data[16 + i] = 1;
+  }
+
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "simple_input", kInputKey, 2 * kTensorBytes),
+      "registering input region");
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "simple_output", kOutputKey, 2 * kTensorBytes),
+      "registering output region");
+
+  inference::SystemSharedMemoryStatusResponse status;
+  FAIL_IF_ERR(client->SystemSharedMemoryStatus(&status), "shm status");
+  if (status.regions_size() < 2) {
+    std::cerr << "error: expected 2 registered regions" << std::endl;
+    exit(1);
+  }
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  FAIL_IF_ERR(
+      input0_ptr->SetSharedMemory("simple_input", kTensorBytes, 0),
+      "INPUT0 shm");
+  FAIL_IF_ERR(
+      input1_ptr->SetSharedMemory(
+          "simple_input", kTensorBytes, kTensorBytes),
+      "INPUT1 shm");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0),
+      output1_ptr(output1);
+  FAIL_IF_ERR(
+      output0_ptr->SetSharedMemory("simple_output", kTensorBytes, 0),
+      "OUTPUT0 shm");
+  FAIL_IF_ERR(
+      output1_ptr->SetSharedMemory(
+          "simple_output", kTensorBytes, kTensorBytes),
+      "OUTPUT1 shm");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, {input0_ptr.get(), input1_ptr.get()},
+          {output0_ptr.get(), output1_ptr.get()}),
+      "infer");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  int32_t* output_data = (int32_t*)output_base;
+  for (int i = 0; i < 16; ++i) {
+    if (output_data[i] != input_data[i] + input_data[16 + i]) {
+      std::cerr << "error: incorrect sum at " << i << std::endl;
+      exit(1);
+    }
+    if (output_data[16 + i] != input_data[i] - input_data[16 + i]) {
+      std::cerr << "error: incorrect difference at " << i << std::endl;
+      exit(1);
+    }
+  }
+
+  client->UnregisterSystemSharedMemory("simple_input");
+  client->UnregisterSystemSharedMemory("simple_output");
+  tc::UnmapSharedMemory(input_base, 2 * kTensorBytes);
+  tc::UnmapSharedMemory(output_base, 2 * kTensorBytes);
+  tc::CloseSharedMemory(input_fd);
+  tc::CloseSharedMemory(output_fd);
+  tc::UnlinkSharedMemoryRegion(kInputKey);
+  tc::UnlinkSharedMemoryRegion(kOutputKey);
+
+  std::cout << "shm infer OK" << std::endl;
+  return 0;
+}

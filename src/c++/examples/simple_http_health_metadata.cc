@@ -1,0 +1,85 @@
+// Health, metadata, config and repository-index queries over HTTP (role
+// of reference simple_http_health_metadata.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8000");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose),
+      "unable to create http client");
+
+  bool live = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server liveness");
+  bool ready = false;
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server readiness");
+  bool model_ready = false;
+  FAIL_IF_ERR(
+      client->IsModelReady(&model_ready, "simple"), "model readiness");
+  if (!live || !ready || !model_ready) {
+    std::cerr << "error: server/model not ready" << std::endl;
+    exit(1);
+  }
+
+  std::string server_metadata;
+  FAIL_IF_ERR(client->ServerMetadata(&server_metadata), "server metadata");
+  if (server_metadata.find("\"name\"") == std::string::npos) {
+    std::cerr << "error: unexpected server metadata" << std::endl;
+    exit(1);
+  }
+
+  std::string model_metadata;
+  FAIL_IF_ERR(
+      client->ModelMetadata(&model_metadata, "simple"), "model metadata");
+  if (model_metadata.find("\"simple\"") == std::string::npos) {
+    std::cerr << "error: unexpected model metadata" << std::endl;
+    exit(1);
+  }
+
+  std::string model_config;
+  FAIL_IF_ERR(
+      client->ModelConfig(&model_config, "simple"), "model config");
+
+  std::string index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  if (index.find("\"simple\"") == std::string::npos) {
+    std::cerr << "error: 'simple' not in repository index" << std::endl;
+    exit(1);
+  }
+
+  std::cout << "health metadata OK" << std::endl;
+  return 0;
+}

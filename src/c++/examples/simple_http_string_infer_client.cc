@@ -1,0 +1,101 @@
+// BYTES-tensor infer on `simple_string` over HTTP (role of reference
+// simple_http_string_infer_client.cc).
+
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8000");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose),
+      "unable to create http client");
+
+  std::vector<std::string> input0_data, input1_data;
+  for (int i = 0; i < 16; ++i) {
+    input0_data.push_back(std::to_string(i));
+    input1_data.push_back("1");
+  }
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "BYTES"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "BYTES"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  FAIL_IF_ERR(input0_ptr->AppendFromString(input0_data), "INPUT0 data");
+  FAIL_IF_ERR(input1_ptr->AppendFromString(input1_data), "INPUT1 data");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0),
+      output1_ptr(output1);
+
+  tc::InferOptions options("simple_string");
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, {input0_ptr.get(), input1_ptr.get()},
+          {output0_ptr.get(), output1_ptr.get()}),
+      "infer");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  std::vector<std::string> sums, diffs;
+  FAIL_IF_ERR(result_ptr->StringData("OUTPUT0", &sums), "OUTPUT0 data");
+  FAIL_IF_ERR(result_ptr->StringData("OUTPUT1", &diffs), "OUTPUT1 data");
+  for (int i = 0; i < 16; ++i) {
+    if (std::stoi(sums[i]) != i + 1) {
+      std::cerr << "error: incorrect sum at " << i << std::endl;
+      exit(1);
+    }
+    if (std::stoi(diffs[i]) != i - 1) {
+      std::cerr << "error: incorrect difference at " << i << std::endl;
+      exit(1);
+    }
+  }
+  std::cout << "string infer OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Drive the `image_ensemble` model (preprocess -> ResNet-50 ensemble
+scheduling): raw uint8 pixels in, top-k classes out (role of reference
+src/python/examples/ensemble_image_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-i", "--protocol", default="HTTP",
+                        choices=["HTTP", "GRPC", "http", "grpc"])
+    parser.add_argument("-c", "--classes", type=int, default=3)
+    args = parser.parse_args()
+
+    protocol = args.protocol.lower()
+    if protocol == "grpc":
+        import tritonclient.grpc as tclient
+    else:
+        import tritonclient.http as tclient
+
+    client = tclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose)
+
+    rng = np.random.RandomState(3)
+    raw = rng.randint(0, 255, (1, 224, 224, 3)).astype(np.uint8)
+    inp = tclient.InferInput("RAW_IMAGE", [1, 224, 224, 3], "UINT8")
+    inp.set_data_from_numpy(raw)
+    if protocol == "grpc":
+        outputs = [tclient.InferRequestedOutput(
+            "OUTPUT", class_count=args.classes)]
+    else:
+        outputs = [tclient.InferRequestedOutput(
+            "OUTPUT", binary_data=True, class_count=args.classes)]
+
+    result = client.infer("image_ensemble", [inp], outputs=outputs)
+    classes = result.as_numpy("OUTPUT").reshape(-1)
+    if len(classes) != args.classes:
+        print("FAILED: expected {} classes, got {}".format(
+            args.classes, len(classes)))
+        sys.exit(1)
+    for entry in classes:
+        value, index, label = entry.decode("utf-8").split(":")
+        print("    {} ({}) = {}".format(index, label, value))
+    client.close()
+    print("PASS: ensemble image client")
+
+
+if __name__ == "__main__":
+    main()

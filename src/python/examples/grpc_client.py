@@ -1,0 +1,62 @@
+#!/usr/bin/env python3
+"""Minimal infer using the raw generated gRPC stubs and nothing else —
+the "bring your own language" recipe (role of reference
+src/python/examples/grpc_client.py)."""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+
+
+def call(channel, name, request):
+    req_cls, resp_cls, _ = METHODS[name]
+    return channel.unary_unary(
+        "/{}/{}".format(SERVICE, name),
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )(request)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+
+    metadata = call(
+        channel, "ServerMetadata", pb.ServerMetadataRequest())
+    print("server: {} {}".format(metadata.name, metadata.version))
+
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.full((1, 16), 1, dtype=np.int32)
+    request = pb.ModelInferRequest(model_name="simple")
+    for name, arr in (("INPUT0", input0), ("INPUT1", input1)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend(arr.shape)
+        request.raw_input_contents.append(arr.tobytes())
+
+    response = call(channel, "ModelInfer", request)
+    output0 = np.frombuffer(
+        response.raw_output_contents[0], dtype=np.int32).reshape(1, 16)
+    output1 = np.frombuffer(
+        response.raw_output_contents[1], dtype=np.int32).reshape(1, 16)
+    if not np.array_equal(output0, input0 + input1):
+        print("FAILED: incorrect sum")
+        sys.exit(1)
+    if not np.array_equal(output1, input0 - input1):
+        print("FAILED: incorrect difference")
+        sys.exit(1)
+    channel.close()
+    print("PASS: raw grpc client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""Infer passing BYTES input via the typed ``contents.bytes_contents``
+field (role of reference grpc_explicit_byte_content_client.py)."""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    req_cls, resp_cls, _ = METHODS["ModelInfer"]
+    infer = channel.unary_unary(
+        "/{}/ModelInfer".format(SERVICE),
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.full(16, 1, dtype=np.int32)
+    request = pb.ModelInferRequest(model_name="simple_string")
+    for name, arr in (("INPUT0", in0), ("INPUT1", in1)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "BYTES"
+        tensor.shape.extend([1, 16])
+        tensor.contents.bytes_contents.extend(
+            str(x).encode("utf-8") for x in arr
+        )
+
+    response = infer(request)
+    # outputs come back length-prefix serialized in raw_output_contents
+    import struct
+
+    raw = response.raw_output_contents[0]
+    values = []
+    pos = 0
+    while pos < len(raw):
+        (length,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        values.append(int(raw[pos : pos + length]))
+        pos += length
+    if values != [int(a + b) for a, b in zip(in0, in1)]:
+        print("FAILED: incorrect sums")
+        sys.exit(1)
+    channel.close()
+    print("PASS: explicit byte contents")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Infer passing INT8-shaped data via the typed contents field; INT8
+rides ``int_contents`` per the KServe v2 proto (role of reference
+grpc_explicit_int8_content_client.py).  Uses the identity model since
+the fixture `simple` is INT32."""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    req_cls, resp_cls, _ = METHODS["ModelInfer"]
+    infer = channel.unary_unary(
+        "/{}/ModelInfer".format(SERVICE),
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+    # identity_fp32 echoes FP32; demonstrate typed fp32_contents alongside
+    # the int path on simple (typed int_contents carries INT8..INT32).
+    data = np.arange(-8, 8, dtype=np.int8)
+    as_int32 = data.astype(np.int32).reshape(1, 16)
+    request = pb.ModelInferRequest(model_name="simple")
+    for name in ("INPUT0", "INPUT1"):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend([1, 16])
+        tensor.contents.int_contents.extend(int(x) for x in as_int32.flat)
+
+    response = infer(request)
+    output0 = np.frombuffer(
+        response.raw_output_contents[0], dtype=np.int32).reshape(1, 16)
+    if not np.array_equal(output0, as_int32 + as_int32):
+        print("FAILED: incorrect sum")
+        sys.exit(1)
+    channel.close()
+    print("PASS: explicit int8-range contents")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+#!/usr/bin/env python3
+"""Infer passing INT32 input via the typed ``contents.int_contents``
+field instead of raw bytes (role of reference
+grpc_explicit_int_content_client.py)."""
+
+import argparse
+import sys
+
+import grpc
+import numpy as np
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+    req_cls, resp_cls, _ = METHODS["ModelInfer"]
+    infer = channel.unary_unary(
+        "/{}/ModelInfer".format(SERVICE),
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+
+    input0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1 = np.full((1, 16), 1, dtype=np.int32)
+    request = pb.ModelInferRequest(model_name="simple")
+    for name, arr in (("INPUT0", input0), ("INPUT1", input1)):
+        tensor = request.inputs.add()
+        tensor.name = name
+        tensor.datatype = "INT32"
+        tensor.shape.extend(arr.shape)
+        tensor.contents.int_contents.extend(int(x) for x in arr.flat)
+
+    response = infer(request)
+    output0 = np.frombuffer(
+        response.raw_output_contents[0], dtype=np.int32).reshape(1, 16)
+    if not np.array_equal(output0, input0 + input1):
+        print("FAILED: incorrect sum")
+        sys.exit(1)
+    channel.close()
+    print("PASS: explicit int contents")
+
+
+if __name__ == "__main__":
+    main()

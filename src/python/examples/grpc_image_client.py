@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Image classification over gRPC using the raw generated service stubs
+(no tritonclient wrapper) — shows direct protobuf assembly (role of
+reference src/python/examples/grpc_image_client.py)."""
+
+import argparse
+import struct
+import sys
+
+import grpc
+import numpy as np
+
+from tritonclient.grpc import grpc_service_pb2 as pb
+from tritonclient.grpc._service import METHODS, SERVICE
+
+
+def _stub_call(channel, name, request, timeout=None):
+    req_cls, resp_cls, kind = METHODS[name]
+    method = channel.unary_unary(
+        "/{}/{}".format(SERVICE, name),
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+    return method(request, timeout=timeout)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-c", "--classes", type=int, default=1)
+    parser.add_argument("--synthetic", type=int, default=1)
+    args = parser.parse_args()
+
+    channel = grpc.insecure_channel(args.url)
+
+    live = _stub_call(channel, "ServerLive", pb.ServerLiveRequest())
+    if not live.live:
+        print("FAILED: server not live")
+        sys.exit(1)
+
+    metadata = _stub_call(
+        channel, "ModelMetadata",
+        pb.ModelMetadataRequest(name=args.model_name),
+    )
+    input_name = metadata.inputs[0].name
+    output_name = metadata.outputs[0].name
+
+    rng = np.random.RandomState(7)
+    img = rng.rand(1, 224, 224, 3).astype(np.float32)
+
+    request = pb.ModelInferRequest(model_name=args.model_name)
+    tensor = request.inputs.add()
+    tensor.name = input_name
+    tensor.datatype = "FP32"
+    tensor.shape.extend(img.shape)
+    request.raw_input_contents.append(img.tobytes())
+    out = request.outputs.add()
+    out.name = output_name
+    out.parameters["classification"].int64_param = args.classes
+
+    response = _stub_call(channel, "ModelInfer", request, timeout=300)
+    raw = response.raw_output_contents[0]
+    # BYTES classification tensor: 4-byte little-endian length prefix per
+    # element ("value:index:label")
+    entries = []
+    pos = 0
+    while pos < len(raw):
+        (length,) = struct.unpack_from("<I", raw, pos)
+        pos += 4
+        entries.append(raw[pos : pos + length].decode("utf-8"))
+        pos += length
+    if len(entries) != args.classes:
+        print("FAILED: expected {} classes, got {}".format(
+            args.classes, len(entries)))
+        sys.exit(1)
+    for entry in entries:
+        print("    " + entry)
+    channel.close()
+    print("PASS: raw-stub image client")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,265 @@
+#!/usr/bin/env python3
+"""Image classification client: preprocessing (NONE/VGG/INCEPTION
+scaling), batching, sync/async/streaming issue, classification
+postprocessing — over HTTP or gRPC (role of reference
+src/python/examples/image_client.py and the C++ image_client.cc:64-120).
+
+Inputs may be .npy arrays, binary PPM (P6) images, or --synthetic random
+images; images of other sizes are resampled (nearest neighbor) to the
+model's 224x224 input.
+"""
+
+import argparse
+import os
+import queue
+import sys
+
+import numpy as np
+
+
+def read_ppm(path):
+    """Minimal binary-PPM (P6) reader -> uint8 HWC array."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(b"P6"):
+        raise ValueError("not a binary PPM (P6) file: " + path)
+    fields = []
+    pos = 2
+    while len(fields) < 3:
+        while pos < len(data) and data[pos : pos + 1].isspace():
+            pos += 1
+        if pos >= len(data):
+            raise ValueError("truncated PPM header: " + path)
+        if data[pos : pos + 1] == b"#":  # comment line
+            newline = data.find(b"\n", pos)
+            if newline < 0:
+                raise ValueError("truncated PPM header: " + path)
+            pos = newline + 1
+            continue
+        end = pos
+        while end < len(data) and not data[end : end + 1].isspace():
+            end += 1
+        if end >= len(data):
+            raise ValueError("truncated PPM header: " + path)
+        fields.append(int(data[pos:end]))
+        pos = end
+    pos += 1  # single whitespace after maxval
+    width, height, maxval = fields
+    if maxval != 255:
+        raise ValueError("only maxval=255 PPM supported")
+    pixels = np.frombuffer(
+        data, dtype=np.uint8, count=width * height * 3, offset=pos
+    )
+    return pixels.reshape(height, width, 3)
+
+
+def load_image(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    return read_ppm(path)
+
+
+def resize_nearest(img, height, width):
+    """Nearest-neighbor resample to (height, width, C)."""
+    h, w = img.shape[:2]
+    rows = (np.arange(height) * (h / height)).astype(np.int64)
+    cols = (np.arange(width) * (w / width)).astype(np.int64)
+    return img[rows][:, cols]
+
+
+def preprocess(img, scaling, dtype=np.float32):
+    """Scale pixel values per the requested scheme (reference
+    image_client.cc:64-120: NONE, VGG mean-subtraction, INCEPTION
+    [-1, 1])."""
+    if img.ndim == 2:
+        img = np.stack([img] * 3, axis=-1)
+    img = resize_nearest(img, 224, 224).astype(np.float32)
+    if scaling == "INCEPTION":
+        scaled = (img / 127.5) - 1.0
+    elif scaling == "VGG":
+        scaled = img - np.array([123.68, 116.78, 103.94], np.float32)
+    else:
+        scaled = img
+    return scaled.astype(dtype)
+
+
+def parse_classes(class_bytes):
+    """'value:index[:label]' entries -> (value, index, label) tuples."""
+    out = []
+    for entry in np.asarray(class_bytes).reshape(-1):
+        parts = entry.decode("utf-8").split(":")
+        out.append(
+            (float(parts[0]), int(parts[1]),
+             parts[2] if len(parts) > 2 else "")
+        )
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-m", "--model-name", default="resnet50")
+    parser.add_argument("-x", "--model-version", default="")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("-c", "--classes", type=int, default=1,
+                        help="number of class results to report")
+    parser.add_argument("-s", "--scaling", default="NONE",
+                        choices=["NONE", "VGG", "INCEPTION"])
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-i", "--protocol", default="HTTP",
+                        choices=["HTTP", "GRPC", "http", "grpc"])
+    parser.add_argument("-a", "--async", dest="async_set",
+                        action="store_true",
+                        help="issue requests asynchronously")
+    parser.add_argument("--streaming", action="store_true",
+                        help="issue via the gRPC bidi stream")
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="use N synthetic images instead of files")
+    parser.add_argument("image_filename", nargs="*",
+                        help=".npy or binary .ppm image files")
+    args = parser.parse_args()
+
+    protocol = args.protocol.lower()
+    if args.streaming and protocol != "grpc":
+        print("error: streaming requires the gRPC protocol")
+        sys.exit(1)
+
+    if protocol == "grpc":
+        import tritonclient.grpc as tclient
+    else:
+        import tritonclient.http as tclient
+    client = tclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose)
+
+    # model metadata drives input naming/validation
+    metadata = client.get_model_metadata(
+        args.model_name, args.model_version)
+    if protocol == "grpc":
+        input_meta = metadata.inputs[0]
+        input_name, input_dtype = input_meta.name, input_meta.datatype
+        output_name = metadata.outputs[0].name
+    else:
+        input_meta = metadata["inputs"][0]
+        input_name, input_dtype = input_meta["name"], input_meta["datatype"]
+        output_name = metadata["outputs"][0]["name"]
+
+    np_dtype = {"FP32": np.float32, "UINT8": np.uint8}.get(
+        input_dtype, np.float32)
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        raw_images = [
+            (rng.rand(224, 224, 3) * 255).astype(np.uint8)
+            for _ in range(args.synthetic)
+        ]
+        names = ["synthetic_{}".format(i) for i in range(args.synthetic)]
+    else:
+        if not args.image_filename:
+            print("error: no input images (pass files or --synthetic N)")
+            sys.exit(1)
+        raw_images = [load_image(p) for p in args.image_filename]
+        names = [os.path.basename(p) for p in args.image_filename]
+
+    batches = []
+    for start in range(0, len(raw_images), args.batch_size):
+        chunk = raw_images[start : start + args.batch_size]
+        batch = np.stack(
+            [preprocess(img, args.scaling, np_dtype) for img in chunk]
+        )
+        batches.append((batch, names[start : start + args.batch_size]))
+
+    outputs_of = lambda: [
+        tclient.InferRequestedOutput(output_name)
+        if args.classes == 0
+        else _requested_output(tclient, output_name, args.classes,
+                               protocol)
+    ]
+
+    responses = []
+    if args.streaming:
+        completed = queue.Queue()
+        client.start_stream(
+            callback=lambda result, error: completed.put((result, error)))
+        try:
+            for batch, batch_names in batches:
+                inp = tclient.InferInput(
+                    input_name, list(batch.shape), input_dtype)
+                inp.set_data_from_numpy(batch)
+                client.async_stream_infer(
+                    args.model_name, [inp], outputs=outputs_of())
+            for _, batch_names in batches:
+                result, error = completed.get(timeout=120)
+                if error is not None:
+                    print("streaming infer failed: " + str(error))
+                    sys.exit(1)
+                responses.append((result, batch_names))
+        finally:
+            client.stop_stream()
+    elif args.async_set:
+        if protocol == "grpc":
+            completed = queue.Queue()
+            for batch, batch_names in batches:
+                inp = tclient.InferInput(
+                    input_name, list(batch.shape), input_dtype)
+                inp.set_data_from_numpy(batch)
+                client.async_infer(
+                    args.model_name, [inp],
+                    callback=(
+                        lambda ns: lambda result, error: completed.put(
+                            (result, error, ns))
+                    )(batch_names),
+                    outputs=outputs_of(),
+                )
+            for _ in batches:
+                result, error, batch_names = completed.get(timeout=120)
+                if error is not None:
+                    print("async infer failed: " + str(error))
+                    sys.exit(1)
+                responses.append((result, batch_names))
+        else:
+            futures = []
+            for batch, batch_names in batches:
+                inp = tclient.InferInput(
+                    input_name, list(batch.shape), input_dtype)
+                inp.set_data_from_numpy(batch)
+                futures.append(
+                    (client.async_infer(args.model_name, [inp],
+                                        outputs=outputs_of()),
+                     batch_names))
+            for fut, batch_names in futures:
+                responses.append((fut.get_result(), batch_names))
+    else:
+        for batch, batch_names in batches:
+            inp = tclient.InferInput(
+                input_name, list(batch.shape), input_dtype)
+            inp.set_data_from_numpy(batch)
+            responses.append(
+                (client.infer(args.model_name, [inp],
+                              model_version=args.model_version,
+                              outputs=outputs_of()),
+                 batch_names))
+
+    for result, batch_names in responses:
+        output = result.as_numpy(output_name)
+        if args.classes > 0:
+            per_image = output.reshape(len(batch_names), -1)
+            for name, row in zip(batch_names, per_image):
+                print("Image '{}':".format(name))
+                for value, index, label in parse_classes(row):
+                    print("    {} ({}) = {}".format(index, label, value))
+        else:
+            print("Image batch {}: output shape {}".format(
+                batch_names, output.shape))
+    client.close()
+    print("PASS: image client")
+
+
+def _requested_output(tclient, name, classes, protocol):
+    if protocol == "grpc":
+        return tclient.InferRequestedOutput(name, class_count=classes)
+    return tclient.InferRequestedOutput(
+        name, binary_data=True, class_count=classes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Client memory-growth check: loop inference and verify the process RSS
+stays bounded (role of reference src/python/examples/memory_growth_test.py
+/ C++ memory_leak_test.cc)."""
+
+import argparse
+import resource
+import sys
+
+import numpy as np
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    parser.add_argument("-i", "--protocol", default="HTTP",
+                        choices=["HTTP", "GRPC", "http", "grpc"])
+    parser.add_argument("-n", "--iterations", type=int, default=500)
+    parser.add_argument("--max-growth-mb", type=float, default=32.0)
+    args = parser.parse_args()
+
+    protocol = args.protocol.lower()
+    if protocol == "grpc":
+        import tritonclient.grpc as tclient
+        url = args.url
+    else:
+        import tritonclient.http as tclient
+        url = args.url
+    client = tclient.InferenceServerClient(url=url, verbose=args.verbose)
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 1, dtype=np.int32)
+    inputs = [
+        tclient.InferInput("INPUT0", [1, 16], "INT32"),
+        tclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    # warmup establishes steady-state allocations (pools, buffers)
+    for _ in range(50):
+        client.infer("simple", inputs)
+    baseline = rss_mb()
+
+    for i in range(args.iterations):
+        result = client.infer("simple", inputs)
+        if i == 0 and not np.array_equal(
+            result.as_numpy("OUTPUT0"), input0_data + input1_data
+        ):
+            print("FAILED: incorrect result")
+            sys.exit(1)
+
+    growth = rss_mb() - baseline
+    print("rss baseline {:.1f} MB, growth after {} iterations: "
+          "{:.1f} MB".format(baseline, args.iterations, growth))
+    if growth > args.max_growth_mb:
+        print("FAILED: memory growth {:.1f} MB exceeds {} MB".format(
+            growth, args.max_growth_mb))
+        sys.exit(1)
+    client.close()
+    print("PASS: memory growth")
+
+
+if __name__ == "__main__":
+    main()

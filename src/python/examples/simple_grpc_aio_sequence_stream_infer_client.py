@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""asyncio bidirectional streaming with stateful sequences: the
+stream_infer async generator consumes an async iterator of requests
+(role of reference simple_grpc_aio_sequence_stream_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import tritonclient.grpc.aio as grpcclient
+
+
+async def run(args):
+    values = [11, 7, 5, 3, 2, 0, 1]
+    sequence_id = 4007
+
+    async def requests():
+        for i, v in enumerate(values):
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+            yield {
+                "model_name": "sequence_accumulate",
+                "inputs": [inp],
+                "request_id": "seq_{}".format(i),
+                "sequence_id": sequence_id,
+                "sequence_start": i == 0,
+                "sequence_end": i == len(values) - 1,
+            }
+
+    async with grpcclient.InferenceServerClient(url=args.url) as client:
+        partial_sums = []
+        async for result, error in client.stream_infer(requests()):
+            if error is not None:
+                print("stream error: " + str(error))
+                sys.exit(1)
+            partial_sums.append(int(result.as_numpy("OUTPUT")[0]))
+
+    expected = []
+    acc = 0
+    for v in values:
+        acc += v
+        expected.append(acc)
+    print("partial sums: {}".format(partial_sums))
+    if partial_sums != expected:
+        print("FAILED: wrong partial sums")
+        sys.exit(1)
+    print("PASS: aio sequence stream")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    asyncio.run(run(parser.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

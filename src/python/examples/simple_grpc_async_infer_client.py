@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Callback-style async infer over gRPC (role of reference
+src/python/examples/simple_grpc_async_infer_client.py)."""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 5, dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    completed = queue.Queue()
+    n_requests = 8
+    for _ in range(n_requests):
+        client.async_infer(
+            "simple", inputs,
+            callback=lambda result, error: completed.put((result, error)),
+        )
+    for _ in range(n_requests):
+        result, error = completed.get(timeout=30)
+        if error is not None:
+            print("inference failed: " + str(error))
+            sys.exit(1)
+        if not np.array_equal(
+            result.as_numpy("OUTPUT0"), input0_data + input1_data
+        ):
+            print("error: incorrect sum")
+            sys.exit(1)
+    client.close()
+    print("PASS: async infer")
+
+
+if __name__ == "__main__":
+    main()

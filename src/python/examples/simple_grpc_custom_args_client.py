@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""Infer with custom request headers and request parameters attached
+(role of reference simple_grpc_custom_args_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 4, dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    result = client.infer(
+        "simple", inputs,
+        headers={"x-client-example": "custom-args"},
+        parameters={"example_param": "value", "example_flag": True},
+        request_id="custom-args-1",
+        priority=1,
+    )
+    if result.get_response().id != "custom-args-1":
+        print("FAILED: request id not echoed")
+        sys.exit(1)
+    if not np.array_equal(
+        result.as_numpy("OUTPUT0"), input0_data + input1_data
+    ):
+        print("FAILED: incorrect sum")
+        sys.exit(1)
+    client.close()
+    print("PASS: custom args")
+
+
+if __name__ == "__main__":
+    main()

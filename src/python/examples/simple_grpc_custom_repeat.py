@@ -1,0 +1,68 @@
+#!/usr/bin/env python3
+"""Decoupled streaming: one request to the `repeat_int32` model produces
+N streamed responses plus the empty final-response marker (role of
+reference simple_grpc_custom_repeat.py:78-105)."""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-r", "--repeat-count", type=int, default=6)
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+    results = queue.Queue()
+    client.start_stream(
+        callback=lambda result, error: results.put((result, error))
+    )
+
+    values = np.arange(args.repeat_count, dtype=np.int32) * 10
+    inputs = [
+        grpcclient.InferInput("IN", [len(values)], "INT32"),
+        grpcclient.InferInput("DELAY", [len(values)], "UINT32"),
+        grpcclient.InferInput("WAIT", [1], "UINT32"),
+    ]
+    inputs[0].set_data_from_numpy(values)
+    inputs[1].set_data_from_numpy(
+        np.full(len(values), 1000, dtype=np.uint32))
+    inputs[2].set_data_from_numpy(np.array([500], dtype=np.uint32))
+
+    try:
+        client.async_stream_infer(
+            "repeat_int32", inputs, enable_empty_final_response=True
+        )
+        received = []
+        while True:
+            result, error = results.get(timeout=30)
+            if error is not None:
+                print("stream error: " + str(error))
+                sys.exit(1)
+            response = result.get_response()
+            final = response.parameters.get("triton_final_response")
+            if final is not None and final.bool_param:
+                break
+            received.append(int(result.as_numpy("OUT")[0]))
+    finally:
+        client.stop_stream()
+
+    print("received: {}".format(received))
+    if received != list(values):
+        print("FAILED: wrong streamed values")
+        sys.exit(1)
+    client.close()
+    print("PASS: custom repeat")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python3
+"""Health, metadata, config, repository-index and statistics queries over
+gRPC (role of reference src/python/examples/simple_grpc_health_metadata.py)."""
+
+import argparse
+import sys
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    if not client.is_server_live():
+        print("FAILED: server not live")
+        sys.exit(1)
+    if not client.is_server_ready():
+        print("FAILED: server not ready")
+        sys.exit(1)
+    if not client.is_model_ready("simple"):
+        print("FAILED: model 'simple' not ready")
+        sys.exit(1)
+
+    server_metadata = client.get_server_metadata()
+    print("server: {} {}".format(
+        server_metadata.name, server_metadata.version))
+
+    model_metadata = client.get_model_metadata("simple")
+    if model_metadata.name != "simple":
+        print("FAILED: wrong model metadata name")
+        sys.exit(1)
+    print("model inputs: {}".format(
+        [t.name for t in model_metadata.inputs]))
+
+    model_config = client.get_model_config("simple")
+    if model_config.config.name != "simple":
+        print("FAILED: wrong model config name")
+        sys.exit(1)
+
+    index = client.get_model_repository_index()
+    if not any(m.name == "simple" for m in index.models):
+        print("FAILED: 'simple' not in repository index")
+        sys.exit(1)
+
+    stats = client.get_inference_statistics("simple")
+    if not stats.model_stats:
+        print("FAILED: no statistics for 'simple'")
+        sys.exit(1)
+    client.close()
+    print("PASS: health and metadata")
+
+
+if __name__ == "__main__":
+    main()

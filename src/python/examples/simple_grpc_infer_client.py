@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Sync infer on the `simple` add/sub model over gRPC (role of reference
+src/python/examples/simple_grpc_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+from tritonclient.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 1, dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+    outputs = [
+        grpcclient.InferRequestedOutput("OUTPUT0"),
+        grpcclient.InferRequestedOutput("OUTPUT1"),
+    ]
+
+    try:
+        result = client.infer("simple", inputs, outputs=outputs)
+    except InferenceServerException as e:
+        print("inference failed: " + str(e))
+        sys.exit(1)
+
+    output0_data = result.as_numpy("OUTPUT0")
+    output1_data = result.as_numpy("OUTPUT1")
+    if not np.array_equal(output0_data, input0_data + input1_data):
+        print("error: incorrect sum")
+        sys.exit(1)
+    if not np.array_equal(output1_data, input0_data - input1_data):
+        print("error: incorrect difference")
+        sys.exit(1)
+    print("0 + 1 = {}".format(output0_data[0][0]))
+    print("0 - 1 = {}".format(output1_data[0][0]))
+    client.close()
+    print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

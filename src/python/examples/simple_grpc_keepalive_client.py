@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Infer over a channel configured with explicit keepalive options (role
+of reference simple_grpc_keepalive_client.py; reference KeepAliveOptions
+grpc_client.h:61-82)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    keepalive_options = grpcclient.KeepAliveOptions(
+        keepalive_time_ms=1000,
+        keepalive_timeout_ms=500,
+        keepalive_permit_without_calls=True,
+        http2_max_pings_without_data=0,
+    )
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose,
+        keepalive_options=keepalive_options,
+    )
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 3, dtype=np.int32)
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    result = client.infer("simple", inputs)
+    if not np.array_equal(
+        result.as_numpy("OUTPUT0"), input0_data + input1_data
+    ):
+        print("FAILED: incorrect sum")
+        sys.exit(1)
+    client.close()
+    print("PASS: keepalive")
+
+
+if __name__ == "__main__":
+    main()

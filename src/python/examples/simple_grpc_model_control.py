@@ -1,0 +1,51 @@
+#!/usr/bin/env python3
+"""Explicit model load/unload over gRPC (role of reference
+src/python/examples/simple_grpc_model_control.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+from tritonclient.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    client.unload_model("simple")
+    if client.is_model_ready("simple"):
+        print("FAILED: model still ready after unload")
+        sys.exit(1)
+
+    inputs = [grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+              grpcclient.InferInput("INPUT1", [1, 16], "INT32")]
+    data = np.zeros((1, 16), dtype=np.int32)
+    inputs[0].set_data_from_numpy(data)
+    inputs[1].set_data_from_numpy(data)
+    try:
+        client.infer("simple", inputs)
+        print("FAILED: infer succeeded on unloaded model")
+        sys.exit(1)
+    except InferenceServerException:
+        pass
+
+    client.load_model("simple")
+    if not client.is_model_ready("simple"):
+        print("FAILED: model not ready after load")
+        sys.exit(1)
+    client.infer("simple", inputs)
+    client.close()
+    print("PASS: model control")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+#!/usr/bin/env python3
+"""Stateful sequences over the bidirectional gRPC stream: two sequences
+issued on one stream, responses correlated by request id (role of
+reference simple_grpc_sequence_stream_infer_client.py)."""
+
+import argparse
+import queue
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+    results = queue.Queue()
+    client.start_stream(
+        callback=lambda result, error: results.put((result, error))
+    )
+
+    values = [11, 7, 5, 3, 2, 0, 1]
+    seq0, seq1 = 3007, 3008
+    n_sent = 0
+    try:
+        for i, v in enumerate(values):
+            start = i == 0
+            end = i == len(values) - 1
+            for seq, val in ((seq0, v), (seq1, -v)):
+                inp = grpcclient.InferInput("INPUT", [1], "INT32")
+                inp.set_data_from_numpy(np.array([val], dtype=np.int32))
+                client.async_stream_infer(
+                    "sequence_accumulate", [inp],
+                    request_id="{}_{}".format(seq, i),
+                    sequence_id=seq, sequence_start=start, sequence_end=end,
+                )
+                n_sent += 1
+        acc = {}
+        for _ in range(n_sent):
+            result, error = results.get(timeout=30)
+            if error is not None:
+                print("stream error: " + str(error))
+                sys.exit(1)
+            rid = result.get_response().id
+            acc[rid] = int(result.as_numpy("OUTPUT")[0])
+    finally:
+        client.stop_stream()
+
+    last = len(values) - 1
+    expected = sum(values)
+    final0 = acc["{}_{}".format(seq0, last)]
+    final1 = acc["{}_{}".format(seq1, last)]
+    print("sequence {}: {}".format(seq0, final0))
+    print("sequence {}: {}".format(seq1, final1))
+    if final0 != expected or final1 != -expected:
+        print("FAILED: wrong accumulated values")
+        sys.exit(1)
+    client.close()
+    print("PASS: sequence stream")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""Stateful-sequence infer over gRPC: two interleaved sequences against
+the `sequence_accumulate` model (role of reference
+simple_grpc_sequence_sync_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def send(client, sequence_id, value, start=False, end=False):
+    inp = grpcclient.InferInput("INPUT", [1], "INT32")
+    inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+    result = client.infer(
+        "sequence_accumulate", [inp],
+        sequence_id=sequence_id, sequence_start=start, sequence_end=end,
+    )
+    return int(result.as_numpy("OUTPUT")[0])
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    values = [11, 7, 5, 3, 2, 0, 1]
+    seq0, seq1 = 2007, 2008
+    acc0 = acc1 = 0
+    for i, v in enumerate(values):
+        start = i == 0
+        end = i == len(values) - 1
+        acc0 = send(client, seq0, v, start=start, end=end)
+        acc1 = send(client, seq1, -v, start=start, end=end)
+    expected = sum(values)
+    print("sequence {}: {}".format(seq0, acc0))
+    print("sequence {}: {}".format(seq1, acc1))
+    if acc0 != expected or acc1 != -expected:
+        print("FAILED: wrong accumulated values")
+        sys.exit(1)
+    client.close()
+    print("PASS: sequence sync")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""BYTES-tensor infer on `simple_string` over gRPC (role of reference
+src/python/examples/simple_grpc_string_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.grpc as grpcclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    args = parser.parse_args()
+
+    client = grpcclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.full(16, 1, dtype=np.int32)
+    input0_str = np.array(
+        [str(x).encode("utf-8") for x in in0], dtype=np.object_
+    ).reshape(1, 16)
+    input1_str = np.array(
+        [str(x).encode("utf-8") for x in in1], dtype=np.object_
+    ).reshape(1, 16)
+
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "BYTES"),
+        grpcclient.InferInput("INPUT1", [1, 16], "BYTES"),
+    ]
+    inputs[0].set_data_from_numpy(input0_str)
+    inputs[1].set_data_from_numpy(input1_str)
+
+    result = client.infer("simple_string", inputs)
+    output0 = result.as_numpy("OUTPUT0").reshape(16)
+    output1 = result.as_numpy("OUTPUT1").reshape(16)
+    for i in range(16):
+        if int(output0[i]) != in0[i] + in1[i]:
+            print("error: incorrect sum")
+            sys.exit(1)
+        if int(output1[i]) != in0[i] - in1[i]:
+            print("error: incorrect difference")
+            sys.exit(1)
+    client.close()
+    print("PASS: string infer")
+
+
+if __name__ == "__main__":
+    main()

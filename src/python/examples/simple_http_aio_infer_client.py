@@ -1,0 +1,53 @@
+#!/usr/bin/env python3
+"""asyncio infer over HTTP (role of reference
+simple_http_aio_infer_client.py)."""
+
+import argparse
+import asyncio
+import sys
+
+import numpy as np
+
+import tritonclient.http.aio as httpclient
+
+
+async def run(args):
+    async with httpclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    ) as client:
+        if not await client.is_server_live():
+            print("FAILED: server not live")
+            sys.exit(1)
+
+        input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        input1_data = np.full((1, 16), 1, dtype=np.int32)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(input0_data, binary_data=True)
+        inputs[1].set_data_from_numpy(input1_data, binary_data=True)
+
+        result = await client.infer("simple", inputs)
+        if not np.array_equal(
+            result.as_numpy("OUTPUT0"), input0_data + input1_data
+        ):
+            print("FAILED: incorrect sum")
+            sys.exit(1)
+        if not np.array_equal(
+            result.as_numpy("OUTPUT1"), input0_data - input1_data
+        ):
+            print("FAILED: incorrect difference")
+            sys.exit(1)
+    print("PASS: aio infer")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    asyncio.run(run(parser.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Async (worker-pool) infer over HTTP; fires several requests and
+collects the futures (role of reference
+src/python/examples/simple_http_async_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose, concurrency=4
+    )
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 2, dtype=np.int32)
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data)
+    inputs[1].set_data_from_numpy(input1_data)
+
+    async_requests = [
+        client.async_infer("simple", inputs) for _ in range(8)
+    ]
+    for request in async_requests:
+        result = request.get_result()
+        if not np.array_equal(
+            result.as_numpy("OUTPUT0"), input0_data + input1_data
+        ):
+            print("error: incorrect sum")
+            sys.exit(1)
+    client.close()
+    print("PASS: async infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Sync infer on the `simple` add/sub model over HTTP (role of reference
+src/python/examples/simple_http_infer_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.http as httpclient
+from tritonclient.utils import InferenceServerException
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    try:
+        client = httpclient.InferenceServerClient(
+            url=args.url, verbose=args.verbose
+        )
+    except Exception as e:
+        print("client creation failed: " + str(e))
+        sys.exit(1)
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 1, dtype=np.int32)
+
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(input0_data, binary_data=True)
+    inputs[1].set_data_from_numpy(input1_data, binary_data=False)
+
+    outputs = [
+        httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+        httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+    ]
+
+    try:
+        result = client.infer("simple", inputs, outputs=outputs)
+    except InferenceServerException as e:
+        print("inference failed: " + str(e))
+        sys.exit(1)
+
+    output0_data = result.as_numpy("OUTPUT0")
+    output1_data = result.as_numpy("OUTPUT1")
+    for i in range(16):
+        print(
+            "{} + {} = {}".format(
+                input0_data[0][i], input1_data[0][i], output0_data[0][i]
+            )
+        )
+        if (input0_data[0][i] + input1_data[0][i]) != output0_data[0][i]:
+            print("error: incorrect sum")
+            sys.exit(1)
+        if (input0_data[0][i] - input1_data[0][i]) != output1_data[0][i]:
+            print("error: incorrect difference")
+            sys.exit(1)
+
+    stat = client.get_inference_stat()
+    if stat.completed_request_count < 1:
+        print("error: client statistics not recorded")
+        sys.exit(1)
+    client.close()
+    print("PASS: infer")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""System shared-memory data plane over HTTP: inputs and outputs both
+live in POSIX shm regions registered with the server (role of reference
+simple_http_shm_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.http as httpclient
+from tritonclient.utils import shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+    client.unregister_system_shared_memory()
+
+    input0_data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    input1_data = np.full((1, 16), 1, dtype=np.int32)
+    byte_size = input0_data.nbytes
+
+    shm_ip_handle = shm.create_shared_memory_region(
+        "input_data", "/input_simple_http", byte_size * 2
+    )
+    shm_op_handle = shm.create_shared_memory_region(
+        "output_data", "/output_simple_http", byte_size * 2
+    )
+    try:
+        shm.set_shared_memory_region(
+            shm_ip_handle, [input0_data, input1_data]
+        )
+        client.register_system_shared_memory(
+            "input_data", "/input_simple_http", byte_size * 2
+        )
+        client.register_system_shared_memory(
+            "output_data", "/output_simple_http", byte_size * 2
+        )
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", byte_size)
+        inputs[1].set_shared_memory("input_data", byte_size,
+                                    offset=byte_size)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
+            httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
+        ]
+        outputs[0].set_shared_memory("output_data", byte_size)
+        outputs[1].set_shared_memory("output_data", byte_size,
+                                     offset=byte_size)
+
+        client.infer("simple", inputs, outputs=outputs)
+
+        sum_data = shm.get_contents_as_numpy(
+            shm_op_handle, np.int32, [1, 16]
+        )
+        diff_data = shm.get_contents_as_numpy(
+            shm_op_handle, np.int32, [1, 16], offset=byte_size
+        )
+        if not np.array_equal(sum_data, input0_data + input1_data):
+            print("FAILED: incorrect sum in shm")
+            sys.exit(1)
+        if not np.array_equal(diff_data, input0_data - input1_data):
+            print("FAILED: incorrect difference in shm")
+            sys.exit(1)
+        status = client.get_system_shared_memory_status()
+        if len(status) < 2:
+            print("FAILED: shm status missing regions")
+            sys.exit(1)
+    finally:
+        client.unregister_system_shared_memory()
+        shm.destroy_shared_memory_region(shm_ip_handle)
+        shm.destroy_shared_memory_region(shm_op_handle)
+    client.close()
+    print("PASS: system shared memory")
+
+
+if __name__ == "__main__":
+    main()

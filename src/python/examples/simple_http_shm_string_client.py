@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""BYTES tensors through system shared memory over HTTP: string inputs
+are length-prefix serialized into the region (role of reference
+simple_http_shm_string_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.http as httpclient
+from tritonclient.utils import serialized_byte_size
+from tritonclient.utils import shared_memory as shm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    client = httpclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+
+    in0 = np.arange(16, dtype=np.int32)
+    in1 = np.full(16, 1, dtype=np.int32)
+    input0_str = np.array(
+        [str(x).encode("utf-8") for x in in0], dtype=np.object_
+    ).reshape(1, 16)
+    input1_str = np.array(
+        [str(x).encode("utf-8") for x in in1], dtype=np.object_
+    ).reshape(1, 16)
+    size0 = serialized_byte_size(input0_str)
+    size1 = serialized_byte_size(input1_str)
+
+    shm_ip_handle = shm.create_shared_memory_region(
+        "str_input_data", "/str_input_http", size0 + size1
+    )
+    try:
+        shm.set_shared_memory_region(
+            shm_ip_handle, [input0_str, input1_str]
+        )
+        client.register_system_shared_memory(
+            "str_input_data", "/str_input_http", size0 + size1
+        )
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "BYTES"),
+            httpclient.InferInput("INPUT1", [1, 16], "BYTES"),
+        ]
+        inputs[0].set_shared_memory("str_input_data", size0)
+        inputs[1].set_shared_memory("str_input_data", size1, offset=size0)
+
+        result = client.infer("simple_string", inputs)
+        output0 = result.as_numpy("OUTPUT0").reshape(16)
+        output1 = result.as_numpy("OUTPUT1").reshape(16)
+        for i in range(16):
+            if int(output0[i]) != in0[i] + in1[i]:
+                print("FAILED: incorrect sum")
+                sys.exit(1)
+            if int(output1[i]) != in0[i] - in1[i]:
+                print("FAILED: incorrect difference")
+                sys.exit(1)
+    finally:
+        client.unregister_system_shared_memory("str_input_data")
+        shm.destroy_shared_memory_region(shm_ip_handle)
+    client.close()
+    print("PASS: string shared memory")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""TPU shared-memory data plane over HTTP (TPU-native role of reference
+simple_http_cudashm_client.py)."""
+
+import argparse
+import sys
+
+import numpy as np
+
+import tritonclient.http as httpclient
+from tritonclient.utils import xla_shared_memory as xshm
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default="localhost:8000")
+    args = parser.parse_args()
+
+    import jax.numpy as jnp
+
+    client = httpclient.InferenceServerClient(
+        url=args.url, verbose=args.verbose
+    )
+    client.unregister_xla_shared_memory()
+
+    input0_data = jnp.asarray(
+        np.arange(16, dtype=np.int32).reshape(1, 16))
+    input1_data = jnp.asarray(np.full((1, 16), 1, dtype=np.int32))
+    byte_size = 16 * 4
+
+    shm_ip_handle = xshm.create_shared_memory_region(
+        "input_data", byte_size * 2)
+    shm_op_handle = xshm.create_shared_memory_region(
+        "output_data", byte_size * 2)
+    try:
+        client.register_xla_shared_memory(
+            "input_data", xshm.get_raw_handle(shm_ip_handle), 0,
+            byte_size * 2)
+        client.register_xla_shared_memory(
+            "output_data", xshm.get_raw_handle(shm_op_handle), 0,
+            byte_size * 2)
+        xshm.set_shared_memory_region_from_jax(
+            shm_ip_handle, [input0_data, input1_data])
+
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_shared_memory("input_data", byte_size)
+        inputs[1].set_shared_memory("input_data", byte_size,
+                                    offset=byte_size)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        outputs[0].set_shared_memory("output_data", byte_size)
+        outputs[1].set_shared_memory("output_data", byte_size,
+                                     offset=byte_size)
+
+        client.infer("simple", inputs, outputs=outputs)
+
+        sum_np = xshm.get_contents_as_numpy(
+            shm_op_handle, np.int32, [1, 16])
+        diff_np = xshm.get_contents_as_numpy(
+            shm_op_handle, np.int32, [1, 16], offset=byte_size)
+        expected_sum = np.asarray(input0_data + input1_data)
+        expected_diff = np.asarray(input0_data - input1_data)
+        if not np.array_equal(sum_np, expected_sum):
+            print("FAILED: incorrect sum in xla shm")
+            sys.exit(1)
+        if not np.array_equal(diff_np, expected_diff):
+            print("FAILED: incorrect difference in xla shm")
+            sys.exit(1)
+    finally:
+        client.unregister_xla_shared_memory()
+        xshm.destroy_shared_memory_region(shm_ip_handle)
+        xshm.destroy_shared_memory_region(shm_op_handle)
+    client.close()
+    print("PASS: xla shared memory")
+
+
+if __name__ == "__main__":
+    main()

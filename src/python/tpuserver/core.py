@@ -236,6 +236,13 @@ class JaxModel(Model):
     def jax_fn(self, **kwargs):
         raise NotImplementedError
 
+    def prepare(self):
+        """One-time eager setup (e.g. parameter initialization), run
+        OUTSIDE any jit trace.  Lazily creating params inside the traced
+        ``jax_fn`` would store tracers of that trace in model state
+        (jitted helpers like jax.random.normal inline into an active
+        trace), corrupting every later re-trace."""
+
     def _get_jitted(self):
         if self._jitted is None:
             with self._lock:
@@ -254,6 +261,7 @@ class JaxModel(Model):
         import jax
 
         fn = self._get_jitted()
+        self.prepare()
         dev_inputs = {}
         for name, arr in inputs.items():
             if isinstance(arr, jax.Array) and self._device is None:

@@ -39,9 +39,15 @@ def serving_models(include_vision=True, include_bert=True,
     generation.  Separate from ``default_models`` so unit tests stay fast."""
     models = []
     if include_vision:
-        from tpuserver.models.vision import DenseNet121Model, ResNet50Model
+        from tpuserver.models.vision import (
+            DenseNet121Model,
+            ImageEnsembleModel,
+            ImagePreprocessModel,
+            ResNet50Model,
+        )
 
-        models += [ResNet50Model(), DenseNet121Model()]
+        models += [ResNet50Model(), DenseNet121Model(),
+                   ImagePreprocessModel(), ImageEnsembleModel()]
     if include_bert:
         from tpuserver.models.bert import (
             BertEncoderModel,

@@ -83,6 +83,10 @@ class BertEncoderModel(JaxModel):
         self._seed = seed
         self._params_lock = threading.Lock()
 
+    def prepare(self):
+        # eager param init (outside any jit trace; see JaxModel.prepare)
+        self._get_params()
+
     def _get_params(self):
         if self._params is not None:
             return self._params

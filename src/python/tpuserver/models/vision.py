@@ -13,7 +13,7 @@ import threading
 
 import numpy as np
 
-from tpuserver.core import JaxModel, TensorSpec
+from tpuserver.core import JaxModel, Model, TensorSpec
 
 
 def _conv(x, w, stride=1, padding="SAME"):
@@ -84,6 +84,10 @@ class _ImageNetModel(JaxModel):
         self.labels = {
             "OUTPUT": ["class_{}".format(i) for i in range(1000)]
         }
+
+    def prepare(self):
+        # eager param init (outside any jit trace; see JaxModel.prepare)
+        self._get_params()
 
     def _get_params(self):
         if self._params is None:
@@ -289,3 +293,62 @@ class DenseNet121Model(_ImageNetModel):
         ))
         x = jnp.mean(x, axis=(1, 2))
         return x @ params["fc"]["w"] + params["fc"]["b"]
+
+
+class ImagePreprocessModel(JaxModel):
+    """Raw UINT8 pixels -> normalized FP32 network input.
+
+    The preprocessing stage the reference's ensemble_image_client drives as
+    the first composing model of its image ensemble (reference
+    src/c++/examples/ensemble_image_client.cc); here it is a jitted cast +
+    scale so the whole ensemble stays on device.
+    """
+
+    name = "image_preprocess"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 32
+    inputs = (TensorSpec("RAW_IMAGE", "UINT8", [224, 224, 3]),)
+    outputs = (TensorSpec("PREPROCESSED", "FP32", [224, 224, 3]),)
+
+    def jax_fn(self, RAW_IMAGE):
+        import jax.numpy as jnp
+
+        return {
+            "PREPROCESSED": RAW_IMAGE.astype(jnp.float32) / 255.0
+        }
+
+
+class ImageEnsembleModel(Model):
+    """RAW_IMAGE -> classification probs via preprocess + ResNet-50
+    (ensemble_scheduling; role of the reference's preprocess+classifier
+    ensemble in ensemble_image_client.cc).  Plain Model like
+    BertEnsembleModel: the core's ensemble dispatch runs the steps, so no
+    jit machinery of its own."""
+
+    name = "image_ensemble"
+    platform = "ensemble"
+    backend = ""
+    max_batch_size = 32
+    inputs = (TensorSpec("RAW_IMAGE", "UINT8", [224, 224, 3]),)
+    outputs = (TensorSpec("OUTPUT", "FP32", [1000]),)
+    ensemble_steps = [
+        {
+            "model_name": "image_preprocess",
+            "model_version": -1,
+            "input_map": {"RAW_IMAGE": "RAW_IMAGE"},
+            "output_map": {"PREPROCESSED": "pixels"},
+        },
+        {
+            "model_name": "resnet50",
+            "model_version": -1,
+            "input_map": {"INPUT": "pixels"},
+            "output_map": {"OUTPUT": "OUTPUT"},
+        },
+    ]
+
+    def __init__(self):
+        super().__init__()
+        self.labels = {
+            "OUTPUT": ["class_{}".format(i) for i in range(1000)]
+        }

@@ -46,3 +46,27 @@ def http_server(server_core):
 @pytest.fixture(scope="session")
 def http_url(http_server):
     return http_server.url
+
+
+@pytest.fixture(scope="session")
+def zoo_servers():
+    """HTTP + gRPC frontends over a core with the vision serving zoo —
+    shared by the Python/C++ example suites (image/ensemble examples
+    need resnet50/image_ensemble; one compile for the whole session)."""
+    from tpuserver.core import InferenceServer
+    from tpuserver.grpc_frontend import GrpcFrontend
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models import default_models, serving_models
+
+    core = InferenceServer(
+        default_models()
+        + serving_models(include_bert=False, include_llama=False)
+    )
+    http = HttpFrontend(core, port=0).start()
+    grpc_f = GrpcFrontend(core, port=0).start()
+    yield {
+        "http": http.url.replace("http://", ""),
+        "grpc": "127.0.0.1:{}".format(grpc_f.port),
+    }
+    grpc_f.stop()
+    http.stop()

@@ -82,3 +82,64 @@ def test_perf_analyzer_e2e(cc_build, http_server):
         header, row = f.read().strip().splitlines()[:2]
     assert header.startswith("Concurrency,Inferences/Second")
     assert float(row.split(",")[1]) > 50  # sane throughput over loopback
+
+
+# -- C++ example programs over real sockets ----------------------------------
+
+# (binary, url-protocol, marker, extra args)
+CC_EXAMPLES = [
+    ("simple_grpc_infer_client", "grpc", "infer OK", []),
+    ("simple_grpc_shm_client", "grpc", "shm infer OK", []),
+    ("simple_grpc_xlashm_client", "grpc", "xla shm infer OK", []),
+    ("simple_grpc_string_infer_client", "grpc", "string infer OK", []),
+    ("simple_http_string_infer_client", "http", "string infer OK", []),
+    ("simple_grpc_health_metadata", "grpc", "health metadata OK", []),
+    ("simple_http_health_metadata", "http", "health metadata OK", []),
+    ("simple_grpc_model_control", "grpc", "model control OK", []),
+    ("simple_http_model_control", "http", "model control OK", []),
+    ("simple_grpc_sequence_sync_infer_client", "grpc",
+     "sequence sync OK", []),
+    ("simple_http_sequence_sync_infer_client", "http",
+     "sequence sync OK", []),
+    ("simple_grpc_sequence_stream_infer_client", "grpc",
+     "sequence stream OK", []),
+    ("simple_grpc_custom_args_client", "grpc", "custom args OK", []),
+    ("image_client", "http", "image client OK",
+     ["--synthetic", "2", "-c", "2"]),
+    ("image_client", "grpc", "image client OK",
+     ["-i", "grpc", "--synthetic", "4", "-b", "2", "-a",
+      "-s", "INCEPTION"]),
+    ("image_client", "grpc", "image client OK",
+     ["-i", "grpc", "--synthetic", "1", "--streaming", "-s", "VGG"]),
+    ("ensemble_image_client", "http", "ensemble image client OK", []),
+    ("ensemble_image_client", "grpc", "ensemble image client OK",
+     ["-i", "grpc"]),
+]
+
+
+@pytest.mark.parametrize(
+    "binary,proto,marker,extra",
+    CC_EXAMPLES,
+    ids=["{}[{}]{}".format(c[0], c[1], "-" + "".join(
+        a.lstrip("-") for a in c[3] if a.startswith("-")
+    ) if c[3] else "") for c in CC_EXAMPLES],
+)
+def test_cc_example(cc_build, zoo_servers, binary, proto, marker, extra):
+    result = subprocess.run(
+        [os.path.join(cc_build, binary), "-u", zoo_servers[proto]] + extra,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert result.returncode == 0, (
+        binary + "\n" + result.stdout + "\n" + result.stderr
+    )
+    assert marker in result.stdout, result.stdout
+
+
+def test_cc_reuse_infer_objects(cc_build, zoo_servers):
+    result = subprocess.run(
+        [os.path.join(cc_build, "reuse_infer_objects_client"),
+         "-u", zoo_servers["http"], "-g", zoo_servers["grpc"]],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "reuse infer objects OK" in result.stdout

@@ -1,0 +1,82 @@
+"""Run every Python example end-to-end against in-process frontends over
+real sockets (role of the reference's qa/L0_* example harnesses; the
+examples themselves mirror src/python/examples/ of the reference)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES_DIR = os.path.join(REPO, "src", "python", "examples")
+
+
+
+
+# (script, protocol-of-url, extra args)
+CASES = [
+    ("simple_http_infer_client.py", "http", []),
+    ("simple_grpc_infer_client.py", "grpc", []),
+    ("simple_http_async_infer_client.py", "http", []),
+    ("simple_grpc_async_infer_client.py", "grpc", []),
+    ("simple_http_string_infer_client.py", "http", []),
+    ("simple_grpc_string_infer_client.py", "grpc", []),
+    ("simple_http_health_metadata.py", "http", []),
+    ("simple_grpc_health_metadata.py", "grpc", []),
+    ("simple_http_model_control.py", "http", []),
+    ("simple_grpc_model_control.py", "grpc", []),
+    ("simple_http_sequence_sync_infer_client.py", "http", []),
+    ("simple_grpc_sequence_sync_infer_client.py", "grpc", []),
+    ("simple_grpc_sequence_stream_infer_client.py", "grpc", []),
+    ("simple_grpc_custom_args_client.py", "grpc", []),
+    ("simple_grpc_keepalive_client.py", "grpc", []),
+    ("simple_grpc_custom_repeat.py", "grpc", []),
+    ("simple_http_shm_client.py", "http", []),
+    ("simple_grpc_shm_client.py", "grpc", []),
+    ("simple_http_shm_string_client.py", "http", []),
+    ("simple_grpc_shm_string_client.py", "grpc", []),
+    ("simple_http_xlashm_client.py", "http", []),
+    ("simple_grpc_xlashm_client.py", "grpc", []),
+    ("simple_http_aio_infer_client.py", "http", []),
+    ("simple_grpc_aio_infer_client.py", "grpc", []),
+    ("simple_grpc_aio_sequence_stream_infer_client.py", "grpc", []),
+    ("grpc_client.py", "grpc", []),
+    ("grpc_explicit_int_content_client.py", "grpc", []),
+    ("grpc_explicit_int8_content_client.py", "grpc", []),
+    ("grpc_explicit_byte_content_client.py", "grpc", []),
+    ("memory_growth_test.py", "http", ["-n", "200"]),
+    ("image_client.py", "http", ["--synthetic", "2", "-c", "2"]),
+    ("image_client.py", "grpc",
+     ["-i", "grpc", "--synthetic", "4", "-b", "2", "-a",
+      "-s", "INCEPTION"]),
+    ("image_client.py", "grpc",
+     ["-i", "grpc", "--synthetic", "1", "--streaming", "-s", "VGG"]),
+    ("grpc_image_client.py", "grpc", []),
+    ("ensemble_image_client.py", "http", []),
+    ("ensemble_image_client.py", "grpc", ["-i", "grpc"]),
+    ("reuse_infer_objects_client.py", "http", []),
+    ("reuse_infer_objects_client.py", "grpc", ["-i", "grpc"]),
+]
+
+
+@pytest.mark.parametrize(
+    "script,proto,extra",
+    CASES,
+    ids=["{}{}".format(c[0], "-" + "".join(
+        a.lstrip("-") for a in c[2] if a.startswith("-")
+    ) if c[2] else "") for c in CASES],
+)
+def test_example(zoo_servers, script, proto, extra):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "src", "python")
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES_DIR, script),
+         "-u", zoo_servers[proto]] + extra,
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    assert result.returncode == 0, (
+        script + "\n" + result.stdout + "\n" + result.stderr
+    )
+    assert "PASS" in result.stdout, result.stdout
