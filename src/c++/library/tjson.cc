@@ -263,6 +263,8 @@ struct Parser {
   }
 };
 
+}  // namespace
+
 void
 EscapeTo(const std::string& s, std::string* out)
 {
@@ -296,6 +298,8 @@ EscapeTo(const std::string& s, std::string* out)
   }
   out->push_back('"');
 }
+
+namespace {
 
 void
 SerializeTo(const Value& v, std::string* out)

@@ -130,5 +130,8 @@ class Value {
 // Parse JSON text; returns nullptr and sets *error on failure.
 ValuePtr Parse(const std::string& text, std::string* error);
 
+// Append `s` to *out as a quoted, escaped JSON string literal.
+void EscapeTo(const std::string& s, std::string* out);
+
 }  // namespace json
 }  // namespace tc

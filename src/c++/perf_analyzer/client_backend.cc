@@ -4,6 +4,9 @@
 
 #include "grpc_client.h"
 #include "http_client.h"
+#ifdef PA_ENABLE_INPROC
+#include "tpuserver_loader.h"
+#endif
 
 namespace pa {
 
@@ -478,6 +481,105 @@ class TritonGrpcBackend : public ClientBackend {
   std::unique_ptr<tc::InferenceServerGrpcClient> client_;
 };
 
+#ifdef PA_ENABLE_INPROC
+// In-process backend: serves through the embedded tpuserver runtime,
+// no sockets (role of reference triton_c_api backend; like it, issue
+// is synchronous — AsyncInfer completes inline,
+// reference docs/benchmarking.md:92-98).
+class InProcessBackend : public ClientBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    TpuServerLoader::Options options;
+    options.server_src = config.server_src;
+    options.include_vision = config.inproc_vision;
+    options.verbose = config.verbose;
+    tc::Error err = TpuServerLoader::Create(options);
+    if (!err.IsOk()) {
+      return err;
+    }
+    backend->reset(new InProcessBackend());
+    return tc::Error::Success;
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    return TpuServerLoader::GetSingleton()->ServerReady(ready);
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    return TpuServerLoader::GetSingleton()->ModelMetadata(
+        metadata_json, model_name, model_version);
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    return TpuServerLoader::GetSingleton()->ModelConfig(
+        config_json, model_name, model_version);
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) override
+  {
+    return TpuServerLoader::GetSingleton()->ModelStatistics(
+        stats_json, model_name);
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    return TpuServerLoader::GetSingleton()->Infer(result, request);
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback,
+      const BackendInferRequest& request) override
+  {
+    BackendInferResult result;
+    tc::Error err =
+        TpuServerLoader::GetSingleton()->Infer(&result, request);
+    if (!err.IsOk()) {
+      result.status = err;
+    }
+    callback(std::move(result));
+    return tc::Error::Success;
+  }
+
+  tc::Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key,
+      size_t byte_size) override
+  {
+    return TpuServerLoader::GetSingleton()->RegisterSystemSharedMemory(
+        name, key, byte_size);
+  }
+  tc::Error UnregisterSystemSharedMemory(const std::string& name) override
+  {
+    return TpuServerLoader::GetSingleton()->UnregisterSystemSharedMemory(
+        name);
+  }
+  tc::Error RegisterXlaSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      size_t byte_size, int device_ordinal) override
+  {
+    return TpuServerLoader::GetSingleton()->RegisterXlaSharedMemory(
+        name, raw_handle, byte_size, device_ordinal);
+  }
+  tc::Error UnregisterXlaSharedMemory(const std::string& name) override
+  {
+    return TpuServerLoader::GetSingleton()->UnregisterXlaSharedMemory(
+        name);
+  }
+};
+#endif  // PA_ENABLE_INPROC
+
 tc::Error
 ClientBackendFactory::Create(
     std::shared_ptr<ClientBackend>* backend,
@@ -488,6 +590,14 @@ ClientBackendFactory::Create(
       return TritonHttpBackend::Create(backend, config);
     case BackendKind::TRITON_GRPC:
       return TritonGrpcBackend::Create(backend, config);
+    case BackendKind::IN_PROCESS:
+#ifdef PA_ENABLE_INPROC
+      return InProcessBackend::Create(backend, config);
+#else
+      return tc::Error(
+          "in-process backend not built (libpython development files "
+          "were unavailable at build time)");
+#endif
     case BackendKind::MOCK:
       return tc::Error(
           "mock backend is constructed directly in tests");

@@ -130,6 +130,10 @@ struct BackendFactoryConfig {
   std::string url = "localhost:8000";
   bool verbose = false;
   int concurrency = 16;  // async worker threads for the http backend
+  // IN_PROCESS mode (tpuserver embedded via CPython; role of reference
+  // --triton-server-directory for the C-API backend)
+  std::string server_src;
+  bool inproc_vision = false;
 };
 
 class ClientBackendFactory {

@@ -44,6 +44,8 @@ enum LongOptIds {
   OPT_METRICS_INTERVAL,
   OPT_VERBOSE_CSV,
   OPT_ENABLE_MPI,
+  OPT_SERVER_SRC,
+  OPT_SERVER_ZOO,
 };
 
 const struct option kLongOptions[] = {
@@ -83,6 +85,8 @@ const struct option kLongOptions[] = {
     {"random-seed", required_argument, nullptr, OPT_SEED},
     {"num-threads", required_argument, nullptr, OPT_NUM_THREADS},
     {"service-kind", required_argument, nullptr, OPT_SERVICE_KIND},
+    {"server-src", required_argument, nullptr, OPT_SERVER_SRC},
+    {"server-zoo", required_argument, nullptr, OPT_SERVER_ZOO},
     {"protocol", required_argument, nullptr, 'i'},
     {"concurrency", required_argument, nullptr, 'c'},
     {"request-rate", required_argument, nullptr, 2000},
@@ -160,7 +164,10 @@ CLParser::Usage()
       "  -x/--model-version <ver>        model version\n"
       "  -u/--url <host:port>            server url (default "
       "localhost:8000)\n"
-      "  --service-kind <kind>           triton_http (default)\n"
+      "  --service-kind <kind>           triton_http (default) | triton_grpc |\n"
+      "                                  tpuserver_inproc (in-process, no network)\n"
+      "  --server-src <path>             tpuserver python tree for tpuserver_inproc\n"
+      "  --server-zoo <set>              default | vision (tpuserver_inproc models)\n"
       "  -v/--verbose                    verbose output\n"
       "  -a/--async                      async request issuance\n"
       "  -b/--batch-size <n>             batch size (default 1)\n"
@@ -435,8 +442,27 @@ CLParser::Parse(
         if (strcmp(optarg, "triton_http") == 0 ||
             strcmp(optarg, "triton") == 0) {
           params->kind = BackendKind::TRITON_HTTP;
+        } else if (strcmp(optarg, "triton_grpc") == 0) {
+          params->kind = BackendKind::TRITON_GRPC;
+        } else if (
+            strcmp(optarg, "tpuserver_inproc") == 0 ||
+            strcmp(optarg, "triton_c_api") == 0) {
+          // in-process serving (role of reference triton_c_api mode)
+          params->kind = BackendKind::IN_PROCESS;
         } else {
           *error = std::string("unsupported service kind ") + optarg;
+          return false;
+        }
+        break;
+      case OPT_SERVER_SRC:
+        params->server_src = optarg;
+        break;
+      case OPT_SERVER_ZOO:
+        if (strcmp(optarg, "default") == 0 ||
+            strcmp(optarg, "vision") == 0) {
+          params->server_zoo = optarg;
+        } else {
+          *error = std::string("unsupported server zoo ") + optarg;
           return false;
         }
         break;

@@ -21,6 +21,10 @@ struct PerfAnalyzerParameters {
   BackendKind kind = BackendKind::TRITON_HTTP;
   bool verbose = false;
   bool async = false;
+  // in-process mode: path of the tpuserver python tree (role of
+  // reference --triton-server-directory)
+  std::string server_src;
+  std::string server_zoo = "default";  // model set for in-process mode
   int batch_size = 1;
   bool zero_input = false;
   std::string input_data_path;  // JSON file of request payloads

@@ -127,12 +127,19 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
   // (reference --warmup-request-count)
   if (config_.warmup_request_count > 0) {
     size_t warmed = 0;
-    uint64_t warmup_start = NowNs();
+    // stall-based deadline, reset on progress: the first request may sit
+    // in a long server-side compile (XLA warms per shape), which must
+    // not push measurement windows into the compile
+    uint64_t last_progress = NowNs();
     manager_->GetAndResetNumSentRequests();
     while (warmed < config_.warmup_request_count && !early_exit.load() &&
-           (NowNs() - warmup_start) < 60ull * 1000000000ull) {
+           (NowNs() - last_progress) < 300ull * 1000000000ull) {
       std::this_thread::sleep_for(std::chrono::milliseconds(10));
-      warmed += manager_->GetAndResetNumSentRequests();
+      size_t progressed = manager_->GetAndResetNumSentRequests();
+      if (progressed > 0) {
+        warmed += progressed;
+        last_progress = NowNs();
+      }
       tc::Error err = manager_->CheckHealth();
       if (!err.IsOk()) {
         return err;

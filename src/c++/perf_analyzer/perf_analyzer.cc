@@ -32,6 +32,8 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
     config.kind = params_.kind;
     config.url = params_.url;
     config.verbose = params_.verbose;
+    config.server_src = params_.server_src;
+    config.inproc_vision = (params_.server_zoo == "vision");
     tc::Error err = ClientBackendFactory::Create(&backend_, config);
     if (!err.IsOk()) {
       return err;

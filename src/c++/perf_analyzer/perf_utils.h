@@ -10,7 +10,7 @@
 
 namespace pa {
 
-enum class BackendKind { TRITON_HTTP, TRITON_GRPC, MOCK };
+enum class BackendKind { TRITON_HTTP, TRITON_GRPC, IN_PROCESS, MOCK };
 enum class SharedMemoryType { NONE, SYSTEM, XLA };
 enum class Distribution { POISSON, CONSTANT };
 

@@ -1,0 +1,482 @@
+// CPython-embedding implementation of the in-process backend (role of
+// reference triton_loader.cc: dlopen + symbol binding + in-process
+// serve).  All Python access goes through a JSON+bytes bridge module so
+// the C++ side needs no numpy/jax API knowledge.
+
+#include "tpuserver_loader.h"
+
+#include <Python.h>
+
+#include <mutex>
+
+#include "tjson.h"
+#include <sstream>
+
+namespace pa {
+
+namespace {
+
+// Bridge functions injected into the embedded interpreter.  The C++ side
+// only ever passes/receives str and bytes objects.
+const char kBridgeSource[] = R"PYBRIDGE(
+import json
+
+import numpy as np
+
+
+_core = None
+
+
+def _pa_setup(include_vision):
+    global _core
+    from tpuserver.core import InferenceServer
+    from tpuserver.models import default_models, serving_models
+
+    models = default_models()
+    if include_vision:
+        models += serving_models(include_bert=False, include_llama=False)
+    _core = InferenceServer(models)
+    return "ok"
+
+
+def _pa_model_metadata(name, version):
+    return json.dumps(_core.model_metadata(name, version))
+
+
+def _pa_model_config(name, version):
+    return json.dumps(_core.model_config(name, version))
+
+
+def _pa_model_statistics(name):
+    return json.dumps(_core.model_statistics(name))
+
+
+def _pa_register_system_shm_sized(name, key, byte_size):
+    _core.register_system_shm(name, key, 0, int(byte_size))
+    return "ok"
+
+
+def _pa_unregister_system_shm(name):
+    _core.unregister_system_shm(name)
+    return "ok"
+
+
+def _pa_register_xla_shm_sized(name, raw_handle, byte_size, device_ordinal):
+    _core.register_xla_shm(
+        name, raw_handle, int(device_ordinal), int(byte_size))
+    return "ok"
+
+
+def _pa_unregister_xla_shm(name):
+    _core.unregister_xla_shm(name)
+    return "ok"
+
+
+def _pa_infer(meta_json, raw_blobs):
+    from tpuserver.core import InferRequest, RequestedOutput
+    from tritonclient.utils import (
+        deserialize_bytes_tensor,
+        serialize_byte_tensor,
+        triton_to_np_dtype,
+    )
+
+    meta = json.loads(meta_json)
+    inputs = {}
+    cursor = 0
+    for t in meta["inputs"]:
+        if t.get("shm_region"):
+            inputs[t["name"]] = _core.read_shm_input(
+                t["shm_region"], t.get("shm_byte_size", 0),
+                t.get("shm_offset", 0), t["datatype"], t["shape"],
+            )
+        else:
+            raw = raw_blobs[cursor]
+            cursor += 1
+            if t["datatype"] == "BYTES":
+                arr = deserialize_bytes_tensor(raw).reshape(t["shape"])
+            else:
+                arr = np.frombuffer(
+                    raw, dtype=triton_to_np_dtype(t["datatype"])
+                ).reshape(t["shape"])
+            inputs[t["name"]] = arr
+    requested = None
+    if meta.get("outputs"):
+        requested = [
+            RequestedOutput(
+                o["name"],
+                shm_region=o.get("shm_region"),
+                shm_byte_size=o.get("shm_byte_size", 0),
+                shm_offset=o.get("shm_offset", 0),
+            )
+            for o in meta["outputs"]
+        ]
+    parameters = dict(meta.get("parameters", {}))
+    request = InferRequest(
+        meta["model_name"], meta.get("model_version", ""),
+        meta.get("id", ""), inputs, requested, parameters,
+    )
+    resp = _core.infer(request)
+    out_meta = []
+    blobs = []
+    for spec, array, delivery in resp.outputs:
+        entry = {
+            "name": spec["name"],
+            "datatype": spec["datatype"],
+            "shape": spec["shape"],
+        }
+        if array is None:  # delivered via shared memory
+            entry["shm"] = True
+        else:
+            if spec["datatype"] == "BYTES":
+                serialized = serialize_byte_tensor(
+                    np.asarray(array, dtype=object)
+                )
+                blobs.append(
+                    serialized.item() if serialized.size > 0 else b""
+                )
+            else:
+                blobs.append(np.ascontiguousarray(array).tobytes())
+        out_meta.append(entry)
+    return json.dumps({"id": resp.id, "outputs": out_meta}), blobs
+)PYBRIDGE";
+
+std::mutex init_mu;
+PyObject* bridge_dict = nullptr;  // borrowed module dict, lives forever
+
+std::string
+PyErrToString()
+{
+  PyObject *type, *value, *traceback;
+  PyErr_Fetch(&type, &value, &traceback);
+  PyErr_NormalizeException(&type, &value, &traceback);
+  std::string message = "python error";
+  if (value != nullptr) {
+    PyObject* str = PyObject_Str(value);
+    if (str != nullptr) {
+      const char* utf8 = PyUnicode_AsUTF8(str);
+      if (utf8 != nullptr) {
+        message = utf8;
+      }
+      Py_DECREF(str);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(traceback);
+  return message;
+}
+
+// Call a bridge function with already-built argument tuple; returns the
+// result object or an Error (GIL must be held).
+tc::Error
+CallBridge(const char* fn_name, PyObject* args, PyObject** out)
+{
+  PyObject* fn = PyDict_GetItemString(bridge_dict, fn_name);  // borrowed
+  if (fn == nullptr) {
+    return tc::Error(std::string("bridge function missing: ") + fn_name);
+  }
+  PyObject* result = PyObject_CallObject(fn, args);
+  if (result == nullptr) {
+    return tc::Error(
+        std::string(fn_name) + " failed: " + PyErrToString());
+  }
+  *out = result;
+  return tc::Error::Success;
+}
+
+// string-in/string-out bridge call helper
+tc::Error
+CallBridgeStr(
+    const char* fn_name, const std::vector<std::string>& args,
+    std::string* out)
+{
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* tuple = PyTuple_New(args.size());
+  for (size_t i = 0; i < args.size(); ++i) {
+    PyTuple_SetItem(tuple, i, PyUnicode_FromString(args[i].c_str()));
+  }
+  PyObject* result = nullptr;
+  tc::Error err = CallBridge(fn_name, tuple, &result);
+  Py_DECREF(tuple);
+  if (err.IsOk()) {
+    const char* utf8 = PyUnicode_AsUTF8(result);
+    if (out != nullptr && utf8 != nullptr) {
+      *out = utf8;
+    }
+    Py_DECREF(result);
+  }
+  PyGILState_Release(gil);
+  return err;
+}
+
+// quoted+escaped JSON string literal (shared tjson escaper)
+std::string
+Quoted(const std::string& in)
+{
+  std::string out;
+  tc::json::EscapeTo(in, &out);
+  return out;
+}
+
+}  // namespace
+
+TpuServerLoader*
+TpuServerLoader::GetSingleton()
+{
+  static TpuServerLoader loader;
+  return &loader;
+}
+
+tc::Error
+TpuServerLoader::Create(const Options& options)
+{
+  std::lock_guard<std::mutex> lk(init_mu);
+  TpuServerLoader* loader = GetSingleton();
+  if (loader->initialized_) {
+    return tc::Error::Success;
+  }
+  tc::Error err = loader->InitPython(options);
+  if (err.IsOk()) {
+    loader->initialized_ = true;
+  }
+  return err;
+}
+
+tc::Error
+TpuServerLoader::InitPython(const Options& options)
+{
+  Py_InitializeEx(0);
+
+  // sys.path: prepend the tpuserver/tritonclient source tree.  Also
+  // re-assert JAX_PLATFORMS from the process environment: interpreter
+  // startup hooks (site) may override it, and the operator's choice of
+  // platform must win inside the embedded runtime too.
+  {
+    std::ostringstream src;
+    src << "import sys\n"
+        << "sys.path.insert(0, " << Quoted(options.server_src)
+        << ")\n";
+    const char* jax_platforms = getenv("JAX_PLATFORMS");
+    if (jax_platforms != nullptr) {
+      src << "import os\n"
+          << "os.environ[\"JAX_PLATFORMS\"] = "
+          << Quoted(jax_platforms) << "\n";
+    }
+    if (PyRun_SimpleString(src.str().c_str()) != 0) {
+      return tc::Error("unable to set up sys.path for tpuserver");
+    }
+  }
+
+  PyObject* module = PyImport_AddModule("__pa_bridge__");  // borrowed
+  if (module == nullptr) {
+    return tc::Error("unable to create bridge module");
+  }
+  bridge_dict = PyModule_GetDict(module);  // borrowed
+  // builtins so the bridge source can import/def
+  PyDict_SetItemString(
+      bridge_dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* run = PyRun_String(
+      kBridgeSource, Py_file_input, bridge_dict, bridge_dict);
+  if (run == nullptr) {
+    return tc::Error("bridge source failed: " + PyErrToString());
+  }
+  Py_DECREF(run);
+
+  PyObject* args = PyTuple_New(1);
+  PyTuple_SetItem(args, 0, PyBool_FromLong(options.include_vision));
+  PyObject* result = nullptr;
+  tc::Error err = CallBridge("_pa_setup", args, &result);
+  Py_DECREF(args);
+  if (!err.IsOk()) {
+    return err;
+  }
+  Py_DECREF(result);
+  if (options.verbose) {
+    std::ostringstream msg;
+    msg << "print('tpuserver in-process core up (src=" << options.server_src
+        << ")')";
+    PyRun_SimpleString(msg.str().c_str());
+  }
+  // release the GIL so worker threads can take it per call
+  PyEval_SaveThread();
+  return tc::Error::Success;
+}
+
+tc::Error
+TpuServerLoader::ServerReady(bool* ready)
+{
+  *ready = initialized_;
+  return tc::Error::Success;
+}
+
+tc::Error
+TpuServerLoader::ModelMetadata(
+    std::string* metadata_json, const std::string& model_name,
+    const std::string& model_version)
+{
+  return CallBridgeStr(
+      "_pa_model_metadata", {model_name, model_version}, metadata_json);
+}
+
+tc::Error
+TpuServerLoader::ModelConfig(
+    std::string* config_json, const std::string& model_name,
+    const std::string& model_version)
+{
+  return CallBridgeStr(
+      "_pa_model_config", {model_name, model_version}, config_json);
+}
+
+tc::Error
+TpuServerLoader::ModelStatistics(
+    std::string* stats_json, const std::string& model_name)
+{
+  return CallBridgeStr("_pa_model_statistics", {model_name}, stats_json);
+}
+
+tc::Error
+TpuServerLoader::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size)
+{
+  return CallBridgeStr(
+      "_pa_register_system_shm_sized",
+      {name, key, std::to_string(byte_size)}, nullptr);
+}
+
+tc::Error
+TpuServerLoader::UnregisterSystemSharedMemory(const std::string& name)
+{
+  return CallBridgeStr("_pa_unregister_system_shm", {name}, nullptr);
+}
+
+tc::Error
+TpuServerLoader::RegisterXlaSharedMemory(
+    const std::string& name, const std::string& raw_handle,
+    size_t byte_size, int device_ordinal)
+{
+  return CallBridgeStr(
+      "_pa_register_xla_shm_sized",
+      {name, raw_handle, std::to_string(byte_size),
+       std::to_string(device_ordinal)},
+      nullptr);
+}
+
+tc::Error
+TpuServerLoader::UnregisterXlaSharedMemory(const std::string& name)
+{
+  return CallBridgeStr("_pa_unregister_xla_shm", {name}, nullptr);
+}
+
+tc::Error
+TpuServerLoader::Infer(
+    BackendInferResult* result, const BackendInferRequest& request)
+{
+  // request descriptor JSON
+  std::ostringstream meta;
+  meta << "{\"model_name\": " << Quoted(request.model_name)
+       << ", \"model_version\": " << Quoted(request.model_version)
+       << ", \"id\": " << Quoted(request.request_id);
+  if (request.sequence_id != 0) {
+    meta << ", \"parameters\": {\"sequence_id\": " << request.sequence_id
+         << ", \"sequence_start\": "
+         << (request.sequence_start ? "true" : "false")
+         << ", \"sequence_end\": "
+         << (request.sequence_end ? "true" : "false") << "}";
+  }
+  meta << ", \"inputs\": [";
+  bool first = true;
+  for (const auto& input : request.inputs) {
+    if (!first) {
+      meta << ", ";
+    }
+    first = false;
+    meta << "{\"name\": " << Quoted(input.name)
+         << ", \"datatype\": " << Quoted(input.datatype) << ", \"shape\": [";
+    for (size_t i = 0; i < input.shape.size(); ++i) {
+      meta << (i ? ", " : "") << input.shape[i];
+    }
+    meta << "]";
+    if (!input.shm_region.empty()) {
+      meta << ", \"shm_region\": " << Quoted(input.shm_region)
+           << ", \"shm_byte_size\": " << input.shm_byte_size
+           << ", \"shm_offset\": " << input.shm_offset;
+    }
+    meta << "}";
+  }
+  meta << "], \"outputs\": [";
+  first = true;
+  for (const auto& name : request.requested_outputs) {
+    if (!first) {
+      meta << ", ";
+    }
+    first = false;
+    meta << "{\"name\": " << Quoted(name) << "}";
+  }
+  meta << "]}";
+
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* blobs = PyList_New(0);
+  for (const auto& input : request.inputs) {
+    if (input.shm_region.empty()) {
+      PyObject* bytes = PyBytes_FromStringAndSize(
+          (const char*)input.data.data(), input.data.size());
+      PyList_Append(blobs, bytes);
+      Py_DECREF(bytes);
+    }
+  }
+  PyObject* args = PyTuple_New(2);
+  PyTuple_SetItem(args, 0, PyUnicode_FromString(meta.str().c_str()));
+  PyTuple_SetItem(args, 1, blobs);  // steals blobs ref
+  PyObject* py_result = nullptr;
+  tc::Error err = CallBridge("_pa_infer", args, &py_result);
+  Py_DECREF(args);
+  if (!err.IsOk()) {
+    PyGILState_Release(gil);
+    result->status = err;
+    return err;
+  }
+
+  // (json_str, [bytes, ...])
+  PyObject* meta_obj = PyTuple_GetItem(py_result, 0);   // borrowed
+  PyObject* blobs_out = PyTuple_GetItem(py_result, 1);  // borrowed
+  const char* meta_utf8 = PyUnicode_AsUTF8(meta_obj);
+  std::string out_meta = meta_utf8 ? meta_utf8 : "{}";
+
+  // parse the descriptor; blobs align with non-shm outputs in order
+  result->outputs.clear();
+  result->request_id = request.request_id;
+  result->status = tc::Error::Success;
+  std::string parse_error;
+  tc::json::ValuePtr doc = tc::json::Parse(out_meta, &parse_error);
+  if (doc == nullptr) {
+    err = tc::Error("bad infer response descriptor: " + parse_error);
+    Py_DECREF(py_result);
+    PyGILState_Release(gil);
+    result->status = err;
+    return err;
+  }
+  size_t blob_index = 0;
+  tc::json::ValuePtr outputs = doc->Get("outputs");
+  if (outputs != nullptr) {
+    for (const auto& entry : outputs->Elements()) {
+      const std::string& name = entry->Get("name")->AsString();
+      bool is_shm =
+          entry->Has("shm") && entry->Get("shm")->AsBool();
+      std::vector<uint8_t> data;
+      if (!is_shm && blob_index < (size_t)PyList_Size(blobs_out)) {
+        PyObject* blob = PyList_GetItem(blobs_out, blob_index++);
+        char* buf;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(blob, &buf, &len) == 0) {
+          data.assign((uint8_t*)buf, (uint8_t*)buf + len);
+        }
+      }
+      result->outputs[name] = std::move(data);
+    }
+  }
+  Py_DECREF(py_result);
+  PyGILState_Release(gil);
+  return tc::Error::Success;
+}
+
+}  // namespace pa

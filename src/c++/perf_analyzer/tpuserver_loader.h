@@ -1,0 +1,72 @@
+// In-process serving backend: embeds the tpuserver Python runtime in the
+// perf_analyzer process so inference is measured without any network or
+// IPC — the TPU-native role of the reference's "triton_c_api" mode,
+// which dlopens libtritonserver.so and binds ~40 TRITONSERVER_* symbols
+// (reference client_backend/triton_c_api/triton_loader.h:85-115).  Here
+// the embedded runtime is CPython (libpython) hosting
+// tpuserver.core.InferenceServer, and the binding surface is a small
+// JSON+bytes bridge (see kBridgeSource in tpuserver_loader.cc).
+//
+// Like the reference's C-API mode, calls are serialized (the reference
+// supports no async mode either — docs/benchmarking.md:92-98); here the
+// GIL is the serializer.
+
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client_backend.h"
+
+namespace pa {
+
+class TpuServerLoader {
+ public:
+  struct Options {
+    // directory holding the tpuserver/tritonclient packages (the repo's
+    // src/python); role of the reference's --triton-server-directory
+    std::string server_src;
+    bool include_vision = false;
+    bool verbose = false;
+  };
+
+  // Initialize the embedded interpreter + server core (idempotent; the
+  // process can host only one interpreter, mirroring the reference's
+  // single TritonLoader singleton, triton_loader.cc:230-235).
+  static tc::Error Create(const Options& options);
+  static TpuServerLoader* GetSingleton();
+
+  bool Initialized() const { return initialized_; }
+
+  tc::Error ServerReady(bool* ready);
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version);
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version);
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name);
+
+  // request/response carried as a JSON descriptor plus aligned raw
+  // buffers (non-shm inputs), matching the backend-neutral types.
+  tc::Error Infer(
+      BackendInferResult* result, const BackendInferRequest& request);
+
+  tc::Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size);
+  tc::Error UnregisterSystemSharedMemory(const std::string& name);
+  tc::Error RegisterXlaSharedMemory(
+      const std::string& name, const std::string& raw_handle,
+      size_t byte_size, int device_ordinal);
+  tc::Error UnregisterXlaSharedMemory(const std::string& name);
+
+ private:
+  TpuServerLoader() = default;
+  tc::Error InitPython(const Options& options);
+
+  bool initialized_ = false;
+};
+
+}  // namespace pa

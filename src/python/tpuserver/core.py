@@ -326,8 +326,20 @@ class _XlaShmRegion:
         self.handle.write_bytes(offset, data)
 
     def get_device_array(self, offset, datatype, shape):
-        """jax.Array view of the region contents (zero-copy in-process)."""
-        return self.handle.as_jax(offset, datatype, shape)
+        """Device-resident ``jax.Array`` parked at ``offset``, or None.
+
+        Only live in-process segments qualify (the zero-copy fast path).
+        Cross-process attaches hold data in the host staging window; for
+        those, returning None lets the caller read host bytes — a jitted
+        model will device_put once itself, and numpy models skip the
+        device round-trip entirely (eager device_put here would cost two
+        transfers per request)."""
+        seg = self.handle.get_jax_segment(offset)
+        if seg is None:
+            return None
+        if list(seg.shape) != list(shape):
+            seg = seg.reshape(shape)
+        return seg
 
     def put_device_array(self, offset, array):
         return self.handle.put_jax(offset, array)

@@ -156,27 +156,6 @@ class XlaShmHandle:
         root._segments.pop(offset, None)
         root._write_host(offset, data)
 
-    def as_jax(self, offset, datatype, shape):
-        """jax.Array at ``offset``; device-resident segments return as-is."""
-        root = self._root()
-        seg = root._segments.get(offset)
-        if seg is not None:
-            array = seg[0]
-            if list(array.shape) != list(shape):
-                array = array.reshape(shape)
-            return array
-        if root._host is None:
-            return None
-        import jax
-
-        np_dtype = triton_to_np_dtype(datatype)
-        if np_dtype is None or datatype == "BYTES":
-            return None
-        count = int(np.prod(shape)) if len(shape) else 1
-        raw = root._read_host(offset, count * np.dtype(np_dtype).itemsize)
-        host_arr = np.frombuffer(raw, dtype=np_dtype).reshape(shape)
-        return jax.device_put(host_arr, _device(root.device_ordinal))
-
     def get_jax_segment(self, offset):
         """Public accessor: the device-resident ``jax.Array`` parked at
         ``offset``, or None when the slot holds no live segment."""

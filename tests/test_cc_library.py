@@ -143,3 +143,33 @@ def test_cc_reuse_infer_objects(cc_build, zoo_servers):
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "reuse infer objects OK" in result.stdout
+
+
+# -- in-process backend (embedded tpuserver; triton_c_api analogue) ----------
+
+@pytest.mark.parametrize("shm", ["none", "system", "xla"])
+def test_perf_analyzer_inproc(cc_build, shm):
+    """perf_analyzer serves through the embedded Python core: no sockets,
+    no separate server process (reference triton_c_api mode,
+    triton_loader.h:85-115)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    cmd = [
+        os.path.join(cc_build, "perf_analyzer"),
+        "--service-kind", "tpuserver_inproc",
+        "--server-src", os.path.join(REPO, "src", "python"),
+        "-m", "simple", "-p", "400", "--max-trials", "4",
+        "--stability-percentage", "50", "--warmup-request-count", "20",
+    ]
+    if shm != "none":
+        cmd += ["--shared-memory", shm]
+    result = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput:" in result.stdout
+    # in-process serving should be far faster than any socket transport
+    for line in result.stdout.splitlines():
+        if "Throughput:" in line:
+            value = float(line.split("Throughput:")[1].split()[0])
+            assert value > 200, line  # well above any socket transport floor
