@@ -478,6 +478,13 @@ CLParser::Parse(
   if (!params->url_specified && params->kind == BackendKind::TRITON_GRPC) {
     params->url = "localhost:8001";
   }
+  if (params->kind == BackendKind::IN_PROCESS &&
+      params->server_src.empty()) {
+    *error =
+        "--service-kind tpuserver_inproc requires --server-src "
+        "(path of the tpuserver python tree)";
+    return false;
+  }
   if (params->request_rate_start > 0 && params->concurrency_start > 1) {
     *error =
         "cannot use concurrency and request rate modes together";

@@ -7,6 +7,7 @@
 
 #include <Python.h>
 
+#include <iostream>
 #include <mutex>
 
 #include "tjson.h"
@@ -193,7 +194,15 @@ CallBridgeStr(
   PyGILState_STATE gil = PyGILState_Ensure();
   PyObject* tuple = PyTuple_New(args.size());
   for (size_t i = 0; i < args.size(); ++i) {
-    PyTuple_SetItem(tuple, i, PyUnicode_FromString(args[i].c_str()));
+    PyObject* str = PyUnicode_FromString(args[i].c_str());
+    if (str == nullptr) {  // e.g. argv bytes that are not valid UTF-8
+      PyErr_Clear();
+      Py_DECREF(tuple);
+      PyGILState_Release(gil);
+      return tc::Error(
+          std::string(fn_name) + ": argument is not valid UTF-8");
+    }
+    PyTuple_SetItem(tuple, i, str);
   }
   PyObject* result = nullptr;
   tc::Error err = CallBridge(fn_name, tuple, &result);
@@ -292,10 +301,8 @@ TpuServerLoader::InitPython(const Options& options)
   }
   Py_DECREF(result);
   if (options.verbose) {
-    std::ostringstream msg;
-    msg << "print('tpuserver in-process core up (src=" << options.server_src
-        << ")')";
-    PyRun_SimpleString(msg.str().c_str());
+    std::cout << "tpuserver in-process core up (src=" << options.server_src
+              << ")" << std::endl;
   }
   // release the GIL so worker threads can take it per call
   PyEval_SaveThread();

@@ -1,0 +1,89 @@
+// Minimal Go gRPC client for the KServe v2 protocol (role of reference
+// src/grpc_generated/go/grpc_simple_client.go).  Generate the stubs from
+// the repo's proto files first:
+//
+//	protoc --go_out=. --go-grpc_out=. -I ../../../proto \
+//	    grpc_service.proto model_config.proto
+//
+// then: go mod init client && go mod tidy && go run grpc_simple_client.go
+package main
+
+import (
+	"bytes"
+	"context"
+	"encoding/binary"
+	"flag"
+	"log"
+	"time"
+
+	"google.golang.org/grpc"
+	"google.golang.org/grpc/credentials/insecure"
+
+	pb "client/inference" // generated from proto/grpc_service.proto
+)
+
+func int32sToLE(values []int32) []byte {
+	buf := new(bytes.Buffer)
+	for _, v := range values {
+		binary.Write(buf, binary.LittleEndian, v)
+	}
+	return buf.Bytes()
+}
+
+func leToInt32s(raw []byte) []int32 {
+	out := make([]int32, len(raw)/4)
+	for i := range out {
+		out[i] = int32(binary.LittleEndian.Uint32(raw[i*4:]))
+	}
+	return out
+}
+
+func main() {
+	url := flag.String("u", "localhost:8001", "server host:port")
+	flag.Parse()
+
+	conn, err := grpc.NewClient(
+		*url, grpc.WithTransportCredentials(insecure.NewCredentials()))
+	if err != nil {
+		log.Fatalf("connect: %v", err)
+	}
+	defer conn.Close()
+	client := pb.NewGRPCInferenceServiceClient(conn)
+	ctx, cancel := context.WithTimeout(context.Background(), 30*time.Second)
+	defer cancel()
+
+	live, err := client.ServerLive(ctx, &pb.ServerLiveRequest{})
+	if err != nil || !live.Live {
+		log.Fatalf("server not live: %v", err)
+	}
+
+	input0 := make([]int32, 16)
+	input1 := make([]int32, 16)
+	for i := range input0 {
+		input0[i] = int32(i)
+		input1[i] = 1
+	}
+	request := &pb.ModelInferRequest{
+		ModelName: "simple",
+		Inputs: []*pb.ModelInferRequest_InferInputTensor{
+			{Name: "INPUT0", Datatype: "INT32", Shape: []int64{1, 16}},
+			{Name: "INPUT1", Datatype: "INT32", Shape: []int64{1, 16}},
+		},
+		RawInputContents: [][]byte{
+			int32sToLE(input0), int32sToLE(input1),
+		},
+	}
+	response, err := client.ModelInfer(ctx, request)
+	if err != nil {
+		log.Fatalf("infer: %v", err)
+	}
+	sums := leToInt32s(response.RawOutputContents[0])
+	diffs := leToInt32s(response.RawOutputContents[1])
+	for i := range input0 {
+		if sums[i] != input0[i]+input1[i] ||
+			diffs[i] != input0[i]-input1[i] {
+			log.Fatalf("wrong result at %d", i)
+		}
+	}
+	log.Println("PASS: go infer")
+}

@@ -1,0 +1,59 @@
+// Minimal Scala gRPC client over the same generated Java stubs (role of
+// reference src/grpc_generated/java/.../SimpleClient.scala).  Build the
+// stubs as described in SimpleJavaClient.java, add scala-library.
+
+import com.google.protobuf.ByteString
+import inference.GRPCInferenceServiceGrpc
+import inference.GrpcService.{ModelInferRequest, ServerLiveRequest}
+import io.grpc.ManagedChannelBuilder
+import java.nio.{ByteBuffer, ByteOrder}
+
+object SimpleClient {
+  def main(args: Array[String]): Unit = {
+    val target = if (args.nonEmpty) args(0) else "localhost:8001"
+    val channel =
+      ManagedChannelBuilder.forTarget(target).usePlaintext().build()
+    val stub = GRPCInferenceServiceGrpc.newBlockingStub(channel)
+
+    require(
+      stub.serverLive(ServerLiveRequest.getDefaultInstance).getLive,
+      "server not live")
+
+    val input0 = (0 until 16).map(_.toInt)
+    val input1 = Seq.fill(16)(1)
+    def pack(values: Seq[Int]): ByteString = {
+      val buf =
+        ByteBuffer.allocate(values.size * 4).order(ByteOrder.LITTLE_ENDIAN)
+      values.foreach(buf.putInt)
+      ByteString.copyFrom(buf.array())
+    }
+
+    def tensor(name: String) =
+      ModelInferRequest.InferInputTensor
+        .newBuilder()
+        .setName(name)
+        .setDatatype("INT32")
+        .addShape(1)
+        .addShape(16)
+
+    val request = ModelInferRequest
+      .newBuilder()
+      .setModelName("simple")
+      .addInputs(tensor("INPUT0"))
+      .addInputs(tensor("INPUT1"))
+      .addRawInputContents(pack(input0))
+      .addRawInputContents(pack(input1))
+      .build()
+
+    val response = stub.modelInfer(request)
+    val sums = response
+      .getRawOutputContents(0)
+      .asReadOnlyByteBuffer()
+      .order(ByteOrder.LITTLE_ENDIAN)
+    (0 until 16).foreach { i =>
+      require(sums.getInt() == input0(i) + input1(i), s"wrong sum at $i")
+    }
+    println("PASS: scala grpc infer")
+    channel.shutdownNow()
+  }
+}

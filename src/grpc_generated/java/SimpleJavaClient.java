@@ -1,0 +1,85 @@
+// Minimal Java gRPC client using stubs generated from the repo's proto
+// files (role of reference src/grpc_generated/java/SimpleJavaClient.java).
+//
+// Generate stubs with the protobuf-gradle-plugin or:
+//   protoc --java_out=. --grpc-java_out=. -I ../../../proto \
+//       grpc_service.proto model_config.proto
+// (needs protoc-gen-grpc-java), then compile against grpc-netty-shaded,
+// grpc-protobuf and grpc-stub.
+
+import inference.GRPCInferenceServiceGrpc;
+import inference.GrpcService.InferTensorContents;
+import inference.GrpcService.ModelInferRequest;
+import inference.GrpcService.ModelInferResponse;
+import inference.GrpcService.ServerLiveRequest;
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import com.google.protobuf.ByteString;
+
+public class SimpleJavaClient {
+  public static void main(String[] args) {
+    String target = args.length > 0 ? args[0] : "localhost:8001";
+    ManagedChannel channel =
+        ManagedChannelBuilder.forTarget(target).usePlaintext().build();
+    GRPCInferenceServiceGrpc.GRPCInferenceServiceBlockingStub stub =
+        GRPCInferenceServiceGrpc.newBlockingStub(channel);
+
+    boolean live =
+        stub.serverLive(ServerLiveRequest.getDefaultInstance()).getLive();
+    if (!live) {
+      System.err.println("server not live");
+      System.exit(1);
+    }
+
+    int[] input0 = new int[16];
+    int[] input1 = new int[16];
+    ByteBuffer raw0 =
+        ByteBuffer.allocate(64).order(ByteOrder.LITTLE_ENDIAN);
+    ByteBuffer raw1 =
+        ByteBuffer.allocate(64).order(ByteOrder.LITTLE_ENDIAN);
+    for (int i = 0; i < 16; i++) {
+      input0[i] = i;
+      input1[i] = 1;
+      raw0.putInt(input0[i]);
+      raw1.putInt(input1[i]);
+    }
+
+    ModelInferRequest request =
+        ModelInferRequest.newBuilder()
+            .setModelName("simple")
+            .addInputs(
+                ModelInferRequest.InferInputTensor.newBuilder()
+                    .setName("INPUT0")
+                    .setDatatype("INT32")
+                    .addShape(1)
+                    .addShape(16))
+            .addInputs(
+                ModelInferRequest.InferInputTensor.newBuilder()
+                    .setName("INPUT1")
+                    .setDatatype("INT32")
+                    .addShape(1)
+                    .addShape(16))
+            .addRawInputContents(ByteString.copyFrom(raw0.array()))
+            .addRawInputContents(ByteString.copyFrom(raw1.array()))
+            .build();
+
+    ModelInferResponse response = stub.modelInfer(request);
+    ByteBuffer sums =
+        response.getRawOutputContents(0).asReadOnlyByteBuffer()
+            .order(ByteOrder.LITTLE_ENDIAN);
+    ByteBuffer diffs =
+        response.getRawOutputContents(1).asReadOnlyByteBuffer()
+            .order(ByteOrder.LITTLE_ENDIAN);
+    for (int i = 0; i < 16; i++) {
+      if (sums.getInt() != input0[i] + input1[i]
+          || diffs.getInt() != input0[i] - input1[i]) {
+        System.err.println("wrong result at " + i);
+        System.exit(1);
+      }
+    }
+    System.out.println("PASS: java grpc infer");
+    channel.shutdownNow();
+  }
+}

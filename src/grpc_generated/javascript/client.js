@@ -1,0 +1,69 @@
+// Minimal Node.js gRPC client for the KServe v2 protocol using dynamic
+// proto loading (role of reference src/grpc_generated/javascript/
+// client.js:27-33).
+//
+//   npm install @grpc/grpc-js @grpc/proto-loader
+//   node client.js [host:port]
+
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+const path = require("path");
+
+const url = process.argv[2] || "localhost:8001";
+const PROTO_DIR = path.join(__dirname, "..", "..", "..", "proto");
+
+const definition = protoLoader.loadSync(
+  path.join(PROTO_DIR, "grpc_service.proto"),
+  { includeDirs: [PROTO_DIR], keepCase: true, longs: Number }
+);
+const inference = grpc.loadPackageDefinition(definition).inference;
+const client = new inference.GRPCInferenceService(
+  url, grpc.credentials.createInsecure()
+);
+
+function int32ToLE(values) {
+  const buf = Buffer.alloc(values.length * 4);
+  values.forEach((v, i) => buf.writeInt32LE(v, i * 4));
+  return buf;
+}
+
+function leToInt32(buf) {
+  const out = [];
+  for (let i = 0; i < buf.length; i += 4) {
+    out.push(buf.readInt32LE(i));
+  }
+  return out;
+}
+
+client.ServerLive({}, (err, response) => {
+  if (err || !response.live) {
+    console.error("server not live:", err);
+    process.exit(1);
+  }
+  const input0 = Array.from({ length: 16 }, (_, i) => i);
+  const input1 = Array.from({ length: 16 }, () => 1);
+  const request = {
+    model_name: "simple",
+    inputs: [
+      { name: "INPUT0", datatype: "INT32", shape: [1, 16] },
+      { name: "INPUT1", datatype: "INT32", shape: [1, 16] },
+    ],
+    raw_input_contents: [int32ToLE(input0), int32ToLE(input1)],
+  };
+  client.ModelInfer(request, (err, response) => {
+    if (err) {
+      console.error("infer failed:", err);
+      process.exit(1);
+    }
+    const sums = leToInt32(response.raw_output_contents[0]);
+    const diffs = leToInt32(response.raw_output_contents[1]);
+    for (let i = 0; i < 16; i++) {
+      if (sums[i] !== input0[i] + input1[i] ||
+          diffs[i] !== input0[i] - input1[i]) {
+        console.error("wrong result at", i);
+        process.exit(1);
+      }
+    }
+    console.log("PASS: js infer");
+  });
+});

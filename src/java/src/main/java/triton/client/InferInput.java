@@ -1,0 +1,125 @@
+// Input tensor descriptor + data for an inference request (role of
+// reference src/java/.../InferInput.java).
+package triton.client;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.List;
+
+public class InferInput {
+  private final String name;
+  private final long[] shape;
+  private final DataType datatype;
+  private byte[] data;            // little-endian raw tensor bytes
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferInput(String name, long[] shape, DataType datatype) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.datatype = datatype;
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public long[] getShape() {
+    return shape.clone();
+  }
+
+  public DataType getDatatype() {
+    return datatype;
+  }
+
+  byte[] getData() {
+    return data;
+  }
+
+  String getSharedMemoryRegion() {
+    return sharedMemoryRegion;
+  }
+
+  long getSharedMemoryByteSize() {
+    return sharedMemoryByteSize;
+  }
+
+  long getSharedMemoryOffset() {
+    return sharedMemoryOffset;
+  }
+
+  /** Raw little-endian tensor bytes (caller-controlled layout). */
+  public void setData(byte[] raw) {
+    this.data = raw;
+    this.sharedMemoryRegion = null;
+  }
+
+  public void setData(int[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : values) {
+      buf.putInt(v);
+    }
+    setData(buf.array());
+  }
+
+  public void setData(long[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (long v : values) {
+      buf.putLong(v);
+    }
+    setData(buf.array());
+  }
+
+  public void setData(float[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (float v : values) {
+      buf.putFloat(v);
+    }
+    setData(buf.array());
+  }
+
+  public void setData(double[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (double v : values) {
+      buf.putDouble(v);
+    }
+    setData(buf.array());
+  }
+
+  /** BYTES tensor: 4-byte little-endian length prefix per element. */
+  public void setData(List<byte[]> elements) {
+    int total = 0;
+    for (byte[] e : elements) {
+      total += 4 + e.length;
+    }
+    ByteBuffer buf =
+        ByteBuffer.allocate(total).order(ByteOrder.LITTLE_ENDIAN);
+    for (byte[] e : elements) {
+      buf.putInt(e.length);
+      buf.put(e);
+    }
+    setData(buf.array());
+  }
+
+  public void setStringData(List<String> strings) {
+    setData(
+        strings.stream()
+            .map(s -> s.getBytes(StandardCharsets.UTF_8))
+            .toList());
+  }
+
+  /** Reference the tensor in a registered shared-memory region instead of
+   * carrying bytes in the request body. */
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+    this.data = null;
+  }
+}

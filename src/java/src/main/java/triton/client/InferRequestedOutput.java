@@ -1,0 +1,56 @@
+// Requested-output descriptor (role of reference
+// src/java/.../InferRequestedOutput.java).
+package triton.client;
+
+public class InferRequestedOutput {
+  private final String name;
+  private final boolean binaryData;
+  private final int classCount;
+  private String sharedMemoryRegion;
+  private long sharedMemoryByteSize;
+  private long sharedMemoryOffset;
+
+  public InferRequestedOutput(String name) {
+    this(name, true, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData) {
+    this(name, binaryData, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData, int classCount) {
+    this.name = name;
+    this.binaryData = binaryData;
+    this.classCount = classCount;
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public boolean isBinaryData() {
+    return binaryData;
+  }
+
+  public int getClassCount() {
+    return classCount;
+  }
+
+  String getSharedMemoryRegion() {
+    return sharedMemoryRegion;
+  }
+
+  long getSharedMemoryByteSize() {
+    return sharedMemoryByteSize;
+  }
+
+  long getSharedMemoryOffset() {
+    return sharedMemoryOffset;
+  }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    this.sharedMemoryRegion = regionName;
+    this.sharedMemoryByteSize = byteSize;
+    this.sharedMemoryOffset = offset;
+  }
+}

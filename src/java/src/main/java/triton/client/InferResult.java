@@ -1,0 +1,150 @@
+// Inference response: JSON header + optional binary tensor tail, split
+// by Inference-Header-Content-Length (role of reference
+// src/java/.../InferResult.java).
+package triton.client;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferResult {
+  private final Map<String, Object> header;
+  private final Map<String, byte[]> binaryOutputs = new LinkedHashMap<>();
+
+  @SuppressWarnings("unchecked")
+  InferResult(byte[] body, Integer headerLength) throws InferenceException {
+    int jsonLength = headerLength != null ? headerLength : body.length;
+    String json =
+        new String(body, 0, jsonLength, StandardCharsets.UTF_8);
+    try {
+      header = Json.parseObject(json);
+    } catch (RuntimeException e) {
+      throw new InferenceException("malformed response JSON", e);
+    }
+    int cursor = jsonLength;
+    for (Map<String, Object> output : outputs()) {
+      Map<String, Object> params =
+          (Map<String, Object>) output.get("parameters");
+      if (params != null && params.get("binary_data_size") != null) {
+        int size = ((Number) params.get("binary_data_size")).intValue();
+        byte[] raw = new byte[size];
+        System.arraycopy(body, cursor, raw, 0, size);
+        cursor += size;
+        binaryOutputs.put((String) output.get("name"), raw);
+      }
+    }
+  }
+
+  @SuppressWarnings("unchecked")
+  private List<Map<String, Object>> outputs() {
+    Object outputs = header.get("outputs");
+    return outputs == null
+        ? List.of()
+        : (List<Map<String, Object>>) (List<?>) outputs;
+  }
+
+  public String getModelName() {
+    return (String) header.get("model_name");
+  }
+
+  public String getId() {
+    return (String) header.get("id");
+  }
+
+  @SuppressWarnings("unchecked")
+  private Map<String, Object> findOutput(String name)
+      throws InferenceException {
+    for (Map<String, Object> output : outputs()) {
+      if (name.equals(output.get("name"))) {
+        return output;
+      }
+    }
+    throw new InferenceException("no output named '" + name + "'");
+  }
+
+  public long[] getShape(String name) throws InferenceException {
+    List<Object> shape =
+        asList(findOutput(name).get("shape"));
+    long[] out = new long[shape.size()];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = ((Number) shape.get(i)).longValue();
+    }
+    return out;
+  }
+
+  public String getDatatype(String name) throws InferenceException {
+    return (String) findOutput(name).get("datatype");
+  }
+
+  @SuppressWarnings("unchecked")
+  private static List<Object> asList(Object value) {
+    return (List<Object>) value;
+  }
+
+  /** Raw little-endian bytes of a binary output. */
+  public byte[] getRawData(String name) throws InferenceException {
+    byte[] raw = binaryOutputs.get(name);
+    if (raw == null) {
+      throw new InferenceException(
+          "output '" + name + "' has no binary data");
+    }
+    return raw;
+  }
+
+  public int[] getOutputAsInt(String name) throws InferenceException {
+    Object data = findOutput(name).get("data");
+    if (data != null) { // JSON-delivered tensor
+      List<Object> values = asList(data);
+      int[] out = new int[values.size()];
+      for (int i = 0; i < out.length; i++) {
+        out[i] = ((Number) values.get(i)).intValue();
+      }
+      return out;
+    }
+    ByteBuffer buf =
+        ByteBuffer.wrap(getRawData(name)).order(ByteOrder.LITTLE_ENDIAN);
+    int[] out = new int[buf.remaining() / 4];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getInt();
+    }
+    return out;
+  }
+
+  public float[] getOutputAsFloat(String name) throws InferenceException {
+    Object data = findOutput(name).get("data");
+    if (data != null) {
+      List<Object> values = asList(data);
+      float[] out = new float[values.size()];
+      for (int i = 0; i < out.length; i++) {
+        out[i] = ((Number) values.get(i)).floatValue();
+      }
+      return out;
+    }
+    ByteBuffer buf =
+        ByteBuffer.wrap(getRawData(name)).order(ByteOrder.LITTLE_ENDIAN);
+    float[] out = new float[buf.remaining() / 4];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getFloat();
+    }
+    return out;
+  }
+
+  /** BYTES tensor elements (4-byte little-endian length prefix each). */
+  public List<byte[]> getOutputAsBytes(String name)
+      throws InferenceException {
+    ByteBuffer buf =
+        ByteBuffer.wrap(getRawData(name)).order(ByteOrder.LITTLE_ENDIAN);
+    List<byte[]> out = new ArrayList<>();
+    while (buf.remaining() >= 4) {
+      int length = buf.getInt();
+      byte[] element = new byte[length];
+      buf.get(element);
+      out.add(element);
+    }
+    return out;
+  }
+}

@@ -1,0 +1,13 @@
+// Error type thrown by the Java client (role of reference
+// src/java/.../InferenceException.java).
+package triton.client;
+
+public class InferenceException extends Exception {
+  public InferenceException(String message) {
+    super(message);
+  }
+
+  public InferenceException(String message, Throwable cause) {
+    super(message, cause);
+  }
+}

@@ -1,0 +1,302 @@
+// HTTP client for the KServe v2 inference protocol with the binary
+// tensor extension (role of reference
+// src/java/.../InferenceServerClient.java:26-60 — async Apache
+// HttpAsyncClient there; this design rides the JDK's built-in
+// java.net.http.HttpClient, sync + CompletableFuture async).
+package triton.client;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.CompletableFuture;
+
+public class InferenceServerClient implements AutoCloseable {
+  private final String baseUrl;
+  private final HttpClient http;
+  private final Duration requestTimeout;
+
+  public InferenceServerClient(String url) {
+    this(url, Duration.ofSeconds(60), Duration.ofSeconds(60));
+  }
+
+  public InferenceServerClient(
+      String url, Duration connectTimeout, Duration requestTimeout) {
+    this.baseUrl =
+        url.startsWith("http://") || url.startsWith("https://")
+            ? url
+            : "http://" + url;
+    this.requestTimeout = requestTimeout;
+    this.http =
+        HttpClient.newBuilder().connectTimeout(connectTimeout).build();
+  }
+
+  // -- health / metadata ---------------------------------------------------
+
+  public boolean isServerLive() throws InferenceException {
+    return get("/v2/health/live").statusCode() == 200;
+  }
+
+  public boolean isServerReady() throws InferenceException {
+    return get("/v2/health/ready").statusCode() == 200;
+  }
+
+  public boolean isModelReady(String modelName) throws InferenceException {
+    return get("/v2/models/" + modelName + "/ready").statusCode() == 200;
+  }
+
+  public Map<String, Object> getServerMetadata() throws InferenceException {
+    return getJson("/v2");
+  }
+
+  public Map<String, Object> getModelMetadata(String modelName)
+      throws InferenceException {
+    return getJson("/v2/models/" + modelName);
+  }
+
+  public Map<String, Object> getModelConfig(String modelName)
+      throws InferenceException {
+    return getJson("/v2/models/" + modelName + "/config");
+  }
+
+  public Map<String, Object> getInferenceStatistics(String modelName)
+      throws InferenceException {
+    return getJson("/v2/models/" + modelName + "/stats");
+  }
+
+  // -- model control -------------------------------------------------------
+
+  public void loadModel(String modelName) throws InferenceException {
+    post("/v2/repository/models/" + modelName + "/load", new byte[0], null);
+  }
+
+  public void unloadModel(String modelName) throws InferenceException {
+    post(
+        "/v2/repository/models/" + modelName + "/unload", new byte[0], null);
+  }
+
+  // -- shared memory -------------------------------------------------------
+
+  public void registerSystemSharedMemory(
+      String name, String key, long byteSize) throws InferenceException {
+    Map<String, Object> body = new LinkedHashMap<>();
+    body.put("key", key);
+    body.put("offset", 0L);
+    body.put("byte_size", byteSize);
+    post(
+        "/v2/systemsharedmemory/region/" + name + "/register",
+        Json.write(body).getBytes(StandardCharsets.UTF_8),
+        "application/json");
+  }
+
+  public void unregisterSystemSharedMemory(String name)
+      throws InferenceException {
+    post(
+        "/v2/systemsharedmemory/region/" + name + "/unregister",
+        new byte[0], null);
+  }
+
+  // -- inference -----------------------------------------------------------
+
+  public InferResult infer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceException {
+    RequestBody body = buildRequestBody(inputs, outputs);
+    HttpRequest request =
+        requestBuilder("/v2/models/" + modelName + "/infer")
+            .header("Content-Type", "application/octet-stream")
+            .header(
+                "Inference-Header-Content-Length",
+                Integer.toString(body.jsonLength))
+            .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
+            .build();
+    HttpResponse<byte[]> response;
+    try {
+      response =
+          http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("infer request failed", e);
+    }
+    return toResult(response);
+  }
+
+  /** Asynchronous infer on the JDK client's executor. */
+  public CompletableFuture<InferResult> inferAsync(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) {
+    RequestBody body;
+    try {
+      body = buildRequestBody(inputs, outputs);
+    } catch (InferenceException e) {
+      return CompletableFuture.failedFuture(e);
+    }
+    HttpRequest request =
+        requestBuilder("/v2/models/" + modelName + "/infer")
+            .header("Content-Type", "application/octet-stream")
+            .header(
+                "Inference-Header-Content-Length",
+                Integer.toString(body.jsonLength))
+            .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
+            .build();
+    return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
+        .thenApply(
+            response -> {
+              try {
+                return toResult(response);
+              } catch (InferenceException e) {
+                throw new RuntimeException(e);
+              }
+            });
+  }
+
+  // -- internals -----------------------------------------------------------
+
+  private record RequestBody(byte[] bytes, int jsonLength) {}
+
+  private RequestBody buildRequestBody(
+      List<InferInput> inputs, List<InferRequestedOutput> outputs)
+      throws InferenceException {
+    Map<String, Object> header = new LinkedHashMap<>();
+    List<Object> inputEntries = new ArrayList<>();
+    for (InferInput input : inputs) {
+      Map<String, Object> entry = new LinkedHashMap<>();
+      entry.put("name", input.getName());
+      entry.put("shape", input.getShape());
+      entry.put("datatype", input.getDatatype().name());
+      Map<String, Object> params = new LinkedHashMap<>();
+      if (input.getSharedMemoryRegion() != null) {
+        params.put(
+            "shared_memory_region", input.getSharedMemoryRegion());
+        params.put(
+            "shared_memory_byte_size", input.getSharedMemoryByteSize());
+        if (input.getSharedMemoryOffset() != 0) {
+          params.put(
+              "shared_memory_offset", input.getSharedMemoryOffset());
+        }
+      } else {
+        if (input.getData() == null) {
+          throw new InferenceException(
+              "input '" + input.getName() + "' has no data");
+        }
+        params.put("binary_data_size", input.getData().length);
+      }
+      entry.put("parameters", params);
+      inputEntries.add(entry);
+    }
+    header.put("inputs", inputEntries);
+    if (outputs != null && !outputs.isEmpty()) {
+      List<Object> outputEntries = new ArrayList<>();
+      for (InferRequestedOutput output : outputs) {
+        Map<String, Object> entry = new LinkedHashMap<>();
+        entry.put("name", output.getName());
+        Map<String, Object> params = new LinkedHashMap<>();
+        if (output.getSharedMemoryRegion() != null) {
+          params.put(
+              "shared_memory_region", output.getSharedMemoryRegion());
+          params.put(
+              "shared_memory_byte_size",
+              output.getSharedMemoryByteSize());
+          if (output.getSharedMemoryOffset() != 0) {
+            params.put(
+                "shared_memory_offset", output.getSharedMemoryOffset());
+          }
+        } else {
+          params.put("binary_data", output.isBinaryData());
+          if (output.getClassCount() > 0) {
+            params.put("classification", output.getClassCount());
+          }
+        }
+        entry.put("parameters", params);
+        outputEntries.add(entry);
+      }
+      header.put("outputs", outputEntries);
+    }
+    byte[] json = Json.write(header).getBytes(StandardCharsets.UTF_8);
+    ByteArrayOutputStream body = new ByteArrayOutputStream();
+    body.writeBytes(json);
+    for (InferInput input : inputs) {
+      if (input.getSharedMemoryRegion() == null) {
+        body.writeBytes(input.getData());
+      }
+    }
+    return new RequestBody(body.toByteArray(), json.length);
+  }
+
+  private InferResult toResult(HttpResponse<byte[]> response)
+      throws InferenceException {
+    if (response.statusCode() != 200) {
+      throw new InferenceException(
+          "infer failed: HTTP " + response.statusCode() + ": "
+              + new String(response.body(), StandardCharsets.UTF_8));
+    }
+    Integer headerLength =
+        response.headers()
+            .firstValue("Inference-Header-Content-Length")
+            .map(Integer::parseInt)
+            .orElse(null);
+    return new InferResult(response.body(), headerLength);
+  }
+
+  private HttpRequest.Builder requestBuilder(String path) {
+    return HttpRequest.newBuilder()
+        .uri(URI.create(baseUrl + path))
+        .timeout(requestTimeout);
+  }
+
+  private HttpResponse<byte[]> get(String path) throws InferenceException {
+    try {
+      return http.send(
+          requestBuilder(path).GET().build(),
+          HttpResponse.BodyHandlers.ofByteArray());
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("request failed: " + path, e);
+    }
+  }
+
+  private Map<String, Object> getJson(String path)
+      throws InferenceException {
+    HttpResponse<byte[]> response = get(path);
+    String body = new String(response.body(), StandardCharsets.UTF_8);
+    if (response.statusCode() != 200) {
+      throw new InferenceException(
+          "request failed: HTTP " + response.statusCode() + ": " + body);
+    }
+    return Json.parseObject(body);
+  }
+
+  private void post(String path, byte[] body, String contentType)
+      throws InferenceException {
+    HttpRequest.Builder builder = requestBuilder(path);
+    if (contentType != null) {
+      builder.header("Content-Type", contentType);
+    }
+    HttpResponse<byte[]> response;
+    try {
+      response =
+          http.send(
+              builder.POST(HttpRequest.BodyPublishers.ofByteArray(body))
+                  .build(),
+              HttpResponse.BodyHandlers.ofByteArray());
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("request failed: " + path, e);
+    }
+    if (response.statusCode() != 200) {
+      throw new InferenceException(
+          "request failed: HTTP " + response.statusCode() + ": "
+              + new String(response.body(), StandardCharsets.UTF_8));
+    }
+  }
+
+  @Override
+  public void close() {
+    // JDK HttpClient needs no explicit shutdown
+  }
+}

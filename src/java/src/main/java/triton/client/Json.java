@@ -1,0 +1,258 @@
+// Minimal dependency-free JSON reader/writer used by the client (the
+// reference's Java client pulls Jackson; this recipe stays stdlib-only).
+package triton.client;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+final class Json {
+  private Json() {}
+
+  // -- writing ------------------------------------------------------------
+
+  static void escape(String s, StringBuilder out) {
+    out.append('"');
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"' -> out.append("\\\"");
+        case '\\' -> out.append("\\\\");
+        case '\n' -> out.append("\\n");
+        case '\r' -> out.append("\\r");
+        case '\t' -> out.append("\\t");
+        default -> {
+          if (c < 0x20) {
+            out.append(String.format("\\u%04x", (int) c));
+          } else {
+            out.append(c);
+          }
+        }
+      }
+    }
+    out.append('"');
+  }
+
+  static void write(Object value, StringBuilder out) {
+    if (value == null) {
+      out.append("null");
+    } else if (value instanceof String s) {
+      escape(s, out);
+    } else if (value instanceof Boolean || value instanceof Number) {
+      out.append(value.toString());
+    } else if (value instanceof Map<?, ?> map) {
+      out.append('{');
+      boolean first = true;
+      for (Map.Entry<?, ?> e : map.entrySet()) {
+        if (!first) {
+          out.append(',');
+        }
+        first = false;
+        escape(e.getKey().toString(), out);
+        out.append(':');
+        write(e.getValue(), out);
+      }
+      out.append('}');
+    } else if (value instanceof List<?> list) {
+      out.append('[');
+      boolean first = true;
+      for (Object e : list) {
+        if (!first) {
+          out.append(',');
+        }
+        first = false;
+        write(e, out);
+      }
+      out.append(']');
+    } else if (value instanceof long[] arr) {
+      out.append('[');
+      for (int i = 0; i < arr.length; i++) {
+        if (i > 0) {
+          out.append(',');
+        }
+        out.append(arr[i]);
+      }
+      out.append(']');
+    } else {
+      escape(value.toString(), out);
+    }
+  }
+
+  static String write(Object value) {
+    StringBuilder out = new StringBuilder();
+    write(value, out);
+    return out.toString();
+  }
+
+  // -- parsing ------------------------------------------------------------
+
+  private static final class Parser {
+    private final String text;
+    private int pos;
+
+    Parser(String text) {
+      this.text = text;
+    }
+
+    void ws() {
+      while (pos < text.length()
+          && Character.isWhitespace(text.charAt(pos))) {
+        pos++;
+      }
+    }
+
+    char next() {
+      if (pos >= text.length()) {
+        throw new IllegalArgumentException("unexpected end of JSON");
+      }
+      char c = text.charAt(pos);
+      pos++;
+      return c;
+    }
+
+    Object value() {
+      ws();
+      if (pos >= text.length()) {
+        throw new IllegalArgumentException("unexpected end of JSON");
+      }
+      char c = text.charAt(pos);
+      switch (c) {
+        case '{':
+          return object();
+        case '[':
+          return array();
+        case '"':
+          return string();
+        case 't':
+          expect("true");
+          return Boolean.TRUE;
+        case 'f':
+          expect("false");
+          return Boolean.FALSE;
+        case 'n':
+          expect("null");
+          return null;
+        default:
+          return number();
+      }
+    }
+
+    void expect(String literal) {
+      if (!text.startsWith(literal, pos)) {
+        throw new IllegalArgumentException(
+            "bad JSON literal at " + pos);
+      }
+      pos += literal.length();
+    }
+
+    Map<String, Object> object() {
+      Map<String, Object> out = new LinkedHashMap<>();
+      pos++; // '{'
+      ws();
+      if (pos < text.length() && text.charAt(pos) == '}') {
+        pos++;
+        return out;
+      }
+      while (true) {
+        ws();
+        String key = string();
+        ws();
+        if (next() != ':') {
+          throw new IllegalArgumentException("expected ':' at " + pos);
+        }
+        out.put(key, value());
+        ws();
+        char c = next();
+        if (c == '}') {
+          return out;
+        }
+        if (c != ',') {
+          throw new IllegalArgumentException(
+              "expected ',' or '}' at " + pos);
+        }
+      }
+    }
+
+    List<Object> array() {
+      List<Object> out = new ArrayList<>();
+      pos++; // '['
+      ws();
+      if (pos < text.length() && text.charAt(pos) == ']') {
+        pos++;
+        return out;
+      }
+      while (true) {
+        out.add(value());
+        ws();
+        char c = next();
+        if (c == ']') {
+          return out;
+        }
+        if (c != ',') {
+          throw new IllegalArgumentException(
+              "expected ',' or ']' at " + pos);
+        }
+      }
+    }
+
+    String string() {
+      if (next() != '"') {
+        throw new IllegalArgumentException("expected string at " + pos);
+      }
+      StringBuilder out = new StringBuilder();
+      while (true) {
+        char c = next();
+        if (c == '"') {
+          return out.toString();
+        }
+        if (c == '\\') {
+          char esc = next();
+          switch (esc) {
+            case 'n' -> out.append('\n');
+            case 'r' -> out.append('\r');
+            case 't' -> out.append('\t');
+            case 'b' -> out.append('\b');
+            case 'f' -> out.append('\f');
+            case 'u' -> {
+              if (pos + 4 > text.length()) {
+                throw new IllegalArgumentException(
+                    "unexpected end of JSON");
+              }
+              out.append(
+                  (char) Integer.parseInt(
+                      text.substring(pos, pos + 4), 16));
+              pos += 4;
+            }
+            default -> out.append(esc);
+          }
+        } else {
+          out.append(c);
+        }
+      }
+    }
+
+    Object number() {
+      int start = pos;
+      while (pos < text.length()
+          && "+-0123456789.eE".indexOf(text.charAt(pos)) >= 0) {
+        pos++;
+      }
+      String token = text.substring(start, pos);
+      if (token.contains(".") || token.contains("e")
+          || token.contains("E")) {
+        return Double.parseDouble(token);
+      }
+      return Long.parseLong(token);
+    }
+  }
+
+  static Object parse(String text) {
+    return new Parser(text).value();
+  }
+
+  @SuppressWarnings("unchecked")
+  static Map<String, Object> parseObject(String text) {
+    return (Map<String, Object>) parse(text);
+  }
+}
