@@ -28,6 +28,7 @@ import json
 import os
 import statistics
 import sys
+import traceback
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -236,7 +237,19 @@ def bench_vision(grpc_url, config, model, modes, window_s, windows):
 # config 4: BERT ensemble, async GRPC streaming, pipelined
 # ---------------------------------------------------------------------------
 
-def bench_bert_stream(grpc_url, window_s, windows):
+def bench_bert_stream(grpc_url, window_s, windows, attempts=2):
+    """Pipelined streaming over a long-lived bidi stream; one retry with
+    a fresh channel covers transient stream resets."""
+    last_error = None
+    for _ in range(attempts):
+        try:
+            return _bench_bert_stream_once(grpc_url, window_s, windows)
+        except Exception as e:
+            last_error = e
+    raise last_error
+
+
+def _bench_bert_stream_once(grpc_url, window_s, windows):
     import queue
 
     import tritonclient.grpc as grpcclient
@@ -258,42 +271,49 @@ def bench_bert_stream(grpc_url, window_s, windows):
     def issue(i):
         client.async_stream_infer("bert_ensemble", [inputs[i % len(inputs)]])
 
-    # prime/compile
-    issue(0)
-    result, error = done.get(timeout=120)
-    assert error is None, repr(error)
+    try:
+        # prime/compile: the first request carries the XLA compile, which
+        # can run minutes on a cold or tunneled device
+        issue(0)
+        result, error = done.get(timeout=600)
+        assert error is None, repr(error)
 
-    rates = []
-    lat = []
-    inflight_target = 8
-    for _ in range(windows):
-        inflight = 0
-        completed = 0
-        t0 = time.perf_counter()
-        sent_at = {}
-        seq = 0
-        while True:
-            while inflight < inflight_target:
-                sent_at[seq] = time.perf_counter()
-                issue(seq)
-                seq += 1
-                inflight += 1
-            result, error = done.get(timeout=120)
-            assert error is None, repr(error)
-            completed += 1
-            inflight -= 1
-            lat.append(time.perf_counter() - sent_at.pop(completed - 1, t0))
-            dt = time.perf_counter() - t0
-            if dt >= window_s:
-                break
-        # drain
-        while inflight:
-            result, error = done.get(timeout=120)
-            assert error is None, repr(error)
-            inflight -= 1
-        rates.append(completed / dt)
-    client.stop_stream()
-    client.close()
+        rates = []
+        lat = []
+        inflight_target = 8
+        for _ in range(windows):
+            inflight = 0
+            completed = 0
+            t0 = time.perf_counter()
+            sent_at = {}
+            seq = 0
+            while True:
+                while inflight < inflight_target:
+                    sent_at[seq] = time.perf_counter()
+                    issue(seq)
+                    seq += 1
+                    inflight += 1
+                result, error = done.get(timeout=300)
+                assert error is None, repr(error)
+                completed += 1
+                inflight -= 1
+                lat.append(
+                    time.perf_counter() - sent_at.pop(completed - 1, t0))
+                dt = time.perf_counter() - t0
+                if dt >= window_s:
+                    break
+            # drain
+            while inflight:
+                result, error = done.get(timeout=300)
+                assert error is None, repr(error)
+                inflight -= 1
+            rates.append(completed / dt)
+    finally:
+        try:
+            client.stop_stream(cancel_requests=True)
+        except Exception:
+            pass
+        client.close()
     lat.sort()
     return _emit(4, "bert_ensemble_grpc_stream_pipelined",
                  statistics.median(rates), "infer/sec", None,
@@ -344,16 +364,21 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
             n += 1
         return n / (time.perf_counter() - t0), first
 
-    generate(False)  # compile/warmup
-    rates, ttfts = [], []
-    for _ in range(windows):
-        r, ttft = generate(True)
-        rates.append(r)
-        ttfts.append(ttft)
-    client.stop_stream()
-    client.unregister_xla_shared_memory("bench_kv")
-    xshm.destroy_shared_memory_region(kv)
-    client.close()
+    try:
+        generate(False)  # compile/warmup
+        rates, ttfts = [], []
+        for _ in range(windows):
+            r, ttft = generate(True)
+            rates.append(r)
+            ttfts.append(ttft)
+    finally:
+        try:
+            client.stop_stream(cancel_requests=True)
+            client.unregister_xla_shared_memory("bench_kv")
+        except Exception:
+            pass
+        xshm.destroy_shared_memory_region(kv)
+        client.close()
     return _emit(5, "llama_decoupled_stream", statistics.median(rates),
                  "tokens/sec", None,
                  ttft_ms=round(statistics.median(ttfts) * 1e3, 1),
@@ -422,8 +447,13 @@ def main():
         grpc_f.stop()
         http.stop()
     for config, err in failures:
-        print(json.dumps({"config": config, "error": str(err)}),
-              file=sys.stderr, flush=True)
+        print(json.dumps({
+            "config": config,
+            "error": "".join(
+                traceback.format_exception(type(err), err,
+                                           err.__traceback__)
+            ),
+        }), file=sys.stderr, flush=True)
     if failures:
         sys.exit(1)
 

@@ -132,6 +132,16 @@ main(int argc, char** argv)
   delete result;
   std::cout << "sync infer OK" << std::endl;
 
+  // gzip request body + deflate-compressed response
+  result = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, inputs, outputs, "gzip", "deflate"),
+      "compressed infer");
+  validate(result);
+  delete result;
+  std::cout << "compressed infer OK" << std::endl;
+
   // async
   std::mutex mu;
   std::condition_variable cv;

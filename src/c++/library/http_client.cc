@@ -8,6 +8,8 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <zlib.h>
+
 #include <cstring>
 #include <sstream>
 
@@ -643,11 +645,99 @@ InferenceServerHttpClient::Post(
   return err;
 }
 
+
+namespace {
+
+// zlib-backed body (de)compression for the gzip/deflate content codings
+// (role of reference http_client.cc:563-580 CompressInput, which rides
+// libcurl; windowBits 15+16 selects the gzip wrapper).
+Error
+CompressBuffer(
+    const std::string& algorithm, const std::vector<uint8_t>& in,
+    std::vector<uint8_t>* out)
+{
+  z_stream strm{};
+  int window_bits = (algorithm == "gzip") ? 15 + 16 : 15;
+  if (deflateInit2(
+          &strm, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits, 8,
+          Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("unable to initialize " + algorithm + " compression");
+  }
+  out->resize(deflateBound(&strm, in.size()));
+  // feed in <4 GiB chunks: zlib's avail_in/avail_out are 32-bit
+  const size_t kChunk = 1u << 30;
+  size_t consumed = 0;
+  size_t produced = 0;
+  int rc = Z_OK;
+  do {
+    size_t in_chunk = std::min(kChunk, in.size() - consumed);
+    strm.next_in = (Bytef*)in.data() + consumed;
+    strm.avail_in = (uInt)in_chunk;
+    bool last = (consumed + in_chunk == in.size());
+    do {
+      size_t out_chunk = std::min(kChunk, out->size() - produced);
+      strm.next_out = out->data() + produced;
+      strm.avail_out = (uInt)out_chunk;
+      rc = deflate(&strm, last ? Z_FINISH : Z_NO_FLUSH);
+      produced += out_chunk - strm.avail_out;
+    } while (rc == Z_OK && strm.avail_in > 0);
+    consumed += in_chunk - strm.avail_in;
+  } while (rc == Z_OK && consumed < in.size());
+  deflateEnd(&strm);
+  if (rc != Z_STREAM_END) {
+    return Error(algorithm + " compression failed");
+  }
+  out->resize(produced);
+  return Error::Success;
+}
+
+Error
+DecompressString(const std::string& encoding, std::string* body)
+{
+  z_stream strm{};
+  // 15+32: auto-detect gzip or zlib wrapper
+  if (inflateInit2(&strm, 15 + 32) != Z_OK) {
+    return Error("unable to initialize " + encoding + " decompression");
+  }
+  std::string out;
+  out.resize(body->size() * 4 + 1024);
+  const size_t kChunk = 1u << 30;  // zlib counters are 32-bit
+  size_t consumed = 0;
+  size_t written = 0;
+  int rc = Z_OK;
+  do {
+    size_t in_chunk = std::min(kChunk, body->size() - consumed);
+    strm.next_in = (Bytef*)body->data() + consumed;
+    strm.avail_in = (uInt)in_chunk;
+    do {
+      if (written == out.size()) {
+        out.resize(out.size() * 2);
+      }
+      size_t out_chunk = std::min(kChunk, out.size() - written);
+      strm.next_out = (Bytef*)out.data() + written;
+      strm.avail_out = (uInt)out_chunk;
+      rc = inflate(&strm, Z_NO_FLUSH);
+      written += out_chunk - strm.avail_out;
+    } while (rc == Z_OK && strm.avail_in > 0);
+    consumed += in_chunk - strm.avail_in;
+  } while (rc == Z_OK && consumed < body->size());
+  inflateEnd(&strm);
+  if (rc != Z_STREAM_END) {
+    return Error(encoding + " decompression failed");
+  }
+  out.resize(written);
+  *body = std::move(out);
+  return Error::Success;
+}
+
+}  // namespace
+
 Error
 InferenceServerHttpClient::PostBinary(
     const std::string& path, const std::vector<uint8_t>& body,
     size_t header_length, long* http_code, std::string* response,
-    size_t* response_header_length, uint64_t timeout_us)
+    size_t* response_header_length, uint64_t timeout_us,
+    const std::string& extra_headers, std::string* response_content_encoding)
 {
   auto conn = pool_->Acquire();
   Error err;
@@ -667,6 +757,7 @@ InferenceServerHttpClient::PostBinary(
         << "\r\nConnection: keep-alive"
         << "\r\nContent-Type: application/octet-stream"
         << "\r\nInference-Header-Content-Length: " << header_length
+        << extra_headers
         << "\r\nContent-Length: " << body.size() << "\r\n\r\n";
     std::string header = req.str();
     struct iovec iov[2] = {
@@ -702,6 +793,11 @@ InferenceServerHttpClient::PostBinary(
         it == resp_headers.end()
             ? 0
             : (size_t)strtoull(it->second.c_str(), nullptr, 10);
+    if (response_content_encoding != nullptr) {
+      auto enc = resp_headers.find("content-encoding");
+      *response_content_encoding =
+          enc == resp_headers.end() ? "" : enc->second;
+    }
   }
   pool_->Release(std::move(conn));
   return err;
@@ -1221,7 +1317,9 @@ Error
 InferenceServerHttpClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs)
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const std::string& request_compression_algorithm,
+    const std::string& response_compression_algorithm)
 {
   RequestTimers timer;
   timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_START);
@@ -1234,6 +1332,34 @@ InferenceServerHttpClient::Infer(
     return err;
   }
 
+  std::string extra_headers;
+  if (!request_compression_algorithm.empty()) {
+    if (request_compression_algorithm != "gzip" &&
+        request_compression_algorithm != "deflate") {
+      return Error(
+          "unsupported request compression algorithm: " +
+          request_compression_algorithm);
+    }
+    std::vector<uint8_t> compressed;
+    err = CompressBuffer(request_compression_algorithm, body, &compressed);
+    if (!err.IsOk()) {
+      return err;
+    }
+    body = std::move(compressed);
+    extra_headers +=
+        "\r\nContent-Encoding: " + request_compression_algorithm;
+  }
+  if (!response_compression_algorithm.empty()) {
+    if (response_compression_algorithm != "gzip" &&
+        response_compression_algorithm != "deflate") {
+      return Error(
+          "unsupported response compression algorithm: " +
+          response_compression_algorithm);
+    }
+    extra_headers +=
+        "\r\nAccept-Encoding: " + response_compression_algorithm;
+  }
+
   std::string path = "/v2/models/" + UriEscape(options.model_name_);
   if (!options.model_version_.empty()) {
     path += "/versions/" + options.model_version_;
@@ -1244,12 +1370,20 @@ InferenceServerHttpClient::Infer(
   long code;
   std::string response;
   size_t response_header_length;
+  std::string response_encoding;
   err = PostBinary(
       path, body, header_length, &code, &response,
-      &response_header_length, options.client_timeout_us_);
+      &response_header_length, options.client_timeout_us_,
+      extra_headers, &response_encoding);
   timer.CaptureTimestamp(RequestTimers::Kind::SEND_END);
   if (!err.IsOk()) {
     return err;
+  }
+  if (!response_encoding.empty()) {
+    err = DecompressString(response_encoding, &response);
+    if (!err.IsOk()) {
+      return err;
+    }
   }
 
   timer.CaptureTimestamp(RequestTimers::Kind::RECV_START);
@@ -1278,7 +1412,9 @@ Error
 InferenceServerHttpClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs)
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const std::string& request_compression_algorithm,
+    const std::string& response_compression_algorithm)
 {
   if (callback == nullptr) {
     return Error("callback must not be null for AsyncInfer");
@@ -1288,14 +1424,17 @@ InferenceServerHttpClient::AsyncInfer(
   InferOptions opts = options;
   std::vector<InferInput*> ins = inputs;
   std::vector<const InferRequestedOutput*> outs = outputs;
+  std::string req_comp = request_compression_algorithm;
+  std::string resp_comp = response_compression_algorithm;
   {
     std::lock_guard<std::mutex> lk(async_mu_);
     if (exiting_) {
       return Error("client is shutting down");
     }
-    async_queue_.emplace_back([this, callback, opts, ins, outs] {
+    async_queue_.emplace_back([this, callback, opts, ins, outs, req_comp,
+                               resp_comp] {
       InferResult* result = nullptr;
-      Error err = Infer(&result, opts, ins, outs);
+      Error err = Infer(&result, opts, ins, outs, req_comp, resp_comp);
       if (!err.IsOk() && result == nullptr) {
         // surface transport failure through a result-less sentinel: the
         // reference delivers a result whose RequestStatus is the error

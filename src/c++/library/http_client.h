@@ -89,17 +89,24 @@ class InferenceServerHttpClient : public InferenceServerClient {
   Error UnregisterCudaSharedMemory(const std::string& name = "");
   Error CudaSharedMemoryStatus(std::string* status);
 
+  // Compression algorithms: "" (none), "gzip", "deflate" — request-side
+  // body compression and response-side Accept-Encoding (reference
+  // http_client.cc:563-580 CompressInput via libcurl; zlib here).
   Error Infer(
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs =
-          std::vector<const InferRequestedOutput*>());
+          std::vector<const InferRequestedOutput*>(),
+      const std::string& request_compression_algorithm = "",
+      const std::string& response_compression_algorithm = "");
 
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs =
-          std::vector<const InferRequestedOutput*>());
+          std::vector<const InferRequestedOutput*>(),
+      const std::string& request_compression_algorithm = "",
+      const std::string& response_compression_algorithm = "");
 
   // Build an inference request body without sending (reference
   // http_client.h:122-138). Returns body and the JSON header length.
@@ -126,7 +133,9 @@ class InferenceServerHttpClient : public InferenceServerClient {
   Error PostBinary(
       const std::string& path, const std::vector<uint8_t>& body,
       size_t header_length, long* http_code, std::string* response,
-      size_t* response_header_length, uint64_t timeout_us);
+      size_t* response_header_length, uint64_t timeout_us,
+      const std::string& extra_headers = "",
+      std::string* response_content_encoding = nullptr);
 
   std::string host_;
   int port_;

@@ -4,6 +4,20 @@ namespace pa {
 
 namespace {
 
+// proto3 JSON mapping renders int64 as quoted strings (the gRPC
+// backend's MessageToJsonString path); accept both forms.
+int64_t
+JsonInt(const tc::json::ValuePtr& v)
+{
+  if (v == nullptr) {
+    return 0;
+  }
+  if (v->type() == tc::json::Type::String) {
+    return strtoll(v->AsString().c_str(), nullptr, 10);
+  }
+  return v->AsInt();
+}
+
 std::vector<ModelTensor>
 ParseTensors(const tc::json::ValuePtr& arr, bool strip_batch, int max_batch)
 {
@@ -20,7 +34,7 @@ ParseTensors(const tc::json::ValuePtr& arr, bool strip_batch, int max_batch)
     tensor.datatype = datatype ? datatype->AsString() : "FP32";
     if (shape != nullptr) {
       for (const auto& d : shape->Elements()) {
-        tensor.shape.push_back(d->AsInt());
+        tensor.shape.push_back(JsonInt(d));
       }
     }
     // metadata shapes include the batch dim for batching models
@@ -54,7 +68,7 @@ ModelParser::Init(
     return tc::Error("failed to parse model config: " + parse_err);
   }
   auto mbs = config->Get("max_batch_size");
-  max_batch_size_ = mbs ? (int)mbs->AsInt() : 0;
+  max_batch_size_ = (int)JsonInt(mbs);
   if (config->Has("ensemble_scheduling")) {
     scheduler_ = SchedulerType::ENSEMBLE;
     // composing models, for per-step server-stat merging (reference

@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Build the client wheel with the native shm shim bundled (role of
+reference src/python/library/build_wheel.py: compile artifacts, copy
+into the package tree, invoke setup.py).
+
+Usage: python build_wheel.py [--dest-dir DIR]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+THIS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_cshm():
+    """Compile libcshm.so next to its ctypes loader so the wheel ships a
+    prebuilt binary (the loader falls back to on-demand compilation when
+    the bundled library is missing)."""
+    src = os.path.join(
+        os.path.dirname(THIS_DIR), "c++", "library", "cshm.cc"
+    )
+    dest = os.path.join(
+        THIS_DIR, "tritonclient", "utils", "shared_memory", "libcshm.so"
+    )
+    if not os.path.exists(src):
+        print("cshm.cc not found; wheel will compile on first import")
+        return None
+    gxx = shutil.which("g++")
+    if gxx is None:
+        print("g++ not found; wheel will compile on first import")
+        return None
+    subprocess.run(
+        [gxx, "-O2", "-fPIC", "-shared", "-o", dest, src, "-lrt"],
+        check=True,
+    )
+    return dest
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dest-dir", default=os.path.join(THIS_DIR, "dist"))
+    args = parser.parse_args()
+
+    bundled = build_cshm()
+    try:
+        subprocess.run(
+            [sys.executable, "setup.py", "bdist_wheel",
+             "--dist-dir", args.dest_dir],
+            cwd=THIS_DIR, check=True,
+        )
+    finally:
+        if bundled and os.path.exists(bundled):
+            os.unlink(bundled)  # keep the source tree clean
+    wheels = [
+        f for f in os.listdir(args.dest_dir) if f.endswith(".whl")
+    ]
+    print("built: {}".format(sorted(wheels)[-1] if wheels else "nothing"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""Wheel packaging for the TPU-native Triton client stack (role of
+reference src/python/library/setup.py:60-80: extras ``grpc``/``http``/
+``all``, bundled native shm library, deprecated shim packages).
+
+Build:  cd src/python && python setup.py bdist_wheel
+        (or: python build_wheel.py --dest-dir ../../dist)
+The native POSIX-shm shim (libcshm.so) auto-compiles on first import
+when absent, so the wheel works from source checkouts too; build_wheel.py
+pre-compiles and bundles it.
+"""
+
+import os
+
+from setuptools import find_packages, setup
+
+VERSION = os.environ.get("VERSION", "0.1.0")
+
+REQUIRES_HTTP = []  # stdlib-socket HTTP client: no extra deps
+REQUIRES_GRPC = ["grpcio>=1.41", "protobuf>=3.18"]
+REQUIRES_AIO = ["aiohttp>=3.8"]
+
+this_dir = os.path.dirname(os.path.abspath(__file__))
+
+
+def package_data():
+    data = {"tritonclient.utils.shared_memory": []}
+    lib = os.path.join(
+        this_dir, "tritonclient", "utils", "shared_memory", "libcshm.so"
+    )
+    if os.path.exists(lib):
+        data["tritonclient.utils.shared_memory"].append("libcshm.so")
+    return data
+
+
+setup(
+    name="tpu-tritonclient",
+    version=VERSION,
+    description=(
+        "TPU-native client libraries for the KServe v2 / Triton "
+        "inference protocol (HTTP + gRPC, system and XLA/TPU-HBM "
+        "shared memory)"
+    ),
+    license="BSD",
+    python_requires=">=3.9",
+    packages=find_packages(
+        include=[
+            "tritonclient",
+            "tritonclient.*",
+            "tritonhttpclient",
+            "tritongrpcclient",
+            "tritonclientutils",
+            "tritonshmutils",
+            "tritonshmutils.*",
+        ]
+    ),
+    package_data=package_data(),
+    install_requires=["numpy>=1.20"],
+    extras_require={
+        "http": REQUIRES_HTTP + REQUIRES_AIO,
+        "grpc": REQUIRES_GRPC,
+        "all": REQUIRES_HTTP + REQUIRES_GRPC + REQUIRES_AIO,
+    },
+    zip_safe=False,
+)

@@ -418,7 +418,8 @@ class InferenceServer:
         self._system_shm = {}
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
-        self._sequence_state = {}  # (model, seq_id) -> state
+        self._sequence_state = {}  # (model, seq_id) -> (state, touched)
+        self._last_sequence_sweep = 0.0
         self._trace_settings = {
             "trace_file": [""],
             "trace_level": ["OFF"],
@@ -840,6 +841,7 @@ class InferenceServer:
                 "inference request to model '{}' must specify a non-zero "
                 "sequence id".format(model.name)
             )
+        self._expire_idle_sequences(model)
         key = (model.name, request.sequence_id)
         if request.sequence_start:
             state = None
@@ -850,13 +852,36 @@ class InferenceServer:
                     "specify the START flag on the first request of the "
                     "sequence".format(request.sequence_id, model.name)
                 )
-            state = self._sequence_state[key]
+            state = self._sequence_state[key][0]
         outputs, new_state = model.execute_sequence(inputs, state, request)
         if request.sequence_end:
             self._sequence_state.pop(key, None)
         else:
-            self._sequence_state[key] = new_state
+            self._sequence_state[key] = (new_state, time.monotonic())
         return outputs
+
+    def _expire_idle_sequences(self, model):
+        """Drop sequences idle beyond the model's
+        ``max_sequence_idle_us`` so abandoned sequences (no END request)
+        cannot grow state unboundedly — role of the reference sequence
+        batcher's max_sequence_idle_microseconds expiry.  Swept at most
+        once per idle window (min 50 ms) so the scan stays off the
+        per-request hot path, over an atomic snapshot so concurrent
+        frontend threads can insert/pop freely."""
+        idle_us = getattr(model, "max_sequence_idle_us", 60_000_000)
+        now = time.monotonic()
+        sweep_gap = max(idle_us / 1e6 / 2.0, 0.05)
+        if now - self._last_sequence_sweep < sweep_gap:
+            return
+        self._last_sequence_sweep = now
+        cutoff = now - idle_us / 1e6
+        expired = [
+            key
+            for key, (_, touched) in list(self._sequence_state.items())
+            if key[0] == model.name and touched < cutoff
+        ]
+        for key in expired:
+            self._sequence_state.pop(key, None)
 
     def _execute_ensemble(self, model, inputs, request):
         tensors = dict(inputs)

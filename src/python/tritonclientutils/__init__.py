@@ -1,0 +1,13 @@
+"""Deprecated alias of :mod:`tritonclient.utils` (reference
+tritonclientutils shim)."""
+
+import warnings
+
+warnings.warn(
+    "The package `tritonclientutils` is deprecated; use "
+    "`tritonclient.utils` instead.",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from tritonclient.utils import *  # noqa: F401,F403,E402

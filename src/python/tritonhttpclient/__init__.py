@@ -1,0 +1,20 @@
+"""Deprecated alias of :mod:`tritonclient.http` (role of reference
+src/python/library/tritonhttpclient/__init__.py:26-35 — kept so pre-rename imports
+keep working, with a DeprecationWarning)."""
+
+import warnings
+
+warnings.warn(
+    "The package `tritonhttpclient` is deprecated; use `tritonclient.http` "
+    "instead.",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+from tritonclient.http import *  # noqa: F401,F403,E402
+from tritonclient.http import InferenceServerClient  # noqa: F401,E402
+from tritonclient.utils import (  # noqa: F401,E402
+    InferenceServerException,
+    np_to_triton_dtype,
+    triton_to_np_dtype,
+)

@@ -1,0 +1,15 @@
+"""Deprecated alias of the shared-memory utility modules (reference
+tritonshmutils shim)."""
+
+import warnings
+
+warnings.warn(
+    "The package `tritonshmutils` is deprecated; use "
+    "`tritonclient.utils.shared_memory` / "
+    "`tritonclient.utils.xla_shared_memory` instead.",
+    DeprecationWarning,
+    stacklevel=2,
+)
+
+import tritonclient.utils.shared_memory as shared_memory  # noqa: F401,E402
+import tritonclient.utils.xla_shared_memory as xla_shared_memory  # noqa: F401,E402

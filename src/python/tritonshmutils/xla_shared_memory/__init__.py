@@ -1,0 +1,1 @@
+from tritonclient.utils.xla_shared_memory import *  # noqa: F401,F403

@@ -104,3 +104,31 @@ def test_perf_analyzer_grpc_async(cc_build, grpc_url):
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "Throughput" in result.stdout
+
+
+def test_perf_analyzer_streaming_decoupled(cc_build, grpc_url):
+    """Profile a decoupled model over the bidi stream (--streaming;
+    reference client_backend.h:335-466 StartStream/AsyncStreamInfer)."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "repeat_int32",
+         "-i", "grpc", "-u", grpc_url, "--streaming", "--zero-input",
+         "-p", "400", "--max-trials", "3",
+         "--stability-percentage", "90"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput" in result.stdout
+
+
+def test_perf_analyzer_grpc_xla_shm(cc_build, grpc_url):
+    """--shared-memory xla over a live gRPC socket: the analyzer creates
+    the host window, fabricates the raw handle, registers it."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "simple",
+         "-i", "grpc", "-u", grpc_url, "--shared-memory", "xla",
+         "-p", "400", "--max-trials", "3",
+         "--stability-percentage", "90"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput" in result.stdout

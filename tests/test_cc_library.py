@@ -43,6 +43,7 @@ def test_cc_simple_http_infer_client(cc_build, http_server):
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "sync infer OK" in result.stdout
+    assert "compressed infer OK" in result.stdout
     assert "async infer OK" in result.stdout
 
 

@@ -252,3 +252,43 @@ def test_generate_request_body_static():
     )
     assert header_len is not None
     assert body[header_len:] == in0.tobytes()
+
+
+def test_sequence_idle_expiry_direct(server_core):
+    """Core-level check: idle sequences expire; active ones survive."""
+    import time as _time
+
+    from tpuserver.core import InferRequest
+
+    model = server_core._models["sequence_accumulate"]
+    old_idle = getattr(model, "max_sequence_idle_us", None)
+    model.max_sequence_idle_us = 50_000  # 50 ms
+    try:
+        def send(seq, start=False, end=False):
+            return server_core.infer(InferRequest(
+                "sequence_accumulate",
+                inputs={"INPUT": np.array([1], dtype=np.int32)},
+                parameters={"sequence_id": seq, "sequence_start": start,
+                            "sequence_end": end},
+            ))
+
+        send(801, start=True)
+        send(802, start=True)
+        key = ("sequence_accumulate", 801)
+        assert key in server_core._sequence_state
+        _time.sleep(0.1)
+        send(802, start=True)  # touching the model sweeps idle sequences
+        assert key not in server_core._sequence_state
+        # continuing the expired sequence now demands a new START
+        try:
+            send(801)
+            assert False, "expected ServerError for expired sequence"
+        except Exception as e:
+            assert "START" in str(e)
+    finally:
+        if old_idle is None:
+            del model.max_sequence_idle_us
+        else:
+            model.max_sequence_idle_us = old_idle
+        server_core._sequence_state.pop(
+            ("sequence_accumulate", 802), None)
