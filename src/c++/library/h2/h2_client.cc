@@ -601,6 +601,12 @@ H2Connection::HandleSettings(const uint8_t* p, size_t len, uint8_t flags)
   if (flags & kFlagAck) {
     return;
   }
+  // Apply + ACK atomically w.r.t. other writers (write_mu_ held across
+  // both, matching SendHeaders' write_mu_ -> mu_ lock order): peers —
+  // grpc-core among them — keep enforcing their previous limits until
+  // the ACK arrives, so no frame computed with the NEW values may reach
+  // the wire ahead of the ACK.
+  std::lock_guard<std::mutex> wlk(write_mu_);
   {
     std::lock_guard<std::mutex> lk(mu_);
     for (size_t off = 0; off + 6 <= len; off += 6) {
@@ -626,8 +632,8 @@ H2Connection::HandleSettings(const uint8_t* p, size_t len, uint8_t flags)
       }
     }
   }
+  SendFrameRaw(kFrameSettings, kFlagAck, 0, nullptr, 0);
   window_cv_.notify_all();
-  SendFrame(kFrameSettings, kFlagAck, 0, nullptr, 0);
 }
 
 void

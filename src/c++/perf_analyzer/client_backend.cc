@@ -580,6 +580,14 @@ class InProcessBackend : public ClientBackend {
 };
 #endif  // PA_ENABLE_INPROC
 
+// REST backends (rest_backends.cc)
+tc::Error CreateTFServeBackend(
+    std::shared_ptr<ClientBackend>* backend,
+    const BackendFactoryConfig& config);
+tc::Error CreateTorchServeBackend(
+    std::shared_ptr<ClientBackend>* backend,
+    const BackendFactoryConfig& config);
+
 tc::Error
 ClientBackendFactory::Create(
     std::shared_ptr<ClientBackend>* backend,
@@ -598,6 +606,10 @@ ClientBackendFactory::Create(
           "in-process backend not built (libpython development files "
           "were unavailable at build time)");
 #endif
+    case BackendKind::TFSERVING:
+      return CreateTFServeBackend(backend, config);
+    case BackendKind::TORCHSERVE:
+      return CreateTorchServeBackend(backend, config);
     case BackendKind::MOCK:
       return tc::Error(
           "mock backend is constructed directly in tests");

@@ -165,7 +165,8 @@ CLParser::Usage()
       "  -u/--url <host:port>            server url (default "
       "localhost:8000)\n"
       "  --service-kind <kind>           triton_http (default) | triton_grpc |\n"
-      "                                  tpuserver_inproc (in-process, no network)\n"
+      "                                  tpuserver_inproc (in-process, no network) |\n"
+      "                                  tfserving (REST predict) | torchserve\n"
       "  --server-src <path>             tpuserver python tree for tpuserver_inproc\n"
       "  --server-zoo <set>              default | vision (tpuserver_inproc models)\n"
       "  -v/--verbose                    verbose output\n"
@@ -449,6 +450,10 @@ CLParser::Parse(
             strcmp(optarg, "triton_c_api") == 0) {
           // in-process serving (role of reference triton_c_api mode)
           params->kind = BackendKind::IN_PROCESS;
+        } else if (strcmp(optarg, "tfserving") == 0) {
+          params->kind = BackendKind::TFSERVING;
+        } else if (strcmp(optarg, "torchserve") == 0) {
+          params->kind = BackendKind::TORCHSERVE;
         } else {
           *error = std::string("unsupported service kind ") + optarg;
           return false;

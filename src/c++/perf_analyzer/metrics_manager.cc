@@ -1,5 +1,7 @@
 #include "metrics_manager.h"
 
+#include "rest_util.h"
+
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -15,69 +17,8 @@ namespace pa {
 
 namespace {
 
-// Minimal blocking HTTP/1.0 GET (Connection: close framing keeps the
-// read loop trivial; a metrics scrape every second doesn't need a pool).
-tc::Error
-HttpGet(
-    const std::string& host, int port, const std::string& path,
-    std::string* body)
-{
-  struct addrinfo hints;
-  std::memset(&hints, 0, sizeof(hints));
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  int rc =
-      getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
-  if (rc != 0) {
-    return tc::Error(
-        "metrics: failed to resolve " + host + ": " + gai_strerror(rc));
-  }
-  int fd = -1;
-  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) {
-      continue;
-    }
-    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
-      break;
-    }
-    close(fd);
-    fd = -1;
-  }
-  freeaddrinfo(res);
-  if (fd < 0) {
-    return tc::Error("metrics: unable to connect to " + host);
-  }
-  std::string request = "GET " + path +
-                        " HTTP/1.0\r\nHost: " + host +
-                        "\r\nConnection: close\r\n\r\n";
-  if (::send(fd, request.data(), request.size(), MSG_NOSIGNAL) !=
-      (ssize_t)request.size()) {
-    close(fd);
-    return tc::Error("metrics: send failed");
-  }
-  std::string response;
-  char buf[8192];
-  ssize_t n;
-  while ((n = ::read(fd, buf, sizeof(buf))) > 0) {
-    response.append(buf, n);
-  }
-  close(fd);
-  size_t header_end = response.find("\r\n\r\n");
-  if (header_end == std::string::npos) {
-    return tc::Error("metrics: malformed HTTP response");
-  }
-  if (response.find("200") == std::string::npos ||
-      response.find("200") > response.find("\r\n")) {
-    return tc::Error(
-        "metrics: non-200 response: " +
-        response.substr(0, response.find("\r\n")));
-  }
-  *body = response.substr(header_end + 4);
-  return tc::Error::Success;
-}
-
+// url "host:port/path" -> host/port/path (path defaults to /metrics);
+// socket work is shared with the REST backends (rest_util)
 void
 SplitUrl(const std::string& url, std::string* host, int* port,
          std::string* path)
@@ -89,17 +30,7 @@ SplitUrl(const std::string& url, std::string* host, int* port,
   }
   auto slash = u.find('/');
   *path = (slash == std::string::npos) ? "/metrics" : u.substr(slash);
-  if (slash != std::string::npos) {
-    u = u.substr(0, slash);
-  }
-  auto colon = u.rfind(':');
-  if (colon == std::string::npos) {
-    *host = u;
-    *port = 8002;  // reference Triton metrics port
-  } else {
-    *host = u.substr(0, colon);
-    *port = atoi(u.c_str() + colon + 1);
-  }
+  SplitHostPort(u, 8002, host, port);  // 8002: reference metrics port
 }
 
 }  // namespace
@@ -171,7 +102,13 @@ MetricsManager::ScrapeOnce(MetricsSnapshot* out)
   int port = 0;
   SplitUrl(url_, &host, &port, &path);
   std::string body;
-  tc::Error err = HttpGet(host, port, path, &body);
+  long code = 0;
+  tc::Error err =
+      RestRequest(host, port, "GET", path, "", "", &code, &body);
+  if (err.IsOk() && code != 200) {
+    err = tc::Error(
+        "metrics: non-200 response: HTTP " + std::to_string(code));
+  }
   if (!err.IsOk()) {
     return err;
   }

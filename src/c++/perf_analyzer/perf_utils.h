@@ -10,7 +10,14 @@
 
 namespace pa {
 
-enum class BackendKind { TRITON_HTTP, TRITON_GRPC, IN_PROCESS, MOCK };
+enum class BackendKind {
+  TRITON_HTTP,
+  TRITON_GRPC,
+  IN_PROCESS,
+  TFSERVING,
+  TORCHSERVE,
+  MOCK,
+};
 enum class SharedMemoryType { NONE, SYSTEM, XLA };
 enum class Distribution { POISSON, CONSTANT };
 

@@ -1,0 +1,556 @@
+// Non-Triton serving backends over REST: TensorFlow Serving and
+// TorchServe (roles of reference client_backend/tensorflow_serving/ —
+// gRPC PredictService there — and client_backend/torchserve/; both are
+// "beta" backends in the reference with documented caveats,
+// docs/benchmarking.md:136-218).  The native metadata of each server is
+// adapted into the KServe-style JSON the ModelParser consumes, playing
+// the role of the reference's ModelParser::InitTFServe/InitTorchServe.
+
+#include <cmath>
+#include <cstring>
+#include <sstream>
+
+#include "client_backend.h"
+#include "rest_util.h"
+#include "tjson.h"
+
+namespace pa {
+
+namespace {
+
+// -- JSON <-> raw tensor conversion -----------------------------------------
+
+size_t
+DtypeSize(const std::string& datatype)
+{
+  if (datatype == "FP64" || datatype == "INT64" || datatype == "UINT64") {
+    return 8;
+  }
+  if (datatype == "FP32" || datatype == "INT32" || datatype == "UINT32") {
+    return 4;
+  }
+  if (datatype == "FP16" || datatype == "BF16" || datatype == "INT16" ||
+      datatype == "UINT16") {
+    return 2;
+  }
+  return 1;  // BOOL/INT8/UINT8
+}
+
+// append one element at `index` of the raw little-endian buffer as JSON
+void
+AppendElement(
+    const std::string& datatype, const uint8_t* data, size_t index,
+    std::ostringstream& out)
+{
+  if (datatype == "FP32") {
+    float v;
+    memcpy(&v, data + index * 4, 4);
+    out << (std::isfinite(v) ? v : 0.0f);
+  } else if (datatype == "FP64") {
+    double v;
+    memcpy(&v, data + index * 8, 8);
+    out << (std::isfinite(v) ? v : 0.0);
+  } else if (datatype == "INT64") {
+    int64_t v;
+    memcpy(&v, data + index * 8, 8);
+    out << v;
+  } else if (datatype == "INT32") {
+    int32_t v;
+    memcpy(&v, data + index * 4, 4);
+    out << v;
+  } else if (datatype == "INT16") {
+    int16_t v;
+    memcpy(&v, data + index * 2, 2);
+    out << v;
+  } else if (datatype == "UINT8") {
+    out << (unsigned)data[index];
+  } else if (datatype == "INT8") {
+    out << (int)(int8_t)data[index];
+  } else if (datatype == "BOOL") {
+    out << (data[index] ? "true" : "false");
+  } else {
+    out << 0;  // unsupported dtypes send zeros
+  }
+}
+
+// nested JSON array for shape[dim:] over the raw buffer
+void
+BuildNested(
+    const std::string& datatype, const uint8_t* data,
+    const std::vector<int64_t>& shape, size_t dim, size_t* cursor,
+    std::ostringstream& out)
+{
+  if (dim == shape.size()) {
+    AppendElement(datatype, data, (*cursor)++, out);
+    return;
+  }
+  out << "[";
+  for (int64_t i = 0; i < shape[dim]; ++i) {
+    if (i) {
+      out << ", ";
+    }
+    BuildNested(datatype, data, shape, dim + 1, cursor, out);
+  }
+  out << "]";
+}
+
+// flatten a parsed JSON value (nested arrays of numbers) into raw bytes
+void
+FlattenTo(
+    const tc::json::ValuePtr& value, const std::string& datatype,
+    std::vector<uint8_t>* out)
+{
+  if (value == nullptr) {
+    return;
+  }
+  if (value->type() == tc::json::Type::Array) {
+    for (const auto& e : value->Elements()) {
+      FlattenTo(e, datatype, out);
+    }
+    return;
+  }
+  double d = value->type() == tc::json::Type::Bool
+                 ? (value->AsBool() ? 1.0 : 0.0)
+                 : value->AsDouble();
+  size_t pos = out->size();
+  if (datatype == "FP64") {
+    out->resize(pos + 8);
+    memcpy(out->data() + pos, &d, 8);
+  } else if (datatype == "INT64") {
+    int64_t v = (int64_t)d;
+    out->resize(pos + 8);
+    memcpy(out->data() + pos, &v, 8);
+  } else if (datatype == "INT32") {
+    int32_t v = (int32_t)d;
+    out->resize(pos + 4);
+    memcpy(out->data() + pos, &v, 4);
+  } else if (datatype == "UINT8" || datatype == "INT8" ||
+             datatype == "BOOL") {
+    out->push_back((uint8_t)(int64_t)d);
+  } else {  // FP32 default
+    float v = (float)d;
+    out->resize(pos + 4);
+    memcpy(out->data() + pos, &v, 4);
+  }
+}
+
+std::string
+TfDtypeToKserve(const std::string& dt)
+{
+  if (dt == "DT_FLOAT") {
+    return "FP32";
+  }
+  if (dt == "DT_DOUBLE") {
+    return "FP64";
+  }
+  if (dt == "DT_INT32") {
+    return "INT32";
+  }
+  if (dt == "DT_INT64") {
+    return "INT64";
+  }
+  if (dt == "DT_INT8") {
+    return "INT8";
+  }
+  if (dt == "DT_UINT8") {
+    return "UINT8";
+  }
+  if (dt == "DT_BOOL") {
+    return "BOOL";
+  }
+  if (dt == "DT_HALF") {
+    return "FP16";
+  }
+  if (dt == "DT_STRING") {
+    return "BYTES";
+  }
+  return "FP32";
+}
+
+int64_t
+JsonNum(const tc::json::ValuePtr& v)
+{
+  if (v == nullptr) {
+    return 0;
+  }
+  if (v->type() == tc::json::Type::String) {
+    return strtoll(v->AsString().c_str(), nullptr, 10);
+  }
+  return v->AsInt();
+}
+
+}  // namespace
+
+// ============================================================================
+// TensorFlow Serving (REST predict API; the reference backend speaks the
+// gRPC PredictService — client_backend/tensorflow_serving/)
+// ============================================================================
+
+class TFServeBackend : public ClientBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    auto* b = new TFServeBackend();
+    SplitHostPort(config.url, 8501, &b->host_, &b->port_);
+    b->pool_.reset(new RestClientPool(b->host_, b->port_));
+    b->dispatch_.reset(new RestDispatchPool(config.concurrency));
+    backend->reset(b);
+    return tc::Error::Success;
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    // TF-Serving has no global health endpoint; model state is checked
+    // in ModelMetadata (reference notes the same caveat)
+    *ready = true;
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    long code;
+    std::string body;
+    std::string path = "/v1/models/" + model_name +
+                       (model_version.empty()
+                            ? ""
+                            : "/versions/" + model_version) +
+                       "/metadata";
+    tc::Error err = pool_->Request(
+        "GET", path, "", "", &code, &body);
+    if (!err.IsOk()) {
+      return err;
+    }
+    if (code != 200) {
+      return tc::Error(
+          "tfserving metadata failed: HTTP " + std::to_string(code) +
+          ": " + body);
+    }
+    // {"metadata": {"signature_def": {"signature_def": {"serving_default":
+    //   {"inputs": {name: {"dtype": "DT_FLOAT", "tensor_shape":
+    //     {"dim": [{"size": "-1"}, ...]}}}, "outputs": {...}}}}}
+    std::string parse_err;
+    auto doc = tc::json::Parse(body, &parse_err);
+    if (doc == nullptr) {
+      return tc::Error("tfserving metadata parse: " + parse_err);
+    }
+    auto sig = Walk(
+        doc, {"metadata", "signature_def", "signature_def",
+              "serving_default"});
+    if (sig == nullptr) {
+      return tc::Error(
+          "tfserving metadata has no serving_default signature");
+    }
+    std::ostringstream out;
+    out << "{\"name\": \"" << model_name << "\", \"inputs\": [";
+    AppendTensors(sig->Get("inputs"), out);
+    out << "], \"outputs\": [";
+    AppendTensors(sig->Get("outputs"), out);
+    out << "]}";
+    *metadata_json = out.str();
+    // remember input dtypes for predict conversion
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    // TF REST carries the batch dim inside tensor shapes; expose a
+    // non-batching config and let shapes speak for themselves
+    *config_json = "{\"name\": \"" + model_name +
+                   "\", \"platform\": \"tensorflow_serving\", "
+                   "\"max_batch_size\": 0}";
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) override
+  {
+    return tc::Error("tfserving reports no per-model statistics");
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    std::ostringstream body;
+    body << "{\"inputs\": {";
+    bool first = true;
+    for (const auto& input : request.inputs) {
+      if (!input.shm_region.empty()) {
+        return tc::Error(
+            "tfserving backend does not support shared memory");
+      }
+      if (!first) {
+        body << ", ";
+      }
+      first = false;
+      body << "\"" << input.name << "\": ";
+      size_t cursor = 0;
+      std::ostringstream nested;
+      BuildNested(
+          input.datatype, input.data.data(), input.shape, 0, &cursor,
+          nested);
+      body << nested.str();
+    }
+    body << "}}";
+    long code;
+    std::string response;
+    tc::Error err = pool_->Request(
+        "POST", "/v1/models/" + request.model_name + ":predict",
+        body.str(), "application/json", &code, &response);
+    if (!err.IsOk()) {
+      result->status = err;
+      return err;
+    }
+    if (code != 200) {
+      result->status = tc::Error(
+          "tfserving predict failed: HTTP " + std::to_string(code) +
+          ": " + response);
+      return result->status;
+    }
+    std::string parse_err;
+    auto doc = tc::json::Parse(response, &parse_err);
+    if (doc == nullptr) {
+      result->status =
+          tc::Error("tfserving response parse: " + parse_err);
+      return result->status;
+    }
+    auto outputs = doc->Get("outputs");
+    result->outputs.clear();
+    result->request_id = request.request_id;
+    result->status = tc::Error::Success;
+    if (outputs != nullptr &&
+        outputs->type() == tc::json::Type::Object) {
+      for (const auto& kv : outputs->Members()) {
+        std::vector<uint8_t> raw;
+        FlattenTo(kv.second, "FP32", &raw);
+        result->outputs[kv.first] = std::move(raw);
+      }
+    } else if (outputs != nullptr) {  // single unnamed output
+      std::vector<uint8_t> raw;
+      FlattenTo(outputs, "FP32", &raw);
+      result->outputs["output"] = std::move(raw);
+    }
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback,
+      const BackendInferRequest& request) override
+  {
+    // non-blocking issue: rate schedules must not stall on slow servers
+    BackendInferRequest copy = request;
+    dispatch_->Enqueue([this, callback, copy = std::move(copy)]() {
+      BackendInferResult result;
+      Infer(&result, copy);
+      callback(std::move(result));
+    });
+    return tc::Error::Success;
+  }
+
+ private:
+  static tc::json::ValuePtr Walk(
+      const tc::json::ValuePtr& root,
+      const std::vector<std::string>& path)
+  {
+    tc::json::ValuePtr cur = root;
+    for (const auto& key : path) {
+      if (cur == nullptr) {
+        return nullptr;
+      }
+      cur = cur->Get(key);
+    }
+    return cur;
+  }
+
+  static void AppendTensors(
+      const tc::json::ValuePtr& tensors, std::ostringstream& out)
+  {
+    if (tensors == nullptr) {
+      return;
+    }
+    bool first = true;
+    for (const auto& kv : tensors->Members()) {
+      if (!first) {
+        out << ", ";
+      }
+      first = false;
+      const auto& info = kv.second;
+      std::string dtype = "FP32";
+      if (info->Has("dtype")) {
+        dtype = TfDtypeToKserve(info->Get("dtype")->AsString());
+      }
+      out << "{\"name\": \"" << kv.first << "\", \"datatype\": \""
+          << dtype << "\", \"shape\": [";
+      auto ts = info->Get("tensor_shape");
+      auto dims = ts != nullptr ? ts->Get("dim") : nullptr;
+      bool fd = true;
+      if (dims != nullptr) {
+        for (const auto& d : dims->Elements()) {
+          if (!fd) {
+            out << ", ";
+          }
+          fd = false;
+          int64_t size = JsonNum(d->Get("size"));
+          // TF uses -1 for the batch dim; the harness needs concrete
+          // shapes, so unknown dims default to 1
+          out << (size < 0 ? 1 : size);
+        }
+      }
+      out << "]}";
+    }
+  }
+
+  std::string host_;
+  int port_ = 8501;
+  std::unique_ptr<RestClientPool> pool_;
+  std::unique_ptr<RestDispatchPool> dispatch_;
+};
+
+// ============================================================================
+// TorchServe (HTTP inference API; reference client_backend/torchserve/ —
+// file-upload style input, JSON user data required)
+// ============================================================================
+
+class TorchServeBackend : public ClientBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    auto* b = new TorchServeBackend();
+    SplitHostPort(config.url, 8080, &b->host_, &b->port_);
+    b->pool_.reset(new RestClientPool(b->host_, b->port_));
+    b->dispatch_.reset(new RestDispatchPool(config.concurrency));
+    backend->reset(b);
+    return tc::Error::Success;
+  }
+
+  tc::Error ServerReady(bool* ready) override
+  {
+    long code;
+    std::string body;
+    tc::Error err = pool_->Request(
+        "GET", "/ping", "", "", &code, &body);
+    *ready = err.IsOk() && code == 200;
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelMetadata(
+      std::string* metadata_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    // TorchServe exposes no tensor metadata; fabricate the single
+    // BYTES input the reference uses (TORCHSERVE_INPUT, fed from
+    // --input-data; reference model_parser.h:89-115 InitTorchServe)
+    *metadata_json =
+        "{\"name\": \"" + model_name +
+        "\", \"inputs\": [{\"name\": \"TORCHSERVE_INPUT\", "
+        "\"datatype\": \"BYTES\", \"shape\": [1]}], "
+        "\"outputs\": [{\"name\": \"OUTPUT\", \"datatype\": \"BYTES\", "
+        "\"shape\": [1]}]}";
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelConfig(
+      std::string* config_json, const std::string& model_name,
+      const std::string& model_version) override
+  {
+    *config_json = "{\"name\": \"" + model_name +
+                   "\", \"platform\": \"torchserve\", "
+                   "\"max_batch_size\": 0}";
+    return tc::Error::Success;
+  }
+
+  tc::Error ModelStatistics(
+      std::string* stats_json, const std::string& model_name) override
+  {
+    return tc::Error("torchserve reports no per-model statistics");
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    if (request.inputs.empty()) {
+      result->status = tc::Error("torchserve requires input data");
+      return result->status;
+    }
+    const auto& input = request.inputs[0];
+    // BYTES tensors carry a 4-byte length prefix per element; the
+    // upload body is the first element's raw content
+    std::string body;
+    if (input.datatype == "BYTES" && input.data.size() >= 4) {
+      uint32_t len;
+      memcpy(&len, input.data.data(), 4);
+      size_t n = std::min((size_t)len, input.data.size() - 4);
+      body.assign((const char*)input.data.data() + 4, n);
+    } else {
+      body.assign(
+          (const char*)input.data.data(), input.data.size());
+    }
+    long code;
+    std::string response;
+    tc::Error err = pool_->Request(
+        "POST", "/predictions/" + request.model_name, body,
+        "application/octet-stream", &code, &response);
+    if (!err.IsOk()) {
+      result->status = err;
+      return err;
+    }
+    if (code != 200) {
+      result->status = tc::Error(
+          "torchserve predict failed: HTTP " + std::to_string(code) +
+          ": " + response);
+      return result->status;
+    }
+    result->request_id = request.request_id;
+    result->status = tc::Error::Success;
+    result->outputs.clear();
+    result->outputs["OUTPUT"].assign(
+        response.begin(), response.end());
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback,
+      const BackendInferRequest& request) override
+  {
+    BackendInferRequest copy = request;
+    dispatch_->Enqueue([this, callback, copy = std::move(copy)]() {
+      BackendInferResult result;
+      Infer(&result, copy);
+      callback(std::move(result));
+    });
+    return tc::Error::Success;
+  }
+
+ private:
+  std::string host_;
+  int port_ = 8080;
+  std::unique_ptr<RestClientPool> pool_;
+  std::unique_ptr<RestDispatchPool> dispatch_;
+};
+
+tc::Error
+CreateTFServeBackend(
+    std::shared_ptr<ClientBackend>* backend,
+    const BackendFactoryConfig& config)
+{
+  return TFServeBackend::Create(backend, config);
+}
+
+tc::Error
+CreateTorchServeBackend(
+    std::shared_ptr<ClientBackend>* backend,
+    const BackendFactoryConfig& config)
+{
+  return TorchServeBackend::Create(backend, config);
+}
+
+}  // namespace pa

@@ -1,0 +1,364 @@
+#include "rest_util.h"
+
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace pa {
+
+namespace {
+
+int
+ConnectTo(const std::string& host, int port, std::string* error)
+{
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  int rc =
+      getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints, &res);
+  if (rc != 0) {
+    *error = "failed to resolve " + host + ": " + gai_strerror(rc);
+    return -1;
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) {
+      continue;
+    }
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    *error = "unable to connect to " + host + ":" + std::to_string(port);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+tc::Error
+SendAll(int fd, const std::string& data)
+{
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t n = ::send(
+        fd, data.data() + sent, data.size() - sent, MSG_NOSIGNAL);
+    if (n <= 0) {
+      return tc::Error("send failed");
+    }
+    sent += (size_t)n;
+  }
+  return tc::Error::Success;
+}
+
+std::string
+BuildRequest(
+    const std::string& host, const std::string& method,
+    const std::string& path, const std::string& body,
+    const std::string& content_type, bool keep_alive)
+{
+  std::string request = method + " " + path + " HTTP/1.1\r\nHost: " +
+                        host + "\r\nConnection: " +
+                        (keep_alive ? "keep-alive" : "close") + "\r\n";
+  if (method == "POST") {
+    request += "Content-Type: " +
+               (content_type.empty() ? "application/json" : content_type) +
+               "\r\nContent-Length: " + std::to_string(body.size()) +
+               "\r\n";
+  }
+  request += "\r\n";
+  if (method == "POST") {
+    request += body;
+  }
+  return request;
+}
+
+// parse status + headers + body; returns false when the response must
+// terminate the connection (no Content-Length framing)
+tc::Error
+ReadResponse(
+    int fd, long* http_code, std::string* body, bool* reusable)
+{
+  *reusable = false;
+  std::string buf;
+  size_t header_end;
+  while (true) {
+    header_end = buf.find("\r\n\r\n");
+    if (header_end != std::string::npos) {
+      break;
+    }
+    char tmp[16384];
+    ssize_t n = ::recv(fd, tmp, sizeof(tmp), 0);
+    if (n <= 0) {
+      return tc::Error("connection closed while reading response");
+    }
+    buf.append(tmp, (size_t)n);
+  }
+  size_t line_end = buf.find("\r\n");
+  std::string status_line = buf.substr(0, line_end);
+  size_t sp = status_line.find(' ');
+  *http_code =
+      sp == std::string::npos
+          ? 0
+          : strtol(status_line.c_str() + sp + 1, nullptr, 10);
+  // scan headers for content-length / connection
+  bool have_length = false;
+  size_t content_length = 0;
+  bool close_after = false;
+  size_t pos = line_end + 2;
+  while (pos < header_end) {
+    size_t eol = buf.find("\r\n", pos);
+    std::string line = buf.substr(pos, eol - pos);
+    pos = eol + 2;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) {
+      continue;
+    }
+    std::string key = line.substr(0, colon);
+    for (auto& c : key) {
+      c = (char)tolower((unsigned char)c);
+    }
+    size_t vstart = colon + 1;
+    while (vstart < line.size() && line[vstart] == ' ') {
+      ++vstart;
+    }
+    std::string value = line.substr(vstart);
+    if (key == "content-length") {
+      have_length = true;
+      content_length = (size_t)strtoull(value.c_str(), nullptr, 10);
+    } else if (key == "connection") {
+      for (auto& c : value) {
+        c = (char)tolower((unsigned char)c);
+      }
+      close_after = value.find("close") != std::string::npos;
+    }
+  }
+  body->assign(buf.substr(header_end + 4));
+  if (have_length) {
+    while (body->size() < content_length) {
+      char tmp[16384];
+      size_t want = content_length - body->size();
+      ssize_t n = ::recv(
+          fd, tmp, want < sizeof(tmp) ? want : sizeof(tmp), 0);
+      if (n <= 0) {
+        return tc::Error("connection closed while reading body");
+      }
+      body->append(tmp, (size_t)n);
+    }
+    *reusable = !close_after;
+  } else {
+    // no framing info: read to close
+    char tmp[16384];
+    ssize_t n;
+    while ((n = ::recv(fd, tmp, sizeof(tmp), 0)) > 0) {
+      body->append(tmp, (size_t)n);
+    }
+    *reusable = false;
+  }
+  return tc::Error::Success;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+
+RestClient::RestClient(const std::string& host, int port)
+    : host_(host), port_(port)
+{
+}
+
+RestClient::~RestClient()
+{
+  Close();
+}
+
+void
+RestClient::Close()
+{
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+tc::Error
+RestClient::Connect()
+{
+  std::string error;
+  fd_ = ConnectTo(host_, port_, &error);
+  if (fd_ < 0) {
+    return tc::Error(error);
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
+RestClient::Request(
+    const std::string& method, const std::string& path,
+    const std::string& body, const std::string& content_type,
+    long* http_code, std::string* response_body)
+{
+  std::string request =
+      BuildRequest(host_, method, path, body, content_type, true);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    bool reused = fd_ >= 0;
+    if (!reused) {
+      tc::Error err = Connect();
+      if (!err.IsOk()) {
+        return err;
+      }
+    }
+    tc::Error err = SendAll(fd_, request);
+    if (err.IsOk()) {
+      bool reusable = false;
+      err = ReadResponse(fd_, http_code, response_body, &reusable);
+      if (err.IsOk()) {
+        if (!reusable) {
+          Close();
+        }
+        return tc::Error::Success;
+      }
+    }
+    Close();
+    if (!reused) {  // fresh connection failed: report, don't retry
+      return err;
+    }
+    // stale keep-alive connection: retry once on a fresh one
+  }
+  return tc::Error("request failed after reconnect");
+}
+
+tc::Error
+RestClientPool::Request(
+    const std::string& method, const std::string& path,
+    const std::string& body, const std::string& content_type,
+    long* http_code, std::string* response_body)
+{
+  std::unique_ptr<RestClient> client;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!idle_.empty()) {
+      client = std::move(idle_.back());
+      idle_.pop_back();
+    }
+  }
+  if (client == nullptr) {
+    client.reset(new RestClient(host_, port_));
+  }
+  tc::Error err = client->Request(
+      method, path, body, content_type, http_code, response_body);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    idle_.push_back(std::move(client));
+  }
+  return err;
+}
+
+RestDispatchPool::RestDispatchPool(int workers)
+{
+  for (int i = 0; i < workers; ++i) {
+    workers_.emplace_back(&RestDispatchPool::Worker, this);
+  }
+}
+
+RestDispatchPool::~RestDispatchPool()
+{
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    exiting_ = true;
+  }
+  cv_.notify_all();
+  for (auto& w : workers_) {
+    w.join();
+  }
+}
+
+void
+RestDispatchPool::Enqueue(std::function<void()> job)
+{
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(std::move(job));
+  }
+  cv_.notify_one();
+}
+
+void
+RestDispatchPool::Worker()
+{
+  while (true) {
+    std::function<void()> job;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return exiting_ || !queue_.empty(); });
+      if (exiting_ && queue_.empty()) {
+        return;
+      }
+      job = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    job();
+  }
+}
+
+// ---------------------------------------------------------------------------
+
+tc::Error
+RestRequest(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::string& body,
+    const std::string& content_type, long* http_code,
+    std::string* response_body)
+{
+  std::string error;
+  int fd = ConnectTo(host, port, &error);
+  if (fd < 0) {
+    return tc::Error(error);
+  }
+  std::string request =
+      BuildRequest(host, method, path, body, content_type, false);
+  tc::Error err = SendAll(fd, request);
+  if (err.IsOk()) {
+    bool reusable = false;
+    err = ReadResponse(fd, http_code, response_body, &reusable);
+  }
+  close(fd);
+  return err;
+}
+
+void
+SplitHostPort(
+    const std::string& url, int default_port, std::string* host, int* port)
+{
+  std::string u = url;
+  auto scheme = u.find("://");
+  if (scheme != std::string::npos) {
+    u = u.substr(scheme + 3);
+  }
+  auto slash = u.find('/');
+  if (slash != std::string::npos) {
+    u = u.substr(0, slash);
+  }
+  auto colon = u.rfind(':');
+  if (colon == std::string::npos) {
+    *host = u;
+    *port = default_port;
+  } else {
+    *host = u.substr(0, colon);
+    *port = atoi(u.c_str() + colon + 1);
+  }
+}
+
+}  // namespace pa

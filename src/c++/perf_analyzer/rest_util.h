@@ -1,0 +1,94 @@
+// Minimal HTTP helpers shared by the non-Triton REST backends
+// (TF-Serving, TorchServe) and the metrics scraper.
+
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+
+namespace pa {
+
+// One keep-alive HTTP/1.1 connection: reconnects on demand, frames
+// responses by Content-Length (or connection close as a fallback).
+// Not thread-safe; pool instances per concurrent caller.
+class RestClient {
+ public:
+  RestClient(const std::string& host, int port);
+  ~RestClient();
+
+  tc::Error Request(
+      const std::string& method, const std::string& path,
+      const std::string& body, const std::string& content_type,
+      long* http_code, std::string* response_body);
+
+ private:
+  tc::Error Connect();
+  void Close();
+
+  std::string host_;
+  int port_;
+  int fd_ = -1;
+};
+
+// Mutex-guarded pool of RestClients for concurrent perf workers.
+class RestClientPool {
+ public:
+  RestClientPool(const std::string& host, int port)
+      : host_(host), port_(port)
+  {
+  }
+
+  tc::Error Request(
+      const std::string& method, const std::string& path,
+      const std::string& body, const std::string& content_type,
+      long* http_code, std::string* response_body);
+
+ private:
+  std::string host_;
+  int port_;
+  std::mutex mu_;
+  std::vector<std::unique_ptr<RestClient>> idle_;
+};
+
+// Fixed-size dispatch pool so backend AsyncInfer stays non-blocking
+// (request-rate schedules depend on issue not stalling).
+class RestDispatchPool {
+ public:
+  explicit RestDispatchPool(int workers = 4);
+  ~RestDispatchPool();
+
+  void Enqueue(std::function<void()> job);
+
+ private:
+  void Worker();
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::vector<std::thread> workers_;
+  bool exiting_ = false;
+};
+
+// One-shot request (Connection: close framing); used by the metrics
+// scraper where a request a second doesn't warrant a pool.
+tc::Error RestRequest(
+    const std::string& host, int port, const std::string& method,
+    const std::string& path, const std::string& body,
+    const std::string& content_type, long* http_code,
+    std::string* response_body);
+
+// "host:port" (optional scheme/path) -> host, port (default_port when
+// absent).
+void SplitHostPort(
+    const std::string& url, int default_port, std::string* host,
+    int* port);
+
+}  // namespace pa

@@ -1,0 +1,124 @@
+// Client-timeout behavior against the delayed_identity fixture model
+// (role of reference src/c++/tests/client_timeout_test.cc — exercises
+// client_timeout_ deadlines on both protocols).
+
+#include <getopt.h>
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+// request against delayed_identity with the given server-side delay and
+// client timeout; returns whether the request succeeded
+template <typename ClientT>
+bool
+DelayedInfer(ClientT* client, uint32_t delay_us, uint64_t timeout_us)
+{
+  std::vector<int32_t> payload{7};
+  tc::InferInput* input0;
+  tc::InferInput* delay_in;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&delay_in, "DELAY_US", {1}, "UINT32"),
+      "creating DELAY_US");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0),
+      delay_ptr(delay_in);
+  input0_ptr->AppendRaw(
+      (const uint8_t*)payload.data(), sizeof(int32_t));
+  delay_ptr->AppendRaw((const uint8_t*)&delay_us, sizeof(delay_us));
+  tc::InferOptions options("delayed_identity");
+  options.client_timeout_us_ = timeout_us;
+  tc::InferResult* result = nullptr;
+  tc::Error err = client->Infer(
+      &result, options, {input0_ptr.get(), delay_ptr.get()});
+  bool ok = err.IsOk() && result != nullptr &&
+            result->RequestStatus().IsOk();
+  delete result;
+  return ok;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  std::string http_url("localhost:8000");
+  std::string grpc_url("localhost:8001");
+  int opt;
+  while ((opt = getopt(argc, argv, "u:g:")) != -1) {
+    switch (opt) {
+      case 'u':
+        http_url = optarg;
+        break;
+      case 'g':
+        grpc_url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&http_client, http_url, false),
+      "creating http client");
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&grpc_client, grpc_url, false),
+      "creating grpc client");
+
+  // generous timeout, no delay: must succeed
+  if (!DelayedInfer(http_client.get(), 0, 10 * 1000 * 1000)) {
+    std::cerr << "error: http infer failed with generous timeout"
+              << std::endl;
+    exit(1);
+  }
+  if (!DelayedInfer(grpc_client.get(), 0, 10 * 1000 * 1000)) {
+    std::cerr << "error: grpc infer failed with generous timeout"
+              << std::endl;
+    exit(1);
+  }
+
+  // 500 ms server-side delay with a 50 ms client deadline: must fail
+  if (DelayedInfer(http_client.get(), 500 * 1000, 50 * 1000)) {
+    std::cerr << "error: http infer ignored the client timeout"
+              << std::endl;
+    exit(1);
+  }
+  if (DelayedInfer(grpc_client.get(), 500 * 1000, 50 * 1000)) {
+    std::cerr << "error: grpc infer ignored the client timeout"
+              << std::endl;
+    exit(1);
+  }
+
+  // clients survive a timed-out request (fresh request succeeds)
+  if (!DelayedInfer(http_client.get(), 0, 10 * 1000 * 1000)) {
+    std::cerr << "error: http client broken after timeout" << std::endl;
+    exit(1);
+  }
+  if (!DelayedInfer(grpc_client.get(), 0, 10 * 1000 * 1000)) {
+    std::cerr << "error: grpc client broken after timeout" << std::endl;
+    exit(1);
+  }
+
+  std::cout << "client timeout test OK" << std::endl;
+  return 0;
+}

@@ -1,0 +1,134 @@
+// Loop inference and verify client-side memory stays bounded (role of
+// reference src/c++/tests/memory_leak_test.cc, which loops infer against
+// a live server watching for growth; RSS via getrusage here).
+
+#include <getopt.h>
+#include <sys/resource.h>
+#include <unistd.h>
+
+#include <iostream>
+#include <memory>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+long
+RssKb()
+{
+  struct rusage usage;
+  getrusage(RUSAGE_SELF, &usage);
+  return usage.ru_maxrss;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  std::string url("localhost:8000");
+  std::string protocol = "http";
+  int iterations = 2000;
+  long max_growth_kb = 32 * 1024;
+  int opt;
+  while ((opt = getopt(argc, argv, "u:i:n:g:")) != -1) {
+    switch (opt) {
+      case 'u':
+        url = optarg;
+        break;
+      case 'i':
+        protocol = optarg;
+        break;
+      case 'n':
+        iterations = atoi(optarg);
+        break;
+      case 'g':
+        max_growth_kb = atol(optarg);
+        break;
+      default:
+        std::cerr << "usage: " << argv[0]
+                  << " [-u url] [-i http|grpc] [-n iters] [-g max_kb]"
+                  << std::endl;
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  if (protocol == "grpc") {
+    FAIL_IF_ERR(
+        tc::InferenceServerGrpcClient::Create(&grpc_client, url, false),
+        "creating grpc client");
+  } else {
+    FAIL_IF_ERR(
+        tc::InferenceServerHttpClient::Create(&http_client, url, false),
+        "creating http client");
+  }
+
+  std::vector<int32_t> input0_data(16), input1_data(16, 1);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+  }
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  input0_ptr->AppendRaw(
+      (const uint8_t*)input0_data.data(),
+      input0_data.size() * sizeof(int32_t));
+  input1_ptr->AppendRaw(
+      (const uint8_t*)input1_data.data(),
+      input1_data.size() * sizeof(int32_t));
+  tc::InferOptions options("simple");
+  std::vector<tc::InferInput*> inputs{input0_ptr.get(), input1_ptr.get()};
+
+  auto infer_once = [&]() {
+    tc::InferResult* result = nullptr;
+    if (grpc_client != nullptr) {
+      FAIL_IF_ERR(
+          grpc_client->Infer(&result, options, inputs), "infer");
+    } else {
+      FAIL_IF_ERR(
+          http_client->Infer(&result, options, inputs), "infer");
+    }
+    FAIL_IF_ERR(result->RequestStatus(), "request status");
+    delete result;
+  };
+
+  // warmup establishes steady-state allocations (pools, buffers)
+  for (int i = 0; i < 200; ++i) {
+    infer_once();
+  }
+  long baseline_kb = RssKb();
+  for (int i = 0; i < iterations; ++i) {
+    infer_once();
+  }
+  long growth_kb = RssKb() - baseline_kb;
+  std::cout << "rss baseline " << baseline_kb << " KB, growth after "
+            << iterations << " iterations: " << growth_kb << " KB"
+            << std::endl;
+  if (growth_kb > max_growth_kb) {
+    std::cerr << "error: memory growth " << growth_kb << " KB exceeds "
+              << max_growth_kb << " KB" << std::endl;
+    exit(1);
+  }
+  std::cout << "memory leak test OK" << std::endl;
+  return 0;
+}

@@ -186,6 +186,60 @@ class _Handler(socketserver.StreamRequestHandler):
     def _send_json(self, obj, code=200, headers=None):
         self._send(code, json.dumps(obj).encode("utf-8"), headers)
 
+    def _send_metrics(self, core):
+        """Prometheus-style exposition (role of Triton's :8002/metrics;
+        scraped by perf_analyzer --collect-metrics,
+        reference metrics_manager.h:44-91).  Gauge names mirror the
+        nv_* families with TPU labels where the reference reports GPU."""
+        lines = []
+        try:
+            import resource
+
+            rss_bytes = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss * 1024
+            lines.append(
+                "# HELP nv_cpu_memory_used_bytes Server RSS.\n"
+                "# TYPE nv_cpu_memory_used_bytes gauge\n"
+                "nv_cpu_memory_used_bytes {}".format(rss_bytes))
+        except Exception:
+            pass
+        try:
+            import jax
+
+            devices = [
+                d for d in jax.devices() if d.platform != "cpu"
+            ]
+            for i, dev in enumerate(devices):
+                stats = {}
+                try:
+                    stats = dev.memory_stats() or {}
+                except Exception:
+                    pass
+                used = stats.get("bytes_in_use", 0)
+                total = stats.get("bytes_limit", 0)
+                label = '{{tpu="{}"}}'.format(i)
+                lines.append(
+                    "nv_gpu_memory_used_bytes{} {}".format(label, used))
+                lines.append(
+                    "nv_gpu_memory_total_bytes{} {}".format(label, total))
+                if total:
+                    lines.append(
+                        "nv_gpu_utilization{} {}".format(
+                            label, used / total))
+        except Exception:
+            pass
+        for stat in core.model_statistics()["model_stats"]:
+            label = '{{model="{}"}}'.format(stat["name"])
+            lines.append(
+                "nv_inference_count{} {}".format(
+                    label, stat["inference_count"]))
+            lines.append(
+                "nv_inference_exec_count{} {}".format(
+                    label, stat["execution_count"]))
+        self._send(
+            200, ("\n".join(lines) + "\n").encode("utf-8"),
+            content_type="text/plain")
+
     def _send_error_json(self, msg, code=400):
         self._send_json({"error": msg}, code)
 
@@ -218,6 +272,8 @@ class _Handler(socketserver.StreamRequestHandler):
             return self._send_json(core.server_metadata())
         if path == "/v2/models/stats":
             return self._send_json(core.model_statistics())
+        if path == "/metrics":
+            return self._send_metrics(core)
         if path == "/v2/logging":
             if method == "POST":
                 return self._send_json(

@@ -175,3 +175,46 @@ def test_perf_analyzer_inproc(cc_build, shm):
     )
     assert result.returncode == 0, result.stdout + result.stderr
     assert "Throughput:" in result.stdout
+
+
+def test_cc_memory_leak(cc_build, zoo_servers):
+    """C++ client RSS stays flat over repeated infers (reference
+    memory_leak_test.cc)."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "memory_leak_test"),
+         "-u", zoo_servers["http"], "-n", "1000"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "memory leak test OK" in result.stdout
+
+
+def test_cc_client_timeout(cc_build, zoo_servers):
+    """client_timeout_us is enforced and survivable on both protocols
+    (reference client_timeout_test.cc)."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "client_timeout_test"),
+         "-u", zoo_servers["http"], "-g", zoo_servers["grpc"]],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "client timeout test OK" in result.stdout
+
+
+def test_perf_analyzer_collect_metrics(cc_build, zoo_servers, tmp_path):
+    """--collect-metrics scrapes the server's /metrics on an interval and
+    lands the gauges as verbose-CSV columns (reference
+    metrics_manager.h:44-91)."""
+    csv_path = str(tmp_path / "metrics.csv")
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "simple",
+         "-u", zoo_servers["http"], "--collect-metrics",
+         "--metrics-url", zoo_servers["http"] + "/metrics",
+         "-p", "400", "--max-trials", "3",
+         "--stability-percentage", "90", "--verbose-csv",
+         "-f", csv_path],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    header, row = open(csv_path).read().strip().splitlines()[:2]
+    assert "nv_inference_count" in header or "nv_" in header, header

@@ -1,0 +1,125 @@
+"""perf_analyzer's TF-Serving and TorchServe backends against mock REST
+servers (roles of reference client_backend/tensorflow_serving/ and
+client_backend/torchserve/ — both beta backends there, driven against
+real serving stacks out-of-repo; here the protocol handling is verified
+against in-process mocks over real sockets)."""
+
+import json
+import os
+import subprocess
+import threading
+
+import pytest
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tests.test_cc_library import BUILD, cc_build  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _TFServeHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload, code=200):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.endswith("/metadata"):
+            self._json({
+                "model_spec": {"name": "addone"},
+                "metadata": {"signature_def": {"signature_def": {
+                    "serving_default": {
+                        "inputs": {"x": {
+                            "dtype": "DT_FLOAT",
+                            "tensor_shape": {"dim": [
+                                {"size": "-1"}, {"size": "4"}]},
+                        }},
+                        "outputs": {"y": {
+                            "dtype": "DT_FLOAT",
+                            "tensor_shape": {"dim": [
+                                {"size": "-1"}, {"size": "4"}]},
+                        }},
+                    }}}},
+            })
+        else:
+            self._json({"model_version_status": [
+                {"state": "AVAILABLE"}]})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        request = json.loads(self.rfile.read(length))
+        x = request["inputs"]["x"]
+
+        def addone(v):
+            if isinstance(v, list):
+                return [addone(e) for e in v]
+            return v + 1
+
+        self._json({"outputs": {"y": addone(x)}})
+
+
+class _TorchServeHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        body = b'{"status": "Healthy"}'
+        self.send_response(200 if self.path == "/ping" else 404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        body = json.dumps({"echo_bytes": len(payload)}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def mock_server():
+    servers = []
+
+    def start(handler):
+        server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        servers.append(server)
+        return "127.0.0.1:{}".format(server.server_address[1])
+
+    yield start
+    for server in servers:
+        server.shutdown()
+
+
+def test_perf_analyzer_tfserving(cc_build, mock_server):
+    url = mock_server(_TFServeHandler)
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "addone",
+         "--service-kind", "tfserving", "-u", url, "-p", "300",
+         "--max-trials", "3", "--stability-percentage", "90"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput" in result.stdout
+
+
+def test_perf_analyzer_torchserve(cc_build, mock_server):
+    url = mock_server(_TorchServeHandler)
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "resnet",
+         "--service-kind", "torchserve", "-u", url, "-p", "300",
+         "--max-trials", "3", "--stability-percentage", "90",
+         "--string-data", "dummy-image-bytes"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput" in result.stdout
