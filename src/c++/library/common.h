@@ -16,6 +16,7 @@
 #include <memory>
 #include <string>
 #include <vector>
+#include <mutex>
 
 namespace tc {
 
@@ -260,6 +261,7 @@ class InferenceServerClient {
 
   Error ClientInferStat(InferStat* infer_stat) const
   {
+    std::lock_guard<std::mutex> lk(stat_mu_);
     *infer_stat = infer_stat_;
     return Error::Success;
   }
@@ -269,6 +271,9 @@ class InferenceServerClient {
 
   bool verbose_;
   bool exiting_;
+  // async workers complete requests concurrently; the aggregate is
+  // guarded (reference serializes via its worker thread, common.h:135)
+  mutable std::mutex stat_mu_;
   InferStat infer_stat_;
 };
 

@@ -1152,6 +1152,25 @@ InferenceServerGrpcClient::StopStream()
   Error status = stream_status_;
   stream_call_.reset();
   stream_callback_ = nullptr;
+  lk.unlock();
+  // Quiesce: response callbacks already queued on the dispatch worker
+  // may still be executing (they snapshot stream_callback_ before this
+  // cleared it).  Wait for a sentinel to flow through the queue so no
+  // user callback runs after StopStream returns — callers may destroy
+  // state their callback captures by reference right after this.
+  {
+    std::mutex drain_mu;
+    std::condition_variable drain_cv;
+    bool drained = false;
+    EnqueueCallback([&]() {
+      std::lock_guard<std::mutex> dlk(drain_mu);
+      drained = true;
+      drain_cv.notify_all();
+    });
+    std::unique_lock<std::mutex> dlk(drain_mu);
+    drain_cv.wait_for(
+        dlk, std::chrono::seconds(10), [&]() { return drained; });
+  }
   return status;
 }
 

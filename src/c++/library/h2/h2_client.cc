@@ -131,7 +131,11 @@ H2Connection::Connect(
     return err;
   }
 
-  conn->reader_ = std::thread(&H2Connection::ReaderLoop, conn.get());
+  // the reader holds its own reference for the whole loop: external
+  // owners dropping theirs must not destroy the connection while
+  // ReaderLoop is mid-frame on this thread (owners call Shutdown() to
+  // stop the reader; the self-reference then unwinds cleanly)
+  conn->reader_ = std::thread([conn]() { conn->ReaderLoop(); });
   *connection = std::move(conn);
   return Error::Success;
 }

@@ -107,12 +107,16 @@ InferContext::SendStreamRequest(
 void
 InferContext::SendAsyncRequest(bool delayed)
 {
-  BackendInferRequest request = BuildRequest();
+  // the request owns the input payload buffers that zero-copy backends
+  // reference until the wire write completes — keep it alive until the
+  // completion callback has fired (its copy of the shared_ptr drops
+  // last)
+  auto request = std::make_shared<BackendInferRequest>(BuildRequest());
   uint64_t start = NowNs();
   thread_stat_->inflight++;
   auto thread_stat = thread_stat_;
   tc::Error err = backend_->AsyncInfer(
-      [thread_stat, start, delayed](BackendInferResult&& result) {
+      [thread_stat, start, delayed, request](BackendInferResult&& result) {
         uint64_t end = NowNs();
         {
           std::lock_guard<std::mutex> lk(thread_stat->mu);
@@ -121,7 +125,7 @@ InferContext::SendAsyncRequest(bool delayed)
         }
         thread_stat->inflight--;
       },
-      request);
+      *request);
   if (!err.IsOk()) {
     thread_stat_->inflight--;
     std::lock_guard<std::mutex> lk(thread_stat_->mu);
