@@ -820,10 +820,7 @@ InferenceServerGrpcClient::Infer(
   }
   timer.CaptureTimestamp(RequestTimers::Kind::RECV_END);
   timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
-  {
-    std::lock_guard<std::mutex> lk(stat_mu_);
-    UpdateInferStat(timer);
-  }
+  UpdateInferStat(timer);
   if (verbose_) {
     std::cerr << "ModelInfer: " << response->ShortDebugString() << std::endl;
   }
@@ -902,7 +899,6 @@ InferenceServerGrpcClient::AsyncInfer(
             timer->CaptureTimestamp(RequestTimers::Kind::RECV_END);
             timer->CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
             if (final_err.IsOk()) {
-              std::lock_guard<std::mutex> lk(stat_mu_);
               UpdateInferStat(*timer);
             }
             InferResultGrpc::Create(&result, std::move(response));
@@ -1096,7 +1092,6 @@ InferenceServerGrpcClient::StartStream(
             timer.CaptureTimestamp(RequestTimers::Kind::RECV_START);
             timer.CaptureTimestamp(RequestTimers::Kind::RECV_END);
             timer.CaptureTimestamp(RequestTimers::Kind::REQUEST_END);
-            std::lock_guard<std::mutex> lk2(stat_mu_);
             UpdateInferStat(timer);
           }
           // StopStream clears stream_callback_ as soon as stream_done_ is
@@ -1158,18 +1153,23 @@ InferenceServerGrpcClient::StopStream()
   // cleared it).  Wait for a sentinel to flow through the queue so no
   // user callback runs after StopStream returns — callers may destroy
   // state their callback captures by reference right after this.
-  {
-    std::mutex drain_mu;
-    std::condition_variable drain_cv;
-    bool drained = false;
-    EnqueueCallback([&]() {
-      std::lock_guard<std::mutex> dlk(drain_mu);
-      drained = true;
-      drain_cv.notify_all();
+  // Skipped when StopStream runs ON the worker (a stream callback
+  // stopping its own stream): the sentinel could never be dequeued.
+  if (std::this_thread::get_id() != worker_.get_id()) {
+    struct DrainState {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool drained = false;
+    };
+    auto state = std::make_shared<DrainState>();
+    EnqueueCallback([state]() {
+      std::lock_guard<std::mutex> dlk(state->mu);
+      state->drained = true;
+      state->cv.notify_all();
     });
-    std::unique_lock<std::mutex> dlk(drain_mu);
-    drain_cv.wait_for(
-        dlk, std::chrono::seconds(10), [&]() { return drained; });
+    std::unique_lock<std::mutex> dlk(state->mu);
+    state->cv.wait_for(
+        dlk, std::chrono::seconds(10), [&]() { return state->drained; });
   }
   return status;
 }

@@ -298,7 +298,6 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   std::atomic<uint64_t> keepalive_pings_{0};
   std::atomic<uint64_t> call_activity_{0};  // bumped per issued call
 
-  std::mutex stat_mu_;
 };
 
 }  // namespace tc

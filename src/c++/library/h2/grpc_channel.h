@@ -73,20 +73,17 @@ class GrpcChannel {
   Error Ping(int64_t timeout_ms) { return conn_->Ping(timeout_ms); }
   // Declare the connection dead: fail all in-flight calls and close the
   // socket (keepalive uses this when a PING ack is missed).
-  ~GrpcChannel()
-  {
-    // the reader thread keeps the connection alive via its own
-    // reference; an explicit Shutdown closes the socket so the reader
-    // exits and that reference unwinds
-    Shutdown();
-  }
-
   void Shutdown()
   {
     if (conn_) {
       conn_->Shutdown();
     }
   }
+
+  // the reader thread keeps the connection alive via its own reference;
+  // the explicit Shutdown closes the socket so the reader exits and
+  // that reference unwinds
+  ~GrpcChannel() { Shutdown(); }
   const std::string& Url() const { return url_; }
 
  private:
