@@ -28,6 +28,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpuserver.parallel.ring import ring_attention
+from tpuserver.parallel.ulysses import ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,6 +42,10 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16
+    # sequence-parallel attention: "ring" (ppermute K/V rotation — scales
+    # to any head count) or "ulysses" (two all_to_alls, full-sequence
+    # attention per head shard — needs local heads divisible by sp)
+    sp_strategy: str = "ring"
 
     @property
     def head_dim(self):
@@ -234,7 +239,19 @@ def _forward_spmd(params, tokens, cfg):
     t0 = lax.axis_index("sp") * T
     positions = t0 + jnp.arange(T)
 
+    if cfg.sp_strategy not in ("ring", "ulysses"):
+        raise ValueError(
+            "unknown sp_strategy '{}' (expected 'ring' or "
+            "'ulysses')".format(cfg.sp_strategy)
+        )
+
     def attn_fn(q, k, v):
+        if cfg.sp_strategy == "ulysses":
+            # unexpanded kv heads ride the all_to_alls; GQA replication
+            # happens after redistribution
+            return ulysses_attention(
+                q, k, v, axis_name="sp", causal=True, kv_repeat=n_rep,
+            )
         return ring_attention(
             q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
             axis_name="sp", causal=True,

@@ -19,3 +19,4 @@ from tpuserver.parallel.mesh import (  # noqa: F401
     shard_params,
 )
 from tpuserver.parallel.ring import ring_attention  # noqa: F401
+from tpuserver.parallel.ulysses import ulysses_attention  # noqa: F401

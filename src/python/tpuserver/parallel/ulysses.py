@@ -1,0 +1,127 @@
+"""Ulysses-style sequence parallelism: exact attention over a sequence
+sharded on the ``sp`` mesh axis via head redistribution.
+
+Where ring attention keeps the sequence sharded and rotates K/V blocks
+around the ring (neighbor ICI traffic, O(sp) steps), the all-to-all
+strategy re-shards ONCE: an ``all_to_all`` trades the sequence shards
+for head shards, every device then runs blockwise online-softmax
+attention over the FULL sequence for its subset of heads, and a second
+``all_to_all`` restores sequence sharding.  Communication is two
+all-to-alls regardless of sequence length — the better trade when heads
+divide evenly across the axis and the per-device activations fit in HBM.
+
+The per-shard attention reuses ring's flash-attention fold over fixed
+K/V blocks (fp32 accumulation), so the [T, T] score matrix never
+materializes here either.
+
+Used inside ``shard_map`` like :func:`ring_attention`; with sp=1 both
+all-to-alls are identities and this is plain blockwise attention.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tpuserver.parallel.ring import _fold_block
+
+
+def _blockwise_attention(q, k, v, scale, causal, block_size=512):
+    """Full-sequence exact attention via the online-softmax fold.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D].  K/V are folded in
+    ``block_size`` chunks so peak memory is O(Tq * block_size), not
+    O(Tq * Tk).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    qf = q.astype(jnp.float32)
+    o = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+    q_pos = jnp.arange(Tq)
+    for start in range(0, Tk, block_size):  # static unroll at trace time
+        stop = min(start + block_size, Tk)
+        k_pos = start + jnp.arange(stop - start)
+        o, m, l = _fold_block(
+            qf, k[:, start:stop], v[:, start:stop], o, m, l, q_pos, k_pos,
+            scale, causal,
+        )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _expand_heads(x, repeat):
+    """[B, T, Hkv, D] -> [B, T, Hkv*repeat, D] (GQA head replication)."""
+    if repeat == 1:
+        return x
+    b, t, h, d = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (b, t, h, repeat, d)
+    ).reshape(b, t, h * repeat, d)
+
+
+def ulysses_attention(
+    q, k, v, axis_name=None, causal=True, scale=None, kv_repeat=1,
+    block_size=512):
+    """Exact attention with q/k/v sequence-sharded on ``axis_name``.
+
+    q: [B, T_local, H, D]; k, v: [B, T_local, H_kv, D] with
+    ``H == H_kv * kv_repeat`` (pass ``kv_repeat > 1`` for GQA so the
+    all-to-alls move the UNexpanded kv heads — expansion happens after
+    redistribution when the kv head count allows it).  H must be
+    divisible by the ``axis_name`` axis size.  Outside shard_map
+    (axis_name=None) this is plain blockwise attention.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if axis_name is None:
+        return _blockwise_attention(
+            q, _expand_heads(k, kv_repeat), _expand_heads(v, kv_repeat),
+            scale, causal, block_size)
+    sp = lax.axis_size(axis_name)
+    if sp == 1:
+        return _blockwise_attention(
+            q, _expand_heads(k, kv_repeat), _expand_heads(v, kv_repeat),
+            scale, causal, block_size)
+    heads = q.shape[2]
+    if heads % sp != 0:
+        raise ValueError(
+            "ulysses attention needs heads ({}) divisible by the '{}' "
+            "axis size ({}); use ring_attention otherwise".format(
+                heads, axis_name, sp
+            )
+        )
+    # expand kv heads only as far as divisibility by sp requires; the
+    # rest of the GQA replication happens after the all_to_all so the
+    # wire carries as few kv copies as possible
+    kv_heads = k.shape[2]
+    pre = 1
+    while (kv_heads * pre) % sp != 0:
+        pre += 1
+    if pre > kv_repeat:
+        raise ValueError(
+            "kv heads ({}) times kv_repeat ({}) must be divisible by "
+            "the '{}' axis size ({})".format(
+                kv_heads, kv_repeat, axis_name, sp
+            )
+        )
+    post = kv_repeat // pre
+    if (kv_repeat % pre) != 0:
+        # uneven split: fall back to full pre-expansion
+        pre, post = kv_repeat, 1
+    k = _expand_heads(k, pre)
+    v = _expand_heads(v, pre)
+
+    # [B, T/sp, H, D] -> [B, T, H/sp, D]: trade sequence shards for head
+    # shards (tiled all_to_all splits dim 2 across the axis and
+    # concatenates the received pieces along dim 1)
+    q = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    k = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = _blockwise_attention(
+        q, _expand_heads(k, post), _expand_heads(v, post), scale, causal,
+        block_size)
+    # [B, T, H/sp, D] -> [B, T/sp, H, D]: restore sequence sharding
+    return lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
