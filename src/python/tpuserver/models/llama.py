@@ -46,6 +46,10 @@ class LlamaConfig:
     # to any head count) or "ulysses" (two all_to_alls, full-sequence
     # attention per head shard — needs local heads divisible by sp)
     sp_strategy: str = "ring"
+    # single-shard attention: "xla" (fused by the compiler) or "pallas"
+    # (the hand-tiled flash kernel, tpuserver.ops.flash_attention;
+    # needs T divisible by its block sizes)
+    attn_impl: str = "xla"
 
     @property
     def head_dim(self):
@@ -195,6 +199,18 @@ def forward(params, tokens, cfg):
     positions = jnp.arange(T)
 
     def attn_fn(q, k, v):
+        if cfg.attn_impl == "pallas":
+            import math
+
+            from tpuserver.ops import flash_attention
+
+            # largest power-of-two-ish block that divides T, capped at
+            # the MXU-friendly 128 (gcd handles any sequence length)
+            block = math.gcd(T, 128)
+            return flash_attention(
+                q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
+                causal=True, block_q=block, block_k=block,
+            )
         return ring_attention(
             q, _expand_kv(k, n_rep), _expand_kv(v, n_rep), causal=True
         )

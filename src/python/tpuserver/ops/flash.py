@@ -1,0 +1,135 @@
+"""Pallas flash-attention forward kernel for TPU.
+
+The hot op of the llama serving/training paths, hand-tiled for the MXU:
+the grid walks (batch*heads, query blocks, K/V blocks) with the K/V
+block dimension innermost, so VMEM only ever holds one [block_q, D]
+query tile and one [block_k, D] K/V tile — sequence length is bounded
+by HBM, not VMEM.  The online-softmax state (running max, normalizer,
+output accumulator) lives in VMEM scratch carried across the K/V grid
+steps; accumulation is fp32 (MXU-native via preferred_element_type)
+regardless of input dtype, and causal query blocks skip fully-masked
+K/V blocks via predication.
+
+On non-TPU backends the kernel runs in interpret mode (same math,
+Python-level execution) so tests pin it against the dense reference on
+the CPU mesh; on TPU it compiles through Mosaic.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal,
+    block_q, block_k):
+    """One (batch*head, q-block, k-block) program.
+
+    q_ref: [block_q, D]; k_ref/v_ref: [block_k, D]; o_ref: [block_q, D];
+    scratch m/l: [block_q, 1] fp32, acc: [block_q, D] fp32 — carried
+    across the (sequential) k-block grid dimension.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: K/V blocks wholly above the diagonal contribute nothing
+    live = (
+        ki * block_k <= qi * block_q + (block_q - 1)
+        if causal
+        else True
+    )
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k = k_ref[:].astype(jnp.float32)
+        v = v_ref[:].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+        m = m_scr[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # fully-masked rows keep m_new == -inf; exp(-inf - -inf) is nan,
+        # so pin the shift to a finite value there
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[:, None])
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[:, 0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def flash_attention(
+    q, k, v, causal=True, scale=None, block_q=128, block_k=128,
+    interpret=None):
+    """Exact attention, q/k/v [B, T, H, D] -> [B, T, H, D].
+
+    Drop-in for the XLA attention paths; T must be divisible by
+    ``block_q`` and ``block_k`` (pick smaller blocks for short or odd
+    sequences).  ``interpret=None`` auto-selects interpret mode off-TPU.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t, h, d = q.shape
+    t_kv = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t_kv)
+    if t % block_q or t_kv % block_k:
+        raise ValueError(
+            "sequence lengths ({}, {}) must divide by block sizes "
+            "({}, {})".format(t, t_kv, block_q, block_k))
+
+    # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head)
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t_kv, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t_kv, d)
+
+    kernel = functools.partial(
+        _attn_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, block_q, d), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
