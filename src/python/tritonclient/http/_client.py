@@ -56,39 +56,179 @@ class InferAsyncRequest:
 
 
 class _PooledConnection:
-    """A keep-alive HTTP/1.1 connection with raw send/recv helpers."""
+    """A keep-alive HTTP/1.1 connection with raw send/recv helpers.
+
+    Plain-HTTP requests ride a hand-rolled socket path: stdlib
+    http.client burns ~250 us/request in its email-module header parser,
+    which dominates small-tensor infer latency (the reference picks
+    geventhttpclient's C parser for the same reason,
+    reference http/_client.py:155-180).  HTTPS falls back to
+    http.client for its TLS plumbing.
+    """
 
     def __init__(self, scheme, host, port, connection_timeout, network_timeout,
                  ssl_context):
-        import http.client
-
+        self._scheme = scheme
+        self._host = host
+        self._port = port
+        self._connection_timeout = connection_timeout
         self._network_timeout = network_timeout
+        self._conn = None  # https fallback (http.client connection)
+        self._sock = None
+        self._buf = bytearray()
         if scheme == "https":
+            import http.client
+
             self._conn = http.client.HTTPSConnection(
                 host, port, timeout=connection_timeout, context=ssl_context
             )
-        else:
-            self._conn = http.client.HTTPConnection(
-                host, port, timeout=connection_timeout
-            )
 
-    def request(self, method, path, body, headers):
+    # -- https fallback ----------------------------------------------------
+
+    def _request_https(self, method, path, body, headers):
         if self._conn.sock is None:
             self._conn.connect()
-        # Configure the socket before any bytes are written so NODELAY
-        # covers the (possibly large, binary-tensor) send path.
         self._conn.sock.settimeout(self._network_timeout)
         self._conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._conn.request(method, path, body=body, headers=headers)
         resp = self._conn.getresponse()
-        resp_body = resp.read()
-        return resp.status, dict(resp.headers), resp_body
+        return resp.status, dict(resp.headers), resp.read()
+
+    # -- raw-socket fast path ---------------------------------------------
+
+    def _connect(self):
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connection_timeout
+        )
+        self._sock.settimeout(self._network_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._buf = bytearray()
+
+    def _read_more(self):
+        chunk = self._sock.recv(65536)
+        if not chunk:
+            raise ConnectionError("connection closed by server")
+        self._buf += chunk  # bytearray += is amortized in-place
+
+    def _read_exact(self, n):
+        if len(self._buf) >= n:
+            out = bytes(self._buf[:n])
+            del self._buf[:n]
+            return out
+        # large read: drain the buffer, then recv_into the remainder
+        out = bytearray(n)
+        have = len(self._buf)
+        out[:have] = self._buf
+        del self._buf[:]
+        view = memoryview(out)
+        while have < n:
+            got = self._sock.recv_into(view[have:])
+            if not got:
+                raise ConnectionError("connection closed by server")
+            have += got
+        return bytes(out)
+
+    def _read_line(self):
+        start = 0
+        while True:
+            eol = self._buf.find(b"\r\n", start)
+            if eol >= 0:
+                line = bytes(self._buf[:eol])
+                del self._buf[:eol + 2]
+                return line
+            start = max(0, len(self._buf) - 1)
+            self._read_more()
+
+    @staticmethod
+    def _check_header(key, value):
+        text = "{}{}".format(key, value)
+        if "\r" in text or "\n" in text:
+            raise ValueError(
+                "invalid CR/LF in header {!r}".format(key))
+
+    def request(self, method, path, body, headers):
+        if self._conn is not None:
+            return self._request_https(method, path, body, headers)
+        if self._sock is None:
+            self._connect()
+        if "\r" in path or "\n" in path or " " in path:
+            raise ValueError("invalid characters in request path")
+        head = [
+            "{} {} HTTP/1.1".format(method, path),
+            "Host: {}:{}".format(self._host, self._port),
+        ]
+        for key, value in headers.items():
+            self._check_header(key, value)
+            head.append("{}: {}".format(key, value))
+        request = "\r\n".join(head).encode("latin-1") + b"\r\n\r\n"
+        if body:
+            # writev without concatenating the (possibly large) body;
+            # sendmsg may send partially, so advance views until drained
+            views = [memoryview(request), memoryview(body)]
+            while views:
+                sent = self._sock.sendmsg(views)
+                while views and sent >= len(views[0]):
+                    sent -= len(views[0])
+                    views.pop(0)
+                if views and sent:
+                    views[0] = views[0][sent:]
+        else:
+            self._sock.sendall(request)
+
+        status_line = self._read_line()
+        parts = status_line.split(None, 2)
+        status = int(parts[1])
+        resp_headers = {}
+        while True:
+            line = self._read_line()
+            if not line:
+                break
+            key, _, value = line.partition(b":")
+            resp_headers[key.decode("latin-1").strip()] = (
+                value.decode("latin-1").strip()
+            )
+        lowered = {k.lower(): v for k, v in resp_headers.items()}
+        if status in (204, 304) or 100 <= status < 200:
+            resp_body = b""  # bodiless by status (RFC 9112 6.3)
+        elif lowered.get("transfer-encoding", "").lower() == "chunked":
+            pieces = []
+            while True:
+                size = int(self._read_line().split(b";")[0], 16)
+                if size == 0:
+                    while self._read_line():  # trailers until blank line
+                        pass
+                    break
+                pieces.append(self._read_exact(size))
+                self._read_exact(2)  # CRLF after each chunk
+            resp_body = b"".join(pieces)
+        elif "content-length" in lowered:
+            resp_body = self._read_exact(int(lowered["content-length"]))
+        else:  # no framing: read to close
+            try:
+                while True:
+                    self._read_more()
+            except ConnectionError:
+                pass
+            resp_body = bytes(self._buf)
+            self._buf = bytearray()
+            self.close()
+        if lowered.get("connection", "").lower() == "close":
+            self.close()
+        return status, resp_headers, resp_body
 
     def close(self):
-        try:
-            self._conn.close()
-        except Exception:
-            pass
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
+            self._sock = None
+        self._buf = bytearray()
 
 
 class InferenceServerClient:

@@ -292,3 +292,46 @@ def test_sequence_idle_expiry_direct(server_core):
             model.max_sequence_idle_us = old_idle
         server_core._sequence_state.pop(
             ("sequence_accumulate", 802), None)
+
+
+def test_pooled_connection_chunked_keepalive():
+    """The raw-socket connection decodes chunked responses (with
+    trailers) and keeps the connection reusable afterwards — tpuserver
+    always sends Content-Length, so this pins the branch real Triton
+    deployments behind proxies can hit."""
+    import socketserver
+    import threading
+
+    from tritonclient.http._client import _PooledConnection
+
+    class Srv(socketserver.ThreadingTCPServer):
+        daemon_threads = True
+        allow_reuse_address = True
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                while self.rfile.readline().strip():
+                    pass
+                self.wfile.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Transfer-Encoding: chunked\r\n\r\n"
+                    b"6\r\nhello \r\n5\r\nworld\r\n"
+                    b"0\r\nX-Trailer: 1\r\n\r\n")
+                self.wfile.flush()
+
+    server = Srv(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = _PooledConnection(
+            "http", "127.0.0.1", server.server_address[1], 5, 5, None)
+        for _ in range(3):
+            status, headers, body = conn.request("GET", "/x", None, {})
+            assert status == 200
+            assert body == b"hello world"
+        conn.close()
+    finally:
+        server.shutdown()
