@@ -218,3 +218,33 @@ def test_perf_analyzer_collect_metrics(cc_build, zoo_servers, tmp_path):
     assert result.returncode == 0, result.stdout + result.stderr
     header, row = open(csv_path).read().strip().splitlines()[:2]
     assert "nv_inference_count" in header or "nv_" in header, header
+
+
+def test_perf_analyzer_multiprocess_barrier(cc_build, zoo_servers):
+    """Two perf_analyzer processes measure the same interval via the TCP
+    coordination barrier (--enable-mpi without mpirun; reference
+    mpi_utils.h:32-83 + perf_analyzer.cc:353-368)."""
+    import socket
+
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+    processes = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PA_COORD_RANK": str(rank),
+            "PA_COORD_SIZE": "2",
+            "PA_COORD_ADDR": "127.0.0.1:{}".format(port),
+        })
+        processes.append(subprocess.Popen(
+            [os.path.join(cc_build, "perf_analyzer"), "-m", "simple",
+             "-u", zoo_servers["http"], "--enable-mpi", "-p", "400",
+             "--max-trials", "3", "--stability-percentage", "90"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    outs = [p.communicate(timeout=180) for p in processes]
+    for p, (out, err) in zip(processes, outs):
+        assert p.returncode == 0, out + err
+        assert "Throughput" in out
