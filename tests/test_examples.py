@@ -80,3 +80,30 @@ def test_example(zoo_servers, script, proto, extra):
         script + "\n" + result.stdout + "\n" + result.stderr
     )
     assert "PASS" in result.stdout, result.stdout
+
+
+def test_llama_streaming_example():
+    """Token streaming with KV parked in XLA shm — BASELINE config #5's
+    user-facing client (own tiny-llama server; the shared zoo omits
+    llama to keep the rest of the suite fast)."""
+    from tpuserver.core import InferenceServer
+    from tpuserver.grpc_frontend import GrpcFrontend
+    from tpuserver.models import llama
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+
+    core = InferenceServer([LlamaGenerateModel(cfg=llama.tiny(vocab=256))])
+    frontend = GrpcFrontend(core, port=0).start()
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.path.join(REPO, "src", "python")
+        env["JAX_PLATFORMS"] = "cpu"
+        result = subprocess.run(
+            [sys.executable,
+             os.path.join(EXAMPLES_DIR, "llama_streaming_client.py"),
+             "-u", "127.0.0.1:{}".format(frontend.port), "-n", "3"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "PASS" in result.stdout
+    finally:
+        frontend.stop()
