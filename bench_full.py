@@ -454,8 +454,12 @@ def main():
                                            err.__traceback__)
             ),
         }), file=sys.stderr, flush=True)
-    if failures:
-        sys.exit(1)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard exit: long runs can leave stray library threads (grpc/jax
+    # teardown) that would stall interpreter shutdown after all results
+    # are already flushed
+    os._exit(1 if failures else 0)
 
 
 if __name__ == "__main__":
