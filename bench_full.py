@@ -385,11 +385,71 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
                  max_tokens=max_tokens)
 
 
+def bench_vision_core(window_s, windows):
+    """Config-2 data-plane comparison at the server core (no sockets):
+    in-band numpy input vs a device-parked XLA-shm input with shm-
+    delivered output.  The end-to-end ratio is tunnel-noise-bound on a
+    remote chip; this isolates the host<->device traffic the XLA plane
+    exists to remove."""
+    import jax.numpy as jnp
+
+    from tpuserver.core import InferenceServer, InferRequest, RequestedOutput
+    from tpuserver.models import serving_models
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    core = InferenceServer(
+        serving_models(include_bert=False, include_llama=False))
+    img = np.random.RandomState(0).rand(1, 224, 224, 3).astype(np.float32)
+
+    inband = InferRequest("resnet50", inputs={"INPUT": img})
+    rate_in, p50_in = _measure(
+        lambda: core.infer(inband), window_s, windows, warmup=5)
+    _emit(2, "resnet50_core_inband", rate_in, "infer/sec", None,
+          p50_usec=round(p50_in, 1))
+
+    h_in = xshm.create_shared_memory_region("core_xin", img.nbytes)
+    h_out = xshm.create_shared_memory_region("core_xout", 4000)
+    core.register_xla_shm(
+        "core_xin", xshm.get_raw_handle(h_in), 0, img.nbytes)
+    core.register_xla_shm(
+        "core_xout", xshm.get_raw_handle(h_out), 0, 4000)
+    try:
+        xshm.set_shared_memory_region_from_jax(h_in, [jnp.asarray(img)])
+        arr = core.read_shm_input(
+            "core_xin", img.nbytes, 0, "FP32", [1, 224, 224, 3])
+        shm_req = InferRequest(
+            "resnet50", inputs={"INPUT": arr},
+            requested_outputs=[RequestedOutput(
+                "OUTPUT", shm_region="core_xout", shm_byte_size=4000)])
+        rate_shm, p50_shm = _measure(
+            lambda: core.infer(shm_req), window_s, windows, warmup=5)
+        _emit(2, "resnet50_core_xla_shm", rate_shm, "infer/sec", None,
+              p50_usec=round(p50_shm, 1))
+        print(json.dumps({
+            "config": 2, "metric": "resnet50_core_xla_vs_inband",
+            "value": round(rate_shm / rate_in, 4), "unit": "ratio",
+            "vs_baseline": None,
+        }), flush=True)
+    finally:
+        core.unregister_xla_shm()
+        xshm.destroy_shared_memory_region(h_in)
+        xshm.destroy_shared_memory_region(h_out)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", default="1,2,3,4,5")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--core-only", action="store_true",
+        help="config-2 data-plane comparison at the server core "
+             "(no sockets; isolates the host<->device traffic)")
     args = ap.parse_args()
+    if args.core_only:
+        bench_vision_core(0.5 if args.quick else 2.0,
+                          2 if args.quick else 5)
+        sys.stdout.flush()
+        os._exit(0)
     wanted = {int(c) for c in args.configs.split(",")}
     window_s = 0.5 if args.quick else 2.0
     windows = 2 if args.quick else 5
