@@ -439,5 +439,8 @@ class GrpcFrontend:
 
     def stop(self, grace=None):
         if self._server is not None:
-            self._server.stop(grace).wait()
+            # bounded wait: a handler thread wedged in user/model code
+            # (e.g. a compile) cannot be interrupted and must not hang
+            # the owner's shutdown forever
+            self._server.stop(grace).wait(timeout=10)
             self._server = None
