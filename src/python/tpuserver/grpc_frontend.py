@@ -442,5 +442,12 @@ class GrpcFrontend:
             # bounded wait: a handler thread wedged in user/model code
             # (e.g. a compile) cannot be interrupted and must not hang
             # the owner's shutdown forever
-            self._server.stop(grace).wait(timeout=10)
+            if not self._server.stop(grace).wait(timeout=10):
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "grpc frontend did not terminate within 10s "
+                    "(a handler thread is still running); the port may "
+                    "stay bound"
+                )
             self._server = None

@@ -435,3 +435,33 @@ def prefill(params, cache, tokens, cfg):
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
     logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
+
+
+def decode_chunk(params, cache, logits, pos, cfg, chunk):
+    """Greedy-decode ``chunk`` tokens in ONE device dispatch.
+
+    Steady-state decode is dispatch-latency-bound when the host is far
+    from the chip (each per-token round trip costs a full host<->device
+    hop); scanning a fixed chunk of argmax+decode_step pairs inside one
+    jitted call amortizes that hop over ``chunk`` tokens.  Greedy
+    sampling keeps the result bit-identical to per-token decode.
+
+    logits: [B, vocab] for the NEXT position (from prefill or the prior
+    chunk).  Returns (tokens [chunk, B], logprobs [chunk, B],
+    next_logits, cache); positions pos..pos+chunk-1 are written.
+    """
+    from jax import lax
+
+    def body(carry, _):
+        logits, cache, pos = carry
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok_logp = jnp.take_along_axis(
+            logp, token[:, None], axis=-1)[:, 0]
+        next_logits, cache = decode_step(params, cache, token, pos, cfg)
+        return (next_logits, cache, pos + 1), (token, tok_logp)
+
+    (next_logits, cache, _), (tokens, logps) = lax.scan(
+        body, (logits, cache, pos), None, length=chunk
+    )
+    return tokens, logps, next_logits, cache

@@ -41,13 +41,27 @@ class LlamaGenerateModel(Model):
         TensorSpec("LOGPROB", "FP32", [1]),
     )
 
-    def __init__(self, cfg=None, max_seq=512, server=None):
+    # tokens greedy-decoded per device dispatch: the steady state is
+    # dispatch-latency-bound on remote chips, so a scanned chunk
+    # amortizes the host<->device hop over several tokens (each token
+    # still streams as its own decoupled response)
+    decode_chunk = 8
+
+    def __init__(self, cfg=None, max_seq=512, server=None,
+                 decode_chunk=None):
         self._cfg = cfg or llama.tiny(vocab=2048)
         self._max_seq = max_seq
         self._server = server  # for kv_cache_region xla-shm lookups
         self._params = None
         self._prefill = None
         self._decode = None
+        self._decode_chunk = None
+        if decode_chunk is not None:
+            if decode_chunk < 1:
+                raise ValueError(
+                    "decode_chunk must be >= 1 (got {})".format(
+                        decode_chunk))
+            self.decode_chunk = decode_chunk
         self._lock = threading.Lock()
 
     def attach_server(self, server):
@@ -70,6 +84,12 @@ class LlamaGenerateModel(Model):
                 )
                 self._decode = jax.jit(
                     functools.partial(llama.decode_step, cfg=self._cfg),
+                    donate_argnums=(1,),
+                )
+                self._decode_chunk = jax.jit(
+                    functools.partial(
+                        llama.decode_chunk, cfg=self._cfg,
+                        chunk=self.decode_chunk),
                     donate_argnums=(1,),
                 )
 
@@ -141,23 +161,41 @@ class LlamaGenerateModel(Model):
                 )
                 pos += 1
 
-        for i in range(max_tokens):
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            token_id = int(token[0])
-            yield {
-                "TOKEN": np.array([token_id], dtype=np.int32),
-                "LOGPROB": np.array(
-                    [float(logp[0, token_id])], dtype=np.float32
-                ),
-            }
-            # the trailing decode only matters if another token follows or
-            # the cache is being parked for resumption
-            if i + 1 < max_tokens or region is not None:
-                logits, cache = self._decode(
-                    self._params, cache, token, pos
+        emitted = 0
+        while emitted < max_tokens:
+            n = min(self.decode_chunk, max_tokens - emitted)
+            if n == self.decode_chunk:
+                # full chunk: one dispatch greedy-decodes chunk tokens
+                tokens_dev, logps_dev, logits, cache = self._decode_chunk(
+                    self._params, cache, logits, pos
                 )
-                pos += 1
+                # one device->host transfer for both arrays: on remote
+                # chips each fetch costs a full round trip
+                tokens_all, logps_all = jax.device_get(
+                    (tokens_dev, logps_dev))
+                tokens_host = tokens_all[:, 0]
+                logps_host = logps_all[:, 0]
+                pos += n
+            else:
+                # tail shorter than the compiled chunk: per-token steps
+                tokens_host = np.empty((n,), np.int32)
+                logps_host = np.empty((n,), np.float32)
+                for i in range(n):
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    tokens_host[i] = int(token[0])
+                    logps_host[i] = float(logp[0, tokens_host[i]])
+                    if i + 1 < n or region is not None:
+                        logits, cache = self._decode(
+                            self._params, cache, token, pos
+                        )
+                        pos += 1
+            for i in range(n):
+                yield {
+                    "TOKEN": np.array([tokens_host[i]], dtype=np.int32),
+                    "LOGPROB": np.array([logps_host[i]], dtype=np.float32),
+                }
+            emitted += n
 
         if region is not None:
             # park the device-resident cache in the XLA region (zero-copy

@@ -168,3 +168,36 @@ def test_llama_generate_rejects_overflow(zoo_core):
     )
     with pytest.raises(ServerError, match="exceeds"):
         list(zoo_core.infer_stream(req))
+
+
+def test_llama_chunked_decode_matches_per_token():
+    """Scanned decode chunks are bit-identical to per-token decode across
+    full chunks AND the sub-chunk tail (greedy sampling)."""
+    from tpuserver.models import llama as llama_mod
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+
+    def tokens_with(chunk, n_tokens):
+        core = InferenceServer([
+            LlamaGenerateModel(
+                cfg=llama_mod.tiny(vocab=256), decode_chunk=chunk)
+        ])
+        req = InferRequest("llama_generate", inputs={
+            "PROMPT_IDS": np.array([1, 2, 3, 4], dtype=np.int32),
+            "MAX_TOKENS": np.array([n_tokens], dtype=np.int32),
+        })
+        out = []
+        for resp in core.infer_stream(req):
+            for spec, arr, _ in resp.outputs:
+                if spec["name"] == "TOKEN":
+                    out.append(int(arr[0]))
+        return out
+
+    n = 19  # 2 full chunks of 8 + a 3-token tail
+    per_token = tokens_with(1, n)
+    chunked = tokens_with(8, n)
+    assert len(per_token) == n
+    assert per_token == chunked
+
+    with pytest.raises(ValueError):
+        LlamaGenerateModel(
+            cfg=llama_mod.tiny(vocab=256), decode_chunk=0)
