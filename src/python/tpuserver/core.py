@@ -469,6 +469,13 @@ class InferenceServer:
             )
         return model
 
+    def requires_stream_order(self, name, version=""):
+        """Whether stream requests to this model must execute in arrival
+        order: decoupled response bursts are contractual, and sequence
+        state depends on step order."""
+        model = self._get_model(name, version)
+        return bool(model.decoupled or model.sequence)
+
     def model_ready(self, name, version=""):
         model = self._models.get(name)
         return (

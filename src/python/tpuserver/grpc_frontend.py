@@ -338,23 +338,122 @@ class _CoreBridge:
         resp = self._core.infer(core_request)
         return self._response_to_proto(resp)
 
+    # concurrent in-flight non-decoupled requests per stream: clients
+    # pipeline on one bidi stream, and serializing every dispatch would
+    # waste the device while a response is in flight
+    STREAM_CONCURRENCY = 8
+
     def ModelStreamInfer(self, request_iterator, context):
         """Bidi stream: each request may yield 0..N responses (decoupled
         models); errors are delivered in-band via error_message so the
-        stream survives bad requests (reference server semantics)."""
-        for request in request_iterator:
+        stream survives bad requests (reference server semantics).
+
+        Non-decoupled requests execute concurrently (bounded) and their
+        responses interleave in completion order — each response carries
+        its request id, matching server stream semantics.  Decoupled
+        requests keep strict sequential handling: their multi-response
+        ordering is part of the model's contract.
+        """
+        import queue as _queue
+        import threading as _threading
+
+        # bounded: restores response backpressure that direct generator
+        # yields gave (a slow reader must slow producers, not buffer
+        # unboundedly)
+        out = _queue.Queue(maxsize=self.STREAM_CONCURRENCY * 4)
+        inflight = _threading.Semaphore(self.STREAM_CONCURRENCY)
+        pending = [0]
+        done_feeding = _threading.Event()
+        cancelled = _threading.Event()
+        lock = _threading.Lock()
+        _SENTINEL = object()
+
+        def emit(item):
+            """put with cancellation: a gone client must not wedge
+            producer threads on a full queue."""
+            while not cancelled.is_set():
+                try:
+                    out.put(item, timeout=0.25)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def finish_one():
+            with lock:
+                pending[0] -= 1
+                if pending[0] == 0 and done_feeding.is_set():
+                    emit(_SENTINEL)
+
+        def run_one(core_request):
             try:
-                core_request = self._request_from_proto(request)
                 for resp in self._core.infer_stream(core_request):
-                    yield pb.ModelStreamInferResponse(
-                        infer_response=self._response_to_proto(resp)
-                    )
+                    if cancelled.is_set() or not context.is_active():
+                        break  # stop generating for a gone client
+                    if not emit(pb.ModelStreamInferResponse(
+                            infer_response=self._response_to_proto(resp))):
+                        break
             except ServerError as e:
-                yield pb.ModelStreamInferResponse(error_message=str(e))
+                emit(pb.ModelStreamInferResponse(error_message=str(e)))
             except Exception as e:
-                yield pb.ModelStreamInferResponse(
-                    error_message="unexpected error: {}".format(e)
-                )
+                emit(pb.ModelStreamInferResponse(
+                    error_message="unexpected error: {}".format(e)))
+            finally:
+                inflight.release()
+                finish_one()
+
+        def feed():
+            try:
+                for request in request_iterator:
+                    if cancelled.is_set():
+                        break
+                    try:
+                        core_request = self._request_from_proto(request)
+                    except Exception as e:
+                        emit(pb.ModelStreamInferResponse(
+                            error_message=str(e)))
+                        continue
+                    try:
+                        ordered = self._core.requires_stream_order(
+                            core_request.model_name)
+                    except Exception:
+                        ordered = False
+                    inflight.acquire()
+                    with lock:
+                        pending[0] += 1
+                    if ordered:
+                        # sequential: decoupled response bursts and
+                        # sequence-state step order are contractual
+                        run_one(core_request)
+                    else:
+                        _threading.Thread(
+                            target=run_one, args=(core_request,),
+                            daemon=True,
+                        ).start()
+            except grpc.RpcError:
+                pass  # client cancelled/disconnected: normal stream end
+            finally:
+                done_feeding.set()
+                with lock:
+                    if pending[0] == 0:
+                        emit(_SENTINEL)
+
+        _threading.Thread(target=feed, daemon=True).start()
+        try:
+            while True:
+                item = out.get()
+                if item is _SENTINEL:
+                    return
+                yield item
+        finally:
+            # reader gone (cancel/deadline/exit): release producers and
+            # stop outstanding generation
+            cancelled.set()
+            while True:
+                try:
+                    out.get_nowait()
+                except _queue.Empty:
+                    break
 
 
 def _wrap_unary(bridge, name):

@@ -450,7 +450,6 @@ def decode_chunk(params, cache, logits, pos, cfg, chunk):
     chunk).  Returns (tokens [chunk, B], logprobs [chunk, B],
     next_logits, cache); positions pos..pos+chunk-1 are written.
     """
-    from jax import lax
 
     def body(carry, _):
         logits, cache, pos = carry

@@ -366,3 +366,33 @@ def test_xla_shared_memory_roundtrip(client, grpc_server):
 def test_cuda_shared_memory_rejected(client):
     with pytest.raises(InferenceServerException, match="no CUDA"):
         client.register_cuda_shared_memory("cshm", b"handle", 0, 64)
+
+
+def test_stream_concurrent_out_of_order(client):
+    """Pipelined non-ordered stream requests execute concurrently: a
+    fast request completes while a slow one is still in flight, each
+    response matched by request id."""
+    results = queue.Queue()
+    client.start_stream(lambda result, error: results.put((result, error)))
+    try:
+        def issue(rid, value, delay_us):
+            i0 = grpcclient.InferInput("INPUT0", [1], "INT32")
+            i0.set_data_from_numpy(np.array([value], np.int32))
+            d = grpcclient.InferInput("DELAY_US", [1], "UINT32")
+            d.set_data_from_numpy(np.array([delay_us], np.uint32))
+            client.async_stream_infer(
+                "delayed_identity", [i0, d], request_id=rid)
+
+        issue("slow", 111, 400000)
+        issue("fast", 222, 0)
+        order = []
+        for _ in range(2):
+            result, error = results.get(timeout=30)
+            assert error is None, repr(error)
+            order.append((
+                result.get_response().id,
+                int(result.as_numpy("OUTPUT0")[0]),
+            ))
+        assert order == [("fast", 222), ("slow", 111)], order
+    finally:
+        client.stop_stream()
