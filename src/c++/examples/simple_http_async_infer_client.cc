@@ -1,0 +1,125 @@
+// Asynchronous infer on the `simple` add/sub model over HTTP: several
+// requests issued without waiting, completions collected via callback
+// (role of reference src/c++/examples/simple_http_async_infer_client.cc).
+
+#include <unistd.h>
+
+#include <condition_variable>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "http_client.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8000");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose, 4),
+      "unable to create http client");
+
+  std::vector<int32_t> input0_data(16), input1_data(16, 2);
+  for (int i = 0; i < 16; ++i) {
+    input0_data[i] = i;
+  }
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  input0_ptr->AppendRaw(
+      (const uint8_t*)input0_data.data(),
+      input0_data.size() * sizeof(int32_t));
+  input1_ptr->AppendRaw(
+      (const uint8_t*)input1_data.data(),
+      input1_data.size() * sizeof(int32_t));
+  tc::InferRequestedOutput* output0;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0);
+  tc::InferOptions options("simple");
+
+  constexpr int kRequests = 8;
+  std::mutex mu;
+  std::condition_variable cv;
+  int completed = 0;
+  bool failed = false;
+  for (int r = 0; r < kRequests; ++r) {
+    FAIL_IF_ERR(
+        client->AsyncInfer(
+            [&](tc::InferResult* result) {
+              std::unique_ptr<tc::InferResult> result_ptr(result);
+              bool ok = result_ptr->RequestStatus().IsOk();
+              const uint8_t* buf;
+              size_t len;
+              if (ok &&
+                  result_ptr->RawData("OUTPUT0", &buf, &len).IsOk()) {
+                const int32_t* sums = (const int32_t*)buf;
+                for (int i = 0; i < 16; ++i) {
+                  if (sums[i] != i + 2) {
+                    ok = false;
+                  }
+                }
+              } else {
+                ok = false;
+              }
+              std::lock_guard<std::mutex> lk(mu);
+              if (!ok) {
+                failed = true;
+              }
+              ++completed;
+              cv.notify_all();
+            },
+            options, {input0_ptr.get(), input1_ptr.get()},
+            {output0_ptr.get()}),
+        "async infer");
+  }
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    if (!cv.wait_for(lk, std::chrono::seconds(60), [&] {
+          return completed == kRequests;
+        })) {
+      std::cerr << "error: timed out waiting for completions" << std::endl;
+      exit(1);
+    }
+  }
+  if (failed) {
+    std::cerr << "error: a request returned a wrong result" << std::endl;
+    exit(1);
+  }
+  std::cout << "async infer OK" << std::endl;
+  return 0;
+}

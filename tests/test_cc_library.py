@@ -90,6 +90,8 @@ def test_perf_analyzer_e2e(cc_build, http_server):
 # (binary, url-protocol, marker, extra args)
 CC_EXAMPLES = [
     ("simple_grpc_infer_client", "grpc", "infer OK", []),
+    ("simple_http_async_infer_client", "http", "async infer OK", []),
+    ("simple_grpc_async_infer_client", "grpc", "async infer OK", []),
     ("simple_grpc_shm_client", "grpc", "shm infer OK", []),
     ("simple_grpc_xlashm_client", "grpc", "xla shm infer OK", []),
     ("simple_grpc_string_infer_client", "grpc", "string infer OK", []),
