@@ -1,4 +1,7 @@
 """Hand-tiled Pallas TPU kernels for the hot ops (SURVEY §7's "pallas
 for the rest" tier); XLA-composed fallbacks everywhere else."""
 
-from tpuserver.ops.flash import flash_attention  # noqa: F401
+from tpuserver.ops.flash import (  # noqa: F401
+    decode_attention,
+    flash_attention,
+)

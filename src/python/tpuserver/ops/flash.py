@@ -133,3 +133,114 @@ def flash_attention(
         interpret=interpret,
     )(qh, kh, vh)
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _decode_kernel(
+    len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale,
+    block_k, n_rep):
+    """One (batch, k-block) program of single-query decode attention.
+
+    len_ref: scalar-prefetch [batch] int32 valid lengths; q_ref: [H, D]
+    (every query head of this batch row); k_ref/v_ref: [block_k, Hkv, D]
+    cache slices; scratch m/l: [H, 1] fp32, acc: [H, D] fp32 carried
+    across k blocks.  GQA replication happens on the in-VMEM block only.
+    """
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = len_ref[b]
+    heads = q_ref.shape[0]
+    block = k_ref.shape[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # skip blocks entirely past the valid cache prefix
+    @pl.when(ki * block_k < length)
+    def _fold():
+        q = q_ref[:].astype(jnp.float32) * scale          # [H, D]
+        k = k_ref[:].astype(jnp.float32)                  # [bk, Hkv, D]
+        v = v_ref[:].astype(jnp.float32)
+        if n_rep > 1:  # GQA: expand kv heads inside VMEM only
+            k = jnp.repeat(k, n_rep, axis=1)              # [bk, H, D]
+            v = jnp.repeat(v, n_rep, axis=1)
+        # Mosaic-friendly batched vec-mat: elementwise multiply +
+        # reduce on the VPU (the head-batched dot_general does not lower)
+        s = jnp.sum(q[None, :, :] * k, axis=-1).T  # [H, bk]
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (heads, block), 1)
+        s = jnp.where(k_pos < length, s, -jnp.inf)
+        m = m_scr[:, 0]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - shift[:, None])
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.sum(
+            p.T[:, :, None] * v, axis=0)  # [H, D]
+        m_scr[:, 0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, 0]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "block_k", "interpret"))
+def decode_attention(
+    q, k_cache, v_cache, lengths, scale=None, block_k=256,
+    interpret=None):
+    """Single-token decode attention over a padded KV cache.
+
+    q: [B, H, D] (the current token's queries); k_cache/v_cache:
+    [B, S, Hkv, D] with valid prefix ``lengths`` [B] int32; GQA
+    replication (H = Hkv * n_rep) happens on in-VMEM blocks only — the
+    expanded cache never exists in HBM.  Returns [B, H, D].
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, d = q.shape
+    s = k_cache.shape[1]
+    h_kv = k_cache.shape[2]
+    n_rep = h // h_kv
+    block_k = min(block_k, s)
+    if s % block_k:
+        raise ValueError(
+            "cache length {} must divide by block_k {}".format(s, block_k))
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_k=block_k, n_rep=n_rep)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda b, ki, *refs: (b, 0, 0)),
+            pl.BlockSpec(
+                (None, block_k, h_kv, d),
+                lambda b, ki, *refs: (b, ki, 0, 0)),
+            pl.BlockSpec(
+                (None, block_k, h_kv, d),
+                lambda b, ki, *refs: (b, ki, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, h, d), lambda b, ki, *refs: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k_cache, v_cache)
+    return out

@@ -82,3 +82,48 @@ def test_llama_forward_pallas_matches_xla():
     np.testing.assert_allclose(
         np.asarray(xla_logits), np.asarray(pallas_logits),
         rtol=5e-2, atol=5e-2)
+
+
+def _dense_decode(q, kc, vc, lengths, n_rep):
+    k = np.repeat(kc, n_rep, axis=2)
+    v = np.repeat(vc, n_rep, axis=2)
+    s = np.einsum("bhd,bkhd->bhk", q, k) / np.sqrt(q.shape[-1])
+    for bi, length in enumerate(lengths):
+        s[bi, :, length:] = -np.inf
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhk,bkhd->bhd", p, v)
+
+
+def test_decode_attention_matches_dense():
+    """Single-query decode over a padded KV cache: GQA head mapping and
+    per-batch valid lengths."""
+    from tpuserver.ops import decode_attention
+
+    rng = np.random.RandomState(4)
+    q = rng.randn(2, 6, 16).astype(np.float32)
+    kc = rng.randn(2, 64, 2, 16).astype(np.float32)
+    vc = rng.randn(2, 64, 2, 16).astype(np.float32)
+    lengths = np.array([40, 17], np.int32)
+    out = decode_attention(
+        jnp.array(q), jnp.array(kc), jnp.array(vc), jnp.array(lengths),
+        block_k=16)
+    np.testing.assert_allclose(
+        np.asarray(out), _dense_decode(q, kc, vc, lengths, 3),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_decode_attention_no_gqa_short_length():
+    from tpuserver.ops import decode_attention
+
+    rng = np.random.RandomState(5)
+    q = rng.randn(1, 4, 8).astype(np.float32)
+    kc = rng.randn(1, 32, 4, 8).astype(np.float32)
+    vc = rng.randn(1, 32, 4, 8).astype(np.float32)
+    lengths = np.array([1], np.int32)  # attend a single position
+    out = decode_attention(
+        jnp.array(q), jnp.array(kc), jnp.array(vc), jnp.array(lengths),
+        block_k=8)
+    np.testing.assert_allclose(
+        np.asarray(out), _dense_decode(q, kc, vc, lengths, 1),
+        rtol=2e-4, atol=2e-4)
