@@ -23,6 +23,32 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+def _online_softmax_fold(s, m_scr, l_scr, acc_scr, pv):
+    """One block of the flash recurrence over scores ``s`` [rows, bk].
+
+    Updates the carried (m, l, acc) scratch; ``pv(p)`` supplies the
+    probability-value product in whatever block layout the kernel uses.
+    Fully-masked rows keep m == -inf, and exp(-inf - -inf) is nan, so
+    the shift is pinned to a finite value there.
+    """
+    m = m_scr[:, 0]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - shift[:, None])
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
+    l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+    acc_scr[:] = acc_scr[:] * alpha[:, None] + pv(p)
+    m_scr[:, 0] = m_new
+
+
+def _fold_finish(o_ref, m_scr, l_scr, acc_scr):
+    """Normalize the carried accumulator into the output block."""
+    del m_scr
+    l = l_scr[:, 0]
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[:] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+
+
 def _attn_kernel(
     q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, causal,
     block_q, block_k):
@@ -61,23 +87,13 @@ def _attn_kernel(
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
-        m = m_scr[:, 0]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # fully-masked rows keep m_new == -inf; exp(-inf - -inf) is nan,
-        # so pin the shift to a finite value there
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift[:, None])
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
-        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
-        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
-        m_scr[:, 0] = m_new
+        _online_softmax_fold(
+            s, m_scr, l_scr, acc_scr,
+            lambda p: jnp.dot(p, v, preferred_element_type=jnp.float32))
 
     @pl.when(ki == nk - 1)
     def _finish():
-        l = l_scr[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[:] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        _fold_finish(o_ref, m_scr, l_scr, acc_scr)
 
 
 @functools.partial(
@@ -173,21 +189,13 @@ def _decode_kernel(
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (heads, block), 1)
         s = jnp.where(k_pos < length, s, -jnp.inf)
-        m = m_scr[:, 0]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        shift = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(s - shift[:, None])
-        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - shift), 0.0)
-        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
-        acc_scr[:] = acc_scr[:] * alpha[:, None] + jnp.sum(
-            p.T[:, :, None] * v, axis=0)  # [H, D]
-        m_scr[:, 0] = m_new
+        _online_softmax_fold(
+            s, m_scr, l_scr, acc_scr,
+            lambda p: jnp.sum(p.T[:, :, None] * v, axis=0))
 
     @pl.when(ki == nk - 1)
     def _finish():
-        l = l_scr[:, 0]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[:] = (acc_scr[:] / l[:, None]).astype(o_ref.dtype)
+        _fold_finish(o_ref, m_scr, l_scr, acc_scr)
 
 
 @functools.partial(
@@ -209,11 +217,24 @@ def decode_attention(
     b, h, d = q.shape
     s = k_cache.shape[1]
     h_kv = k_cache.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            "query heads ({}) must be a multiple of kv heads ({})".format(
+                h, h_kv))
     n_rep = h // h_kv
     block_k = min(block_k, s)
     if s % block_k:
         raise ValueError(
             "cache length {} must divide by block_k {}".format(s, block_k))
+
+    def _kv_index(b, ki, len_ref):
+        # clamp dead iterations (past the valid prefix) onto the last
+        # live block: Pallas elides the re-fetch of an already-resident
+        # block, so padded cache tail bytes are never DMA'd from HBM
+        live_blocks = jax.lax.div(
+            len_ref[b] + (block_k - 1), block_k)
+        ki_eff = jnp.minimum(ki, jnp.maximum(live_blocks - 1, 0))
+        return (b, ki_eff, 0, 0)
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_k=block_k, n_rep=n_rep)
@@ -222,12 +243,8 @@ def decode_attention(
         grid=(b, s // block_k),
         in_specs=[
             pl.BlockSpec((None, h, d), lambda b, ki, *refs: (b, 0, 0)),
-            pl.BlockSpec(
-                (None, block_k, h_kv, d),
-                lambda b, ki, *refs: (b, ki, 0, 0)),
-            pl.BlockSpec(
-                (None, block_k, h_kv, d),
-                lambda b, ki, *refs: (b, ki, 0, 0)),
+            pl.BlockSpec((None, block_k, h_kv, d), _kv_index),
+            pl.BlockSpec((None, block_k, h_kv, d), _kv_index),
         ],
         out_specs=pl.BlockSpec(
             (None, h, d), lambda b, ki, *refs: (b, 0, 0)),
