@@ -36,7 +36,13 @@ sys.path.insert(0, os.path.join(REPO, "src", "python"))
 
 import numpy as np  # noqa: E402
 
+import tpuserver  # noqa: E402
+
 from bench import BASELINE_INFER_PER_SEC, BASELINE_P50_USEC  # noqa: E402
+
+# conv-net / llama compiles cost minutes over the tunneled chip; the
+# persistent cache makes re-runs start hot
+tpuserver.enable_compile_cache(os.path.join(REPO, ".jax_cache"))
 
 BASELINES = {
     "simple_http": BASELINE_INFER_PER_SEC,   # quick_start.md:94
@@ -233,6 +239,97 @@ def bench_vision(grpc_url, config, model, modes, window_s, windows):
     return results
 
 
+def bench_vision_concurrent(grpc_url, config, model, window_s, windows,
+                            sweep=((1, 4), (1, 8), (1, 16), (1, 32),
+                                   (4, 8), (8, 4))):
+    """Async concurrency sweep for the vision configs.
+
+    The reference's 165.8 infer/sec ResNet-50 number (benchmarking.md:121)
+    is a local-network GPU box; this host talks to its chip over a
+    ~100 ms-RTT tunnel, so sync concurrency-1 is RTT-bound by physics.
+    perf_analyzer's answer (and the reference's async examples') is
+    pipelining: N in-flight async_infer requests amortize the RTT, and
+    the server's dynamic batcher folds them into one MXU-shaped dispatch.
+    Sweeps (client_batch, concurrency) pairs; reports each plus the best.
+    """
+    import queue
+
+    import tritonclient.grpc as grpcclient
+
+    baseline_key = "resnet50_grpc" if model == "resnet50" else "densenet_grpc"
+    best = None
+    client = grpcclient.InferenceServerClient(grpc_url)
+    try:
+        for batch, conc in sweep:
+            img = np.random.RandomState(0).rand(
+                batch, 224, 224, 3).astype(np.float32)
+            inp = grpcclient.InferInput("INPUT", list(img.shape), "FP32")
+            inp.set_data_from_numpy(img)
+            out = grpcclient.InferRequestedOutput("OUTPUT")
+            done = queue.Queue()
+
+            def issue():
+                t0 = time.perf_counter()
+                client.async_infer(
+                    model, [inp],
+                    lambda result, error, t0=t0: done.put(
+                        (result, error, time.perf_counter() - t0)),
+                    outputs=[out])
+
+            # warmup burst at the target concurrency, so the batch
+            # bucket this level actually lands in gets compiled now,
+            # not inside a measured window
+            for _ in range(conc):
+                issue()
+            for _ in range(conc):
+                _, err, _ = done.get(timeout=600)
+                assert err is None, repr(err)
+
+            rates, lats = [], []
+            for _ in range(windows):
+                inflight = 0
+                completed = 0
+                t0 = time.perf_counter()
+                while inflight < conc:
+                    issue()
+                    inflight += 1
+                while True:
+                    _, err, lat = done.get(timeout=300)
+                    assert err is None, repr(err)
+                    completed += batch
+                    inflight -= 1
+                    lats.append(lat)
+                    dt = time.perf_counter() - t0
+                    if dt >= window_s:
+                        break
+                    issue()
+                    inflight += 1
+                while inflight:
+                    _, err, _ = done.get(timeout=300)
+                    assert err is None, repr(err)
+                    inflight -= 1
+                rates.append(completed / dt)
+            lats.sort()
+            line = _emit(
+                config,
+                "{}_grpc_async_b{}_conc{}".format(model, batch, conc),
+                statistics.median(rates), "infer/sec", baseline_key,
+                p50_usec=round(lats[len(lats) // 2] * 1e6, 1))
+            if best is None or line["value"] > best["value"]:
+                best = dict(line, batch=batch, concurrency=conc)
+    finally:
+        client.close()
+    if best is not None:
+        print(json.dumps({
+            "config": config,
+            "metric": "{}_grpc_async_best".format(model),
+            "value": best["value"], "unit": "infer/sec",
+            "vs_baseline": best["vs_baseline"],
+            "batch": best["batch"], "concurrency": best["concurrency"],
+        }), flush=True)
+    return best
+
+
 # ---------------------------------------------------------------------------
 # config 4: BERT ensemble, async GRPC streaming, pipelined
 # ---------------------------------------------------------------------------
@@ -323,6 +420,85 @@ def _bench_bert_stream_once(grpc_url, window_s, windows):
 # ---------------------------------------------------------------------------
 # config 5: llama decoupled generation, tokens/sec, KV parked in XLA shm
 # ---------------------------------------------------------------------------
+
+def bench_llama_direct(cfg_name, windows, prefill_len=2048, chunk=32,
+                       decode_ctx=512, max_seq=3072):
+    """Model-level llama numbers on the chip: prefill wall-clock + MFU,
+    steady-state decode tokens/sec + MFU + MBU (roofline accounting in
+    tpuserver/ops/perf.py).  This is the defensible form of the config-5
+    claim: real model dims, one-dispatch prefill, scanned decode chunks
+    (so dispatch latency is amortized ``chunk`` ways), and utilization
+    reported against the chip's published peaks rather than bare rates.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuserver.models import llama
+    from tpuserver.ops import perf
+
+    cfg = getattr(llama, cfg_name)()
+    spec = perf.chip_spec()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    jax.block_until_ready(params)
+    n_params = perf.param_count(cfg)
+
+    prefill_j = jax.jit(functools.partial(llama.prefill, cfg=cfg))
+    decode_j = jax.jit(
+        functools.partial(llama.decode_chunk, cfg=cfg, chunk=chunk),
+        donate_argnums=(1,),
+    )
+    tokens = jnp.ones((1, prefill_len), jnp.int32)
+
+    # prefill: one batched dispatch
+    cache = llama.init_kv_cache(cfg, 1, max_seq)
+    logits, cache = prefill_j(params, cache, tokens)  # compile
+    jax.block_until_ready((logits, cache))
+    times = []
+    for _ in range(max(windows, 3)):
+        c2 = llama.init_kv_cache(cfg, 1, max_seq)
+        jax.block_until_ready(c2)
+        t0 = time.perf_counter()
+        logits, c2 = prefill_j(params, c2, tokens)
+        jax.block_until_ready(logits)
+        times.append(time.perf_counter() - t0)
+        del c2
+    t_prefill = statistics.median(times)
+    pf = perf.prefill_flops(cfg, prefill_len)
+    _emit(5, "{}_prefill_T{}".format(cfg_name, prefill_len),
+          t_prefill * 1e3, "ms", None,
+          mfu=round(perf.mfu(pf, t_prefill, spec), 4) if spec else None,
+          params=n_params, chip=spec.name if spec else None)
+
+    # steady-state decode from decode_ctx: chunked scan dispatches
+    cache = llama.init_kv_cache(cfg, 1, max_seq)
+    logits, cache = prefill_j(
+        params, cache, jnp.ones((1, decode_ctx), jnp.int32))
+    toks, lps, logits, cache = decode_j(params, cache, logits, decode_ctx)
+    jax.block_until_ready((toks, logits))  # compile
+    pos = decode_ctx + chunk
+    rates = []
+    n_chunks = max(windows, 3)
+    for _ in range(n_chunks):
+        if pos + chunk > max_seq:
+            break
+        t0 = time.perf_counter()
+        toks, lps, logits, cache = decode_j(params, cache, logits, pos)
+        jax.block_until_ready(toks)
+        dt = time.perf_counter() - t0
+        rates.append(chunk / dt)
+        pos += chunk
+    rate = statistics.median(rates)
+    ctx_mid = decode_ctx + chunk * (len(rates) // 2)
+    fpt = perf.decode_flops_per_token(cfg, ctx_mid)
+    bpt = perf.decode_bytes_per_token(cfg, ctx_mid)
+    _emit(5, "{}_decode_ctx{}".format(cfg_name, ctx_mid), rate,
+          "tokens/sec", None,
+          mfu=round(perf.mfu(fpt * rate, 1.0, spec), 4) if spec else None,
+          mbu=round(perf.mbu(bpt * rate, 1.0, spec), 4) if spec else None,
+          chunk=chunk, params=n_params,
+          chip=spec.name if spec else None)
 
 def bench_llama_stream(grpc_url, windows, max_tokens=64):
     import queue
@@ -441,6 +617,11 @@ def main():
     ap.add_argument("--configs", default="1,2,3,4,5")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument(
+        "--llama-config", default="llama3_3b",
+        help="config-5 model preset (llama3_3b = the largest that fits "
+             "one v5e chip's 16 GB HBM in bf16; llama3_1b / tiny for "
+             "smoke runs)")
+    ap.add_argument(
         "--core-only", action="store_true",
         help="config-2 data-plane comparison at the server core "
              "(no sockets; isolates the host<->device traffic)")
@@ -459,20 +640,43 @@ def main():
     from tpuserver.http_frontend import HttpFrontend
     from tpuserver.models import default_models, serving_models
 
+    failures = []
+    if 5 in wanted:
+        # model-level numbers first: the params/cache used here are
+        # freed before the serving zoo loads its own copy
+        try:
+            bench_llama_direct(
+                args.llama_config, 2 if args.quick else 5,
+                prefill_len=256 if args.quick else 2048,
+                chunk=8 if args.quick else 32,
+                decode_ctx=64 if args.quick else 512,
+                max_seq=512 if args.quick else 3072)
+        except Exception as e:
+            failures.append((5, e))
+        import gc
+        gc.collect()
+
     need_zoo = wanted & {2, 3, 4, 5}
     models = default_models()
     if need_zoo:
+        from tpuserver.models import llama as llama_mod
+
+        llama_cfg = (
+            getattr(llama_mod, args.llama_config)()
+            if args.llama_config != "tiny" else llama_mod.tiny(vocab=2048)
+        )
         models += serving_models(
             include_vision=bool(wanted & {2, 3}),
             include_bert=4 in wanted,
             include_llama=5 in wanted,
+            llama_cfg=llama_cfg,
+            llama_decode_chunk=8 if args.quick else 32,
         )
     core = InferenceServer(models)
     http = HttpFrontend(core, port=0).start()
     grpc_f = GrpcFrontend(core, port=0).start()
     grpc_url = "127.0.0.1:{}".format(grpc_f.port)
     http_url = http.url.replace("http://", "")
-    failures = []
     try:
         if 1 in wanted:
             try:
@@ -486,10 +690,21 @@ def main():
                              window_s, windows)
             except Exception as e:  # keep later configs running
                 failures.append((2, e))
+            try:
+                bench_vision_concurrent(grpc_url, 2, "resnet50",
+                                        window_s, windows)
+            except Exception as e:
+                failures.append((2, e))
         if 3 in wanted:
             try:
                 bench_vision(grpc_url, 3, "densenet121", ["xla_shm"],
                              window_s, windows)
+            except Exception as e:
+                failures.append((3, e))
+            try:
+                bench_vision_concurrent(grpc_url, 3, "densenet121",
+                                        window_s, windows,
+                                        sweep=((1, 8), (1, 16), (8, 4)))
             except Exception as e:
                 failures.append((3, e))
         if 4 in wanted:

@@ -11,4 +11,27 @@ same runtime serves both the test suite and the TPU benchmarks.
 
 from tpuserver.core import InferenceServer, JaxModel, Model, TensorSpec
 
-__all__ = ["InferenceServer", "JaxModel", "Model", "TensorSpec"]
+
+def enable_compile_cache(path=None):
+    """Point jax's persistent compilation cache at ``path`` (default
+    ``~/.cache/tpuserver-xla``).  On a tunneled chip a conv-net compile
+    costs minutes; the cache makes every later process start hot.  Safe
+    to call before or after jax import, best before first compile."""
+    import os
+
+    import jax
+
+    if path is None:
+        path = os.environ.get("TPUSERVER_XLA_CACHE") or os.path.join(
+            os.path.expanduser("~"), ".cache", "tpuserver-xla"
+        )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return path
+
+
+__all__ = [
+    "InferenceServer", "JaxModel", "Model", "TensorSpec",
+    "enable_compile_cache",
+]

@@ -138,6 +138,24 @@ class Model:
     ensemble_steps = None  # list of dicts for ensemble models
     labels = None  # name -> list[str] classification labels
     version = "1"
+    # server-side dynamic batching (role of the reference server's
+    # dynamic_batching model-config block): concurrent single requests
+    # are coalesced into one batched ``execute`` call.  On TPU one
+    # [N, ...] dispatch keeps the MXU fed and amortizes the
+    # host<->device round trip N ways where N serialized [1, ...]
+    # dispatches each pay it in full.
+    dynamic_batching = False
+    max_queue_delay_us = 2000
+    # allowed padded batch sizes (ascending); None = powers of two up to
+    # max_batch_size.  Fewer buckets = fewer compiled executables —
+    # each distinct batch shape is a separate XLA compile, minutes each
+    # for conv nets on a tunneled chip.
+    batch_buckets = None
+    # parallel executor count (role of the reference server's
+    # instance_group count): >1 lets batch executions overlap, hiding
+    # the host<->device sync round trip of one batch behind the compute
+    # of the next — essential when the chip is behind a ~100 ms tunnel.
+    instance_count = 1
 
     def config_dict(self):
         cfg = {
@@ -166,12 +184,17 @@ class Model:
                 "kind": "KIND_CPU"
                 if getattr(self, "device_kind", "tpu") == "cpu"
                 else "KIND_TPU",
-                "count": 1,
+                "count": self.instance_count,
             }],
             "version_policy": {"latest": {"num_versions": 1}},
         }
         if self.decoupled:
             cfg["model_transaction_policy"] = {"decoupled": True}
+        if self.dynamic_batching and self.max_batch_size > 1:
+            cfg["dynamic_batching"] = {
+                "preferred_batch_size": [self.max_batch_size],
+                "max_queue_delay_microseconds": self.max_queue_delay_us,
+            }
         if self.sequence:
             cfg["sequence_batching"] = {
                 "max_sequence_idle_microseconds": 60000000,
@@ -348,6 +371,232 @@ class _XlaShmRegion:
         self.handle.detach()
 
 
+class _BatchSlot:
+    """One queued request inside the dynamic batcher."""
+
+    __slots__ = ("inputs", "rows", "event", "outputs", "error")
+
+    def __init__(self, inputs, rows):
+        self.inputs = inputs
+        self.rows = rows
+        self.event = threading.Event()
+        self.outputs = None
+        self.error = None
+
+
+class _DynamicBatcher:
+    """Coalesces concurrent requests for one model into batched calls.
+
+    Role of the reference server's dynamic batcher (model_config
+    ``dynamic_batching``; observable to perf_analyzer as super-linear
+    throughput under concurrency).  A worker thread drains a queue:
+    the first waiting request opens a window of
+    ``model.max_queue_delay_us``; every compatible request (same input
+    names, dtypes and trailing dims) that arrives inside it is stacked
+    along the batch axis, executed as ONE device call, and the outputs
+    are split back per request.  Requests left over (incompatible
+    signature or window overflow) seed the next batch, so nothing
+    starves.
+    """
+
+    def __init__(self, model):
+        self._model = model
+        self._cond = threading.Condition()
+        self._queue = []  # of _BatchSlot
+        self._stop = False
+        self._threads = [
+            threading.Thread(
+                target=self._run,
+                name="batcher-{}-{}".format(model.name, i),
+                daemon=True,
+            )
+            for i in range(max(1, model.instance_count))
+        ]
+        for t in self._threads:
+            t.start()
+
+    @staticmethod
+    def _signature(inputs):
+        return tuple(
+            sorted(
+                (name, arr.dtype.str, arr.shape[1:])
+                for name, arr in inputs.items()
+            )
+        )
+
+    def submit(self, inputs, rows):
+        """Queue one request's inputs; blocks until its batch executes.
+
+        Returns the request's slice of the batched outputs (raises the
+        batch's error if execution failed)."""
+        slot = _BatchSlot(inputs, rows)
+        with self._cond:
+            if self._stop:
+                raise ServerError(
+                    "model '{}' is unloading".format(self._model.name)
+                )
+            self._queue.append(slot)
+            self._cond.notify_all()
+        slot.event.wait()
+        if slot.error is not None:
+            raise slot.error
+        return slot.outputs
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+        # snapshot under the lock: a worker that outlived the join may
+        # still rebind the queue in _take_batch; slots it has taken will
+        # complete normally, only still-queued slots get errored
+        with self._cond:
+            pending, self._queue = self._queue, []
+        for slot in pending:
+            slot.error = ServerError(
+                "model '{}' is unloading".format(self._model.name)
+            )
+            slot.event.set()
+
+    def _take_batch(self):
+        """Collect one compatible batch (called with the lock held)."""
+        max_rows = self._model.max_batch_size
+        sig = self._signature(self._queue[0].inputs)
+        batch, rest, rows = [], [], 0
+        for slot in self._queue:
+            if (
+                rows + slot.rows <= max_rows
+                and self._signature(slot.inputs) == sig
+            ):
+                batch.append(slot)
+                rows += slot.rows
+            else:
+                rest.append(slot)
+        if not batch:
+            # oversized single request: run it alone, the model's own
+            # shape validation decides its fate
+            batch, rest = [rest[0]], rest[1:]
+            rows = batch[0].rows
+        self._queue = rest
+        return batch, rows
+
+    def _run(self):
+        delay_s = self._model.max_queue_delay_us / 1e6
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if self._stop:
+                    return
+                # batching window: wait for companions until the delay
+                # elapses or a full preferred batch is queued
+                deadline = time.monotonic() + delay_s
+                while (
+                    sum(s.rows for s in self._queue)
+                    < self._model.max_batch_size
+                    and not self._stop
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                if self._stop:
+                    return
+                if not self._queue:
+                    # a sibling instance thread drained the queue while
+                    # this one sat in its batching window
+                    continue
+                batch, rows = self._take_batch()
+            self._execute(batch, rows)
+
+    def _bucket(self, rows, max_rows):
+        """Smallest allowed padded batch >= rows: every jit model
+        compiles one executable per distinct batch shape, so padding
+        the batch axis to a few fixed buckets bounds the compile set
+        (model.batch_buckets, default powers of two up to max_batch)
+        instead of one compile per concurrency level."""
+        buckets = self._model.batch_buckets
+        if buckets:
+            for b in buckets:
+                if b >= rows:
+                    return b
+            return max(buckets[-1], rows)
+        b = 1
+        while b < rows:
+            b <<= 1
+        return min(b, max(max_rows, rows))
+
+    def _stack(self, batch, rows, padded):
+        """Build the batched input dict.
+
+        For device-kind models the parts are pushed individually and
+        concatenated/padded ON DEVICE: only real request bytes cross the
+        host<->device link (padding a b1 request to a b8 bucket must not
+        transfer 8x the data over a slow tunnel), and the per-part
+        transfers overlap.  The padding rows replicate row 0 on device.
+        """
+        on_device = getattr(self._model, "device_kind", "") == "tpu"
+        stacked = {}
+        if on_device:
+            import jax
+            import jax.numpy as jnp
+
+            for name in batch[0].inputs:
+                parts = [
+                    p if isinstance(p, jax.Array) else jax.device_put(p)
+                    for p in (s.inputs[name] for s in batch)
+                ]
+                x = (
+                    jnp.concatenate(parts, axis=0)
+                    if len(parts) > 1
+                    else parts[0]
+                )
+                if padded > rows:
+                    x = jnp.concatenate(
+                        [x, jnp.repeat(x[:1], padded - rows, axis=0)],
+                        axis=0,
+                    )
+                stacked[name] = x
+        else:
+            for name in batch[0].inputs:
+                parts = [s.inputs[name] for s in batch]
+                if padded > rows:
+                    parts.append(
+                        np.repeat(parts[0][:1], padded - rows, axis=0)
+                    )
+                stacked[name] = (
+                    np.concatenate(parts, axis=0)
+                    if len(parts) > 1
+                    else parts[0]
+                )
+        return stacked
+
+    def _execute(self, batch, rows):
+        try:
+            padded = self._bucket(rows, self._model.max_batch_size)
+            stacked = self._stack(batch, rows, padded)
+            outputs = self._model.execute(stacked, None)
+            offset = 0
+            for slot in batch:
+                slot.outputs = {}
+                for name, arr in outputs.items():
+                    if (
+                        getattr(arr, "ndim", 0) >= 1
+                        and arr.shape[0] == padded
+                    ):
+                        slot.outputs[name] = arr[offset : offset + slot.rows]
+                    else:  # non-batched output: replicate
+                        slot.outputs[name] = arr
+                offset += slot.rows
+        except Exception as e:  # noqa: BLE001 — failure fans out per slot
+            for slot in batch:
+                slot.error = e
+        finally:
+            for slot in batch:
+                slot.event.set()
+
+
 class _ModelStats:
     def __init__(self):
         self.lock = threading.Lock()
@@ -418,6 +667,7 @@ class InferenceServer:
         self._system_shm = {}
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
+        self._batchers = {}  # name -> _DynamicBatcher (lazily created)
         self._sequence_state = {}  # (model, seq_id) -> (state, touched)
         self._last_sequence_sweep = 0.0
         self._trace_settings = {
@@ -819,6 +1069,13 @@ class InferenceServer:
                 outputs = self._execute_ensemble(model, inputs, request)
             elif model.sequence:
                 outputs = self._execute_sequence(model, inputs, request)
+            elif self._batchable(model, inputs, request):
+                # the batching window shows up inside compute_infer
+                # (the split would be cosmetic; the client-visible
+                # latency is what perf_analyzer measures anyway)
+                outputs = self._batcher_of(model).submit(
+                    inputs, int(next(iter(inputs.values())).shape[0])
+                )
             else:
                 outputs = model.execute(inputs, request)
         except ServerError:
@@ -841,6 +1098,52 @@ class InferenceServer:
             t_end - t_co0,
         )
         return resp
+
+    def _batchable(self, model, inputs, request):
+        """Route through the dynamic batcher? Requires the model to opt
+        in, host (numpy) inputs with a leading batch dim, one consistent
+        row count, and no per-request parameters (batched execution sees
+        no request object)."""
+        if not (model.dynamic_batching and model.max_batch_size > 1):
+            return False
+        if request.parameters or not inputs:
+            return False
+        on_device = getattr(model, "device_kind", "") == "tpu"
+        rows = None
+        for arr in inputs.values():
+            ok = isinstance(arr, np.ndarray)
+            if not ok and on_device:
+                # device-resident inputs (XLA-shm fast path) batch too —
+                # the batcher stacks them on device, no host copy
+                import jax
+
+                ok = isinstance(arr, jax.Array)
+            if not ok or getattr(arr, "ndim", 0) < 1:
+                return False
+            if rows is None:
+                rows = arr.shape[0]
+            elif arr.shape[0] != rows:
+                return False
+        return True
+
+    def _batcher_of(self, model):
+        batcher = self._batchers.get(model.name)
+        if batcher is None:
+            with self._lock:
+                batcher = self._batchers.get(model.name)
+                if batcher is None:
+                    batcher = _DynamicBatcher(model)
+                    self._batchers[model.name] = batcher
+        return batcher
+
+    def close(self):
+        """Stop background workers (dynamic batchers).  Safe to call
+        twice; batcher threads are daemons so skipping it only leaks
+        idle threads until process exit."""
+        with self._lock:
+            batchers, self._batchers = list(self._batchers.values()), {}
+        for b in batchers:
+            b.stop()
 
     def _execute_sequence(self, model, inputs, request):
         if request.sequence_id == 0:
@@ -868,25 +1171,33 @@ class InferenceServer:
         return outputs
 
     def _expire_idle_sequences(self, model):
-        """Drop sequences idle beyond the model's
+        """Drop sequences idle beyond their model's
         ``max_sequence_idle_us`` so abandoned sequences (no END request)
         cannot grow state unboundedly — role of the reference sequence
-        batcher's max_sequence_idle_microseconds expiry.  Swept at most
-        once per idle window (min 50 ms) so the scan stays off the
-        per-request hot path, over an atomic snapshot so concurrent
-        frontend threads can insert/pop freely."""
+        batcher's max_sequence_idle_microseconds expiry.  One sweep
+        covers EVERY model's sequences (each judged by its own idle
+        window), so a model that stops receiving traffic still gets its
+        abandoned state reclaimed by any other model's requests.  Swept
+        at most once per triggering model's half-window (min 50 ms) so
+        the scan stays off the per-request hot path, over an atomic
+        snapshot so concurrent frontend threads can insert/pop freely."""
         idle_us = getattr(model, "max_sequence_idle_us", 60_000_000)
         now = time.monotonic()
         sweep_gap = max(idle_us / 1e6 / 2.0, 0.05)
         if now - self._last_sequence_sweep < sweep_gap:
             return
         self._last_sequence_sweep = now
-        cutoff = now - idle_us / 1e6
-        expired = [
-            key
-            for key, (_, touched) in list(self._sequence_state.items())
-            if key[0] == model.name and touched < cutoff
-        ]
+        idle_cache = {}
+        expired = []
+        for key, (_, touched) in list(self._sequence_state.items()):
+            name = key[0]
+            if name not in idle_cache:
+                owner = self._models.get(name)
+                idle_cache[name] = getattr(
+                    owner, "max_sequence_idle_us", 60_000_000
+                ) if owner is not None else 0
+            if touched < now - idle_cache[name] / 1e6:
+                expired.append(key)
         for key in expired:
             self._sequence_state.pop(key, None)
 

@@ -482,7 +482,7 @@ def _status_code(http_code):
 class GrpcFrontend:
     """A grpc.server hosting the full GRPCInferenceService."""
 
-    def __init__(self, core, host="127.0.0.1", port=0, max_workers=8):
+    def __init__(self, core, host="127.0.0.1", port=0, max_workers=32):
         self._core = core
         self._host = host
         self._max_workers = max_workers

@@ -33,7 +33,8 @@ def default_models():
 
 
 def serving_models(include_vision=True, include_bert=True,
-                   include_llama=True, llama_cfg=None):
+                   include_llama=True, llama_cfg=None,
+                   llama_decode_chunk=None, llama_max_seq=512):
     """The heavyweight serving zoo for the BASELINE configs (#2-#5):
     ResNet-50 / DenseNet-121, the BERT ensemble, and decoupled llama
     generation.  Separate from ``default_models`` so unit tests stay fast."""
@@ -60,5 +61,7 @@ def serving_models(include_vision=True, include_bert=True,
     if include_llama:
         from tpuserver.models.llama_serving import LlamaGenerateModel
 
-        models.append(LlamaGenerateModel(cfg=llama_cfg))
+        models.append(LlamaGenerateModel(
+            cfg=llama_cfg, max_seq=llama_max_seq,
+            decode_chunk=llama_decode_chunk))
     return models

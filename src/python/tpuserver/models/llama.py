@@ -60,6 +60,23 @@ def llama3_8b():
     return LlamaConfig()
 
 
+def llama3_3b():
+    """Llama-3.2-3B shapes (untied head): ~3.6B params ≈ 7.2 GB bf16 —
+    the largest preset that fits a single v5e chip's 16 GB HBM with KV
+    cache and compiler workspace to spare (the 8B preset's 16 GB of
+    weights alone would not).  The single-chip serving flagship."""
+    return LlamaConfig(
+        d_model=3072, n_layers=28, n_heads=24, n_kv_heads=8, d_ff=8192,
+    )
+
+
+def llama3_1b():
+    """Llama-3.2-1B shapes (untied head): ~1.5B params ≈ 3 GB bf16."""
+    return LlamaConfig(
+        d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8, d_ff=8192,
+    )
+
+
 def tiny(vocab=256):
     """Test-size config: same graph, toy dims (multiples of 8 for sharding)."""
     return LlamaConfig(
@@ -464,3 +481,64 @@ def decode_chunk(params, cache, logits, pos, cfg, chunk):
         body, (logits, cache, pos), None, length=chunk
     )
     return tokens, logps, next_logits, cache
+
+
+# -- tensor-parallel serving (decode over a tp mesh) -------------------------
+
+
+def cache_spec(cfg):
+    """PartitionSpec of the KV cache [n_layers, 2, B, S, n_kv_heads, hd]:
+    kv heads sharded over tp — each tp shard owns its heads' cache rows,
+    so cache reads/writes during decode are collective-free."""
+    return P(None, None, None, None, "tp", None)
+
+
+def make_tp_serving(mesh, cfg, chunk=8, donate=True):
+    """Tensor-parallel prefill + chunked decode over a mesh's ``tp`` axis.
+
+    Where training uses an explicit ``shard_map`` (psums spelled out),
+    serving uses the pure GSPMD form: jit with ``NamedSharding``
+    annotations on params (Megatron column/row split, ``param_specs``)
+    and cache (kv heads on tp, ``cache_spec``) and let XLA place the
+    collectives — one all-reduce after each row-parallel matmul, the
+    attention itself collective-free because each shard holds exactly
+    its own heads' Q and KV rows.  The TPU-native analogue of the
+    reference stack's multi-GPU serving (its clients drive
+    NCCL-backed backends; here the backend itself is the sharded jit).
+
+    Requires tp | n_heads and tp | n_kv_heads.  Returns
+    ``(init_cache, prefill_fn, decode_fn)``; ``decode_fn`` is
+    ``decode_chunk`` with the cache donated (pass ``donate=False`` when
+    the caller needs the input cache afterwards, e.g. A/B tests).
+    """
+    tp = mesh.shape["tp"]
+    if cfg.n_heads % tp or cfg.n_kv_heads % tp:
+        raise ValueError(
+            "tp={} must divide n_heads={} and n_kv_heads={}".format(
+                tp, cfg.n_heads, cfg.n_kv_heads
+            )
+        )
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(cfg)
+    )
+    cache_sh = NamedSharding(mesh, cache_spec(cfg))
+    repl = NamedSharding(mesh, P())
+
+    prefill_fn = jax.jit(
+        functools.partial(prefill, cfg=cfg),
+        in_shardings=(param_sh, cache_sh, repl),
+        out_shardings=(repl, cache_sh),
+    )
+    decode_fn = jax.jit(
+        functools.partial(decode_chunk, cfg=cfg, chunk=chunk),
+        in_shardings=(param_sh, cache_sh, repl, repl),
+        out_shardings=(repl, repl, repl, cache_sh),
+        donate_argnums=(1,) if donate else (),
+    )
+
+    def init_cache(batch, max_seq):
+        return jax.device_put(
+            init_kv_cache(cfg, batch, max_seq), cache_sh
+        )
+
+    return init_cache, prefill_fn, decode_fn

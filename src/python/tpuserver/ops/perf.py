@@ -1,0 +1,120 @@
+"""Roofline accounting for the llama serving path: analytic FLOP/byte
+counts per config plus a chip-spec table, so benchmarks can report MFU
+(achieved FLOP/s over the chip's peak) and MBU (achieved HBM bytes/s
+over peak bandwidth) instead of bare tokens/sec.
+
+No reference counterpart — the reference is a client-side load
+generator; this is the TPU-native framework's own proof-of-performance
+layer.  Peak numbers are the published per-chip specs (bf16 matmul peak
+and HBM bandwidth); MFU follows the standard convention of counting
+only algorithmic matmul/attention FLOPs (2*m*n*k per matmul), no
+rematerialization credit.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_bf16_flops: float  # FLOP/s
+    hbm_bandwidth: float    # bytes/s
+    hbm_bytes: int
+
+
+# published single-chip specs, keyed by jax Device.device_kind
+CHIP_SPECS = {
+    "TPU v4": ChipSpec("v4", 275e12, 1228e9, 32 << 30),
+    "TPU v5 lite": ChipSpec("v5e", 197e12, 819e9, 16 << 30),
+    "TPU v5e": ChipSpec("v5e", 197e12, 819e9, 16 << 30),
+    "TPU v5": ChipSpec("v5p", 459e12, 2765e9, 95 << 30),
+    "TPU v5p": ChipSpec("v5p", 459e12, 2765e9, 95 << 30),
+    "TPU v6 lite": ChipSpec("v6e", 918e12, 1640e9, 32 << 30),
+    "TPU v6e": ChipSpec("v6e", 918e12, 1640e9, 32 << 30),
+}
+
+
+def chip_spec(device=None):
+    """Spec for ``device`` (default: jax's first device), or None when
+    the platform isn't a known TPU (CPU test meshes)."""
+    import jax
+
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    return CHIP_SPECS.get(getattr(device, "device_kind", ""))
+
+
+def param_count(cfg):
+    """Analytic parameter count of ``llama.init_params`` for ``cfg``."""
+    hd = cfg.head_dim
+    per_layer = (
+        cfg.d_model * cfg.n_heads * hd          # wq
+        + 2 * cfg.d_model * cfg.n_kv_heads * hd  # wk, wv
+        + cfg.n_heads * hd * cfg.d_model        # wo
+        + 3 * cfg.d_model * cfg.d_ff            # gate, up, down
+        + 2 * cfg.d_model                       # norms
+    )
+    return (
+        2 * cfg.vocab * cfg.d_model             # embed + lm_head
+        + cfg.n_layers * per_layer
+        + cfg.d_model                           # final norm
+    )
+
+
+def matmul_params(cfg):
+    """Params that participate in per-token matmuls (excludes the embed
+    gather, which costs a lookup, not FLOPs; includes lm_head)."""
+    return param_count(cfg) - cfg.vocab * cfg.d_model
+
+
+def decode_flops_per_token(cfg, ctx_len):
+    """Forward FLOPs to decode ONE token at context length ``ctx_len``.
+
+    2 FLOPs per matmul parameter, plus attention: per layer the single
+    query attends over ctx_len cached K/V rows — QK^T and PV are each
+    2 * ctx_len * n_heads * head_dim FLOPs.
+    """
+    attn = cfg.n_layers * 4 * ctx_len * cfg.n_heads * cfg.head_dim
+    return 2 * matmul_params(cfg) + attn
+
+
+def prefill_flops(cfg, seq_len):
+    """Forward FLOPs for a causal prefill of ``seq_len`` tokens.
+
+    Matmuls are linear in tokens; causal attention sums to
+    ~seq_len^2/2 score rows per head per layer (QK^T + PV).
+    """
+    matmul = 2 * matmul_params(cfg) * seq_len
+    attn = cfg.n_layers * 4 * (seq_len * seq_len // 2) * (
+        cfg.n_heads * cfg.head_dim
+    )
+    return matmul + attn
+
+
+def decode_bytes_per_token(cfg, ctx_len, dtype_bytes=2):
+    """HBM bytes touched to decode one token: every matmul weight is
+    read once, the valid KV prefix is read, and one KV row is written.
+    (The decode roofline — at batch 1 this is bandwidth-bound, so
+    tokens/sec * bytes/token vs peak bandwidth is the honest
+    utilization number.)"""
+    weights = matmul_params(cfg) * dtype_bytes
+    kv_row = 2 * cfg.n_kv_heads * cfg.head_dim * dtype_bytes
+    kv = cfg.n_layers * kv_row * (ctx_len + 1)
+    return weights + kv
+
+
+def mfu(flops, seconds, spec):
+    """Achieved-over-peak FLOP ratio (None without a known chip)."""
+    if spec is None or seconds <= 0:
+        return None
+    return flops / seconds / spec.peak_bf16_flops
+
+
+def mbu(nbytes, seconds, spec):
+    """Achieved-over-peak HBM bandwidth ratio."""
+    if spec is None or seconds <= 0:
+        return None
+    return nbytes / seconds / spec.hbm_bandwidth

@@ -1,0 +1,179 @@
+"""Dynamic-batcher tests: concurrent single requests coalesce into one
+batched device call and split back correctly (role of the reference
+server's dynamic_batching config; observable to perf_analyzer as
+super-linear throughput under concurrency)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpuserver.core import (
+    InferenceServer,
+    InferRequest,
+    Model,
+    TensorSpec,
+)
+
+
+class _RowOffsetModel(Model):
+    """OUT[i] = IN[i] + 1000 * (value of IN[i][0]): row-dependent result
+    so a mis-split batch is detected, plus a log of executed batch
+    sizes."""
+
+    name = "rowoffset"
+    platform = "jax"
+    backend = "jax"
+    max_batch_size = 8
+    dynamic_batching = True
+    max_queue_delay_us = 30000
+    inputs = (TensorSpec("IN", "FP32", [4]),)
+    outputs = (TensorSpec("OUT", "FP32", [4]),)
+
+    def __init__(self):
+        self.batch_sizes = []
+        self._log_lock = threading.Lock()
+
+    def execute(self, inputs, request):
+        arr = inputs["IN"]
+        with self._log_lock:
+            self.batch_sizes.append(arr.shape[0])
+        return {"OUT": arr + 1.0}
+
+
+@pytest.fixture()
+def batch_core():
+    model = _RowOffsetModel()
+    core = InferenceServer([model])
+    yield core, model
+    core.close()
+
+
+def test_concurrent_requests_coalesce_and_split(batch_core):
+    core, model = batch_core
+    n = 8
+    results = [None] * n
+    errors = []
+
+    def worker(i):
+        x = np.full((1, 4), float(i), dtype=np.float32)
+        try:
+            resp = core.infer(InferRequest("rowoffset", inputs={"IN": x}))
+            results[i] = resp.outputs[0][1]
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for i in range(n):
+        np.testing.assert_allclose(
+            results[i], np.full((1, 4), i + 1.0, np.float32)
+        )
+    # at least one executed call actually carried a multi-request batch
+    assert max(model.batch_sizes) > 1
+    # fewer executions than requests = real coalescing happened
+    assert len(model.batch_sizes) < n
+
+
+def test_batch_padding_is_invisible(batch_core):
+    """3 concurrent rows pad to the 4-bucket; callers still get exactly
+    their own rows back."""
+    core, model = batch_core
+    n = 3
+    results = [None] * n
+
+    def worker(i):
+        x = np.full((1, 4), 10.0 * i, dtype=np.float32)
+        resp = core.infer(InferRequest("rowoffset", inputs={"IN": x}))
+        results[i] = resp.outputs[0][1]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n):
+        np.testing.assert_allclose(
+            results[i], np.full((1, 4), 10.0 * i + 1.0, np.float32)
+        )
+    # executed batch shapes are power-of-two buckets
+    for b in model.batch_sizes:
+        assert b & (b - 1) == 0
+
+
+def test_multi_row_requests_batch(batch_core):
+    """Requests with batch > 1 of their own still coalesce (2+2 <= 8)."""
+    core, model = batch_core
+    results = [None] * 2
+
+    def worker(i):
+        x = np.arange(8, dtype=np.float32).reshape(2, 4) + 100.0 * i
+        resp = core.infer(InferRequest("rowoffset", inputs={"IN": x}))
+        results[i] = resp.outputs[0][1]
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(2):
+        expected = (
+            np.arange(8, dtype=np.float32).reshape(2, 4) + 100.0 * i + 1.0
+        )
+        np.testing.assert_allclose(results[i], expected)
+
+
+def test_requests_with_parameters_bypass_batcher(batch_core):
+    core, model = batch_core
+    x = np.zeros((1, 4), np.float32)
+    resp = core.infer(
+        InferRequest(
+            "rowoffset", inputs={"IN": x}, parameters={"custom": "1"}
+        )
+    )
+    np.testing.assert_allclose(resp.outputs[0][1], x + 1.0)
+    # bypass path executes exactly the request's own rows, unpadded
+    assert model.batch_sizes == [1] or model.batch_sizes == []
+
+
+def test_error_fans_out_to_all_requests():
+    class _Boom(_RowOffsetModel):
+        name = "boom"
+
+        def execute(self, inputs, request):
+            raise RuntimeError("kernel exploded")
+
+    model = _Boom()
+    core = InferenceServer([model])
+    try:
+        errs = []
+
+        def worker():
+            x = np.zeros((1, 4), np.float32)
+            try:
+                core.infer(InferRequest("boom", inputs={"IN": x}))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(errs) == 3
+        assert all("kernel exploded" in str(e) for e in errs)
+    finally:
+        core.close()
+
+
+def test_config_reports_dynamic_batching(batch_core):
+    core, _ = batch_core
+    cfg = core.model_config("rowoffset")
+    assert cfg["dynamic_batching"]["preferred_batch_size"] == [8]
+    assert (
+        cfg["dynamic_batching"]["max_queue_delay_microseconds"] == 30000
+    )
