@@ -42,10 +42,17 @@ MaxShareCount()
 Error
 AcquireChannel(
     std::shared_ptr<h2::GrpcChannel>* channel, const std::string& url,
-    bool verbose)
+    bool verbose, const TlsOptions& tls = TlsOptions())
 {
   std::lock_guard<std::mutex> lk(channel_cache_mu_);
-  auto& entries = channel_cache_[url];
+  // TLS channels never share a cache slot with cleartext ones (nor with
+  // TLS channels built from different credentials)
+  std::string cache_key = url;
+  if (tls.enabled) {
+    cache_key = "tls|" + tls.ca_file + "|" + tls.cert_file + "|" +
+                tls.key_file + "|" + url;
+  }
+  auto& entries = channel_cache_[cache_key];
   const int max_share = MaxShareCount();
   for (auto& e : entries) {
     if (e.use_count < max_share && e.channel->Alive()) {
@@ -55,7 +62,7 @@ AcquireChannel(
     }
   }
   std::shared_ptr<h2::GrpcChannel> fresh;
-  Error err = h2::GrpcChannel::Create(&fresh, url, verbose);
+  Error err = h2::GrpcChannel::Create(&fresh, url, verbose, tls);
   if (!err.IsOk()) {
     return err;
   }
@@ -68,21 +75,21 @@ void
 ReleaseChannel(const std::shared_ptr<h2::GrpcChannel>& channel)
 {
   std::lock_guard<std::mutex> lk(channel_cache_mu_);
-  auto it = channel_cache_.find(channel->Url());
-  if (it == channel_cache_.end()) {
-    return;
-  }
-  auto& entries = it->second;
-  for (auto eit = entries.begin(); eit != entries.end(); ++eit) {
-    if (eit->channel == channel) {
-      if (--eit->use_count <= 0) {
-        entries.erase(eit);
+  // scan every bucket: TLS channels cache under a credential-qualified
+  // key, not the bare URL (see AcquireChannel)
+  for (auto it = channel_cache_.begin(); it != channel_cache_.end(); ++it) {
+    auto& entries = it->second;
+    for (auto eit = entries.begin(); eit != entries.end(); ++eit) {
+      if (eit->channel == channel) {
+        if (--eit->use_count <= 0) {
+          entries.erase(eit);
+        }
+        if (entries.empty()) {
+          channel_cache_.erase(it);
+        }
+        return;
       }
-      break;
     }
-  }
-  if (entries.empty()) {
-    channel_cache_.erase(it);
   }
 }
 
@@ -257,14 +264,22 @@ InferenceServerGrpcClient::Create(
     const std::string& server_url, bool verbose, bool use_ssl,
     const SslOptions& ssl_options, const KeepAliveOptions& keepalive_options)
 {
-  (void)ssl_options;
+  TlsOptions tls;
   if (use_ssl) {
-    return Error(
-        "SSL is not supported by the in-tree h2 transport; terminate TLS in "
-        "a local proxy (e.g. stunnel/envoy) or use the insecure port");
+    std::string why;
+    if (!TlsSession::Available(&why)) {
+      return Error("use_ssl requested but " + why);
+    }
+    // reference SslOptions fields are PEM file paths
+    // (reference grpc_client.h:43-63); empty roots = system defaults
+    tls.enabled = true;
+    tls.ca_file = ssl_options.root_certificates;
+    tls.cert_file = ssl_options.certificate_chain;
+    tls.key_file = ssl_options.private_key;
+    tls.alpn = {"h2"};
   }
   std::shared_ptr<h2::GrpcChannel> channel;
-  Error err = AcquireChannel(&channel, server_url, verbose);
+  Error err = AcquireChannel(&channel, server_url, verbose, tls);
   if (!err.IsOk()) {
     return err;
   }
@@ -808,7 +823,8 @@ InferenceServerGrpcClient::Infer(
   call_activity_.fetch_add(1);
   std::string out;
   err = channel_->Unary(
-      kService, "ModelInfer", serialized, &out, options.client_timeout_us_);
+      kService, "ModelInfer", serialized, &out, options.client_timeout_us_,
+      CompressionHeaders());
   if (!err.IsOk()) {
     return err;
   }
@@ -913,7 +929,7 @@ InferenceServerGrpcClient::AsyncInfer(
         --outstanding_async_;
         async_cv_.notify_all();
       },
-      options.client_timeout_us_);
+      options.client_timeout_us_, CompressionHeaders());
   if (!err.IsOk()) {
     std::lock_guard<std::mutex> lk(async_mu_);
     outstanding_calls_.erase(call_id);
@@ -1122,7 +1138,7 @@ InferenceServerGrpcClient::StartStream(
         }
         stream_cv_.notify_all();
       },
-      stream_timeout_us);
+      stream_timeout_us, CompressionHeaders());
   if (!err.IsOk()) {
     return err;
   }

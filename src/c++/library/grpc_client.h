@@ -34,8 +34,9 @@ using OnMultiCompleteFn = std::function<void(std::vector<InferResult*>)>;
 
 //==============================================================================
 // SSL/keepalive option structs (API parity, reference grpc_client.h:43-82).
-// TLS is not supported by the in-tree h2 transport; Create fails when
-// use_ssl is requested.  Keepalive maps onto h2 PING: a keepalive thread
+// use_ssl upgrades the h2 transport to TLS (ALPN "h2") via the dlopen'd
+// OpenSSL engine in tls.h; the SslOptions fields are PEM file paths, like
+// the reference's.  Keepalive maps onto h2 PING: a keepalive thread
 // pings every keepalive_time_ms (when < INT32_MAX) and treats a missed
 // ack within keepalive_timeout_ms as connection death; pings pause after
 // http2_max_pings_without_data consecutive pings with no intervening
@@ -156,6 +157,25 @@ class InferenceServerGrpcClient : public InferenceServerClient {
       const std::string& model_name = "",
       const std::string& model_version = "");
 
+  // Per-message compression for Infer/AsyncInfer/stream requests:
+  // "" or "none" (identity, default), "gzip", "deflate".  Role of the
+  // reference's grpc_compression_algorithm context setting
+  // (reference grpc_client.cc:1380-1389); responses auto-detect either
+  // algorithm whenever the server sets the compressed flag.  Unknown
+  // algorithms error here — silently mislabeling the wire encoding
+  // would surface as confusing server-side decode failures.
+  Error SetInferCompression(const std::string& algorithm)
+  {
+    if (algorithm != "" && algorithm != "none" && algorithm != "gzip" &&
+        algorithm != "deflate") {
+      return Error(
+          "unsupported compression algorithm '" + algorithm +
+          "' (expected none|gzip|deflate)");
+    }
+    infer_compression_ = (algorithm == "none") ? "" : algorithm;
+    return Error::Success;
+  }
+
   Error UpdateTraceSettings(
       inference::TraceSettingResponse* response,
       const std::string& model_name = "",
@@ -254,6 +274,16 @@ class InferenceServerGrpcClient : public InferenceServerClient {
   void DispatchWorker();
   void EnqueueCallback(std::function<void()> fn);
   void KeepAliveWorker();
+
+  std::vector<h2::Header> CompressionHeaders() const
+  {
+    if (infer_compression_.empty()) {
+      return {};
+    }
+    return {{"grpc-encoding", infer_compression_}};
+  }
+
+  std::string infer_compression_;
 
   std::shared_ptr<h2::GrpcChannel> channel_;
   // reused protobuf for sync Infer (reference's protobuf-reuse

@@ -1,11 +1,72 @@
 #include "grpc_channel.h"
 
+#include <zlib.h>
+
 #include <cstring>
 
 namespace tc {
 namespace h2 {
 
 namespace {
+
+// gRPC message compression ("gzip" = RFC1952, "deflate" = RFC1950 zlib).
+Error
+CompressMessage(
+    const std::string& algorithm, const std::string& in, std::string* out)
+{
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  const int window_bits = algorithm == "gzip" ? 15 + 16 : 15;
+  if (deflateInit2(
+          &zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window_bits, 8,
+          Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("deflateInit2 failed");
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in =
+      reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = static_cast<uInt>(out->size());
+  int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("deflate failed");
+  }
+  out->resize(zs.total_out);
+  return Error::Success;
+}
+
+// Auto-detecting inflate (15+32: zlib or gzip headers).
+Error
+DecompressMessage(const std::string& in, std::string* out)
+{
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (inflateInit2(&zs, 15 + 32) != Z_OK) {
+    return Error("inflateInit2 failed");
+  }
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = static_cast<uInt>(in.size());
+  out->clear();
+  char buf[65536];
+  int rc = Z_OK;
+  do {
+    zs.next_out = reinterpret_cast<Bytef*>(buf);
+    zs.avail_out = sizeof(buf);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Error("inflate failed (corrupt compressed gRPC message)");
+    }
+    out->append(buf, sizeof(buf) - zs.avail_out);
+  } while (rc != Z_STREAM_END && (zs.avail_in > 0 || zs.avail_out == 0));
+  inflateEnd(&zs);
+  if (rc != Z_STREAM_END) {
+    return Error("truncated compressed gRPC message");
+  }
+  return Error::Success;
+}
 
 Error
 ParseHostPort(const std::string& url, std::string* host, int* port)
@@ -93,6 +154,10 @@ struct GrpcCall::State {
   std::shared_ptr<H2Connection> conn;
   int32_t stream_id = 0;
 
+  // per-message compression for sends (from the call's grpc-encoding
+  // header); receives auto-detect whenever the compressed flag is set
+  std::string send_encoding;
+
   // reader-thread state: gRPC message reassembly
   std::string recv_buf;
   GrpcCall::OnMessage on_message;
@@ -138,11 +203,16 @@ struct GrpcCall::State {
         break;
       }
       if (compressed != 0) {
-        return Error(
-            "received compressed gRPC message but no compression was "
-            "negotiated");
-      }
-      if (on_message) {
+        std::string plain;
+        Error err =
+            DecompressMessage(recv_buf.substr(off + 5, msg_len), &plain);
+        if (!err.IsOk()) {
+          return err;
+        }
+        if (on_message) {
+          on_message(std::move(plain));
+        }
+      } else if (on_message) {
         on_message(recv_buf.substr(off + 5, msg_len));
       }
       off += 5 + msg_len;
@@ -194,15 +264,27 @@ GrpcCall::Write(const std::string& serialized, bool end_of_calls)
     // role of the reference's 2 GB protobuf guard (grpc_client.cc:1345-1353)
     return Error("gRPC message exceeds 2 GB limit");
   }
+  const std::string* payload = &serialized;
+  std::string compressed_payload;
+  bool compressed = false;
+  if (!state_->send_encoding.empty() && !serialized.empty()) {
+    Error cerr = CompressMessage(
+        state_->send_encoding, serialized, &compressed_payload);
+    if (!cerr.IsOk()) {
+      return cerr;
+    }
+    payload = &compressed_payload;
+    compressed = true;
+  }
   std::string framed;
-  framed.reserve(5 + serialized.size());
-  framed.push_back('\0');
-  const uint32_t len = static_cast<uint32_t>(serialized.size());
+  framed.reserve(5 + payload->size());
+  framed.push_back(compressed ? '\1' : '\0');
+  const uint32_t len = static_cast<uint32_t>(payload->size());
   framed.push_back(static_cast<char>((len >> 24) & 0xff));
   framed.push_back(static_cast<char>((len >> 16) & 0xff));
   framed.push_back(static_cast<char>((len >> 8) & 0xff));
   framed.push_back(static_cast<char>(len & 0xff));
-  framed += serialized;
+  framed += *payload;
   return state_->conn->SendData(
       state_->stream_id, reinterpret_cast<const uint8_t*>(framed.data()),
       framed.size(), end_of_calls);
@@ -232,7 +314,7 @@ GrpcCall::Cancel()
 Error
 GrpcChannel::Create(
     std::shared_ptr<GrpcChannel>* channel, const std::string& url,
-    bool verbose)
+    bool verbose, const TlsOptions& tls)
 {
   std::string host;
   int port = 0;
@@ -241,7 +323,7 @@ GrpcChannel::Create(
     return err;
   }
   auto ch = std::shared_ptr<GrpcChannel>(new GrpcChannel(url));
-  err = H2Connection::Connect(&ch->conn_, host, port, verbose);
+  err = H2Connection::Connect(&ch->conn_, host, port, verbose, tls);
   if (!err.IsOk()) {
     return err;
   }
@@ -272,8 +354,14 @@ GrpcChannel::StartCall(
   if (timeout_us > 0) {
     headers.push_back({"grpc-timeout", EncodeGrpcTimeout(timeout_us)});
   }
+  // the receive path auto-detects either algorithm on the compressed flag
+  headers.push_back({"grpc-accept-encoding", "identity,deflate,gzip"});
   for (const auto& h : extra_headers) {
     headers.push_back(h);
+    if (h.name == "grpc-encoding" && h.value != "identity" &&
+        h.value != "none") {
+      state->send_encoding = h.value;
+    }
   }
 
   StreamHandler handler;

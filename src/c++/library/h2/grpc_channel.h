@@ -48,10 +48,11 @@ class GrpcCall {
 class GrpcChannel {
  public:
   // url is host:port (no scheme) — cleartext h2c, like the reference's
-  // insecure channel default.
+  // insecure channel default; tls.enabled upgrades to h2-over-TLS (the
+  // SslCredentials analogue).
   static Error Create(
       std::shared_ptr<GrpcChannel>* channel, const std::string& url,
-      bool verbose = false);
+      bool verbose = false, const TlsOptions& tls = TlsOptions());
 
   // Start a (possibly streaming) call on /<service>/<method>.
   // timeout_us > 0 adds a grpc-timeout header (server-side deadline).

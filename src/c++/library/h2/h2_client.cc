@@ -65,7 +65,7 @@ GetUint32(const uint8_t* p)
 Error
 H2Connection::Connect(
     std::shared_ptr<H2Connection>* connection, const std::string& host,
-    int port, bool verbose)
+    int port, bool verbose, const TlsOptions& tls)
 {
   struct addrinfo hints;
   std::memset(&hints, 0, sizeof(hints));
@@ -99,8 +99,28 @@ H2Connection::Connect(
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 
-  auto conn = std::shared_ptr<H2Connection>(
-      new H2Connection(fd, host + ":" + port_str, verbose));
+  std::unique_ptr<TlsDuplex> tls_session;
+  if (tls.enabled) {
+    TlsOptions h2_tls = tls;
+    if (h2_tls.alpn.empty()) {
+      h2_tls.alpn = {"h2"};
+    }
+    Error tls_err = TlsDuplex::Handshake(&tls_session, fd, h2_tls, host);
+    if (!tls_err.IsOk()) {
+      close(fd);
+      return tls_err;
+    }
+    if (!tls_session->SelectedAlpn().empty() &&
+        tls_session->SelectedAlpn() != "h2") {
+      close(fd);
+      return Error(
+          "TLS peer negotiated ALPN '" + tls_session->SelectedAlpn() +
+          "', expected h2");
+    }
+  }
+
+  auto conn = std::shared_ptr<H2Connection>(new H2Connection(
+      fd, host + ":" + port_str, verbose, std::move(tls_session)));
 
   // preface + SETTINGS(ENABLE_PUSH=0, INITIAL_WINDOW_SIZE) + connection
   // WINDOW_UPDATE, written before the reader starts.
@@ -115,7 +135,14 @@ H2Connection::Connect(
   put_setting(kSettingsEnablePush, 0);
   put_setting(kSettingsInitialWindowSize, kStreamRecvWindow);
 
-  if (::send(fd, kPreface, sizeof(kPreface) - 1, MSG_NOSIGNAL) !=
+  if (conn->tls_ != nullptr) {
+    Error perr = conn->tls_->SendAll(
+        reinterpret_cast<const uint8_t*>(kPreface), sizeof(kPreface) - 1);
+    if (!perr.IsOk()) {
+      return Error("failed to send h2 preface: " + perr.Message());
+    }
+  } else if (
+      ::send(fd, kPreface, sizeof(kPreface) - 1, MSG_NOSIGNAL) !=
       static_cast<ssize_t>(sizeof(kPreface) - 1)) {
     return Error("failed to send h2 preface: " + std::string(strerror(errno)));
   }
@@ -140,8 +167,11 @@ H2Connection::Connect(
   return Error::Success;
 }
 
-H2Connection::H2Connection(int fd, const std::string& authority, bool verbose)
-    : fd_(fd), authority_(authority), verbose_(verbose)
+H2Connection::H2Connection(
+    int fd, const std::string& authority, bool verbose,
+    std::unique_ptr<TlsDuplex> tls)
+    : fd_(fd), authority_(authority), verbose_(verbose),
+      tls_(std::move(tls))
 {
 }
 
@@ -158,6 +188,9 @@ H2Connection::Shutdown()
     // best-effort GOAWAY
     uint8_t payload[8] = {0};
     SendFrameRaw(kFrameGoaway, 0, 0, payload, 8);
+    if (tls_ != nullptr) {
+      tls_->ShutdownNotify();
+    }
   }
   ::shutdown(fd_, SHUT_RDWR);
   if (reader_.joinable()) {
@@ -193,6 +226,16 @@ H2Connection::SendFrameRaw(
   hdr[3] = type;
   hdr[4] = flags;
   PutUint32(hdr + 5, static_cast<uint32_t>(stream_id));
+  if (tls_ != nullptr) {
+    Error err = tls_->SendAll(hdr, 9);
+    if (err.IsOk() && len > 0) {
+      err = tls_->SendAll(payload, len);
+    }
+    if (!err.IsOk()) {
+      return Error("h2 send failed: " + err.Message());
+    }
+    return Error::Success;
+  }
   struct iovec iov[2];
   iov[0].iov_base = hdr;
   iov[0].iov_len = 9;
@@ -237,7 +280,9 @@ H2Connection::ReadExact(uint8_t* buf, size_t len)
 {
   size_t got = 0;
   while (got < len) {
-    ssize_t n = ::read(fd_, buf + got, len - got);
+    ssize_t n = tls_ != nullptr
+                    ? tls_->Recv(buf + got, len - got)
+                    : ::read(fd_, buf + got, len - got);
     if (n == 0) {
       return Error("h2 connection closed by peer");
     }

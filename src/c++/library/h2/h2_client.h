@@ -25,6 +25,7 @@
 
 #include "common.h"
 #include "hpack.h"
+#include "tls.h"
 
 namespace tc {
 namespace h2 {
@@ -41,9 +42,12 @@ struct StreamHandler {
 
 class H2Connection {
  public:
+  // tls.enabled upgrades the connection to h2-over-TLS (ALPN "h2",
+  // full-duplex engine — tls.h TlsDuplex); cleartext h2c otherwise.
   static Error Connect(
       std::shared_ptr<H2Connection>* connection, const std::string& host,
-      int port, bool verbose = false);
+      int port, bool verbose = false,
+      const TlsOptions& tls = TlsOptions());
 
   ~H2Connection();
   H2Connection(const H2Connection&) = delete;
@@ -72,7 +76,9 @@ class H2Connection {
   void Shutdown();
 
  private:
-  H2Connection(int fd, const std::string& authority, bool verbose);
+  H2Connection(
+      int fd, const std::string& authority, bool verbose,
+      std::unique_ptr<TlsDuplex> tls);
 
   struct Stream {
     StreamHandler handler;
@@ -104,6 +110,7 @@ class H2Connection {
   int fd_;
   std::string authority_;
   bool verbose_;
+  std::unique_ptr<TlsDuplex> tls_;  // null for cleartext h2c
   std::atomic<bool> dead_{false};
   std::string dead_reason_;
 

@@ -40,8 +40,8 @@ UriEscape(const std::string& s)
 //
 class HttpConnection {
  public:
-  HttpConnection(const std::string& host, int port)
-      : host_(host), port_(port), fd_(-1)
+  HttpConnection(const std::string& host, int port, const TlsOptions& tls)
+      : host_(host), port_(port), tls_opts_(tls), fd_(-1)
   {
   }
 
@@ -49,6 +49,10 @@ class HttpConnection {
 
   void Close()
   {
+    if (tls_ != nullptr) {
+      tls_->ShutdownNotify();
+      tls_.reset();
+    }
     if (fd_ >= 0) {
       ::close(fd_);
       fd_ = -1;
@@ -90,6 +94,14 @@ class HttpConnection {
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     SetTimeout(timeout_us);
+    if (tls_opts_.enabled) {
+      Error tls_err =
+          TlsSession::Handshake(&tls_, fd_, tls_opts_, host_);
+      if (!tls_err.IsOk()) {
+        Close();
+        return tls_err;
+      }
+    }
     return Error::Success;
   }
 
@@ -112,11 +124,17 @@ class HttpConnection {
 
   Error SendAll(const struct iovec* iov, int iovcnt)
   {
-    // writev with continuation across partial writes
+    // writev with continuation across partial writes; TLS sessions take
+    // the per-iovec path (SSL_write has no scatter-gather)
     std::vector<struct iovec> vec(iov, iov + iovcnt);
     size_t idx = 0;
     while (idx < vec.size()) {
-      ssize_t n = writev(fd_, vec.data() + idx, (int)(vec.size() - idx));
+      ssize_t n;
+      if (tls_ != nullptr) {
+        n = tls_->Send(vec[idx].iov_base, vec[idx].iov_len);
+      } else {
+        n = writev(fd_, vec.data() + idx, (int)(vec.size() - idx));
+      }
       if (n < 0) {
         if (errno == EINTR) {
           continue;
@@ -137,6 +155,15 @@ class HttpConnection {
     return Error::Success;
   }
 
+  // recv() for whichever transport is live (plain fd or TLS session)
+  ssize_t RecvSome(void* buf, size_t len)
+  {
+    if (tls_ != nullptr) {
+      return tls_->Recv(buf, len);
+    }
+    return recv(fd_, buf, len, 0);
+  }
+
   // Read an HTTP/1.1 response: status code, headers, body (Content-Length
   // or chunked).
   Error ReadResponse(
@@ -154,7 +181,7 @@ class HttpConnection {
         break;
       }
       char tmp[8192];
-      ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
+      ssize_t n = RecvSome(tmp, sizeof(tmp));
       if (n <= 0) {
         Close();
         return Error(
@@ -212,8 +239,8 @@ class HttpConnection {
     while (body->size() < content_length) {
       char tmp[65536];
       size_t want = content_length - body->size();
-      ssize_t n = recv(
-          fd_, tmp, want < sizeof(tmp) ? want : sizeof(tmp), 0);
+      ssize_t n = RecvSome(
+          tmp, want < sizeof(tmp) ? want : sizeof(tmp));
       if (n <= 0) {
         Close();
         return Error(
@@ -236,7 +263,7 @@ class HttpConnection {
       size_t eol;
       while ((eol = buf.find("\r\n", pos)) == std::string::npos) {
         char tmp[8192];
-        ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
+        ssize_t n = RecvSome(tmp, sizeof(tmp));
         if (n <= 0) {
           Close();
           return Error("connection closed mid chunked body");
@@ -253,7 +280,7 @@ class HttpConnection {
           size_t teol;
           while ((teol = buf.find("\r\n", pos)) == std::string::npos) {
             char tmp[1024];
-            ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
+            ssize_t n = RecvSome(tmp, sizeof(tmp));
             if (n <= 0) {
               Close();
               return Error("connection closed in chunked trailer");
@@ -269,7 +296,7 @@ class HttpConnection {
       }
       while (buf.size() < pos + chunk_len + 2) {
         char tmp[65536];
-        ssize_t n = recv(fd_, tmp, sizeof(tmp), 0);
+        ssize_t n = RecvSome(tmp, sizeof(tmp));
         if (n <= 0) {
           Close();
           return Error("connection closed mid chunked body");
@@ -283,6 +310,8 @@ class HttpConnection {
 
   std::string host_;
   int port_;
+  TlsOptions tls_opts_;
+  std::unique_ptr<TlsSession> tls_;
   int fd_;
 };
 
@@ -291,8 +320,10 @@ class HttpConnection {
 //
 class HttpConnectionPool {
  public:
-  HttpConnectionPool(const std::string& host, int port)
-      : host_(host), port_(port)
+  HttpConnectionPool(
+      const std::string& host, int port,
+      const TlsOptions& tls = TlsOptions())
+      : host_(host), port_(port), tls_(tls)
   {
   }
 
@@ -305,7 +336,7 @@ class HttpConnectionPool {
       return conn;
     }
     return std::unique_ptr<HttpConnection>(
-        new HttpConnection(host_, port_));
+        new HttpConnection(host_, port_, tls_));
   }
 
   void Release(std::unique_ptr<HttpConnection> conn)
@@ -319,6 +350,7 @@ class HttpConnectionPool {
  private:
   std::string host_;
   int port_;
+  TlsOptions tls_;
   std::mutex mu_;
   std::vector<std::unique_ptr<HttpConnection>> idle_;
 };
@@ -495,17 +527,29 @@ InferResultHttp::Create(
 Error
 InferenceServerHttpClient::Create(
     std::unique_ptr<InferenceServerHttpClient>* client,
-    const std::string& server_url, bool verbose, int concurrency)
+    const std::string& server_url, bool verbose, int concurrency,
+    const HttpSslOptions& ssl_options)
 {
-  client->reset(
-      new InferenceServerHttpClient(server_url, verbose, concurrency));
+  if (server_url.rfind("https://", 0) == 0) {
+    std::string why;
+    if (!TlsSession::Available(&why)) {
+      return Error("https requested but " + why);
+    }
+  }
+  client->reset(new InferenceServerHttpClient(
+      server_url, verbose, concurrency, ssl_options));
   return Error::Success;
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(
-    const std::string& url, bool verbose, int concurrency)
+    const std::string& url, bool verbose, int concurrency,
+    const HttpSslOptions& ssl_options)
     : InferenceServerClient(verbose)
 {
+  // TLS iff the URL carries the https scheme (reference semantics:
+  // SetSSLCurlOptions applies to an https:// URL,
+  // reference http_client.cc:253-280)
+  bool use_tls = url.rfind("https://", 0) == 0;
   std::string stripped = url;
   auto scheme = stripped.find("://");
   if (scheme != std::string::npos) {
@@ -514,12 +558,19 @@ InferenceServerHttpClient::InferenceServerHttpClient(
   auto colon = stripped.rfind(':');
   if (colon == std::string::npos) {
     host_ = stripped;
-    port_ = 8000;
+    port_ = use_tls ? 443 : 8000;
   } else {
     host_ = stripped.substr(0, colon);
     port_ = atoi(stripped.c_str() + colon + 1);
   }
-  pool_.reset(new HttpConnectionPool(host_, port_));
+  TlsOptions tls;
+  tls.enabled = use_tls;
+  tls.ca_file = ssl_options.ca_info;
+  tls.cert_file = ssl_options.cert;
+  tls.key_file = ssl_options.key;
+  tls.verify_peer = ssl_options.verify_peer != 0;
+  tls.verify_host = ssl_options.verify_host != 0;
+  pool_.reset(new HttpConnectionPool(host_, port_, tls));
   for (int i = 0; i < concurrency; ++i) {
     workers_.emplace_back(&InferenceServerHttpClient::AsyncWorker, this);
   }

@@ -20,18 +20,38 @@
 
 #include "common.h"
 #include "tjson.h"
+#include "tls.h"
 
 namespace tc {
 
 class HttpConnectionPool;
 
+// TLS settings for https:// server URLs (API parity with the reference's
+// curl-backed struct, reference http_client.h:46-87; served here by the
+// dlopen'd-OpenSSL transport in tls.h).  verify_peer/verify_host keep the
+// curl numeric convention: 0 disables, the defaults (1/2) enable.
+struct HttpSslOptions {
+  enum CERTTYPE { CERT_PEM = 0, CERT_DER = 1 };
+  enum KEYTYPE { KEY_PEM = 0, KEY_DER = 1 };
+  long verify_peer = 1;
+  long verify_host = 2;
+  std::string ca_info;       // PEM roots; empty = system default paths
+  CERTTYPE cert_type = CERT_PEM;  // only PEM is supported
+  std::string cert;          // client certificate chain
+  KEYTYPE key_type = KEY_PEM;     // only PEM is supported
+  std::string key;           // client private key
+};
+
 //==============================================================================
 class InferenceServerHttpClient : public InferenceServerClient {
  public:
+  // server_url may carry an http:// or https:// scheme; https enables
+  // TLS with ssl_options (reference http_client.h:152-157).
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& server_url, bool verbose = false,
-      int concurrency = 4);
+      int concurrency = 4,
+      const HttpSslOptions& ssl_options = HttpSslOptions());
 
   ~InferenceServerHttpClient();
 
@@ -122,7 +142,8 @@ class InferenceServerHttpClient : public InferenceServerClient {
 
  private:
   InferenceServerHttpClient(
-      const std::string& url, bool verbose, int concurrency);
+      const std::string& url, bool verbose, int concurrency,
+      const HttpSslOptions& ssl_options);
 
   Error Get(
       const std::string& path, long* http_code, std::string* response);

@@ -1,0 +1,498 @@
+// dlopen'd OpenSSL 3 TLS session (see tls.h for the design rationale).
+
+#include "tls.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+
+namespace tc {
+
+namespace {
+
+// Minimal OpenSSL 3 surface, resolved at runtime.  Types are opaque
+// pointers; constants below match the stable public ABI.
+struct SslApi {
+  int (*OPENSSL_init_ssl)(uint64_t, const void*);
+  const void* (*TLS_client_method)();
+  void* (*SSL_CTX_new)(const void*);
+  void (*SSL_CTX_free)(void*);
+  int (*SSL_CTX_load_verify_locations)(void*, const char*, const char*);
+  int (*SSL_CTX_set_default_verify_paths)(void*);
+  int (*SSL_CTX_use_certificate_chain_file)(void*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(void*, const char*, int);
+  void (*SSL_CTX_set_verify)(void*, int, void*);
+  int (*SSL_CTX_set_alpn_protos)(void*, const unsigned char*, unsigned);
+  void* (*SSL_new)(void*);
+  void (*SSL_free)(void*);
+  int (*SSL_set_fd)(void*, int);
+  int (*SSL_connect)(void*);
+  int (*SSL_read)(void*, void*, int);
+  int (*SSL_write)(void*, const void*, int);
+  int (*SSL_shutdown)(void*);
+  int (*SSL_get_error)(const void*, int);
+  int (*SSL_pending)(const void*);
+  long (*SSL_ctrl)(void*, int, long, void*);
+  long (*SSL_CTX_ctrl)(void*, int, long, void*);
+  int (*SSL_set1_host)(void*, const char*);
+  void (*SSL_get0_alpn_selected)(
+      const void*, const unsigned char**, unsigned*);
+  unsigned long (*ERR_get_error)();
+  void (*ERR_error_string_n)(unsigned long, char*, size_t);
+
+  void* libssl = nullptr;
+  void* libcrypto = nullptr;
+  bool ok = false;
+  std::string why;
+};
+
+// public ABI constants (openssl/ssl.h, openssl/tls1.h)
+constexpr int kSslErrorWantRead = 2;
+constexpr int kSslErrorWantWrite = 3;
+constexpr int kSslErrorSyscall = 5;
+constexpr int kSslFiletypePem = 1;
+constexpr int kSslVerifyNone = 0;
+constexpr int kSslVerifyPeer = 1;
+constexpr int kSslCtrlSetTlsextHostname = 55;
+constexpr long kTlsextNametypeHostName = 0;
+constexpr int kSslCtrlMode = 33;
+// ENABLE_PARTIAL_WRITE | ACCEPT_MOVING_WRITE_BUFFER: non-blocking
+// writers may retry from advanced buffer positions
+constexpr long kSslModeNonblockWrite = 0x1 | 0x2;
+
+SslApi&
+Api()
+{
+  static SslApi api;
+  static std::once_flag once;
+  std::call_once(once, []() {
+    // libcrypto first: libssl depends on it, and loading it explicitly
+    // keeps its symbols resolvable under RTLD_LOCAL
+    api.libcrypto = dlopen("libcrypto.so.3", RTLD_NOW | RTLD_GLOBAL);
+    if (api.libcrypto == nullptr) {
+      api.libcrypto = dlopen("libcrypto.so", RTLD_NOW | RTLD_GLOBAL);
+    }
+    api.libssl = dlopen("libssl.so.3", RTLD_NOW);
+    if (api.libssl == nullptr) {
+      api.libssl = dlopen("libssl.so", RTLD_NOW);
+    }
+    if (api.libssl == nullptr) {
+      api.why = std::string("libssl not found: ") + dlerror();
+      return;
+    }
+    auto need = [&](const char* name) -> void* {
+      void* sym = dlsym(api.libssl, name);
+      if (sym == nullptr && api.libcrypto != nullptr) {
+        sym = dlsym(api.libcrypto, name);
+      }
+      if (sym == nullptr && api.why.empty()) {
+        api.why = std::string("missing symbol ") + name;
+      }
+      return sym;
+    };
+#define TC_RESOLVE(field) \
+  api.field = reinterpret_cast<decltype(api.field)>(need(#field))
+    TC_RESOLVE(OPENSSL_init_ssl);
+    TC_RESOLVE(TLS_client_method);
+    TC_RESOLVE(SSL_CTX_new);
+    TC_RESOLVE(SSL_CTX_free);
+    TC_RESOLVE(SSL_CTX_load_verify_locations);
+    TC_RESOLVE(SSL_CTX_set_default_verify_paths);
+    TC_RESOLVE(SSL_CTX_use_certificate_chain_file);
+    TC_RESOLVE(SSL_CTX_use_PrivateKey_file);
+    TC_RESOLVE(SSL_CTX_set_verify);
+    TC_RESOLVE(SSL_CTX_set_alpn_protos);
+    TC_RESOLVE(SSL_new);
+    TC_RESOLVE(SSL_free);
+    TC_RESOLVE(SSL_set_fd);
+    TC_RESOLVE(SSL_connect);
+    TC_RESOLVE(SSL_read);
+    TC_RESOLVE(SSL_write);
+    TC_RESOLVE(SSL_shutdown);
+    TC_RESOLVE(SSL_get_error);
+    TC_RESOLVE(SSL_pending);
+    TC_RESOLVE(SSL_ctrl);
+    TC_RESOLVE(SSL_CTX_ctrl);
+    TC_RESOLVE(SSL_set1_host);
+    TC_RESOLVE(SSL_get0_alpn_selected);
+    TC_RESOLVE(ERR_get_error);
+    TC_RESOLVE(ERR_error_string_n);
+#undef TC_RESOLVE
+    if (!api.why.empty()) {
+      return;
+    }
+    api.OPENSSL_init_ssl(0, nullptr);
+    api.ok = true;
+  });
+  return api;
+}
+
+std::string
+LastSslError(SslApi& api, const char* what)
+{
+  char buf[256];
+  unsigned long code = api.ERR_get_error();
+  if (code == 0) {
+    return std::string(what) + ": unknown TLS error";
+  }
+  api.ERR_error_string_n(code, buf, sizeof(buf));
+  // drain the queue so a later call reports its own error
+  while (api.ERR_get_error() != 0) {
+  }
+  return std::string(what) + ": " + buf;
+}
+
+// Build an SSL_CTX + SSL for a client connection on ``fd`` per ``opts``
+// (CA/cert/key, verify flags, ALPN, SNI + host verification).  Shared by
+// the blocking (TlsSession) and full-duplex (TlsDuplex) wrappers.
+Error
+BuildEngine(
+    SslApi& api, const TlsOptions& opts, const std::string& host, int fd,
+    void** ctx_out, void** ssl_out)
+{
+  void*& ctx = *ctx_out;
+  void*& ssl = *ssl_out;
+  ctx = api.SSL_CTX_new(api.TLS_client_method());
+  if (ctx == nullptr) {
+    return Error(LastSslError(api, "SSL_CTX_new failed"));
+  }
+  if (!opts.ca_file.empty()) {
+    if (api.SSL_CTX_load_verify_locations(
+            ctx, opts.ca_file.c_str(), nullptr) != 1) {
+      return Error(
+          LastSslError(api, ("loading CA file " + opts.ca_file).c_str()));
+    }
+  } else {
+    api.SSL_CTX_set_default_verify_paths(ctx);
+  }
+  if (!opts.cert_file.empty()) {
+    if (api.SSL_CTX_use_certificate_chain_file(
+            ctx, opts.cert_file.c_str()) != 1) {
+      return Error(LastSslError(
+          api, ("loading client cert " + opts.cert_file).c_str()));
+    }
+  }
+  if (!opts.key_file.empty()) {
+    if (api.SSL_CTX_use_PrivateKey_file(
+            ctx, opts.key_file.c_str(), kSslFiletypePem) != 1) {
+      return Error(LastSslError(
+          api, ("loading client key " + opts.key_file).c_str()));
+    }
+  }
+  api.SSL_CTX_set_verify(
+      ctx, opts.verify_peer ? kSslVerifyPeer : kSslVerifyNone, nullptr);
+  if (!opts.alpn.empty()) {
+    // wire format: length-prefixed protocol names
+    std::vector<unsigned char> wire;
+    for (const auto& proto : opts.alpn) {
+      wire.push_back(static_cast<unsigned char>(proto.size()));
+      wire.insert(wire.end(), proto.begin(), proto.end());
+    }
+    // note inverted convention: 0 means success
+    if (api.SSL_CTX_set_alpn_protos(
+            ctx, wire.data(), (unsigned)wire.size()) != 0) {
+      return Error(LastSslError(api, "SSL_CTX_set_alpn_protos failed"));
+    }
+  }
+  ssl = api.SSL_new(ctx);
+  if (ssl == nullptr) {
+    return Error(LastSslError(api, "SSL_new failed"));
+  }
+  if (api.SSL_set_fd(ssl, fd) != 1) {
+    return Error(LastSslError(api, "SSL_set_fd failed"));
+  }
+  // SNI (macro SSL_set_tlsext_host_name in the headers); the host part
+  // only, certificates never carry ports
+  api.SSL_ctrl(
+      ssl, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+      const_cast<char*>(host.c_str()));
+  if (opts.verify_peer && opts.verify_host) {
+    if (api.SSL_set1_host(ssl, host.c_str()) != 1) {
+      return Error(LastSslError(api, "SSL_set1_host failed"));
+    }
+  }
+  return Error::Success;
+}
+
+void
+ReadAlpn(SslApi& api, void* ssl, std::string* out)
+{
+  const unsigned char* proto = nullptr;
+  unsigned proto_len = 0;
+  api.SSL_get0_alpn_selected(ssl, &proto, &proto_len);
+  if (proto != nullptr && proto_len > 0) {
+    out->assign(reinterpret_cast<const char*>(proto), proto_len);
+  }
+}
+
+}  // namespace
+
+bool
+TlsSession::Available(std::string* why)
+{
+  SslApi& api = Api();
+  if (!api.ok && why != nullptr) {
+    *why = api.why;
+  }
+  return api.ok;
+}
+
+Error
+TlsSession::Handshake(
+    std::unique_ptr<TlsSession>* session, int fd, const TlsOptions& opts,
+    const std::string& host)
+{
+  SslApi& api = Api();
+  if (!api.ok) {
+    return Error("TLS unavailable: " + api.why);
+  }
+  std::unique_ptr<TlsSession> s(new TlsSession());
+  Error err = BuildEngine(api, opts, host, fd, &s->ctx_, &s->ssl_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  int rc = api.SSL_connect(s->ssl_);
+  if (rc != 1) {
+    int detail = api.SSL_get_error(s->ssl_, rc);
+    if (detail == kSslErrorSyscall && errno != 0) {
+      return Error(
+          std::string("TLS handshake failed: ") + strerror(errno));
+    }
+    return Error(LastSslError(api, "TLS handshake failed"));
+  }
+  ReadAlpn(api, s->ssl_, &s->alpn_);
+  *session = std::move(s);
+  return Error::Success;
+}
+
+TlsSession::~TlsSession()
+{
+  SslApi& api = Api();
+  if (ssl_ != nullptr && api.ok) {
+    api.SSL_free(ssl_);
+  }
+  if (ctx_ != nullptr && api.ok) {
+    api.SSL_CTX_free(ctx_);
+  }
+}
+
+ssize_t
+TlsSession::Send(const void* buf, size_t len)
+{
+  SslApi& api = Api();
+  int rc = api.SSL_write(ssl_, buf, (int)len);
+  if (rc > 0) {
+    return rc;
+  }
+  int detail = api.SSL_get_error(ssl_, rc);
+  if (detail == kSslErrorWantRead || detail == kSslErrorWantWrite) {
+    errno = EAGAIN;  // SO_SNDTIMEO expired mid-record
+  } else if (detail != kSslErrorSyscall) {
+    errno = EPROTO;
+  }
+  return -1;
+}
+
+ssize_t
+TlsSession::Recv(void* buf, size_t len)
+{
+  SslApi& api = Api();
+  int rc = api.SSL_read(ssl_, buf, (int)len);
+  if (rc > 0) {
+    return rc;
+  }
+  int detail = api.SSL_get_error(ssl_, rc);
+  if (detail == 0 /* SSL_ERROR_NONE */ ||
+      detail == 6 /* SSL_ERROR_ZERO_RETURN: clean close_notify */) {
+    return 0;
+  }
+  if (detail == kSslErrorWantRead || detail == kSslErrorWantWrite) {
+    errno = EAGAIN;  // SO_RCVTIMEO expired
+  } else if (detail == kSslErrorSyscall && rc == 0) {
+    return 0;  // peer closed without close_notify
+  } else if (detail != kSslErrorSyscall) {
+    errno = EPROTO;
+  }
+  return -1;
+}
+
+void
+TlsSession::ShutdownNotify()
+{
+  SslApi& api = Api();
+  if (ssl_ != nullptr && api.ok) {
+    api.SSL_shutdown(ssl_);
+  }
+}
+
+//==============================================================================
+// TlsDuplex
+
+namespace {
+
+int
+PollFd(int fd, bool want_write, int timeout_ms)
+{
+  struct pollfd pfd;
+  pfd.fd = fd;
+  pfd.events = want_write ? POLLOUT : POLLIN;
+  pfd.revents = 0;
+  return poll(&pfd, 1, timeout_ms);
+}
+
+}  // namespace
+
+Error
+TlsDuplex::Handshake(
+    std::unique_ptr<TlsDuplex>* duplex, int fd, const TlsOptions& opts,
+    const std::string& host, int handshake_timeout_ms)
+{
+  SslApi& api = Api();
+  if (!api.ok) {
+    return Error("TLS unavailable: " + api.why);
+  }
+  std::unique_ptr<TlsDuplex> d(new TlsDuplex());
+  d->fd_ = fd;
+  Error err = BuildEngine(api, opts, host, fd, &d->ctx_, &d->ssl_);
+  if (!err.IsOk()) {
+    return err;
+  }
+  // on the SSL object, not the ctx: SSL_new copied the ctx's mode
+  // before this point (SSL_set_mode is a macro over SSL_ctrl)
+  api.SSL_ctrl(d->ssl_, kSslCtrlMode, kSslModeNonblockWrite, nullptr);
+  int flags = fcntl(fd, F_GETFL, 0);
+  if (flags < 0 || fcntl(fd, F_SETFL, flags | O_NONBLOCK) < 0) {
+    return Error(
+        std::string("failed to set O_NONBLOCK: ") + strerror(errno));
+  }
+  // non-blocking handshake bounded by the deadline
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(handshake_timeout_ms);
+  while (true) {
+    int rc = api.SSL_connect(d->ssl_);
+    if (rc == 1) {
+      break;
+    }
+    int detail = api.SSL_get_error(d->ssl_, rc);
+    if (detail != kSslErrorWantRead && detail != kSslErrorWantWrite) {
+      if (detail == kSslErrorSyscall && errno != 0) {
+        return Error(
+            std::string("TLS handshake failed: ") + strerror(errno));
+      }
+      return Error(LastSslError(api, "TLS handshake failed"));
+    }
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    if (left <= 0) {
+      return Error("TLS handshake timed out");
+    }
+    if (PollFd(fd, detail == kSslErrorWantWrite, (int)left) < 0 &&
+        errno != EINTR) {
+      return Error(std::string("poll failed: ") + strerror(errno));
+    }
+  }
+  ReadAlpn(api, d->ssl_, &d->alpn_);
+  *duplex = std::move(d);
+  return Error::Success;
+}
+
+TlsDuplex::~TlsDuplex()
+{
+  SslApi& api = Api();
+  if (ssl_ != nullptr && api.ok) {
+    api.SSL_free(ssl_);
+  }
+  if (ctx_ != nullptr && api.ok) {
+    api.SSL_CTX_free(ctx_);
+  }
+}
+
+Error
+TlsDuplex::SendAll(const uint8_t* data, size_t len)
+{
+  SslApi& api = Api();
+  size_t sent = 0;
+  while (sent < len) {
+    int rc;
+    int detail = 0;
+    {
+      std::lock_guard<std::mutex> lk(engine_mu_);
+      rc = api.SSL_write(ssl_, data + sent, (int)(len - sent));
+      if (rc <= 0) {
+        detail = api.SSL_get_error(ssl_, rc);
+      }
+    }
+    if (rc > 0) {
+      sent += (size_t)rc;
+      continue;
+    }
+    if (detail == kSslErrorWantWrite || detail == kSslErrorWantRead) {
+      // socket buffer full (or engine needs peer bytes the reader will
+      // pump); wait without holding the engine lock
+      if (PollFd(fd_, detail == kSslErrorWantWrite, 5000) < 0 &&
+          errno != EINTR) {
+        return Error(std::string("poll failed: ") + strerror(errno));
+      }
+      continue;
+    }
+    if (detail == kSslErrorSyscall && errno != 0) {
+      return Error(std::string("TLS send failed: ") + strerror(errno));
+    }
+    return Error(LastSslError(api, "TLS send failed"));
+  }
+  return Error::Success;
+}
+
+ssize_t
+TlsDuplex::Recv(uint8_t* buf, size_t len)
+{
+  SslApi& api = Api();
+  while (true) {
+    int rc;
+    int detail = 0;
+    {
+      std::lock_guard<std::mutex> lk(engine_mu_);
+      rc = api.SSL_read(ssl_, buf, (int)len);
+      if (rc <= 0) {
+        detail = api.SSL_get_error(ssl_, rc);
+      }
+    }
+    if (rc > 0) {
+      return rc;
+    }
+    if (detail == kSslErrorWantRead || detail == kSslErrorWantWrite) {
+      if (PollFd(fd_, detail == kSslErrorWantWrite, -1) < 0 &&
+          errno != EINTR) {
+        return -1;
+      }
+      continue;
+    }
+    if (detail == 6 /* SSL_ERROR_ZERO_RETURN */) {
+      return 0;
+    }
+    if (detail == kSslErrorSyscall) {
+      return rc == 0 ? 0 : -1;  // peer closed without close_notify
+    }
+    errno = EPROTO;
+    return -1;
+  }
+}
+
+void
+TlsDuplex::ShutdownNotify()
+{
+  SslApi& api = Api();
+  if (ssl_ != nullptr && api.ok) {
+    std::lock_guard<std::mutex> lk(engine_mu_);
+    api.SSL_shutdown(ssl_);
+  }
+}
+
+}  // namespace tc

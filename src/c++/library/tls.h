@@ -1,0 +1,113 @@
+// TLS transport for the raw-socket HTTP client and the h2/gRPC channel.
+//
+// The reference stack gets TLS for free from libcurl / grpc++
+// (reference src/c++/library/http_client.cc:253-280 SetSSLCurlOptions,
+// grpc_client.cc:78-145 SslCredentials); this image has neither, nor
+// OpenSSL headers — but it does ship libssl.so.3/libcrypto.so.3.  So,
+// mirroring the dlopen-MPI approach (perf_analyzer/mpi_utils.cc), the
+// needed OpenSSL 3 entry points are dlopen'd and declared by hand, and a
+// TlsSession wraps an already-connected fd with handshake + read/write.
+// Both transports stay single-code-path: they talk to the socket through
+// Send/Recv here whether or not TLS is on.
+
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace tc {
+
+// Transport-neutral TLS settings, filled from the protocol-specific
+// option structs (HttpSslOptions / SslOptions).
+struct TlsOptions {
+  bool enabled = false;
+  // PEM file with trusted roots; empty = OpenSSL default verify paths.
+  std::string ca_file;
+  // Client certificate chain + private key (PEM), both optional.
+  std::string cert_file;
+  std::string key_file;
+  // Verify the server certificate chain / that the cert matches the
+  // host name (reference semantics: CURLOPT_SSL_VERIFYPEER/-HOST).
+  bool verify_peer = true;
+  bool verify_host = true;
+  // ALPN protocols to offer, e.g. {"h2"} for gRPC; empty offers none.
+  std::vector<std::string> alpn;
+};
+
+// One TLS client session over a connected socket.  Blocking; honors the
+// fd's SO_RCVTIMEO/SO_SNDTIMEO (a timeout surfaces as -1 with
+// errno=EAGAIN from Recv/Send, like the plain socket would).
+class TlsSession {
+ public:
+  // Is libssl available in this process? (dlopen on first call)
+  static bool Available(std::string* why = nullptr);
+
+  // Wrap ``fd`` (already connected): build a context from ``opts``,
+  // send SNI for ``host``, handshake, and verify per opts.  On error the
+  // fd is left open (caller owns it).
+  static Error Handshake(
+      std::unique_ptr<TlsSession>* session, int fd, const TlsOptions& opts,
+      const std::string& host);
+
+  ~TlsSession();
+  TlsSession(const TlsSession&) = delete;
+  TlsSession& operator=(const TlsSession&) = delete;
+
+  // write/read semantics of send/recv: bytes moved, or -1 with errno.
+  ssize_t Send(const void* buf, size_t len);
+  ssize_t Recv(void* buf, size_t len);
+
+  // Protocol the server selected via ALPN ("" when none).
+  const std::string& SelectedAlpn() const { return alpn_; }
+
+  // Best-effort close_notify (does not close the fd).
+  void ShutdownNotify();
+
+ private:
+  TlsSession() = default;
+  void* ssl_ = nullptr;  // SSL*
+  void* ctx_ = nullptr;  // SSL_CTX*
+  std::string alpn_;
+};
+
+// Full-duplex TLS for the h2 transport: one reader thread blocks in
+// Recv while writer threads call SendAll concurrently.  A single
+// blocking SSL* cannot do that (the object is not thread-safe), so the
+// socket runs non-blocking and every engine call happens under a
+// short-held mutex; blocking semantics are rebuilt with poll() OUTSIDE
+// the lock, so a stalled reader never starves writers or vice versa.
+class TlsDuplex {
+ public:
+  // Puts ``fd`` in non-blocking mode and handshakes (bounded by
+  // ``handshake_timeout_ms``).
+  static Error Handshake(
+      std::unique_ptr<TlsDuplex>* duplex, int fd, const TlsOptions& opts,
+      const std::string& host, int handshake_timeout_ms = 30000);
+
+  ~TlsDuplex();
+  TlsDuplex(const TlsDuplex&) = delete;
+  TlsDuplex& operator=(const TlsDuplex&) = delete;
+
+  // Write the whole buffer (the h2 layer serializes senders itself).
+  Error SendAll(const uint8_t* data, size_t len);
+  // Block until >=1 byte of plaintext (or 0 on clean close, -1 errno).
+  ssize_t Recv(uint8_t* buf, size_t len);
+
+  const std::string& SelectedAlpn() const { return alpn_; }
+  void ShutdownNotify();
+
+ private:
+  TlsDuplex() = default;
+  void* ssl_ = nullptr;
+  void* ctx_ = nullptr;
+  int fd_ = -1;
+  std::string alpn_;
+  // guards every SSL_* call; never held across poll()
+  std::mutex engine_mu_;
+};
+
+}  // namespace tc

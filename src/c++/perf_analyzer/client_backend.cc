@@ -19,8 +19,11 @@ class TritonHttpBackend : public ClientBackend {
       const BackendFactoryConfig& config)
   {
     auto* b = new TritonHttpBackend();
+    // a CA/cert/key or disabled-verify setting only engages when the
+    // URL carries the https scheme (reference curl semantics)
     tc::Error err = tc::InferenceServerHttpClient::Create(
-        &b->client_, config.url, config.verbose, config.concurrency);
+        &b->client_, config.url, config.verbose, config.concurrency,
+        config.http_ssl);
     if (!err.IsOk()) {
       delete b;
       return err;
@@ -230,7 +233,11 @@ class TritonGrpcBackend : public ClientBackend {
   {
     auto* b = new TritonGrpcBackend();
     tc::Error err = tc::InferenceServerGrpcClient::Create(
-        &b->client_, config.url, config.verbose);
+        &b->client_, config.url, config.verbose, config.grpc_use_ssl,
+        config.grpc_ssl);
+    if (err.IsOk() && !config.grpc_compression.empty()) {
+      err = b->client_->SetInferCompression(config.grpc_compression);
+    }
     if (!err.IsOk()) {
       delete b;
       return err;

@@ -12,6 +12,8 @@
 #include <vector>
 
 #include "common.h"  // tc::Error et al. from the client library
+#include "grpc_client.h"  // tc::SslOptions
+#include "http_client.h"  // tc::HttpSslOptions
 #include "perf_utils.h"
 
 namespace pa {
@@ -134,6 +136,14 @@ struct BackendFactoryConfig {
   // --triton-server-directory for the C-API backend)
   std::string server_src;
   bool inproc_vision = false;
+  // TLS (reference --ssl-grpc-*/--ssl-https-* option families)
+  bool grpc_use_ssl = false;
+  tc::SslOptions grpc_ssl;
+  tc::HttpSslOptions http_ssl;
+  // per-message gRPC compression: "" | gzip | deflate
+  std::string grpc_compression;
+  // TF-Serving signature (reference --model-signature-name)
+  std::string model_signature_name = "serving_default";
 };
 
 class ClientBackendFactory {

@@ -46,6 +46,25 @@ enum LongOptIds {
   OPT_ENABLE_MPI,
   OPT_SERVER_SRC,
   OPT_SERVER_ZOO,
+  OPT_SSL_GRPC_USE_SSL,
+  OPT_SSL_GRPC_ROOT_CERTS,
+  OPT_SSL_GRPC_PRIVATE_KEY,
+  OPT_SSL_GRPC_CERT_CHAIN,
+  OPT_SSL_HTTPS_VERIFY_PEER,
+  OPT_SSL_HTTPS_VERIFY_HOST,
+  OPT_SSL_HTTPS_CA_CERTS,
+  OPT_SSL_HTTPS_CLIENT_CERT,
+  OPT_SSL_HTTPS_CLIENT_CERT_TYPE,
+  OPT_SSL_HTTPS_PRIVATE_KEY,
+  OPT_SSL_HTTPS_PRIVATE_KEY_TYPE,
+  OPT_SHAPE,
+  OPT_NUM_OF_SEQUENCES,
+  OPT_DATA_DIRECTORY,
+  OPT_GRPC_COMPRESSION,
+  OPT_MODEL_SIGNATURE_NAME,
+  OPT_BLS_COMPOSING_MODELS,
+  OPT_TRITON_SERVER_DIRECTORY,
+  OPT_MODEL_REPOSITORY,
 };
 
 const struct option kLongOptions[] = {
@@ -114,6 +133,41 @@ const struct option kLongOptions[] = {
     {"verbose-csv", no_argument, nullptr, OPT_VERBOSE_CSV},
     {"enable-mpi", no_argument, nullptr, OPT_ENABLE_MPI},
     {"max-threads", required_argument, nullptr, 2001},
+    {"ssl-grpc-use-ssl", no_argument, nullptr, OPT_SSL_GRPC_USE_SSL},
+    {"ssl-grpc-root-certifications-file", required_argument, nullptr,
+     OPT_SSL_GRPC_ROOT_CERTS},
+    {"ssl-grpc-private-key-file", required_argument, nullptr,
+     OPT_SSL_GRPC_PRIVATE_KEY},
+    {"ssl-grpc-certificate-chain-file", required_argument, nullptr,
+     OPT_SSL_GRPC_CERT_CHAIN},
+    {"ssl-https-verify-peer", required_argument, nullptr,
+     OPT_SSL_HTTPS_VERIFY_PEER},
+    {"ssl-https-verify-host", required_argument, nullptr,
+     OPT_SSL_HTTPS_VERIFY_HOST},
+    {"ssl-https-ca-certificates-file", required_argument, nullptr,
+     OPT_SSL_HTTPS_CA_CERTS},
+    {"ssl-https-client-certificate-file", required_argument, nullptr,
+     OPT_SSL_HTTPS_CLIENT_CERT},
+    {"ssl-https-client-certificate-type", required_argument, nullptr,
+     OPT_SSL_HTTPS_CLIENT_CERT_TYPE},
+    {"ssl-https-private-key-file", required_argument, nullptr,
+     OPT_SSL_HTTPS_PRIVATE_KEY},
+    {"ssl-https-private-key-type", required_argument, nullptr,
+     OPT_SSL_HTTPS_PRIVATE_KEY_TYPE},
+    {"shape", required_argument, nullptr, OPT_SHAPE},
+    {"num-of-sequences", required_argument, nullptr,
+     OPT_NUM_OF_SEQUENCES},
+    {"data-directory", required_argument, nullptr, OPT_DATA_DIRECTORY},
+    {"grpc-compression-algorithm", required_argument, nullptr,
+     OPT_GRPC_COMPRESSION},
+    {"model-signature-name", required_argument, nullptr,
+     OPT_MODEL_SIGNATURE_NAME},
+    {"bls-composing-models", required_argument, nullptr,
+     OPT_BLS_COMPOSING_MODELS},
+    {"triton-server-directory", required_argument, nullptr,
+     OPT_TRITON_SERVER_DIRECTORY},
+    {"model-repository", required_argument, nullptr,
+     OPT_MODEL_REPOSITORY},
     {nullptr, 0, nullptr, 0},
 };
 
@@ -217,7 +271,30 @@ CLParser::Usage()
       "  --enable-mpi                    multi-process measurement barrier\n"
       "  -f/--latency-report-file <csv>  CSV report path\n"
       "  --random-seed <n>               data/schedule seed\n"
-      "  --num-threads/--max-threads <n> rate-mode sender threads\n";
+      "  --num-threads/--max-threads <n> rate-mode sender threads\n"
+      "  --shape <name:d1,d2,...>        fix a dynamic input shape "
+      "(repeatable)\n"
+      "  --num-of-sequences <n>          concurrent sequence streams "
+      "(default 4)\n"
+      "  --data-directory <dir>          raw input files <dir>/<INPUT>\n"
+      "  --grpc-compression-algorithm <a> none|gzip|deflate\n"
+      "  --model-signature-name <name>   TF-Serving signature (default "
+      "serving_default)\n"
+      "  --bls-composing-models <m1,m2>  report stats for these "
+      "composing models\n"
+      "  --triton-server-directory <dir> alias of --server-src\n"
+      "  --model-repository <dir|zoo>    in-process model set\n"
+      "  --ssl-grpc-use-ssl              TLS for the gRPC channel\n"
+      "  --ssl-grpc-root-certifications-file <pem>\n"
+      "  --ssl-grpc-private-key-file <pem>\n"
+      "  --ssl-grpc-certificate-chain-file <pem>\n"
+      "  --ssl-https-verify-peer <0|1>   verify server cert chain\n"
+      "  --ssl-https-verify-host <0|2>   verify cert matches host\n"
+      "  --ssl-https-ca-certificates-file <pem>\n"
+      "  --ssl-https-client-certificate-file <pem>\n"
+      "  --ssl-https-client-certificate-type <PEM>\n"
+      "  --ssl-https-private-key-file <pem>\n"
+      "  --ssl-https-private-key-type <PEM>\n";
 }
 
 bool
@@ -471,6 +548,139 @@ CLParser::Parse(
           return false;
         }
         break;
+      case OPT_SSL_GRPC_USE_SSL:
+        params->ssl_grpc_use_ssl = true;
+        break;
+      case OPT_SSL_GRPC_ROOT_CERTS:
+        params->ssl_grpc_root_certifications_file = optarg;
+        break;
+      case OPT_SSL_GRPC_PRIVATE_KEY:
+        params->ssl_grpc_private_key_file = optarg;
+        break;
+      case OPT_SSL_GRPC_CERT_CHAIN:
+        params->ssl_grpc_certificate_chain_file = optarg;
+        break;
+      case OPT_SSL_HTTPS_VERIFY_PEER:
+        params->ssl_https_verify_peer = atol(optarg);
+        break;
+      case OPT_SSL_HTTPS_VERIFY_HOST:
+        params->ssl_https_verify_host = atol(optarg);
+        break;
+      case OPT_SSL_HTTPS_CA_CERTS:
+        params->ssl_https_ca_certificates_file = optarg;
+        break;
+      case OPT_SSL_HTTPS_CLIENT_CERT:
+        params->ssl_https_client_certificate_file = optarg;
+        break;
+      case OPT_SSL_HTTPS_CLIENT_CERT_TYPE:
+        params->ssl_https_client_certificate_type = optarg;
+        if (params->ssl_https_client_certificate_type != "PEM") {
+          *error = "only PEM client certificates are supported";
+          return false;
+        }
+        break;
+      case OPT_SSL_HTTPS_PRIVATE_KEY:
+        params->ssl_https_private_key_file = optarg;
+        break;
+      case OPT_SSL_HTTPS_PRIVATE_KEY_TYPE:
+        params->ssl_https_private_key_type = optarg;
+        if (params->ssl_https_private_key_type != "PEM") {
+          *error = "only PEM private keys are supported";
+          return false;
+        }
+        break;
+      case OPT_SHAPE: {
+        // NAME:d1,d2,...
+        std::string arg = optarg;
+        auto colon = arg.rfind(':');
+        if (colon == std::string::npos || colon == 0) {
+          *error = "--shape expects NAME:d1,d2,...";
+          return false;
+        }
+        std::vector<int64_t> dims;
+        std::istringstream ds(arg.substr(colon + 1));
+        std::string d;
+        while (std::getline(ds, d, ',')) {
+          if (d.empty() ||
+              d.find_first_not_of("0123456789") != std::string::npos) {
+            *error =
+                "--shape dimensions must be positive integers, got '" +
+                d + "'";
+            return false;
+          }
+          int64_t v = atoll(d.c_str());
+          if (v <= 0) {
+            *error = "--shape dimensions must be >= 1";
+            return false;
+          }
+          dims.push_back(v);
+        }
+        if (dims.empty()) {
+          *error = "--shape expects at least one dimension";
+          return false;
+        }
+        params->input_shapes.emplace_back(
+            arg.substr(0, colon), std::move(dims));
+        break;
+      }
+      case OPT_NUM_OF_SEQUENCES:
+        params->num_of_sequences = (size_t)atoi(optarg);
+        if (params->num_of_sequences == 0) {
+          *error = "--num-of-sequences must be > 0";
+          return false;
+        }
+        break;
+      case OPT_DATA_DIRECTORY:
+        params->data_directory = optarg;
+        break;
+      case OPT_GRPC_COMPRESSION:
+        if (strcmp(optarg, "gzip") == 0 || strcmp(optarg, "deflate") == 0 ||
+            strcmp(optarg, "none") == 0) {
+          params->grpc_compression_algorithm = optarg;
+        } else {
+          *error = std::string("unsupported compression algorithm ") +
+                   optarg + " (expected none|gzip|deflate)";
+          return false;
+        }
+        break;
+      case OPT_MODEL_SIGNATURE_NAME:
+        params->model_signature_name = optarg;
+        break;
+      case OPT_BLS_COMPOSING_MODELS: {
+        std::istringstream ms(optarg);
+        std::string name;
+        while (std::getline(ms, name, ',')) {
+          if (!name.empty()) {
+            params->bls_composing_models.push_back(name);
+          }
+        }
+        break;
+      }
+      case OPT_TRITON_SERVER_DIRECTORY:
+        // reference name for the in-process server install path; here
+        // the tpuserver python tree (alias of --server-src)
+        params->server_src = optarg;
+        break;
+      case OPT_MODEL_REPOSITORY: {
+        // reference name for the in-process model repository; the
+        // tpuserver analogue is the model-zoo selector — accept a zoo
+        // name or a path whose last component names one
+        std::string repo = optarg;
+        auto slash = repo.find_last_not_of('/');
+        repo = repo.substr(0, slash + 1);
+        slash = repo.rfind('/');
+        std::string base =
+            slash == std::string::npos ? repo : repo.substr(slash + 1);
+        if (base == "default" || base == "vision") {
+          params->server_zoo = base;
+        } else {
+          *error =
+              "--model-repository must name a tpuserver zoo "
+              "(default|vision), got '" + repo + "'";
+          return false;
+        }
+        break;
+      }
       default:
         *error = "unknown option";
         return false;
@@ -493,6 +703,17 @@ CLParser::Parse(
   if (params->request_rate_start > 0 && params->concurrency_start > 1) {
     *error =
         "cannot use concurrency and request rate modes together";
+    return false;
+  }
+  if (params->sequence_id_range != 0 &&
+      params->sequence_id_range < params->num_of_sequences) {
+    // a wrapping pool smaller than the live stream count would hand the
+    // same id to two concurrent sequences, silently corrupting state
+    *error =
+        "--sequence-id-range (" +
+        std::to_string(params->sequence_id_range) +
+        ") must be >= --num-of-sequences (" +
+        std::to_string(params->num_of_sequences) + ")";
     return false;
   }
   if (params->binary_search) {

@@ -93,6 +93,38 @@ struct PerfAnalyzerParameters {
   uint32_t seed = 17;
   size_t num_threads = 2;  // rate-mode sender threads
 
+  // TLS (reference --ssl-grpc-* / --ssl-https-* families,
+  // reference command_line_parser.cc:706-759)
+  bool ssl_grpc_use_ssl = false;
+  std::string ssl_grpc_root_certifications_file;
+  std::string ssl_grpc_private_key_file;
+  std::string ssl_grpc_certificate_chain_file;
+  long ssl_https_verify_peer = 1;
+  long ssl_https_verify_host = 2;
+  std::string ssl_https_ca_certificates_file;
+  std::string ssl_https_client_certificate_file;
+  std::string ssl_https_client_certificate_type = "PEM";
+  std::string ssl_https_private_key_file;
+  std::string ssl_https_private_key_type = "PEM";
+
+  // input shape overrides for models with dynamic dims
+  // (reference --shape NAME:d1,d2,...; may repeat)
+  std::vector<std::pair<std::string, std::vector<int64_t>>> input_shapes;
+  // concurrent sequence streams in sequence mode
+  // (reference --num-of-sequences, default 4)
+  size_t num_of_sequences = 4;
+  // directory holding per-input raw data files (reference
+  // --data-directory; consumed with --input-data style payloads)
+  std::string data_directory;
+  // gRPC per-message compression: "" | deflate | gzip | none
+  // (reference --grpc-compression-algorithm)
+  std::string grpc_compression_algorithm;
+  // TF-Serving signature (reference --model-signature-name)
+  std::string model_signature_name = "serving_default";
+  // BLS composing models to report server-side stats for (reference
+  // --bls-composing-models; comma-separated)
+  std::vector<std::string> bls_composing_models;
+
   bool usage_requested = false;
 };
 

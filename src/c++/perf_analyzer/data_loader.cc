@@ -151,6 +151,54 @@ DataLoader::ReadDataFromJson(
 }
 
 tc::Error
+DataLoader::ReadDataFromDir(
+    const std::vector<ModelTensor>& inputs, const std::string& dir,
+    int batch_size)
+{
+  streams_ = 1;
+  steps_ = 1;
+  for (const auto& input : inputs) {
+    const std::string path = dir + "/" + input.name;
+    FILE* f = fopen(path.c_str(), "rb");
+    if (f == nullptr) {
+      return tc::Error(
+          "--data-directory: cannot open '" + path + "' for input '" +
+          input.name + "'");
+    }
+    fseek(f, 0, SEEK_END);
+    long fsize = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    std::vector<uint8_t> payload((size_t)fsize);
+    size_t got = fsize > 0 ? fread(payload.data(), 1, (size_t)fsize, f) : 0;
+    fclose(f);
+    if ((long)got != fsize) {
+      return tc::Error("--data-directory: short read on '" + path + "'");
+    }
+    int64_t elem_size = ByteSize(input.datatype);
+    if (elem_size > 0) {
+      int64_t elems = batch_size;
+      for (int64_t d : input.shape) {
+        if (d < 0) {
+          return tc::Error(
+              "--data-directory: input '" + input.name +
+              "' has a dynamic shape; fix it with --shape " + input.name +
+              ":d1,d2,...");
+        }
+        elems *= d;
+      }
+      if ((int64_t)payload.size() != elems * elem_size) {
+        return tc::Error(
+            "--data-directory: '" + path + "' holds " +
+            std::to_string(payload.size()) + " bytes but input '" +
+            input.name + "' needs " + std::to_string(elems * elem_size));
+      }
+    }
+    data_[Key(input.name, 0, 0)] = std::move(payload);
+  }
+  return tc::Error::Success;
+}
+
+tc::Error
 DataLoader::GetInputData(
     const std::string& input_name, size_t stream, size_t step,
     const std::vector<uint8_t>** data) const

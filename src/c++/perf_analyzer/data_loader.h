@@ -28,6 +28,14 @@ class DataLoader {
       const std::vector<ModelTensor>& inputs, const std::string& json_text,
       int batch_size = 1);
 
+  // Load raw little-endian tensor bytes from <dir>/<INPUT_NAME> for
+  // every model input, one data stream of one step (reference
+  // --data-directory file layout).  File size must match the input's
+  // byte size (batch dim included).
+  tc::Error ReadDataFromDir(
+      const std::vector<ModelTensor>& inputs, const std::string& dir,
+      int batch_size = 1);
+
   size_t StreamCount() const { return streams_; }
   size_t StepCount() const { return steps_; }
 

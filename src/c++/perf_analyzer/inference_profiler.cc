@@ -151,7 +151,11 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
   bool have_server_stats =
       QueryServerStats(&server_begin, parser_->ModelName()).IsOk();
   std::map<std::string, ServerSideStats> composing_begin;
-  for (const auto& composing : parser_->ComposingModels()) {
+  auto composing_models = parser_->ComposingModels();
+  for (const auto& extra : config_.extra_composing_models) {
+    composing_models.push_back(extra);
+  }
+  for (const auto& composing : composing_models) {
     ServerSideStats s;
     if (QueryServerStats(&s, composing).IsOk()) {
       composing_begin[composing] = s;

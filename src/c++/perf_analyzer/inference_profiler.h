@@ -68,6 +68,10 @@ struct ProfilerConfig {
   size_t percentile = 0;
   // requests discarded before the first window of each level
   size_t warmup_request_count = 0;
+  // extra models to collect server-side stat deltas for, merged with
+  // the ensemble's auto-derived composing models (reference
+  // --bls-composing-models: BLS children are invisible in the config)
+  std::vector<std::string> extra_composing_models;
   bool verbose = false;
 };
 

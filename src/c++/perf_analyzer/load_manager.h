@@ -26,7 +26,14 @@ struct LoadManagerConfig {
   bool use_sequences = false;
   size_t sequence_length = 20;
   double sequence_length_variation = 20.0;
+  // concurrent sequence streams + id allocation (reference
+  // --num-of-sequences / --start-sequence-id / --sequence-id-range)
+  size_t num_of_sequences = 4;
+  uint64_t start_sequence_id = 1;
+  uint64_t sequence_id_range = 0;
   uint32_t seed = 17;
+  // directory of per-input raw data files (reference --data-directory)
+  std::string data_directory;
   // XLA-shm regions attach to this device on the server side
   int xla_device_ordinal = 0;
 };
@@ -58,6 +65,9 @@ class LoadManager {
     if (!config_.input_data_json.empty()) {
       err = data_loader_->ReadDataFromJson(
           parser_->Inputs(), config_.input_data_json, config_.batch_size);
+    } else if (!config_.data_directory.empty()) {
+      err = data_loader_->ReadDataFromDir(
+          parser_->Inputs(), config_.data_directory, config_.batch_size);
     } else {
       err = data_loader_->GenerateData(
           parser_->Inputs(), config_.zero_input, 1, 1, config_.batch_size,
@@ -170,8 +180,9 @@ class LoadManager {
     if (config_.use_sequences) {
       if (sequence_manager_ == nullptr) {
         sequence_manager_ = std::make_shared<SequenceManager>(
-            64, config_.sequence_length,
-            config_.sequence_length_variation, config_.seed);
+            config_.num_of_sequences, config_.sequence_length,
+            config_.sequence_length_variation, config_.seed,
+            config_.start_sequence_id, config_.sequence_id_range);
       }
       seq = sequence_manager_;
     }

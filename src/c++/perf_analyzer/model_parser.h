@@ -49,6 +49,30 @@ class ModelParser {
     return composing_models_;
   }
 
+  // Fix dynamic input dims (reference --shape NAME:d1,d2,...); applied
+  // on top of whatever Init parsed.  Unknown names error so typos are
+  // caught before load generation.
+  tc::Error OverrideShapes(
+      const std::vector<std::pair<std::string, std::vector<int64_t>>>&
+          overrides)
+  {
+    for (const auto& ov : overrides) {
+      bool found = false;
+      for (auto& input : inputs_) {
+        if (input.name == ov.first) {
+          input.shape = ov.second;
+          found = true;
+          break;
+        }
+      }
+      if (!found) {
+        return tc::Error(
+            "--shape names unknown input '" + ov.first + "'");
+      }
+    }
+    return tc::Error::Success;
+  }
+
   // direct init for tests (no backend round-trip)
   void InitDirect(
       const std::string& name, int max_batch_size,

@@ -34,6 +34,21 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
     config.verbose = params_.verbose;
     config.server_src = params_.server_src;
     config.inproc_vision = (params_.server_zoo == "vision");
+    config.grpc_use_ssl = params_.ssl_grpc_use_ssl;
+    config.grpc_ssl.root_certificates =
+        params_.ssl_grpc_root_certifications_file;
+    config.grpc_ssl.private_key = params_.ssl_grpc_private_key_file;
+    config.grpc_ssl.certificate_chain =
+        params_.ssl_grpc_certificate_chain_file;
+    config.http_ssl.verify_peer = params_.ssl_https_verify_peer;
+    config.http_ssl.verify_host = params_.ssl_https_verify_host;
+    config.http_ssl.ca_info = params_.ssl_https_ca_certificates_file;
+    config.http_ssl.cert = params_.ssl_https_client_certificate_file;
+    config.http_ssl.key = params_.ssl_https_private_key_file;
+    config.grpc_compression = params_.grpc_compression_algorithm == "none"
+                                  ? ""
+                                  : params_.grpc_compression_algorithm;
+    config.model_signature_name = params_.model_signature_name;
     tc::Error err = ClientBackendFactory::Create(&backend_, config);
     if (!err.IsOk()) {
       return err;
@@ -45,6 +60,12 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
       backend_.get(), params_.model_name, params_.model_version);
   if (!err.IsOk()) {
     return err;
+  }
+  if (!params_.input_shapes.empty()) {
+    err = parser_->OverrideShapes(params_.input_shapes);
+    if (!err.IsOk()) {
+      return err;
+    }
   }
   if (parser_->Scheduler() == SchedulerType::SEQUENCE &&
       !params_.use_sequences) {
@@ -96,6 +117,10 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
   lm_config.sequence_length = params_.sequence_length;
   lm_config.sequence_length_variation =
       params_.sequence_length_variation;
+  lm_config.num_of_sequences = params_.num_of_sequences;
+  lm_config.start_sequence_id = params_.start_sequence_id;
+  lm_config.sequence_id_range = params_.sequence_id_range;
+  lm_config.data_directory = params_.data_directory;
   lm_config.seed = params_.seed;
   if (!params_.input_data_path.empty()) {
     err = ReadFile(params_.input_data_path, &lm_config.input_data_json);
@@ -130,6 +155,7 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
   prof_config.stability_threshold_pct = params_.stability_threshold_pct;
   prof_config.percentile = params_.percentile;
   prof_config.warmup_request_count = params_.warmup_request_count;
+  prof_config.extra_composing_models = params_.bls_composing_models;
   prof_config.verbose = params_.verbose;
   profiler_.reset(new InferenceProfiler(
       backend_, parser_, manager_.get(), prof_config));

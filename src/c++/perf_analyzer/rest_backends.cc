@@ -196,6 +196,7 @@ class TFServeBackend : public ClientBackend {
     SplitHostPort(config.url, 8501, &b->host_, &b->port_);
     b->pool_.reset(new RestClientPool(b->host_, b->port_));
     b->dispatch_.reset(new RestDispatchPool(config.concurrency));
+    b->signature_name_ = config.model_signature_name;
     backend->reset(b);
     return tc::Error::Success;
   }
@@ -239,10 +240,11 @@ class TFServeBackend : public ClientBackend {
     }
     auto sig = Walk(
         doc, {"metadata", "signature_def", "signature_def",
-              "serving_default"});
+              signature_name_});
     if (sig == nullptr) {
       return tc::Error(
-          "tfserving metadata has no serving_default signature");
+          "tfserving metadata has no " + signature_name_ +
+          " signature (--model-signature-name)");
     }
     std::ostringstream out;
     out << "{\"name\": \"" << model_name << "\", \"inputs\": [";
@@ -278,7 +280,11 @@ class TFServeBackend : public ClientBackend {
       const BackendInferRequest& request) override
   {
     std::ostringstream body;
-    body << "{\"inputs\": {";
+    body << "{";
+    if (signature_name_ != "serving_default") {
+      body << "\"signature_name\": \"" << signature_name_ << "\", ";
+    }
+    body << "\"inputs\": {";
     bool first = true;
     for (const auto& input : request.inputs) {
       if (!input.shm_region.empty()) {
@@ -408,6 +414,7 @@ class TFServeBackend : public ClientBackend {
 
   std::string host_;
   int port_ = 8501;
+  std::string signature_name_ = "serving_default";
   std::unique_ptr<RestClientPool> pool_;
   std::unique_ptr<RestDispatchPool> dispatch_;
 };

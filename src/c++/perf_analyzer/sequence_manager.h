@@ -14,15 +14,21 @@ namespace pa {
 class SequenceManager {
  public:
   // `concurrent` independent sequences; each restarts after
-  // `sequence_length` (+- variation pct) requests.
+  // `sequence_length` (+- variation pct) requests.  Ids are allocated
+  // from `start_id`, wrapping within `id_range` when nonzero (reference
+  // --start-sequence-id / --sequence-id-range semantics,
+  // reference sequence_manager.cc:46-210).
   SequenceManager(
       size_t concurrent, size_t sequence_length,
-      double length_variation_pct = 0.0, uint32_t seed = 33)
+      double length_variation_pct = 0.0, uint32_t seed = 33,
+      uint64_t start_id = 1, uint64_t id_range = 0)
       : states_(concurrent), base_length_(sequence_length),
-        variation_pct_(length_variation_pct), rng_(seed)
+        variation_pct_(length_variation_pct), rng_(seed),
+        start_id_(start_id == 0 ? 1 : start_id), id_range_(id_range)
   {
     for (size_t i = 0; i < states_.size(); ++i) {
-      states_[i].id = next_id_++;
+      states_[i].slot = i;
+      states_[i].id = NextId(states_[i]);
       states_[i].remaining = DrawLength();
       states_[i].drawn = states_[i].remaining;
     }
@@ -45,7 +51,7 @@ class SequenceManager {
     flags.end = (st.remaining == 0);
     flags.sequence_id = st.id;
     if (flags.end) {
-      st.id = next_id_++;
+      st.id = NextId(st);
       st.remaining = DrawLength();
       st.drawn = st.remaining;
     }
@@ -61,7 +67,7 @@ class SequenceManager {
     for (auto& st : states_) {
       if (st.remaining != DrawnLengthOf(st)) {
         out.push_back({st.id, false, true});
-        st.id = next_id_++;
+        st.id = NextId(st);
         st.remaining = DrawLength();
         st.drawn = st.remaining;
       }
@@ -71,6 +77,8 @@ class SequenceManager {
 
  private:
   struct State {
+    size_t slot = 0;
+    uint64_t counter = 0;  // sequences this slot has started
     uint64_t id = 0;
     size_t remaining = 0;
     size_t drawn = 0;
@@ -93,12 +101,36 @@ class SequenceManager {
     return st.drawn != 0 ? st.drawn : base_length_;
   }
 
+  uint64_t NextId(State& st)
+  {
+    // Each slot draws from its own residue class modulo the slot count
+    // (slot, slot+C, slot+2C, ... within id_range_): the classes are
+    // disjoint, so two concurrently-live sequences can never share an
+    // id no matter how their lifetimes interleave — a global counter
+    // with a plain modulo could hand slot A the id slot B is still
+    // using.
+    const uint64_t concurrent = states_.size();
+    uint64_t lane = st.counter++;
+    if (id_range_ > 0) {
+      // ids in this slot's class: ceil((id_range_ - slot) / concurrent);
+      // direct construction may violate range >= concurrent (the CLI
+      // validates it), so clamp the degenerate case
+      uint64_t lane_size =
+          id_range_ > st.slot
+              ? (id_range_ - st.slot + concurrent - 1) / concurrent
+              : 1;
+      lane %= lane_size;
+    }
+    return start_id_ + st.slot + lane * concurrent;
+  }
+
   std::mutex mu_;
   std::vector<State> states_;
   size_t base_length_;
   double variation_pct_;
   std::mt19937 rng_;
-  uint64_t next_id_ = 1;
+  uint64_t start_id_ = 1;
+  uint64_t id_range_ = 0;
 };
 
 }  // namespace pa

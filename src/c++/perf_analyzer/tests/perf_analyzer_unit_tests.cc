@@ -57,6 +57,121 @@ TestCliMissingModel()
 }
 
 static void
+TestCliSslShapeAndDataOptions()
+{
+  const char* argv[] = {
+      "perf_analyzer", "-m", "simple",
+      "--ssl-grpc-use-ssl",
+      "--ssl-grpc-root-certifications-file", "/tmp/ca.pem",
+      "--ssl-https-verify-peer", "0",
+      "--ssl-https-verify-host", "0",
+      "--ssl-https-ca-certificates-file", "/tmp/https-ca.pem",
+      "--shape", "INPUT0:3,224,224",
+      "--shape", "INPUT1:8",
+      "--num-of-sequences", "7",
+      "--data-directory", "/tmp/data",
+      "--grpc-compression-algorithm", "gzip",
+      "--model-signature-name", "my_sig",
+      "--bls-composing-models", "tok,enc",
+      "--triton-server-directory", "/srv/tree",
+      "--model-repository", "/models/vision/",
+  };
+  PerfAnalyzerParameters params;
+  std::string error;
+  CHECK(CLParser::Parse(
+      sizeof(argv) / sizeof(argv[0]), (char**)argv, &params, &error));
+  CHECK(params.ssl_grpc_use_ssl);
+  CHECK(params.ssl_grpc_root_certifications_file == "/tmp/ca.pem");
+  CHECK(params.ssl_https_verify_peer == 0);
+  CHECK(params.ssl_https_verify_host == 0);
+  CHECK(params.ssl_https_ca_certificates_file == "/tmp/https-ca.pem");
+  CHECK(params.input_shapes.size() == 2);
+  CHECK(params.input_shapes[0].first == "INPUT0");
+  CHECK(
+      params.input_shapes[0].second ==
+      (std::vector<int64_t>{3, 224, 224}));
+  CHECK(params.input_shapes[1].second == (std::vector<int64_t>{8}));
+  CHECK(params.num_of_sequences == 7);
+  CHECK(params.data_directory == "/tmp/data");
+  CHECK(params.grpc_compression_algorithm == "gzip");
+  CHECK(params.model_signature_name == "my_sig");
+  CHECK(params.bls_composing_models.size() == 2);
+  CHECK(params.bls_composing_models[1] == "enc");
+  CHECK(params.server_src == "/srv/tree");
+  CHECK(params.server_zoo == "vision");
+
+  const char* bad_shape[] = {
+      "perf_analyzer", "-m", "simple", "--shape", "noshape"};
+  PerfAnalyzerParameters p2;
+  CHECK(!CLParser::Parse(5, (char**)bad_shape, &p2, &error));
+  const char* bad_comp[] = {
+      "perf_analyzer", "-m", "simple", "--grpc-compression-algorithm",
+      "br"};
+  PerfAnalyzerParameters p3;
+  CHECK(!CLParser::Parse(5, (char**)bad_comp, &p3, &error));
+  const char* bad_repo[] = {
+      "perf_analyzer", "-m", "simple", "--model-repository", "/nope"};
+  PerfAnalyzerParameters p4;
+  CHECK(!CLParser::Parse(5, (char**)bad_repo, &p4, &error));
+}
+
+static void
+TestShapeOverrideAndDataDirectory()
+{
+  ModelParser parser;
+  parser.InitDirect(
+      "m", 0,
+      {ModelTensor{"IN", "FP32", {-1, 4}}},
+      {ModelTensor{"OUT", "FP32", {4}}});
+  CHECK(parser.Inputs()[0].is_shape_dynamic());
+  CHECK(parser.OverrideShapes({{"IN", {2, 4}}}).IsOk());
+  CHECK(!parser.Inputs()[0].is_shape_dynamic());
+  CHECK(parser.Inputs()[0].shape == (std::vector<int64_t>{2, 4}));
+  CHECK(!parser.OverrideShapes({{"NOPE", {1}}}).IsOk());
+
+  // data-directory: raw file feeding an input, size-checked
+  char dir[] = "/tmp/pa_dataXXXXXX";
+  CHECK(mkdtemp(dir) != nullptr);
+  std::string path = std::string(dir) + "/IN";
+  {
+    FILE* f = fopen(path.c_str(), "wb");
+    float vals[8] = {1, 2, 3, 4, 5, 6, 7, 8};
+    fwrite(vals, sizeof(float), 8, f);
+    fclose(f);
+  }
+  DataLoader loader;
+  CHECK(loader.ReadDataFromDir(parser.Inputs(), dir, 1).IsOk());
+  const std::vector<uint8_t>* data = nullptr;
+  CHECK(loader.GetInputData("IN", 0, 0, &data).IsOk());
+  CHECK(data->size() == 8 * sizeof(float));
+  // wrong size -> loud error
+  ModelParser parser2;
+  parser2.InitDirect(
+      "m", 0, {ModelTensor{"IN", "FP32", {16, 4}}}, {});
+  DataLoader loader2;
+  CHECK(!loader2.ReadDataFromDir(parser2.Inputs(), dir, 1).IsOk());
+  remove(path.c_str());
+  remove(dir);
+}
+
+static void
+TestSequenceIdAllocation()
+{
+  // start id + bounded range wrap (reference --start-sequence-id /
+  // --sequence-id-range)
+  SequenceManager mgr(2, 1, 0.0, 33, 100, 3);
+  std::vector<uint64_t> seen;
+  for (int i = 0; i < 6; ++i) {
+    auto flags = mgr.Next(i % 2);
+    CHECK(flags.start && flags.end);  // length-1 sequences
+    seen.push_back(flags.sequence_id);
+  }
+  for (uint64_t id : seen) {
+    CHECK(id >= 100 && id < 103);
+  }
+}
+
+static void
 TestCliRanges()
 {
   const char* argv[] = {
@@ -432,6 +547,9 @@ main()
   TestCliDefaults();
   TestCliMissingModel();
   TestCliRanges();
+  TestCliSslShapeAndDataOptions();
+  TestShapeOverrideAndDataDirectory();
+  TestSequenceIdAllocation();
   TestCliBackHalf();
   TestScheduleDistribution();
   TestSummarizeRecords();

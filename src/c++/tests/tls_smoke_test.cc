@@ -1,0 +1,165 @@
+// TLS end-to-end smoke driver, exercised by tests/test_cc_tls.py.
+//
+// Usage:
+//   tls_smoke_test http  https://HOST:PORT CA_FILE
+//   tls_smoke_test grpc  HOST:PORT        CA_FILE
+//   tls_smoke_test http-noverify https://HOST:PORT
+//
+// Connects with TLS (verifying against CA_FILE unless -noverify), checks
+// server liveness, runs one `simple` add/sub inference, and prints
+// "TLS_SMOKE_OK <alpn-protocol-or-http1>" on success.  Exit 0/1.
+// Proves the capability the reference gets from libcurl/grpc++ TLS
+// (reference http_client.h:46-87, grpc_client.h:43-82) works end-to-end
+// on this stack's dlopen'd-OpenSSL transport (library/tls.h).
+
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+namespace {
+
+void
+FillInputs(
+    std::vector<int32_t>* in0, std::vector<int32_t>* in1,
+    std::vector<tc::InferInput*>* inputs)
+{
+  for (int i = 0; i < 16; ++i) {
+    (*in0)[i] = i;
+    (*in1)[i] = 2 * i;
+  }
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  std::vector<int64_t> shape{1, 16};
+  if (!tc::InferInput::Create(&input0, "INPUT0", shape, "INT32").IsOk() ||
+      !tc::InferInput::Create(&input1, "INPUT1", shape, "INT32").IsOk()) {
+    std::cerr << "input create failed" << std::endl;
+    exit(1);
+  }
+  input0->AppendRaw(
+      reinterpret_cast<uint8_t*>(in0->data()), in0->size() * sizeof(int32_t));
+  input1->AppendRaw(
+      reinterpret_cast<uint8_t*>(in1->data()), in1->size() * sizeof(int32_t));
+  inputs->push_back(input0);
+  inputs->push_back(input1);
+}
+
+int
+CheckSum(tc::InferResult* result)
+{
+  const uint8_t* buf = nullptr;
+  size_t byte_size = 0;
+  if (!result->RawData("OUTPUT0", &buf, &byte_size).IsOk() ||
+      byte_size != 16 * sizeof(int32_t)) {
+    std::cerr << "bad OUTPUT0" << std::endl;
+    return 1;
+  }
+  const int32_t* vals = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; ++i) {
+    if (vals[i] != 3 * i) {
+      std::cerr << "OUTPUT0[" << i << "] = " << vals[i] << " != " << 3 * i
+                << std::endl;
+      return 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  if (argc < 3) {
+    std::cerr << "usage: tls_smoke_test http|grpc|http-noverify URL [CA]"
+              << std::endl;
+    return 2;
+  }
+  const std::string mode = argv[1];
+  const std::string url = argv[2];
+  const std::string ca = argc > 3 ? argv[3] : "";
+
+  std::vector<int32_t> in0(16), in1(16);
+  std::vector<tc::InferInput*> inputs;
+  FillInputs(&in0, &in1, &inputs);
+  tc::InferOptions options("simple");
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  if (!tc::InferRequestedOutput::Create(&output0, "OUTPUT0").IsOk() ||
+      !tc::InferRequestedOutput::Create(&output1, "OUTPUT1").IsOk()) {
+    std::cerr << "output create failed" << std::endl;
+    return 1;
+  }
+  std::vector<const tc::InferRequestedOutput*> outputs{output0, output1};
+
+  if (mode == "http" || mode == "http-noverify") {
+    tc::HttpSslOptions ssl;
+    ssl.ca_info = ca;
+    if (mode == "http-noverify") {
+      ssl.verify_peer = 0;
+      ssl.verify_host = 0;
+    }
+    std::unique_ptr<tc::InferenceServerHttpClient> client;
+    tc::Error err =
+        tc::InferenceServerHttpClient::Create(&client, url, false, 2, ssl);
+    if (!err.IsOk()) {
+      std::cerr << "create failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    bool live = false;
+    err = client->IsServerLive(&live);
+    if (!err.IsOk() || !live) {
+      std::cerr << "liveness failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    tc::InferResult* result = nullptr;
+    err = client->Infer(&result, options, inputs, outputs);
+    if (!err.IsOk()) {
+      std::cerr << "infer failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    int rc = CheckSum(result);
+    delete result;
+    if (rc == 0) {
+      std::cout << "TLS_SMOKE_OK http1" << std::endl;
+    }
+    return rc;
+  }
+
+  if (mode == "grpc") {
+    tc::SslOptions ssl;
+    ssl.root_certificates = ca;
+    std::unique_ptr<tc::InferenceServerGrpcClient> client;
+    tc::Error err = tc::InferenceServerGrpcClient::Create(
+        &client, url, false, true /* use_ssl */, ssl);
+    if (!err.IsOk()) {
+      std::cerr << "create failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    bool live = false;
+    err = client->IsServerLive(&live);
+    if (!err.IsOk() || !live) {
+      std::cerr << "liveness failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    tc::InferResult* result = nullptr;
+    err = client->Infer(&result, options, inputs, outputs);
+    if (!err.IsOk()) {
+      std::cerr << "infer failed: " << err.Message() << std::endl;
+      return 1;
+    }
+    int rc = CheckSum(result);
+    delete result;
+    if (rc == 0) {
+      std::cout << "TLS_SMOKE_OK h2" << std::endl;
+    }
+    return rc;
+  }
+
+  std::cerr << "unknown mode " << mode << std::endl;
+  return 2;
+}

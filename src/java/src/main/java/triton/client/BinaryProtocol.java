@@ -1,0 +1,143 @@
+// Binary-tensor-extension framing helpers (role of reference
+// src/java/.../BinaryProtocol.java: the byte-level encoding that rides
+// after the JSON header when Inference-Header-Content-Length is set).
+package triton.client;
+
+import java.io.ByteArrayOutputStream;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Encoders/decoders for the v2 binary tensor extension: fixed-width
+ * types are raw little-endian element bytes; BYTES elements are each
+ * framed with a 4-byte little-endian length prefix.
+ */
+public final class BinaryProtocol {
+  private BinaryProtocol() {}
+
+  // -- fixed-width encode ----------------------------------------------
+
+  public static byte[] encode(int[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : values) {
+      buf.putInt(v);
+    }
+    return buf.array();
+  }
+
+  public static byte[] encode(long[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (long v : values) {
+      buf.putLong(v);
+    }
+    return buf.array();
+  }
+
+  public static byte[] encode(float[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (float v : values) {
+      buf.putFloat(v);
+    }
+    return buf.array();
+  }
+
+  public static byte[] encode(double[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (double v : values) {
+      buf.putDouble(v);
+    }
+    return buf.array();
+  }
+
+  // -- fixed-width decode ----------------------------------------------
+
+  public static int[] decodeInt32(byte[] raw) {
+    ByteBuffer buf = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    int[] out = new int[raw.length / 4];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getInt();
+    }
+    return out;
+  }
+
+  public static long[] decodeInt64(byte[] raw) {
+    ByteBuffer buf = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    long[] out = new long[raw.length / 8];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getLong();
+    }
+    return out;
+  }
+
+  public static float[] decodeFp32(byte[] raw) {
+    ByteBuffer buf = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    float[] out = new float[raw.length / 4];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getFloat();
+    }
+    return out;
+  }
+
+  public static double[] decodeFp64(byte[] raw) {
+    ByteBuffer buf = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    double[] out = new double[raw.length / 8];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = buf.getDouble();
+    }
+    return out;
+  }
+
+  // -- BYTES framing ----------------------------------------------------
+
+  /** Length-prefix frame a list of byte-string elements. */
+  public static byte[] encodeBytes(List<byte[]> elements) {
+    ByteArrayOutputStream out = new ByteArrayOutputStream();
+    ByteBuffer len = ByteBuffer.allocate(4).order(ByteOrder.LITTLE_ENDIAN);
+    for (byte[] element : elements) {
+      len.clear();
+      len.putInt(element.length);
+      out.write(len.array(), 0, 4);
+      out.write(element, 0, element.length);
+    }
+    return out.toByteArray();
+  }
+
+  /** Convenience: UTF-8 string elements. */
+  public static byte[] encodeStrings(List<String> elements) {
+    List<byte[]> raw = new ArrayList<>(elements.size());
+    for (String s : elements) {
+      raw.add(s.getBytes(StandardCharsets.UTF_8));
+    }
+    return encodeBytes(raw);
+  }
+
+  /** Split a length-prefixed BYTES section back into elements. */
+  public static List<byte[]> decodeBytes(byte[] raw)
+      throws InferenceException {
+    List<byte[]> out = new ArrayList<>();
+    ByteBuffer buf = ByteBuffer.wrap(raw).order(ByteOrder.LITTLE_ENDIAN);
+    while (buf.remaining() >= 4) {
+      int n = buf.getInt();
+      if (n < 0 || n > buf.remaining()) {
+        throw new InferenceException(
+            "malformed BYTES tensor: element length " + n + " with "
+            + buf.remaining() + " bytes left");
+      }
+      byte[] element = new byte[n];
+      buf.get(element);
+      out.add(element);
+    }
+    if (buf.remaining() != 0) {
+      throw new InferenceException(
+          "malformed BYTES tensor: " + buf.remaining() + " trailing bytes");
+    }
+    return out;
+  }
+}

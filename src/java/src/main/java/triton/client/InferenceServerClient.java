@@ -20,7 +20,8 @@ import java.util.Map;
 import java.util.concurrent.CompletableFuture;
 
 public class InferenceServerClient implements AutoCloseable {
-  private final String baseUrl;
+  private final String baseUrl;  // null when endpoint-driven
+  private final triton.client.endpoint.AbstractEndpoint endpoint;
   private final HttpClient http;
   private final Duration requestTimeout;
 
@@ -30,13 +31,40 @@ public class InferenceServerClient implements AutoCloseable {
 
   public InferenceServerClient(
       String url, Duration connectTimeout, Duration requestTimeout) {
-    this.baseUrl =
-        url.startsWith("http://") || url.startsWith("https://")
-            ? url
-            : "http://" + url;
+    this.baseUrl = normalize(url);
+    this.endpoint = null;
     this.requestTimeout = requestTimeout;
     this.http =
         HttpClient.newBuilder().connectTimeout(connectTimeout).build();
+  }
+
+  /** Endpoint-abstraction constructor (role of the reference's
+   *  endpoint tier): {@code endpoint.getUrl()} is consulted for EVERY
+   *  request, so rotating/failover endpoints see each call and get
+   *  {@code markFailure} feedback on transport errors. */
+  public InferenceServerClient(
+      triton.client.endpoint.AbstractEndpoint endpoint) {
+    this.baseUrl = null;
+    this.endpoint = endpoint;
+    this.requestTimeout = Duration.ofSeconds(60);
+    this.http = HttpClient.newBuilder()
+        .connectTimeout(Duration.ofSeconds(60)).build();
+  }
+
+  private static String normalize(String url) {
+    return url.startsWith("http://") || url.startsWith("https://")
+        ? url
+        : "http://" + url;
+  }
+
+  private String resolveUrl() throws InferenceException {
+    return baseUrl != null ? baseUrl : normalize(endpoint.getUrl());
+  }
+
+  private void reportFailure(String url, Exception cause) {
+    if (endpoint != null) {
+      endpoint.markFailure(url, cause);
+    }
   }
 
   // -- health / metadata ---------------------------------------------------
@@ -110,8 +138,11 @@ public class InferenceServerClient implements AutoCloseable {
       String modelName, List<InferInput> inputs,
       List<InferRequestedOutput> outputs) throws InferenceException {
     RequestBody body = buildRequestBody(inputs, outputs);
+    String url = resolveUrl();
     HttpRequest request =
-        requestBuilder("/v2/models/" + modelName + "/infer")
+        HttpRequest.newBuilder()
+            .uri(URI.create(url + "/v2/models/" + modelName + "/infer"))
+            .timeout(requestTimeout)
             .header("Content-Type", "application/octet-stream")
             .header(
                 "Inference-Header-Content-Length",
@@ -123,6 +154,7 @@ public class InferenceServerClient implements AutoCloseable {
       response =
           http.send(request, HttpResponse.BodyHandlers.ofByteArray());
     } catch (IOException | InterruptedException e) {
+      reportFailure(url, e);
       throw new InferenceException("infer request failed", e);
     }
     return toResult(response);
@@ -138,14 +170,19 @@ public class InferenceServerClient implements AutoCloseable {
     } catch (InferenceException e) {
       return CompletableFuture.failedFuture(e);
     }
-    HttpRequest request =
-        requestBuilder("/v2/models/" + modelName + "/infer")
-            .header("Content-Type", "application/octet-stream")
-            .header(
-                "Inference-Header-Content-Length",
-                Integer.toString(body.jsonLength))
-            .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
-            .build();
+    HttpRequest request;
+    try {
+      request =
+          requestBuilder("/v2/models/" + modelName + "/infer")
+              .header("Content-Type", "application/octet-stream")
+              .header(
+                  "Inference-Header-Content-Length",
+                  Integer.toString(body.jsonLength))
+              .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
+              .build();
+    } catch (InferenceException e) {
+      return CompletableFuture.failedFuture(e);
+    }
     return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
         .thenApply(
             response -> {
@@ -245,18 +282,25 @@ public class InferenceServerClient implements AutoCloseable {
     return new InferResult(response.body(), headerLength);
   }
 
-  private HttpRequest.Builder requestBuilder(String path) {
+  private HttpRequest.Builder requestBuilder(String path)
+      throws InferenceException {
     return HttpRequest.newBuilder()
-        .uri(URI.create(baseUrl + path))
+        .uri(URI.create(resolveUrl() + path))
         .timeout(requestTimeout);
   }
 
   private HttpResponse<byte[]> get(String path) throws InferenceException {
+    String url = resolveUrl();
     try {
       return http.send(
-          requestBuilder(path).GET().build(),
+          HttpRequest.newBuilder()
+              .uri(URI.create(url + path))
+              .timeout(requestTimeout)
+              .GET()
+              .build(),
           HttpResponse.BodyHandlers.ofByteArray());
     } catch (IOException | InterruptedException e) {
+      reportFailure(url, e);
       throw new InferenceException("request failed: " + path, e);
     }
   }
@@ -274,7 +318,10 @@ public class InferenceServerClient implements AutoCloseable {
 
   private void post(String path, byte[] body, String contentType)
       throws InferenceException {
-    HttpRequest.Builder builder = requestBuilder(path);
+    String url = resolveUrl();
+    HttpRequest.Builder builder = HttpRequest.newBuilder()
+        .uri(URI.create(url + path))
+        .timeout(requestTimeout);
     if (contentType != null) {
       builder.header("Content-Type", contentType);
     }
@@ -286,6 +333,7 @@ public class InferenceServerClient implements AutoCloseable {
                   .build(),
               HttpResponse.BodyHandlers.ofByteArray());
     } catch (IOException | InterruptedException e) {
+      reportFailure(url, e);
       throw new InferenceException("request failed: " + path, e);
     }
     if (response.statusCode() != 200) {

@@ -1,0 +1,67 @@
+// Small shared helpers (role of reference src/java/.../Util.java).
+package triton.client;
+
+/** Conversions the typed getters and examples share. */
+public final class Util {
+  private Util() {}
+
+  /** IEEE 754 half-precision bits -> float (FP16 tensors arrive as raw
+   *  2-byte elements; Java has no primitive half type). */
+  public static float fp16BitsToFloat(short bits) {
+    int sign = (bits >> 15) & 0x1;
+    int exp = (bits >> 10) & 0x1f;
+    int frac = bits & 0x3ff;
+    float value;
+    if (exp == 0) {
+      value = (float) (frac * Math.pow(2, -24));
+    } else if (exp == 0x1f) {
+      value = frac == 0 ? Float.POSITIVE_INFINITY : Float.NaN;
+    } else {
+      value = (float) ((1 + frac / 1024.0) * Math.pow(2, exp - 15));
+    }
+    return sign == 0 ? value : -value;
+  }
+
+  /** float -> IEEE 754 half bits (round-to-nearest-even via the float
+   *  intermediate; sufficient for test tensors). */
+  public static short floatToFp16Bits(float value) {
+    int fbits = Float.floatToIntBits(value);
+    int sign = (fbits >>> 16) & 0x8000;
+    int val = (fbits & 0x7fffffff) + 0x1000;  // rounding
+    if (val >= 0x47800000) {  // overflow -> inf (or NaN preserved)
+      if ((fbits & 0x7fffffff) >= 0x47800000) {
+        if ((fbits & 0x7fffffff) < 0x7f800000) {
+          return (short) (sign | 0x7c00);
+        }
+        return (short) (sign | 0x7c00 | ((fbits & 0x007fffff) >>> 13));
+      }
+      return (short) (sign | 0x7bff);
+    }
+    if (val >= 0x38800000) {  // normal
+      return (short) (sign | ((val - 0x38000000) >>> 13));
+    }
+    if (val < 0x33000000) {  // underflow -> zero
+      return (short) sign;
+    }
+    val = (fbits & 0x7fffffff) >>> 23;  // subnormal
+    return (short) (sign
+        | ((((fbits & 0x7fffff) | 0x800000) + (0x800000 >>> (val - 102)))
+            >>> (126 - val)));
+  }
+
+  /** Human-readable byte count for perf/memory reporting. */
+  public static String formatBytes(long bytes) {
+    if (bytes < 1024) {
+      return bytes + " B";
+    }
+    int unit = (63 - Long.numberOfLeadingZeros(bytes)) / 10;
+    return String.format(
+        "%.1f %sB", (double) bytes / (1L << (unit * 10)), "KMGTPE".charAt(
+            unit - 1));
+  }
+
+  /** Monotonic milliseconds (examples measure with this). */
+  public static long nowMs() {
+    return System.nanoTime() / 1_000_000L;
+  }
+}

@@ -1,0 +1,26 @@
+// Server-address provider abstraction (role of reference
+// src/java/.../endpoint/AbstractEndpoint.java: clients resolve the
+// target URL per request, so subclasses can rotate replicas or skip
+// unhealthy hosts).
+package triton.client.endpoint;
+
+import triton.client.InferenceException;
+
+/**
+ * Supplies the base URL for each request. Implementations may load
+ * balance or fail over; {@link #markFailure} lets the client report a
+ * transport error so stateful endpoints can react.
+ */
+public abstract class AbstractEndpoint {
+  /** Base URL (scheme optional, {@code host:port} accepted) to use for
+   *  the next request. */
+  public abstract String getUrl() throws InferenceException;
+
+  /** Number of distinct underlying addresses (1 for a fixed endpoint). */
+  public int size() {
+    return 1;
+  }
+
+  /** Transport-failure feedback; default is stateless. */
+  public void markFailure(String url, Exception cause) {}
+}

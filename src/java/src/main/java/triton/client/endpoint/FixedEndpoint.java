@@ -1,0 +1,17 @@
+// Single-address endpoint (role of reference
+// src/java/.../endpoint/FixedEndpoint.java).
+package triton.client.endpoint;
+
+/** Always returns the one address it was constructed with. */
+public class FixedEndpoint extends AbstractEndpoint {
+  private final String url;
+
+  public FixedEndpoint(String url) {
+    this.url = url;
+  }
+
+  @Override
+  public String getUrl() {
+    return url;
+  }
+}

@@ -1,0 +1,75 @@
+// Long-running leak check over the Java client (role of reference
+// src/java/.../examples/MemoryGrowthTest.java).
+package triton.client.examples;
+
+import java.util.List;
+import triton.client.DataType;
+import triton.client.InferInput;
+import triton.client.InferRequestedOutput;
+import triton.client.InferenceServerClient;
+import triton.client.Util;
+
+/**
+ * Hammers {@code simple} inferences and samples JVM heap usage; growth
+ * between the early and late thirds beyond a tolerance fails the run
+ * (exit 1), catching reference-count leaks in the client plumbing.
+ *
+ * <p>Usage: {@code MemoryGrowthTest [url] [iterations]}
+ */
+public final class MemoryGrowthTest {
+  private MemoryGrowthTest() {}
+
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    int iterations = args.length > 1 ? Integer.parseInt(args[1]) : 2000;
+
+    int[] a = new int[16];
+    int[] b = new int[16];
+    for (int i = 0; i < 16; i++) {
+      a[i] = i;
+      b[i] = i * i;
+    }
+    InferInput in0 = new InferInput("INPUT0", new long[] {1, 16},
+        DataType.INT32);
+    in0.setData(a);
+    InferInput in1 = new InferInput("INPUT1", new long[] {1, 16},
+        DataType.INT32);
+    in1.setData(b);
+    List<InferInput> inputs = List.of(in0, in1);
+    List<InferRequestedOutput> outputs = List.of(
+        new InferRequestedOutput("OUTPUT0", true));
+
+    Runtime rt = Runtime.getRuntime();
+    long earlySum = 0;
+    int earlyCount = 0;
+    long lateSum = 0;
+    int lateCount = 0;
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      for (int i = 0; i < iterations; i++) {
+        client.infer("simple", inputs, outputs);
+        if (i % 100 == 0) {
+          System.gc();
+          long used = rt.totalMemory() - rt.freeMemory();
+          if (i < iterations / 3) {
+            earlySum += used;
+            earlyCount++;
+          } else if (i >= 2 * iterations / 3) {
+            lateSum += used;
+            lateCount++;
+          }
+        }
+      }
+    }
+    long early = earlySum / Math.max(earlyCount, 1);
+    long late = lateSum / Math.max(lateCount, 1);
+    System.out.printf(
+        "heap early %s -> late %s%n", Util.formatBytes(early),
+        Util.formatBytes(late));
+    // tolerance: 20% + 8 MB slack for JIT/GC noise
+    if (late > early * 1.2 + (8L << 20)) {
+      System.err.println("MEMORY GROWTH DETECTED");
+      System.exit(1);
+    }
+    System.out.println("memory growth OK");
+  }
+}

@@ -1,0 +1,76 @@
+// Throughput/latency micro-driver over the Java client (role of
+// reference src/java/.../examples/SimpleInferPerf.java).
+package triton.client.examples;
+
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.List;
+import triton.client.DataType;
+import triton.client.InferInput;
+import triton.client.InferRequestedOutput;
+import triton.client.InferResult;
+import triton.client.InferenceServerClient;
+import triton.client.Util;
+
+/**
+ * Drives the {@code simple} add/sub model in a timed loop and reports
+ * infer/sec plus p50/p99 latency — the Java-side analogue of the
+ * quick-start perf_analyzer measurement.
+ *
+ * <p>Usage: {@code SimpleInferPerf [url] [seconds]}
+ */
+public final class SimpleInferPerf {
+  private SimpleInferPerf() {}
+
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    long seconds = args.length > 1 ? Long.parseLong(args[1]) : 5;
+
+    int[] a = new int[16];
+    int[] b = new int[16];
+    for (int i = 0; i < 16; i++) {
+      a[i] = i;
+      b[i] = 2 * i;
+    }
+    InferInput in0 = new InferInput("INPUT0", new long[] {1, 16},
+        DataType.INT32);
+    in0.setData(a);
+    InferInput in1 = new InferInput("INPUT1", new long[] {1, 16},
+        DataType.INT32);
+    in1.setData(b);
+    List<InferInput> inputs = List.of(in0, in1);
+    List<InferRequestedOutput> outputs = List.of(
+        new InferRequestedOutput("OUTPUT0", true),
+        new InferRequestedOutput("OUTPUT1", true));
+
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      // warmup + correctness
+      InferResult result = client.infer("simple", inputs, outputs);
+      int[] sum = result.getOutputAsInt("OUTPUT0");
+      for (int i = 0; i < 16; i++) {
+        if (sum[i] != a[i] + b[i]) {
+          throw new IllegalStateException("OUTPUT0[" + i + "] wrong");
+        }
+      }
+
+      List<Long> latenciesUs = new ArrayList<>();
+      long deadline = Util.nowMs() + seconds * 1000;
+      long count = 0;
+      long start = Util.nowMs();
+      while (Util.nowMs() < deadline) {
+        long t0 = System.nanoTime();
+        client.infer("simple", inputs, outputs);
+        latenciesUs.add((System.nanoTime() - t0) / 1000);
+        count++;
+      }
+      double elapsed = (Util.nowMs() - start) / 1000.0;
+      Collections.sort(latenciesUs);
+      System.out.printf(
+          "Throughput: %.1f infer/sec%n", count / elapsed);
+      System.out.printf(
+          "Latency: p50 %d us, p99 %d us%n",
+          latenciesUs.get(latenciesUs.size() / 2),
+          latenciesUs.get((int) (latenciesUs.size() * 0.99)));
+    }
+  }
+}

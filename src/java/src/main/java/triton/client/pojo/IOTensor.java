@@ -1,0 +1,126 @@
+// JSON-shaped tensor descriptor of the KServe v2 protocol (role of
+// reference src/java/.../pojo/IOTensor.java: the wire form of an
+// input/output tensor in request and response bodies).
+package triton.client.pojo;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * One {@code {"name", "datatype", "shape", "parameters", "data"}} tensor
+ * object as it appears in v2 JSON bodies. {@code data} is the row-major
+ * flattened value list and is absent when the tensor rides the binary
+ * extension or shared memory.
+ */
+public class IOTensor {
+  private String name;
+  private String datatype;
+  private long[] shape;
+  private Parameters parameters = new Parameters();
+  private List<Object> data;
+
+  public IOTensor() {}
+
+  public IOTensor(String name, String datatype, long[] shape) {
+    this.name = name;
+    this.datatype = datatype;
+    this.shape = shape == null ? null : shape.clone();
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public void setName(String name) {
+    this.name = name;
+  }
+
+  public String getDatatype() {
+    return datatype;
+  }
+
+  public void setDatatype(String datatype) {
+    this.datatype = datatype;
+  }
+
+  public long[] getShape() {
+    return shape == null ? null : shape.clone();
+  }
+
+  public void setShape(long[] shape) {
+    this.shape = shape == null ? null : shape.clone();
+  }
+
+  public Parameters getParameters() {
+    return parameters;
+  }
+
+  public List<Object> getData() {
+    return data;
+  }
+
+  public void setData(List<Object> data) {
+    this.data = data;
+  }
+
+  /** Element count implied by the shape (1 for rank 0). */
+  public long elementCount() {
+    long n = 1;
+    if (shape != null) {
+      for (long d : shape) {
+        n *= d;
+      }
+    }
+    return n;
+  }
+
+  /** Wire-form map for JSON serialization. */
+  public Map<String, Object> toMap() {
+    Map<String, Object> out = new LinkedHashMap<>();
+    out.put("name", name);
+    if (datatype != null) {
+      out.put("datatype", datatype);
+    }
+    if (shape != null) {
+      List<Object> dims = new ArrayList<>(shape.length);
+      for (long d : shape) {
+        dims.add(d);
+      }
+      out.put("shape", dims);
+    }
+    if (!parameters.isEmpty()) {
+      out.put("parameters", parameters.toMap());
+    }
+    if (data != null) {
+      out.put("data", data);
+    }
+    return out;
+  }
+
+  /** Parse one tensor object out of a decoded JSON map. */
+  @SuppressWarnings("unchecked")
+  public static IOTensor fromMap(Map<String, Object> map) {
+    IOTensor t = new IOTensor();
+    t.name = (String) map.get("name");
+    t.datatype = (String) map.get("datatype");
+    Object dims = map.get("shape");
+    if (dims instanceof List) {
+      List<Object> list = (List<Object>) dims;
+      t.shape = new long[list.size()];
+      for (int i = 0; i < list.size(); i++) {
+        t.shape[i] = ((Number) list.get(i)).longValue();
+      }
+    }
+    Object params = map.get("parameters");
+    if (params instanceof Map) {
+      t.parameters = new Parameters((Map<String, Object>) params);
+    }
+    Object values = map.get("data");
+    if (values instanceof List) {
+      t.data = (List<Object>) values;
+    }
+    return t;
+  }
+}

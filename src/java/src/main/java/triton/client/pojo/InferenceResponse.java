@@ -1,0 +1,71 @@
+// Parsed v2 inference-response body (role of reference
+// src/java/.../pojo/InferenceResponse.java).
+package triton.client.pojo;
+
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * The JSON header of a ModelInfer response: model identity, request id,
+ * response parameters, and output tensor descriptors. Binary-extension
+ * payload bytes live outside this object (see
+ * {@link triton.client.BinaryProtocol}).
+ */
+public class InferenceResponse {
+  private String modelName;
+  private String modelVersion;
+  private String id;
+  private Parameters parameters = new Parameters();
+  private List<IOTensor> outputs = new ArrayList<>();
+
+  public String getModelName() {
+    return modelName;
+  }
+
+  public String getModelVersion() {
+    return modelVersion;
+  }
+
+  public String getId() {
+    return id;
+  }
+
+  public Parameters getParameters() {
+    return parameters;
+  }
+
+  public List<IOTensor> getOutputs() {
+    return outputs;
+  }
+
+  public IOTensor getOutput(String name) {
+    for (IOTensor t : outputs) {
+      if (t.getName().equals(name)) {
+        return t;
+      }
+    }
+    return null;
+  }
+
+  @SuppressWarnings("unchecked")
+  public static InferenceResponse fromMap(Map<String, Object> map) {
+    InferenceResponse r = new InferenceResponse();
+    r.modelName = (String) map.get("model_name");
+    r.modelVersion = (String) map.get("model_version");
+    r.id = (String) map.get("id");
+    Object params = map.get("parameters");
+    if (params instanceof Map) {
+      r.parameters = new Parameters((Map<String, Object>) params);
+    }
+    Object outs = map.get("outputs");
+    if (outs instanceof List) {
+      for (Object o : (List<Object>) outs) {
+        if (o instanceof Map) {
+          r.outputs.add(IOTensor.fromMap((Map<String, Object>) o));
+        }
+      }
+    }
+    return r;
+  }
+}

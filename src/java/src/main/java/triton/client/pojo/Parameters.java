@@ -1,0 +1,64 @@
+// Typed view over the v2 protocol's free-form "parameters" objects
+// (role of reference src/java/.../pojo/Parameters.java).
+package triton.client.pojo;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+/**
+ * Request/response/tensor parameter map with convenience getters for the
+ * JSON scalar types the protocol allows (bool, int64, double, string).
+ */
+public class Parameters {
+  private final Map<String, Object> values;
+
+  public Parameters() {
+    this.values = new LinkedHashMap<>();
+  }
+
+  public Parameters(Map<String, Object> values) {
+    this.values = new LinkedHashMap<>(values);
+  }
+
+  public boolean isEmpty() {
+    return values.isEmpty();
+  }
+
+  public boolean contains(String key) {
+    return values.containsKey(key);
+  }
+
+  public Object get(String key) {
+    return values.get(key);
+  }
+
+  public Parameters put(String key, Object value) {
+    values.put(key, value);
+    return this;
+  }
+
+  public Boolean getBool(String key) {
+    Object v = values.get(key);
+    return v instanceof Boolean ? (Boolean) v : null;
+  }
+
+  public Long getLong(String key) {
+    Object v = values.get(key);
+    return v instanceof Number ? ((Number) v).longValue() : null;
+  }
+
+  public Double getDouble(String key) {
+    Object v = values.get(key);
+    return v instanceof Number ? ((Number) v).doubleValue() : null;
+  }
+
+  public String getString(String key) {
+    Object v = values.get(key);
+    return v instanceof String ? (String) v : null;
+  }
+
+  /** Live view used for JSON serialization. */
+  public Map<String, Object> toMap() {
+    return values;
+  }
+}

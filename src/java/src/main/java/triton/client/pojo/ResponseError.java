@@ -1,0 +1,28 @@
+// The v2 protocol's error body ({"error": "..."}) (role of reference
+// src/java/.../pojo/ResponseError.java).
+package triton.client.pojo;
+
+import java.util.Map;
+
+/** Parsed {@code {"error": "..."}} payload of a non-2xx response. */
+public class ResponseError {
+  private final String error;
+
+  public ResponseError(String error) {
+    this.error = error;
+  }
+
+  public String getError() {
+    return error;
+  }
+
+  public static ResponseError fromMap(Map<String, Object> map) {
+    Object msg = map == null ? null : map.get("error");
+    return new ResponseError(msg == null ? "unknown error" : msg.toString());
+  }
+
+  @Override
+  public String toString() {
+    return "ResponseError{" + error + "}";
+  }
+}

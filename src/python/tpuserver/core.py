@@ -297,7 +297,12 @@ class JaxModel(Model):
             else:
                 dev_inputs[name] = jax.device_put(arr)
         out = fn(**dev_inputs)
-        return {k: np.asarray(v) for k, v in out.items()}
+        # Outputs stay as device arrays: the response builder converts
+        # (= synchronizes) only when a tensor actually leaves in-band,
+        # so XLA-shm-delivered outputs never block on the device — on a
+        # remote chip every sync costs a full tunnel round trip, and the
+        # zero-sync path is what lets dispatches pipeline.
+        return dict(out)
 
 
 class _SystemShmRegion:
@@ -530,22 +535,34 @@ class _DynamicBatcher:
     def _stack(self, batch, rows, padded):
         """Build the batched input dict.
 
-        For device-kind models the parts are pushed individually and
-        concatenated/padded ON DEVICE: only real request bytes cross the
-        host<->device link (padding a b1 request to a b8 bucket must not
-        transfer 8x the data over a slow tunnel), and the per-part
-        transfers overlap.  The padding rows replicate row 0 on device.
+        Host (numpy) parts are stacked host-side into one bucket-shaped
+        array — the model's single device_put moves the whole batch in
+        one transfer, and the compiled-shape set stays exactly the
+        bucket set.  Device-resident parts (the XLA-shm fast path) are
+        concatenated on device instead, so they never round-trip through
+        the host; the padding rows replicate row 0.
         """
-        on_device = getattr(self._model, "device_kind", "") == "tpu"
         stacked = {}
-        if on_device:
-            import jax
-            import jax.numpy as jnp
+        for name in batch[0].inputs:
+            raw_parts = [s.inputs[name] for s in batch]
+            if all(isinstance(p, np.ndarray) for p in raw_parts):
+                parts = raw_parts
+                if padded > rows:
+                    parts = parts + [
+                        np.repeat(parts[0][:1], padded - rows, axis=0)
+                    ]
+                stacked[name] = (
+                    np.concatenate(parts, axis=0)
+                    if len(parts) > 1
+                    else parts[0]
+                )
+            else:
+                import jax
+                import jax.numpy as jnp
 
-            for name in batch[0].inputs:
                 parts = [
                     p if isinstance(p, jax.Array) else jax.device_put(p)
-                    for p in (s.inputs[name] for s in batch)
+                    for p in raw_parts
                 ]
                 x = (
                     jnp.concatenate(parts, axis=0)
@@ -558,18 +575,6 @@ class _DynamicBatcher:
                         axis=0,
                     )
                 stacked[name] = x
-        else:
-            for name in batch[0].inputs:
-                parts = [s.inputs[name] for s in batch]
-                if padded > rows:
-                    parts.append(
-                        np.repeat(parts[0][:1], padded - rows, axis=0)
-                    )
-                stacked[name] = (
-                    np.concatenate(parts, axis=0)
-                    if len(parts) > 1
-                    else parts[0]
-                )
         return stacked
 
     def _execute(self, batch, rows):
@@ -577,6 +582,15 @@ class _DynamicBatcher:
             padded = self._bucket(rows, self._model.max_batch_size)
             stacked = self._stack(batch, rows, padded)
             outputs = self._model.execute(stacked, None)
+            if len(batch) > 1:
+                # materialize device outputs ONCE for the whole batch:
+                # splitting into per-slot device slices would make each
+                # response pay its own device sync (a full tunnel round
+                # trip apiece) for the same bytes
+                outputs = {
+                    k: v if isinstance(v, np.ndarray) else np.asarray(v)
+                    for k, v in outputs.items()
+                }
             offset = 0
             for slot in batch:
                 slot.outputs = {}
@@ -585,7 +599,12 @@ class _DynamicBatcher:
                         getattr(arr, "ndim", 0) >= 1
                         and arr.shape[0] == padded
                     ):
-                        slot.outputs[name] = arr[offset : offset + slot.rows]
+                        if len(batch) == 1 and padded == slot.rows:
+                            slot.outputs[name] = arr  # no split needed
+                        else:
+                            slot.outputs[name] = arr[
+                                offset : offset + slot.rows
+                            ]
                     else:  # non-batched output: replicate
                         slot.outputs[name] = arr
                 offset += slot.rows

@@ -76,11 +76,10 @@ class _ImageNetModel(JaxModel):
     # coalesce concurrent b1 requests into one MXU-shaped dispatch: a
     # conv net at batch 1 leaves the systolic array mostly idle, and on
     # a remote chip each extra dispatch costs a full host<->device hop.
-    # Two buckets only — each batch shape is a ~2 min XLA compile for a
-    # conv net over the tunnel, and padding b1 to b8 costs far less
-    # than the dispatch it rides in.
+    # Power-of-two buckets (the batcher default) keep the padding tax
+    # under 2x while bounding the compiled-shape set; compiles persist
+    # across runs via the XLA compilation cache.
     dynamic_batching = True
-    batch_buckets = (8, 32)
     # overlapping executors hide the ~100 ms tunnel sync of one batch
     # behind the next batch's compute (instance_group count analogue)
     instance_count = 4
@@ -121,11 +120,19 @@ class _ImageNetModel(JaxModel):
     def warmup(self):
         import numpy as np
 
-        # compile every batcher bucket plus batch 1 (requests carrying
-        # parameters bypass the batcher and run at their own batch) — a
-        # cold shape is a multi-minute conv-net compile landing inside
-        # somebody's request
-        for b in (1,) + tuple(self.batch_buckets or ()):
+        # compile every batch shape live traffic can run at: the
+        # batcher's buckets (declared, else its power-of-two default)
+        # plus batch 1 (parameter-carrying requests bypass the batcher).
+        # A cold shape is a multi-minute conv-net compile landing inside
+        # somebody's request; warmed compiles persist in the XLA cache.
+        buckets = self.batch_buckets
+        if buckets is None and self.dynamic_batching:
+            buckets, b = [], 1
+            while b < self.max_batch_size:
+                buckets.append(b)
+                b <<= 1
+            buckets.append(self.max_batch_size)
+        for b in {1, *(buckets or ())}:
             self.execute(
                 {"INPUT": np.zeros((b, 224, 224, 3), np.float32)}, None
             )

@@ -85,6 +85,40 @@ def test_perf_analyzer_e2e(cc_build, http_server):
     assert float(row.split(",")[1]) > 50  # sane throughput over loopback
 
 
+def test_perf_analyzer_grpc_compression_e2e(cc_build, zoo_servers):
+    """--grpc-compression-algorithm gzip: requests carry gzip-compressed
+    gRPC messages end-to-end against the live grpcio server (which
+    transparently decompresses) and results still come back correct."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m", "simple",
+         "-i", "grpc", "-u", zoo_servers["grpc"],
+         "--grpc-compression-algorithm", "gzip",
+         "-p", "300", "--max-trials", "4",
+         "--stability-percentage", "50"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput:" in result.stdout
+
+
+def test_perf_analyzer_shape_and_sequences_e2e(cc_build, http_server):
+    """--shape fixes a dynamic dim and --num-of-sequences bounds the id
+    pool; driven against the live sequence model."""
+    result = subprocess.run(
+        [os.path.join(cc_build, "perf_analyzer"), "-m",
+         "sequence_accumulate", "-u",
+         http_server.url.replace("http://", ""),
+         "--shape", "INPUT:1",
+         "--sequence-length", "4", "--num-of-sequences", "2",
+         "--start-sequence-id", "7000", "--sequence-id-range", "50",
+         "-p", "300", "--max-trials", "4",
+         "--stability-percentage", "50"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "Throughput:" in result.stdout
+
+
 # -- C++ example programs over real sockets ----------------------------------
 
 # (binary, url-protocol, marker, extra args)

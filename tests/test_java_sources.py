@@ -1,0 +1,95 @@
+"""Source-level sanity for the Java client (no JDK in this image, so a
+real compile is impossible; these checks catch the classes of breakage
+a javac run would: unbalanced braces/parens, package/path mismatches,
+references to sibling classes that don't exist, and inventory drift
+against the reference's file set)."""
+
+import os
+import re
+
+import pytest
+
+JAVA_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "src", "java", "src", "main", "java")
+
+
+def _java_files():
+    out = []
+    for root, _, names in os.walk(JAVA_ROOT):
+        for name in names:
+            if name.endswith(".java"):
+                out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+def _strip_comments_and_strings(text):
+    # ONE left-to-right pass over all four literal/comment forms: a
+    # sequential pipeline mis-nests them ("http://" is not a comment,
+    # '"' is not a string opener, /* "x" */ is not a string)
+    return re.sub(
+        r'/\*.*?\*/|//[^\n]*|"(?:\\.|[^"\\])*"|\'(?:\\.|[^\'\\])\'',
+        "", text, flags=re.S)
+
+
+def test_inventory_covers_reference_tiers():
+    rel = {os.path.relpath(p, JAVA_ROOT) for p in _java_files()}
+    # the reference's library tiers (src/java/.../triton/client) all
+    # have counterparts here
+    for expected in [
+        "triton/client/InferenceServerClient.java",
+        "triton/client/InferInput.java",
+        "triton/client/InferRequestedOutput.java",
+        "triton/client/InferResult.java",
+        "triton/client/InferenceException.java",
+        "triton/client/BinaryProtocol.java",
+        "triton/client/Util.java",
+        "triton/client/endpoint/AbstractEndpoint.java",
+        "triton/client/endpoint/FixedEndpoint.java",
+        "triton/client/pojo/IOTensor.java",
+        "triton/client/pojo/InferenceResponse.java",
+        "triton/client/pojo/Parameters.java",
+        "triton/client/pojo/ResponseError.java",
+        "triton/client/examples/SimpleInferClient.java",
+        "triton/client/examples/SimpleInferPerf.java",
+        "triton/client/examples/MemoryGrowthTest.java",
+    ]:
+        assert expected in rel, "missing " + expected
+
+
+@pytest.mark.parametrize("path", _java_files(),
+                         ids=lambda p: os.path.relpath(p, JAVA_ROOT))
+def test_source_is_structurally_sound(path):
+    text = open(path).read()
+    body = _strip_comments_and_strings(text)
+    for open_c, close_c in [("{", "}"), ("(", ")"), ("[", "]")]:
+        assert body.count(open_c) == body.count(close_c), (
+            "unbalanced {}{} in {}".format(open_c, close_c, path))
+    # package statement matches directory
+    pkg = re.search(r"^package\s+([\w.]+);", text, re.M)
+    assert pkg, "no package statement in " + path
+    expected_dir = pkg.group(1).replace(".", os.sep)
+    assert os.path.dirname(os.path.relpath(path, JAVA_ROOT)) == expected_dir
+    # primary type name matches file name (public or package-private)
+    cls = re.search(
+        r"^(?:public\s+)?(?:final\s+|abstract\s+)*(?:class|interface|enum)"
+        r"\s+(\w+)", text, re.M)
+    assert cls, "no type declaration in " + path
+    assert cls.group(1) == os.path.basename(path)[:-5]
+
+
+def test_cross_references_resolve():
+    """Every `triton.client[...]` type referenced in imports exists."""
+    files = _java_files()
+    have = {
+        os.path.relpath(p, JAVA_ROOT)
+        .replace(os.sep, ".")
+        .removesuffix(".java")
+        for p in files
+    }
+    for path in files:
+        for m in re.finditer(
+                r"^import\s+(triton\.client[\w.]*);", open(path).read(),
+                re.M):
+            assert m.group(1) in have, (
+                "{} imports missing class {}".format(path, m.group(1)))
