@@ -144,6 +144,9 @@ struct BackendFactoryConfig {
   std::string grpc_compression;
   // TF-Serving signature (reference --model-signature-name)
   std::string model_signature_name = "serving_default";
+  // TFSERVING kind + "-i grpc": speak gRPC PredictService (the wire the
+  // reference backend measures) instead of the REST predict API
+  bool tfserve_grpc = false;
 };
 
 class ClientBackendFactory {

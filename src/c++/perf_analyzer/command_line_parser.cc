@@ -331,11 +331,20 @@ CLParser::Parse(
         params->url_specified = true;
         break;
       case 'i':
+        // -i selects the wire; for the triton pair it maps directly to
+        // the backend kind (in either flag order), for other kinds
+        // (e.g. tfserving) the backend consults protocol_grpc
         if (strcmp(optarg, "http") == 0 || strcmp(optarg, "HTTP") == 0) {
-          params->kind = BackendKind::TRITON_HTTP;
+          params->protocol_grpc = false;
+          if (params->kind == BackendKind::TRITON_GRPC) {
+            params->kind = BackendKind::TRITON_HTTP;
+          }
         } else if (
             strcmp(optarg, "grpc") == 0 || strcmp(optarg, "gRPC") == 0) {
-          params->kind = BackendKind::TRITON_GRPC;
+          params->protocol_grpc = true;
+          if (params->kind == BackendKind::TRITON_HTTP) {
+            params->kind = BackendKind::TRITON_GRPC;
+          }
         } else {
           *error = std::string("unknown protocol ") + optarg;
           return false;
@@ -517,11 +526,18 @@ CLParser::Parse(
         params->enable_mpi = true;
         break;
       case OPT_SERVICE_KIND:
-        if (strcmp(optarg, "triton_http") == 0 ||
-            strcmp(optarg, "triton") == 0) {
+        if (strcmp(optarg, "triton") == 0) {
+          // generic kind: honor whichever protocol -i chose, in
+          // either flag order
+          params->kind = params->protocol_grpc
+                             ? BackendKind::TRITON_GRPC
+                             : BackendKind::TRITON_HTTP;
+        } else if (strcmp(optarg, "triton_http") == 0) {
           params->kind = BackendKind::TRITON_HTTP;
+          params->protocol_grpc = false;
         } else if (strcmp(optarg, "triton_grpc") == 0) {
           params->kind = BackendKind::TRITON_GRPC;
+          params->protocol_grpc = true;
         } else if (
             strcmp(optarg, "tpuserver_inproc") == 0 ||
             strcmp(optarg, "triton_c_api") == 0) {
@@ -625,6 +641,7 @@ CLParser::Parse(
       }
       case OPT_NUM_OF_SEQUENCES:
         params->num_of_sequences = (size_t)atoi(optarg);
+        params->num_of_sequences_given = true;
         if (params->num_of_sequences == 0) {
           *error = "--num-of-sequences must be > 0";
           return false;
@@ -703,6 +720,18 @@ CLParser::Parse(
   if (params->request_rate_start > 0 && params->concurrency_start > 1) {
     *error =
         "cannot use concurrency and request rate modes together";
+    return false;
+  }
+  if (params->num_of_sequences_given &&
+      params->concurrency_end > params->num_of_sequences) {
+    // each concurrency worker owns a sequence slot; fewer slots than
+    // workers would interleave two workers' requests under one
+    // sequence id (out-of-order within a sequence)
+    *error =
+        "--num-of-sequences (" +
+        std::to_string(params->num_of_sequences) +
+        ") must be >= the maximum concurrency (" +
+        std::to_string(params->concurrency_end) + ")";
     return false;
   }
   if (params->sequence_id_range != 0 &&

@@ -19,6 +19,10 @@ struct PerfAnalyzerParameters {
   std::string url = "localhost:8000";
   bool url_specified = false;  // -u given; else default follows protocol
   BackendKind kind = BackendKind::TRITON_HTTP;
+  // -i grpc was given (kind tracks the triton backend pair; non-triton
+  // kinds consult this to pick their own wire, e.g. TF-Serving REST vs
+  // gRPC PredictService)
+  bool protocol_grpc = false;
   bool verbose = false;
   bool async = false;
   // in-process mode: path of the tpuserver python tree (role of
@@ -111,8 +115,11 @@ struct PerfAnalyzerParameters {
   // (reference --shape NAME:d1,d2,...; may repeat)
   std::vector<std::pair<std::string, std::vector<int64_t>>> input_shapes;
   // concurrent sequence streams in sequence mode
-  // (reference --num-of-sequences, default 4)
+  // (reference --num-of-sequences, default 4).  When not given
+  // explicitly the load manager sizes the slot pool to cover the
+  // concurrency level, so distinct workers never share a sequence.
   size_t num_of_sequences = 4;
+  bool num_of_sequences_given = false;
   // directory holding per-input raw data files (reference
   // --data-directory; consumed with --input-data style payloads)
   std::string data_directory;

@@ -49,6 +49,7 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
                                   ? ""
                                   : params_.grpc_compression_algorithm;
     config.model_signature_name = params_.model_signature_name;
+    config.tfserve_grpc = params_.protocol_grpc;
     tc::Error err = ClientBackendFactory::Create(&backend_, config);
     if (!err.IsOk()) {
       return err;
@@ -117,7 +118,14 @@ PerfAnalyzer::CreateAnalyzerObjects(std::shared_ptr<ClientBackend> backend)
   lm_config.sequence_length = params_.sequence_length;
   lm_config.sequence_length_variation =
       params_.sequence_length_variation;
-  lm_config.num_of_sequences = params_.num_of_sequences;
+  // default slot pool covers every concurrency worker (the parser
+  // rejects an explicit --num-of-sequences below the concurrency)
+  lm_config.num_of_sequences =
+      params_.num_of_sequences_given
+          ? params_.num_of_sequences
+          : std::max<size_t>(
+                {params_.num_of_sequences, params_.concurrency_end,
+                 params_.num_threads});
   lm_config.start_sequence_id = params_.start_sequence_id;
   lm_config.sequence_id_range = params_.sequence_id_range;
   lm_config.data_directory = params_.data_directory;

@@ -11,7 +11,9 @@
 #include <sstream>
 
 #include "client_backend.h"
+#include "grpc_channel.h"
 #include "rest_util.h"
+#include "tfserve_predict.pb.h"
 #include "tjson.h"
 
 namespace pa {
@@ -412,6 +414,7 @@ class TFServeBackend : public ClientBackend {
     }
   }
 
+ protected:
   std::string host_;
   int port_ = 8501;
   std::string signature_name_ = "serving_default";
@@ -544,11 +547,227 @@ class TorchServeBackend : public ClientBackend {
   std::unique_ptr<RestDispatchPool> dispatch_;
 };
 
+// ============================================================================
+// TensorFlow Serving over gRPC PredictService — the wire the reference
+// backend measures (client_backend/tensorflow_serving/
+// tfserve_grpc_client.cc).  Predict rides this framework's h2 gRPC
+// channel with a wire-compatible proto subset (proto/
+// tfserve_predict.proto); model METADATA still comes from the REST API
+// (tensorflow_model_server serves both; the gRPC GetModelMetadata reply
+// needs the full meta_graph proto tree for no measurement benefit).
+// Port convention: the url names the gRPC port (default 8500), REST
+// metadata is fetched from port+1 (the server's customary 8500/8501
+// pairing).
+// ============================================================================
+
+namespace {
+
+// KServe datatype -> tensorflow.DataType enum value
+int
+TfDtypeEnum(const std::string& datatype)
+{
+  if (datatype == "FP32") {
+    return 1;  // DT_FLOAT
+  }
+  if (datatype == "FP64") {
+    return 2;  // DT_DOUBLE
+  }
+  if (datatype == "INT32") {
+    return 3;
+  }
+  if (datatype == "UINT8") {
+    return 4;
+  }
+  if (datatype == "INT16") {
+    return 5;
+  }
+  if (datatype == "INT8") {
+    return 6;
+  }
+  if (datatype == "BYTES") {
+    return 7;  // DT_STRING
+  }
+  if (datatype == "INT64") {
+    return 9;
+  }
+  if (datatype == "BOOL") {
+    return 10;
+  }
+  if (datatype == "FP16") {
+    return 19;  // DT_HALF
+  }
+  if (datatype == "UINT32") {
+    return 22;
+  }
+  if (datatype == "UINT64") {
+    return 23;
+  }
+  return -1;  // unknown: callers error loudly (a silent DT_FLOAT label
+              // on differently-sized elements would corrupt the wire)
+}
+
+}  // namespace
+
+class TFServeGrpcBackend : public TFServeBackend {
+ public:
+  static tc::Error Create(
+      std::shared_ptr<ClientBackend>* backend,
+      const BackendFactoryConfig& config)
+  {
+    auto* b = new TFServeGrpcBackend();
+    SplitHostPort(config.url, 8500, &b->host_, &b->port_);
+    tc::TlsOptions tls;
+    if (config.grpc_use_ssl) {
+      tls.enabled = true;
+      tls.ca_file = config.grpc_ssl.root_certificates;
+      tls.cert_file = config.grpc_ssl.certificate_chain;
+      tls.key_file = config.grpc_ssl.private_key;
+      tls.alpn = {"h2"};
+    }
+    tc::Error err = tc::h2::GrpcChannel::Create(
+        &b->channel_, b->host_ + ":" + std::to_string(b->port_),
+        config.verbose, tls);
+    if (!err.IsOk()) {
+      delete b;
+      return err;
+    }
+    // REST metadata rides the customary adjacent port
+    b->pool_.reset(new RestClientPool(b->host_, b->port_ + 1));
+    b->dispatch_.reset(new RestDispatchPool(config.concurrency));
+    b->signature_name_ = config.model_signature_name;
+    backend->reset(b);
+    return tc::Error::Success;
+  }
+
+  tc::Error Infer(
+      BackendInferResult* result,
+      const BackendInferRequest& request) override
+  {
+    pa::tfserve::PredictRequest predict;
+    predict.mutable_model_spec()->set_name(request.model_name);
+    if (!request.model_version.empty()) {
+      predict.mutable_model_spec()->mutable_version()->set_value(
+          strtoll(request.model_version.c_str(), nullptr, 10));
+    }
+    if (signature_name_ != "serving_default") {
+      predict.mutable_model_spec()->set_signature_name(signature_name_);
+    }
+    for (const auto& input : request.inputs) {
+      if (!input.shm_region.empty()) {
+        return tc::Error(
+            "tfserving backend does not support shared memory");
+      }
+      int dtype_enum = TfDtypeEnum(input.datatype);
+      if (dtype_enum < 0) {
+        return tc::Error(
+            "datatype " + input.datatype +
+            " has no TensorFlow TensorProto mapping");
+      }
+      auto& tensor = (*predict.mutable_inputs())[input.name];
+      tensor.set_dtype(dtype_enum);
+      for (int64_t d : input.shape) {
+        tensor.mutable_tensor_shape()->add_dim()->set_size(d);
+      }
+      if (input.datatype == "BYTES") {
+        // triton length-prefix framing -> repeated string_val
+        const uint8_t* p = input.data.data();
+        size_t left = input.data.size();
+        while (left >= 4) {
+          uint32_t n;
+          memcpy(&n, p, 4);
+          p += 4;
+          left -= 4;
+          if (n > left) {
+            return tc::Error("malformed BYTES input element");
+          }
+          tensor.add_string_val(reinterpret_cast<const char*>(p), n);
+          p += n;
+          left -= n;
+        }
+      } else {
+        tensor.set_tensor_content(
+            input.data.data(), input.data.size());
+      }
+    }
+    for (const auto& name : request.requested_outputs) {
+      predict.add_output_filter(name);
+    }
+
+    std::string serialized;
+    if (!predict.SerializeToString(&serialized)) {
+      return tc::Error("failed to serialize PredictRequest");
+    }
+    std::string out;
+    tc::Error err = channel_->Unary(
+        "tensorflow.serving.PredictionService", "Predict", serialized,
+        &out);
+    if (!err.IsOk()) {
+      result->status = err;
+      return err;
+    }
+    pa::tfserve::PredictResponse response;
+    if (!response.ParseFromString(out)) {
+      return tc::Error("failed to parse PredictResponse");
+    }
+    result->status = tc::Error::Success;
+    result->request_id = request.request_id;
+    for (const auto& kv : response.outputs()) {
+      std::vector<uint8_t>& raw = result->outputs[kv.first];
+      const auto& tensor = kv.second;
+      if (!tensor.tensor_content().empty()) {
+        raw.assign(
+            tensor.tensor_content().begin(), tensor.tensor_content().end());
+      } else if (tensor.string_val_size() > 0) {
+        for (const auto& element : tensor.string_val()) {
+          uint32_t n = (uint32_t)element.size();
+          const uint8_t* np = reinterpret_cast<const uint8_t*>(&n);
+          raw.insert(raw.end(), np, np + 4);
+          raw.insert(raw.end(), element.begin(), element.end());
+        }
+      } else if (tensor.float_val_size() > 0) {
+        raw.resize(tensor.float_val_size() * 4);
+        memcpy(raw.data(), tensor.float_val().data(), raw.size());
+      } else if (tensor.double_val_size() > 0) {
+        raw.resize(tensor.double_val_size() * 8);
+        memcpy(raw.data(), tensor.double_val().data(), raw.size());
+      } else if (tensor.int_val_size() > 0) {
+        raw.resize(tensor.int_val_size() * 4);
+        memcpy(raw.data(), tensor.int_val().data(), raw.size());
+      } else if (tensor.int64_val_size() > 0) {
+        raw.resize(tensor.int64_val_size() * 8);
+        memcpy(raw.data(), tensor.int64_val().data(), raw.size());
+      }
+    }
+    return tc::Error::Success;
+  }
+
+  tc::Error AsyncInfer(
+      BackendCallback callback, const BackendInferRequest& request) override
+  {
+    auto copy = std::make_shared<BackendInferRequest>(request);
+    dispatch_->Enqueue([this, callback, copy]() {
+      BackendInferResult result;
+      tc::Error err = Infer(&result, *copy);
+      if (!err.IsOk()) {
+        result.status = err;
+      }
+      callback(std::move(result));
+    });
+    return tc::Error::Success;
+  }
+
+ private:
+  std::shared_ptr<tc::h2::GrpcChannel> channel_;
+};
+
 tc::Error
 CreateTFServeBackend(
     std::shared_ptr<ClientBackend>* backend,
     const BackendFactoryConfig& config)
 {
+  if (config.tfserve_grpc) {
+    return TFServeGrpcBackend::Create(backend, config);
+  }
   return TFServeBackend::Create(backend, config);
 }
 

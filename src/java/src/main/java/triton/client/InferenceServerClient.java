@@ -140,9 +140,7 @@ public class InferenceServerClient implements AutoCloseable {
     RequestBody body = buildRequestBody(inputs, outputs);
     String url = resolveUrl();
     HttpRequest request =
-        HttpRequest.newBuilder()
-            .uri(URI.create(url + "/v2/models/" + modelName + "/infer"))
-            .timeout(requestTimeout)
+        requestBuilder(url, "/v2/models/" + modelName + "/infer")
             .header("Content-Type", "application/octet-stream")
             .header(
                 "Inference-Header-Content-Length",
@@ -170,20 +168,32 @@ public class InferenceServerClient implements AutoCloseable {
     } catch (InferenceException e) {
       return CompletableFuture.failedFuture(e);
     }
-    HttpRequest request;
+    String url;
     try {
-      request =
-          requestBuilder("/v2/models/" + modelName + "/infer")
-              .header("Content-Type", "application/octet-stream")
-              .header(
-                  "Inference-Header-Content-Length",
-                  Integer.toString(body.jsonLength))
-              .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
-              .build();
+      url = resolveUrl();
     } catch (InferenceException e) {
       return CompletableFuture.failedFuture(e);
     }
+    HttpRequest request =
+        requestBuilder(url, "/v2/models/" + modelName + "/infer")
+            .header("Content-Type", "application/octet-stream")
+            .header(
+                "Inference-Header-Content-Length",
+                Integer.toString(body.jsonLength))
+            .POST(HttpRequest.BodyPublishers.ofByteArray(body.bytes))
+            .build();
     return http.sendAsync(request, HttpResponse.BodyHandlers.ofByteArray())
+        .whenComplete(
+            (response, failure) -> {
+              if (failure != null) {
+                // transport failure feedback mirrors the sync paths
+                reportFailure(
+                    url,
+                    failure instanceof Exception ? (Exception) failure
+                                                 : new RuntimeException(
+                                                     failure));
+              }
+            })
         .thenApply(
             response -> {
               try {
@@ -282,10 +292,9 @@ public class InferenceServerClient implements AutoCloseable {
     return new InferResult(response.body(), headerLength);
   }
 
-  private HttpRequest.Builder requestBuilder(String path)
-      throws InferenceException {
+  private HttpRequest.Builder requestBuilder(String url, String path) {
     return HttpRequest.newBuilder()
-        .uri(URI.create(resolveUrl() + path))
+        .uri(URI.create(url + path))
         .timeout(requestTimeout);
   }
 
@@ -293,11 +302,7 @@ public class InferenceServerClient implements AutoCloseable {
     String url = resolveUrl();
     try {
       return http.send(
-          HttpRequest.newBuilder()
-              .uri(URI.create(url + path))
-              .timeout(requestTimeout)
-              .GET()
-              .build(),
+          requestBuilder(url, path).GET().build(),
           HttpResponse.BodyHandlers.ofByteArray());
     } catch (IOException | InterruptedException e) {
       reportFailure(url, e);
@@ -319,9 +324,7 @@ public class InferenceServerClient implements AutoCloseable {
   private void post(String path, byte[] body, String contentType)
       throws InferenceException {
     String url = resolveUrl();
-    HttpRequest.Builder builder = HttpRequest.newBuilder()
-        .uri(URI.create(url + path))
-        .timeout(requestTimeout);
+    HttpRequest.Builder builder = requestBuilder(url, path);
     if (contentType != null) {
       builder.header("Content-Type", contentType);
     }

@@ -192,17 +192,31 @@ class _Handler(socketserver.StreamRequestHandler):
         reference metrics_manager.h:44-91).  Gauge names mirror the
         nv_* families with TPU labels where the reference reports GPU."""
         lines = []
+        rss_bytes = None
         try:
-            import resource
+            # current RSS (ru_maxrss is the PEAK, and its unit is
+            # platform-dependent; /proc is authoritative on Linux)
+            import os
 
-            rss_bytes = resource.getrusage(
-                resource.RUSAGE_SELF).ru_maxrss * 1024
+            with open("/proc/self/statm") as f:
+                rss_bytes = int(f.read().split()[1]) * os.sysconf(
+                    "SC_PAGE_SIZE")
+        except Exception:
+            try:
+                import resource
+                import sys
+
+                peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                # Linux reports KB, macOS bytes; label it as the peak
+                # it is rather than mislabeling it current
+                rss_bytes = peak * (1 if sys.platform == "darwin" else 1024)
+            except Exception:
+                pass
+        if rss_bytes is not None:
             lines.append(
                 "# HELP nv_cpu_memory_used_bytes Server RSS.\n"
                 "# TYPE nv_cpu_memory_used_bytes gauge\n"
                 "nv_cpu_memory_used_bytes {}".format(rss_bytes))
-        except Exception:
-            pass
         try:
             import jax
 
@@ -223,8 +237,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 lines.append(
                     "nv_gpu_memory_total_bytes{} {}".format(label, total))
                 if total:
+                    # a memory fraction, NOT compute duty-cycle — keep it
+                    # out of nv_gpu_utilization (whose nv_* semantics,
+                    # and perf_analyzer's averaging, mean busy-percent)
                     lines.append(
-                        "nv_gpu_utilization{} {}".format(
+                        "nv_gpu_memory_utilization{} {}".format(
                             label, used / total))
         except Exception:
             pass

@@ -161,7 +161,7 @@ class _PooledConnection:
             self._check_header(key, value)
             head.append("{}: {}".format(key, value))
         request = "\r\n".join(head).encode("latin-1") + b"\r\n\r\n"
-        if body:
+        if body and hasattr(self._sock, "sendmsg"):
             # writev without concatenating the (possibly large) body;
             # sendmsg may send partially, so advance views until drained
             views = [memoryview(request), memoryview(body)]
@@ -172,23 +172,35 @@ class _PooledConnection:
                     views.pop(0)
                 if views and sent:
                     views[0] = views[0][sent:]
+        elif body:
+            # sendmsg is Unix-only; fall back to two sendalls (still no
+            # concatenation copy of the body)
+            self._sock.sendall(request)
+            self._sock.sendall(body)
         else:
             self._sock.sendall(request)
 
-        status_line = self._read_line()
-        parts = status_line.split(None, 2)
-        status = int(parts[1])
-        resp_headers = {}
         while True:
-            line = self._read_line()
-            if not line:
-                break
-            key, _, value = line.partition(b":")
-            resp_headers[key.decode("latin-1").strip()] = (
-                value.decode("latin-1").strip()
-            )
+            status_line = self._read_line()
+            parts = status_line.split(None, 2)
+            status = int(parts[1])
+            resp_headers = {}
+            while True:
+                line = self._read_line()
+                if not line:
+                    break
+                key, _, value = line.partition(b":")
+                resp_headers[key.decode("latin-1").strip()] = (
+                    value.decode("latin-1").strip()
+                )
+            if 100 <= status < 200:
+                # interim response (e.g. a solicited 100 Continue):
+                # bodiless by definition; the real response follows on
+                # the same connection
+                continue
+            break
         lowered = {k.lower(): v for k, v in resp_headers.items()}
-        if status in (204, 304) or 100 <= status < 200:
+        if status in (204, 304):
             resp_body = b""  # bodiless by status (RFC 9112 6.3)
         elif lowered.get("transfer-encoding", "").lower() == "chunked":
             pieces = []

@@ -6,7 +6,8 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 OUT=src/python/tritonclient/grpc
-protoc -Iproto --python_out="$OUT" proto/model_config.proto proto/grpc_service.proto
+protoc -Iproto --python_out="$OUT" proto/model_config.proto \
+  proto/grpc_service.proto proto/tfserve_predict.proto
 # Make the generated import package-relative.
 sed -i 's/^import model_config_pb2 as/from . import model_config_pb2 as/' \
   "$OUT/grpc_service_pb2.py"
