@@ -731,11 +731,40 @@ class TFServeGrpcBackend : public TFServeBackend {
         raw.resize(tensor.double_val_size() * 8);
         memcpy(raw.data(), tensor.double_val().data(), raw.size());
       } else if (tensor.int_val_size() > 0) {
-        raw.resize(tensor.int_val_size() * 4);
-        memcpy(raw.data(), tensor.int_val().data(), raw.size());
+        // TensorProto packs every integer type <= 32 bits into
+        // int_val; emit elements at the DECLARED dtype's width
+        // (DT_INT8=6 / DT_UINT8=4 -> 1 byte, DT_INT16=5 -> 2,
+        // else 4)
+        const int dt = tensor.dtype();
+        const size_t width = (dt == 4 || dt == 6) ? 1
+                             : (dt == 5)          ? 2
+                                                  : 4;
+        raw.resize(tensor.int_val_size() * width);
+        for (int i = 0; i < tensor.int_val_size(); ++i) {
+          int32_t v = tensor.int_val(i);
+          memcpy(raw.data() + i * width, &v, width);
+        }
       } else if (tensor.int64_val_size() > 0) {
         raw.resize(tensor.int64_val_size() * 8);
         memcpy(raw.data(), tensor.int64_val().data(), raw.size());
+      } else if (tensor.bool_val_size() > 0) {
+        raw.resize(tensor.bool_val_size());
+        for (int i = 0; i < tensor.bool_val_size(); ++i) {
+          raw[i] = tensor.bool_val(i) ? 1 : 0;
+        }
+      } else if (tensor.half_val_size() > 0) {
+        // half_val carries fp16 bit patterns in int32 slots
+        raw.resize(tensor.half_val_size() * 2);
+        for (int i = 0; i < tensor.half_val_size(); ++i) {
+          uint16_t bits = (uint16_t)tensor.half_val(i);
+          memcpy(raw.data() + i * 2, &bits, 2);
+        }
+      } else if (tensor.uint32_val_size() > 0) {
+        raw.resize(tensor.uint32_val_size() * 4);
+        memcpy(raw.data(), tensor.uint32_val().data(), raw.size());
+      } else if (tensor.uint64_val_size() > 0) {
+        raw.resize(tensor.uint64_val_size() * 8);
+        memcpy(raw.data(), tensor.uint64_val().data(), raw.size());
       }
     }
     return tc::Error::Success;

@@ -46,10 +46,19 @@ class LlamaConfig:
     # to any head count) or "ulysses" (two all_to_alls, full-sequence
     # attention per head shard — needs local heads divisible by sp)
     sp_strategy: str = "ring"
-    # single-shard attention: "xla" (fused by the compiler) or "pallas"
-    # (the hand-tiled flash kernel, tpuserver.ops.flash_attention;
-    # needs T divisible by its block sizes)
+    # single-shard prefill/forward attention: "xla" (compiler-fused
+    # dense) or "pallas" (the hand-tiled flash kernel,
+    # tpuserver.ops.flash_attention; needs T divisible by its block
+    # sizes).  Measured on v5e at T=2048 on the 3B preset the flash
+    # prefill runs at 38-41% MFU vs 28-38% dense (~1.1-1.35x) — see
+    # docs/benchmarking.md.
     attn_impl: str = "xla"
+    # single-query decode attention: "xla" or "pallas"
+    # (tpuserver.ops.decode_attention).  The Pallas kernel skips dead
+    # cache-tail blocks, winning ~2x when the valid prefix is a small
+    # fraction of max_seq; XLA's fused dense wins once the cache is
+    # mostly full.  Pick per deployment shape.
+    decode_impl: str = "xla"
 
     @property
     def head_dim(self):
@@ -216,17 +225,15 @@ def forward(params, tokens, cfg):
     positions = jnp.arange(T)
 
     def attn_fn(q, k, v):
-        if cfg.attn_impl == "pallas":
-            import math
-
+        if cfg.attn_impl == "pallas" and T % 128 == 0:
+            # MXU-tileable lengths only: the TPU lowering needs
+            # (8, 128)-aligned blocks; other lengths fall through to
+            # the dense path below
             from tpuserver.ops import flash_attention
 
-            # largest power-of-two-ish block that divides T, capped at
-            # the MXU-friendly 128 (gcd handles any sequence length)
-            block = math.gcd(T, 128)
             return flash_attention(
                 q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
-                causal=True, block_q=block, block_k=block,
+                causal=True, block_q=128, block_k=128,
             )
         return ring_attention(
             q, _expand_kv(k, n_rep), _expand_kv(v, n_rep), causal=True
@@ -394,6 +401,51 @@ def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
                     axis=1,
                 )
             )
+            max_seq = cache.shape[3]
+            pallas_block = next(
+                (b for b in (256, 128) if max_seq % b == 0), None
+            )
+            if (
+                cfg.decode_impl == "pallas"
+                and q.shape[1] == 1
+                and pallas_block is not None
+            ):
+                # the serving hot op: hand-tiled single-query decode
+                # attention (GQA expansion stays in VMEM, dead cache
+                # tail blocks never stream from HBM).  Equivalent mask:
+                # with q_pos == lengths-1, "k > q_pos" == "k >= lengths".
+                # max_seq without a tileable block falls through to the
+                # dense path (like the prefill gate above) instead of
+                # erroring at trace time.
+                from tpuserver.ops import decode_attention
+
+                out = decode_attention(
+                    q[:, 0],
+                    new_cache[i, 0],
+                    new_cache[i, 1],
+                    jnp.full((q.shape[0],), lengths, jnp.int32),
+                    block_k=pallas_block,
+                )
+                return out[:, None]
+            if (
+                cfg.attn_impl == "pallas"
+                and q.shape[1] > 1
+                and q.shape[1] % 128 == 0
+                and isinstance(write_pos, int)
+                and write_pos == 0
+            ):
+                # prefill from position 0: the cached attention is
+                # exactly causal self-attention over the prompt, so the
+                # flash kernel applies (K/V still land in the cache via
+                # the updates above).  Only MXU-tileable lengths — the
+                # TPU lowering needs (8, 128)-aligned blocks, so odd
+                # prompt lengths take the dense path.
+                from tpuserver.ops import flash_attention
+
+                return flash_attention(
+                    q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
+                    causal=True, block_q=128, block_k=128,
+                )
             return _attend_cached(
                 q, new_cache[i, 0], new_cache[i, 1], positions, lengths,
                 n_rep,
