@@ -1,0 +1,225 @@
+// TPU (XLA) shared-memory infer on the `simple` model over HTTP — the
+// TPU-native role of reference simple_http_cudashm_client.cc (the
+// cudaMalloc → cudaIpc handle → RegisterCudaSharedMemory →
+// SetSharedMemory scenario, which the reference ships over BOTH
+// protocols).  This process creates the region's host staging window,
+// serializes an XlaShmHandle-compatible raw handle
+// {uuid, shm_key, byte_size, device_ordinal}, and registers it through
+// the XLA plane's HTTP verbs; the server stages tensors to TPU HBM on
+// use (tritonclient/utils/xla_shared_memory).
+
+#include <unistd.h>
+
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "http_client.h"
+#include "shm_utils.h"
+
+#define FAIL_IF_ERR(X, MSG)                              \
+  {                                                      \
+    tc::Error err = (X);                                 \
+    if (!err.IsOk()) {                                   \
+      std::cerr << "error: " << (MSG) << ": " << err     \
+                << std::endl;                            \
+      exit(1);                                           \
+    }                                                    \
+  }
+
+namespace {
+
+std::string
+Base64Encode(const std::string& in)
+{
+  static const char tbl[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    uint32_t v = ((uint8_t)in[i] << 16) | ((uint8_t)in[i + 1] << 8) |
+                 (uint8_t)in[i + 2];
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    uint32_t v = (uint8_t)in[i] << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    uint32_t v = ((uint8_t)in[i] << 16) | ((uint8_t)in[i + 1] << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += "=";
+  }
+  return out;
+}
+
+std::string
+XlaRawHandle(const std::string& shm_key, size_t byte_size, int device)
+{
+  std::string json = std::string("{\"uuid\": \"xlashm_http_example") +
+                     std::to_string(getpid()) + "\", \"shm_key\": \"" +
+                     shm_key +
+                     "\", \"byte_size\": " + std::to_string(byte_size) +
+                     ", \"device_ordinal\": " + std::to_string(device) + "}";
+  return Base64Encode(json);
+}
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  bool verbose = false;
+  std::string url("localhost:8000");
+  int opt;
+  while ((opt = getopt(argc, argv, "vu:")) != -1) {
+    switch (opt) {
+      case 'v':
+        verbose = true;
+        break;
+      case 'u':
+        url = optarg;
+        break;
+      default:
+        std::cerr << "usage: " << argv[0] << " [-v] [-u host:port]"
+                  << std::endl;
+        exit(1);
+    }
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url, verbose),
+      "unable to create http client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  const char* kInputKey = "/simple_http_xlashm_input";
+  const char* kOutputKey = "/simple_http_xlashm_output";
+  client->UnregisterXlaSharedMemory("xla_input_data");
+  client->UnregisterXlaSharedMemory("xla_output_data");
+
+  // host staging windows for the two regions
+  int input_fd, output_fd;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(kInputKey, 2 * kTensorBytes, &input_fd),
+      "creating input window");
+  void* input_base;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(input_fd, 0, 2 * kTensorBytes, &input_base),
+      "mapping input window");
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(kOutputKey, 2 * kTensorBytes, &output_fd),
+      "creating output window");
+  void* output_base;
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(output_fd, 0, 2 * kTensorBytes, &output_base),
+      "mapping output window");
+
+  int32_t* input_data = (int32_t*)input_base;
+  for (int i = 0; i < 16; ++i) {
+    input_data[i] = i;       // INPUT0
+    input_data[16 + i] = 1;  // INPUT1
+  }
+
+  FAIL_IF_ERR(
+      client->RegisterXlaSharedMemory(
+          "xla_input_data", XlaRawHandle(kInputKey, 2 * kTensorBytes, 0),
+          2 * kTensorBytes, 0),
+      "registering input region");
+  FAIL_IF_ERR(
+      client->RegisterXlaSharedMemory(
+          "xla_output_data", XlaRawHandle(kOutputKey, 2 * kTensorBytes, 0),
+          2 * kTensorBytes, 0),
+      "registering output region");
+
+  std::string status;
+  FAIL_IF_ERR(client->XlaSharedMemoryStatus(&status), "xla shm status");
+  if (status.find("xla_input_data") == std::string::npos ||
+      status.find("xla_output_data") == std::string::npos) {
+    std::cerr << "error: expected both registered xla regions in status"
+              << std::endl;
+    exit(1);
+  }
+
+  tc::InferInput* input0;
+  tc::InferInput* input1;
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input0, "INPUT0", {1, 16}, "INT32"),
+      "creating INPUT0");
+  FAIL_IF_ERR(
+      tc::InferInput::Create(&input1, "INPUT1", {1, 16}, "INT32"),
+      "creating INPUT1");
+  std::shared_ptr<tc::InferInput> input0_ptr(input0), input1_ptr(input1);
+  FAIL_IF_ERR(
+      input0_ptr->SetSharedMemory("xla_input_data", kTensorBytes, 0),
+      "INPUT0 shm");
+  FAIL_IF_ERR(
+      input1_ptr->SetSharedMemory(
+          "xla_input_data", kTensorBytes, kTensorBytes),
+      "INPUT1 shm");
+
+  tc::InferRequestedOutput* output0;
+  tc::InferRequestedOutput* output1;
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output0, "OUTPUT0"),
+      "creating OUTPUT0");
+  FAIL_IF_ERR(
+      tc::InferRequestedOutput::Create(&output1, "OUTPUT1"),
+      "creating OUTPUT1");
+  std::shared_ptr<tc::InferRequestedOutput> output0_ptr(output0),
+      output1_ptr(output1);
+  FAIL_IF_ERR(
+      output0_ptr->SetSharedMemory("xla_output_data", kTensorBytes, 0),
+      "OUTPUT0 shm");
+  FAIL_IF_ERR(
+      output1_ptr->SetSharedMemory(
+          "xla_output_data", kTensorBytes, kTensorBytes),
+      "OUTPUT1 shm");
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result;
+  FAIL_IF_ERR(
+      client->Infer(
+          &result, options, {input0_ptr.get(), input1_ptr.get()},
+          {output0_ptr.get(), output1_ptr.get()}),
+      "infer");
+  std::unique_ptr<tc::InferResult> result_ptr(result);
+  FAIL_IF_ERR(result_ptr->RequestStatus(), "request status");
+
+  // outputs land in the output window (server syncs the region's host
+  // view on write-back for cross-process clients)
+  int32_t* output_data = (int32_t*)output_base;
+  for (int i = 0; i < 16; ++i) {
+    if (output_data[i] != input_data[i] + input_data[16 + i]) {
+      std::cerr << "error: incorrect sum at " << i << std::endl;
+      exit(1);
+    }
+    if (output_data[16 + i] != input_data[i] - input_data[16 + i]) {
+      std::cerr << "error: incorrect difference at " << i << std::endl;
+      exit(1);
+    }
+  }
+
+  FAIL_IF_ERR(
+      client->UnregisterXlaSharedMemory("xla_input_data"),
+      "unregister input");
+  FAIL_IF_ERR(
+      client->UnregisterXlaSharedMemory("xla_output_data"),
+      "unregister output");
+  tc::UnmapSharedMemory(input_base, 2 * kTensorBytes);
+  tc::UnmapSharedMemory(output_base, 2 * kTensorBytes);
+  tc::CloseSharedMemory(input_fd);
+  tc::CloseSharedMemory(output_fd);
+  tc::UnlinkSharedMemoryRegion(kInputKey);
+  tc::UnlinkSharedMemoryRegion(kOutputKey);
+
+  std::cout << "xla shm infer OK" << std::endl;
+  return 0;
+}

@@ -60,6 +60,14 @@ DecompressMessage(const std::string& in, std::string* out)
       return Error("inflate failed (corrupt compressed gRPC message)");
     }
     out->append(buf, sizeof(buf) - zs.avail_out);
+    // mirror the send side's 2 GB gRPC message cap: without it a small
+    // gzip bomb from a hostile server inflates unboundedly into client
+    // memory
+    if (out->size() > static_cast<size_t>(INT32_MAX)) {
+      inflateEnd(&zs);
+      return Error(
+          "decompressed gRPC message exceeds the 2 GB message limit");
+    }
   } while (rc != Z_STREAM_END && (zs.avail_in > 0 || zs.avail_out == 0));
   inflateEnd(&zs);
   if (rc != Z_STREAM_END) {

@@ -536,6 +536,14 @@ InferenceServerHttpClient::Create(
       return Error("https requested but " + why);
     }
   }
+  // the transport loads certificates with the *_PEM loaders only; a
+  // DER request must fail here rather than be silently parsed as PEM
+  if (ssl_options.cert_type != HttpSslOptions::CERTTYPE::CERT_PEM) {
+    return Error("unsupported ssl certificate type: only PEM is supported");
+  }
+  if (ssl_options.key_type != HttpSslOptions::KEYTYPE::KEY_PEM) {
+    return Error("unsupported ssl key type: only PEM is supported");
+  }
   client->reset(new InferenceServerHttpClient(
       server_url, verbose, concurrency, ssl_options));
   return Error::Success;

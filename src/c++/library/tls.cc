@@ -2,6 +2,7 @@
 
 #include "tls.h"
 
+#include <arpa/inet.h>
 #include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
@@ -41,6 +42,8 @@ struct SslApi {
   long (*SSL_ctrl)(void*, int, long, void*);
   long (*SSL_CTX_ctrl)(void*, int, long, void*);
   int (*SSL_set1_host)(void*, const char*);
+  void* (*SSL_get0_param)(void*);
+  int (*X509_VERIFY_PARAM_set1_ip_asc)(void*, const char*);
   void (*SSL_get0_alpn_selected)(
       const void*, const unsigned char**, unsigned*);
   unsigned long (*ERR_get_error)();
@@ -120,6 +123,8 @@ Api()
     TC_RESOLVE(SSL_ctrl);
     TC_RESOLVE(SSL_CTX_ctrl);
     TC_RESOLVE(SSL_set1_host);
+    TC_RESOLVE(SSL_get0_param);
+    TC_RESOLVE(X509_VERIFY_PARAM_set1_ip_asc);
     TC_RESOLVE(SSL_get0_alpn_selected);
     TC_RESOLVE(ERR_get_error);
     TC_RESOLVE(ERR_error_string_n);
@@ -208,12 +213,37 @@ BuildEngine(
     return Error(LastSslError(api, "SSL_set_fd failed"));
   }
   // SNI (macro SSL_set_tlsext_host_name in the headers); the host part
-  // only, certificates never carry ports
-  api.SSL_ctrl(
-      ssl, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
-      const_cast<char*>(host.c_str()));
+  // only, certificates never carry ports.  RFC 6066 forbids IP
+  // literals in server_name, so skip the extension for them (matching
+  // what curl and grpc do); hostname verification for IP endpoints
+  // goes through X509_VERIFY_PARAM_set1_ip_asc below (iPAddress SANs;
+  // SSL_set1_host only matches dNSName).  IPv6 URL hosts arrive bracketed
+  // ("[2001:db8::1]") — strip before the literal check and hostname
+  // match, since neither inet_pton nor certificate SANs use brackets.
+  std::string bare = host;
+  if (bare.size() >= 2 && bare.front() == '[' && bare.back() == ']') {
+    bare = bare.substr(1, bare.size() - 2);
+  }
+  struct in_addr v4;
+  struct in6_addr v6;
+  const bool ip_literal = inet_pton(AF_INET, bare.c_str(), &v4) == 1 ||
+                          inet_pton(AF_INET6, bare.c_str(), &v6) == 1;
+  if (!ip_literal) {
+    api.SSL_ctrl(
+        ssl, kSslCtrlSetTlsextHostname, kTlsextNametypeHostName,
+        const_cast<char*>(bare.c_str()));
+  }
   if (opts.verify_peer && opts.verify_host) {
-    if (api.SSL_set1_host(ssl, host.c_str()) != 1) {
+    if (ip_literal) {
+      // SSL_set1_host only matches dNSName SANs; IP endpoints must
+      // verify against iPAddress SANs via the verify param
+      void* param = api.SSL_get0_param(ssl);
+      if (param == nullptr ||
+          api.X509_VERIFY_PARAM_set1_ip_asc(param, bare.c_str()) != 1) {
+        return Error(
+            LastSslError(api, "X509_VERIFY_PARAM_set1_ip_asc failed"));
+      }
+    } else if (api.SSL_set1_host(ssl, bare.c_str()) != 1) {
       return Error(LastSslError(api, "SSL_set1_host failed"));
     }
   }

@@ -76,6 +76,33 @@ InferenceProfiler::SummarizeRecords(
   return stats;
 }
 
+bool
+InferenceProfiler::DetermineStability(
+    const std::vector<ClientSideStats>& windows, double threshold_pct,
+    size_t window_count)
+{
+  if (windows.size() < window_count || window_count == 0) {
+    return false;
+  }
+  const auto& last = windows[windows.size() - 1];
+  for (size_t i = windows.size() - window_count; i < windows.size(); ++i) {
+    const auto& w = windows[i];
+    double tput_dev = std::fabs(w.infer_per_sec - last.infer_per_sec) /
+                      (last.infer_per_sec > 0 ? last.infer_per_sec : 1.0);
+    double lat_dev =
+        std::fabs(
+            (double)w.stability_latency_ns -
+            (double)last.stability_latency_ns) /
+        (last.stability_latency_ns > 0 ? (double)last.stability_latency_ns
+                                       : 1.0);
+    if (tput_dev > threshold_pct / 100.0 ||
+        lat_dev > threshold_pct / 100.0) {
+      return false;
+    }
+  }
+  return true;
+}
+
 tc::Error
 InferenceProfiler::QueryServerStats(
     ServerSideStats* stats, const std::string& model_name)
@@ -237,31 +264,9 @@ InferenceProfiler::ProfileCurrentLevel(PerfStatus* status)
     }
     // stability: last 3 windows within threshold on throughput + the
     // stability latency metric (avg, or p<N> with --percentile)
-    if (windows.size() >= 3) {
-      bool stable = true;
-      const auto& last = windows[windows.size() - 1];
-      for (size_t i = windows.size() - 3; i < windows.size(); ++i) {
-        const auto& w = windows[i];
-        double tput_dev =
-            std::fabs(w.infer_per_sec - last.infer_per_sec) /
-            (last.infer_per_sec > 0 ? last.infer_per_sec : 1.0);
-        double lat_dev =
-            std::fabs(
-                (double)w.stability_latency_ns -
-                (double)last.stability_latency_ns) /
-            (last.stability_latency_ns > 0
-                 ? (double)last.stability_latency_ns
-                 : 1.0);
-        if (tput_dev > config_.stability_threshold_pct / 100.0 ||
-            lat_dev > config_.stability_threshold_pct / 100.0) {
-          stable = false;
-          break;
-        }
-      }
-      if (stable) {
-        status->stabilized = true;
-        break;
-      }
+    if (DetermineStability(windows, config_.stability_threshold_pct)) {
+      status->stabilized = true;
+      break;
     }
   }
   if (windows.empty()) {

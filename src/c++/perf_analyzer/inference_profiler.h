@@ -99,6 +99,14 @@ class InferenceProfiler {
       const std::vector<RequestRecord>& records, uint64_t window_ns,
       size_t percentile = 0);
 
+  // True when the last `window_count` windows agree with the final
+  // window within `threshold_pct` on BOTH throughput and the stability
+  // latency metric (reference DetermineStability,
+  // inference_profiler.cc:780-833).  Public/static for unit tests.
+  static bool DetermineStability(
+      const std::vector<ClientSideStats>& windows, double threshold_pct,
+      size_t window_count = 3);
+
   // Optional Prometheus scraper; when set, per-measurement averages are
   // attached to PerfStatus::metrics.
   void SetMetricsManager(std::shared_ptr<class MetricsManager> metrics)

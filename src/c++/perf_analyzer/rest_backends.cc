@@ -20,6 +20,30 @@ namespace pa {
 
 namespace {
 
+// Append `count` elements of width `elem` from `src` as little-endian
+// wire bytes (the v2 binary-tensor convention): a plain memcpy on LE
+// hosts, a per-element byte swap on BE ones.
+void
+AppendLE(std::vector<uint8_t>& raw, const void* src, size_t elem,
+         size_t count)
+{
+  static const uint16_t probe = 1;
+  static const bool little =
+      *reinterpret_cast<const uint8_t*>(&probe) == 1;
+  const uint8_t* p = static_cast<const uint8_t*>(src);
+  size_t off = raw.size();
+  raw.resize(off + elem * count);
+  if (little) {
+    memcpy(raw.data() + off, p, elem * count);
+    return;
+  }
+  for (size_t i = 0; i < count; ++i) {
+    for (size_t b = 0; b < elem; ++b) {
+      raw[off + i * elem + b] = p[i * elem + (elem - 1 - b)];
+    }
+  }
+}
+
 // -- JSON <-> raw tensor conversion -----------------------------------------
 
 size_t
@@ -719,34 +743,47 @@ class TFServeGrpcBackend : public TFServeBackend {
             tensor.tensor_content().begin(), tensor.tensor_content().end());
       } else if (tensor.string_val_size() > 0) {
         for (const auto& element : tensor.string_val()) {
+          // 4-byte length prefix, explicitly little-endian (the v2
+          // BYTES wire format; a native-endian write would corrupt on
+          // big-endian hosts)
           uint32_t n = (uint32_t)element.size();
-          const uint8_t* np = reinterpret_cast<const uint8_t*>(&n);
+          uint8_t np[4] = {
+              (uint8_t)(n & 0xff), (uint8_t)((n >> 8) & 0xff),
+              (uint8_t)((n >> 16) & 0xff), (uint8_t)((n >> 24) & 0xff)};
           raw.insert(raw.end(), np, np + 4);
           raw.insert(raw.end(), element.begin(), element.end());
         }
       } else if (tensor.float_val_size() > 0) {
-        raw.resize(tensor.float_val_size() * 4);
-        memcpy(raw.data(), tensor.float_val().data(), raw.size());
+        AppendLE(raw, tensor.float_val().data(), 4,
+                 tensor.float_val_size());
       } else if (tensor.double_val_size() > 0) {
-        raw.resize(tensor.double_val_size() * 8);
-        memcpy(raw.data(), tensor.double_val().data(), raw.size());
+        AppendLE(raw, tensor.double_val().data(), 8,
+                 tensor.double_val_size());
       } else if (tensor.int_val_size() > 0) {
         // TensorProto packs every integer type <= 32 bits into
         // int_val; emit elements at the DECLARED dtype's width
-        // (DT_INT8=6 / DT_UINT8=4 -> 1 byte, DT_INT16=5 -> 2,
-        // else 4)
+        // (DT_INT8=6 / DT_UINT8=4 / DT_QINT8=11 / DT_QUINT8=12 -> 1
+        // byte, DT_INT16=5 / DT_UINT16=17 / DT_QINT16=15 /
+        // DT_QUINT16=16 -> 2, DT_INT32=3 / DT_UINT32 via its own
+        // field; anything else packed here is 4 bytes)
         const int dt = tensor.dtype();
-        const size_t width = (dt == 4 || dt == 6) ? 1
-                             : (dt == 5)          ? 2
-                                                  : 4;
+        const size_t width =
+            (dt == 4 || dt == 6 || dt == 11 || dt == 12) ? 1
+            : (dt == 5 || dt == 15 || dt == 16 || dt == 17)
+                ? 2
+                : 4;
         raw.resize(tensor.int_val_size() * width);
         for (int i = 0; i < tensor.int_val_size(); ++i) {
-          int32_t v = tensor.int_val(i);
-          memcpy(raw.data() + i * width, &v, width);
+          // explicit little-endian narrowing (memcpy of the native
+          // int32 would take the high-order bytes on big-endian hosts)
+          uint32_t v = (uint32_t)tensor.int_val(i);
+          for (size_t b = 0; b < width; ++b) {
+            raw[i * width + b] = (uint8_t)((v >> (8 * b)) & 0xff);
+          }
         }
       } else if (tensor.int64_val_size() > 0) {
-        raw.resize(tensor.int64_val_size() * 8);
-        memcpy(raw.data(), tensor.int64_val().data(), raw.size());
+        AppendLE(raw, tensor.int64_val().data(), 8,
+                 tensor.int64_val_size());
       } else if (tensor.bool_val_size() > 0) {
         raw.resize(tensor.bool_val_size());
         for (int i = 0; i < tensor.bool_val_size(); ++i) {
@@ -754,17 +791,17 @@ class TFServeGrpcBackend : public TFServeBackend {
         }
       } else if (tensor.half_val_size() > 0) {
         // half_val carries fp16 bit patterns in int32 slots
-        raw.resize(tensor.half_val_size() * 2);
+        raw.reserve(raw.size() + tensor.half_val_size() * 2);
         for (int i = 0; i < tensor.half_val_size(); ++i) {
           uint16_t bits = (uint16_t)tensor.half_val(i);
-          memcpy(raw.data() + i * 2, &bits, 2);
+          AppendLE(raw, &bits, 2, 1);
         }
       } else if (tensor.uint32_val_size() > 0) {
-        raw.resize(tensor.uint32_val_size() * 4);
-        memcpy(raw.data(), tensor.uint32_val().data(), raw.size());
+        AppendLE(raw, tensor.uint32_val().data(), 4,
+                 tensor.uint32_val_size());
       } else if (tensor.uint64_val_size() > 0) {
-        raw.resize(tensor.uint64_val_size() * 8);
-        memcpy(raw.data(), tensor.uint64_val().data(), raw.size());
+        AppendLE(raw, tensor.uint64_val().data(), 8,
+                 tensor.uint64_val_size());
       }
     }
     return tc::Error::Success;

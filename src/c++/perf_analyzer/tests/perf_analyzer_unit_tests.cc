@@ -2,6 +2,12 @@
 // server (role of the reference's doctest suite,
 // perf_analyzer_unit_tests.cc:37-39 + test_*.cc).
 
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
 #include <cmath>
 #include <cstdio>
 #include <cstring>
@@ -10,6 +16,7 @@
 #include "command_line_parser.h"
 #include "concurrency_manager.h"
 #include "inference_profiler.h"
+#include "metrics_manager.h"
 #include "mock_client_backend.h"
 #include "perf_analyzer.h"
 #include "report_writer.h"
@@ -517,6 +524,299 @@ TestProfilerEndToEndWithMock()
   CHECK(status.server_stats.inference_count > 0);
 }
 
+// -- stability determination (reference test_inference_profiler.cc:160-738)
+
+static ClientSideStats
+MakeWindow(double infer_per_sec, uint64_t stab_lat_ns)
+{
+  ClientSideStats w;
+  w.request_count = 100;
+  w.infer_per_sec = infer_per_sec;
+  w.avg_latency_ns = stab_lat_ns;
+  w.stability_latency_ns = stab_lat_ns;
+  return w;
+}
+
+static void
+TestDetermineStability()
+{
+  using IP = InferenceProfiler;
+  // fewer than 3 windows can never be stable
+  CHECK(!IP::DetermineStability({MakeWindow(100, 1000)}, 10.0));
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(100, 1000), MakeWindow(100, 1000)}, 10.0));
+  // three identical windows are stable
+  CHECK(IP::DetermineStability(
+      {MakeWindow(100, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000)},
+      10.0));
+  // oscillating throughput beyond +-10% is unstable even though the
+  // latency is rock solid (rate-unstable / latency-stable)
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(100, 1000), MakeWindow(130, 1000),
+       MakeWindow(100, 1000)},
+      10.0));
+  // latency oscillation with stable rate is equally unstable
+  // (latency-unstable / rate-stable)
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(100, 1000), MakeWindow(100, 1300),
+       MakeWindow(100, 1000)},
+      10.0));
+  // deviation is measured against the LAST window: drift that ends
+  // within threshold of the final value is stable
+  CHECK(IP::DetermineStability(
+      {MakeWindow(95, 1000), MakeWindow(98, 1020),
+       MakeWindow(100, 1000)},
+      10.0));
+  // boundary: exactly at the threshold passes (> rejects, not >=)
+  CHECK(IP::DetermineStability(
+      {MakeWindow(90, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000)},
+      10.0));
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(89, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000)},
+      10.0));
+  // only the last `window_count` windows matter: early chaos is fine
+  CHECK(IP::DetermineStability(
+      {MakeWindow(500, 9000), MakeWindow(5, 50), MakeWindow(100, 1000),
+       MakeWindow(100, 1000), MakeWindow(100, 1000)},
+      10.0));
+  // a tighter threshold rejects what a looser one accepts
+  CHECK(IP::DetermineStability(
+      {MakeWindow(95, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000)},
+      10.0));
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(95, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000)},
+      1.0));
+  // custom window_count: 4 windows must all agree
+  CHECK(!IP::DetermineStability(
+      {MakeWindow(130, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000), MakeWindow(100, 1000)},
+      10.0, 4));
+  CHECK(IP::DetermineStability(
+      {MakeWindow(100, 1000), MakeWindow(100, 1000),
+       MakeWindow(100, 1000), MakeWindow(100, 1000)},
+      10.0, 4));
+}
+
+// -- custom-interval manager (reference test_custom_load_manager.cc:108) ----
+
+static void
+TestCustomIntervalParsing()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 50});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  {
+    CustomLoadManager manager(backend, parser, config);
+    CHECK(manager.InitManager().IsOk());
+    // microsecond lines -> nanosecond schedule; blank lines skipped
+    CHECK(manager.InitCustomIntervals("1000\n2000\n\n1500\n").IsOk());
+    manager.StopWorkers();
+    const auto& sched = manager.Schedule();
+    CHECK(sched.size() == 3);
+    CHECK(sched[0] == 1000000ull);
+    CHECK(sched[1] == 2000000ull);
+    CHECK(sched[2] == 1500000ull);
+  }
+  {
+    CustomLoadManager manager(backend, parser, config);
+    CHECK(manager.InitManager().IsOk());
+    tc::Error err = manager.InitCustomIntervals("");
+    CHECK(!err.IsOk());
+    CHECK(err.Message().find("no intervals") != std::string::npos);
+  }
+}
+
+static void
+TestCustomIntervalsDriveSchedule()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 50});
+  auto parser = std::make_shared<ModelParser>();
+  CHECK(parser->Init(backend.get(), "mock", "").IsOk());
+  LoadManagerConfig config;
+  CustomLoadManager manager(backend, parser, config);
+  CHECK(manager.InitManager().IsOk());
+  // 2ms intervals -> ~500/sec; measure for 300ms -> ~150 requests
+  CHECK(manager.InitCustomIntervals("2000\n").IsOk());
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  manager.StopWorkers();
+  std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  auto records = manager.SwapRequestRecords();
+  CHECK(records.size() > 75);
+  CHECK(records.size() < 300);
+  // inter-request gaps should cluster near the 2ms interval: check the
+  // median gap lands in [1ms, 4ms] (scheduling jitter tolerated)
+  std::vector<uint64_t> starts;
+  for (const auto& r : records) {
+    starts.push_back(r.start_ns);
+  }
+  std::sort(starts.begin(), starts.end());
+  std::vector<uint64_t> gaps;
+  for (size_t i = 1; i < starts.size(); ++i) {
+    gaps.push_back(starts[i] - starts[i - 1]);
+  }
+  std::sort(gaps.begin(), gaps.end());
+  uint64_t median_gap = gaps[gaps.size() / 2];
+  CHECK(median_gap > 1000000ull);
+  CHECK(median_gap < 4000000ull);
+}
+
+// -- metrics manager (reference test_metrics_manager.cc:52,96) --------------
+
+static void
+TestMetricsManagerParse()
+{
+  const char* body =
+      "# HELP tpu_duty_cycle duty\n"
+      "# TYPE tpu_duty_cycle gauge\n"
+      "tpu_duty_cycle{chip=\"0\"} 87.5\n"
+      "nv_gpu_utilization 0.4\n"
+      "process_resident_memory_bytes 123456 1700000000000\n"
+      "garbage line without value\n"
+      "requests_total 42\n";
+  auto snap = ParsePrometheusText(body);
+  CHECK(snap.count("tpu_duty_cycle{chip=\"0\"}") == 1);
+  CHECK(std::fabs(snap["tpu_duty_cycle{chip=\"0\"}"] - 87.5) < 1e-9);
+  CHECK(std::fabs(snap["nv_gpu_utilization"] - 0.4) < 1e-9);
+  // trailing timestamp is stripped, value kept
+  CHECK(std::fabs(snap["process_resident_memory_bytes"] - 123456.0) < 1e-6);
+  CHECK(snap.count("requests_total") == 1);
+  // relevance filter: nv_/tpu_/process_ prefixes + utilization/memory/
+  // power/duty names are kept, plain counters are not
+  CHECK(IsRelevantMetric("nv_gpu_utilization"));
+  CHECK(IsRelevantMetric("tpu_duty_cycle{chip=\"0\"}"));
+  CHECK(IsRelevantMetric("process_resident_memory_bytes"));
+  CHECK(IsRelevantMetric("hbm_memory_used"));
+  CHECK(!IsRelevantMetric("requests_total"));
+}
+
+static void
+TestMetricsManagerScrapesRealEndpoint()
+{
+  // a minimal /metrics HTTP server on a loopback socket: two scrapes
+  // see different gauge values, the measurement average must combine
+  // them (reference test_metrics_manager.cc polling behavior)
+  int listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(listen_fd >= 0);
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  CHECK(bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) == 0);
+  CHECK(listen(listen_fd, 8) == 0);
+  socklen_t alen = sizeof(addr);
+  CHECK(getsockname(listen_fd, (sockaddr*)&addr, &alen) == 0);
+  int port = ntohs(addr.sin_port);
+  std::atomic<bool> server_exit{false};
+  std::atomic<int> served{0};
+  std::thread server([&]() {
+    while (!server_exit.load()) {
+      int fd = accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        break;
+      }
+      char buf[2048];
+      (void)!read(fd, buf, sizeof(buf));
+      double util = (served.load() == 0) ? 10.0 : 30.0;
+      char body[256];
+      snprintf(
+          body, sizeof(body),
+          "tpu_duty_cycle %.1f\nrequests_total 7\n", util);
+      char resp[512];
+      int n = snprintf(
+          resp, sizeof(resp),
+          "HTTP/1.1 200 OK\r\nContent-Type: text/plain\r\n"
+          "Content-Length: %zu\r\nConnection: close\r\n\r\n%s",
+          strlen(body), body);
+      (void)!write(fd, resp, n);
+      close(fd);
+      served++;
+    }
+  });
+  {
+    MetricsManager metrics(
+        "127.0.0.1:" + std::to_string(port) + "/metrics", 50);
+    CHECK(metrics.Start().IsOk());
+    // wait until a background scrape has actually been FOLDED into the
+    // accumulator (the served counter alone races the scraper thread's
+    // parse+merge)
+    auto avg = metrics.MeasurementAverages();
+    for (int i = 0; i < 120; ++i) {
+      avg = metrics.MeasurementAverages();
+      if (avg.count("tpu_duty_cycle") && avg["tpu_duty_cycle"] > 10.0) {
+        break;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(25));
+    }
+    CHECK(avg.count("tpu_duty_cycle") == 1);
+    // average of 10 (startup scrape) and >=1 folded poll at 30
+    CHECK(avg["tpu_duty_cycle"] > 10.0);
+    CHECK(avg["tpu_duty_cycle"] <= 30.0);
+    CHECK(served.load() >= 2);
+    // irrelevant counters are filtered out of the accumulator
+    CHECK(avg.count("requests_total") == 0);
+    // a new measurement discards history
+    metrics.StartNewMeasurement();
+    for (int i = 0; i < 40; ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(25));
+      if (metrics.MeasurementAverages().count("tpu_duty_cycle")) {
+        break;
+      }
+    }
+    auto avg2 = metrics.MeasurementAverages();
+    if (avg2.count("tpu_duty_cycle")) {
+      CHECK(std::fabs(avg2["tpu_duty_cycle"] - 30.0) < 1e-9);
+    }
+    metrics.Stop();
+  }
+  server_exit = true;
+  // unblock accept()
+  int poke = socket(AF_INET, SOCK_STREAM, 0);
+  connect(poke, (sockaddr*)&addr, sizeof(addr));
+  close(poke);
+  server.join();
+  close(listen_fd);
+  // failure path: nothing listening -> Start fails fast
+  MetricsManager dead("127.0.0.1:1/metrics", 50);
+  CHECK(!dead.Start().IsOk());
+}
+
+// -- count-window measurement mode + overhead accounting --------------------
+
+static void
+TestProfilerCountWindowsWithMock()
+{
+  auto backend = std::make_shared<MockClientBackend>(
+      MockClientBackend::Config{.response_delay_us = 500});
+  PerfAnalyzerParameters params;
+  params.model_name = "mock";
+  params.count_windows = true;  // reference --measurement-mode count
+  params.measurement_request_count = 30;
+  params.measurement_window_ms = 2000;  // backstop only
+  params.max_trials = 6;
+  params.stability_threshold_pct = 80.0;
+  PerfAnalyzer analyzer(params);
+  CHECK(analyzer.CreateAnalyzerObjects(backend).IsOk());
+  CHECK(analyzer.Profile().IsOk());
+  CHECK(analyzer.Results().size() == 1);
+  const auto& status = analyzer.Results()[0];
+  // each merged window waited for >=30 completions
+  CHECK(status.client_stats.request_count >= 30);
+  CHECK(status.client_stats.infer_per_sec > 0);
+  // concurrency-1 sync workers over a 500us mock: most wall-time is
+  // inside requests, so client overhead must be small
+  CHECK(status.client_stats.overhead_pct >= 0.0);
+  CHECK(status.client_stats.overhead_pct <= 100.0);
+}
+
 // -- report writer (reference test_report_writer.cc) ------------------------
 
 static void
@@ -561,6 +861,12 @@ main()
   TestRequestRateManagerAgainstMock();
   TestSequencesThroughManager();
   TestProfilerEndToEndWithMock();
+  TestDetermineStability();
+  TestCustomIntervalParsing();
+  TestCustomIntervalsDriveSchedule();
+  TestMetricsManagerParse();
+  TestMetricsManagerScrapesRealEndpoint();
+  TestProfilerCountWindowsWithMock();
   TestReportWriterCsv();
   printf("%d checks, %d failures\n", checks, failures);
   return failures == 0 ? 0 : 1;

@@ -591,15 +591,24 @@ class _DynamicBatcher:
                     k: v if isinstance(v, np.ndarray) else np.asarray(v)
                     for k, v in outputs.items()
                 }
+            # A max_batch_size>0 model's declared outputs always carry
+            # the batch dim (Triton config semantics), so split them by
+            # declaration — including ones the model returned un-padded
+            # (shape[0] == rows).  Undeclared extras have no spec to
+            # consult; they fall back to the padded-shape heuristic so
+            # a batch-shaped extra is still split per request (never
+            # replicated whole, which would leak other requests' rows).
+            declared = {t.name for t in self._model.outputs}
             offset = 0
             for slot in batch:
                 slot.outputs = {}
                 for name, arr in outputs.items():
-                    if (
-                        getattr(arr, "ndim", 0) >= 1
-                        and arr.shape[0] == padded
-                    ):
-                        if len(batch) == 1 and padded == slot.rows:
+                    ndim = getattr(arr, "ndim", 0)
+                    batched = ndim >= 1 and (
+                        name in declared or arr.shape[0] == padded
+                    )
+                    if batched and arr.shape[0] >= rows:
+                        if len(batch) == 1 and arr.shape[0] == slot.rows:
                             slot.outputs[name] = arr  # no split needed
                         else:
                             slot.outputs[name] = arr[
@@ -609,8 +618,21 @@ class _DynamicBatcher:
                         slot.outputs[name] = arr
                 offset += slot.rows
         except Exception as e:  # noqa: BLE001 — failure fans out per slot
+            # each waiting frontend thread raises its own slot.error;
+            # handing every slot the same instance would race the
+            # interpreter's __traceback__ mutation on concurrent raises.
+            # ValueError keeps the 400 the frontends would have mapped it
+            # to on the unbatched path; everything else is a server 500.
+            code = getattr(
+                e, "code", 400 if isinstance(e, ValueError) else 500
+            )
             for slot in batch:
-                slot.error = e
+                slot.error = ServerError(
+                    "batched execution failed for model '{}': {}".format(
+                        self._model.name, e
+                    ),
+                    code=code,
+                )
         finally:
             for slot in batch:
                 slot.event.set()
@@ -687,6 +709,8 @@ class InferenceServer:
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
         self._batchers = {}  # name -> _DynamicBatcher (lazily created)
+        self._closed = False
+        self._frontends = 0  # attached frontends; last detach closes
         self._sequence_state = {}  # (model, seq_id) -> (state, touched)
         self._last_sequence_sweep = 0.0
         self._trace_settings = {
@@ -1102,9 +1126,12 @@ class InferenceServer:
             raise
         except Exception as e:
             stats.record(0, 0, 0, 0, 0, ok=False)
+            # malformed tensors surface as ValueError from the model's
+            # numpy/jax ops: a client error (400), matching the batched
+            # path and the frontends' own ValueError mapping
             raise ServerError(
                 "inference failed for model '{}': {}".format(model.name, e),
-                code=500,
+                code=400 if isinstance(e, ValueError) else 500,
             )
         t_co0 = time.monotonic_ns()
         resp = self._make_response(model, request, outputs)
@@ -1149,17 +1176,46 @@ class InferenceServer:
         batcher = self._batchers.get(model.name)
         if batcher is None:
             with self._lock:
+                if self._closed:
+                    # a request racing close() must not lazily resurrect
+                    # a batcher whose stop() already ran
+                    raise ServerError("server is shutting down", code=503)
                 batcher = self._batchers.get(model.name)
                 if batcher is None:
                     batcher = _DynamicBatcher(model)
                     self._batchers[model.name] = batcher
         return batcher
 
+    def attach_frontend(self):
+        """Frontends register on start(); the last detach closes the
+        core's background workers, so frontend shutdown paths reach
+        batcher stop()/unload errors instead of leaking threads."""
+        with self._lock:
+            self._frontends += 1
+            self._closed = False  # re-attach after close re-opens
+
+    def detach_frontend(self):
+        to_stop = []
+        with self._lock:
+            self._frontends = max(0, self._frontends - 1)
+            if self._frontends == 0:
+                # decide AND mark closed under the same lock hold: a
+                # concurrent attach_frontend can only run before (it
+                # bumps the count, no close) or after (it re-opens and
+                # batchers lazily recreate) — never see a close land
+                # under a live attach
+                self._closed = True
+                to_stop, self._batchers = list(
+                    self._batchers.values()), {}
+        for b in to_stop:
+            b.stop()
+
     def close(self):
         """Stop background workers (dynamic batchers).  Safe to call
-        twice; batcher threads are daemons so skipping it only leaks
-        idle threads until process exit."""
+        twice; after close, batched inference is rejected rather than
+        lazily recreating workers."""
         with self._lock:
+            self._closed = True
             batchers, self._batchers = list(self._batchers.values()), {}
         for b in batchers:
             b.stop()

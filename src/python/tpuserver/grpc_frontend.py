@@ -526,6 +526,8 @@ class GrpcFrontend:
             "{}:{}".format(self._host, self._requested_port)
         )
         self._server.start()
+        self._core.attach_frontend()
+        self._attached = True
         return self
 
     @property
@@ -550,3 +552,9 @@ class GrpcFrontend:
                     "stay bound"
                 )
             self._server = None
+            if getattr(self, "_attached", False):
+                # only an attach that actually happened may detach: an
+                # unpaired detach would close a shared core under
+                # another live frontend
+                self._attached = False
+                self._core.detach_frontend()

@@ -578,6 +578,8 @@ class HttpFrontend:
             daemon=True,
         )
         self._thread.start()
+        self._core.attach_frontend()
+        self._attached = True
         return self
 
     def stop(self):
@@ -585,3 +587,9 @@ class HttpFrontend:
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            self._thread = None
+        if getattr(self, "_attached", False):
+            # only an attach that actually happened may detach (see
+            # grpc_frontend.stop)
+            self._attached = False
+            self._core.detach_frontend()

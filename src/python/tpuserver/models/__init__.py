@@ -34,7 +34,8 @@ def default_models():
 
 def serving_models(include_vision=True, include_bert=True,
                    include_llama=True, llama_cfg=None,
-                   llama_decode_chunk=None, llama_max_seq=512):
+                   llama_decode_chunk=None, llama_max_seq=512,
+                   llama_mesh=None, llama_quantize=False):
     """The heavyweight serving zoo for the BASELINE configs (#2-#5):
     ResNet-50 / DenseNet-121, the BERT ensemble, and decoupled llama
     generation.  Separate from ``default_models`` so unit tests stay fast."""
@@ -63,5 +64,6 @@ def serving_models(include_vision=True, include_bert=True,
 
         models.append(LlamaGenerateModel(
             cfg=llama_cfg, max_seq=llama_max_seq,
-            decode_chunk=llama_decode_chunk))
+            decode_chunk=llama_decode_chunk,
+            mesh=llama_mesh, quantize=llama_quantize))
     return models

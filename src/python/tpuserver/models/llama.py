@@ -53,12 +53,23 @@ class LlamaConfig:
     # prefill runs at 38-41% MFU vs 28-38% dense (~1.1-1.35x) — see
     # docs/benchmarking.md.
     attn_impl: str = "xla"
-    # single-query decode attention: "xla" or "pallas"
-    # (tpuserver.ops.decode_attention).  The Pallas kernel skips dead
-    # cache-tail blocks, winning ~2x when the valid prefix is a small
-    # fraction of max_seq; XLA's fused dense wins once the cache is
-    # mostly full.  Pick per deployment shape.
-    decode_impl: str = "xla"
+    # single-query decode attention: "auto" (default), "xla" or
+    # "pallas" (tpuserver.ops.decode_attention).  The Pallas kernel
+    # skips dead cache-tail blocks, winning up to ~10x when the valid
+    # prefix is a small fraction of max_seq; XLA's fused dense wins for
+    # short, mostly-full caches.  "auto" picks STATICALLY at trace time
+    # from the measured cost model (docs/benchmarking.md): the kernel
+    # when it wins for the majority of possible cache lengths, dense
+    # otherwise.  (A per-step lax.cond was measured and rejected: XLA
+    # cannot alias the KV cache through cond branches, and the copies
+    # collapsed long-context decode 3x — see bench_prefill_sweep.)
+    decode_impl: str = "auto"
+    # flash-kernel tile sizes (prefill): preferred tiles, tuned on v5e
+    # via tools/bench_prefill_sweep.py (256x512 = 55% MFU on the 3B at
+    # T=2048 vs 44% at 128x128); prompts not divisible by these fall
+    # back to 128-tiles, then to the dense path (_flash_blocks)
+    flash_block_q: int = 256
+    flash_block_k: int = 512
 
     @property
     def head_dim(self):
@@ -135,29 +146,118 @@ def init_params(key, cfg):
     }
 
 
-def param_specs(cfg):
+def param_specs(cfg, quantized=False, quantized_embed=False):
     """PartitionSpec pytree: Megatron split — qkv/gate/up column-parallel on
-    tp, o/down row-parallel; embeddings sharded on vocab."""
+    tp, o/down row-parallel; embeddings sharded on vocab.
+
+    With ``quantized=True`` the specs match the ``quantize_params`` tree:
+    each int8 weight keeps its bf16 spec and its per-output-channel scale
+    vector shards along the weight's sharded *output* dim (replicated for
+    row-parallel weights, whose outputs are unsharded).  Pass
+    ``quantized_embed=True`` iff ``quantize_params`` ran with
+    ``quantize_embed=True`` (its per-ROW scales shard with the vocab
+    rows)."""
+
+    def wspec(spec, out_axis_name):
+        if not quantized:
+            return spec
+        return {"q": spec, "s": P(out_axis_name)}
+
     layer = {
         "attn_norm": P(),
-        "wq": P(None, "tp"),
-        "wk": P(None, "tp"),
-        "wv": P(None, "tp"),
-        "wo": P("tp", None),
+        "wq": wspec(P(None, "tp"), "tp"),
+        "wk": wspec(P(None, "tp"), "tp"),
+        "wv": wspec(P(None, "tp"), "tp"),
+        "wo": wspec(P("tp", None), None),
         "mlp_norm": P(),
-        "w_gate": P(None, "tp"),
-        "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
+        "w_gate": wspec(P(None, "tp"), "tp"),
+        "w_up": wspec(P(None, "tp"), "tp"),
+        "w_down": wspec(P("tp", None), None),
     }
     return {
-        "embed": P("tp", None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "embed": (
+            {"q": P("tp", None), "s": P("tp")}
+            if quantized and quantized_embed
+            else P("tp", None)
+        ),
+        "layers": [
+            {k: (dict(v) if isinstance(v, dict) else v)
+             for k, v in layer.items()}
+            for _ in range(cfg.n_layers)
+        ],
         "norm": P(),
-        "lm_head": P(None, "tp"),
+        "lm_head": wspec(P(None, "tp"), "tp"),
     }
+
+
+def quantize_params(params, quantize_embed=False):
+    """Int8-quantize the serving weights (per-output-channel scales).
+
+    Layer matmul weights and ``lm_head`` go int8 (~2x HBM shrink — what
+    fits the 8B preset's 16 GB of bf16 weights into a single v5e);
+    norms stay bf16.  ``embed`` is a row gather, not a matmul; it stays
+    bf16 by default for exact lookups (pass ``quantize_embed=True`` to
+    shrink it too).
+    """
+    from tpuserver.ops import quant
+
+    out = {
+        "embed": (
+            quant.quantize_int8(params["embed"], axis=1)
+            if quantize_embed
+            else params["embed"]
+        ),
+        "norm": params["norm"],
+        "lm_head": quant.quantize_int8(params["lm_head"], axis=0),
+        "layers": [],
+    }
+    for layer in params["layers"]:
+        out["layers"].append(
+            {
+                "attn_norm": layer["attn_norm"],
+                "mlp_norm": layer["mlp_norm"],
+                "wq": quant.quantize_int8(layer["wq"], axis=0),
+                "wk": quant.quantize_int8(layer["wk"], axis=0),
+                "wv": quant.quantize_int8(layer["wv"], axis=0),
+                "wo": quant.quantize_int8(layer["wo"], axis=0),
+                "w_gate": quant.quantize_int8(layer["w_gate"], axis=0),
+                "w_up": quant.quantize_int8(layer["w_up"], axis=0),
+                "w_down": quant.quantize_int8(layer["w_down"], axis=0),
+            }
+        )
+    return out
 
 
 # -- kernels -----------------------------------------------------------------
+
+
+def _flash_blocks(T, cfg):
+    """Largest usable (block_q, block_k) for a length-T flash prefill:
+    the preferred (tuned) tile when T divides by it, else 128-tiles,
+    else None (caller falls back to dense attention)."""
+    bq = next(
+        (b for b in (cfg.flash_block_q, 128) if b <= T and T % b == 0),
+        None,
+    )
+    bk = next(
+        (b for b in (cfg.flash_block_k, 256, 128)
+         if b <= T and T % b == 0),
+        None,
+    )
+    return bq, bk
+
+
+def _mm(x, w):
+    """Matmul against a plain or int8-quantized weight leaf."""
+    from tpuserver.ops import quant
+
+    return quant.matmul(x, w)
+
+
+def _embed_rows(params, tokens):
+    from tpuserver.ops import quant
+
+    return quant.gather_rows(params["embed"], tokens)
 
 
 def _rms_norm(x, w, eps):
@@ -205,16 +305,16 @@ def _block(params, x, positions, cfg, attn_fn, n_heads=None, n_kv_heads=None,
     nkv = n_kv_heads if n_kv_heads is not None else cfg.n_kv_heads
     red = reduce if reduce is not None else (lambda y: y)
     h = _rms_norm(x, params["attn_norm"], cfg.norm_eps)
-    q = (h @ params["wq"]).reshape(B, T, nh, hd)
-    k = (h @ params["wk"]).reshape(B, T, nkv, hd)
-    v = (h @ params["wv"]).reshape(B, T, nkv, hd)
+    q = _mm(h, params["wq"]).reshape(B, T, nh, hd)
+    k = _mm(h, params["wk"]).reshape(B, T, nkv, hd)
+    v = _mm(h, params["wv"]).reshape(B, T, nkv, hd)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     attn = attn_fn(q, k, v)
-    x = x + red(attn.reshape(B, T, nh * hd) @ params["wo"])
+    x = x + red(_mm(attn.reshape(B, T, nh * hd), params["wo"]))
     h = _rms_norm(x, params["mlp_norm"], cfg.norm_eps)
-    gated = jax.nn.silu(h @ params["w_gate"]) * (h @ params["w_up"])
-    return x + red(gated @ params["w_down"])
+    gated = jax.nn.silu(_mm(h, params["w_gate"])) * _mm(h, params["w_up"])
+    return x + red(_mm(gated, params["w_down"]))
 
 
 def forward(params, tokens, cfg):
@@ -225,7 +325,8 @@ def forward(params, tokens, cfg):
     positions = jnp.arange(T)
 
     def attn_fn(q, k, v):
-        if cfg.attn_impl == "pallas" and T % 128 == 0:
+        bq, bk = _flash_blocks(T, cfg)
+        if cfg.attn_impl == "pallas" and bq is not None and bk is not None:
             # MXU-tileable lengths only: the TPU lowering needs
             # (8, 128)-aligned blocks; other lengths fall through to
             # the dense path below
@@ -233,17 +334,17 @@ def forward(params, tokens, cfg):
 
             return flash_attention(
                 q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
-                causal=True, block_q=128, block_k=128,
+                causal=True, block_q=bq, block_k=bk,
             )
         return ring_attention(
             q, _expand_kv(k, n_rep), _expand_kv(v, n_rep), causal=True
         )
 
-    x = params["embed"][tokens]
+    x = _embed_rows(params, tokens)
     for layer in params["layers"]:
         x = _block(layer, x, positions, cfg, attn_fn)
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    return _mm(x, params["lm_head"]).astype(jnp.float32)
 
 
 def sharded_forward(mesh, cfg):
@@ -378,6 +479,53 @@ def init_kv_cache(cfg, batch, max_seq, dtype=None):
     )
 
 
+def decode_crossover_length(max_seq):
+    """Valid-prefix length below which the Pallas decode-attention kernel
+    beats dense XLA attention against a cache padded to ``max_seq``.
+
+    Cost model fitted to the measured table in docs/benchmarking.md
+    (v5e, llama3-class head geometry): dense reads the whole padded
+    cache every token — ~16.5 ns/key at S=2k degrading to ~62 ns/key at
+    S=32k as its MBU collapses — while the kernel's length-clamped index
+    map costs ~4.6 µs fixed + ~24.7 µs per 1024 *valid* keys.  Returns
+    <= 0 when dense always wins, >= max_seq when Pallas always wins.
+    """
+    pts = ((2048, 16.5), (8192, 18.7), (32768, 61.8))
+    if max_seq <= pts[0][0]:
+        ns_per_key = pts[0][1]
+    elif max_seq >= pts[-1][0]:
+        ns_per_key = pts[-1][1]
+    else:
+        ns_per_key = pts[0][1]
+        for (s0, n0), (s1, n1) in zip(pts, pts[1:]):
+            if s0 <= max_seq <= s1:
+                ns_per_key = n0 + (n1 - n0) * (max_seq - s0) / (s1 - s0)
+                break
+    dense_us = max_seq * ns_per_key / 1000.0
+    return int((dense_us - 4.6) / (24.7 / 1024.0))
+
+
+def _select_decode_impl(max_seq, lengths):
+    """Trace-time selection for ``decode_impl="auto"``.
+
+    Static only: a per-step ``lax.cond`` on the live length was measured
+    on v5e and rejected — XLA cannot donate/alias the KV cache through
+    cond branches, so every step paid cache copies and long-context
+    decode collapsed ~3x (70.8 -> 23.1 tokens/sec at ctx 2176).  With a
+    static ``lengths`` the crossover applies exactly; otherwise the
+    kernel is chosen when it wins for the MAJORITY of possible cache
+    lengths (a serving request sweeps lengths upward, so the majority
+    rule tracks the time-averaged cost)."""
+    cross = decode_crossover_length(max_seq)
+    if cross <= 0:
+        return "xla"
+    if cross >= max_seq:
+        return "pallas"
+    if isinstance(lengths, (int, np.integer)):
+        return "pallas" if int(lengths) < cross else "xla"
+    return "pallas" if cross >= max_seq // 2 else "xla"
+
+
 def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
     """Shared decode/prefill body: run all blocks, writing new K/V into the
     cache at ``write_pos`` and attending over cache[:lengths].
@@ -405,8 +553,11 @@ def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
             pallas_block = next(
                 (b for b in (256, 128) if max_seq % b == 0), None
             )
+            impl = cfg.decode_impl
+            if impl == "auto" and q.shape[1] == 1:
+                impl = _select_decode_impl(max_seq, lengths)
             if (
-                cfg.decode_impl == "pallas"
+                impl == "pallas"
                 and q.shape[1] == 1
                 and pallas_block is not None
             ):
@@ -427,10 +578,12 @@ def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
                     block_k=pallas_block,
                 )
                 return out[:, None]
+            pf_bq, pf_bk = _flash_blocks(q.shape[1], cfg)
             if (
                 cfg.attn_impl == "pallas"
                 and q.shape[1] > 1
-                and q.shape[1] % 128 == 0
+                and pf_bq is not None
+                and pf_bk is not None
                 and isinstance(write_pos, int)
                 and write_pos == 0
             ):
@@ -444,7 +597,7 @@ def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
 
                 return flash_attention(
                     q, _expand_kv(k, n_rep), _expand_kv(v, n_rep),
-                    causal=True, block_q=128, block_k=128,
+                    causal=True, block_q=pf_bq, block_k=pf_bk,
                 )
             return _attend_cached(
                 q, new_cache[i, 0], new_cache[i, 1], positions, lengths,
@@ -482,12 +635,12 @@ def decode_step(params, cache, tokens, pos, cfg):
     """
     B = tokens.shape[0]
     positions = jnp.full((B, 1), pos)
-    x = params["embed"][tokens][:, None, :]  # [B, 1, Dm]
+    x = _embed_rows(params, tokens)[:, None, :]  # [B, 1, Dm]
     x, new_cache = _run_cached(
         params, cache, x, positions, pos, pos + 1, cfg
     )
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = (x[:, 0, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -499,10 +652,10 @@ def prefill(params, cache, tokens, cfg):
     dynamic_update_slice per layer (not T sequential steps)."""
     B, T = tokens.shape
     positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
-    x = params["embed"][tokens]
+    x = _embed_rows(params, tokens)
     x, new_cache = _run_cached(params, cache, x, positions, 0, T, cfg)
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = (x[:, -1, :] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
     return logits, new_cache
 
 
@@ -545,7 +698,7 @@ def cache_spec(cfg):
     return P(None, None, None, None, "tp", None)
 
 
-def make_tp_serving(mesh, cfg, chunk=8, donate=True):
+def make_tp_serving(mesh, cfg, chunk=8, donate=True, quantized=False):
     """Tensor-parallel prefill + chunked decode over a mesh's ``tp`` axis.
 
     Where training uses an explicit ``shard_map`` (psums spelled out),
@@ -570,11 +723,9 @@ def make_tp_serving(mesh, cfg, chunk=8, donate=True):
                 tp, cfg.n_heads, cfg.n_kv_heads
             )
         )
-    param_sh = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), param_specs(cfg)
+    param_sh, cache_sh, repl = serving_shardings(
+        mesh, cfg, quantized=quantized
     )
-    cache_sh = NamedSharding(mesh, cache_spec(cfg))
-    repl = NamedSharding(mesh, P())
 
     prefill_fn = jax.jit(
         functools.partial(prefill, cfg=cfg),
@@ -594,3 +745,33 @@ def make_tp_serving(mesh, cfg, chunk=8, donate=True):
         )
 
     return init_cache, prefill_fn, decode_fn
+
+
+def serving_shardings(mesh, cfg, quantized=False, quantized_embed=False):
+    """(param_sh, cache_sh, repl) NamedSharding trees for TP serving —
+    the single source shared by ``make_tp_serving``, ``make_tp_step``
+    and the serving model's ``device_put`` of loaded params."""
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        param_specs(
+            cfg, quantized=quantized, quantized_embed=quantized_embed
+        ),
+    )
+    cache_sh = NamedSharding(mesh, cache_spec(cfg))
+    repl = NamedSharding(mesh, P())
+    return param_sh, cache_sh, repl
+
+
+def make_tp_step(mesh, cfg, donate=True, quantized=False):
+    """Single-token tensor-parallel ``decode_step`` (same sharding rules
+    as ``make_tp_serving``) — the per-token path serving uses for chunk
+    tails and for feeding resumed-prompt tokens into a parked cache."""
+    param_sh, cache_sh, repl = serving_shardings(
+        mesh, cfg, quantized=quantized
+    )
+    return jax.jit(
+        functools.partial(decode_step, cfg=cfg),
+        in_shardings=(param_sh, cache_sh, repl, repl),
+        out_shardings=(repl, cache_sh),
+        donate_argnums=(1,) if donate else (),
+    )

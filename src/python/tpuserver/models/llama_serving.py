@@ -1,18 +1,30 @@
 """Decoupled llama generation serving model (BASELINE config #5: token-by-
 token generate streaming with TPU-shm KV handles).
 
-One request carries the prompt ids; the model prefillls the KV cache in one
+One request carries the prompt ids; the model prefills the KV cache in one
 batched pass, then streams one sampled token per response over the
 decoupled channel (ModelStreamInfer).  Generation runs as a jitted
 decode_step per token — static shapes, cache donated, so steady-state cost
 is one device dispatch per token.
 
+Execution modes:
+
+- **single-device** (default): plain jits on the default device.
+- **tensor-parallel** (``mesh=`` with a ``tp`` axis): the same compute
+  via ``llama.make_tp_serving`` — Megatron column/row-split weights,
+  kv-head-sharded cache (``llama.cache_spec``), XLA-inserted collectives.
+  The served model IS the sharded jit; no separate "distributed backend".
+- **int8 weights** (``quantize=True``): weights quantize on load
+  (``llama.quantize_params``) so the 8B preset (16 GB bf16) serves within
+  a single 16 GB-HBM v5e chip.
+
 KV-cache persistence: a request parameter ``kv_cache_region`` naming a
 registered XLA shared-memory region makes the model park the finished KV
-cache (a device-resident ``jax.Array``) in that region and, on a follow-up
-request with the same parameter and ``kv_cache_resume=True``, continue
-generation from it without re-prefilling — the TPU-shm analogue of the
-reference's CUDA-shm tensor passing, applied to generation state.
+cache (a device-resident ``jax.Array`` — sharded across the mesh in tp
+mode) in that region and, on a follow-up request with the same parameter
+and ``kv_cache_resume=True``, continue generation from it without
+re-prefilling — the TPU-shm analogue of the reference's CUDA-shm tensor
+passing, applied to generation state.
 """
 
 import threading
@@ -48,10 +60,12 @@ class LlamaGenerateModel(Model):
     decode_chunk = 8
 
     def __init__(self, cfg=None, max_seq=512, server=None,
-                 decode_chunk=None):
+                 decode_chunk=None, mesh=None, quantize=False):
         self._cfg = cfg or llama.tiny(vocab=2048)
         self._max_seq = max_seq
         self._server = server  # for kv_cache_region xla-shm lookups
+        self._mesh = mesh  # tensor-parallel serving when set (tp axis)
+        self._quantize = bool(quantize)
         self._params = None
         self._prefill = None
         self._decode = None
@@ -62,6 +76,10 @@ class LlamaGenerateModel(Model):
                     "decode_chunk must be >= 1 (got {})".format(
                         decode_chunk))
             self.decode_chunk = decode_chunk
+        if mesh is not None and "tp" not in mesh.shape:
+            raise ValueError(
+                "llama serving mesh needs a 'tp' axis (got {})".format(
+                    dict(mesh.shape)))
         self._lock = threading.Lock()
 
     def attach_server(self, server):
@@ -76,22 +94,66 @@ class LlamaGenerateModel(Model):
 
                 import jax
 
-                self._params = llama.init_params(
-                    jax.random.PRNGKey(0), self._cfg
-                )
-                self._prefill = jax.jit(
-                    functools.partial(llama.prefill, cfg=self._cfg)
-                )
-                self._decode = jax.jit(
-                    functools.partial(llama.decode_step, cfg=self._cfg),
-                    donate_argnums=(1,),
-                )
-                self._decode_chunk = jax.jit(
-                    functools.partial(
-                        llama.decode_chunk, cfg=self._cfg,
-                        chunk=self.decode_chunk),
-                    donate_argnums=(1,),
-                )
+                if self._quantize:
+                    # quantize-on-load: init + quantize on HOST so the
+                    # bf16 weights never exist in HBM — the point for
+                    # the 8B preset, whose 16 GB of bf16 exceeds a v5e
+                    # chip but whose ~8 GB int8 form fits
+                    cpu = jax.devices("cpu")[0]
+                    with jax.default_device(cpu):
+                        params = llama.quantize_params(
+                            llama.init_params(
+                                jax.random.PRNGKey(0), self._cfg
+                            )
+                        )
+                    if self._mesh is None:
+                        params = jax.device_put(
+                            params, jax.devices()[0])
+                else:
+                    params = llama.init_params(
+                        jax.random.PRNGKey(0), self._cfg
+                    )
+                if self._mesh is not None:
+                    init_cache, prefill_fn, chunk_fn = (
+                        llama.make_tp_serving(
+                            self._mesh, self._cfg,
+                            chunk=self.decode_chunk,
+                            quantized=self._quantize,
+                        )
+                    )
+                    step_fn = llama.make_tp_step(
+                        self._mesh, self._cfg,
+                        quantized=self._quantize,
+                    )
+                    param_sh, _, _ = llama.serving_shardings(
+                        self._mesh, self._cfg, quantized=self._quantize
+                    )
+                    params = jax.device_put(params, param_sh)
+                    self._init_cache = (
+                        lambda: init_cache(1, self._max_seq)
+                    )
+                    self._prefill = prefill_fn
+                    self._decode = step_fn
+                    self._decode_chunk = chunk_fn
+                else:
+                    self._init_cache = lambda: llama.init_kv_cache(
+                        self._cfg, 1, self._max_seq
+                    )
+                    self._prefill = jax.jit(
+                        functools.partial(llama.prefill, cfg=self._cfg)
+                    )
+                    self._decode = jax.jit(
+                        functools.partial(
+                            llama.decode_step, cfg=self._cfg),
+                        donate_argnums=(1,),
+                    )
+                    self._decode_chunk = jax.jit(
+                        functools.partial(
+                            llama.decode_chunk, cfg=self._cfg,
+                            chunk=self.decode_chunk),
+                        donate_argnums=(1,),
+                    )
+                self._params = params
 
     def warmup(self):
         self._ensure_compiled()
@@ -139,7 +201,7 @@ class LlamaGenerateModel(Model):
                 cache = jnp.copy(parked)
                 pos = int(request.parameters["kv_cache_position"])
         if cache is None:
-            cache = llama.init_kv_cache(self._cfg, 1, self._max_seq)
+            cache = self._init_cache()
             pos = 0
         if pos + len(prompt) + max_tokens > self._max_seq:
             raise ValueError(
@@ -161,35 +223,83 @@ class LlamaGenerateModel(Model):
                 )
                 pos += 1
 
+        # Software-pipelined emission: decode chunks are CHAINED on
+        # device (each consumes the previous dispatch's logits/cache
+        # futures), so the device→host fetch of chunk i overlaps chunk
+        # i+1's compute — a remote chip's dispatch/fence round trip is
+        # paid once, not per chunk.  The first token is fetched straight
+        # from the prefill logits (a tiny argmax dispatched BEFORE the
+        # first chunk), so time-to-first-token is prefill + one round
+        # trip instead of prefill + a whole chunk.
+        from collections import deque
+
         emitted = 0
+        dispatched = 0
+        inflight = deque()  # (tokens, logps, count, skip_first) device/host
+
+        if max_tokens >= self.decode_chunk:
+            # early first token: argmax of the prefill logits, dispatched
+            # ahead of chunk 0 so it never waits behind chunk compute
+            early_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            early_lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                early_tok[:, None], axis=-1)[:, 0]
+            tokens_dev, logps_dev, logits, cache = self._decode_chunk(
+                self._params, cache, logits, pos
+            )
+            pos += self.decode_chunk
+            dispatched += self.decode_chunk
+            # chunk 0's tokens[0] IS the early token; skip it on fetch
+            inflight.append((tokens_dev, logps_dev,
+                             self.decode_chunk - 1, True))
+            t0, l0 = jax.device_get((early_tok, early_lp))
+            yield {
+                "TOKEN": np.array([t0[0]], dtype=np.int32),
+                "LOGPROB": np.array([l0[0]], dtype=np.float32),
+            }
+            emitted += 1
+
         while emitted < max_tokens:
-            n = min(self.decode_chunk, max_tokens - emitted)
-            if n == self.decode_chunk:
-                # full chunk: one dispatch greedy-decodes chunk tokens
-                tokens_dev, logps_dev, logits, cache = self._decode_chunk(
-                    self._params, cache, logits, pos
-                )
+            # keep one chunk computing behind the one being fetched
+            while dispatched < max_tokens and len(inflight) < 2:
+                n = min(self.decode_chunk, max_tokens - dispatched)
+                if n == self.decode_chunk:
+                    tokens_dev, logps_dev, logits, cache = (
+                        self._decode_chunk(
+                            self._params, cache, logits, pos)
+                    )
+                    pos += n
+                    dispatched += n
+                    inflight.append((tokens_dev, logps_dev, n, False))
+                else:
+                    # tail shorter than the compiled chunk: per-token
+                    # steps (host-driven, so values are already local)
+                    tokens_host = np.empty((n,), np.int32)
+                    logps_host = np.empty((n,), np.float32)
+                    for i in range(n):
+                        logp = jax.nn.log_softmax(logits, axis=-1)
+                        token = jnp.argmax(
+                            logits, axis=-1).astype(jnp.int32)
+                        tokens_host[i] = int(token[0])
+                        logps_host[i] = float(logp[0, tokens_host[i]])
+                        if i + 1 < n or region is not None:
+                            logits, cache = self._decode(
+                                self._params, cache, token, pos
+                            )
+                            pos += 1
+                    dispatched += n
+                    inflight.append((tokens_host, logps_host, n, False))
+            tokens_res, logps_res, n, skip_first = inflight.popleft()
+            if isinstance(tokens_res, np.ndarray):
+                tokens_host, logps_host = tokens_res, logps_res
+            else:
                 # one device->host transfer for both arrays: on remote
                 # chips each fetch costs a full round trip
                 tokens_all, logps_all = jax.device_get(
-                    (tokens_dev, logps_dev))
-                tokens_host = tokens_all[:, 0]
-                logps_host = logps_all[:, 0]
-                pos += n
-            else:
-                # tail shorter than the compiled chunk: per-token steps
-                tokens_host = np.empty((n,), np.int32)
-                logps_host = np.empty((n,), np.float32)
-                for i in range(n):
-                    logp = jax.nn.log_softmax(logits, axis=-1)
-                    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    tokens_host[i] = int(token[0])
-                    logps_host[i] = float(logp[0, tokens_host[i]])
-                    if i + 1 < n or region is not None:
-                        logits, cache = self._decode(
-                            self._params, cache, token, pos
-                        )
-                        pos += 1
+                    (tokens_res, logps_res))
+                start = 1 if skip_first else 0
+                tokens_host = tokens_all[start:, 0]
+                logps_host = logps_all[start:, 0]
             for i in range(n):
                 yield {
                     "TOKEN": np.array([tokens_host[i]], dtype=np.int32),
@@ -199,5 +309,6 @@ class LlamaGenerateModel(Model):
 
         if region is not None:
             # park the device-resident cache in the XLA region (zero-copy
-            # in-process; host-staged cross-process)
+            # in-process; host-staged cross-process).  In tp mode the
+            # parked array stays sharded across the mesh.
             region.put_device_array(0, cache)

@@ -77,10 +77,14 @@ def _attn_kernel(
 
     @pl.when(live)
     def _fold():
-        q = q_ref[:].astype(jnp.float32) * scale
-        k = k_ref[:].astype(jnp.float32)
-        v = v_ref[:].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # keep the matmul operands in the INPUT dtype: bf16 x bf16 with
+        # fp32 accumulation is the MXU's native full-rate mode — an
+        # explicit fp32 upcast before the dot would halve the peak.
+        # The softmax state stays fp32 (preferred_element_type).
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -89,7 +93,9 @@ def _attn_kernel(
             s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
         _online_softmax_fold(
             s, m_scr, l_scr, acc_scr,
-            lambda p: jnp.dot(p, v, preferred_element_type=jnp.float32))
+            lambda p: jnp.dot(
+                p.astype(v.dtype), v,
+                preferred_element_type=jnp.float32))
 
     @pl.when(ki == nk - 1)
     def _finish():

@@ -94,16 +94,35 @@ def prefill_flops(cfg, seq_len):
     return matmul + attn
 
 
-def decode_bytes_per_token(cfg, ctx_len, dtype_bytes=2):
+def decode_bytes_per_token(cfg, ctx_len, dtype_bytes=2,
+                           weight_bytes_per_param=None):
     """HBM bytes touched to decode one token: every matmul weight is
     read once, the valid KV prefix is read, and one KV row is written.
     (The decode roofline — at batch 1 this is bandwidth-bound, so
     tokens/sec * bytes/token vs peak bandwidth is the honest
-    utilization number.)"""
-    weights = matmul_params(cfg) * dtype_bytes
+    utilization number.)  ``weight_bytes_per_param`` overrides the
+    weight-read cost (1 for int8-quantized serving; KV stays
+    ``dtype_bytes``)."""
+    wb = (
+        weight_bytes_per_param
+        if weight_bytes_per_param is not None
+        else dtype_bytes
+    )
+    weights = matmul_params(cfg) * wb
     kv_row = 2 * cfg.n_kv_heads * cfg.head_dim * dtype_bytes
     kv = cfg.n_layers * kv_row * (ctx_len + 1)
     return weights + kv
+
+
+def bert_encoder_flops(seq_len=128, d_model=768, n_layers=12, d_ff=3072):
+    """Forward FLOPs of one BERT-base-shaped encoder pass (the config-4
+    ensemble's device stage): per layer 4 attention projections + the
+    2 MLP matmuls (2*m*n*k each) + QK^T/PV attention, plus the pooler."""
+    per_layer = (
+        2 * seq_len * (4 * d_model * d_model + 2 * d_model * d_ff)
+        + 4 * seq_len * seq_len * d_model
+    )
+    return n_layers * per_layer + 2 * d_model * d_model
 
 
 def mfu(flops, seconds, spec):

@@ -1,0 +1,70 @@
+"""Int8 weight-only quantization for serving (bf16 activations).
+
+The capability that lets the BASELINE.md-named flagship (Llama-3-8B,
+16 GB of bf16 weights) serve on a single 16 GB-HBM v5e chip: weights are
+stored int8 with per-output-channel symmetric scales (~8 GB), activations
+stay bf16, and each matmul upcasts its weight tile in-register — XLA
+fuses the ``convert`` into the dot so HBM traffic is the int8 bytes, not
+a dequantized copy.  This is the TPU-native analogue of the GPU serving
+stacks' W8A16 path; the reference client repo has no counterpart (it
+measures servers; this repo also has to *be* one).
+
+Quantized tensors are plain pytree dicts ``{"q": int8[...,-1],
+"s": f32[out]}`` so they ride jit/sharding like any other param leaf.
+"""
+
+import jax.numpy as jnp
+
+
+def quantize_int8(w, axis=0):
+    """Per-output-channel symmetric int8 quantization of a 2-D weight.
+
+    ``axis`` is the *reduction* (input) axis — scales are computed per
+    channel of the other (output) axis, so the matmul result can be
+    rescaled per output column with one broadcast multiply.
+    Returns ``{"q": int8, "s": float32[out]}``.
+    """
+    if w.ndim != 2:
+        raise ValueError(
+            "quantize_int8 expects a 2-D weight, got shape {}".format(
+                tuple(w.shape)
+            )
+        )
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.reshape(-1).astype(jnp.float32)}
+
+
+def is_quantized(w):
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def matmul(x, w):
+    """``x @ w`` for a plain or int8-quantized weight.
+
+    For quantized weights the int8 tile upcasts to the activation dtype
+    inside the fused dot (HBM reads stay int8) and the per-channel scale
+    applies to the f32-accumulated result.
+    """
+    if not is_quantized(w):
+        return x @ w
+    y = x @ w["q"].astype(x.dtype)
+    return (y * w["s"].astype(x.dtype)).astype(x.dtype)
+
+
+def gather_rows(w, idx):
+    """Row gather (embedding lookup) from a plain or per-row-quantized
+    table (``quantize_int8(w, axis=1)``: one scale per row)."""
+    if not is_quantized(w):
+        return w[idx]
+    rows = w["q"][idx].astype(jnp.bfloat16)
+    return rows * w["s"][idx].astype(jnp.bfloat16)[..., None]
+
+
+def quantized_bytes(w):
+    """HBM bytes a (possibly quantized) weight leaf occupies."""
+    if is_quantized(w):
+        return w["q"].size + w["s"].size * 4
+    return w.size * w.dtype.itemsize

@@ -128,6 +128,7 @@ CC_EXAMPLES = [
     ("simple_grpc_async_infer_client", "grpc", "async infer OK", []),
     ("simple_grpc_shm_client", "grpc", "shm infer OK", []),
     ("simple_grpc_xlashm_client", "grpc", "xla shm infer OK", []),
+    ("simple_http_xlashm_client", "http", "xla shm infer OK", []),
     ("simple_grpc_string_infer_client", "grpc", "string infer OK", []),
     ("simple_http_string_infer_client", "http", "string infer OK", []),
     ("simple_grpc_health_metadata", "grpc", "health metadata OK", []),

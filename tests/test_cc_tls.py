@@ -196,3 +196,14 @@ def test_plain_http_still_works(http_server):
     port = int(http_server.url.rsplit(":", 1)[1])
     r = _run("http-noverify", "http://localhost:{}".format(port))
     assert r.returncode == 0, r.stderr
+
+
+def test_https_ip_literal_endpoint_verified(tls_http):
+    """Connecting by IP literal with full verification: RFC 6066 says no
+    SNI for IPs, and hostname verification must match the cert's
+    iPAddress SAN (IP:127.0.0.1) via X509_VERIFY_PARAM_set1_ip_asc —
+    SSL_set1_host alone would only consult dNSName entries and fail."""
+    proxy, crt = tls_http
+    r = _run("http", "https://127.0.0.1:{}".format(proxy.port), crt)
+    assert r.returncode == 0, r.stderr
+    assert "TLS_SMOKE_OK" in r.stdout

@@ -241,3 +241,80 @@ def test_llama_train_step_ulysses_matches_ring():
     # bf16 params + different softmax accumulation orders: the two
     # exact-attention strategies agree to bf16 noise, not exactly
     assert abs(ring_loss - ulysses_loss) < 5e-3, (ring_loss, ulysses_loss)
+
+
+# -- decode_impl="auto" selection (shape-driven, no operator knob) -----------
+
+
+def test_decode_crossover_static_extremes():
+    # tiny caches: dense always wins -> static "xla"
+    assert llama.decode_crossover_length(64) <= 0
+    assert llama._select_decode_impl(64, None) == "xla"
+    # huge caches: the kernel's dead-block skipping always wins
+    assert llama.decode_crossover_length(32768) >= 32768
+    assert llama._select_decode_impl(32768, None) == "pallas"
+    # midsize: STATIC majority rule (a per-step lax.cond was measured
+    # and rejected — cache copies through cond branches)
+    cross = llama.decode_crossover_length(512)
+    assert 0 < cross < 512
+    assert llama._select_decode_impl(512, None) == (
+        "pallas" if cross >= 256 else "xla"
+    )
+    # serving-shaped cache: kernel wins the majority of lengths
+    assert llama.decode_crossover_length(3072) >= 3072 // 2
+    assert llama._select_decode_impl(3072, None) == "pallas"
+    # static lengths resolve exactly at the crossover
+    assert llama._select_decode_impl(512, cross - 1) == "pallas"
+    assert llama._select_decode_impl(512, cross) == "xla"
+
+
+def test_decode_auto_matches_xla():
+    """The auto selection must be a pure performance choice: greedy
+    tokens identical to the dense XLA path regardless of which impl it
+    statically picks for this shape."""
+    import dataclasses
+    import functools
+
+    cfg = llama.tiny(vocab=512)
+    params = llama.init_params(jax.random.PRNGKey(3), cfg)
+    for max_seq in (512, 1024):
+        toks = jax.random.randint(
+            jax.random.PRNGKey(max_seq), (1, 8), 0, cfg.vocab, jnp.int32
+        )
+        outs = {}
+        for impl in ("xla", "auto"):
+            c = dataclasses.replace(cfg, decode_impl=impl)
+            pf = jax.jit(functools.partial(llama.prefill, cfg=c))
+            dc = jax.jit(
+                functools.partial(llama.decode_chunk, cfg=c, chunk=4)
+            )
+            cache = llama.init_kv_cache(c, 1, max_seq)
+            logits, cache = pf(params, cache, toks)
+            t, _, _, _ = dc(params, cache, logits, 8)
+            outs[impl] = np.asarray(t).ravel()
+        np.testing.assert_array_equal(outs["auto"], outs["xla"])
+
+
+def test_quantized_embed_specs_match_tree():
+    """param_specs(quantized=True, quantized_embed=True) must mirror the
+    quantize_params(quantize_embed=True) tree (review finding: the embed
+    leaf used to stay a bare spec and break device_put)."""
+    cfg = llama.tiny(vocab=512)
+    params = llama.quantize_params(
+        llama.init_params(jax.random.PRNGKey(0), cfg), quantize_embed=True
+    )
+    specs = llama.param_specs(cfg, quantized=True, quantized_embed=True)
+    s_tree = jax.tree_util.tree_structure(params)
+    p_tree = jax.tree_util.tree_structure(specs)
+    assert s_tree == p_tree
+    if len(jax.devices()) >= 4:
+        mesh = make_mesh(MeshConfig(dp=1, sp=1, tp=4), jax.devices()[:4])
+        param_sh, _, _ = llama.serving_shardings(
+            mesh, cfg, quantized=True, quantized_embed=True
+        )
+        sharded = jax.device_put(params, param_sh)
+        rows = {
+            s.data.shape[0]
+            for s in sharded["embed"]["q"].addressable_shards
+        }
+        assert rows == {cfg.vocab // 4}
