@@ -187,6 +187,42 @@ def _vision_call_system_shm(client, grpcclient, model, imgs):
     return call, cleanup
 
 
+
+def _park_distinct_pool(xshm, h_in, rng, slots, img_shape, img_bytes):
+    """Fresh distinct images into every input slot (untimed; rule 1)."""
+    import jax.numpy as jnp
+
+    pool = rng.rand(slots, *img_shape).astype(np.float32)
+    for s in range(slots):
+        xshm.set_shared_memory_region(
+            h_in, [jnp.asarray(pool[s])], offset=s * img_bytes)
+    return pool
+
+
+def _fence_and_verify(xshm, h_out, out_shape, out_bytes, slots, sample_ids,
+                      refs):
+    """Window close (rule 2): value-fence the LAST slot (device
+    executions retire in dispatch order, so its values prove the whole
+    window completed on-device), then — when references are given —
+    check sampled slots against their own input's in-band result and
+    require bit-level distinctness between samples (a replayed/cached
+    answer would be bit-identical)."""
+    last = xshm.get_contents_as_numpy(
+        h_out, np.float32, out_shape, offset=(slots - 1) * out_bytes)
+    assert last.shape == tuple(out_shape)
+    if refs is None:
+        return
+    checked = []
+    for s in sample_ids:
+        got = xshm.get_contents_as_numpy(
+            h_out, np.float32, out_shape, offset=s * out_bytes)
+        np.testing.assert_allclose(got, refs[s], rtol=2e-2, atol=2e-3)
+        checked.append(got)
+    for a, b in zip(checked, checked[1:]):
+        assert (np.asarray(a) != np.asarray(b)).any(), \
+            "distinct inputs produced bit-identical outputs"
+
+
 def bench_vision_xla_shm(grpc_url, config, model, windows, infers_per_window,
                          concurrency=8, batch=1):
     """Hygienic XLA-shm vision bench (the north-star rows).
@@ -233,12 +269,8 @@ def bench_vision_xla_shm(grpc_url, config, model, windows, infers_per_window,
     sample_ids = sorted({0, slots // 2, slots - 1})
 
     def park_pool():
-        """Fresh distinct images into every input slot (untimed)."""
-        pool = rng.rand(slots, *img_shape).astype(np.float32)
-        for s in range(slots):
-            xshm.set_shared_memory_region(
-                h_in, [jnp.asarray(pool[s])], offset=s * img_bytes)
-        return pool
+        return _park_distinct_pool(
+            xshm, h_in, rng, slots, img_shape, img_bytes)
 
     def reference_logits(pool):
         """In-band results for the sampled slots (untimed, pre-window):
@@ -285,34 +317,10 @@ def bench_vision_xla_shm(grpc_url, config, model, windows, infers_per_window,
                 issue(next_slot)
                 next_slot += 1
                 inflight += 1
-        # value fence: the LAST slot's output, fetched as numpy values.
-        # Device executions retire in dispatch order, so this read
-        # proves every dispatch in the window completed on-device.
-        last = xshm.get_contents_as_numpy(
-            h_out, np.float32, [batch, 1000],
-            offset=(slots - 1) * out_bytes)
-        assert last.shape == (batch, 1000)
-        dt = time.perf_counter() - t0
-        if timed:
-            # post-clock correctness: sampled slots must equal their
-            # own input's in-band result (distinct inputs -> distinct
-            # logits, so a cached/skipped dispatch cannot pass)
-            checked = []
-            for s in sample_ids:
-                got = xshm.get_contents_as_numpy(
-                    h_out, np.float32, [batch, 1000],
-                    offset=s * out_bytes)
-                np.testing.assert_allclose(
-                    got, refs[s], rtol=2e-2, atol=2e-3)
-                checked.append(got)
-            for a, b in zip(checked, checked[1:]):
-                # bit-level inequality: an untrained net contracts
-                # distinct inputs to very close logits, but a replayed/
-                # cached answer would be bit-IDENTICAL — any differing
-                # bit proves the dispatches were distinct computations
-                assert (np.asarray(a) != np.asarray(b)).any(), \
-                    "distinct inputs produced bit-identical outputs"
-        return slots * batch / dt
+        _fence_and_verify(
+            xshm, h_out, [batch, 1000], out_bytes, slots, sample_ids,
+            refs if timed else None)
+        return slots * batch / (time.perf_counter() - t0)
 
     try:
         # setup inside the try: a failed register must still release
@@ -887,10 +895,8 @@ def bench_vision_core(window_s, windows, infers_per_window=128):
     rng = np.random.RandomState(77)
     try:
         def run_window(timed):
-            pool = rng.rand(slots, 1, 224, 224, 3).astype(np.float32)
-            for s in range(slots):
-                xshm.set_shared_memory_region(
-                    h_in, [jnp.asarray(pool[s])], offset=s * img_bytes)
+            pool = _park_distinct_pool(
+                xshm, h_in, rng, slots, (1, 224, 224, 3), img_bytes)
             sample = sorted({0, slots // 2, slots - 1})
             refs = {
                 s: np.asarray(
@@ -913,19 +919,9 @@ def bench_vision_core(window_s, windows, infers_per_window=128):
             t0 = time.perf_counter()
             for req in shm_reqs:
                 core.infer(req)
-            last = xshm.get_contents_as_numpy(
-                h_out, np.float32, [1, 1000],
-                offset=(slots - 1) * out_bytes)
-            assert last.shape == (1, 1000)
-            dt = time.perf_counter() - t0
-            if timed:
-                for s in sample:
-                    got = xshm.get_contents_as_numpy(
-                        h_out, np.float32, [1, 1000],
-                        offset=s * out_bytes)
-                    np.testing.assert_allclose(
-                        got, refs[s], rtol=2e-2, atol=2e-3)
-            return slots / dt
+            _fence_and_verify(
+                xshm, h_out, [1, 1000], out_bytes, slots, sample, refs)
+            return slots / (time.perf_counter() - t0)
 
         run_window(timed=False)
         rates = [run_window(timed=True) for _ in range(windows)]

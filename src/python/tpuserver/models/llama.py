@@ -49,9 +49,11 @@ class LlamaConfig:
     # single-shard prefill/forward attention: "xla" (compiler-fused
     # dense) or "pallas" (the hand-tiled flash kernel,
     # tpuserver.ops.flash_attention; needs T divisible by its block
-    # sizes).  Measured on v5e at T=2048 on the 3B preset the flash
-    # prefill runs at 38-41% MFU vs 28-38% dense (~1.1-1.35x) — see
-    # docs/benchmarking.md.
+    # sizes, falling back to dense otherwise).  Measured on v5e at
+    # T=2048 on the 3B preset: flash (bf16 operands, 256x512 tiles)
+    # prefills at 55% MFU vs 39% dense — see docs/benchmarking.md.
+    # The real-size presets default to "pallas"; "xla" here keeps the
+    # tiny test config on the portable dense path.
     attn_impl: str = "xla"
     # single-query decode attention: "auto" (default), "xla" or
     # "pallas" (tpuserver.ops.decode_attention).  The Pallas kernel
@@ -77,7 +79,7 @@ class LlamaConfig:
 
 
 def llama3_8b():
-    return LlamaConfig()
+    return LlamaConfig(attn_impl="pallas")
 
 
 def llama3_3b():
@@ -87,6 +89,7 @@ def llama3_3b():
     weights alone would not).  The single-chip serving flagship."""
     return LlamaConfig(
         d_model=3072, n_layers=28, n_heads=24, n_kv_heads=8, d_ff=8192,
+        attn_impl="pallas",
     )
 
 
@@ -94,6 +97,7 @@ def llama3_1b():
     """Llama-3.2-1B shapes (untied head): ~1.5B params ≈ 3 GB bf16."""
     return LlamaConfig(
         d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8, d_ff=8192,
+        attn_impl="pallas",
     )
 
 

@@ -1,0 +1,26 @@
+#!/bin/bash
+# One dated recapture of every headline number (docs/benchmarking.md
+# round-5 table), run sequentially so no two jobs contend for the chip.
+# Usage: bash tools/recapture_r5.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-bench_results_r5}"
+mkdir -p "$OUT"
+date -u +"%Y-%m-%dT%H:%M:%SZ" > "$OUT/STARTED"
+
+run() {
+  name="$1"; shift
+  echo "=== $name: $*" | tee -a "$OUT/log.txt"
+  "$@" > "$OUT/$name.jsonl" 2> >(grep -v WARNING >> "$OUT/log.txt")
+  echo "=== $name exit=$?" | tee -a "$OUT/log.txt"
+}
+
+run kernels      python tools/bench_kernels.py
+run sweep_3b     python tools/bench_prefill_sweep.py --config llama3_3b --decode-only
+run config5_3b   python bench_full.py --configs 5 --llama-config llama3_3b
+run config5_8b   python bench_full.py --configs 5 --llama-config llama3_8b --llama-quantize
+run config23     python bench_full.py --configs 2,3
+run config4      python bench_full.py --configs 4
+run config1      python bench_full.py --configs 1
+run bench_native python bench.py
+date -u +"%Y-%m-%dT%H:%M:%SZ" > "$OUT/FINISHED"
