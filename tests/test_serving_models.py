@@ -201,3 +201,42 @@ def test_llama_chunked_decode_matches_per_token():
     with pytest.raises(ValueError):
         LlamaGenerateModel(
             cfg=llama_mod.tiny(vocab=256), decode_chunk=0)
+
+
+def test_llama_generate_pipelined_emission_boundaries():
+    """The software-pipelined emission (chunks chained on device, first
+    token fetched from prefill logits) must produce exactly max_tokens
+    tokens and the SAME tokens for every max_tokens around the chunk
+    boundary — prefixes of one greedy sequence."""
+    from tpuserver.models import llama
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=64, decode_chunk=4)
+    core = InferenceServer([model])
+    prompt = np.array([9, 8, 7, 6], dtype=np.int32)
+
+    def generate(n):
+        req = InferRequest(
+            "llama_generate",
+            inputs={
+                "PROMPT_IDS": prompt,
+                "MAX_TOKENS": np.array([n], dtype=np.int32),
+            },
+        )
+        toks = []
+        for resp in core.infer_stream(req):
+            _, tok = _out(resp, "TOKEN")
+            _, logp = _out(resp, "LOGPROB")
+            toks.append(int(tok[0]))
+            assert logp[0] <= 0.0
+        return toks
+
+    # chunk=4: tail-only (3), exactly one chunk (4), chunk+tail (5),
+    # early+two chunks (8), and deep into the pipeline (11)
+    seqs = {n: generate(n) for n in (3, 4, 5, 8, 11)}
+    for n, toks in seqs.items():
+        assert len(toks) == n, (n, toks)
+    longest = seqs[11]
+    for n, toks in seqs.items():
+        assert toks == longest[:n], (n, toks, longest)
