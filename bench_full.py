@@ -807,7 +807,7 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
     m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
     m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
 
-    def generate(park, seed):
+    def generate(park, seed, timeout_s=300):
         # rule 1/4: a distinct prompt per call — an identical prompt
         # would make the whole greedy generation an identical
         # (executable, values) replay a transport could cache
@@ -823,7 +823,7 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
             "llama_generate", [p_in, m_in],
             enable_empty_final_response=True, parameters=params)
         while True:
-            result, error = responses.get(timeout=300)
+            result, error = responses.get(timeout=timeout_s)
             assert error is None, error
             resp = result.get_response()
             if resp.parameters.get(
@@ -836,7 +836,9 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
         return n / (time.perf_counter() - t0), first
 
     try:
-        generate(False, 0)  # compile/warmup
+        # warmup: big presets lazily init+quantize on ONE host core
+        # before their first compile — minutes before the first token
+        generate(False, 0, timeout_s=1800)
         rates, ttfts = [], []
         for w in range(windows):
             r, ttft = generate(True, 1 + w)
@@ -949,6 +951,10 @@ def main():
         help="config-5 prefill attention (pallas = the flash kernel, "
              "~10x the dense prefill at T=2048 on v5e)")
     ap.add_argument(
+        "--llama-stream-only", action="store_true",
+        help="config 5: skip the model-level direct bench (rerun only "
+             "the served decoupled-stream measurement)")
+    ap.add_argument(
         "--llama-quantize", action="store_true",
         help="config-5 int8 weight-only quantization (what fits the "
              "8B preset on one 16 GB v5e chip)")
@@ -977,7 +983,7 @@ def main():
     from tpuserver.models import default_models, serving_models
 
     failures = []
-    if 5 in wanted:
+    if 5 in wanted and not args.llama_stream_only:
         # model-level numbers first: the params/cache used here are
         # freed before the serving zoo loads its own copy
         try:
