@@ -1021,6 +1021,14 @@ def main():
             llama_quantize=args.llama_quantize,
         )
     core = InferenceServer(models)
+    if 5 in wanted:
+        # the llama serving model lazily inits (and for --llama-quantize,
+        # quantizes on the single host core — tens of minutes for the 8B
+        # preset) inside its FIRST request; warm it eagerly so the
+        # stream bench's response timeout covers only compiles
+        for m in models:
+            if getattr(m, "name", "") == "llama_generate":
+                m.warmup()
     http = HttpFrontend(core, port=0).start()
     grpc_f = GrpcFrontend(core, port=0).start()
     grpc_url = "127.0.0.1:{}".format(grpc_f.port)
