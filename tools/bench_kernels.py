@@ -101,6 +101,11 @@ def _time_scanned(step, make_input, n_lo, n_hi, repeats=3):
     best = None
     for r in range(repeats):
         x = make_input(r)
+        # the input's host->device upload must complete BEFORE the
+        # clock: an MB-scale operand's upload otherwise lands inside
+        # t_lo only (the hi run reuses the resident buffer), making
+        # t_hi < t_lo and the difference meaningless
+        jax.block_until_ready(x)
         t0 = time.perf_counter()
         run(f_lo, x)
         t_lo = time.perf_counter() - t0
