@@ -1,0 +1,98 @@
+"""Unit tests for the int8 serving quantization ops
+(tpuserver/ops/quant.py): per-channel weight quantization accuracy, the
+decode-scale upcast path vs the prefill-scale W8A8 path (and the static
+shape threshold between them), embedding row gathers, and byte
+accounting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuserver.ops import quant
+
+
+@pytest.fixture(scope="module")
+def weight():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(
+        rng.standard_normal((64, 48)).astype(np.float32) * 0.05,
+        jnp.bfloat16,
+    )
+
+
+def test_quantize_int8_roundtrip_error(weight):
+    q = quant.quantize_int8(weight, axis=0)
+    assert q["q"].dtype == jnp.int8
+    assert q["s"].shape == (48,)
+    deq = np.asarray(q["q"], np.float32) * np.asarray(q["s"])[None, :]
+    w = np.asarray(weight, np.float32)
+    # symmetric per-channel int8: worst-case error is half a step
+    step = np.asarray(q["s"])[None, :]
+    assert np.all(np.abs(deq - w) <= step * 0.5 + 1e-7)
+
+
+def test_quantize_int8_rejects_non_2d():
+    with pytest.raises(ValueError, match="2-D"):
+        quant.quantize_int8(jnp.zeros((4,), jnp.bfloat16))
+
+
+def test_matmul_decode_scale_accuracy(weight):
+    """Few activation rows -> the bandwidth-oriented upcast path."""
+    q = quant.quantize_int8(weight, axis=0)
+    x = jnp.asarray(
+        np.random.RandomState(1).standard_normal((1, 64)), jnp.bfloat16)
+    ref = np.asarray(x @ weight, np.float32)
+    got = np.asarray(quant.matmul(x, q), np.float32)
+    assert got.dtype == np.float32 and quant.matmul(x, q).dtype == x.dtype
+    err = np.abs(got - ref).max()
+    assert err <= 0.08 * max(np.abs(ref).max(), 1e-3)
+
+
+def test_matmul_w8a8_prefill_scale_accuracy(weight):
+    """>= 8 rows -> dynamic per-row activation quantization + int8 dot."""
+    q = quant.quantize_int8(weight, axis=0)
+    x = jnp.asarray(
+        np.random.RandomState(2).standard_normal((3, 16, 64)),
+        jnp.bfloat16)
+    ref = np.asarray(
+        x.astype(jnp.float32) @ weight.astype(jnp.float32), np.float32)
+    got = np.asarray(quant.matmul(x, q), np.float32)
+    assert got.shape == (3, 16, 48)
+    rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-3)
+    assert rel <= 0.05, rel
+
+
+def test_matmul_threshold_is_static_row_count(weight):
+    """The W8A8/upcast split keys on the activation's row dimension:
+    identical inputs padded across the threshold must both stay close
+    to the bf16 reference (the regimes differ only in rounding)."""
+    q = quant.quantize_int8(weight, axis=0)
+    rng = np.random.RandomState(3)
+    small = jnp.asarray(rng.standard_normal((7, 64)), jnp.bfloat16)
+    big = jnp.concatenate([small, small[:1]], axis=0)  # 8 rows
+    ref_small = np.asarray(small @ weight, np.float32)
+    ref_big = np.asarray(big @ weight, np.float32)
+    got_small = np.asarray(quant.matmul(small, q), np.float32)
+    got_big = np.asarray(quant.matmul(big, q), np.float32)
+    for got, ref in ((got_small, ref_small), (got_big, ref_big)):
+        rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-3)
+        assert rel <= 0.08, rel
+
+
+def test_gather_rows_per_row_scales(weight):
+    table = quant.quantize_int8(weight, axis=1)  # per-row scales
+    idx = jnp.asarray([0, 5, 5, 63], jnp.int32)
+    got = np.asarray(quant.gather_rows(table, idx), np.float32)
+    ref = np.asarray(weight, np.float32)[np.asarray(idx)]
+    assert np.abs(got - ref).max() <= 0.02 * max(np.abs(ref).max(), 1e-3)
+    # plain tables pass through untouched
+    np.testing.assert_array_equal(
+        np.asarray(quant.gather_rows(weight, idx)),
+        np.asarray(weight[idx]))
+
+
+def test_quantized_bytes(weight):
+    q = quant.quantize_int8(weight, axis=0)
+    assert quant.quantized_bytes(q) == 64 * 48 + 48 * 4
+    assert quant.quantized_bytes(weight) == 64 * 48 * 2
