@@ -858,6 +858,144 @@ def bench_llama_stream(grpc_url, windows, max_tokens=64):
                  max_tokens=max_tokens)
 
 
+def bench_llama_multistream(grpc_url, cfg_name, windows, stream_counts,
+                            max_tokens=64, quantize=False):
+    """Config-5 continuous-batching rows: sustained generation over N
+    CONCURRENT decoupled streams (each its own gRPC connection), against
+    a server running the scheduler (``--llama-slots >= max(streams)``).
+
+    Reports per concurrency level: **aggregate tok/s** (total tokens
+    over the round's wall clock — the serving-throughput number the
+    scheduler exists to lift), per-stream p50 tok/s (what one client
+    feels), median TTFT, and MBU with the weight stream amortized over
+    the batch (one batched decode step reads the weights ONCE for all
+    active slots: bytes/step = weights + N * kv_row, steps/sec =
+    aggregate / N).
+
+    Hygiene: every stream in every round carries a DISTINCT prompt
+    (rule 1/4); token counts are exact (value-fenced by construction —
+    each counted token arrived as a decoupled response's VALUES); one
+    full warmup round at max concurrency runs before timing (rule 5).
+    """
+    import queue
+    import threading
+
+    import tritonclient.grpc as grpcclient
+
+    from tpuserver.models import llama as llama_mod
+    from tpuserver.ops import perf
+
+    cfg = (
+        getattr(llama_mod, cfg_name)()
+        if cfg_name != "tiny" else llama_mod.tiny(vocab=2048)
+    )
+    spec = perf.chip_spec()
+    seed_counter = [0]
+
+    def one_stream(seed, n_tokens, out, barrier):
+        client = grpcclient.InferenceServerClient(grpc_url)
+        done = queue.Queue()
+        client.start_stream(lambda result, error: done.put((result, error)))
+        try:
+            prompt = np.random.RandomState(seed).randint(
+                1, 2000, (8,)).astype(np.int32)
+            p_in = grpcclient.InferInput("PROMPT_IDS", [len(prompt)],
+                                         "INT32")
+            p_in.set_data_from_numpy(prompt)
+            m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+            m_in.set_data_from_numpy(np.array([n_tokens], dtype=np.int32))
+            barrier.wait(timeout=600)
+            t0 = time.perf_counter()
+            client.async_stream_infer(
+                "llama_generate", [p_in, m_in],
+                enable_empty_final_response=True)
+            n, first = 0, None
+            while True:
+                result, error = done.get(timeout=1800)
+                assert error is None, repr(error)
+                resp = result.get_response()
+                final = resp.parameters.get("triton_final_response")
+                if final and final.bool_param:
+                    break
+                if first is None:
+                    first = time.perf_counter() - t0
+                n += 1
+            out.append((n, time.perf_counter() - t0, first))
+        finally:
+            client.stop_stream(cancel_requests=True)
+            client.close()
+
+    def run_round(conc, n_tokens):
+        out = []
+        barrier = threading.Barrier(conc + 1)
+        # seeds assigned BEFORE spawning: rule 1's distinct-prompt
+        # guarantee must not depend on thread interleaving
+        threads = []
+        for _ in range(conc):
+            seed_counter[0] += 1
+            threads.append(threading.Thread(
+                target=one_stream,
+                args=(seed_counter[0], n_tokens, out, barrier)))
+        for t in threads:
+            t.start()
+        barrier.wait(timeout=600)
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert len(out) == conc, "a stream died"
+        total = sum(n for n, _, _ in out)
+        assert total == conc * n_tokens, (total, conc, n_tokens)
+        return total / wall, out
+
+    lines = []
+    # warmup at max concurrency: compiles (prefill at this prompt len,
+    # the batched step) land before any timed round
+    run_round(max(stream_counts), min(8, max_tokens))
+    for conc in stream_counts:
+        rates, per_stream, ttfts = [], [], []
+        for _ in range(windows):
+            agg, out = run_round(conc, max_tokens)
+            rates.append(agg)
+            per_stream.extend(n / dt for n, dt, _ in out)
+            ttfts.extend(f for _, _, f in out if f is not None)
+        per_stream.sort()
+        ttfts.sort()
+        agg = statistics.median(rates)
+        mbu_val = None
+        if spec is not None:
+            # one batched step serves `conc` tokens: weights stream once
+            wb = 1 if quantize else 2
+            ctx = 8 + max_tokens // 2
+            kv_per_tok = perf.decode_bytes_per_token(
+                cfg, ctx, weight_bytes_per_param=wb
+            ) - perf.matmul_params(cfg) * wb
+            bytes_per_sec = (
+                agg / conc * perf.matmul_params(cfg) * wb
+                + agg * kv_per_tok
+            )
+            mbu_val = perf.mbu(bytes_per_sec, 1.0, spec)
+        lines.append(_emit(
+            5, "llama_multistream_conc{}".format(conc), agg,
+            "tokens/sec", None,
+            streams=conc,
+            per_stream_p50=round(per_stream[len(per_stream) // 2], 2),
+            ttft_ms=round(ttfts[len(ttfts) // 2] * 1e3, 1)
+            if ttfts else None,
+            mbu=round(mbu_val, 4) if mbu_val is not None else None,
+            max_tokens=max_tokens,
+        ))
+    if len(lines) > 1:
+        print(json.dumps({
+            "config": 5, "metric": "llama_multistream_scaling",
+            "value": round(lines[-1]["value"] / lines[0]["value"], 3),
+            "unit": "x", "vs_baseline": None,
+            "streams": "{}->{}".format(
+                lines[0]["streams"], lines[-1]["streams"]),
+        }), flush=True)
+    return lines
+
+
 def bench_vision_core(window_s, windows, infers_per_window=128):
     """Config-2 data-plane comparison at the server core (no sockets):
     in-band numpy input vs device-parked XLA-shm inputs with shm-
@@ -964,6 +1102,13 @@ def main():
              "one v5e chip's 16 GB HBM in bf16; llama3_1b / tiny for "
              "smoke runs)")
     ap.add_argument(
+        "--llama-slots", type=int, default=1,
+        help="config-5 continuous-batching slots (1 = the original "
+             "single-stream path, byte-for-byte; >1 serves generations "
+             "through the batched decode scheduler and adds the "
+             "multi-stream sustained-generation rows at 1/4/8 "
+             "concurrent streams)")
+    ap.add_argument(
         "--core-only", action="store_true",
         help="config-2 data-plane comparison at the server core "
              "(no sockets; isolates the host<->device traffic)")
@@ -1019,6 +1164,7 @@ def main():
             llama_cfg=llama_cfg,
             llama_decode_chunk=8 if args.quick else 32,
             llama_quantize=args.llama_quantize,
+            llama_max_slots=args.llama_slots,
         )
     core = InferenceServer(models)
     if 5 in wanted:
@@ -1086,6 +1232,20 @@ def main():
                                    max_tokens=16 if args.quick else 64)
             except Exception as e:
                 failures.append((5, e))
+            if args.llama_slots > 1:
+                # continuous-batching rows: aggregate tok/s at 1/4/8
+                # concurrent streams (clipped to the slot count)
+                try:
+                    bench_llama_multistream(
+                        grpc_url, args.llama_config,
+                        2 if args.quick else 3,
+                        stream_counts=[
+                            c for c in (1, 4, 8) if c <= args.llama_slots
+                        ],
+                        max_tokens=16 if args.quick else 64,
+                        quantize=args.llama_quantize)
+                except Exception as e:
+                    failures.append((5, e))
     finally:
         grpc_f.stop()
         http.stop()

@@ -599,6 +599,23 @@ class _DynamicBatcher:
             # a batch-shaped extra is still split per request (never
             # replicated whole, which would leak other requests' rows).
             declared = {t.name for t in self._model.outputs}
+            for name, arr in outputs.items():
+                if name not in declared:
+                    continue
+                ndim = getattr(arr, "ndim", 0)
+                if ndim < 1 or arr.shape[0] not in (rows, padded):
+                    # a misdeclared un-batched output (e.g. [1000] class
+                    # scores for a 3-row batch) must fail loudly — the
+                    # declaration-driven split would otherwise slice it
+                    # into wrong per-request rows
+                    raise ValueError(
+                        "declared output '{}' of model '{}' must carry "
+                        "the batch dim (shape[0] in ({}, {})), got shape "
+                        "{}".format(
+                            name, self._model.name, rows, padded,
+                            tuple(getattr(arr, "shape", ())),
+                        )
+                    )
             offset = 0
             for slot in batch:
                 slot.outputs = {}
@@ -765,9 +782,32 @@ class InferenceServer:
     def requires_stream_order(self, name, version=""):
         """Whether stream requests to this model must execute in arrival
         order: decoupled response bursts are contractual, and sequence
-        state depends on step order."""
+        state depends on step order.
+
+        Continuous-batching decoupled models (``concurrent_decoupled``,
+        e.g. the llama scheduler with ``max_slots > 1``) opt OUT of
+        per-stream serialization: their whole point is that many
+        generations run interleaved on the chip, each response carrying
+        its request id so clients demultiplex."""
         model = self._get_model(name, version)
-        return bool(model.decoupled or model.sequence)
+        if model.sequence:
+            return True
+        if model.decoupled:
+            return not getattr(model, "concurrent_decoupled", False)
+        return False
+
+    def is_concurrent_decoupled(self, name, version=""):
+        """Whether this model runs decoupled requests interleaved (the
+        continuous-batching scheduler).  Such requests self-limit via
+        the model's slot count, so stream frontends must not cap them
+        with their own in-flight bound — a long-lived generation would
+        otherwise starve the scheduler of work it has slots for."""
+        model = self._models.get(name)
+        return bool(
+            model is not None
+            and model.decoupled
+            and getattr(model, "concurrent_decoupled", False)
+        )
 
     def model_ready(self, name, version=""):
         model = self._models.get(name)
@@ -1211,14 +1251,19 @@ class InferenceServer:
             b.stop()
 
     def close(self):
-        """Stop background workers (dynamic batchers).  Safe to call
-        twice; after close, batched inference is rejected rather than
+        """Stop background workers (dynamic batchers, and any model-owned
+        schedulers via the model's own ``close``).  Safe to call twice;
+        after close, batched/scheduled inference is rejected rather than
         lazily recreating workers."""
         with self._lock:
             self._closed = True
             batchers, self._batchers = list(self._batchers.values()), {}
         for b in batchers:
             b.stop()
+        for model in list(self._models.values()):
+            closer = getattr(model, "close", None)
+            if callable(closer):
+                closer()
 
     def _execute_sequence(self, model, inputs, request):
         if request.sequence_id == 0:

@@ -351,8 +351,17 @@ class _CoreBridge:
         Non-decoupled requests execute concurrently (bounded) and their
         responses interleave in completion order — each response carries
         its request id, matching server stream semantics.  Decoupled
-        requests keep strict sequential handling: their multi-response
-        ordering is part of the model's contract.
+        requests keep strict sequential handling by default: their
+        multi-response ordering is part of the model's contract.
+
+        Continuous-batching decoupled models (``concurrent_decoupled``,
+        e.g. llama with ``max_slots > 1``) are the exception the core
+        reports via ``requires_stream_order``: their stream requests run
+        concurrently like unary ones, so several generations submitted
+        on ONE bidi stream decode interleaved on the chip — each slot's
+        per-step token fans out as a response tagged with its request id
+        and the client demultiplexes.  Within one generation, token
+        order is still the emission order of its scheduler slot.
         """
         import queue as _queue
         import threading as _threading
@@ -385,7 +394,7 @@ class _CoreBridge:
                 if pending[0] == 0 and done_feeding.is_set():
                     emit(_SENTINEL)
 
-        def run_one(core_request):
+        def run_one(core_request, bounded=True):
             try:
                 for resp in self._core.infer_stream(core_request):
                     if cancelled.is_set() or not context.is_active():
@@ -399,7 +408,8 @@ class _CoreBridge:
                 emit(pb.ModelStreamInferResponse(
                     error_message="unexpected error: {}".format(e)))
             finally:
-                inflight.release()
+                if bounded:
+                    inflight.release()
                 finish_one()
 
         def feed():
@@ -416,9 +426,18 @@ class _CoreBridge:
                     try:
                         ordered = self._core.requires_stream_order(
                             core_request.model_name)
+                        unbounded = self._core.is_concurrent_decoupled(
+                            core_request.model_name)
                     except Exception:
                         ordered = False
-                    inflight.acquire()
+                        unbounded = False
+                    if not unbounded:
+                        # scheduler-backed generations self-limit via
+                        # their slot count; holding a semaphore slot for
+                        # a whole generation would cap one client stream
+                        # at STREAM_CONCURRENCY regardless of max_slots
+                        # AND stall this feed loop behind it
+                        inflight.acquire()
                     with lock:
                         pending[0] += 1
                     if ordered:
@@ -427,7 +446,8 @@ class _CoreBridge:
                         run_one(core_request)
                     else:
                         _threading.Thread(
-                            target=run_one, args=(core_request,),
+                            target=run_one,
+                            args=(core_request, not unbounded),
                             daemon=True,
                         ).start()
             except grpc.RpcError:

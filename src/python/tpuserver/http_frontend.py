@@ -121,6 +121,9 @@ class _Handler(socketserver.StreamRequestHandler):
                     ] = h[colon + 1 :].decode("latin-1").strip()
             self.headers = _Headers(raw_headers)
             self.path = target
+            # chunked transfer framing is HTTP/1.1; a 1.0 client gets
+            # streamed bodies raw, delimited by connection close
+            self._chunked_ok = version != "HTTP/1.0"
             close = (
                 raw_headers.get("connection", "").lower() == "close"
                 or version == "HTTP/1.0"
@@ -185,6 +188,38 @@ class _Handler(socketserver.StreamRequestHandler):
 
     def _send_json(self, obj, code=200, headers=None):
         self._send(code, json.dumps(obj).encode("utf-8"), headers)
+
+    def _send_stream_start(self, content_type):
+        """Open a streaming 200 response; the body follows as
+        ``_send_chunk`` frames ended by ``_end_chunks``.  Used by
+        /generate_stream — token count is data-dependent, so
+        Content-Length cannot be known up front and each token must
+        leave the socket as its decode step produces it.  HTTP/1.1
+        clients get Transfer-Encoding: chunked; HTTP/1.0 predates
+        chunked framing, so those get the raw bytes delimited by
+        connection close (``handle`` already closes 1.0 connections)."""
+        head = (
+            _STATUS_LINE[200]
+            + b"Server: tpu-triton-server\r\nContent-Type: "
+            + content_type.encode("latin-1")
+        )
+        if self._chunked_ok:
+            head += b"\r\nTransfer-Encoding: chunked\r\n\r\n"
+        else:
+            head += b"\r\nConnection: close\r\n\r\n"
+        self.wfile.write(head)
+
+    def _send_chunk(self, data):
+        if self._chunked_ok:
+            data = ("%x\r\n" % len(data)).encode("latin-1") + data + b"\r\n"
+        self.wfile.write(data)
+        self.wfile.flush()
+
+    def _end_chunks(self):
+        """Terminal zero-length chunk: the connection stays reusable
+        (no-op for HTTP/1.0, whose end-of-body is the close)."""
+        if self._chunked_ok:
+            self.wfile.write(b"0\r\n\r\n")
 
     def _send_metrics(self, core):
         """Prometheus-style exposition (role of Triton's :8002/metrics;
@@ -352,9 +387,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 )
             if rest == "/infer" and method == "POST":
                 return self._route_infer(model, version)
-            if rest == "/generate" or rest == "/generate_stream":
-                raise ServerError(
-                    "generate endpoints not supported; use gRPC streaming"
+            if rest in ("/generate", "/generate_stream") and method == "POST":
+                return self._route_generate(
+                    model, version, stream=rest.endswith("_stream")
                 )
         raise ServerError("unknown endpoint: " + path, code=404)
 
@@ -398,6 +433,110 @@ class _Handler(socketserver.StreamRequestHandler):
             return self._send_json({})
         core.unregister_xla_shm(region)
         return self._send_json({})
+
+    # -- generate (decoupled streaming over HTTP) -------------------------
+
+    def _route_generate(self, model, version, stream):
+        """KServe-style generate endpoints for decoupled models.
+
+        The request body is the infer JSON shape (``inputs`` with
+        ``data``, optional ``parameters``).  ``/generate`` collects the
+        whole decoupled burst into one JSON response (each output's
+        per-step values concatenated along a leading step axis);
+        ``/generate_stream`` emits one SSE event per decoupled response
+        over a chunked transfer — the HTTP fan-out of the continuous-
+        batching scheduler's per-step tokens (each chunk leaves as soon
+        as its decode step retires, so concurrent requests on separate
+        connections interleave at token granularity).
+        """
+        core = self.core
+        body = self._read_body()
+        request_json = json.loads(body)
+        parameters = dict(request_json.get("parameters", {}))
+        inputs = {}
+        for tin in request_json.get("inputs", []):
+            datatype = tin.get("datatype")
+            if not datatype:
+                raise ServerError(
+                    "generate input '{}' needs a datatype".format(
+                        tin.get("name"))
+                )
+            inputs[tin["name"]] = _array_from_json_data(
+                tin.get("data"), datatype, tin["shape"]
+            )
+        request = InferRequest(
+            model, version, request_json.get("id", ""), inputs, None,
+            parameters,
+        )
+
+        def response_json(resp):
+            out = {
+                "model_name": resp.model_name,
+                "model_version": resp.model_version,
+                "outputs": [],
+            }
+            if resp.id:
+                out["id"] = resp.id
+            for spec, array, _ in resp.outputs:
+                entry = dict(spec)
+                if array is not None:
+                    entry["data"] = (
+                        [v.decode("utf-8", errors="replace")
+                         if isinstance(v, bytes) else str(v)
+                         for v in array.reshape(-1)]
+                        if spec["datatype"] == "BYTES"
+                        else array.reshape(-1).tolist()
+                    )
+                out["outputs"].append(entry)
+            return out
+
+        if not stream:
+            merged = None
+            for resp in core.infer_stream(request):
+                piece = response_json(resp)
+                if merged is None:
+                    merged = piece
+                    for entry in merged["outputs"]:
+                        entry["shape"] = [1] + list(entry["shape"])
+                else:
+                    by_name = {e["name"]: e for e in merged["outputs"]}
+                    for entry in piece["outputs"]:
+                        tgt = by_name.get(entry["name"])
+                        if tgt is None:
+                            merged["outputs"].append(entry)
+                            entry["shape"] = [1] + list(entry["shape"])
+                        else:
+                            tgt["data"].extend(entry["data"])
+                            tgt["shape"][0] += 1
+            if merged is None:
+                merged = {"model_name": model, "model_version": version,
+                          "outputs": []}
+            return self._send_json(merged)
+
+        # SSE over chunked transfer: the stream must start before the
+        # generation finishes, so errors after the first token arrive
+        # in-band as an {"error": ...} event (the status line is gone)
+        started = False
+        try:
+            for resp in core.infer_stream(request):
+                if not started:
+                    self._send_stream_start("text/event-stream")
+                    started = True
+                self._send_chunk(
+                    b"data: "
+                    + json.dumps(response_json(resp)).encode("utf-8")
+                    + b"\n\n"
+                )
+        except ServerError as e:
+            if not started:
+                raise
+            self._send_chunk(
+                b"data: " + json.dumps({"error": str(e)}).encode("utf-8")
+                + b"\n\n"
+            )
+        if not started:
+            self._send_stream_start("text/event-stream")
+        self._end_chunks()
 
     # -- inference --------------------------------------------------------
 

@@ -35,10 +35,15 @@ def default_models():
 def serving_models(include_vision=True, include_bert=True,
                    include_llama=True, llama_cfg=None,
                    llama_decode_chunk=None, llama_max_seq=512,
-                   llama_mesh=None, llama_quantize=False):
+                   llama_mesh=None, llama_quantize=False,
+                   llama_max_slots=1):
     """The heavyweight serving zoo for the BASELINE configs (#2-#5):
     ResNet-50 / DenseNet-121, the BERT ensemble, and decoupled llama
-    generation.  Separate from ``default_models`` so unit tests stay fast."""
+    generation.  Separate from ``default_models`` so unit tests stay fast.
+
+    ``llama_max_slots > 1`` turns on the continuous-batching decode
+    scheduler: that many concurrent generations share one slotted KV
+    cache and every decode step serves them all in a single dispatch."""
     models = []
     if include_vision:
         from tpuserver.models.vision import (
@@ -65,5 +70,6 @@ def serving_models(include_vision=True, include_bert=True,
         models.append(LlamaGenerateModel(
             cfg=llama_cfg, max_seq=llama_max_seq,
             decode_chunk=llama_decode_chunk,
-            mesh=llama_mesh, quantize=llama_quantize))
+            mesh=llama_mesh, quantize=llama_quantize,
+            max_slots=llama_max_slots))
     return models

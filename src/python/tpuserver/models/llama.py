@@ -258,10 +258,13 @@ def _mm(x, w):
     return quant.matmul(x, w)
 
 
-def _embed_rows(params, tokens):
+def _embed_rows(params, tokens, cfg=None):
     from tpuserver.ops import quant
 
-    return quant.gather_rows(params["embed"], tokens)
+    return quant.gather_rows(
+        params["embed"], tokens,
+        dtype=cfg.dtype if cfg is not None else None,
+    )
 
 
 def _rms_norm(x, w, eps):
@@ -344,7 +347,7 @@ def forward(params, tokens, cfg):
             q, _expand_kv(k, n_rep), _expand_kv(v, n_rep), causal=True
         )
 
-    x = _embed_rows(params, tokens)
+    x = _embed_rows(params, tokens, cfg)
     for layer in params["layers"]:
         x = _block(layer, x, positions, cfg, attn_fn)
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
@@ -615,8 +618,10 @@ def _run_cached(params, cache, x, positions, write_pos, lengths, cfg):
 def _attend_cached(q, cache_k, cache_v, q_pos, length, n_rep):
     """q: [B, Tq, H, D] against cache [B, S, Hkv, D].
 
-    Masks cache positions >= ``length`` and (causally) > the query's own
-    global position ``q_pos`` [B, Tq]."""
+    Masks cache positions >= ``length`` (a scalar, or a per-row [B]
+    vector when the continuous-batching step decodes rows at different
+    sequence positions) and (causally) > the query's own global position
+    ``q_pos`` [B, Tq]."""
     k = _expand_kv(cache_k, n_rep)
     v = _expand_kv(cache_v, n_rep)
     s = jnp.einsum(
@@ -624,6 +629,8 @@ def _attend_cached(q, cache_k, cache_v, q_pos, length, n_rep):
         preferred_element_type=jnp.float32,
     ) / np.sqrt(q.shape[-1])
     k_idx = jnp.arange(k.shape[1])[None, None, None, :]
+    if getattr(length, "ndim", 0):
+        length = length.reshape(-1, 1, 1, 1)  # per-row valid prefixes
     mask = (k_idx >= length) | (k_idx > q_pos[:, None, :, None])
     s = jnp.where(mask, -jnp.inf, s)
     p = jax.nn.softmax(s, axis=-1)
@@ -639,7 +646,7 @@ def decode_step(params, cache, tokens, pos, cfg):
     """
     B = tokens.shape[0]
     positions = jnp.full((B, 1), pos)
-    x = _embed_rows(params, tokens)[:, None, :]  # [B, 1, Dm]
+    x = _embed_rows(params, tokens, cfg)[:, None, :]  # [B, 1, Dm]
     x, new_cache = _run_cached(
         params, cache, x, positions, pos, pos + 1, cfg
     )
@@ -656,7 +663,7 @@ def prefill(params, cache, tokens, cfg):
     dynamic_update_slice per layer (not T sequential steps)."""
     B, T = tokens.shape
     positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
-    x = _embed_rows(params, tokens)
+    x = _embed_rows(params, tokens, cfg)
     x, new_cache = _run_cached(params, cache, x, positions, 0, T, cfg)
     x = _rms_norm(x, params["norm"], cfg.norm_eps)
     logits = _mm(x[:, -1, :], params["lm_head"]).astype(jnp.float32)
@@ -690,6 +697,276 @@ def decode_chunk(params, cache, logits, pos, cfg, chunk):
         body, (logits, cache, pos), None, length=chunk
     )
     return tokens, logps, next_logits, cache
+
+
+# -- continuous batching (the slotted decode step) ---------------------------
+
+
+def prefill_bucket(cfg, max_seq, true_len):
+    """The padded length the scheduler should prefill a ``true_len``
+    prompt at: the next power of two (min 8, capped at ``max_seq``) —
+    UNLESS padding would change which prefill attention path runs.
+
+    With ``attn_impl="pallas"`` the flash kernel engages only at
+    tileable lengths; padding a dense-length prompt to a tileable bucket
+    (or changing the tile pair) would alter the accumulation order of
+    the admission prefill vs the single-stream path's exact-length
+    prefill, and a near-tie in the first token's logits could flip the
+    greedy argmax — breaking the token-identity contract.  Such lengths
+    compile exactly instead (the pre-bucketing behavior); everything on
+    the dense path buckets freely."""
+    bucket = 8
+    while bucket < true_len:
+        bucket <<= 1
+    bucket = min(bucket, max_seq)
+    if bucket == true_len or cfg.attn_impl != "pallas":
+        return bucket
+
+    def dense(T):
+        return None in _flash_blocks(T, cfg)
+
+    return bucket if dense(true_len) and dense(bucket) else true_len
+
+
+def prefill_to_length(params, cache, tokens, true_len, cfg):
+    """Prefill a PADDED prompt, returning the logits at ``true_len - 1``.
+
+    The admission prefill compiles one executable per distinct prompt
+    length; under continuous batching every novel length would stall
+    ALL in-flight streams for a full model compile.  Padding prompts to
+    a few fixed buckets bounds the compile set — and causal attention
+    makes the result exact: position ``true_len - 1`` attends only
+    positions <= itself, so the padding rows (garbage K/V written at
+    ``true_len..T-1``, later masked by the slot's length and overwritten
+    by decode steps) cannot influence the returned logits.
+    """
+    B, T = tokens.shape
+    positions = jnp.tile(jnp.arange(T)[None, :], (B, 1))
+    x = _embed_rows(params, tokens, cfg)
+    x, new_cache = _run_cached(params, cache, x, positions, 0, T, cfg)
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    last = lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)[:, 0]
+    logits = _mm(last, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def batched_decode_step(params, cache, tokens, positions, cfg):
+    """One decode token per cache SLOT at per-slot positions — the
+    compute heart of the continuous-batching scheduler
+    (``tpuserver.scheduler``).
+
+    Where ``decode_step`` advances one sequence at a shared scalar
+    ``pos``, here every cache row is an independent in-flight generation:
+    ``tokens`` [S] int32 are the rows' next input tokens and ``positions``
+    [S] int32 their current write positions.  Each row's K/V lands at its
+    own position (a scatter instead of a dynamic_update_slice) and
+    attention masks each row to its own valid prefix
+    (``positions + 1``).  Rows holding no live request use the sentinel
+    position ``max_seq`` — out of bounds, so their cache writes DROP
+    (mode="drop") and a finished-but-still-in-flight slot's parked rows
+    are never corrupted.
+
+    Returns (logits [S, vocab] fp32, new cache).  Per-row math is
+    identical to ``decode_step``'s, which is what makes greedy tokens
+    from N interleaved slots equal to N sequential single-stream runs.
+    """
+    S = tokens.shape[0]
+    max_seq = cache.shape[3]
+    q_pos = positions[:, None]  # [S, 1]
+    # inert rows (sentinel position max_seq) clamp to length 1, not
+    # max_seq: the decode-attention kernel skips blocks past each row's
+    # valid prefix, and an empty slot must not stream its whole dead
+    # cache from HBM every step (length 0 would NaN the softmax; the
+    # one garbage position attended is discarded with the row's output)
+    lengths = jnp.where(positions >= max_seq, 1, positions + 1)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    rows = jnp.arange(S)
+    x = _embed_rows(params, tokens, cfg)[:, None, :]  # [S, 1, Dm]
+    new_cache = cache
+    pallas_block = next((b for b in (256, 128) if max_seq % b == 0), None)
+    impl = cfg.decode_impl
+    if impl == "auto":
+        impl = _select_decode_impl(max_seq, None)
+
+    for i, layer in enumerate(params["layers"]):
+        def attn_fn(q, k, v, i=i):
+            nonlocal new_cache
+            new_cache = new_cache.at[i, 0, rows, positions].set(
+                k[:, 0].astype(new_cache.dtype), mode="drop"
+            )
+            new_cache = new_cache.at[i, 1, rows, positions].set(
+                v[:, 0].astype(new_cache.dtype), mode="drop"
+            )
+            if impl == "pallas" and pallas_block is not None:
+                # the decode-attention kernel already takes per-row
+                # lengths — continuous batching is its natural shape
+                from tpuserver.ops import decode_attention
+
+                out = decode_attention(
+                    q[:, 0],
+                    new_cache[i, 0],
+                    new_cache[i, 1],
+                    lengths.astype(jnp.int32),
+                    block_k=pallas_block,
+                )
+                return out[:, None]
+            return _attend_cached(
+                q, new_cache[i, 0], new_cache[i, 1], q_pos, lengths, n_rep
+            )
+
+        x = _block(layer, x, q_pos, cfg, attn_fn)
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = _mm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def scheduler_step(params, cache, logits_all, positions, active,
+                   forced, forced_mask, cfg):
+    """One continuous-batching iteration over every cache slot, in ONE
+    device dispatch.
+
+    Each slot's next token is sampled greedily from its ``logits_all``
+    row — except slots replaying a resumed prompt, whose ``forced``
+    token is taken instead (``forced_mask``); those steps only feed the
+    cache, the scheduler emits nothing for them.  The batched decode
+    step then writes every active row's K/V at its own position.
+    Inactive rows keep their previous logits so a dead slot's state
+    stays inert until an admission overwrites it.
+
+    Returns (tokens [S], logprobs [S], next logits [S, vocab], cache).
+    """
+    logp = jax.nn.log_softmax(logits_all, axis=-1)
+    greedy = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(forced_mask, forced, greedy)
+    tok_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    new_logits, new_cache = batched_decode_step(
+        params, cache, tokens, positions, cfg
+    )
+    new_logits = jnp.where(active[:, None], new_logits, logits_all)
+    return tokens, tok_logp, new_logits, new_cache
+
+
+def scheduler_admit(cache, logits_all, slot_cache, slot_logits, slot):
+    """Admit one prefilled request into the slotted arrays: write its
+    [n_layers, 2, 1, S, Hkv, hd] cache into batch row ``slot`` and its
+    next-token logits [1, vocab] into the matching ``logits_all`` row.
+    ``slot`` is a traced scalar — one compile covers every slot."""
+    cache = lax.dynamic_update_slice_in_dim(
+        cache, slot_cache.astype(cache.dtype), slot, axis=2
+    )
+    logits_all = lax.dynamic_update_slice_in_dim(
+        logits_all, slot_logits.astype(logits_all.dtype), slot, axis=0
+    )
+    return cache, logits_all
+
+
+def scheduler_extract(cache, slot):
+    """One slot's cache rows as a fresh [n_layers, 2, 1, S, Hkv, hd]
+    array — the same shape the single-stream path parks in an XLA shm
+    region, so park/resume interoperates across both modes."""
+    return lax.dynamic_slice_in_dim(cache, slot, 1, axis=2)
+
+
+def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
+    """Compiled function bundle for the continuous-batching scheduler.
+
+    Returns a dict of:
+
+    - ``init_cache()`` — the slotted KV cache
+      [n_layers, 2, max_slots, max_seq, n_kv_heads, head_dim]
+    - ``init_slot_cache()`` — a single-row cache for prefill-on-admit
+    - ``init_logits()`` — [max_slots, vocab] fp32 zeros
+    - ``prefill(params, slot_cache, tokens, true_len)`` — the admission
+      prefill (:func:`prefill_to_length`: prompts arrive padded to a
+      bucket so the compile set stays bounded)
+    - ``prefill_bucket(true_len)`` — the padded length to use
+      (:func:`prefill_bucket`: exact length where padding would change
+      the flash/dense prefill decision and with it the greedy tokens)
+    - ``step(params, cache, logits, positions, active, forced,
+      forced_mask)`` — :func:`scheduler_step`, cache and logits donated
+    - ``admit(cache, logits, slot_cache, slot_logits, slot)`` — donated
+    - ``extract(cache, slot)`` — the park copy (cache NOT donated)
+
+    With a ``mesh`` the bundle is the GSPMD form: params Megatron-split,
+    both caches kv-head-sharded over tp (``cache_spec``), logits and the
+    per-slot control vectors replicated — the same sharding rules as
+    ``make_tp_serving``, applied to the slotted shape.
+    """
+    if mesh is not None and (cfg.n_heads % mesh.shape["tp"]
+                             or cfg.n_kv_heads % mesh.shape["tp"]):
+        raise ValueError(
+            "tp={} must divide n_heads={} and n_kv_heads={}".format(
+                mesh.shape["tp"], cfg.n_heads, cfg.n_kv_heads
+            )
+        )
+    if mesh is None:
+        step = jax.jit(
+            functools.partial(scheduler_step, cfg=cfg),
+            donate_argnums=(1, 2),
+        )
+        admit = jax.jit(scheduler_admit, donate_argnums=(0, 1))
+        extract = jax.jit(scheduler_extract)
+        prefill_fn = jax.jit(functools.partial(prefill_to_length, cfg=cfg))
+
+        def init_cache():
+            return init_kv_cache(cfg, max_slots, max_seq)
+
+        def init_slot_cache():
+            return init_kv_cache(cfg, 1, max_seq)
+
+        def init_logits():
+            return jnp.zeros((max_slots, cfg.vocab), jnp.float32)
+
+    else:
+        param_sh, cache_sh, repl = serving_shardings(
+            mesh, cfg, quantized=quantized
+        )
+        step = jax.jit(
+            functools.partial(scheduler_step, cfg=cfg),
+            in_shardings=(param_sh, cache_sh, repl, repl, repl, repl, repl),
+            out_shardings=(repl, repl, repl, cache_sh),
+            donate_argnums=(1, 2),
+        )
+        admit = jax.jit(
+            scheduler_admit,
+            in_shardings=(cache_sh, repl, cache_sh, repl, repl),
+            out_shardings=(cache_sh, repl),
+            donate_argnums=(0, 1),
+        )
+        extract = jax.jit(
+            scheduler_extract,
+            in_shardings=(cache_sh, repl),
+            out_shardings=cache_sh,
+        )
+        prefill_fn = jax.jit(
+            functools.partial(prefill_to_length, cfg=cfg),
+            in_shardings=(param_sh, cache_sh, repl, repl),
+            out_shardings=(repl, cache_sh),
+        )
+
+        def init_cache():
+            return jax.device_put(
+                init_kv_cache(cfg, max_slots, max_seq), cache_sh
+            )
+
+        def init_slot_cache():
+            return jax.device_put(init_kv_cache(cfg, 1, max_seq), cache_sh)
+
+        def init_logits():
+            return jax.device_put(
+                jnp.zeros((max_slots, cfg.vocab), jnp.float32), repl
+            )
+
+    return {
+        "init_cache": init_cache,
+        "init_slot_cache": init_slot_cache,
+        "init_logits": init_logits,
+        "prefill": prefill_fn,
+        "prefill_bucket": functools.partial(prefill_bucket, cfg, max_seq),
+        "step": step,
+        "admit": admit,
+        "extract": extract,
+    }
 
 
 # -- tensor-parallel serving (decode over a tp mesh) -------------------------

@@ -25,6 +25,17 @@ mode) in that region and, on a follow-up request with the same parameter
 and ``kv_cache_resume=True``, continue generation from it without
 re-prefilling — the TPU-shm analogue of the reference's CUDA-shm tensor
 passing, applied to generation state.
+
+Continuous batching (``max_slots > 1``): generation routes through the
+``tpuserver.scheduler.DecodeScheduler`` — a slotted KV cache and a
+background loop running one batched decode step for ALL in-flight
+streams per iteration, admitting waiting requests into freed slots
+mid-flight.  Greedy tokens are identical to the single-stream path
+(test-enforced); ``max_slots=1`` (the default) keeps the original
+single-stream pipelined path byte-for-byte, so existing tests and BENCH
+numbers stay reproducible.  An optional ``eos_id`` request parameter
+ends a generation early on that token (emitted, then the slot retires
+and is reused), on both paths.
 """
 
 import threading
@@ -60,7 +71,8 @@ class LlamaGenerateModel(Model):
     decode_chunk = 8
 
     def __init__(self, cfg=None, max_seq=512, server=None,
-                 decode_chunk=None, mesh=None, quantize=False):
+                 decode_chunk=None, mesh=None, quantize=False,
+                 max_slots=1):
         self._cfg = cfg or llama.tiny(vocab=2048)
         self._max_seq = max_seq
         self._server = server  # for kv_cache_region xla-shm lookups
@@ -70,6 +82,14 @@ class LlamaGenerateModel(Model):
         self._prefill = None
         self._decode = None
         self._decode_chunk = None
+        if max_slots < 1:
+            raise ValueError(
+                "max_slots must be >= 1 (got {})".format(max_slots))
+        self._max_slots = int(max_slots)
+        self._scheduler = None  # DecodeScheduler when max_slots > 1
+        # continuous-batching models interleave many streams' responses;
+        # the frontends must not serialize their stream requests
+        self.concurrent_decoupled = self._max_slots > 1
         if decode_chunk is not None:
             if decode_chunk < 1:
                 raise ValueError(
@@ -86,10 +106,10 @@ class LlamaGenerateModel(Model):
         self._server = server
 
     def _ensure_compiled(self):
-        if self._decode is not None:
+        if self._params is not None:
             return
         with self._lock:
-            if self._decode is None:
+            if self._params is None:
                 import functools
 
                 import jax
@@ -114,6 +134,24 @@ class LlamaGenerateModel(Model):
                         jax.random.PRNGKey(0), self._cfg
                     )
                 if self._mesh is not None:
+                    param_sh, _, _ = llama.serving_shardings(
+                        self._mesh, self._cfg, quantized=self._quantize
+                    )
+                    params = jax.device_put(params, param_sh)
+                if self._max_slots > 1:
+                    # continuous batching: a background loop owns a
+                    # slotted cache and all device state; the fns below
+                    # stay None (the single-stream path is not built)
+                    from tpuserver.scheduler import DecodeScheduler
+
+                    fns = llama.make_scheduler_fns(
+                        self._cfg, self._max_seq, self._max_slots,
+                        mesh=self._mesh, quantized=self._quantize,
+                    )
+                    self._scheduler = DecodeScheduler(
+                        fns, params, self._max_slots, self._max_seq
+                    )
+                elif self._mesh is not None:
                     init_cache, prefill_fn, chunk_fn = (
                         llama.make_tp_serving(
                             self._mesh, self._cfg,
@@ -125,10 +163,6 @@ class LlamaGenerateModel(Model):
                         self._mesh, self._cfg,
                         quantized=self._quantize,
                     )
-                    param_sh, _, _ = llama.serving_shardings(
-                        self._mesh, self._cfg, quantized=self._quantize
-                    )
-                    params = jax.device_put(params, param_sh)
                     self._init_cache = (
                         lambda: init_cache(1, self._max_seq)
                     )
@@ -171,6 +205,23 @@ class LlamaGenerateModel(Model):
             )
         return self._server.xla_shm_region(name)
 
+    @staticmethod
+    def _resume_state(request, region):
+        """(parked cache segment or None, resume position) for a
+        ``kv_cache_resume`` request — the one copy of the resume
+        parameter contract, shared by both serving paths."""
+        if region is None or not request.parameters.get("kv_cache_resume"):
+            return None, 0
+        parked = region.handle.get_jax_segment(0)
+        if parked is None:
+            return None, 0
+        if "kv_cache_position" not in request.parameters:
+            raise ValueError(
+                "kv_cache_resume requires kv_cache_position (the "
+                "sequence position the parked cache was left at)"
+            )
+        return parked, int(request.parameters["kv_cache_position"])
+
     def execute_stream(self, inputs, request):
         import jax
         import jax.numpy as jnp
@@ -180,26 +231,25 @@ class LlamaGenerateModel(Model):
         max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).reshape(-1)[0])
         if len(prompt) == 0:
             raise ValueError("PROMPT_IDS must be non-empty")
+        eos_id = request.parameters.get("eos_id")
+        eos_id = int(eos_id) if eos_id is not None else None
+
+        if self._scheduler is not None:
+            # continuous batching: hand the request to the shared decode
+            # loop; tokens stream back as the batched steps produce them
+            yield from self._execute_scheduled(
+                prompt, max_tokens, eos_id, request
+            )
+            return
 
         region = self._kv_region(request)
-        resume = bool(request.parameters.get("kv_cache_resume")) and (
-            region is not None
-        )
-        pos = 0
+        parked, pos = self._resume_state(request, region)
         cache = None
-        if resume:
-            parked = region.handle.get_jax_segment(0)
-            if parked is not None:
-                if "kv_cache_position" not in request.parameters:
-                    raise ValueError(
-                        "kv_cache_resume requires kv_cache_position (the "
-                        "sequence position the parked cache was left at)"
-                    )
-                # decode_step donates its cache argument; copy so the parked
-                # array in the region registry stays valid even if this
-                # stream dies mid-generation.
-                cache = jnp.copy(parked)
-                pos = int(request.parameters["kv_cache_position"])
+        if parked is not None:
+            # decode_step donates its cache argument; copy so the parked
+            # array in the region registry stays valid even if this
+            # stream dies mid-generation.
+            cache = jnp.copy(parked)
         if cache is None:
             cache = self._init_cache()
             pos = 0
@@ -258,6 +308,10 @@ class LlamaGenerateModel(Model):
                 "LOGPROB": np.array([l0[0]], dtype=np.float32),
             }
             emitted += 1
+            if eos_id is not None and int(t0[0]) == eos_id:
+                if region is not None:
+                    region.put_device_array(0, cache)
+                return
 
         while emitted < max_tokens:
             # keep one chunk computing behind the one being fetched
@@ -305,10 +359,53 @@ class LlamaGenerateModel(Model):
                     "TOKEN": np.array([tokens_host[i]], dtype=np.int32),
                     "LOGPROB": np.array([logps_host[i]], dtype=np.float32),
                 }
-            emitted += n
+                emitted += 1
+                if eos_id is not None and int(tokens_host[i]) == eos_id:
+                    # the EOS token is emitted, then generation stops;
+                    # chunks already in flight carry tokens past EOS —
+                    # the parked cache's extra rows stay masked behind
+                    # the resume position, same as the scheduler's
+                    # one-step retirement lag
+                    if region is not None:
+                        region.put_device_array(0, cache)
+                    return
 
         if region is not None:
             # park the device-resident cache in the XLA region (zero-copy
             # in-process; host-staged cross-process).  In tp mode the
             # parked array stays sharded across the mesh.
             region.put_device_array(0, cache)
+
+    def _execute_scheduled(self, prompt, max_tokens, eos_id, request):
+        """Continuous-batching path: submit to the shared decode loop and
+        fan its per-step tokens back out to this stream."""
+        import jax.numpy as jnp
+
+        region = self._kv_region(request)
+        parked, pos = self._resume_state(request, region)
+        # the pos+prompt+max_tokens overflow check lives in
+        # DecodeScheduler.submit — one copy, same wording as this
+        # class's single-stream path
+        on_finish = None
+        if region is not None:
+            def on_finish(cache_rows):
+                # the slot's rows in the single-stream park shape, so a
+                # later request may resume on either path
+                region.put_device_array(0, cache_rows)
+
+        stream = self._scheduler.submit(
+            prompt, max_tokens, eos_id=eos_id,
+            resume_cache=jnp.asarray(parked) if parked is not None else None,
+            resume_pos=pos, on_finish=on_finish,
+        )
+        for token, logprob in stream:
+            yield {
+                "TOKEN": np.array([token], dtype=np.int32),
+                "LOGPROB": np.array([logprob], dtype=np.float32),
+            }
+
+    def close(self):
+        """Stop the continuous-batching loop (no-op for max_slots=1).
+        Called by ``InferenceServer.close``."""
+        if self._scheduler is not None:
+            self._scheduler.close()

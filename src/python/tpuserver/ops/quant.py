@@ -54,10 +54,16 @@ def matmul(x, w):
       so activations quantize dynamically per row to int8 and the dot
       runs int8 x int8 -> int32 on the MXU's double-rate integer path:
       73% MFU measured, FASTER than the bf16 matmul (68%).
+
+    The regime test applies only to >=3-D activations, where axis -2 is
+    the token axis.  For a 2-D activation (e.g. the lm_head input
+    ``x[:, -1, :]`` of shape [B, D]) axis -2 is the *server-side batch*,
+    and switching regimes with batch size would silently change the same
+    request's logits numerics between a quiet and a loaded server.
     """
     if not is_quantized(w):
         return x @ w
-    if x.ndim >= 2 and x.shape[-2] >= 8:
+    if x.ndim >= 3 and x.shape[-2] >= 8:
         return _w8a8_matmul(x, w)
     y = x @ w["q"].astype(x.dtype)
     return (y * w["s"].astype(x.dtype)).astype(x.dtype)
@@ -69,6 +75,17 @@ def _w8a8_matmul(x, w):
     x: [..., rows, in]; w: {"q": int8 [in, out], "s": f32 [out]}.
     Accumulation is int32; the result rescales by (row scale x channel
     scale) in f32 before casting back to the activation dtype.
+
+    TP cost note: the per-row amax reduces over the activation's LAST
+    axis.  For row-parallel TP matmuls (llama's wo/w_down, whose inputs
+    are column-split over tp) that axis is sharded, so GSPMD must insert
+    one extra all-reduce(max) collective per matmul before the dot — a
+    latency cost the decode-scale/weight-only path does not pay.  A
+    shard-local scale (quantize per shard-row) would remove the
+    collective at the price of shard-count-dependent numerics; until the
+    w8a8 prefill speedup is re-verified at tp>1 on real hardware the
+    collective is kept and documented (docs/benchmarking.md, "w8a8 under
+    tensor parallelism").
     """
     from jax import lax
 
@@ -85,13 +102,19 @@ def _w8a8_matmul(x, w):
     return (y.astype(jnp.float32) * sx * w["s"]).astype(x.dtype)
 
 
-def gather_rows(w, idx):
+def gather_rows(w, idx, dtype=None):
     """Row gather (embedding lookup) from a plain or per-row-quantized
-    table (``quantize_int8(w, axis=1)``: one scale per row)."""
+    table (``quantize_int8(w, axis=1)``: one scale per row).
+
+    ``dtype`` is the dequantized row dtype — the model's configured
+    activation dtype (``cfg.dtype``), so a float32-configured model gets
+    a float32 residual stream instead of a silently-bf16 one.  Defaults
+    to bfloat16 for callers without a config in hand."""
     if not is_quantized(w):
         return w[idx]
-    rows = w["q"][idx].astype(jnp.bfloat16)
-    return rows * w["s"][idx].astype(jnp.bfloat16)[..., None]
+    dtype = jnp.bfloat16 if dtype is None else dtype
+    rows = w["q"][idx].astype(dtype)
+    return rows * w["s"][idx].astype(dtype)[..., None]
 
 
 def quantized_bytes(w):

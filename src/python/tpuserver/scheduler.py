@@ -1,0 +1,393 @@
+"""Continuous-batching decode scheduler: interleaved served generation.
+
+The round-5 verdict's own decomposition puts the remaining decode-MBU
+lever at *batching across rows*: a single-stream decode step streams the
+whole weight set from HBM to produce ONE token, so served throughput
+equals single-stream throughput while every concurrent gRPC stream
+queues on the model's lock.  This module is the missing subsystem: a
+per-model background decode loop that owns a slotted, padded KV cache
+(``[n_layers, 2, max_slots, max_seq, n_kv_heads, head_dim]``, kv-head
+sharded over the tp mesh when present) and runs **one batched decode
+step for all active slots per iteration**, so the weight stream is paid
+once per step and amortized over every in-flight generation.
+
+Lifecycle of a request (vLLM-style continuous batching, TPU-shaped):
+
+1. **admit** — between decode steps, a waiting request takes a free
+   slot: its prompt prefills into a single-row cache (one batched
+   MXU-shaped pass) whose rows are then written into the slot
+   (``llama.scheduler_admit``).  A resumed request (``kv_cache_region``
+   park/resume) instead copies its parked cache into the slot and
+   replays its new prompt tokens through the batched step as *forced*
+   tokens (fed, not emitted).
+2. **step** — every iteration runs ``llama.scheduler_step``: greedy
+   sample per slot from the slot's logits row, then one batched decode
+   dispatch writing each row's K/V at its own position with per-row
+   length masks.  Steps are software-pipelined one deep: step *i+1* is
+   dispatched before step *i*'s tokens are fetched, so the device→host
+   fetch overlaps the next step's compute.
+3. **retire** — a slot finishes on its max_tokens budget or its
+   ``eos_id``; the slot frees immediately, so a waiting request joins
+   **mid-flight** while other slots keep decoding.  A finishing request
+   that asked for cache parking gets its slot rows extracted
+   (``llama.scheduler_extract`` — the same ``[L, 2, 1, S, Hkv, hd]``
+   shape the single-stream path parks) and handed to its ``on_finish``
+   callback.
+
+Because of the one-deep pipeline, retirement lags its trigger token by
+one step: the slot rides one extra "wasted" dispatch whose token is
+discarded.  Correctness is preserved by construction — the wasted write
+lands beyond the slot's valid prefix (masked on any later resume), rows
+with no live request carry the out-of-bounds sentinel position so their
+writes drop, and emission matches snapshot state by object identity so
+a re-admitted slot can never receive a predecessor's stale token.
+
+Greedy per-row math in the batched step is identical to the
+single-stream ``decode_step``'s, so N interleaved streams produce
+token-identical output to N sequential single-stream runs
+(test-enforced in tests/test_continuous_batching.py).
+"""
+
+import threading
+from collections import deque
+
+import numpy as np
+
+
+class SchedulerClosed(Exception):
+    """Raised on submit after the scheduler has been shut down."""
+
+
+class _Stream:
+    """One in-flight generation bound to a cache slot."""
+
+    __slots__ = (
+        "prompt", "max_tokens", "eos_id", "queue", "forced", "pos",
+        "emitted", "on_finish", "resume_cache", "resume_pos", "finished",
+        "cancelled",
+    )
+
+    def __init__(self, prompt, max_tokens, eos_id, resume_cache,
+                 resume_pos, on_finish):
+        import queue as _queue
+
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self.queue = _queue.Queue()
+        self.forced = deque()
+        self.pos = 0
+        self.emitted = 0
+        self.on_finish = on_finish
+        self.resume_cache = resume_cache
+        self.resume_pos = resume_pos
+        self.finished = False   # terminal queue event delivered
+        self.cancelled = False  # consumer abandoned the token iterator
+
+
+class DecodeScheduler:
+    """The per-model continuous-batching loop.
+
+    ``fns`` is the compiled bundle from ``llama.make_scheduler_fns`` and
+    ``params`` the (possibly sharded/quantized) weight pytree.  One
+    background thread owns ALL device state — the slotted cache and the
+    per-slot logits are threaded (and donated) through its dispatches,
+    so frontend threads never touch the device: they block on per-stream
+    queues that the loop fans tokens into.
+    """
+
+    def __init__(self, fns, params, max_slots, max_seq, max_pending=None):
+        if max_slots < 1:
+            raise ValueError(
+                "max_slots must be >= 1 (got {})".format(max_slots)
+            )
+        self._fns = fns
+        self._params = params
+        self._max_slots = max_slots
+        self._max_seq = max_seq
+        # admission backpressure: before continuous batching, decoupled
+        # requests serialized (implicit backpressure); an unbounded
+        # pending deque would let one client enqueue arbitrarily many
+        # generations (each also holding a frontend thread)
+        self._max_pending = (
+            max_pending if max_pending is not None else max(32, 8 * max_slots)
+        )
+        self._cond = threading.Condition()
+        self._pending = deque()
+        self._thread = None
+        self._closed = False
+
+    # -- frontend side -----------------------------------------------------
+
+    def submit(self, prompt, max_tokens, eos_id=None, resume_cache=None,
+               resume_pos=0, on_finish=None):
+        """Enqueue one generation; returns an iterator of
+        ``(token, logprob)`` pairs that blocks as the decode loop
+        produces them.
+
+        ``resume_cache``/``resume_pos`` continue from a parked KV cache
+        (the prompt replays through the batched step without emission);
+        ``on_finish(cache_rows)`` receives the slot's final cache copy —
+        the park hook."""
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("PROMPT_IDS must be non-empty")
+        start = resume_pos if resume_cache is not None else 0
+        if start + len(prompt) + max_tokens > self._max_seq:
+            raise ValueError(
+                "position ({}) + prompt ({}) + max_tokens ({}) exceeds max "
+                "sequence {}".format(
+                    start, len(prompt), max_tokens, self._max_seq
+                )
+            )
+        stream = _Stream(prompt, int(max_tokens), eos_id,
+                         resume_cache, int(resume_pos), on_finish)
+        with self._cond:
+            if self._closed:
+                raise SchedulerClosed("scheduler is shut down")
+            if len(self._pending) >= self._max_pending:
+                raise RuntimeError(
+                    "scheduler admission queue is full ({} waiting "
+                    "generations); retry later".format(len(self._pending))
+                )
+            self._pending.append(stream)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name="decode-scheduler", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return self._drain(stream)
+
+    @staticmethod
+    def _drain(stream):
+        try:
+            while True:
+                kind, a, b = stream.queue.get()
+                if kind == "tok":
+                    yield a, b
+                elif kind == "err":
+                    stream.finished = True
+                    raise a
+                else:  # "done"
+                    stream.finished = True
+                    return
+        finally:
+            if not stream.finished:
+                # consumer gone mid-generation (client cancel/disconnect
+                # closes the generator): flag the stream so the decode
+                # loop retires its slot instead of burning batched steps
+                # on tokens nobody will read
+                stream.cancelled = True
+
+    def close(self):
+        """Stop the loop; pending and in-flight requests error out.
+        Subsequent submits raise SchedulerClosed."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=30)
+
+    # -- decode loop -------------------------------------------------------
+
+    def _fail(self, stream, exc):
+        stream.queue.put(("err", exc, None))
+
+    def _run(self):
+        slots = [None] * self._max_slots  # slot -> _Stream | None
+        try:
+            self._loop(slots)
+        except Exception as e:  # noqa: BLE001 — the loop must not die
+            # silently: an unexpected failure (e.g. OOM inside the
+            # step-recovery path) would otherwise leave every consumer
+            # blocked forever on its queue
+            with self._cond:
+                pending = list(self._pending)
+                self._pending.clear()
+            for stream in slots:
+                if stream is not None:
+                    self._fail(stream, e)
+            for stream in pending:
+                self._fail(stream, e)
+
+    def _loop(self, slots):
+        fns = self._fns
+        cache = fns["init_cache"]()
+        logits = fns["init_logits"]()
+        inflight = None  # (tokens_dev, logps_dev, snapshot)
+
+        def finish(stream, slot):
+            if stream.on_finish is not None:
+                try:
+                    stream.on_finish(fns["extract"](cache, slot))
+                except Exception as e:  # noqa: BLE001 — park is per-stream
+                    self._fail(stream, e)
+                    slots[slot] = None
+                    return
+            stream.queue.put(("done", None, None))
+            slots[slot] = None
+
+        while True:
+            with self._cond:
+                while (
+                    not self._closed
+                    and not self._pending
+                    and inflight is None
+                    and not any(s is not None for s in slots)
+                ):
+                    self._cond.wait()
+                if self._closed:
+                    pending = list(self._pending)
+                    self._pending.clear()
+                    break
+                # reap cancelled streams first: their consumers are gone,
+                # so the slot frees for waiting work (no park — the
+                # single-stream path abandoned mid-generation doesn't
+                # park either)
+                for i, st in enumerate(slots):
+                    if st is not None and st.cancelled:
+                        slots[i] = None
+                admissions = []
+                free = [i for i, s in enumerate(slots) if s is None]
+                while self._pending and free:
+                    st = self._pending.popleft()
+                    if st.cancelled:
+                        continue  # abandoned while still queued
+                    admissions.append((free.pop(0), st))
+            # device work runs OUTSIDE the lock: submitters must be able
+            # to enqueue while the chip computes
+            for slot, stream in admissions:
+                try:
+                    cache, logits = self._admit(cache, logits, slot, stream)
+                except Exception as e:  # noqa: BLE001 — per-request fault
+                    self._fail(stream, e)
+                    continue
+                slots[slot] = stream
+
+            current = None
+            active_ids = [i for i, s in enumerate(slots) if s is not None]
+            if active_ids:
+                # sentinel position max_seq on inert rows: their cache
+                # writes drop instead of corrupting a parked slot
+                positions = np.full(
+                    (self._max_slots,), self._max_seq, np.int32)
+                active = np.zeros((self._max_slots,), bool)
+                forced_tok = np.zeros((self._max_slots,), np.int32)
+                forced_mask = np.zeros((self._max_slots,), bool)
+                snapshot = []
+                for i in active_ids:
+                    st = slots[i]
+                    positions[i] = st.pos
+                    active[i] = True
+                    was_forced = bool(st.forced)
+                    if was_forced:
+                        forced_tok[i] = st.forced.popleft()
+                        forced_mask[i] = True
+                    snapshot.append((i, st, was_forced))
+                    st.pos += 1
+                try:
+                    tokens_dev, logps_dev, logits, cache = fns["step"](
+                        self._params, cache, logits, positions, active,
+                        forced_tok, forced_mask,
+                    )
+                    current = (tokens_dev, logps_dev, snapshot)
+                except Exception as e:  # noqa: BLE001
+                    # a failed dispatch may have consumed the donated
+                    # cache/logits: fail every live stream and reset
+                    for i, st, _ in snapshot:
+                        self._fail(st, e)
+                        slots[i] = None
+                    if inflight is not None:
+                        for i, st, _ in inflight[2]:
+                            if slots[i] is st:
+                                self._fail(st, e)
+                                slots[i] = None
+                    inflight = None
+                    cache = fns["init_cache"]()
+                    logits = fns["init_logits"]()
+                    continue
+
+            if inflight is not None:
+                tokens_dev, logps_dev, snapshot = inflight
+                try:
+                    toks = np.asarray(tokens_dev)
+                    lps = np.asarray(logps_dev)
+                except Exception as e:  # noqa: BLE001
+                    for i, st, _ in snapshot:
+                        if slots[i] is st:
+                            self._fail(st, e)
+                            slots[i] = None
+                    inflight = current
+                    continue
+                for i, st, was_forced in snapshot:
+                    if slots[i] is not st:
+                        # slot retired (and possibly re-admitted) after
+                        # this step was dispatched: its token is the
+                        # one-deep pipeline's wasted extra — discard
+                        continue
+                    if st.cancelled:
+                        slots[i] = None  # consumer gone: free the slot
+                        continue
+                    if was_forced:
+                        continue  # resumed-prompt feed, nothing to emit
+                    tok = int(toks[i])
+                    if st.emitted < st.max_tokens:
+                        st.queue.put(("tok", tok, float(lps[i])))
+                        st.emitted += 1
+                    if st.emitted >= st.max_tokens or (
+                        st.eos_id is not None and tok == st.eos_id
+                    ):
+                        finish(st, i)
+            inflight = current
+
+        # closed: fail whatever is still queued or running
+        err = SchedulerClosed("scheduler is shut down")
+        if inflight is not None:
+            for i, st, _ in inflight[2]:
+                if slots[i] is st:
+                    slots[i] = None
+                    self._fail(st, err)
+        for st in slots:
+            if st is not None:
+                self._fail(st, err)
+        for st in pending:
+            self._fail(st, err)
+
+    def _admit(self, cache, logits, slot, stream):
+        """Prefill-on-admit (or parked-cache restore) into ``slot``."""
+        import jax.numpy as jnp
+
+        fns = self._fns
+        if stream.resume_cache is not None:
+            # resumed generation: the parked rows become the slot's
+            # cache and the new prompt replays as forced tokens (the
+            # single-stream resume path feeds them through decode the
+            # same way).  The parked array itself is only READ — the
+            # region's copy stays valid for the next resume.
+            slot_cache = stream.resume_cache
+            row = jnp.zeros((1, logits.shape[1]), logits.dtype)
+            stream.forced.extend(int(t) for t in stream.prompt)
+            stream.pos = stream.resume_pos
+        else:
+            # prompts pad to power-of-two buckets so admission compiles
+            # a handful of prefill shapes, not one per length — a novel
+            # length's full-model compile would stall EVERY in-flight
+            # stream's token emission.  Causal attention keeps the
+            # result exact (prefill_to_length); padding rows' garbage
+            # K/V stay masked behind the slot's position.  The model
+            # decides the bucket (exact length where padding would flip
+            # its prefill kernel choice and with it the greedy tokens).
+            true_len = len(stream.prompt)
+            bucket = self._fns["prefill_bucket"](true_len)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:true_len] = stream.prompt
+            slot_cache = fns["init_slot_cache"]()
+            row, slot_cache = fns["prefill"](
+                self._params, slot_cache, jnp.asarray(padded)[None, :],
+                true_len,
+            )
+            stream.pos = true_len
+        cache, logits = fns["admit"](cache, logits, slot_cache, row, slot)
+        return cache, logits

@@ -170,6 +170,44 @@ def test_error_fans_out_to_all_requests():
         core.close()
 
 
+def test_misdeclared_unbatched_output_fails_loudly():
+    """A declared output returned WITHOUT the batch dim (e.g. [1000]
+    class scores for a 3-row batch) must error every request in the
+    batch, not silently slice wrong per-request rows (advisor r5
+    finding)."""
+
+    class _Unbatched(_RowOffsetModel):
+        name = "unbatched"
+
+        def execute(self, inputs, request):
+            return {"OUT": np.zeros((1000,), np.float32)}  # no batch dim
+
+    core = InferenceServer([_Unbatched()])
+    try:
+        errs, oks = [], []
+
+        def worker(i):
+            x = np.full((1, 4), float(i), dtype=np.float32)
+            try:
+                core.infer(InferRequest("unbatched", inputs={"IN": x}))
+                oks.append(i)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not oks
+        assert len(errs) == 3
+        assert all("batch dim" in str(e) for e in errs)
+    finally:
+        core.close()
+
+
 def test_config_reports_dynamic_batching(batch_core):
     core, _ = batch_core
     cfg = core.model_config("rowoffset")

@@ -64,20 +64,47 @@ def test_matmul_w8a8_prefill_scale_accuracy(weight):
 
 
 def test_matmul_threshold_is_static_row_count(weight):
-    """The W8A8/upcast split keys on the activation's row dimension:
-    identical inputs padded across the threshold must both stay close
-    to the bf16 reference (the regimes differ only in rounding)."""
+    """The W8A8/upcast split keys on the activation's TOKEN dimension
+    (axis -2 of a >=3-D activation): inputs padded across the threshold
+    must both stay close to the bf16 reference (the regimes differ only
+    in rounding)."""
     q = quant.quantize_int8(weight, axis=0)
     rng = np.random.RandomState(3)
-    small = jnp.asarray(rng.standard_normal((7, 64)), jnp.bfloat16)
-    big = jnp.concatenate([small, small[:1]], axis=0)  # 8 rows
-    ref_small = np.asarray(small @ weight, np.float32)
-    ref_big = np.asarray(big @ weight, np.float32)
+    small = jnp.asarray(rng.standard_normal((1, 7, 64)), jnp.bfloat16)
+    big = jnp.concatenate([small, small[:, :1]], axis=1)  # 8 tokens
+    ref_small = np.asarray(
+        small.astype(jnp.float32) @ weight.astype(jnp.float32), np.float32)
+    ref_big = np.asarray(
+        big.astype(jnp.float32) @ weight.astype(jnp.float32), np.float32)
     got_small = np.asarray(quant.matmul(small, q), np.float32)
     got_big = np.asarray(quant.matmul(big, q), np.float32)
     for got, ref in ((got_small, ref_small), (got_big, ref_big)):
         rel = np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-3)
         assert rel <= 0.08, rel
+
+
+def test_matmul_2d_is_batch_invariant(weight):
+    """2-D activations (a [B, D] lm_head input, where axis -2 is the
+    SERVER-SIDE batch) must never switch to the W8A8 regime: the same
+    row's numerics would otherwise silently change once concurrent
+    serving pushes the batch past 8 (advisor r5 finding)."""
+    q = quant.quantize_int8(weight, axis=0)
+    rng = np.random.RandomState(4)
+    one = jnp.asarray(rng.standard_normal((1, 64)), jnp.bfloat16)
+    batched = jnp.concatenate([one] * 9, axis=0)  # 9 identical rows
+    row_alone = np.asarray(quant.matmul(one, q), np.float32)[0]
+    row_in_batch = np.asarray(quant.matmul(batched, q), np.float32)[0]
+    np.testing.assert_array_equal(row_alone, row_in_batch)
+
+
+def test_gather_rows_threads_dtype(weight):
+    """gather_rows dequantizes into the caller's dtype (the model's
+    cfg.dtype), not hardcoded bfloat16 (advisor r5 finding)."""
+    table = quant.quantize_int8(weight, axis=1)
+    idx = jnp.asarray([1, 2], jnp.int32)
+    assert quant.gather_rows(table, idx).dtype == jnp.bfloat16  # default
+    assert quant.gather_rows(
+        table, idx, dtype=jnp.float32).dtype == jnp.float32
 
 
 def test_gather_rows_per_row_scales(weight):
