@@ -14,6 +14,8 @@ import time
 
 import numpy as np
 
+from tpuserver import faults
+from tpuserver import scheduler as _scheduler
 from tritonclient.utils import (
     deserialize_bytes_tensor,
     serialize_byte_tensor,
@@ -81,6 +83,10 @@ class InferRequest:
         self.inputs = inputs or {}  # name -> np.ndarray (BYTES as np.object_)
         self.requested_outputs = requested_outputs  # list[RequestedOutput]|None
         self.parameters = parameters or {}
+        # monotonic deadline: stamped by the gRPC frontend (context
+        # deadline) and/or resolved from the 'timeout' parameter in
+        # InferenceServer._resolve_deadline
+        self.deadline = None
 
     @property
     def sequence_id(self):
@@ -110,11 +116,41 @@ class InferResponse:
 
 
 class ServerError(Exception):
-    """Server-side error carrying an HTTP-ish status code."""
+    """Server-side error carrying an HTTP-ish status code.
 
-    def __init__(self, msg, code=400):
+    ``retry_after`` (seconds, or None) is advisory: frontends surface it
+    as the HTTP ``Retry-After`` header / gRPC ``retry-after`` trailing
+    metadata so well-behaved clients back off instead of hammering."""
+
+    def __init__(self, msg, code=400, retry_after=None):
         super().__init__(msg)
         self.code = code
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(ServerError):
+    """The request's deadline (its ``timeout`` parameter or the gRPC
+    context deadline) expired — HTTP 504 / gRPC DEADLINE_EXCEEDED."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=504)
+
+
+class Overloaded(ServerError):
+    """The server shed this request under load (admission queue full or
+    in-flight cap reached) — HTTP 429 + Retry-After / gRPC
+    RESOURCE_EXHAUSTED.  Retryable by contract."""
+
+    def __init__(self, msg, retry_after=1):
+        super().__init__(msg, code=429, retry_after=retry_after)
+
+
+class ShuttingDown(ServerError):
+    """The server is draining or stopped and not accepting new work —
+    HTTP 503 / gRPC UNAVAILABLE.  Retryable against another replica."""
+
+    def __init__(self, msg, retry_after=None):
+        super().__init__(msg, code=503, retry_after=retry_after)
 
 
 class Model:
@@ -715,13 +751,30 @@ class _ModelStats:
 
 
 class InferenceServer:
-    """The serving core: models, shared memory, statistics, settings."""
+    """The serving core: models, shared memory, statistics, settings.
 
-    def __init__(self, models=None):
+    Lifecycle: ``starting`` (constructed with ``ready=False``, e.g.
+    while warmup compiles run) -> ``ready`` -> ``draining`` (via
+    :meth:`drain`/:meth:`begin_drain`) -> ``stopped`` (via
+    :meth:`close`).  :meth:`server_ready` reports True only in
+    ``ready`` with every model's health check passing, so load
+    balancers see drain and watchdog trips, not a constant.
+
+    ``max_inflight`` is the server-wide overload valve: when that many
+    requests are executing, further ones are shed with a typed
+    :class:`Overloaded` (HTTP 429 + Retry-After) instead of queueing
+    without bound behind a saturated device.
+    """
+
+    def __init__(self, models=None, max_inflight=None, ready=True):
         self._models = {}  # name -> Model
         self._ready = {}  # name -> bool
         self._stats = {}  # name -> _ModelStats
         self._lock = threading.Lock()
+        self._state = "ready" if ready else "starting"
+        self._max_inflight = max_inflight
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
         self._system_shm = {}
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
@@ -815,7 +868,120 @@ class InferenceServer:
             model is not None
             and version in ("", model.version)
             and self._ready.get(name, False)
+            and self._state == "ready"
+            and self._model_healthy(model)
         )
+
+    @staticmethod
+    def _model_healthy(model):
+        """A model may expose ``healthy`` (property or callable) — e.g.
+        the continuous-batching scheduler's watchdog; absent means
+        healthy."""
+        probe = getattr(model, "healthy", None)
+        if probe is None:
+            return True
+        return bool(probe() if callable(probe) else probe)
+
+    # -- lifecycle / readiness ---------------------------------------------
+
+    def server_state(self):
+        """``starting`` | ``ready`` | ``draining`` | ``stopped``."""
+        return self._state
+
+    def server_ready(self):
+        """Real readiness for load balancers: True only when serving
+        (not starting/draining/stopped) and every registered model's
+        health probe passes (a tripped scheduler watchdog reports
+        here)."""
+        if self._state != "ready":
+            return False
+        with self._lock:  # snapshot: register_model mutates under _lock
+            models = list(self._models.items())
+        for name, model in models:
+            if self._ready.get(name, False) and not self._model_healthy(
+                model
+            ):
+                return False
+        return True
+
+    def mark_ready(self):
+        """Flip a ``starting`` server to ``ready`` (after warmup)."""
+        with self._inflight_cond:
+            if self._state == "starting":
+                self._state = "ready"
+
+    def set_max_inflight(self, max_inflight):
+        """Adjust the server-wide in-flight cap at runtime (None lifts
+        it); an ops valve, also what overload tests flip."""
+        with self._inflight_cond:
+            self._max_inflight = max_inflight
+            self._inflight_cond.notify_all()
+
+    def _enter_inflight(self):
+        with self._inflight_cond:
+            if self._state != "ready":
+                reason = {
+                    "starting": "starting and not yet ready",
+                    "draining": "draining",
+                }.get(self._state, "shut down")
+                raise ShuttingDown(
+                    "server is {}; not accepting new requests".format(
+                        reason
+                    )
+                )
+            if (
+                self._max_inflight is not None
+                and self._inflight >= self._max_inflight
+            ):
+                raise Overloaded(
+                    "server is at its in-flight request cap ({}); "
+                    "retry later".format(self._max_inflight)
+                )
+            self._inflight += 1
+
+    def _exit_inflight(self):
+        with self._inflight_cond:
+            self._inflight -= 1
+            self._inflight_cond.notify_all()
+
+    def inflight_count(self):
+        with self._inflight_cond:
+            return self._inflight
+
+    def begin_drain(self):
+        """Stop admission and flip readiness; in-flight work continues.
+        The first half of :meth:`drain`, split out so probes can observe
+        the draining state."""
+        with self._inflight_cond:
+            if self._state != "stopped":
+                self._state = "draining"
+
+    def drain(self, timeout=30.0):
+        """Graceful shutdown: stop admission (new requests get a typed
+        503), let in-flight requests — including scheduler-backed
+        generations — finish within ``timeout`` seconds, then close,
+        deterministically failing whatever remains."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout
+        # model-owned schedulers drain first: their in-flight
+        # generations are the long-lived work the deadline budgets for.
+        # Per-model guard: one failing drainer must not abort the whole
+        # graceful shutdown (the server would be stuck 'draining' with
+        # close() never reached)
+        for model in list(self._models.values()):
+            drainer = getattr(model, "drain", None)
+            if callable(drainer):
+                try:
+                    drainer(max(0.0, deadline - time.monotonic()))
+                except Exception:  # noqa: BLE001 — close() must run
+                    pass
+        with self._inflight_cond:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._inflight_cond.wait(remaining)
+        self.close()
 
     def load_model(self, name):
         if name not in self._models:
@@ -1017,6 +1183,7 @@ class InferenceServer:
 
         For XLA regions holding live device buffers this returns the
         ``jax.Array`` itself — no host copy."""
+        faults.fire("core.shm_read")  # shm-read-failure chaos hook
         region = self._shm_region(region_name)
         if isinstance(region, _XlaShmRegion):
             arr = region.get_device_array(offset, datatype, shape)
@@ -1049,19 +1216,60 @@ class InferenceServer:
 
     # -- inference ---------------------------------------------------------
 
+    @staticmethod
+    def _resolve_deadline(request):
+        """One canonical monotonic deadline per request: the ``timeout``
+        request parameter (microseconds, Triton semantics) combined with
+        any transport deadline the frontend stamped on
+        ``request.deadline`` (the gRPC context deadline) — the sooner
+        wins.  Stored back on the request so downstream consumers (the
+        decode scheduler) see the same bound."""
+        deadline = getattr(request, "deadline", None)
+        t = request.parameters.get("timeout")
+        if t:
+            try:
+                param_deadline = time.monotonic() + int(t) / 1e6
+            except (TypeError, ValueError):
+                raise ServerError(
+                    "request parameter 'timeout' must be an integer "
+                    "microsecond count (got {!r})".format(t)
+                )
+            deadline = (
+                param_deadline
+                if deadline is None
+                else min(deadline, param_deadline)
+            )
+        request.deadline = deadline
+        return deadline
+
+    @staticmethod
+    def _check_deadline(deadline):
+        if deadline is not None and time.monotonic() >= deadline:
+            raise DeadlineExceeded(
+                "request deadline expired before execution"
+            )
+
     def infer(self, request):
         """Execute one inference request; returns InferResponse.
 
         Decoupled models are rejected here (use ``infer_stream``), matching
         server behavior for non-streaming endpoints.
         """
-        model = self._get_model(request.model_name, request.model_version)
-        if model.decoupled:
-            raise ServerError(
-                "model '{}' is a decoupled model: it can only be served over "
-                "the streaming endpoint".format(model.name)
+        deadline = self._resolve_deadline(request)
+        self._check_deadline(deadline)
+        self._enter_inflight()
+        try:
+            model = self._get_model(
+                request.model_name, request.model_version
             )
-        return self._execute(model, request)
+            if model.decoupled:
+                raise ServerError(
+                    "model '{}' is a decoupled model: it can only be served "
+                    "over the streaming endpoint".format(model.name)
+                )
+            return self._execute(model, request)
+        finally:
+            self._exit_inflight()
 
     def infer_stream(self, request):
         """Execute a (possibly decoupled) request; yields InferResponse(s).
@@ -1070,6 +1278,15 @@ class InferenceServer:
         trailing empty response marked ``triton_final_response`` is emitted
         so clients can detect completion of data-dependent-length streams.
         """
+        deadline = self._resolve_deadline(request)
+        self._check_deadline(deadline)
+        self._enter_inflight()
+        try:
+            yield from self._infer_stream_inner(request)
+        finally:
+            self._exit_inflight()
+
+    def _infer_stream_inner(self, request):
         want_final = bool(
             request.parameters.get("triton_enable_empty_final_response")
         )
@@ -1086,17 +1303,33 @@ class InferenceServer:
         count = 0
         try:
             for out in model.execute_stream(inputs, request):
+                # per-response deadline enforcement covers EVERY
+                # decoupled model (the scheduler path also self-expires;
+                # the single-stream path relies on this check): a token
+                # produced past the deadline belongs to a request whose
+                # client has stopped waiting
+                self._check_deadline(request.deadline)
                 count += 1
                 resp = self._make_response(model, request, out,
                                            mark_final=False)
                 if want_final:
                     resp.parameters["triton_final_response"] = False
                 yield resp
-        except ServerError:
-            self._stats[model.name].record(0, 0, 0, 0, 0, ok=False)
-            raise
         except Exception as e:
             self._stats[model.name].record(0, 0, 0, 0, 0, ok=False)
+            if isinstance(e, ServerError):
+                raise
+            # the scheduler's typed failures keep their meaning on the
+            # wire: deadline -> 504, admission-full -> 429
+            # (+Retry-After), closed/draining -> 503 — instead of the
+            # generic 500 wrap
+            for sched_exc, wrapper in (
+                (_scheduler.DeadlineExceeded, DeadlineExceeded),
+                (_scheduler.AdmissionQueueFull, Overloaded),
+                (_scheduler.SchedulerClosed, ShuttingDown),
+            ):
+                if isinstance(e, sched_exc):
+                    raise wrapper("model '{}': {}".format(model.name, e))
             raise ServerError(
                 "inference failed for model '{}': {}".format(model.name, e),
                 code=500,
@@ -1174,6 +1407,16 @@ class InferenceServer:
                 code=400 if isinstance(e, ValueError) else 500,
             )
         t_co0 = time.monotonic_ns()
+        # the deadline is a contract, not advice: a result produced past
+        # it is reported as 504 (the client has stopped waiting) and
+        # counted as a failure in the model stats
+        if request.deadline is not None and time.monotonic() >= (
+            request.deadline
+        ):
+            stats.record(0, 0, 0, 0, 0, ok=False)
+            raise DeadlineExceeded(
+                "request deadline expired during execution"
+            )
         resp = self._make_response(model, request, outputs)
         t_end = time.monotonic_ns()
         stats.record(
@@ -1192,7 +1435,11 @@ class InferenceServer:
         no request object)."""
         if not (model.dynamic_batching and model.max_batch_size > 1):
             return False
-        if request.parameters or not inputs:
+        # lifecycle-only parameters (deadline/priority plumbing) don't
+        # make a request un-batchable — the deadline is enforced in
+        # infer(), not inside batched execution
+        extra_params = set(request.parameters) - {"timeout", "priority"}
+        if extra_params or not inputs:
             return False
         on_device = getattr(model, "device_kind", "") == "tpu"
         rows = None
@@ -1233,6 +1480,9 @@ class InferenceServer:
         with self._lock:
             self._frontends += 1
             self._closed = False  # re-attach after close re-opens
+        with self._inflight_cond:
+            if self._state == "stopped":
+                self._state = "ready"
 
     def detach_frontend(self):
         to_stop = []
@@ -1255,6 +1505,9 @@ class InferenceServer:
         schedulers via the model's own ``close``).  Safe to call twice;
         after close, batched/scheduled inference is rejected rather than
         lazily recreating workers."""
+        with self._inflight_cond:
+            self._state = "stopped"
+            self._inflight_cond.notify_all()
         with self._lock:
             self._closed = True
             batchers, self._batchers = list(self._batchers.values()), {}
@@ -1435,6 +1688,27 @@ class InferenceServer:
         return InferResponse(
             model.name, model.version, request.id, resp_outputs
         )
+
+
+def install_sigterm_drain(server, drain_timeout=30.0):
+    """Install a SIGTERM handler that gracefully drains ``server``:
+    admission stops and readiness flips immediately (so load balancers
+    route away), in-flight generations finish within ``drain_timeout``
+    seconds, and the rest fail deterministically.  The drain runs on a
+    worker thread — signal handlers must return promptly.  Returns the
+    previous handler (pass it back to ``signal.signal`` to restore).
+    Main-thread only, as all Python signal installation is."""
+    import signal
+
+    def _handler(signum, frame):
+        threading.Thread(
+            target=server.drain,
+            args=(drain_timeout,),
+            name="sigterm-drain",
+            daemon=True,
+        ).start()
+
+    return signal.signal(signal.SIGTERM, _handler)
 
 
 def _np_to_wire(array):

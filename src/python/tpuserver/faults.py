@@ -1,0 +1,172 @@
+"""Named fault-injection points for chaos testing the serving stack.
+
+Production code calls :func:`fire` at well-known points; by default that
+is a no-op costing one dict check.  Chaos tests (tests/test_chaos.py,
+tools/chaos_smoke.py) arm a point with :func:`install` or the
+:class:`injected` context manager, and the next ``times`` passes through
+it raise :class:`FaultInjected` (``mode="raise"``) or stall for
+``delay`` seconds (``mode="sleep"``).  The recovery invariants the
+scheduler and core promise — donated-cache rebuild, zero leaked slots,
+typed errors to every consumer — are only trustworthy because these
+hooks let tests force the failure paths on demand.
+
+Registered injection points:
+
+==================  ========================================================
+``scheduler.step``   before each batched decode-step dispatch
+                     (``mode="raise"`` = decode-step failure, the donated
+                     cache/logits recovery path; ``mode="sleep"`` = slow
+                     step, for deadline/overload pressure)
+``scheduler.fetch``  before the device->host token transfer of a completed
+                     step (host-transfer failure)
+``scheduler.admit``  before a prefill-on-admit (admission failure: the
+                     request fails, other slots keep decoding)
+``core.shm_read``    before a shared-memory input read (shm read error)
+==================  ========================================================
+
+Env knob: ``TPUSERVER_FAULTS`` arms points at import time without code
+changes, as a comma-separated list of ``name:mode[:times[:delay]]``
+entries, e.g.::
+
+    TPUSERVER_FAULTS="scheduler.step:raise:1,scheduler.fetch:sleep:-1:0.05"
+
+``times=-1`` means unlimited.  :func:`clear` disarms.
+"""
+
+import os
+import threading
+import time
+
+__all__ = [
+    "FaultInjected", "fire", "install", "clear", "fired", "active",
+    "injected", "load_env",
+]
+
+
+class FaultInjected(RuntimeError):
+    """The error raised by an armed ``mode="raise"`` injection point."""
+
+    def __init__(self, point):
+        super().__init__("injected fault at '{}'".format(point))
+        self.point = point
+
+
+class _Fault:
+    __slots__ = ("name", "mode", "remaining", "delay", "fired")
+
+    def __init__(self, name, mode, times, delay):
+        if mode not in ("raise", "sleep"):
+            raise ValueError(
+                "fault mode must be 'raise' or 'sleep' (got {!r})".format(
+                    mode)
+            )
+        self.name = name
+        self.mode = mode
+        self.remaining = int(times)
+        self.delay = float(delay)
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_points = {}  # name -> _Fault
+
+
+def install(name, mode="raise", times=1, delay=0.0):
+    """Arm injection point ``name``: the next ``times`` fires raise
+    (``mode="raise"``) or sleep ``delay`` seconds (``mode="sleep"``).
+    ``times=-1`` keeps the point armed until :func:`clear`."""
+    fault = _Fault(name, mode, times, delay)
+    with _lock:
+        _points[name] = fault
+    return fault
+
+
+def clear(name=None):
+    """Disarm one point (or all, when ``name`` is None)."""
+    with _lock:
+        if name is None:
+            _points.clear()
+        else:
+            _points.pop(name, None)
+
+
+def fired(name):
+    """How many times point ``name`` has actually fired (0 if unarmed)."""
+    with _lock:
+        fault = _points.get(name)
+        return fault.fired if fault is not None else 0
+
+
+def active(name):
+    """Whether point ``name`` is armed with fires remaining."""
+    with _lock:
+        fault = _points.get(name)
+        return fault is not None and fault.remaining != 0
+
+
+def fire(name):
+    """The production-side hook: no-op unless ``name`` is armed.
+
+    Raises :class:`FaultInjected` (mode ``raise``) or sleeps (mode
+    ``sleep``) and decrements the point's remaining count.  The sleep
+    happens OUTSIDE the registry lock so a slow point never blocks
+    arming/disarming other points.
+    """
+    if not _points:  # fast path: nothing armed anywhere
+        return
+    with _lock:
+        fault = _points.get(name)
+        if fault is None or fault.remaining == 0:
+            return
+        if fault.remaining > 0:
+            fault.remaining -= 1
+        fault.fired += 1
+        mode, delay = fault.mode, fault.delay
+    if mode == "sleep":
+        time.sleep(delay)
+        return
+    raise FaultInjected(name)
+
+
+class injected:
+    """Context manager: arm a point on enter, disarm on exit.
+
+    >>> with faults.injected("scheduler.step"):
+    ...     # the next decode step raises FaultInjected
+    """
+
+    def __init__(self, name, mode="raise", times=1, delay=0.0):
+        self._name = name
+        self._args = (mode, times, delay)
+        self.fault = None
+
+    def __enter__(self):
+        self.fault = install(self._name, *self._args)
+        return self.fault
+
+    def __exit__(self, exc_type, exc, tb):
+        clear(self._name)
+        return False
+
+
+def load_env(env=None):
+    """Arm points from ``TPUSERVER_FAULTS`` (see module docstring)."""
+    spec = (env if env is not None else os.environ).get(
+        "TPUSERVER_FAULTS", "")
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                "TPUSERVER_FAULTS entry {!r} needs at least "
+                "'name:mode'".format(entry)
+            )
+        name, mode = parts[0], parts[1]
+        times = int(parts[2]) if len(parts) > 2 else 1
+        delay = float(parts[3]) if len(parts) > 3 else 0.0
+        install(name, mode=mode, times=times, delay=delay)
+
+
+load_env()

@@ -7,6 +7,7 @@ The service layer is a generic-handler table over the vendored pb2 messages
 generated stubs.
 """
 
+import time
 from concurrent import futures
 
 import numpy as np
@@ -166,7 +167,10 @@ class _CoreBridge:
         return pb.ServerLiveResponse(live=True)
 
     def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # real core state (starting/draining/watchdog-tripped), not a
+        # constant: load balancers must see drain begin before requests
+        # start failing
+        return pb.ServerReadyResponse(ready=self._core.server_ready())
 
     def ModelReady(self, request, context):
         return pb.ModelReadyResponse(
@@ -333,8 +337,21 @@ class _CoreBridge:
 
     # -- inference ---------------------------------------------------------
 
+    @staticmethod
+    def _stamp_deadline(core_request, context):
+        """Thread the client's gRPC context deadline into the core as a
+        monotonic bound (None when the client set none): the scheduler
+        expires pending admissions and retires in-flight slots past it,
+        and the typed DeadlineExceeded maps back to DEADLINE_EXCEEDED."""
+        remaining = context.time_remaining()
+        if remaining is not None:
+            core_request.deadline = time.monotonic() + remaining
+        return core_request
+
     def ModelInfer(self, request, context):
-        core_request = self._request_from_proto(request)
+        core_request = self._stamp_deadline(
+            self._request_from_proto(request), context
+        )
         resp = self._core.infer(core_request)
         return self._response_to_proto(resp)
 
@@ -418,7 +435,8 @@ class _CoreBridge:
                     if cancelled.is_set():
                         break
                     try:
-                        core_request = self._request_from_proto(request)
+                        core_request = self._stamp_deadline(
+                            self._request_from_proto(request), context)
                     except Exception as e:
                         emit(pb.ModelStreamInferResponse(
                             error_message=str(e)))
@@ -483,6 +501,12 @@ def _wrap_unary(bridge, name):
         try:
             return method(request, context)
         except ServerError as e:
+            if getattr(e, "retry_after", None) is not None:
+                # the gRPC twin of the HTTP Retry-After header: clients
+                # with a retry policy read it from trailing metadata
+                context.set_trailing_metadata(
+                    (("retry-after", str(int(e.retry_after))),)
+                )
             context.abort(_status_code(e.code), str(e))
         except Exception as e:
             context.abort(grpc.StatusCode.INTERNAL, str(e))
@@ -494,8 +518,11 @@ def _status_code(http_code):
     return {
         400: grpc.StatusCode.INVALID_ARGUMENT,
         404: grpc.StatusCode.NOT_FOUND,
+        429: grpc.StatusCode.RESOURCE_EXHAUSTED,
         500: grpc.StatusCode.INTERNAL,
         501: grpc.StatusCode.UNIMPLEMENTED,
+        503: grpc.StatusCode.UNAVAILABLE,
+        504: grpc.StatusCode.DEADLINE_EXCEEDED,
     }.get(http_code, grpc.StatusCode.UNKNOWN)
 
 

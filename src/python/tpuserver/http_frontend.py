@@ -67,7 +67,10 @@ _STATUS_LINE = {
     400: b"HTTP/1.1 400 Bad Request\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
     405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
 }
 
 
@@ -158,7 +161,12 @@ class _Handler(socketserver.StreamRequestHandler):
         try:
             self._route(method)
         except ServerError as e:
-            self._send_error_json(str(e), e.code)
+            headers = None
+            if getattr(e, "retry_after", None) is not None:
+                # overload shedding contract: 429/503 carry Retry-After
+                # so retrying clients back off instead of hammering
+                headers = {"Retry-After": int(e.retry_after)}
+            self._send_error_json(str(e), e.code, headers)
         except ValueError as e:
             self._send_error_json("malformed request: {}".format(e), 400)
         except Exception as e:  # pragma: no cover
@@ -292,8 +300,8 @@ class _Handler(socketserver.StreamRequestHandler):
             200, ("\n".join(lines) + "\n").encode("utf-8"),
             content_type="text/plain")
 
-    def _send_error_json(self, msg, code=400):
-        self._send_json({"error": msg}, code)
+    def _send_error_json(self, msg, code=400, headers=None):
+        self._send_json({"error": msg}, code, headers)
 
     def _read_body(self):
         """Read (once) and cache the request body.
@@ -319,7 +327,9 @@ class _Handler(socketserver.StreamRequestHandler):
         if path == "/v2/health/live":
             return self._send(200)
         if path == "/v2/health/ready":
-            return self._send(200)
+            # real readiness (starting/draining/watchdog-tripped all
+            # report 503), not a constant — load balancers route on this
+            return self._send(200 if core.server_ready() else 503)
         if path == "/v2" or path == "/v2/":
             return self._send_json(core.server_metadata())
         if path == "/v2/models/stats":

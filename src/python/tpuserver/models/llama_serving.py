@@ -72,7 +72,7 @@ class LlamaGenerateModel(Model):
 
     def __init__(self, cfg=None, max_seq=512, server=None,
                  decode_chunk=None, mesh=None, quantize=False,
-                 max_slots=1):
+                 max_slots=1, max_pending=None):
         self._cfg = cfg or llama.tiny(vocab=2048)
         self._max_seq = max_seq
         self._server = server  # for kv_cache_region xla-shm lookups
@@ -86,6 +86,7 @@ class LlamaGenerateModel(Model):
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots))
         self._max_slots = int(max_slots)
+        self._max_pending = max_pending  # admission-queue bound override
         self._scheduler = None  # DecodeScheduler when max_slots > 1
         # continuous-batching models interleave many streams' responses;
         # the frontends must not serialize their stream requests
@@ -149,7 +150,8 @@ class LlamaGenerateModel(Model):
                         mesh=self._mesh, quantized=self._quantize,
                     )
                     self._scheduler = DecodeScheduler(
-                        fns, params, self._max_slots, self._max_seq
+                        fns, params, self._max_slots, self._max_seq,
+                        max_pending=self._max_pending,
                     )
                 elif self._mesh is not None:
                     init_cache, prefill_fn, chunk_fn = (
@@ -393,10 +395,21 @@ class LlamaGenerateModel(Model):
                 # later request may resume on either path
                 region.put_device_array(0, cache_rows)
 
-        stream = self._scheduler.submit(
+        scheduler = self._scheduler
+        if scheduler is None:
+            # close() nulled the scheduler after this request was
+            # admitted: same typed outcome as racing submit into it
+            from tpuserver.scheduler import SchedulerClosed
+
+            raise SchedulerClosed("scheduler is shut down")
+        stream = scheduler.submit(
             prompt, max_tokens, eos_id=eos_id,
             resume_cache=jnp.asarray(parked) if parked is not None else None,
             resume_pos=pos, on_finish=on_finish,
+            # the deadline the core resolved (timeout parameter / gRPC
+            # context): the scheduler expires pending admissions before
+            # prefill and retires in-flight slots past it
+            deadline=getattr(request, "deadline", None),
         )
         for token, logprob in stream:
             yield {
@@ -404,8 +417,30 @@ class LlamaGenerateModel(Model):
                 "LOGPROB": np.array([logprob], dtype=np.float32),
             }
 
+    def healthy(self):
+        """Readiness probe hook: False once the decode loop's watchdog
+        has tripped or the scheduler is closed (``InferenceServer
+        .server_ready``/``model_ready`` report it).  Bound once: a
+        concurrent close() nulls ``_scheduler`` between reads."""
+        scheduler = self._scheduler
+        return scheduler is None or scheduler.healthy
+
+    def drain(self, timeout=30.0):
+        """Stop admission and let in-flight generations finish within
+        ``timeout`` seconds (called by ``InferenceServer.drain``);
+        no-op for max_slots=1."""
+        scheduler = self._scheduler
+        if scheduler is not None:
+            scheduler.drain(timeout)
+
     def close(self):
         """Stop the continuous-batching loop (no-op for max_slots=1).
-        Called by ``InferenceServer.close``."""
+        Called by ``InferenceServer.close``.  Compiled state is reset so
+        a server re-opened by a later frontend attach rebuilds a FRESH
+        scheduler on the next request instead of failing every
+        generation against the closed one forever."""
         if self._scheduler is not None:
             self._scheduler.close()
+            with self._lock:
+                self._scheduler = None
+                self._params = None

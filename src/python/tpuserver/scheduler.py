@@ -49,13 +49,29 @@ token-identical output to N sequential single-stream runs
 """
 
 import threading
+import time
 from collections import deque
 
 import numpy as np
 
+from tpuserver import faults
+
 
 class SchedulerClosed(Exception):
-    """Raised on submit after the scheduler has been shut down."""
+    """Raised on submit after the scheduler has been shut down (or while
+    it is draining), and into streams the shutdown failed."""
+
+
+class AdmissionQueueFull(RuntimeError):
+    """Raised on submit when the pending queue is at capacity — the
+    scheduler-level overload signal (RuntimeError subclass for backward
+    compatibility; frontends map it to HTTP 429 / RESOURCE_EXHAUSTED)."""
+
+
+class DeadlineExceeded(Exception):
+    """Raised into a stream whose per-request deadline expired — either
+    while waiting for admission (before prefill) or mid-generation (the
+    slot retires and frees immediately)."""
 
 
 class _Stream:
@@ -64,11 +80,11 @@ class _Stream:
     __slots__ = (
         "prompt", "max_tokens", "eos_id", "queue", "forced", "pos",
         "emitted", "on_finish", "resume_cache", "resume_pos", "finished",
-        "cancelled",
+        "cancelled", "deadline",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
-                 resume_pos, on_finish):
+                 resume_pos, on_finish, deadline=None):
         import queue as _queue
 
         self.prompt = prompt
@@ -83,6 +99,10 @@ class _Stream:
         self.resume_pos = resume_pos
         self.finished = False   # terminal queue event delivered
         self.cancelled = False  # consumer abandoned the token iterator
+        self.deadline = deadline  # time.monotonic() bound, or None
+
+    def expired(self, now):
+        return self.deadline is not None and now >= self.deadline
 
 
 class DecodeScheduler:
@@ -116,11 +136,17 @@ class DecodeScheduler:
         self._pending = deque()
         self._thread = None
         self._closed = False
+        self._draining = False
+        self._tripped = False  # decode loop died unexpectedly (watchdog)
+        # every live (not yet terminally-delivered) stream, pending or
+        # slotted: close() fails exactly this set when the loop cannot
+        # (join timeout), and drain() waits on it emptying
+        self._streams = set()
 
     # -- frontend side -----------------------------------------------------
 
     def submit(self, prompt, max_tokens, eos_id=None, resume_cache=None,
-               resume_pos=0, on_finish=None):
+               resume_pos=0, on_finish=None, deadline=None):
         """Enqueue one generation; returns an iterator of
         ``(token, logprob)`` pairs that blocks as the decode loop
         produces them.
@@ -128,7 +154,10 @@ class DecodeScheduler:
         ``resume_cache``/``resume_pos`` continue from a parked KV cache
         (the prompt replays through the batched step without emission);
         ``on_finish(cache_rows)`` receives the slot's final cache copy —
-        the park hook."""
+        the park hook.  ``deadline`` is a ``time.monotonic()`` bound:
+        past it, a still-pending request fails before prefill and an
+        in-flight one retires mid-generation, both with
+        :class:`DeadlineExceeded`."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("PROMPT_IDS must be non-empty")
@@ -141,17 +170,24 @@ class DecodeScheduler:
                 )
             )
         stream = _Stream(prompt, int(max_tokens), eos_id,
-                         resume_cache, int(resume_pos), on_finish)
+                         resume_cache, int(resume_pos), on_finish,
+                         deadline=deadline)
         with self._cond:
             if self._closed:
                 raise SchedulerClosed("scheduler is shut down")
+            if self._draining:
+                raise SchedulerClosed(
+                    "scheduler is draining; not accepting new generations"
+                )
             if len(self._pending) >= self._max_pending:
-                raise RuntimeError(
+                raise AdmissionQueueFull(
                     "scheduler admission queue is full ({} waiting "
                     "generations); retry later".format(len(self._pending))
                 )
             self._pending.append(stream)
+            self._streams.add(stream)
             if self._thread is None or not self._thread.is_alive():
+                self._tripped = False  # fresh loop, fresh device state
                 self._thread = threading.Thread(
                     target=self._run, name="decode-scheduler", daemon=True
                 )
@@ -180,20 +216,84 @@ class DecodeScheduler:
                 # on tokens nobody will read
                 stream.cancelled = True
 
-    def close(self):
+    def close(self, join_timeout=30):
         """Stop the loop; pending and in-flight requests error out.
-        Subsequent submits raise SchedulerClosed."""
+        Subsequent submits raise SchedulerClosed.
+
+        Deterministic even when the loop thread is wedged (e.g. inside a
+        stuck device dispatch): if the join times out, every stream the
+        loop did not terminally deliver gets a SchedulerClosed error
+        here, so no consumer is left blocked on its queue forever."""
         with self._cond:
+            already_closed = self._closed
             self._closed = True
             self._cond.notify_all()
             thread = self._thread
-        if thread is not None:
-            thread.join(timeout=30)
+        if thread is not None and not already_closed:
+            # join once: a second close() (e.g. core.drain's final
+            # close after the scheduler already drained) must not spend
+            # another join_timeout re-waiting on a wedged thread —
+            # the deterministic leftover-fail below still runs
+            thread.join(timeout=join_timeout)
+        # the loop normally fails every live stream on its way out; after
+        # a join timeout (or a loop that never started) do it ourselves
+        with self._cond:
+            leftover = list(self._streams)
+            self._streams.clear()
+            self._pending.clear()
+            self._cond.notify_all()
+        err = SchedulerClosed("scheduler is shut down")
+        for stream in leftover:
+            stream.queue.put(("err", err, None))
+
+    def drain(self, timeout=30.0):
+        """Graceful drain: stop admission immediately, let pending and
+        in-flight generations finish within ``timeout`` seconds, then
+        close — deterministically failing whatever remains.  Submits
+        during and after the drain raise SchedulerClosed."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+            while self._streams:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+        self.close(join_timeout=max(0.1, deadline - time.monotonic()))
+
+    @property
+    def healthy(self):
+        """False after the decode loop died unexpectedly (watchdog
+        tripped) or the scheduler was closed — readiness probes report
+        this through ``ServerReady``/``ModelReady``."""
+        return not self._tripped and not self._closed
+
+    def stats(self):
+        """Introspection for tests and ops: live stream / pending counts
+        and lifecycle flags.  ``live_streams`` counting to zero after
+        traffic is the no-leaked-slots invariant chaos tests assert."""
+        with self._cond:
+            return {
+                "live_streams": len(self._streams),
+                "pending": len(self._pending),
+                "draining": self._draining,
+                "closed": self._closed,
+                "healthy": self.healthy,
+            }
 
     # -- decode loop -------------------------------------------------------
 
     def _fail(self, stream, exc):
-        stream.queue.put(("err", exc, None))
+        self._deliver(stream, ("err", exc, None))
+
+    def _deliver(self, stream, event):
+        """Deliver a terminal event and retire the stream from the live
+        registry (never call while holding ``_cond`` — it takes it)."""
+        with self._cond:
+            self._streams.discard(stream)
+            self._cond.notify_all()
+        stream.queue.put(event)
 
     def _run(self):
         slots = [None] * self._max_slots  # slot -> _Stream | None
@@ -204,6 +304,13 @@ class DecodeScheduler:
             # step-recovery path) would otherwise leave every consumer
             # blocked forever on its queue
             with self._cond:
+                self._tripped = True  # watchdog: readiness reports it
+                if self._thread is threading.current_thread():
+                    # unregister NOW, under the lock: a submit racing
+                    # this cleanup must see no live thread and start a
+                    # fresh loop, not enqueue into a dying one whose
+                    # pending snapshot below would never include it
+                    self._thread = None
                 pending = list(self._pending)
                 self._pending.clear()
             for stream in slots:
@@ -226,13 +333,15 @@ class DecodeScheduler:
                     self._fail(stream, e)
                     slots[slot] = None
                     return
-            stream.queue.put(("done", None, None))
+            self._deliver(stream, ("done", None, None))
             slots[slot] = None
 
         while True:
+            expired = []
             with self._cond:
                 while (
                     not self._closed
+                    and not self._draining
                     and not self._pending
                     and inflight is None
                     and not any(s is not None for s in slots)
@@ -242,20 +351,54 @@ class DecodeScheduler:
                     pending = list(self._pending)
                     self._pending.clear()
                     break
+                if (
+                    self._draining
+                    and not self._pending
+                    and inflight is None
+                    and not any(s is not None for s in slots)
+                ):
+                    # drain complete: every accepted generation finished;
+                    # exit cleanly so drain() sees a closed scheduler
+                    self._closed = True
+                    pending = []
+                    break
                 # reap cancelled streams first: their consumers are gone,
                 # so the slot frees for waiting work (no park — the
                 # single-stream path abandoned mid-generation doesn't
                 # park either)
                 for i, st in enumerate(slots):
                     if st is not None and st.cancelled:
+                        self._streams.discard(st)
                         slots[i] = None
+                # deadline sweep: a pending request past its deadline
+                # fails BEFORE prefill (no slot or compute is ever spent
+                # on it); an in-flight one retires mid-generation, its
+                # slot freeing for waiting work this same iteration
+                now = time.monotonic()
+                if self._pending:
+                    keep = deque()
+                    for st in self._pending:
+                        (expired if st.expired(now) else keep).append(st)
+                    self._pending = keep
+                for i, st in enumerate(slots):
+                    if st is not None and st.expired(now):
+                        expired.append(st)
+                        slots[i] = None
+                self._cond.notify_all()
                 admissions = []
                 free = [i for i, s in enumerate(slots) if s is None]
                 while self._pending and free:
                     st = self._pending.popleft()
                     if st.cancelled:
+                        self._streams.discard(st)
                         continue  # abandoned while still queued
                     admissions.append((free.pop(0), st))
+            # deadline failures deliver OUTSIDE the lock (delivery
+            # re-takes it to retire the stream from the live registry)
+            for st in expired:
+                self._fail(st, DeadlineExceeded(
+                    "request deadline exceeded after {} emitted "
+                    "tokens".format(st.emitted)))
             # device work runs OUTSIDE the lock: submitters must be able
             # to enqueue while the chip computes
             for slot, stream in admissions:
@@ -288,6 +431,10 @@ class DecodeScheduler:
                     snapshot.append((i, st, was_forced))
                     st.pos += 1
                 try:
+                    # chaos hook: "scheduler.step" raise = decode-step
+                    # failure (exercises the donated-cache recovery
+                    # below), sleep = slow step
+                    faults.fire("scheduler.step")
                     tokens_dev, logps_dev, logits, cache = fns["step"](
                         self._params, cache, logits, positions, active,
                         forced_tok, forced_mask,
@@ -312,6 +459,7 @@ class DecodeScheduler:
             if inflight is not None:
                 tokens_dev, logps_dev, snapshot = inflight
                 try:
+                    faults.fire("scheduler.fetch")  # host-transfer chaos
                     toks = np.asarray(tokens_dev)
                     lps = np.asarray(logps_dev)
                 except Exception as e:  # noqa: BLE001
@@ -359,6 +507,7 @@ class DecodeScheduler:
         """Prefill-on-admit (or parked-cache restore) into ``slot``."""
         import jax.numpy as jnp
 
+        faults.fire("scheduler.admit")  # admission-failure chaos hook
         fns = self._fns
         if stream.resume_cache is not None:
             # resumed generation: the parked rows become the slot's

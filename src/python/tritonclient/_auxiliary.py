@@ -1,13 +1,82 @@
-"""Client-side timing and statistics.
+"""Client-side timing, statistics, and the shared retry policy.
 
 Python twin of the reference C++ ``RequestTimers`` (6-point nanosecond
 timestamps, common.h:523-603) and ``InferStat`` (common.h:94-115) so the
 Python clients expose the same request-timing observability the C++ library
-does.
+does.  ``RetryPolicy`` is shared by the HTTP and gRPC clients: one
+definition of which failures are safely retryable and how to back off.
 """
 
+import random
 import threading
 import time
+
+
+class RetryPolicy:
+    """Opt-in client retry policy: exponential backoff with full jitter.
+
+    Deliberately narrow about WHAT retries — only failures where the
+    server provably did not complete the request:
+
+    - **connection errors** (refused/reset before a response): the
+      request never reached a handler;
+    - **overload codes** — HTTP 429/503, gRPC RESOURCE_EXHAUSTED/
+      UNAVAILABLE: the server typed the rejection as shed-before-work.
+
+    Timeouts are never retried (the server may have executed the
+    request — resending a non-idempotent infer would double-execute it),
+    and neither are 4xx/5xx outside the overload set.  A server-supplied
+    ``Retry-After`` (HTTP header / gRPC ``retry-after`` trailing
+    metadata) overrides the computed backoff for that attempt.
+
+    Parameters
+    ----------
+    max_attempts : int
+        Total tries including the first (so 4 = 1 try + 3 retries).
+    initial_backoff_s / max_backoff_s / backoff_multiplier : float
+        Exponential schedule: ``min(max, initial * multiplier**i)``.
+    jitter : float
+        Fraction of the backoff randomized away (0..1): with 0.25 the
+        sleep is uniform in [0.75b, b], decorrelating retry storms.
+    retry_connection_errors : bool
+        Set False to retry only typed overload rejections.
+    """
+
+    #: HTTP statuses retried (gRPC maps RESOURCE_EXHAUSTED/UNAVAILABLE
+    #: onto the same set)
+    retryable_statuses = frozenset((429, 503))
+
+    def __init__(self, max_attempts=4, initial_backoff_s=0.05,
+                 max_backoff_s=2.0, backoff_multiplier=2.0, jitter=0.25,
+                 retry_connection_errors=True):
+        if max_attempts < 1:
+            raise ValueError(
+                "max_attempts must be >= 1 (got {})".format(max_attempts))
+        self.max_attempts = int(max_attempts)
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.jitter = float(jitter)
+        self.retry_connection_errors = bool(retry_connection_errors)
+
+    def backoff_s(self, attempt, retry_after=None):
+        """Seconds to sleep before retry number ``attempt`` (0-based);
+        a server-supplied ``retry_after`` wins over the schedule, but
+        still gets jitter ADDED on top — the server hands every shed
+        client the same number, and N clients sleeping exactly that
+        long re-arrive as one synchronized storm that re-trips the
+        cap."""
+        if retry_after is not None:
+            try:
+                base = max(0.0, float(retry_after))
+                return base * (1.0 + self.jitter * random.random())
+            except (TypeError, ValueError):
+                pass  # unparseable header: fall back to the schedule
+        base = min(
+            self.max_backoff_s,
+            self.initial_backoff_s * self.backoff_multiplier ** attempt,
+        )
+        return base * (1.0 - self.jitter * random.random())
 
 
 class RequestTimers:

@@ -5,6 +5,7 @@ from tritonclient.grpc import model_config_pb2, grpc_service_pb2  # noqa: F401
 from tritonclient.grpc._client import (  # noqa: F401
     InferenceServerClient,
     KeepAliveOptions,
+    RetryPolicy,
 )
 from tritonclient.grpc._infer_input import (  # noqa: F401
     InferInput,

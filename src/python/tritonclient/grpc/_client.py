@@ -9,8 +9,11 @@ CUDA-shm path as the on-device plane (CUDA verbs kept for API parity), and
 InferInput accepts ``jax.Array``.
 """
 
+import time
+
 import grpc
 
+from tritonclient._auxiliary import RetryPolicy  # noqa: F401 — re-exported
 from tritonclient.utils import InferenceServerException, raise_error
 
 from . import grpc_service_pb2 as pb
@@ -42,8 +45,22 @@ class KeepAliveOptions:
         self.http2_max_pings_without_data = http2_max_pings_without_data
 
 
+#: gRPC codes the retry policy treats as overload rejections — the wire
+#: twins of HTTP 429 (RESOURCE_EXHAUSTED) and 503 (UNAVAILABLE; also what
+#: grpc-core surfaces for connection-refused, covering connection errors)
+_RETRYABLE_CODES = frozenset(
+    (grpc.StatusCode.RESOURCE_EXHAUSTED, grpc.StatusCode.UNAVAILABLE)
+)
+
+
 class InferenceServerClient:
-    """A client talking KServe-v2 over gRPC to ``url`` (host:port)."""
+    """A client talking KServe-v2 over gRPC to ``url`` (host:port).
+
+    ``retry_policy`` (a ``tritonclient._auxiliary.RetryPolicy``) opts
+    unary RPCs into exponential-backoff retries of RESOURCE_EXHAUSTED /
+    UNAVAILABLE failures, honoring the server's ``retry-after``
+    trailing metadata; DEADLINE_EXCEEDED and every other code propagate
+    immediately.  Default None = no retries."""
 
     def __init__(
         self,
@@ -56,6 +73,7 @@ class InferenceServerClient:
         creds=None,
         keepalive_options=None,
         channel_args=None,
+        retry_policy=None,
     ):
         if keepalive_options is None:
             keepalive_options = KeepAliveOptions()
@@ -99,6 +117,7 @@ class InferenceServerClient:
         self._stub = ServiceStub(self._channel)
         self._verbose = verbose
         self._stream = None
+        self._retry_policy = retry_policy
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -126,20 +145,81 @@ class InferenceServerClient:
             return None
         return tuple(headers.items())
 
+    @staticmethod
+    def _is_connect_failure(rpc_error):
+        """Whether an UNAVAILABLE provably failed before the request
+        left the client (grpc-core's connect-phase detail strings).
+        Best-effort: an unrecognized detail is treated as possibly
+        mid-call, i.e. NOT safely retryable."""
+        try:
+            details = (rpc_error.details() or "").lower()
+        except Exception:
+            return False
+        return (
+            "failed to connect" in details
+            or "connection refused" in details
+            or "name resolution" in details
+            or "dns resolution failed" in details
+        )
+
+    @staticmethod
+    def _retry_after_of(rpc_error):
+        """The server's ``retry-after`` trailing-metadata value (the
+        gRPC twin of the HTTP header), or None."""
+        try:
+            for key, value in rpc_error.trailing_metadata() or ():
+                if key.lower() == "retry-after":
+                    return value
+        except Exception:
+            pass
+        return None
+
     def _call(self, name, request, headers=None, timeout=None):
         if self._verbose:
             print("{}, metadata {}\n{}".format(name, headers, request))
-        try:
-            response = getattr(self._stub, name)(
-                request=request,
-                metadata=self._metadata(headers),
-                timeout=timeout,
-            )
-            if self._verbose:
-                print(response)
-            return response
-        except grpc.RpcError as rpc_error:
-            raise_error_grpc(rpc_error)
+        policy = self._retry_policy
+        attempt = 0
+        while True:
+            try:
+                response = getattr(self._stub, name)(
+                    request=request,
+                    metadata=self._metadata(headers),
+                    timeout=timeout,
+                )
+                if self._verbose:
+                    print(response)
+                return response
+            except grpc.RpcError as rpc_error:
+                # retry only typed overload/unreachable rejections (the
+                # server shed the request before work, or never saw it);
+                # DEADLINE_EXCEEDED and everything else may have
+                # executed server-side and must propagate.
+                # UNAVAILABLE conflates a server-typed 503, a connect
+                # failure, AND a mid-call reset (the dangerous one): it
+                # is retryable only when the server's retry-after
+                # trailer proves a typed shed, or when the detail
+                # string marks a connect-phase failure (the request
+                # never left the client).
+                code = rpc_error.code() if policy is not None else None
+                retry_after = (
+                    self._retry_after_of(rpc_error)
+                    if code in _RETRYABLE_CODES
+                    else None
+                )
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    retryable = True
+                elif code == grpc.StatusCode.UNAVAILABLE:
+                    retryable = retry_after is not None or (
+                        policy.retry_connection_errors
+                        and self._is_connect_failure(rpc_error)
+                    )
+                else:
+                    retryable = False
+                if retryable and attempt + 1 < policy.max_attempts:
+                    time.sleep(policy.backoff_s(attempt, retry_after))
+                    attempt += 1
+                    continue
+                raise_error_grpc(rpc_error)
 
     @staticmethod
     def _as_json(message, as_json):

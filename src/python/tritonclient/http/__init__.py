@@ -7,6 +7,7 @@ from tritonclient.http._client import (
     InferInput,
     InferRequestedOutput,
     InferResult,
+    RetryPolicy,
 )
 from tritonclient.utils import InferenceServerException
 
@@ -17,4 +18,5 @@ __all__ = [
     "InferInput",
     "InferRequestedOutput",
     "InferResult",
+    "RetryPolicy",
 ]

@@ -13,10 +13,11 @@ import queue
 import socket
 import ssl as ssl_module
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from urllib.parse import quote, urlparse
 
-from tritonclient._auxiliary import InferStat, RequestTimers
+from tritonclient._auxiliary import InferStat, RequestTimers, RetryPolicy
 from tritonclient.http._infer_input import InferInput
 from tritonclient.http._infer_result import InferResult
 from tritonclient.http._requested_output import InferRequestedOutput
@@ -34,6 +35,7 @@ __all__ = [
     "InferRequestedOutput",
     "InferResult",
     "InferAsyncRequest",
+    "RetryPolicy",
 ]
 
 
@@ -266,6 +268,12 @@ class InferenceServerClient:
         If True skip certificate verification.
     ssl_context_factory : callable
         Factory returning an ``ssl.SSLContext`` (overrides ssl_options).
+    retry_policy : tritonclient._auxiliary.RetryPolicy
+        Opt-in retries: exponential backoff with jitter, honoring
+        ``Retry-After``, retrying ONLY connection errors and typed
+        overload rejections (429/503) — never timeouts, which may have
+        executed server-side.  Default None = no retries (the
+        historical behavior).
     """
 
     def __init__(
@@ -280,6 +288,7 @@ class InferenceServerClient:
         ssl_options=None,
         ssl_context_factory=None,
         insecure=False,
+        retry_policy=None,
     ):
         # Set first so close()/__del__ are safe even if __init__ raises below.
         self._closed = True
@@ -315,6 +324,7 @@ class InferenceServerClient:
                     ctx.verify_mode = ssl_module.CERT_NONE
                 self._ssl_context = ctx
 
+        self._retry_policy = retry_policy
         self._pool = queue.LifoQueue()
         for _ in range(self._concurrency):
             self._pool.put(None)  # lazily created
@@ -372,6 +382,53 @@ class InferenceServerClient:
 
     def _request(self, method, request_uri, body=None, headers=None,
                  query_params=None):
+        """One logical request, with the opt-in retry policy applied.
+
+        Only two failure classes ever retry (see RetryPolicy): the
+        connection could not be ESTABLISHED (refused/unresolvable — the
+        server provably never saw the request) and typed overload
+        statuses (429/503 — the server shed the request before doing
+        work).  Timeouts and mid-response drops propagate immediately:
+        the server may have executed the request, and resending a
+        non-idempotent infer would double-execute it.
+        """
+        policy = self._retry_policy
+        if policy is None:
+            return self._request_once(
+                method, request_uri, body, headers, query_params
+            )
+        attempt = 0
+        while True:
+            try:
+                status, resp_headers, resp_body = self._request_once(
+                    method, request_uri, body, headers, query_params
+                )
+            except (ConnectionRefusedError, socket.gaierror) as e:
+                # connect-phase failure only: a ConnectionError AFTER
+                # the request was sent (reset mid-response) is NOT here
+                # — the server may have executed it
+                if (
+                    not policy.retry_connection_errors
+                    or attempt + 1 >= policy.max_attempts
+                ):
+                    raise
+                time.sleep(policy.backoff_s(attempt))
+                attempt += 1
+                continue
+            if (
+                status in policy.retryable_statuses
+                and attempt + 1 < policy.max_attempts
+            ):
+                retry_after = {
+                    k.lower(): v for k, v in resp_headers.items()
+                }.get("retry-after")
+                time.sleep(policy.backoff_s(attempt, retry_after))
+                attempt += 1
+                continue
+            return status, resp_headers, resp_body
+
+    def _request_once(self, method, request_uri, body=None, headers=None,
+                      query_params=None):
         path = self._base_path + "/" + request_uri
         if query_params is not None:
             path = path + "?" + _get_query_string(query_params)

@@ -1,0 +1,391 @@
+"""Chaos tests: forced failures via tpuserver.faults, recovery invariants
+asserted.
+
+The contracts under test (the PR-2 acceptance bar):
+
+- an injected decode-step failure fails the in-flight streams with a
+  typed error, rebuilds the donated cache, leaks zero slots, and a
+  fresh request produces greedy tokens IDENTICAL to a clean run;
+- a deadline expiring mid-generation retires the slot with
+  DeadlineExceeded (504 on the wire) without disturbing other slots;
+- a transiently overloaded server sheds with 429 + Retry-After and a
+  client configured with the retry policy succeeds once load clears —
+  through the real HTTP frontend.
+
+Everything here runs on the tiny CPU llama (same CFG as
+tests/test_continuous_batching.py); tools/chaos_smoke.py soaks the same
+invariants for longer.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuserver import faults
+from tpuserver.core import InferenceServer, InferRequest, ServerError
+from tpuserver.models import llama
+from tpuserver.models.llama_serving import LlamaGenerateModel
+
+pytestmark = pytest.mark.chaos
+
+CFG = llama.tiny(vocab=512)
+MAX_SEQ = 64
+PROMPTS = [
+    np.array([3, 1, 4, 1, 5], dtype=np.int32),
+    np.array([9, 8, 7], dtype=np.int32),
+    np.array([2, 7, 1, 8, 2, 8], dtype=np.int32),
+]
+BUDGETS = [8, 6, 7]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def chaos_model():
+    return LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=2)
+
+
+@pytest.fixture(scope="module")
+def chaos_core(chaos_model):
+    return InferenceServer([chaos_model])
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(chaos_core):
+    """Clean-run greedy tokens from the SAME scheduler core — the
+    identity bar every post-failure run must clear."""
+    return [
+        _generate(chaos_core, p, n) for p, n in zip(PROMPTS, BUDGETS)
+    ]
+
+
+def _generate(core, prompt, n_tokens, parameters=None):
+    req = InferRequest(
+        "llama_generate",
+        inputs={
+            "PROMPT_IDS": np.asarray(prompt, np.int32),
+            "MAX_TOKENS": np.array([n_tokens], dtype=np.int32),
+        },
+        parameters=parameters or {},
+    )
+    return [
+        int(arr[0])
+        for resp in core.infer_stream(req)
+        for spec, arr, _ in resp.outputs
+        if spec["name"] == "TOKEN"
+    ]
+
+
+def _assert_no_leaks(model, timeout=5.0):
+    """Zero leaked slots: every stream the scheduler ever accepted has
+    been terminally delivered (the live registry empties)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        stats = model._scheduler.stats()
+        if stats["live_streams"] == 0 and stats["pending"] == 0:
+            return
+        time.sleep(0.01)
+    pytest.fail("leaked streams: {}".format(model._scheduler.stats()))
+
+
+def test_step_failure_resets_cache_and_next_run_is_identical(
+        chaos_core, chaos_model, reference_tokens):
+    faults.install("scheduler.step", mode="raise", times=1)
+    with pytest.raises(ServerError):
+        _generate(chaos_core, PROMPTS[0], BUDGETS[0])
+    assert faults.fired("scheduler.step") == 1
+    _assert_no_leaks(chaos_model)
+    # the loop survived (recovery, not watchdog): readiness intact
+    assert chaos_model.healthy()
+    assert chaos_core.server_ready()
+    # donated cache was rebuilt: greedy tokens identical to a clean run
+    assert _generate(
+        chaos_core, PROMPTS[0], BUDGETS[0]) == reference_tokens[0]
+
+
+def test_step_failure_under_concurrency_fails_typed_then_recovers(
+        chaos_core, chaos_model, reference_tokens):
+    faults.install("scheduler.step", mode="raise", times=1)
+    outcomes = [None] * len(PROMPTS)
+
+    def worker(i):
+        try:
+            outcomes[i] = ("ok", _generate(
+                chaos_core, PROMPTS[i], BUDGETS[i]))
+        except ServerError as e:
+            outcomes[i] = ("err", e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(PROMPTS))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    # every request got a terminal outcome (no hangs), at least one of
+    # them the injected failure
+    assert all(o is not None for o in outcomes), outcomes
+    assert any(kind == "err" for kind, _ in outcomes), outcomes
+    _assert_no_leaks(chaos_model)
+    # and a full clean pass reproduces the reference token streams
+    for i in range(len(PROMPTS)):
+        assert _generate(
+            chaos_core, PROMPTS[i], BUDGETS[i]) == reference_tokens[i], i
+
+
+def test_host_transfer_failure_recovers(
+        chaos_core, chaos_model, reference_tokens):
+    faults.install("scheduler.fetch", mode="raise", times=1)
+    with pytest.raises(ServerError):
+        _generate(chaos_core, PROMPTS[1], BUDGETS[1])
+    _assert_no_leaks(chaos_model)
+    assert _generate(
+        chaos_core, PROMPTS[1], BUDGETS[1]) == reference_tokens[1]
+
+
+def test_admission_failure_is_isolated(
+        chaos_core, chaos_model, reference_tokens):
+    """An injected prefill-on-admit failure kills only its own request;
+    the decode loop, the cache, and later admissions are untouched."""
+    faults.install("scheduler.admit", mode="raise", times=1)
+    with pytest.raises(ServerError):
+        _generate(chaos_core, PROMPTS[2], BUDGETS[2])
+    _assert_no_leaks(chaos_model)
+    assert chaos_model.healthy()
+    assert _generate(
+        chaos_core, PROMPTS[2], BUDGETS[2]) == reference_tokens[2]
+
+
+def test_deadline_expires_mid_generation(chaos_core, chaos_model):
+    """With steps slowed, a short deadline retires the slot mid-flight
+    with a typed 504 — after emitting some (but not all) tokens."""
+    from tpuserver.core import DeadlineExceeded
+
+    faults.install("scheduler.step", mode="sleep", times=-1, delay=0.05)
+    try:
+        req = InferRequest(
+            "llama_generate",
+            inputs={
+                "PROMPT_IDS": PROMPTS[0],
+                "MAX_TOKENS": np.array([40], dtype=np.int32),
+            },
+            parameters={"timeout": 400_000},  # 0.4 s, in microseconds
+        )
+        tokens = []
+        with pytest.raises(DeadlineExceeded):
+            for resp in chaos_core.infer_stream(req):
+                for spec, arr, _ in resp.outputs:
+                    if spec["name"] == "TOKEN":
+                        tokens.append(int(arr[0]))
+        assert len(tokens) < 40  # expired before the budget
+    finally:
+        faults.clear("scheduler.step")
+    _assert_no_leaks(chaos_model)
+    assert chaos_model.healthy()
+
+
+def test_deadline_expires_while_pending_before_prefill(chaos_model):
+    """A request whose deadline passes while it waits for a slot fails
+    with DeadlineExceeded without ever paying prefill."""
+    from tpuserver.scheduler import DeadlineExceeded as SchedDeadline
+
+    sched = chaos_model._scheduler
+    stream = sched.submit(
+        PROMPTS[0], 4, deadline=time.monotonic() - 0.001
+    )
+    with pytest.raises(SchedDeadline):
+        list(stream)
+    _assert_no_leaks(chaos_model)
+
+
+def test_overload_shed_then_retry_succeeds_through_http(chaos_core):
+    """429 + Retry-After under transient overload; a retry-policy client
+    rides it out — through the real HTTP frontend."""
+    import http.client
+
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException
+
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models.simple import SimpleModel
+
+    core = InferenceServer([SimpleModel()])
+    frontend = HttpFrontend(core, port=0).start()
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+
+        core.set_max_inflight(0)  # overload: shed everything
+        plain = httpclient.InferenceServerClient(
+            "127.0.0.1:{}".format(frontend.port))
+        try:
+            with pytest.raises(InferenceServerException) as exc:
+                plain.infer("simple", inputs)
+            assert exc.value.status() == "429"
+        finally:
+            plain.close()
+        # the Retry-After header is on the wire
+        conn = http.client.HTTPConnection("127.0.0.1", frontend.port)
+        try:
+            conn.request(
+                "POST", "/v2/models/simple/infer",
+                json.dumps({"inputs": [
+                    {"name": "INPUT0", "datatype": "INT32",
+                     "shape": [1, 16], "data": [list(range(16))]},
+                    {"name": "INPUT1", "datatype": "INT32",
+                     "shape": [1, 16], "data": [list(range(16))]},
+                ]}),
+                {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 429
+            assert resp.getheader("Retry-After") is not None
+        finally:
+            conn.close()
+
+        # transient: load clears in 0.3 s; the retry client succeeds
+        timer = threading.Timer(0.3, core.set_max_inflight, args=(None,))
+        timer.start()
+        retrying = httpclient.InferenceServerClient(
+            "127.0.0.1:{}".format(frontend.port),
+            retry_policy=httpclient.RetryPolicy(
+                max_attempts=8, initial_backoff_s=0.1, max_backoff_s=0.5,
+            ),
+        )
+        try:
+            result = retrying.infer("simple", inputs)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), data + data)
+        finally:
+            timer.cancel()
+            retrying.close()
+    finally:
+        frontend.stop()
+    _ = chaos_core  # ordering: reuse the session's compiled model zoo
+
+
+def test_grpc_retry_succeeds_after_transient_overload():
+    import tritonclient.grpc as grpcclient
+
+    from tpuserver.grpc_frontend import GrpcFrontend
+    from tpuserver.models.simple import SimpleModel
+
+    core = InferenceServer([SimpleModel()])
+    frontend = GrpcFrontend(core, port=0).start()
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+        core.set_max_inflight(0)
+        timer = threading.Timer(0.3, core.set_max_inflight, args=(None,))
+        timer.start()
+        client = grpcclient.InferenceServerClient(
+            "127.0.0.1:{}".format(frontend.port),
+            retry_policy=grpcclient.RetryPolicy(
+                max_attempts=8, initial_backoff_s=0.1, max_backoff_s=0.5,
+            ),
+        )
+        try:
+            result = client.infer("simple", inputs)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), data + data)
+        finally:
+            timer.cancel()
+            client.close()
+    finally:
+        frontend.stop()
+
+
+@pytest.mark.slow
+def test_close_during_generation_delivers_error_not_hang():
+    """Satellite: close() racing a live generation must deliver a
+    typed shutdown error to the consumer within the join bound — never
+    leave it blocked on its token queue.  Slow (own model compile)."""
+    from tpuserver.scheduler import SchedulerClosed
+
+    model = LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=2)
+    core = InferenceServer([model])
+    # warm up, then slow the steps so close() provably lands mid-flight
+    _generate(core, PROMPTS[1], 2)
+    faults.install("scheduler.step", mode="sleep", times=-1, delay=0.05)
+    tokens, outcome = [], {}
+
+    def consume():
+        try:
+            req = InferRequest(
+                "llama_generate",
+                inputs={
+                    "PROMPT_IDS": PROMPTS[0],
+                    "MAX_TOKENS": np.array([40], dtype=np.int32),
+                },
+            )
+            for resp in core.infer_stream(req):
+                for spec, arr, _ in resp.outputs:
+                    if spec["name"] == "TOKEN":
+                        tokens.append(int(arr[0]))
+            outcome["end"] = "done"
+        except ServerError as e:
+            outcome["end"] = "err"
+            outcome["exc"] = e
+
+    t = threading.Thread(target=consume)
+    t.start()
+    while not tokens and t.is_alive():
+        time.sleep(0.01)  # at least one token: generation is live
+    model._scheduler.close(join_timeout=10)
+    t.join(timeout=15)
+    faults.clear("scheduler.step")
+    assert not t.is_alive(), "consumer hung through close()"
+    assert outcome.get("end") == "err", outcome
+    assert "shut down" in str(outcome["exc"])
+    assert len(tokens) < 40  # close landed mid-generation
+    _ = SchedulerClosed  # the typed error the 503 mapping wraps
+
+
+@pytest.mark.slow
+def test_wedged_loop_close_is_deterministic():
+    """If the decode loop cannot be joined (wedged in a slow dispatch),
+    close() itself fails the registered streams.  Slow (own compile +
+    deliberate multi-second sleep fault)."""
+    model = LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=2)
+    core = InferenceServer([model])
+    # warm up so the wedge hits steady-state decode, not compile
+    _generate(core, PROMPTS[1], 2)
+    faults.install("scheduler.step", mode="sleep", times=-1, delay=2.0)
+    outcome = {}
+
+    def consume():
+        try:
+            outcome["tokens"] = _generate(core, PROMPTS[0], 30)
+        except ServerError as e:
+            outcome["exc"] = e
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.3)  # let the generation enter the slowed loop
+    t0 = time.monotonic()
+    model._scheduler.close(join_timeout=0.2)  # join will time out
+    assert time.monotonic() - t0 < 2.0  # close did not wait the wedge out
+    t.join(timeout=10)
+    faults.clear("scheduler.step")
+    assert not t.is_alive(), "consumer hung through wedged close()"
+    assert "exc" in outcome, outcome
+    assert "shut down" in str(outcome["exc"])

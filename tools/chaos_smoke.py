@@ -1,0 +1,252 @@
+#!/usr/bin/env python3
+"""Chaos smoke soak: injected failures against an in-process server,
+nonzero exit on any resilience-invariant violation.
+
+Runs rounds of concurrent generations on the continuous-batching
+scheduler while cycling fault injections (decode-step raise, host-
+transfer raise, admit raise, slow step + mid-generation deadline), and
+finishes with a transient-overload phase through the real HTTP frontend
+ridden out by the client retry policy.  After every round it asserts
+the invariants PR 2 promises:
+
+  1. every request reaches a terminal outcome (tokens or a typed error
+     — never a hang);
+  2. zero leaked slots/streams (the scheduler's live registry empties);
+  3. the decode loop stays healthy (recovery, not watchdog trip);
+  4. a clean request after the chaos produces greedy tokens IDENTICAL
+     to the pre-chaos reference (the donated cache was rebuilt right).
+
+Usage:
+    python tools/chaos_smoke.py [--rounds N] [--slots K] [--budget T]
+
+CI wiring: run under JAX_PLATFORMS=cpu; exits 0 only if every invariant
+held.
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "python"),
+)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from tpuserver import faults  # noqa: E402
+from tpuserver.core import (  # noqa: E402
+    DeadlineExceeded,
+    InferenceServer,
+    InferRequest,
+    ServerError,
+)
+from tpuserver.models import llama  # noqa: E402
+from tpuserver.models.llama_serving import LlamaGenerateModel  # noqa: E402
+
+PROMPTS = [
+    np.array([3, 1, 4, 1, 5], dtype=np.int32),
+    np.array([9, 8, 7], dtype=np.int32),
+    np.array([2, 7, 1, 8, 2, 8], dtype=np.int32),
+    np.array([1, 2, 3, 4], dtype=np.int32),
+]
+
+FAULT_CYCLE = [
+    ("scheduler.step", "raise", 1, 0.0),
+    ("scheduler.fetch", "raise", 1, 0.0),
+    ("scheduler.admit", "raise", 1, 0.0),
+    ("scheduler.step", "sleep", -1, 0.02),  # + deadline pressure
+]
+
+_failures = []
+
+
+def fail(msg):
+    _failures.append(msg)
+    print("INVARIANT VIOLATED: {}".format(msg), file=sys.stderr)
+
+
+def generate(core, prompt, n_tokens, parameters=None):
+    req = InferRequest(
+        "llama_generate",
+        inputs={
+            "PROMPT_IDS": np.asarray(prompt, np.int32),
+            "MAX_TOKENS": np.array([n_tokens], dtype=np.int32),
+        },
+        parameters=parameters or {},
+    )
+    return [
+        int(arr[0])
+        for resp in core.infer_stream(req)
+        for spec, arr, _ in resp.outputs
+        if spec["name"] == "TOKEN"
+    ]
+
+
+def wait_no_leaks(model, where, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        stats = model._scheduler.stats()
+        if stats["live_streams"] == 0 and stats["pending"] == 0:
+            return True
+    fail("{}: leaked streams {}".format(where, model._scheduler.stats()))
+    return False
+
+
+def chaos_round(core, model, reference, budget, rnd):
+    name, mode, times, delay = FAULT_CYCLE[rnd % len(FAULT_CYCLE)]
+    faults.install(name, mode=mode, times=times, delay=delay)
+    outcomes = [None] * len(PROMPTS)
+
+    def worker(i):
+        params = None
+        if mode == "sleep":
+            # slow-step round doubles as the deadline probe: this
+            # request must expire mid-generation with a typed 504
+            params = {"timeout": 300_000} if i == 0 else None
+        try:
+            outcomes[i] = ("ok", generate(
+                core, PROMPTS[i], budget, params))
+        except DeadlineExceeded:
+            outcomes[i] = ("deadline", None)
+        except ServerError as e:
+            outcomes[i] = ("err", e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(len(PROMPTS))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    faults.clear(name)
+
+    for i, outcome in enumerate(outcomes):
+        if outcome is None:
+            fail("round {} ({}:{}): request {} never terminated".format(
+                rnd, name, mode, i))
+        elif outcome[0] == "ok" and outcome[1] != reference[i]:
+            # a request that claims success must be token-exact
+            fail("round {} ({}:{}): request {} tokens diverged: "
+                 "{} != {}".format(
+                     rnd, name, mode, i, outcome[1], reference[i]))
+    if mode == "sleep" and outcomes[0] is not None:
+        if outcomes[0][0] not in ("deadline", "ok"):
+            fail("round {} deadline probe got {} instead of a typed "
+                 "DeadlineExceeded".format(rnd, outcomes[0][0]))
+
+    wait_no_leaks(model, "round {}".format(rnd))
+    if not model.healthy():
+        fail("round {} ({}:{}): scheduler watchdog tripped".format(
+            rnd, name, mode))
+    # recovery bar: a clean run right after the chaos is token-identical
+    clean = generate(core, PROMPTS[0], budget)
+    if clean != reference[0]:
+        fail("round {} ({}:{}): post-chaos tokens diverged: "
+             "{} != {}".format(rnd, name, mode, clean, reference[0]))
+    kinds = [o[0] if o else "hang" for o in outcomes]
+    print("round {:2d} fault={}:{} outcomes={} live={}".format(
+        rnd, name, mode, kinds, model._scheduler.stats()["live_streams"]))
+
+
+def overload_phase(core_model_cls):
+    """Transient overload through the real HTTP frontend: plain client
+    sees 429 + Retry-After; retry-policy client succeeds."""
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException
+
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models.simple import SimpleModel
+
+    core = InferenceServer([SimpleModel()])
+    frontend = HttpFrontend(core, port=0).start()
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+        core.set_max_inflight(0)
+        plain = httpclient.InferenceServerClient(
+            "127.0.0.1:{}".format(frontend.port))
+        try:
+            plain.infer("simple", inputs)
+            fail("overload: shed request unexpectedly succeeded")
+        except InferenceServerException as e:
+            if e.status() != "429":
+                fail("overload: expected 429, got {}".format(e.status()))
+        finally:
+            plain.close()
+        timer = threading.Timer(0.3, core.set_max_inflight, args=(None,))
+        timer.start()
+        retrying = httpclient.InferenceServerClient(
+            "127.0.0.1:{}".format(frontend.port),
+            retry_policy=httpclient.RetryPolicy(
+                max_attempts=8, initial_backoff_s=0.1, max_backoff_s=0.5,
+            ),
+        )
+        try:
+            result = retrying.infer("simple", inputs)
+            if not np.array_equal(result.as_numpy("OUTPUT0"), data + data):
+                fail("overload: retried result wrong")
+            print("overload phase: shed typed 429, retry client rode "
+                  "it out")
+        except InferenceServerException as e:
+            fail("overload: retry client failed: {}".format(e))
+        finally:
+            timer.cancel()
+            retrying.close()
+    finally:
+        frontend.stop()
+    _ = core_model_cls
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--rounds", type=int, default=8,
+                        help="chaos rounds (default 8: two full cycles)")
+    parser.add_argument("--slots", type=int, default=2,
+                        help="scheduler slots (default 2)")
+    parser.add_argument("--budget", type=int, default=6,
+                        help="tokens per generation (default 6)")
+    args = parser.parse_args()
+
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=args.slots)
+    core = InferenceServer([model])
+    print("warming up (compiles the scheduler fns)...")
+    reference = [generate(core, p, args.budget) for p in PROMPTS]
+    print("reference tokens captured; starting {} chaos rounds".format(
+        args.rounds))
+
+    t0 = time.monotonic()
+    for rnd in range(args.rounds):
+        chaos_round(core, model, reference, args.budget, rnd)
+    overload_phase(LlamaGenerateModel)
+
+    # graceful drain at the end: accepted work finishes, then stop
+    core.drain(timeout=10.0)
+    if core.server_state() != "stopped":
+        fail("drain did not stop the server (state={})".format(
+            core.server_state()))
+
+    elapsed = time.monotonic() - t0
+    if _failures:
+        print("\nchaos smoke FAILED: {} violation(s) in {:.1f}s".format(
+            len(_failures), elapsed), file=sys.stderr)
+        return 1
+    print("\nchaos smoke OK: {} rounds + overload phase + drain, "
+          "{:.1f}s, all invariants held".format(args.rounds, elapsed))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
