@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Multi-replica HTTP client demo: an EndpointPool over two in-process
+servers rides out a mid-traffic drain of one replica with zero
+user-visible errors, and the drained replica's circuit breaker
+re-closes once it returns to ready.
+
+Self-contained: the two replicas are spun up in-process (a drain demo
+needs a replica it is allowed to drain), so no external server is
+required.  ``-u`` is accepted for harness compatibility and ignored.
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+import tritonclient.http as httpclient
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-u", "--url", default=None,
+                        help="ignored: this demo drains one of its own "
+                             "in-process replicas")
+    parser.add_argument("-n", "--requests", type=int, default=40)
+    args = parser.parse_args()
+
+    from tpuserver.core import InferenceServer
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models.simple import SimpleModel
+
+    cores = [InferenceServer([SimpleModel()]) for _ in range(2)]
+    frontends = [HttpFrontend(core, port=0).start() for core in cores]
+    urls = ["127.0.0.1:{}".format(f.port) for f in frontends]
+    print("replicas: {}".format(urls))
+
+    pool = httpclient.EndpointPool(
+        urls,
+        verbose=args.verbose,
+        retry_policy=httpclient.RetryPolicy(
+            max_attempts=6, initial_backoff_s=0.02),
+        breaker_threshold=2,
+        breaker_cooldown_s=0.2,
+        health_interval_s=0.05,  # background readiness probing
+    )
+
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+
+    def make_inputs():
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+        return inputs
+
+    errors = 0
+    for i in range(args.requests):
+        if i == args.requests // 3:
+            print("--- draining replica B mid-traffic ---")
+            cores[1].begin_drain()
+        try:
+            result = pool.infer("simple", make_inputs())
+            if not np.array_equal(result.as_numpy("OUTPUT0"), data + data):
+                print("wrong result at request {}".format(i))
+                errors += 1
+        except Exception as e:  # noqa: BLE001 — counted as a failure
+            print("request {} failed: {}".format(i, e))
+            errors += 1
+
+    print("drained-phase breaker states: {}".format(pool.endpoint_states()))
+    print("--- replica B returns to ready (undrain) ---")
+    cores[1].mark_ready()
+
+    def replica_b():
+        return [e for e in pool.stats()["endpoints"]
+                if e["url"] == urls[1]][0]
+
+    # the background prober notices recovery: breaker re-closes (if it
+    # opened) and the health flag flips back
+    deadline = time.monotonic() + 5.0
+    while (
+        not (replica_b()["healthy"] and replica_b()["breaker"] == "closed")
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    print("recovered breaker states:     {}".format(pool.endpoint_states()))
+
+    for _ in range(6):  # both replicas take traffic again
+        pool.infer("simple", make_inputs())
+    stats = pool.stats()
+    for entry in stats["endpoints"]:
+        print("endpoint {url}: requests={requests} failures={failures} "
+              "healthy={healthy} breaker={breaker}".format(**entry))
+
+    pool.close()
+    for f in frontends:
+        f.stop()
+
+    if errors:
+        print("FAIL: {} request(s) failed through the pool".format(errors))
+        sys.exit(1)
+    if stats["endpoints"][1]["breaker"] != "closed":
+        print("FAIL: drained replica's breaker did not re-close")
+        sys.exit(1)
+    print("PASS: drain was invisible to pool callers")
+
+
+if __name__ == "__main__":
+    main()

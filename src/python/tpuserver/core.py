@@ -766,7 +766,12 @@ class InferenceServer:
     without bound behind a saturated device.
     """
 
-    def __init__(self, models=None, max_inflight=None, ready=True):
+    def __init__(self, models=None, max_inflight=None, ready=True,
+                 fault_scope=None):
+        # identifies this replica at shared fault-injection points, so
+        # multi-server chaos harnesses can break ONE in-process replica
+        # (tpuserver.faults scopes)
+        self.fault_scope = fault_scope
         self._models = {}  # name -> Model
         self._ready = {}  # name -> bool
         self._stats = {}  # name -> _ModelStats
@@ -905,10 +910,17 @@ class InferenceServer:
         return True
 
     def mark_ready(self):
-        """Flip a ``starting`` server to ``ready`` (after warmup)."""
+        """Flip a ``starting`` server to ``ready`` (after warmup), or
+        cancel an in-progress ``begin_drain()`` (an ops undrain: the
+        replica rejoins the fleet and readiness probes flip back).  A
+        ``stopped`` server stays stopped — its workers are gone; only
+        ``attach_frontend`` re-opens one."""
         with self._inflight_cond:
-            if self._state == "starting":
+            if self._state in ("starting", "draining"):
                 self._state = "ready"
+                # wake a drain() waiting on inflight==0 so it observes
+                # the cancellation instead of closing a serving server
+                self._inflight_cond.notify_all()
 
     def set_max_inflight(self, max_inflight):
         """Adjust the server-wide in-flight cap at runtime (None lifts
@@ -960,7 +972,12 @@ class InferenceServer:
         """Graceful shutdown: stop admission (new requests get a typed
         503), let in-flight requests — including scheduler-backed
         generations — finish within ``timeout`` seconds, then close,
-        deterministically failing whatever remains."""
+        deterministically failing whatever remains.
+
+        A concurrent :meth:`mark_ready` (undrain) aborts the drain:
+        once the server is admitting again, running ``close()`` would
+        hard-kill the just-admitted requests.  Undrain is only safe
+        BEFORE the wait completes — cancel early or not at all."""
         self.begin_drain()
         deadline = time.monotonic() + timeout
         # model-owned schedulers drain first: their in-flight
@@ -976,11 +993,13 @@ class InferenceServer:
                 except Exception:  # noqa: BLE001 — close() must run
                     pass
         with self._inflight_cond:
-            while self._inflight > 0:
+            while self._inflight > 0 and self._state == "draining":
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._inflight_cond.wait(remaining)
+            if self._state == "ready":
+                return  # undrained mid-wait: the server is serving again
         self.close()
 
     def load_model(self, name):
@@ -1178,13 +1197,55 @@ class InferenceServer:
             )
         return region
 
+    @staticmethod
+    def _check_shm_bounds(region, byte_size, offset, direction):
+        """Typed 400 for a shared-memory reference outside its
+        registered region — at request time, instead of an opaque
+        buffer/mmap error deep inside the shm read/write."""
+        try:
+            byte_size = int(byte_size)
+            offset = int(offset)
+        except (TypeError, ValueError):
+            raise ServerError(
+                "shared-memory {} reference for region '{}' must carry "
+                "integer byte_size/offset (got byte_size={!r}, "
+                "offset={!r})".format(
+                    direction, region.name, byte_size, offset
+                ),
+                code=400,
+            )
+        if byte_size < 0 or offset < 0:
+            raise ServerError(
+                "shared-memory {} reference for region '{}' must be "
+                "non-negative (got byte_size={}, offset={})".format(
+                    direction, region.name, byte_size, offset
+                ),
+                code=400,
+            )
+        if offset + byte_size > region.byte_size:
+            raise ServerError(
+                "shared-memory {} reference out of bounds for region "
+                "'{}': offset {} + byte_size {} exceeds the registered "
+                "size {}".format(
+                    direction, region.name, offset, byte_size,
+                    region.byte_size,
+                ),
+                code=400,
+            )
+        return byte_size, offset
+
     def read_shm_input(self, region_name, byte_size, offset, datatype, shape):
         """Materialize an input tensor from a registered shm region.
 
         For XLA regions holding live device buffers this returns the
         ``jax.Array`` itself — no host copy."""
-        faults.fire("core.shm_read")  # shm-read-failure chaos hook
+        # shm-read-failure chaos hook (scoped: multi-replica harnesses
+        # can fail one replica's shm plane)
+        faults.fire("core.shm_read", self.fault_scope)
         region = self._shm_region(region_name)
+        byte_size, offset = self._check_shm_bounds(
+            region, byte_size, offset, "input"
+        )
         if isinstance(region, _XlaShmRegion):
             arr = region.get_device_array(offset, datatype, shape)
             if arr is not None:
@@ -1212,6 +1273,8 @@ class InferenceServer:
             data = serialized.item() if serialized.size > 0 else b""
         else:
             data = np.ascontiguousarray(np.asarray(array)).tobytes()
+        _, offset = self._check_shm_bounds(region, len(data), offset,
+                                           "output")
         region.write(offset, data)
 
     # -- inference ---------------------------------------------------------

@@ -24,11 +24,19 @@ Registered injection points:
 ``core.shm_read``    before a shared-memory input read (shm read error)
 ==================  ========================================================
 
+**Scopes** (multi-replica chaos): several in-process servers share this
+process-global registry, so a point armed with ``scope="replica-b"``
+fires only for the server constructed with
+``InferenceServer(fault_scope="replica-b")`` — chaos tests can kill one
+replica of an in-process multi-server harness while its pool siblings
+stay healthy.  A point armed without a scope fires for every replica
+(the historical behavior).
+
 Env knob: ``TPUSERVER_FAULTS`` arms points at import time without code
-changes, as a comma-separated list of ``name:mode[:times[:delay]]``
+changes, as a comma-separated list of ``name[@scope]:mode[:times[:delay]]``
 entries, e.g.::
 
-    TPUSERVER_FAULTS="scheduler.step:raise:1,scheduler.fetch:sleep:-1:0.05"
+    TPUSERVER_FAULTS="scheduler.step:raise:1,core.shm_read@b:raise:-1"
 
 ``times=-1`` means unlimited.  :func:`clear` disarms.
 """
@@ -52,9 +60,9 @@ class FaultInjected(RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("name", "mode", "remaining", "delay", "fired")
+    __slots__ = ("name", "mode", "remaining", "delay", "fired", "scope")
 
-    def __init__(self, name, mode, times, delay):
+    def __init__(self, name, mode, times, delay, scope=None):
         if mode not in ("raise", "sleep"):
             raise ValueError(
                 "fault mode must be 'raise' or 'sleep' (got {!r})".format(
@@ -65,57 +73,82 @@ class _Fault:
         self.remaining = int(times)
         self.delay = float(delay)
         self.fired = 0
+        self.scope = scope
 
 
 _lock = threading.Lock()
-_points = {}  # name -> _Fault
+_points = {}  # (name, scope) -> _Fault
 
 
-def install(name, mode="raise", times=1, delay=0.0):
+def install(name, mode="raise", times=1, delay=0.0, scope=None):
     """Arm injection point ``name``: the next ``times`` fires raise
     (``mode="raise"``) or sleep ``delay`` seconds (``mode="sleep"``).
-    ``times=-1`` keeps the point armed until :func:`clear`."""
-    fault = _Fault(name, mode, times, delay)
+    ``times=-1`` keeps the point armed until :func:`clear`.  With a
+    ``scope``, only :func:`fire` calls carrying that scope trip the
+    point (per-replica chaos); scope None matches every firer."""
+    fault = _Fault(name, mode, times, delay, scope)
     with _lock:
-        _points[name] = fault
+        _points[(name, scope)] = fault
     return fault
 
 
-def clear(name=None):
-    """Disarm one point (or all, when ``name`` is None)."""
+_ALL_SCOPES = object()
+
+
+def clear(name=None, scope=_ALL_SCOPES):
+    """Disarm points.  ``clear()`` disarms everything; ``clear(name)``
+    disarms the point under every scope; ``clear(name, scope)`` (scope
+    may be None for the global arming) disarms exactly one entry."""
     with _lock:
         if name is None:
             _points.clear()
+        elif scope is _ALL_SCOPES:
+            for key in [k for k in _points if k[0] == name]:
+                _points.pop(key, None)
         else:
-            _points.pop(name, None)
+            _points.pop((name, scope), None)
 
 
-def fired(name):
-    """How many times point ``name`` has actually fired (0 if unarmed)."""
+def _lookup(name, scope):
+    """The armed fault matching a fire site: exact scope first, then
+    the scope-less global arming.  Call with _lock held."""
+    fault = _points.get((name, scope))
+    if fault is None and scope is not None:
+        fault = _points.get((name, None))
+    return fault
+
+
+def fired(name, scope=None):
+    """How many times point ``name`` has actually fired (0 if unarmed).
+    With ``scope``, reads the per-scope arming (falling back to the
+    global one, mirroring :func:`fire`)."""
     with _lock:
-        fault = _points.get(name)
+        fault = _lookup(name, scope)
         return fault.fired if fault is not None else 0
 
 
-def active(name):
-    """Whether point ``name`` is armed with fires remaining."""
+def active(name, scope=None):
+    """Whether point ``name`` is armed with fires remaining for a firer
+    carrying ``scope``."""
     with _lock:
-        fault = _points.get(name)
+        fault = _lookup(name, scope)
         return fault is not None and fault.remaining != 0
 
 
-def fire(name):
+def fire(name, scope=None):
     """The production-side hook: no-op unless ``name`` is armed.
 
-    Raises :class:`FaultInjected` (mode ``raise``) or sleeps (mode
-    ``sleep``) and decrements the point's remaining count.  The sleep
-    happens OUTSIDE the registry lock so a slow point never blocks
+    ``scope`` identifies the firing replica (see module docstring);
+    scope-less armings match every firer.  Raises
+    :class:`FaultInjected` (mode ``raise``) or sleeps (mode ``sleep``)
+    and decrements the point's remaining count.  The sleep happens
+    OUTSIDE the registry lock so a slow point never blocks
     arming/disarming other points.
     """
     if not _points:  # fast path: nothing armed anywhere
         return
     with _lock:
-        fault = _points.get(name)
+        fault = _lookup(name, scope)
         if fault is None or fault.remaining == 0:
             return
         if fault.remaining > 0:
@@ -135,17 +168,18 @@ class injected:
     ...     # the next decode step raises FaultInjected
     """
 
-    def __init__(self, name, mode="raise", times=1, delay=0.0):
+    def __init__(self, name, mode="raise", times=1, delay=0.0, scope=None):
         self._name = name
+        self._scope = scope
         self._args = (mode, times, delay)
         self.fault = None
 
     def __enter__(self):
-        self.fault = install(self._name, *self._args)
+        self.fault = install(self._name, *self._args, scope=self._scope)
         return self.fault
 
     def __exit__(self, exc_type, exc, tb):
-        clear(self._name)
+        clear(self._name, scope=self._scope)
         return False
 
 
@@ -164,9 +198,11 @@ def load_env(env=None):
                 "'name:mode'".format(entry)
             )
         name, mode = parts[0], parts[1]
+        name, _, scope = name.partition("@")
         times = int(parts[2]) if len(parts) > 2 else 1
         delay = float(parts[3]) if len(parts) > 3 else 0.0
-        install(name, mode=mode, times=times, delay=delay)
+        install(name, mode=mode, times=times, delay=delay,
+                scope=scope or None)
 
 
 load_env()

@@ -72,8 +72,11 @@ class LlamaGenerateModel(Model):
 
     def __init__(self, cfg=None, max_seq=512, server=None,
                  decode_chunk=None, mesh=None, quantize=False,
-                 max_slots=1, max_pending=None):
+                 max_slots=1, max_pending=None, fault_scope=None):
         self._cfg = cfg or llama.tiny(vocab=2048)
+        # replica identity threaded to the scheduler's fault-injection
+        # points (multi-replica chaos harnesses)
+        self._fault_scope = fault_scope
         self._max_seq = max_seq
         self._server = server  # for kv_cache_region xla-shm lookups
         self._mesh = mesh  # tensor-parallel serving when set (tp axis)
@@ -152,6 +155,7 @@ class LlamaGenerateModel(Model):
                     self._scheduler = DecodeScheduler(
                         fns, params, self._max_slots, self._max_seq,
                         max_pending=self._max_pending,
+                        fault_scope=self._fault_scope,
                     )
                 elif self._mesh is not None:
                     init_cache, prefill_fn, chunk_fn = (

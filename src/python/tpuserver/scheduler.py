@@ -116,11 +116,16 @@ class DecodeScheduler:
     queues that the loop fans tokens into.
     """
 
-    def __init__(self, fns, params, max_slots, max_seq, max_pending=None):
+    def __init__(self, fns, params, max_slots, max_seq, max_pending=None,
+                 fault_scope=None):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
             )
+        # replica identity at the shared fault-injection points, so a
+        # multi-server chaos harness can fail ONE scheduler's decode
+        # loop while its pool siblings keep serving
+        self.fault_scope = fault_scope
         self._fns = fns
         self._params = params
         self._max_slots = max_slots
@@ -434,7 +439,7 @@ class DecodeScheduler:
                     # chaos hook: "scheduler.step" raise = decode-step
                     # failure (exercises the donated-cache recovery
                     # below), sleep = slow step
-                    faults.fire("scheduler.step")
+                    faults.fire("scheduler.step", self.fault_scope)
                     tokens_dev, logps_dev, logits, cache = fns["step"](
                         self._params, cache, logits, positions, active,
                         forced_tok, forced_mask,
@@ -459,7 +464,8 @@ class DecodeScheduler:
             if inflight is not None:
                 tokens_dev, logps_dev, snapshot = inflight
                 try:
-                    faults.fire("scheduler.fetch")  # host-transfer chaos
+                    # host-transfer chaos
+                    faults.fire("scheduler.fetch", self.fault_scope)
                     toks = np.asarray(tokens_dev)
                     lps = np.asarray(logps_dev)
                 except Exception as e:  # noqa: BLE001
@@ -476,7 +482,13 @@ class DecodeScheduler:
                         # one-deep pipeline's wasted extra — discard
                         continue
                     if st.cancelled:
-                        slots[i] = None  # consumer gone: free the slot
+                        # consumer gone: free the slot AND retire the
+                        # stream from the live registry — every other
+                        # retire site discards too; missing it here
+                        # left stats()['live_streams'] nonzero and made
+                        # drain() wait out its full timeout
+                        self._streams.discard(st)
+                        slots[i] = None
                         continue
                     if was_forced:
                         continue  # resumed-prompt feed, nothing to emit
@@ -507,7 +519,8 @@ class DecodeScheduler:
         """Prefill-on-admit (or parked-cache restore) into ``slot``."""
         import jax.numpy as jnp
 
-        faults.fire("scheduler.admit")  # admission-failure chaos hook
+        # admission-failure chaos hook
+        faults.fire("scheduler.admit", self.fault_scope)
         fns = self._fns
         if stream.resume_cache is not None:
             # resumed generation: the parked rows become the slot's

@@ -11,6 +11,30 @@ import random
 import threading
 import time
 
+#: Failure classes shared by the same-endpoint retry loop and the
+#: multi-replica pool's failover/breaker logic (tritonclient._pool).
+#: The split matters because retry and failover have different safety
+#: requirements: a retry re-executes against the SAME server, failover
+#: re-executes against a DIFFERENT one, and an "interrupted" request
+#: (sent, outcome unknown) is only safe to re-execute anywhere when the
+#: call is idempotent.
+FAILURE_CONNECT = "connect"  # provably never reached a handler
+FAILURE_OVERLOAD = "overload"  # typed shed-before-work (429/503/...)
+FAILURE_INTERRUPTED = "interrupted"  # request sent, outcome unknown
+FAILURE_OTHER = "other"  # typed non-overload response: server is alive
+
+#: grpc-core detail strings that prove an UNAVAILABLE failed in the
+#: connect phase (the request never left the client).  One definition
+#: shared by the gRPC client's retry loop and the pool's failover
+#: classifier — a marker added to one but not the other would make the
+#: two layers classify the same error differently.
+CONNECT_ERROR_DETAILS = (
+    "failed to connect",
+    "connection refused",
+    "name resolution",
+    "dns resolution failed",
+)
+
 
 class RetryPolicy:
     """Opt-in client retry policy: exponential backoff with full jitter.
@@ -40,6 +64,12 @@ class RetryPolicy:
         sleep is uniform in [0.75b, b], decorrelating retry storms.
     retry_connection_errors : bool
         Set False to retry only typed overload rejections.
+    max_total_s : float or None
+        Optional wall-clock budget for the whole logical call (all
+        attempts plus their backoff sleeps).  When set, backoff sleeps
+        are capped at the remaining budget and no retry starts past it,
+        so a large server ``Retry-After`` hint can never park the
+        caller beyond its own deadline.
     """
 
     #: HTTP statuses retried (gRPC maps RESOURCE_EXHAUSTED/UNAVAILABLE
@@ -48,7 +78,7 @@ class RetryPolicy:
 
     def __init__(self, max_attempts=4, initial_backoff_s=0.05,
                  max_backoff_s=2.0, backoff_multiplier=2.0, jitter=0.25,
-                 retry_connection_errors=True):
+                 retry_connection_errors=True, max_total_s=None):
         if max_attempts < 1:
             raise ValueError(
                 "max_attempts must be >= 1 (got {})".format(max_attempts))
@@ -58,25 +88,92 @@ class RetryPolicy:
         self.backoff_multiplier = float(backoff_multiplier)
         self.jitter = float(jitter)
         self.retry_connection_errors = bool(retry_connection_errors)
+        self.max_total_s = None if max_total_s is None else float(max_total_s)
 
-    def backoff_s(self, attempt, retry_after=None):
-        """Seconds to sleep before retry number ``attempt`` (0-based);
-        a server-supplied ``retry_after`` wins over the schedule, but
+    @staticmethod
+    def parse_retry_after(value):
+        """A server ``Retry-After`` hint as float seconds, or None.
+
+        Only the non-negative delta-seconds integer form is accepted;
+        HTTP-dates, negatives, fractions, and garbage return None so
+        the exponential schedule takes over instead of a sleep the
+        server never meant."""
+        if value is None:
+            return None
+        try:
+            seconds = int(str(value).strip())
+        except (TypeError, ValueError):
+            return None
+        return float(seconds) if seconds >= 0 else None
+
+    def backoff_s(self, attempt, retry_after=None, remaining_s=None):
+        """Seconds to sleep before retry number ``attempt`` (0-based).
+
+        A server-supplied ``retry_after`` wins over the schedule, but
         still gets jitter ADDED on top — the server hands every shed
         client the same number, and N clients sleeping exactly that
         long re-arrive as one synchronized storm that re-trips the
-        cap."""
-        if retry_after is not None:
-            try:
-                base = max(0.0, float(retry_after))
-                return base * (1.0 + self.jitter * random.random())
-            except (TypeError, ValueError):
-                pass  # unparseable header: fall back to the schedule
-        base = min(
-            self.max_backoff_s,
-            self.initial_backoff_s * self.backoff_multiplier ** attempt,
+        cap.  ``remaining_s`` (the caller's leftover deadline budget)
+        caps the final sleep: a large server hint must never park the
+        client past its own timeout."""
+        base = self.parse_retry_after(retry_after)
+        if base is not None:
+            sleep = base * (1.0 + self.jitter * random.random())
+        else:
+            base = min(
+                self.max_backoff_s,
+                self.initial_backoff_s * self.backoff_multiplier ** attempt,
+            )
+            sleep = base * (1.0 - self.jitter * random.random())
+        if remaining_s is not None:
+            sleep = min(sleep, max(0.0, remaining_s))
+        return sleep
+
+    # -- failure classification -------------------------------------------
+
+    def classify_http_status(self, status):
+        """Map an HTTP status to a failure kind (module constants)."""
+        try:
+            code = int(status)
+        except (TypeError, ValueError):
+            return FAILURE_OTHER
+        return (
+            FAILURE_OVERLOAD
+            if code in self.retryable_statuses
+            else FAILURE_OTHER
         )
-        return base * (1.0 - self.jitter * random.random())
+
+    def should_retry(self, kind):
+        """Same-endpoint retry decision: only failures where the server
+        provably did not complete the request — typed overload, and
+        connect-phase failures (when enabled).  Interrupted requests
+        (sent, outcome unknown) are never retried here: a retry hits
+        the SAME server that may have executed the request."""
+        if kind == FAILURE_OVERLOAD:
+            return True
+        if kind == FAILURE_CONNECT:
+            return self.retry_connection_errors
+        return False
+
+    def should_failover(self, kind, idempotent=False):
+        """Cross-endpoint failover decision (tritonclient._pool).
+
+        Typed-overload failures always fail over, connect-phase
+        failures fail over unless ``retry_connection_errors=False``
+        narrowed the policy to typed rejections only — either way the
+        rejecting server did no work, so another replica may.  An
+        interrupted request fails over only when the caller marks the
+        call idempotent: the first server may have executed it, and a
+        second execution elsewhere must be safe.  Typed non-overload
+        responses (4xx/5xx outside the overload set) never fail over —
+        every replica would answer the same."""
+        if kind == FAILURE_CONNECT:
+            return self.retry_connection_errors
+        if kind == FAILURE_OVERLOAD:
+            return True
+        if kind == FAILURE_INTERRUPTED:
+            return bool(idempotent)
+        return False
 
 
 class RequestTimers:

@@ -1,0 +1,800 @@
+"""Health-aware multi-replica client layer: endpoint pools, circuit
+breakers, failover, and hedged requests.
+
+A single-URL client makes one replica a single point of failure: a
+restart or brownout takes every caller down even when N-1 healthy
+replicas are a connect away.  :class:`EndpointPool` wraps one
+``InferenceServerClient`` per URL (HTTP or gRPC — the pool is
+transport-agnostic) behind the same method surface and routes each
+call:
+
+- **health-aware routing** — endpoints are probed via the server's
+  truthful ``is_server_ready()`` (draining/stopped replicas answer
+  false or shed with typed 503/UNAVAILABLE), either by a background
+  prober (``health_interval_s``) or lazily by request outcomes, so
+  sick replicas rotate out before a request is wasted on them;
+- **per-endpoint circuit breaker** — closed → open after
+  ``breaker_threshold`` consecutive typed failures → half-open after
+  the cooldown (a server ``Retry-After`` hint overrides the cooldown),
+  where exactly ONE trial request probes the endpoint while concurrent
+  callers fail over fast;
+- **failover** — typed overload rejections (and connect-phase
+  failures, unless ``retry_connection_errors=False``) provably cost
+  the server no work, so they fall through to the next healthy
+  endpoint under one deadline budget (``deadline_s``), reusing the
+  shared :class:`~tritonclient._auxiliary.RetryPolicy` classification
+  instead of nesting per-endpoint retries inside failover;
+- **hedged requests** (opt-in via ``hedge_delay_s``) — idempotent
+  calls (``infer``, metadata, health) that outlive the hedge delay are
+  raced against a second endpoint and the first success wins; the
+  loser is cancelled if still queued, otherwise discarded on
+  completion (its breaker bookkeeping still lands).  Non-idempotent
+  and streaming calls are never hedged.
+
+Streaming (``start_stream``/``async_stream_infer``) pins one healthy
+endpoint for the stream's lifetime — a stream is stateful, so neither
+failover nor hedging applies mid-stream.
+"""
+
+import socket
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+
+from tritonclient._auxiliary import (
+    CONNECT_ERROR_DETAILS,
+    FAILURE_CONNECT,
+    FAILURE_INTERRUPTED,
+    FAILURE_OTHER,
+    FAILURE_OVERLOAD,
+    RetryPolicy,
+)
+from tritonclient.utils import InferenceServerException, raise_error
+
+__all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
+    "CircuitBreaker",
+    "EndpointPool",
+    "classify_failure",
+]
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+#: Pool methods that are safe to execute twice (hedging, failover of
+#: interrupted calls).  ``infer`` qualifies for the stateless serving
+#: path this repo targets — sequence/stateful calls should go through
+#: a pinned stream instead.
+_IDEMPOTENT_METHODS = frozenset((
+    "infer",
+    "is_server_live",
+    "is_server_ready",
+    "is_model_ready",
+    "get_server_metadata",
+    "get_model_metadata",
+    "get_model_config",
+    "get_model_repository_index",
+    "get_inference_statistics",
+    "get_trace_settings",
+    "get_log_settings",
+    "get_system_shared_memory_status",
+    "get_cuda_shared_memory_status",
+    "get_xla_shared_memory_status",
+))
+
+#: The subset of idempotent calls worth hedging: latency-sensitive and
+#: cheap to duplicate.  Matches the issue contract: infer, metadata,
+#: health — never non-idempotent or streaming calls.
+_HEDGEABLE_METHODS = frozenset((
+    "infer",
+    "is_server_live",
+    "is_server_ready",
+    "is_model_ready",
+    "get_server_metadata",
+    "get_model_metadata",
+    "get_model_config",
+))
+
+#: Methods whose side effect lives on ONE server: routing them through
+#: failover would land the mutation on an arbitrary replica (register a
+#: shm region on A, then round-robin an infer that needs it to B).  The
+#: pool broadcasts these to EVERY endpoint instead, raising the first
+#: failure after attempting all.
+_BROADCAST_METHODS = frozenset((
+    "load_model",
+    "unload_model",
+    "register_system_shared_memory",
+    "unregister_system_shared_memory",
+    "register_cuda_shared_memory",
+    "unregister_cuda_shared_memory",
+    "register_xla_shared_memory",
+    "unregister_xla_shared_memory",
+    "update_trace_settings",
+    "update_log_settings",
+))
+
+#: Server-typed shed messages that prove an UNAVAILABLE was a
+#: shed-before-work rejection (tpuserver's ShuttingDown wording), not a
+#: mid-call reset.
+_SHED_DETAILS = (
+    "draining",
+    "not accepting new requests",
+    "shut down",
+)
+
+
+def classify_failure(exc):
+    """Classify an exception from a pooled client call.
+
+    Returns ``(kind, retry_after_s)`` where ``kind`` is one of the
+    ``tritonclient._auxiliary.FAILURE_*`` constants and
+    ``retry_after_s`` is the server's backoff hint (float seconds) when
+    one was attached to the error, else None.
+    """
+    if isinstance(exc, (ConnectionRefusedError, socket.gaierror)):
+        return FAILURE_CONNECT, None
+    if isinstance(exc, InferenceServerException):
+        status = exc.status() or ""
+        retry_after = RetryPolicy.parse_retry_after(exc.retry_after())
+        if status in ("429", "503"):
+            return FAILURE_OVERLOAD, retry_after
+        if status == "StatusCode.RESOURCE_EXHAUSTED":
+            return FAILURE_OVERLOAD, retry_after
+        if status == "StatusCode.UNAVAILABLE":
+            # UNAVAILABLE conflates three cases; the retry-after
+            # trailer or the detail string disambiguates.
+            if retry_after is not None:
+                return FAILURE_OVERLOAD, retry_after
+            detail = (exc.message() or "").lower()
+            if any(marker in detail for marker in CONNECT_ERROR_DETAILS):
+                return FAILURE_CONNECT, None
+            if any(marker in detail for marker in _SHED_DETAILS):
+                return FAILURE_OVERLOAD, None
+            return FAILURE_INTERRUPTED, None  # possibly a mid-call reset
+        return FAILURE_OTHER, retry_after
+    if isinstance(exc, socket.timeout):
+        return FAILURE_INTERRUPTED, None
+    if isinstance(exc, (ConnectionError, OSError)):
+        # sent-then-dropped: the server may have executed the request
+        return FAILURE_INTERRUPTED, None
+    return FAILURE_OTHER, None
+
+
+class CircuitBreaker:
+    """Per-endpoint circuit breaker: closed → open → half-open.
+
+    - **closed**: requests flow; ``failure_threshold`` consecutive
+      typed failures trip the breaker open.
+    - **open**: requests fail over fast for ``cooldown_s`` seconds (a
+      server ``Retry-After`` hint on the tripping failure overrides
+      the cooldown — the server said when to come back).
+    - **half-open**: after the cooldown, :meth:`allow` grants exactly
+      ONE trial request; concurrent callers keep failing over until
+      the probe reports.  Success closes the breaker, failure re-opens
+      it for another cooldown.
+
+    Thread-safe; ``now`` is injectable for tests.
+    """
+
+    def __init__(self, failure_threshold=3, cooldown_s=5.0,
+                 now=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(
+                "failure_threshold must be >= 1 (got {})".format(
+                    failure_threshold))
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._now = now
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._probe_inflight = False
+
+    def _poll_locked(self):
+        if self._state == BREAKER_OPEN and self._now() >= self._open_until:
+            self._state = BREAKER_HALF_OPEN
+            self._probe_inflight = False
+
+    @property
+    def state(self):
+        with self._lock:
+            self._poll_locked()
+            return self._state
+
+    def reopens_in(self):
+        """Seconds until an open breaker goes half-open (0 when it
+        already allows a probe or is closed)."""
+        with self._lock:
+            self._poll_locked()
+            if self._state != BREAKER_OPEN:
+                return 0.0
+            return max(0.0, self._open_until - self._now())
+
+    def allow(self):
+        """Whether a request may be sent through this endpoint now.
+
+        In half-open state this CONSUMES the single probe slot — only
+        call it for an endpoint the request will actually be sent to.
+        """
+        with self._lock:
+            self._poll_locked()
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                return False
+            if self._probe_inflight:
+                return False  # someone else holds the half-open probe
+            self._probe_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            self._state = BREAKER_CLOSED
+            self._consecutive_failures = 0
+            self._probe_inflight = False
+
+    def record_failure(self, retry_after=None):
+        """Record a typed (connect/overload) failure; returns True when
+        this failure tripped the breaker open."""
+        with self._lock:
+            self._poll_locked()
+            self._probe_inflight = False
+            if self._state == BREAKER_HALF_OPEN:
+                self._trip_locked(retry_after)  # failed probe: re-open
+                return True
+            self._consecutive_failures += 1
+            if (
+                self._state == BREAKER_CLOSED
+                and self._consecutive_failures >= self.failure_threshold
+            ):
+                self._trip_locked(retry_after)
+                return True
+            return False
+
+    def _trip_locked(self, retry_after):
+        cooldown = RetryPolicy.parse_retry_after(retry_after)
+        if cooldown is None:
+            cooldown = self.cooldown_s
+        self._state = BREAKER_OPEN
+        self._open_until = self._now() + cooldown
+
+
+class _Endpoint:
+    """One pooled replica: its client, breaker, and health bookkeeping."""
+
+    def __init__(self, url, client, breaker):
+        self.url = url
+        self.client = client
+        self.breaker = breaker
+        self.healthy = True  # last known readiness (optimistic start)
+        self.requests = 0
+        self.failures = 0
+
+    def stats(self):
+        return {
+            "url": self.url,
+            "healthy": self.healthy,
+            "breaker": self.breaker.state,
+            "requests": self.requests,
+            "failures": self.failures,
+        }
+
+
+class EndpointPool:
+    """N replicas behind the single-client ``InferenceServerClient``
+    surface, with health routing, circuit breaking, failover, and
+    opt-in hedging (module docstring has the full semantics).
+
+    Parameters
+    ----------
+    urls : list[str]
+        ``host:port`` of each replica (two or more for any real HA;
+        one degenerates to a plain client with a breaker).
+    protocol : str
+        ``"http"`` or ``"grpc"`` — selects the default client class.
+        The asyncio clients are not poolable yet (ISSUE 3 scopes the
+        sync clients); ``"http_aio"``/``"grpc_aio"`` raise
+        NotImplementedError.
+    client_factory : callable(url) -> client
+        Overrides client construction (tests inject fakes here).  The
+        produced clients must NOT carry their own ``retry_policy`` —
+        the pool owns retry/failover, and nesting retries inside
+        failover multiplies attempts against a sick endpoint.
+    retry_policy : tritonclient._auxiliary.RetryPolicy
+        Attempt budget, backoff schedule, and failure classification
+        shared across endpoints (default: ``RetryPolicy()``).  One
+        logical call makes at most ``max_attempts`` endpoint attempts
+        TOTAL, not per endpoint.
+    breaker_threshold / breaker_cooldown_s
+        Circuit-breaker tuning (see :class:`CircuitBreaker`).
+    health_interval_s : float or None
+        When set, a daemon thread probes every endpoint's
+        ``is_server_ready()`` on this cadence and feeds the breakers,
+        rotating draining replicas out before any request is wasted.
+        None (default) relies on lazy signals: request outcomes and
+        half-open trial requests.
+    hedge_delay_s : float or None
+        Opt-in hedging: an idempotent call still pending after this
+        many seconds is raced against a second endpoint.  None
+        disables hedging.
+    deadline_s : float or None
+        Wall-clock budget for one logical call across all failover
+        attempts and backoff sleeps.
+    """
+
+    def __init__(self, urls, protocol="http", client_factory=None,
+                 retry_policy=None, breaker_threshold=3,
+                 breaker_cooldown_s=5.0, health_interval_s=None,
+                 hedge_delay_s=None, deadline_s=None, verbose=False,
+                 **client_kwargs):
+        if not urls:
+            raise_error("EndpointPool requires at least one endpoint URL")
+        if len(set(urls)) != len(urls):
+            raise_error("EndpointPool URLs must be unique: {}".format(urls))
+        if protocol in ("http_aio", "grpc_aio"):
+            raise NotImplementedError(
+                "EndpointPool does not support the asyncio clients yet "
+                "(ISSUE 3: health-aware multi-replica client covers the "
+                "sync clients; aio pooling is follow-up work)")
+        if client_factory is None:
+            if protocol == "http":
+                import tritonclient.http as _mod
+            elif protocol == "grpc":
+                import tritonclient.grpc as _mod
+            else:
+                raise_error(
+                    "unknown protocol {!r} (use 'http' or 'grpc', or "
+                    "pass client_factory)".format(protocol))
+
+            def client_factory(url, _mod=_mod):
+                return _mod.InferenceServerClient(
+                    url, verbose=verbose, **client_kwargs)
+
+        self._policy = retry_policy if retry_policy is not None else (
+            RetryPolicy())
+        self._deadline_s = deadline_s
+        self._hedge_delay_s = hedge_delay_s
+        self._verbose = verbose
+        self._lock = threading.Lock()
+        self._rr = 0  # round-robin cursor
+        self._closed = False
+        self._stream_endpoint = None
+        self._hedges_fired = 0
+        self._hedges_won = 0
+        self._endpoints = []
+        for url in urls:
+            client = client_factory(url)
+            if getattr(client, "_retry_policy", None) is not None:
+                for ep in self._endpoints:
+                    ep.client.close()
+                client.close()
+                raise_error(
+                    "per-endpoint clients must not carry their own "
+                    "retry_policy: the pool owns retries and failover "
+                    "(nesting retries inside failover multiplies "
+                    "attempts against a sick endpoint) — pass "
+                    "retry_policy to the EndpointPool instead")
+            self._endpoints.append(_Endpoint(
+                url,
+                client,
+                CircuitBreaker(
+                    failure_threshold=breaker_threshold,
+                    cooldown_s=breaker_cooldown_s,
+                ),
+            ))
+        # two separate executors: async_infer callers occupy _executor
+        # workers while (possibly) blocking on hedge futures, so hedge
+        # attempts MUST run on their own executor — sharing one bounded
+        # pool would let saturated async_infer workers wait on primary
+        # attempts queued behind themselves, a permanent deadlock.
+        # Hedge tasks never submit further tasks, so the hedge executor
+        # always makes progress.
+        self._executor = None
+        self._hedge_executor = None
+        self._executor_lock = threading.Lock()
+        self._prober = None
+        self._prober_stop = threading.Event()
+        if health_interval_s is not None:
+            if health_interval_s <= 0:
+                raise_error("health_interval_s must be positive or None")
+            self._prober = threading.Thread(
+                target=self._probe_loop,
+                args=(float(health_interval_s),),
+                name="tritonclient-pool-prober",
+                daemon=True,
+            )
+            self._prober.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+
+    def close(self):
+        """Stop the prober and hedging workers, close every client."""
+        if self._closed:
+            return
+        self._closed = True
+        self._prober_stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5)
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+        if self._hedge_executor is not None:
+            # joins hedge losers too: a discarded attempt fully resolves
+            # (and lands its breaker bookkeeping) before clients close
+            self._hedge_executor.shutdown(wait=True)
+        for ep in self._endpoints:
+            try:
+                ep.client.close()
+            except Exception:
+                pass
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self):
+        """Per-endpoint health/breaker/traffic counters plus hedging
+        totals — the pool's routing decisions, inspectable."""
+        return {
+            "endpoints": [ep.stats() for ep in self._endpoints],
+            "hedges_fired": self._hedges_fired,
+            "hedges_won": self._hedges_won,
+        }
+
+    def endpoint_states(self):
+        """``{url: breaker_state}`` — convenience for tests/dashboards."""
+        return {ep.url: ep.breaker.state for ep in self._endpoints}
+
+    # -- health probing ----------------------------------------------------
+
+    def _probe_loop(self, interval_s):
+        while not self._prober_stop.wait(interval_s):
+            for ep in self._endpoints:
+                if self._prober_stop.is_set():
+                    return
+                self._probe_endpoint(ep)
+
+    def _probe_endpoint(self, ep):
+        """One readiness probe, feeding both the health flag and the
+        breaker.  'Not ready' (draining/starting) counts as a typed
+        failure — the server answered, and the answer was 'route
+        away'; breaker state therefore tracks readiness, so it
+        re-closes only once the server returns to ready."""
+        state = ep.breaker.state
+        if state == BREAKER_OPEN:
+            return  # cooling down; probing would defeat the cooldown
+        if state == BREAKER_HALF_OPEN and not ep.breaker.allow():
+            return  # another caller holds the half-open probe slot
+        try:
+            ready = bool(ep.client.is_server_ready())
+        except Exception as exc:  # noqa: BLE001 — any probe failure counts
+            kind, retry_after = classify_failure(exc)
+            ep.healthy = False
+            ep.breaker.record_failure(
+                retry_after if kind != FAILURE_OTHER else None)
+            return
+        ep.healthy = ready
+        if ready:
+            ep.breaker.record_success()
+        else:
+            ep.breaker.record_failure()
+
+    # -- endpoint selection ------------------------------------------------
+
+    def _rotation(self):
+        """Endpoints in round-robin order starting at the cursor."""
+        with self._lock:
+            n = len(self._endpoints)
+            start = self._rr
+            self._rr = (self._rr + 1) % n
+        return [self._endpoints[(start + i) % n] for i in range(n)]
+
+    def _pick(self, exclude=()):
+        """The next endpoint to try, or None when every breaker is open
+        (or holding a half-open probe).  Healthy endpoints are
+        preferred; unhealthy ones are last-resort candidates whose
+        half-open breakers meter the traffic they see.  Consumes the
+        half-open probe slot of the endpoint it returns."""
+        rotation = self._rotation()
+        candidates = [ep for ep in rotation if ep.healthy] + [
+            ep for ep in rotation if not ep.healthy
+        ]
+        for ep in candidates:
+            if ep in exclude:
+                continue
+            if ep.breaker.allow():
+                return ep
+        return None
+
+    def _any_routable(self, exclude=()):
+        """Whether any endpoint could accept traffic without waiting
+        out a cooldown (no probe slots consumed)."""
+        return any(
+            ep.breaker.state != BREAKER_OPEN
+            for ep in self._endpoints
+            if ep not in exclude
+        )
+
+    # -- the failover core -------------------------------------------------
+
+    def _pool_unavailable(self, last_exc):
+        if last_exc is not None:
+            raise last_exc
+        reopen = min(
+            (ep.breaker.reopens_in() for ep in self._endpoints),
+            default=0.0,
+        )
+        raise InferenceServerException(
+            msg="no pool endpoint available: every circuit breaker is "
+                "open (earliest half-open probe in {:.2f}s)".format(reopen),
+            status="503",
+        )
+
+    def _invoke(self, method_name, args, kwargs, idempotent,
+                exclude_first=(), stop=None, on_pick=None):
+        """One logical call with failover across endpoints.
+
+        ``exclude_first`` keeps a hedge's secondary off the primary's
+        endpoint for its first attempt; ``stop`` (threading.Event) lets
+        a hedge loser abandon further attempts once the winner landed;
+        ``on_pick(ep)`` observes every endpoint an attempt is sent to
+        (the hedge uses it to aim its secondary elsewhere).
+        """
+        policy = self._policy
+        deadline = (
+            time.monotonic() + self._deadline_s
+            if self._deadline_s is not None
+            else None
+        )
+        attempt = 0
+        last_exc = None
+        exclude = tuple(exclude_first)
+        while attempt < policy.max_attempts:
+            if stop is not None and stop.is_set():
+                self._pool_unavailable(last_exc)
+            remaining = (
+                deadline - time.monotonic() if deadline is not None else None
+            )
+            if remaining is not None and remaining <= 0:
+                self._pool_unavailable(last_exc)
+            ep = self._pick(exclude=exclude)
+            if ep is None and exclude:
+                exclude = ()  # hedge preference only holds for attempt 1
+                ep = self._pick()
+            if ep is None:
+                self._pool_unavailable(last_exc)
+            exclude = ()
+            attempt += 1
+            if on_pick is not None:
+                on_pick(ep)
+            ep.requests += 1
+            try:
+                result = getattr(ep.client, method_name)(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                kind, retry_after = classify_failure(exc)
+                if not policy.should_failover(kind, idempotent=idempotent):
+                    if kind == FAILURE_OTHER:
+                        # a typed answer: the endpoint is alive and
+                        # serving — reset its failure streak
+                        ep.breaker.record_success()
+                        ep.healthy = True
+                    else:
+                        ep.failures += 1
+                        ep.breaker.record_failure(retry_after)
+                        ep.healthy = False
+                    raise
+                ep.failures += 1
+                ep.breaker.record_failure(retry_after)
+                ep.healthy = False
+                last_exc = exc
+                if attempt >= policy.max_attempts:
+                    break
+                if not self._any_routable(exclude=(ep,)):
+                    # nowhere else to go: honor the backoff (capped at
+                    # the remaining budget) before trying again
+                    remaining = (
+                        deadline - time.monotonic()
+                        if deadline is not None
+                        else None
+                    )
+                    if remaining is not None and remaining <= 0:
+                        break
+                    time.sleep(policy.backoff_s(
+                        attempt - 1, retry_after, remaining))
+                continue
+            else:
+                ep.breaker.record_success()
+                ep.healthy = True
+                return result
+        self._pool_unavailable(last_exc)
+
+    # -- hedging -----------------------------------------------------------
+
+    def _ensure_executor(self):
+        with self._executor_lock:
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=max(8, 4 * len(self._endpoints)),
+                    thread_name_prefix="tritonclient-pool",
+                )
+            return self._executor
+
+    def _ensure_hedge_executor(self):
+        with self._executor_lock:
+            if self._hedge_executor is None:
+                self._hedge_executor = ThreadPoolExecutor(
+                    max_workers=max(16, 8 * len(self._endpoints)),
+                    thread_name_prefix="tritonclient-pool-hedge",
+                )
+            return self._hedge_executor
+
+    def _hedged(self, method_name, args, kwargs):
+        """Race a primary attempt against a delayed secondary on a
+        different endpoint; first success wins, the loser is cancelled
+        if still queued and discarded otherwise."""
+        executor = self._ensure_hedge_executor()
+        picked = []  # every endpoint the primary sends an attempt to
+        stop = threading.Event()
+        primary = executor.submit(
+            self._invoke, method_name, args, kwargs, True, (), stop,
+            picked.append)
+        done, _ = wait((primary,), timeout=self._hedge_delay_s)
+        if done:
+            return primary.result()
+        # aim the secondary away from wherever the primary is NOW
+        # (after its own failovers), not just its first endpoint
+        hedge_exclude = (picked[-1],) if picked else ()
+        with self._lock:
+            self._hedges_fired += 1
+        secondary = executor.submit(
+            self._invoke, method_name, args, kwargs, True,
+            hedge_exclude, stop)
+        futures = {primary, secondary}
+        first_error = None
+        while futures:
+            done, futures = wait(futures, return_when=FIRST_COMPLETED)
+            for fut in done:
+                exc = fut.exception()
+                if exc is None:
+                    # winner: stop the loser's failover loop and cancel
+                    # it outright if it has not started yet
+                    stop.set()
+                    for loser in futures:
+                        loser.cancel()
+                    if fut is secondary:
+                        with self._lock:
+                            self._hedges_won += 1
+                    return fut.result()
+                if first_error is None:
+                    first_error = exc
+        raise first_error
+
+    # -- public surface ----------------------------------------------------
+
+    def _broadcast(self, method_name, args, kwargs):
+        """Apply a per-server mutation to EVERY endpoint (skipping
+        none): replicas must agree on registered shm regions, loaded
+        models, and settings, or the next round-robined request lands
+        on a replica missing the side effect.  Every endpoint is
+        attempted; the first failure is raised afterwards."""
+        result = None
+        first_exc = None
+        for ep in self._endpoints:
+            try:
+                result = getattr(ep.client, method_name)(*args, **kwargs)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                if first_exc is None:
+                    first_exc = exc
+                kind, retry_after = classify_failure(exc)
+                if kind != FAILURE_OTHER:
+                    ep.failures += 1
+                    ep.breaker.record_failure(retry_after)
+                    ep.healthy = False
+        if first_exc is not None:
+            raise first_exc
+        return result
+
+    def _dispatch(self, method_name, args, kwargs):
+        if self._closed:
+            raise_error("EndpointPool is closed")
+        if method_name in _BROADCAST_METHODS:
+            return self._broadcast(method_name, args, kwargs)
+        idempotent = method_name in _IDEMPOTENT_METHODS
+        if (
+            self._hedge_delay_s is not None
+            and method_name in _HEDGEABLE_METHODS
+            and len(self._endpoints) > 1
+        ):
+            return self._hedged(method_name, args, kwargs)
+        return self._invoke(method_name, args, kwargs, idempotent)
+
+    def infer(self, *args, **kwargs):
+        """Pool-routed ``infer`` (failover; hedged when enabled)."""
+        return self._dispatch("infer", args, kwargs)
+
+    def async_infer(self, *args, **kwargs):
+        """Pool-routed async infer: runs :meth:`infer` (with its full
+        failover/hedging semantics) on a pool worker and returns the
+        HTTP client's ``InferAsyncRequest`` handle
+        (``get_result(block=True, timeout=None)``).  The gRPC callback
+        form is not reproduced here; pass a callable as the third
+        positional argument only to the plain gRPC client."""
+        # lazy import: tritonclient.http's package __init__ imports
+        # this module, so a module-level import would be circular
+        from tritonclient.http._client import InferAsyncRequest
+
+        future = self._ensure_executor().submit(
+            self._dispatch, "infer", args, kwargs)
+        return InferAsyncRequest(future, self._verbose)
+
+    # -- streaming: pinned, never hedged, never failed over ----------------
+
+    def start_stream(self, *args, **kwargs):
+        """Open a stream on ONE healthy endpoint and pin it: streams
+        are stateful, so mid-stream failover/hedging would corrupt
+        sequence state.  ``stop_stream`` unpins."""
+        if self._stream_endpoint is not None:
+            raise_error(
+                "cannot start another stream with one already active")
+        ep = self._pick()
+        if ep is None:
+            self._pool_unavailable(None)
+        try:
+            result = ep.client.start_stream(*args, **kwargs)
+        except Exception as exc:  # noqa: BLE001 — classified for breaker
+            # every outcome must reach the breaker: _pick() may have
+            # consumed the half-open probe slot, and only
+            # record_success/record_failure release it — an unrecorded
+            # failure would blacklist the endpoint forever
+            kind, retry_after = classify_failure(exc)
+            if kind == FAILURE_OTHER:
+                ep.breaker.record_success()  # typed answer: alive
+            else:
+                ep.breaker.record_failure(
+                    retry_after if kind == FAILURE_OVERLOAD else None)
+                ep.healthy = False
+            raise
+        ep.breaker.record_success()
+        self._stream_endpoint = ep
+        return result
+
+    def async_stream_infer(self, *args, **kwargs):
+        if self._stream_endpoint is None:
+            raise_error("stream not available, use start_stream() first")
+        return self._stream_endpoint.client.async_stream_infer(
+            *args, **kwargs)
+
+    def stop_stream(self, *args, **kwargs):
+        ep, self._stream_endpoint = self._stream_endpoint, None
+        if ep is not None:
+            return ep.client.stop_stream(*args, **kwargs)
+
+    # -- everything else: generic delegation with failover ----------------
+
+    def __getattr__(self, name):
+        # Only reached for attributes not defined above.  Delegate any
+        # public client method through the failover dispatcher so the
+        # pool exposes the full InferenceServerClient surface without
+        # hand-writing ~40 wrappers.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        probe = getattr(self._endpoints[0].client, name, None)
+        if not callable(probe):
+            raise AttributeError(
+                "{!r} is not a method of the pooled client".format(name))
+
+        def pooled_method(*args, _pool_method=name, **kwargs):
+            return self._dispatch(_pool_method, args, kwargs)
+
+        pooled_method.__name__ = name
+        pooled_method.__doc__ = probe.__doc__
+        return pooled_method
+
+

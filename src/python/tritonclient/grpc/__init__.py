@@ -2,11 +2,22 @@
 ``tritonclient.grpc.aio``)."""
 
 from tritonclient.grpc import model_config_pb2, grpc_service_pb2  # noqa: F401
+from tritonclient._pool import CircuitBreaker  # noqa: F401
+from tritonclient._pool import EndpointPool as _EndpointPool
 from tritonclient.grpc._client import (  # noqa: F401
     InferenceServerClient,
     KeepAliveOptions,
     RetryPolicy,
 )
+
+
+class EndpointPool(_EndpointPool):
+    """``tritonclient._pool.EndpointPool`` defaulting to gRPC clients —
+    the import location implies the protocol, so the grpc namespace
+    must not silently build HTTP clients against gRPC ports."""
+
+    def __init__(self, urls, protocol="grpc", **kwargs):
+        super().__init__(urls, protocol=protocol, **kwargs)
 from tritonclient.grpc._infer_input import (  # noqa: F401
     InferInput,
     InferRequestedOutput,

@@ -13,7 +13,10 @@ import time
 
 import grpc
 
-from tritonclient._auxiliary import RetryPolicy  # noqa: F401 — re-exported
+from tritonclient._auxiliary import (  # noqa: F401 — RetryPolicy re-exported
+    CONNECT_ERROR_DETAILS,
+    RetryPolicy,
+)
 from tritonclient.utils import InferenceServerException, raise_error
 
 from . import grpc_service_pb2 as pb
@@ -21,7 +24,12 @@ from ._infer_input import InferInput, InferRequestedOutput  # noqa: F401
 from ._infer_result import InferResult
 from ._infer_stream import _InferStream
 from ._service import ServiceStub
-from ._utils import _get_inference_request, get_error_grpc, raise_error_grpc
+from ._utils import (
+    _get_inference_request,
+    get_error_grpc,
+    raise_error_grpc,
+    retry_after_from_rpc_error,
+)
 
 # Reference grpc_client.cc:78-145 keeps a process-wide channel cache with a
 # share count; grpc-python channels multiplex internally, so one channel per
@@ -148,36 +156,42 @@ class InferenceServerClient:
     @staticmethod
     def _is_connect_failure(rpc_error):
         """Whether an UNAVAILABLE provably failed before the request
-        left the client (grpc-core's connect-phase detail strings).
-        Best-effort: an unrecognized detail is treated as possibly
-        mid-call, i.e. NOT safely retryable."""
+        left the client (grpc-core's connect-phase detail strings,
+        shared with the pool's classifier).  Best-effort: an
+        unrecognized detail is treated as possibly mid-call, i.e. NOT
+        safely retryable."""
         try:
             details = (rpc_error.details() or "").lower()
         except Exception:
             return False
-        return (
-            "failed to connect" in details
-            or "connection refused" in details
-            or "name resolution" in details
-            or "dns resolution failed" in details
-        )
+        return any(marker in details for marker in CONNECT_ERROR_DETAILS)
 
     @staticmethod
     def _retry_after_of(rpc_error):
         """The server's ``retry-after`` trailing-metadata value (the
         gRPC twin of the HTTP header), or None."""
-        try:
-            for key, value in rpc_error.trailing_metadata() or ():
-                if key.lower() == "retry-after":
-                    return value
-        except Exception:
-            pass
-        return None
+        return retry_after_from_rpc_error(rpc_error)
 
     def _call(self, name, request, headers=None, timeout=None):
         if self._verbose:
             print("{}, metadata {}\n{}".format(name, headers, request))
         policy = self._retry_policy
+        # the retry loop's wall-clock budget: the sooner of the caller's
+        # RPC timeout and the policy's max_total_s — a server Retry-After
+        # hint may never sleep past either
+        budget_s = None
+        if policy is not None:
+            if timeout is not None:
+                budget_s = float(timeout)
+            if policy.max_total_s is not None:
+                budget_s = (
+                    policy.max_total_s
+                    if budget_s is None
+                    else min(budget_s, policy.max_total_s)
+                )
+        budget_deadline = (
+            time.monotonic() + budget_s if budget_s is not None else None
+        )
         attempt = 0
         while True:
             try:
@@ -215,8 +229,19 @@ class InferenceServerClient:
                     )
                 else:
                     retryable = False
-                if retryable and attempt + 1 < policy.max_attempts:
-                    time.sleep(policy.backoff_s(attempt, retry_after))
+                remaining = (
+                    budget_deadline - time.monotonic()
+                    if budget_deadline is not None
+                    else None
+                )
+                if (
+                    retryable
+                    and attempt + 1 < policy.max_attempts
+                    and (remaining is None or remaining > 0)
+                ):
+                    time.sleep(
+                        policy.backoff_s(attempt, retry_after, remaining)
+                    )
                     attempt += 1
                     continue
                 raise_error_grpc(rpc_error)

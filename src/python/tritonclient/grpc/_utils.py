@@ -14,6 +14,18 @@ def raise_error_grpc(rpc_error):
     raise get_error_grpc(rpc_error) from None
 
 
+def retry_after_from_rpc_error(rpc_error):
+    """The server's ``retry-after`` trailing-metadata value (the gRPC
+    twin of the HTTP Retry-After header), or None."""
+    try:
+        for key, value in rpc_error.trailing_metadata() or ():
+            if key.lower() == "retry-after":
+                return value
+    except Exception:
+        pass
+    return None
+
+
 def get_error_grpc(rpc_error):
     try:
         msg = rpc_error.details()
@@ -22,7 +34,12 @@ def get_error_grpc(rpc_error):
     except Exception:
         msg = str(rpc_error)
         status = None
-    return InferenceServerException(msg=msg, status=status)
+    # the retry-after hint rides along so retry/failover layers
+    # (tritonclient._pool) can honor the server's cooldown
+    return InferenceServerException(
+        msg=msg, status=status,
+        retry_after=retry_after_from_rpc_error(rpc_error),
+    )
 
 
 def _get_inference_request(

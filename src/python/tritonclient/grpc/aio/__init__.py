@@ -41,7 +41,19 @@ class InferenceServerClient:
         creds=None,
         keepalive_options=None,
         channel_args=None,
+        retry_policy=None,
     ):
+        if retry_policy is not None:
+            # reject loudly instead of silently ignoring the kwarg —
+            # a caller passing a policy here believes they have retry
+            # protection they do not have
+            raise NotImplementedError(
+                "retry_policy / EndpointPool are not supported on the "
+                "asyncio gRPC client yet (ISSUE 3 'Health-aware "
+                "multi-replica client' covers the sync clients only); "
+                "use tritonclient.grpc.InferenceServerClient or an "
+                "asyncio-side retry wrapper"
+            )
         if keepalive_options is None:
             keepalive_options = KeepAliveOptions()
         options = [

@@ -1,6 +1,7 @@
 """HTTP/REST client for the KServe-v2 protocol (sync; see ``.aio`` for
 asyncio).  Mirrors the surface of reference ``tritonclient.http``."""
 
+from tritonclient._pool import CircuitBreaker, EndpointPool
 from tritonclient.http._client import (
     InferAsyncRequest,
     InferenceServerClient,
@@ -12,6 +13,8 @@ from tritonclient.http._client import (
 from tritonclient.utils import InferenceServerException
 
 __all__ = [
+    "CircuitBreaker",
+    "EndpointPool",
     "InferAsyncRequest",
     "InferenceServerClient",
     "InferenceServerException",

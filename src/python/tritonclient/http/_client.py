@@ -397,6 +397,20 @@ class InferenceServerClient:
             return self._request_once(
                 method, request_uri, body, headers, query_params
             )
+        # the logical-call budget: no backoff sleep may extend past it,
+        # so a large server Retry-After hint cannot park the caller
+        # beyond its own deadline
+        budget_deadline = (
+            time.monotonic() + policy.max_total_s
+            if policy.max_total_s is not None
+            else None
+        )
+
+        def _remaining():
+            if budget_deadline is None:
+                return None
+            return budget_deadline - time.monotonic()
+
         attempt = 0
         while True:
             try:
@@ -407,22 +421,26 @@ class InferenceServerClient:
                 # connect-phase failure only: a ConnectionError AFTER
                 # the request was sent (reset mid-response) is NOT here
                 # — the server may have executed it
+                remaining = _remaining()
                 if (
                     not policy.retry_connection_errors
                     or attempt + 1 >= policy.max_attempts
+                    or (remaining is not None and remaining <= 0)
                 ):
                     raise
-                time.sleep(policy.backoff_s(attempt))
+                time.sleep(policy.backoff_s(attempt, None, remaining))
                 attempt += 1
                 continue
+            remaining = _remaining()
             if (
                 status in policy.retryable_statuses
                 and attempt + 1 < policy.max_attempts
+                and (remaining is None or remaining > 0)
             ):
                 retry_after = {
                     k.lower(): v for k, v in resp_headers.items()
                 }.get("retry-after")
-                time.sleep(policy.backoff_s(attempt, retry_after))
+                time.sleep(policy.backoff_s(attempt, retry_after, remaining))
                 attempt += 1
                 continue
             return status, resp_headers, resp_body
@@ -484,16 +502,26 @@ class InferenceServerClient:
         )
 
     @staticmethod
-    def _raise_if_error(status, response_body):
+    def _raise_if_error(status, response_body, response_headers=None):
         if status != 200:
+            retry_after = None
+            if response_headers:
+                # carried onto the exception so retry/failover layers
+                # (tritonclient._pool) can honor the server's cooldown
+                retry_after = {
+                    k.lower(): v for k, v in response_headers.items()
+                }.get("retry-after")
             raise InferenceServerException(
                 msg=_get_error_message(response_body),
                 status=str(status),
+                retry_after=retry_after,
             )
 
     def _get_json(self, request_uri, headers=None, query_params=None):
-        status, _, body = self._get(request_uri, headers, query_params)
-        self._raise_if_error(status, body)
+        status, resp_headers, body = self._get(
+            request_uri, headers, query_params
+        )
+        self._raise_if_error(status, body, resp_headers)
         content = json.loads(body) if body else {}
         if self._verbose:
             print(content)
@@ -502,10 +530,10 @@ class InferenceServerClient:
     def _post_json(self, request_uri, request=None, headers=None,
                    query_params=None):
         body = json.dumps(request).encode("utf-8") if request is not None else b""
-        status, _, resp_body = self._post(
+        status, resp_headers, resp_body = self._post(
             request_uri, body, headers, query_params
         )
-        self._raise_if_error(status, resp_body)
+        self._raise_if_error(status, resp_body, resp_headers)
         content = json.loads(resp_body) if resp_body else {}
         if self._verbose:
             print(content)
@@ -887,7 +915,7 @@ class InferenceServerClient:
                 query_params,
             )
             timers.send_end()
-            self._raise_if_error(status, response_body)
+            self._raise_if_error(status, response_body, resp_headers)
         except Exception:
             self._infer_stat.update(timers, success=False)
             raise

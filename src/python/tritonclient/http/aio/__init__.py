@@ -34,7 +34,19 @@ class InferenceServerClient:
         network_timeout=60.0,
         ssl=False,
         ssl_context=None,
+        retry_policy=None,
     ):
+        if retry_policy is not None:
+            # reject loudly instead of silently ignoring the kwarg —
+            # a caller passing a policy here believes they have retry
+            # protection they do not have
+            raise NotImplementedError(
+                "retry_policy / EndpointPool are not supported on the "
+                "asyncio HTTP client yet (ISSUE 3 'Health-aware "
+                "multi-replica client' covers the sync clients only); "
+                "use tritonclient.http.InferenceServerClient or an "
+                "asyncio-side retry wrapper"
+            )
         scheme = "https" if ssl else "http"
         self._base_url = "{}://{}".format(scheme, url)
         self._verbose = verbose

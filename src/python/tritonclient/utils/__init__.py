@@ -39,10 +39,12 @@ class InferenceServerException(Exception):
     Mirrors reference utils/__init__.py:66-125.
     """
 
-    def __init__(self, msg, status=None, debug_details=None):
+    def __init__(self, msg, status=None, debug_details=None,
+                 retry_after=None):
         self._msg = msg
         self._status = status
         self._debug_details = debug_details
+        self._retry_after = retry_after
         super().__init__(msg)
 
     def __str__(self):
@@ -62,6 +64,13 @@ class InferenceServerException(Exception):
     def debug_details(self):
         """Get the detailed information about the exception, or None."""
         return self._debug_details
+
+    def retry_after(self):
+        """The server's Retry-After backoff hint (HTTP header / gRPC
+        trailing metadata) attached to this failure, or None.  Typed
+        overload rejections carry it so retry/failover layers honor
+        the server's own cooldown."""
+        return self._retry_after
 
 
 def raise_error(msg):

@@ -1,0 +1,692 @@
+"""Multi-replica client tests: endpoint pools, circuit breakers,
+failover, and hedged requests (ISSUE 3).
+
+The chaos bar: with a 2-endpoint pool and one real in-process server
+drained mid-traffic, every idempotent request completes via failover —
+zero user-visible errors — and the drained endpoint's breaker re-closes
+only after the server returns to ready.  Breaker/classification
+semantics are unit-tested against a fake clock and fake clients so the
+timing-sensitive state machine is exercised deterministically.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tritonclient.http as httpclient
+from tritonclient._auxiliary import (
+    FAILURE_CONNECT,
+    FAILURE_INTERRUPTED,
+    FAILURE_OTHER,
+    FAILURE_OVERLOAD,
+    RetryPolicy,
+)
+from tritonclient._pool import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    EndpointPool,
+    classify_failure,
+)
+from tritonclient.utils import InferenceServerException
+
+from tpuserver import faults
+from tpuserver.core import InferenceServer, ServerError
+from tpuserver.http_frontend import HttpFrontend
+from tpuserver.models.simple import SimpleModel
+
+pytestmark = pytest.mark.pool
+
+
+# -- circuit breaker state machine (fake clock) ------------------------------
+
+
+def test_breaker_transitions_and_retry_after_cooldown():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                       now=lambda: clock[0])
+    assert b.state == BREAKER_CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == BREAKER_CLOSED  # below threshold
+    # the tripping failure carries Retry-After=10: it overrides the
+    # configured 5 s cooldown — the server said when to come back
+    b.record_failure(retry_after="10")
+    assert b.state == BREAKER_OPEN and not b.allow()
+    clock[0] = 6.0
+    assert b.state == BREAKER_OPEN  # 5 s cooldown would have reopened
+    assert b.reopens_in() == pytest.approx(4.0)
+    clock[0] = 10.0
+    assert b.state == BREAKER_HALF_OPEN
+    assert b.allow()  # the single trial probe
+    b.record_failure()  # failed probe: re-open for another cooldown
+    assert b.state == BREAKER_OPEN
+    clock[0] = 16.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == BREAKER_CLOSED
+    # success resets the consecutive-failure streak
+    b.record_failure()
+    assert b.state == BREAKER_CLOSED
+
+
+def test_breaker_half_open_grants_exactly_one_probe_under_concurrency():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                       now=lambda: clock[0])
+    b.record_failure()
+    assert b.state == BREAKER_OPEN
+    clock[0] = 2.0  # half-open now
+    grants = []
+    barrier = threading.Barrier(8)
+
+    def contender():
+        barrier.wait()
+        grants.append(b.allow())
+
+    threads = [threading.Thread(target=contender) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=5)
+    # exactly ONE concurrent caller won the trial probe; the rest fail
+    # over fast instead of stampeding the recovering endpoint
+    assert grants.count(True) == 1 and len(grants) == 8
+    b.record_success()
+    assert b.state == BREAKER_CLOSED
+
+
+# -- failure classification --------------------------------------------------
+
+
+def test_classify_failure_kinds():
+    import socket
+
+    assert classify_failure(ConnectionRefusedError())[0] == FAILURE_CONNECT
+    assert classify_failure(
+        socket.gaierror(8, "nodename nor servname"))[0] == FAILURE_CONNECT
+    assert classify_failure(ConnectionResetError())[0] == FAILURE_INTERRUPTED
+    kind, ra = classify_failure(
+        InferenceServerException("shed", status="429", retry_after="7"))
+    assert kind == FAILURE_OVERLOAD and ra == 7.0
+    assert classify_failure(
+        InferenceServerException("bad", status="400"))[0] == FAILURE_OTHER
+    # gRPC UNAVAILABLE disambiguation: trailer > detail string > unknown
+    assert classify_failure(InferenceServerException(
+        "x", status="StatusCode.UNAVAILABLE", retry_after="1",
+    ))[0] == FAILURE_OVERLOAD
+    assert classify_failure(InferenceServerException(
+        "failed to connect to all addresses",
+        status="StatusCode.UNAVAILABLE",
+    ))[0] == FAILURE_CONNECT
+    assert classify_failure(InferenceServerException(
+        "server is draining; not accepting new requests",
+        status="StatusCode.UNAVAILABLE",
+    ))[0] == FAILURE_OVERLOAD
+    assert classify_failure(InferenceServerException(
+        "stream reset by peer", status="StatusCode.UNAVAILABLE",
+    ))[0] == FAILURE_INTERRUPTED
+
+
+def test_retry_vs_failover_classification_split():
+    policy = RetryPolicy()
+    # same-endpoint retry: only provably-not-executed failures
+    assert policy.should_retry(FAILURE_OVERLOAD)
+    assert policy.should_retry(FAILURE_CONNECT)
+    assert not policy.should_retry(FAILURE_INTERRUPTED)
+    assert not policy.should_retry(FAILURE_OTHER)
+    # failover adds the idempotent-interrupted case and nothing else
+    assert policy.should_failover(FAILURE_OVERLOAD)
+    assert policy.should_failover(FAILURE_CONNECT)
+    assert not policy.should_failover(FAILURE_INTERRUPTED)
+    assert policy.should_failover(FAILURE_INTERRUPTED, idempotent=True)
+    assert not policy.should_failover(FAILURE_OTHER, idempotent=True)
+    # retry_connection_errors=False narrows both decisions the same way
+    narrow = RetryPolicy(retry_connection_errors=False)
+    assert not narrow.should_retry(FAILURE_CONNECT)
+    assert not narrow.should_failover(FAILURE_CONNECT)
+
+
+# -- pool unit tests (fake clients, no sockets) ------------------------------
+
+
+class _FakeClient:
+    """Scriptable client: ``script`` is a list whose entries are either
+    a value to return or an exception to raise, consumed per call;
+    after the script runs dry every call returns ``steady``."""
+
+    def __init__(self, url, script=(), steady="ok", ready=True):
+        self.url = url
+        self.script = list(script)
+        self.steady = steady
+        self.ready = ready
+        self.calls = []
+        self.closed = False
+
+    def _next(self, method):
+        self.calls.append(method)
+        action = self.script.pop(0) if self.script else self.steady
+        if isinstance(action, BaseException):
+            raise action
+        if callable(action):
+            return action()
+        return action
+
+    def infer(self, *args, **kwargs):
+        return self._next("infer")
+
+    def load_model(self, *args, **kwargs):
+        return self._next("load_model")
+
+    def is_server_ready(self, *args, **kwargs):
+        self.calls.append("is_server_ready")
+        return self.ready
+
+    def get_server_metadata(self, *args, **kwargs):
+        return self._next("get_server_metadata")
+
+    def start_stream(self, *args, **kwargs):
+        return self._next("start_stream")
+
+    def close(self):
+        self.closed = True
+
+
+def _fake_pool(scripts, **kwargs):
+    clients = {}
+
+    def factory(url):
+        clients[url] = _FakeClient(url, script=scripts.get(url, ()))
+        return clients[url]
+
+    pool = EndpointPool(
+        sorted(scripts), client_factory=factory, **kwargs)
+    return pool, clients
+
+
+def test_pool_validates_construction():
+    with pytest.raises(InferenceServerException, match="at least one"):
+        EndpointPool([])
+    with pytest.raises(InferenceServerException, match="unique"):
+        EndpointPool(["a:1", "a:1"], client_factory=_FakeClient)
+
+    # per-endpoint clients carrying their own retry_policy are rejected:
+    # nested retries inside failover multiply attempts at a sick replica
+    def nested_factory(url):
+        client = _FakeClient(url)
+        client._retry_policy = RetryPolicy()
+        return client
+
+    with pytest.raises(InferenceServerException, match="retry_policy"):
+        EndpointPool(["a:1"], client_factory=nested_factory)
+    with pytest.raises(NotImplementedError, match="ISSUE 3"):
+        EndpointPool(["a:1"], protocol="http_aio")
+
+
+def test_pool_failover_on_connect_and_overload():
+    pool, clients = _fake_pool({
+        "a:1": [ConnectionRefusedError("refused")],
+        "b:1": [],
+    }, retry_policy=RetryPolicy(max_attempts=4, initial_backoff_s=0.001))
+    assert pool.infer() == "ok"  # a failed at connect, b answered
+    assert clients["a:1"].calls == ["infer"]
+    assert clients["b:1"].calls == ["infer"]
+    # typed overload sheds fail over the same way
+    clients["a:1"].script = [
+        InferenceServerException("shed", status="429", retry_after="1")]
+    pool._rr = 0  # deterministic: next pick starts at a
+    pool._endpoints[0].healthy = True  # a is preferred again
+    assert pool.infer() == "ok"
+    stats = {e["url"]: e for e in pool.stats()["endpoints"]}
+    assert stats["a:1"]["failures"] == 2
+    pool.close()
+    assert clients["a:1"].closed and clients["b:1"].closed
+
+
+def test_pool_typed_errors_propagate_without_failover():
+    pool, clients = _fake_pool({
+        "a:1": [InferenceServerException("no such model", status="400")],
+        "b:1": [],
+    })
+    pool._rr = 0
+    with pytest.raises(InferenceServerException, match="no such model"):
+        pool.infer()
+    # the second endpoint was never tried: every replica would answer
+    # the same for a typed non-overload error
+    assert clients["b:1"].calls == []
+    pool.close()
+
+
+def test_pool_interrupted_fails_over_only_when_idempotent():
+    # infer (idempotent): a mid-call drop fails over
+    pool, clients = _fake_pool({
+        "a:1": [ConnectionResetError("mid-call")],
+        "b:1": [],
+    })
+    pool._rr = 0
+    assert pool.infer() == "ok"
+    pool.close()
+    # a non-idempotent call through the failover core: the same drop
+    # propagates instead of re-executing elsewhere
+    pool, clients = _fake_pool({
+        "a:1": [ConnectionResetError("mid-call")],
+        "b:1": [],
+    })
+    pool._rr = 0
+    with pytest.raises(ConnectionResetError):
+        pool._invoke("infer", (), {}, idempotent=False)
+    assert clients["b:1"].calls == []
+    pool.close()
+
+
+def test_pool_broadcasts_per_server_mutations_to_every_endpoint():
+    """Registration-style side effects must land on EVERY replica —
+    routing them to one arbitrary endpoint would make the next
+    round-robined request miss the region/model it needs."""
+    pool, clients = _fake_pool({"a:1": [], "b:1": []})
+    assert pool.load_model("m") == "ok"
+    assert clients["a:1"].calls == ["load_model"]
+    assert clients["b:1"].calls == ["load_model"]
+    # one replica failing the mutation surfaces the error — after every
+    # endpoint was attempted (no silent partial application)
+    clients["a:1"].script = [
+        InferenceServerException("draining", status="503")]
+    with pytest.raises(InferenceServerException, match="draining"):
+        pool.load_model("m")
+    assert clients["b:1"].calls == ["load_model", "load_model"]
+    pool.close()
+
+
+def test_start_stream_failure_releases_the_half_open_probe_slot():
+    """A failed stream open must record SOME breaker outcome: _pick()
+    may have consumed the half-open probe slot, and an unrecorded
+    failure would leave it held forever, blacklisting the endpoint."""
+    pool, clients = _fake_pool(
+        {"a:1": []}, breaker_threshold=1, breaker_cooldown_s=0.01)
+    ep = pool._endpoints[0]
+    ep.breaker.record_failure()  # open
+    time.sleep(0.03)  # cooldown elapses: half-open next
+    # the half-open probe is a stream open that fails with a typed 400
+    clients["a:1"].script = [
+        InferenceServerException("no such model", status="400")]
+    with pytest.raises(InferenceServerException, match="no such model"):
+        pool.start_stream()
+    # a typed answer means the endpoint is alive: breaker closed, and
+    # the probe slot was released — the endpoint still takes traffic
+    assert ep.breaker.state == BREAKER_CLOSED
+    assert pool.infer() == "ok"
+    pool.close()
+
+
+def test_pool_fails_fast_when_every_breaker_is_open():
+    pool, clients = _fake_pool(
+        {"a:1": [], "b:1": []}, breaker_threshold=1)
+    for ep in pool._endpoints:
+        ep.breaker.record_failure()
+        assert ep.breaker.state == BREAKER_OPEN
+    t0 = time.monotonic()
+    with pytest.raises(InferenceServerException) as exc:
+        pool.infer()
+    # fail fast: no sleeping out cooldowns on the caller's thread
+    assert time.monotonic() - t0 < 1.0
+    assert exc.value.status() == "503"
+    assert "circuit breaker" in str(exc.value)
+    assert clients["a:1"].calls == [] and clients["b:1"].calls == []
+    pool.close()
+
+
+def test_pool_deadline_budget_bounds_the_whole_call():
+    pool, _ = _fake_pool(
+        {"a:1": 50 * [ConnectionRefusedError()],
+         "b:1": 50 * [ConnectionRefusedError()]},
+        retry_policy=RetryPolicy(
+            max_attempts=100, initial_backoff_s=0.05, max_backoff_s=0.05),
+        deadline_s=0.4,
+    )
+    t0 = time.monotonic()
+    with pytest.raises(ConnectionRefusedError):
+        pool.infer()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0  # the 100-attempt schedule was cut by deadline_s
+    pool.close()
+
+
+def test_pool_never_hedges_non_idempotent_calls():
+    pool, clients = _fake_pool(
+        {"a:1": [], "b:1": []}, hedge_delay_s=0.0)
+    slow = lambda: time.sleep(0.15) or "ok"  # noqa: E731
+    clients["a:1"].script = [slow]
+    clients["b:1"].script = [slow]
+    pool._rr = 0
+    assert pool.load_model("m") == "ok"
+    # well past hedge_delay_s, yet no hedge raced the slow mutation —
+    # it was broadcast (once per endpoint), never duplicated
+    assert pool.stats()["hedges_fired"] == 0
+    assert clients["a:1"].calls == ["load_model"]
+    assert clients["b:1"].calls == ["load_model"]
+    pool.close()
+
+
+# -- real two-replica chaos (in-process servers) -----------------------------
+
+
+def _make_inputs(data):
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(data)
+    inputs[1].set_data_from_numpy(data)
+    return inputs
+
+
+@pytest.fixture()
+def two_replicas():
+    cores = [
+        InferenceServer([SimpleModel()], fault_scope=scope)
+        for scope in ("replica-a", "replica-b")
+    ]
+    frontends = [HttpFrontend(core, port=0).start() for core in cores]
+    urls = ["127.0.0.1:{}".format(f.port) for f in frontends]
+    yield cores, urls
+    for f in frontends:
+        f.stop()
+
+
+@pytest.mark.chaos
+def test_drain_mid_traffic_zero_user_visible_errors(two_replicas):
+    """The acceptance bar: one replica drains mid-traffic and every
+    idempotent request still completes via failover; the drained
+    endpoint's breaker re-closes only after the server returns to
+    ready."""
+    cores, urls = two_replicas
+    pool = httpclient.EndpointPool(
+        urls,
+        retry_policy=RetryPolicy(max_attempts=6, initial_backoff_s=0.01),
+        breaker_threshold=2,
+        breaker_cooldown_s=0.15,
+        health_interval_s=0.05,
+    )
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    errors = []
+
+    def worker():
+        inputs = _make_inputs(data)
+        for _ in range(30):
+            try:
+                result = pool.infer("simple", inputs)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), data + data)
+            except Exception as e:  # noqa: BLE001 — the invariant under test
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # traffic in flight on both replicas
+    cores[1].begin_drain()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+
+    drained_url = urls[1]
+    # the prober rotates the draining replica out and trips its breaker
+    deadline = time.monotonic() + 5.0
+    while (
+        pool.endpoint_states()[drained_url] == BREAKER_CLOSED
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    assert pool.endpoint_states()[drained_url] in (
+        BREAKER_OPEN, BREAKER_HALF_OPEN)
+    # while the server stays draining, half-open probes keep failing:
+    # the breaker must never re-close (cooldown is 0.15 s — this window
+    # spans several probe cycles)
+    for _ in range(10):
+        assert pool.endpoint_states()[drained_url] != BREAKER_CLOSED
+        time.sleep(0.05)
+    # traffic keeps succeeding through the healthy replica meanwhile
+    result = pool.infer("simple", _make_inputs(data))
+    np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), data + data)
+
+    # the replica returns to ready (ops undrain): the next successful
+    # probe re-closes the breaker — and only now
+    cores[1].mark_ready()
+    assert cores[1].server_ready()
+    deadline = time.monotonic() + 5.0
+    while (
+        pool.endpoint_states()[drained_url] != BREAKER_CLOSED
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.02)
+    assert pool.endpoint_states()[drained_url] == BREAKER_CLOSED
+    # and it takes real traffic again
+    before = [e for e in pool.stats()["endpoints"]
+              if e["url"] == drained_url][0]["requests"]
+    for _ in range(4):
+        pool.infer("simple", _make_inputs(data))
+    after = [e for e in pool.stats()["endpoints"]
+             if e["url"] == drained_url][0]["requests"]
+    assert after > before
+    pool.close()
+
+
+@pytest.mark.chaos
+def test_grpc_pool_drain_failover():
+    import tritonclient.grpc as grpcclient
+    from tpuserver.grpc_frontend import GrpcFrontend
+
+    cores = [InferenceServer([SimpleModel()]) for _ in range(2)]
+    frontends = [GrpcFrontend(core, port=0).start() for core in cores]
+    pool = grpcclient.EndpointPool(
+        ["127.0.0.1:{}".format(f.port) for f in frontends],
+        protocol="grpc",
+        retry_policy=RetryPolicy(max_attempts=6, initial_backoff_s=0.01),
+    )
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        inputs = [
+            grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+            grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+        cores[0].begin_drain()  # UNAVAILABLE sheds route to the sibling
+        for _ in range(6):
+            result = pool.infer("simple", inputs)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), data + data)
+        stats = {e["url"]: e for e in pool.stats()["endpoints"]}
+        healthy_url = "127.0.0.1:{}".format(frontends[1].port)
+        assert stats[healthy_url]["requests"] >= 6
+    finally:
+        pool.close()
+        for f in frontends:
+            f.stop()
+
+
+@pytest.mark.chaos
+def test_hedged_request_wins_and_loser_is_not_leaked():
+    """Hedge semantics: a slow primary is raced after hedge_delay_s, the
+    fast secondary wins, and the loser is cancelled/discarded — the
+    servers' in-flight slot registries (PR 2) drain back to zero, so
+    nothing leaked server-side either."""
+
+    class SlowSimple(SimpleModel):
+        def execute(self, inputs, request):
+            time.sleep(0.4)
+            return super().execute(inputs, request)
+
+    slow_core = InferenceServer([SlowSimple()])
+    fast_core = InferenceServer([SimpleModel()])
+    frontends = [
+        HttpFrontend(core, port=0).start()
+        for core in (slow_core, fast_core)
+    ]
+    pool = httpclient.EndpointPool(
+        ["127.0.0.1:{}".format(f.port) for f in frontends],
+        hedge_delay_s=0.05,
+    )
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        t0 = time.monotonic()
+        for _ in range(3):
+            result = pool.infer("simple", _make_inputs(data))
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), data + data)
+        elapsed = time.monotonic() - t0
+        stats = pool.stats()
+        assert stats["hedges_fired"] >= 1
+        assert stats["hedges_won"] >= 1
+        # the hedge actually cut latency: 3 un-hedged slow calls would
+        # take >= 1.2 s even before round-robin lands some on the fast
+        # replica
+        assert elapsed < 1.2
+    finally:
+        # close() joins the hedge executor: losers have fully resolved
+        pool.close()
+        deadline = time.monotonic() + 10.0
+        while (
+            (slow_core.inflight_count() or fast_core.inflight_count())
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert slow_core.inflight_count() == 0
+        assert fast_core.inflight_count() == 0
+        for f in frontends:
+            f.stop()
+
+
+def test_pool_async_infer_roundtrip(two_replicas):
+    _, urls = two_replicas
+    pool = httpclient.EndpointPool(urls)
+    try:
+        data = np.arange(16, dtype=np.int32).reshape(1, 16)
+        handles = [
+            pool.async_infer("simple", _make_inputs(data)) for _ in range(4)
+        ]
+        for handle in handles:
+            result = handle.get_result(timeout=30)
+            np.testing.assert_array_equal(
+                result.as_numpy("OUTPUT0"), data + data)
+    finally:
+        pool.close()
+
+
+# -- per-replica fault scoping (tpuserver.faults) ----------------------------
+
+
+def test_scoped_fault_hits_only_its_replica():
+    core_a = InferenceServer([], fault_scope="replica-a")
+    core_b = InferenceServer([], fault_scope="replica-b")
+    faults.install("core.shm_read", times=-1, scope="replica-b")
+    try:
+        # replica a sails past the armed point (scope mismatch) and
+        # fails on the unknown region instead
+        with pytest.raises(ServerError, match="Unable to find"):
+            core_a.read_shm_input("nope", 4, 0, "FP32", [1])
+        with pytest.raises(faults.FaultInjected):
+            core_b.read_shm_input("nope", 4, 0, "FP32", [1])
+        assert faults.fired("core.shm_read", "replica-b") == 1
+        assert faults.active("core.shm_read", "replica-b")
+        assert not faults.active("core.shm_read", "replica-a")
+    finally:
+        faults.clear("core.shm_read")
+    # a scope-less arming still matches every replica
+    with faults.injected("core.shm_read"):
+        with pytest.raises(faults.FaultInjected):
+            core_a.read_shm_input("nope", 4, 0, "FP32", [1])
+
+
+def test_scoped_fault_env_parsing():
+    faults.load_env({
+        "TPUSERVER_FAULTS": "test.scoped@replica-b:raise:2"
+    })
+    try:
+        assert faults.active("test.scoped", "replica-b")
+        assert not faults.active("test.scoped")
+        faults.fire("test.scoped")  # wrong (no) scope: no-op
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("test.scoped", "replica-b")
+    finally:
+        faults.clear("test.scoped")
+
+
+# -- undrain (the breaker-reclose precondition) ------------------------------
+
+
+def test_mark_ready_cancels_drain():
+    core = InferenceServer([SimpleModel()])
+    core.begin_drain()
+    assert core.server_state() == "draining"
+    assert not core.server_ready()
+    core.mark_ready()
+    assert core.server_state() == "ready"
+    assert core.server_ready()
+    # stopped is terminal for mark_ready (workers are gone)
+    core.close()
+    core.mark_ready()
+    assert core.server_state() == "stopped"
+
+
+def test_undrain_aborts_inflight_drain_instead_of_closing():
+    """mark_ready() racing a drain() must abort it: once the server is
+    admitting again, drain's close() would hard-kill the just-admitted
+    requests."""
+    from tpuserver.models.simple import DelayedIdentityModel
+
+    core = InferenceServer([DelayedIdentityModel(), SimpleModel()])
+    results = {}
+
+    def slow_infer():
+        from tpuserver.core import InferRequest
+
+        req = InferRequest(
+            "delayed_identity",
+            inputs={
+                "INPUT0": np.array([7], dtype=np.int32),
+                "DELAY_US": np.array([400_000], dtype=np.uint32),
+            },
+        )
+        results["resp"] = core.infer(req)
+
+    t = threading.Thread(target=slow_infer)
+    t.start()
+    while core.inflight_count() == 0 and t.is_alive():
+        time.sleep(0.005)
+    drainer = threading.Thread(target=core.drain, kwargs={"timeout": 30.0})
+    drainer.start()
+    while core.server_state() != "draining":
+        time.sleep(0.005)
+    core.mark_ready()  # undrain while drain() waits on the in-flight
+    drainer.join(timeout=10)
+    t.join(timeout=10)
+    assert not drainer.is_alive()
+    # the drain aborted: server still serving, the in-flight finished
+    assert core.server_state() == "ready"
+    assert results["resp"].outputs
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+    from tpuserver.core import InferRequest
+
+    resp = core.infer(InferRequest(
+        "simple", inputs={"INPUT0": data, "INPUT1": data}))
+    assert resp.outputs
+
+
+# -- aio clients reject the sync-only resilience kwargs ----------------------
+
+
+def test_http_aio_rejects_retry_policy():
+    aio_http = pytest.importorskip("tritonclient.http.aio")
+    with pytest.raises(NotImplementedError, match="ISSUE 3"):
+        aio_http.InferenceServerClient(
+            "localhost:8000", retry_policy=RetryPolicy())
+
+
+def test_grpc_aio_rejects_retry_policy():
+    aio_grpc = pytest.importorskip("tritonclient.grpc.aio")
+    with pytest.raises(NotImplementedError, match="ISSUE 3"):
+        aio_grpc.InferenceServerClient(
+            "localhost:8001", retry_policy=RetryPolicy())

@@ -126,6 +126,113 @@ def test_retry_policy_jitter_bounds():
         assert 0.5 <= b <= 1.0
 
 
+def test_retry_after_accepts_only_nonnegative_integers():
+    from tritonclient._auxiliary import RetryPolicy
+
+    policy = RetryPolicy(initial_backoff_s=0.1, jitter=0.0)
+    assert RetryPolicy.parse_retry_after("2") == 2.0
+    assert RetryPolicy.parse_retry_after(3) == 3.0
+    assert RetryPolicy.parse_retry_after(" 4 ") == 4.0
+    # negatives, fractions, HTTP-dates, garbage: fall back to schedule
+    for bad in ("-1", "1.5", "Wed, 21 Oct 2026 07:28:00 GMT", "", None):
+        assert RetryPolicy.parse_retry_after(bad) is None
+        assert policy.backoff_s(0, retry_after=bad) == pytest.approx(0.1)
+
+
+def test_retry_after_capped_at_remaining_deadline_budget():
+    """A large server hint must never park the client past its own
+    deadline: the honored sleep is min(hint+jitter, remaining)."""
+    from tritonclient._auxiliary import RetryPolicy
+
+    policy = RetryPolicy(jitter=0.25)
+    # server says 100 s, caller has 0.5 s left: sleep 0.5 s, not 100
+    assert policy.backoff_s(0, retry_after="100", remaining_s=0.5) == 0.5
+    # the schedule path is capped the same way
+    assert policy.backoff_s(9, remaining_s=0.01) <= 0.01
+    # an exhausted budget sleeps zero (the caller then gives up)
+    assert policy.backoff_s(0, retry_after="5", remaining_s=-1.0) == 0.0
+    # with room to spare, the hint passes through (with jitter on top)
+    jitter_free = RetryPolicy(jitter=0.0)
+    assert jitter_free.backoff_s(
+        0, retry_after="2", remaining_s=60.0) == pytest.approx(2.0)
+
+
+# -- shared-memory request-time bounds ---------------------------------------
+
+
+def test_shm_reference_bounds_checked_at_request_time():
+    """A shm input reference past the registered region size is a typed
+    400 at the request boundary, not an opaque mmap/buffer error deep
+    inside core's shm read (satellite of ISSUE 3)."""
+    from tritonclient.utils import shared_memory as shm
+
+    handle = shm.create_shared_memory_region(
+        "bounds", "/resilience_bounds", 128
+    )
+    core = InferenceServer([SimpleModel()])
+    try:
+        core.register_system_shm("bounds", "/resilience_bounds", 0, 128)
+        # in-bounds read works
+        data = np.arange(16, dtype=np.int32)
+        shm.set_shared_memory_region(handle, [data])
+        out = core.read_shm_input("bounds", 64, 0, "INT32", [16])
+        np.testing.assert_array_equal(out, data)
+        # out-of-bounds byte_size / offset / negative / non-integer: 400
+        for byte_size, offset in ((256, 0), (128, 64), (64, 128)):
+            with pytest.raises(ServerError, match="out of bounds") as exc:
+                core.read_shm_input(
+                    "bounds", byte_size, offset, "INT32", [16])
+            assert exc.value.code == 400
+        with pytest.raises(ServerError, match="non-negative") as exc:
+            core.read_shm_input("bounds", -4, 0, "INT32", [16])
+        assert exc.value.code == 400
+        with pytest.raises(ServerError, match="integer") as exc:
+            core.read_shm_input("bounds", "lots", 0, "INT32", [16])
+        assert exc.value.code == 400
+        # the output path is bounds-checked too
+        big = np.zeros(64, dtype=np.int32)  # 256 bytes > 128
+        with pytest.raises(ServerError, match="out of bounds"):
+            core.write_shm_output("bounds", 0, big, "INT32")
+    finally:
+        core.unregister_system_shm()
+        shm.destroy_shared_memory_region(handle)
+
+
+def test_shm_bounds_violation_maps_to_http_400():
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException
+    from tritonclient.utils import shared_memory as shm
+
+    from tpuserver.http_frontend import HttpFrontend
+
+    handle = shm.create_shared_memory_region(
+        "http_bounds", "/resilience_http_bounds", 128
+    )
+    core = InferenceServer([SimpleModel()])
+    frontend = HttpFrontend(core, port=0).start()
+    client = httpclient.InferenceServerClient(
+        "127.0.0.1:{}".format(frontend.port))
+    try:
+        client.register_system_shared_memory(
+            "http_bounds", "/resilience_http_bounds", 128)
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        # INPUT1's reference runs 64 bytes past the 128-byte region
+        inputs[0].set_shared_memory("http_bounds", 64)
+        inputs[1].set_shared_memory("http_bounds", 128, offset=64)
+        with pytest.raises(InferenceServerException) as exc:
+            client.infer("simple", inputs)
+        assert exc.value.status() == "400"
+        assert "out of bounds" in str(exc.value)
+    finally:
+        client.unregister_system_shared_memory()
+        client.close()
+        frontend.stop()
+        shm.destroy_shared_memory_region(handle)
+
+
 # -- core state machine / overload / deadline -------------------------------
 
 

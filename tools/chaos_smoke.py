@@ -18,6 +18,15 @@ the invariants PR 2 promises:
 
 Usage:
     python tools/chaos_smoke.py [--rounds N] [--slots K] [--budget T]
+    python tools/chaos_smoke.py --pool [--cycles N] [--soak M]
+
+``--pool`` soaks the multi-replica client layer instead: an
+EndpointPool over two in-process HTTP servers with one replica
+SIGTERM-drained (PR 2 ``install_sigterm_drain``) and revived on a
+cycle.  Invariants: no pool request may fail with a NON-TYPED error
+(raw socket errors must be classified/failed-over), the pool sees zero
+failures at all while a healthy sibling exists, and the drained
+replica's breaker/health recovers after each revival.
 
 CI wiring: run under JAX_PLATFORMS=cpu; exits 0 only if every invariant
 held.
@@ -209,6 +218,119 @@ def overload_phase(core_model_cls):
     _ = core_model_cls
 
 
+def pool_phase(cycles, soak):
+    """Multi-replica soak: pool traffic rides out SIGTERM drains of one
+    replica; exits nonzero on any non-typed failure (raw socket errors
+    leaking through classification) or any failed request at all while
+    the healthy sibling is up."""
+    import signal
+
+    import numpy as np
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException
+
+    from tpuserver.core import InferenceServer, install_sigterm_drain
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models.simple import SimpleModel
+
+    cores = [
+        InferenceServer([SimpleModel()], fault_scope=scope)
+        for scope in ("pool-a", "pool-b")
+    ]
+    frontends = [HttpFrontend(core, port=0).start() for core in cores]
+    urls = ["127.0.0.1:{}".format(f.port) for f in frontends]
+    previous = install_sigterm_drain(cores[1], drain_timeout=5.0)
+    pool = httpclient.EndpointPool(
+        urls,
+        retry_policy=httpclient.RetryPolicy(
+            max_attempts=6, initial_backoff_s=0.02, max_backoff_s=0.2),
+        breaker_threshold=2,
+        breaker_cooldown_s=0.1,
+        health_interval_s=0.05,
+    )
+    data = np.arange(16, dtype=np.int32).reshape(1, 16)
+
+    def make_inputs():
+        inputs = [
+            httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+            httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+        ]
+        inputs[0].set_data_from_numpy(data)
+        inputs[1].set_data_from_numpy(data)
+        return inputs
+
+    def replica_b():
+        return [e for e in pool.stats()["endpoints"]
+                if e["url"] == urls[1]][0]
+
+    try:
+        for cycle in range(cycles):
+            outcomes = {"ok": 0, "typed": 0, "untyped": 0}
+
+            def worker(n):
+                for i in range(n):
+                    try:
+                        result = pool.infer("simple", make_inputs())
+                        if not np.array_equal(
+                            result.as_numpy("OUTPUT0"), data + data
+                        ):
+                            fail("pool cycle: wrong result")
+                        outcomes["ok"] += 1
+                    except InferenceServerException as e:
+                        outcomes["typed"] += 1
+                        fail("pool cycle {}: typed failure leaked "
+                             "through failover: {}".format(cycle, e))
+                    except Exception as e:  # noqa: BLE001 — the invariant
+                        outcomes["untyped"] += 1
+                        fail("pool cycle {}: NON-TYPED failure {}: "
+                             "{}".format(cycle, type(e).__name__, e))
+
+            threads = [
+                threading.Thread(target=worker, args=(soak,))
+                for _ in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # traffic in flight on both replicas
+            # SIGTERM-drain replica b mid-traffic (PR 2 handler): the
+            # drain runs on a worker thread; in-flight work finishes,
+            # new work sheds typed 503s that the pool routes around
+            os.kill(os.getpid(), signal.SIGTERM)
+            for t in threads:
+                t.join(timeout=120)
+            deadline = time.monotonic() + 10.0
+            while (
+                cores[1].server_state() != "stopped"
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            if cores[1].server_state() != "stopped":
+                fail("pool cycle {}: SIGTERM drain never completed "
+                     "(state={})".format(cycle, cores[1].server_state()))
+            # revive: re-attach flips stopped -> ready (the balanced
+            # detach keeps the frontend refcount at one)
+            cores[1].attach_frontend()
+            cores[1].detach_frontend()
+            deadline = time.monotonic() + 10.0
+            while (
+                not (replica_b()["healthy"]
+                     and replica_b()["breaker"] == "closed")
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            b = replica_b()
+            if not b["healthy"] or b["breaker"] != "closed":
+                fail("pool cycle {}: drained replica never recovered: "
+                     "{}".format(cycle, b))
+            print("pool cycle {:2d} outcomes={} replica_b={}".format(
+                cycle, outcomes, replica_b()))
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        pool.close()
+        for f in frontends:
+            f.stop()
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -217,7 +339,29 @@ def main():
                         help="scheduler slots (default 2)")
     parser.add_argument("--budget", type=int, default=6,
                         help="tokens per generation (default 6)")
+    parser.add_argument("--pool", action="store_true",
+                        help="soak the multi-replica pool layer instead "
+                             "(SIGTERM-drain one of two replicas on a "
+                             "cycle)")
+    parser.add_argument("--cycles", type=int, default=4,
+                        help="pool mode: drain/revive cycles (default 4)")
+    parser.add_argument("--soak", type=int, default=40,
+                        help="pool mode: requests per worker per cycle "
+                             "(default 40)")
     args = parser.parse_args()
+
+    if args.pool:
+        t0 = time.monotonic()
+        pool_phase(args.cycles, args.soak)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\npool chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\npool chaos smoke OK: {} SIGTERM-drain cycles, {:.1f}s, "
+              "all invariants held".format(args.cycles, elapsed))
+        return 0
 
     model = LlamaGenerateModel(
         cfg=llama.tiny(vocab=512), max_seq=64, max_slots=args.slots)
