@@ -103,8 +103,19 @@ def _emit(config, metric, value, unit, baseline_key=None, **extra):
 # ---------------------------------------------------------------------------
 
 def bench_simple_http(http_url, window_s, windows):
+    """Config 1 on the perfanalyzer profiler (the ad-hoc `_measure`
+    loop this config used pre-PR-4 duplicated the percentile/window
+    math that now lives in `perfanalyzer.metrics`): windowed
+    measurement to 3-window stability, client percentiles, and the
+    server queue/compute breakdown — same one-JSON-line schema."""
     import tritonclient.http as httpclient
 
+    from perfanalyzer.client_backend import HttpBackend, build_input_pool
+    from perfanalyzer.load_manager import ConcurrencyManager
+    from perfanalyzer.profiler import InferenceProfiler
+
+    # correctness smoke before any timing: the profiled path must be
+    # computing real answers
     client = httpclient.InferenceServerClient(http_url)
     a = np.arange(16, dtype=np.int32).reshape(1, 16)
     b = np.full((1, 16), 2, dtype=np.int32)
@@ -112,34 +123,43 @@ def bench_simple_http(http_url, window_s, windows):
     in1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
     in0.set_data_from_numpy(a, binary_data=True)
     in1.set_data_from_numpy(b, binary_data=True)
-    outputs = [
-        httpclient.InferRequestedOutput("OUTPUT0", binary_data=True),
-        httpclient.InferRequestedOutput("OUTPUT1", binary_data=True),
-    ]
-    result = client.infer("simple", [in0, in1], outputs=outputs)
+    result = client.infer("simple", [in0, in1])
     assert (result.as_numpy("OUTPUT0") == a + b).all()
-    # rule 1: a rotating pool of distinct input pairs (the response
-    # carries result values in-band, so every call is self-fencing)
-    pool = []
-    for s in range(16):
-        pa = np.random.RandomState(s).randint(
-            0, 1000, (1, 16)).astype(np.int32)
-        pb = pa + s
-        j0 = httpclient.InferInput("INPUT0", [1, 16], "INT32")
-        j1 = httpclient.InferInput("INPUT1", [1, 16], "INT32")
-        j0.set_data_from_numpy(pa, binary_data=True)
-        j1.set_data_from_numpy(pb, binary_data=True)
-        pool.append((j0, j1))
-
-    def call(i):
-        p0, p1 = pool[i % len(pool)]
-        client.infer("simple", [p0, p1], outputs=outputs)
-
-    rate, p50 = _measure(call, window_s, windows)
     client.close()
-    return _emit(1, "simple_http_sync_conc1", rate, "infer/sec",
-                 "simple_http", p50_usec=round(p50, 1),
-                 p50_vs_baseline=round(p50 / BASELINES["simple_http_p50"], 4))
+
+    backend = HttpBackend(http_url)
+    manager = None
+    try:
+        # rule 1 lives in build_input_pool: 16 distinct input sets
+        # rotated across dispatches
+        pool = build_input_pool(
+            backend.model_metadata("simple"),
+            backend.model_config("simple"),
+            pool_size=16, batch_size=1)
+        manager = ConcurrencyManager(
+            backend, "simple", backend.prepare("simple", pool))
+        profiler = InferenceProfiler(
+            backend, "simple", manager,
+            measurement_interval_s=window_s,
+            stability_windows=min(3, windows),
+            max_trials=max(2 * windows, 3),
+            warmup_s=0.3)
+        res = profiler.profile_level(1)
+    finally:
+        if manager is not None:
+            manager.stop()
+        backend.close()
+    return _emit(1, "simple_http_sync_conc1", res["throughput"],
+                 "infer/sec", "simple_http",
+                 p50_usec=round(res["p50_usec"], 1),
+                 p50_vs_baseline=round(
+                     res["p50_usec"] / BASELINES["simple_http_p50"], 4),
+                 p90_usec=round(res["p90_usec"], 1),
+                 p99_usec=round(res["p99_usec"], 1),
+                 stable=res["stable"],
+                 server_queue_usec=round(res["queue_usec"], 2),
+                 server_compute_usec=round(res["compute_infer_usec"], 2),
+                 client_overhead_pct=round(res["client_overhead_pct"], 1))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,57 @@
+"""perfanalyzer: load-generation & profiling harness for the serving stack.
+
+Python port of the reference `perf_analyzer` (SURVEY.md §2.2, §3.4)
+shaped for this repo: pluggable client backends (triton-HTTP,
+triton-gRPC, in-process core, multi-replica pool), concurrency and
+request-rate load managers, a measurement-window profiler with
+3-consecutive-window stability detection and client/server stat
+merging, a generation mode reporting token-level metrics (TTFT, ITL,
+tokens/sec), and a report writer (stdout table / CSV / JSON rows).
+
+Entry points:
+
+- :func:`perfanalyzer.client_backend.create_backend` — backend factory
+- :class:`perfanalyzer.load_manager.ConcurrencyManager` /
+  :class:`~perfanalyzer.load_manager.RequestRateManager` — load managers
+- :class:`perfanalyzer.profiler.InferenceProfiler` — windowed profiler
+- :class:`perfanalyzer.generation.GenerationProfiler` — token metrics
+- :class:`perfanalyzer.report.ReportWriter` — table / CSV / JSON output
+- ``tools/perf_analyzer.py`` — the CLI that wires them together
+"""
+
+from perfanalyzer.client_backend import ClientBackend, create_backend
+from perfanalyzer.generation import GenerationProfiler
+from perfanalyzer.load_manager import (
+    ConcurrencyManager,
+    LoadCollector,
+    RequestRateManager,
+)
+from perfanalyzer.metrics import (
+    latency_summary,
+    merge_window_records,
+    percentile,
+    server_stats_delta,
+    server_stats_snapshot,
+)
+from perfanalyzer.profiler import InferenceProfiler
+from perfanalyzer.report import ReportWriter
+from perfanalyzer.schedule import schedule_distribution
+from perfanalyzer.stability import StabilityDetector
+
+__all__ = [
+    "ClientBackend",
+    "ConcurrencyManager",
+    "GenerationProfiler",
+    "InferenceProfiler",
+    "LoadCollector",
+    "RequestRateManager",
+    "ReportWriter",
+    "StabilityDetector",
+    "create_backend",
+    "latency_summary",
+    "merge_window_records",
+    "percentile",
+    "schedule_distribution",
+    "server_stats_delta",
+    "server_stats_snapshot",
+]

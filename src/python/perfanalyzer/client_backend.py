@@ -1,0 +1,679 @@
+"""Backend-neutral client abstraction for the load managers.
+
+Role of the reference's ``client_backend/`` layer
+(client_backend.h:250-620): the load managers and profiler speak one
+small interface; four concrete backends map it onto the stack's real
+entry points:
+
+- ``http``      — ``tritonclient.http`` against a live HTTP frontend
+- ``grpc``      — ``tritonclient.grpc`` against a live gRPC frontend
+- ``inprocess`` — wraps ``tpuserver.core.InferenceServer`` directly
+                  (the analogue of the reference's Triton C-API
+                  backend: no sockets, so the client/transport overhead
+                  is isolated from the model cost)
+- ``pool``      — drives ``tritonclient.EndpointPool`` over N replica
+                  URLs, so failover/hedging behavior can be load-tested
+
+A backend hands out *prepared* requests (inputs pre-serialized once,
+outside the timed path), executes them synchronously (``infer``) or
+asynchronously (``submit`` + completion callback — what the
+concurrency manager's context free-list rides on), snapshots server
+statistics, and — where the transport supports decoupled models —
+streams generations token-by-token for the generation profiler.
+"""
+
+import json
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+class BackendError(Exception):
+    """A request failed inside a backend (wraps the transport error)."""
+
+
+def _coerce_int(value, default=0):
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+class ClientBackend:
+    """The interface the load managers and profiler consume.
+
+    ``capacity`` is the backend's true in-flight ceiling (executor
+    threads / pooled connections), or None when the transport
+    multiplexes without a fixed bound (gRPC async).  Size it via
+    ``max_inflight`` at construction: a load level above the capacity
+    would silently measure the backend's own queueing, not the server.
+    """
+
+    kind = "?"
+    supports_generation = False
+
+    def __init__(self, max_inflight=None):
+        self._executor = None
+        self._executor_lock = threading.Lock()
+        # an explicit bound is honored EXACTLY (a user capping
+        # outstanding requests means it); only the unspecified case
+        # gets the roomy default
+        self._executor_workers = (max(1, int(max_inflight))
+                                  if max_inflight else 64)
+        self.capacity = self._executor_workers
+
+    # -- metadata / statistics --------------------------------------------
+
+    def model_metadata(self, model):
+        raise NotImplementedError
+
+    def model_config(self, model):
+        raise NotImplementedError
+
+    def server_statistics(self, model):
+        """Cumulative stats dict ``{"model_stats": [...]}`` (KServe
+        statistics extension shape, both clients' native form)."""
+        raise NotImplementedError
+
+    def stats_snapshot(self, model):
+        """Flat cumulative-counter snapshot for the profiler's window
+        diffs (see :func:`perfanalyzer.metrics.server_stats_snapshot`).
+        Multi-replica backends override to attach per-replica data so
+        deltas can be paired replica-by-replica."""
+        from perfanalyzer.metrics import server_stats_snapshot
+
+        return server_stats_snapshot(self.server_statistics(model), model)
+
+    # -- inference --------------------------------------------------------
+
+    def prepare(self, model, input_sets):
+        """Pre-serialize ``input_sets`` (list of name->np.ndarray dicts)
+        into backend-native request handles.  Runs once per load level,
+        OUTSIDE any measurement window — the timed path then only
+        dispatches."""
+        return [self._prepare_one(model, s) for s in input_sets]
+
+    def _prepare_one(self, model, inputs):
+        raise NotImplementedError
+
+    def infer(self, prepared):
+        """Synchronous inference of one prepared request; raises
+        :class:`BackendError` on failure."""
+        raise NotImplementedError
+
+    def submit(self, prepared, on_done):
+        """Non-blocking dispatch; ``on_done(error_or_None)`` fires on a
+        completion thread.  Default implementation runs :meth:`infer`
+        on a shared executor; backends with native async (gRPC)
+        override."""
+        executor = self._ensure_executor()
+
+        def run():
+            try:
+                self.infer(prepared)
+            except Exception as e:  # noqa: BLE001 — handed to on_done
+                on_done(e)
+                return
+            on_done(None)
+
+        executor.submit(run)
+
+    def _ensure_executor(self):
+        if self._executor is None:
+            with self._executor_lock:
+                if self._executor is None:
+                    self._executor = ThreadPoolExecutor(
+                        max_workers=self._executor_workers,
+                        thread_name_prefix="perfanalyzer-" + self.kind,
+                    )
+        return self._executor
+
+    # -- generation (decoupled streaming) ---------------------------------
+
+    def generate_stream(self, model, inputs, parameters=None):
+        """Generator yielding the token count of each streamed response
+        as it arrives (1 for the llama TOKEN-per-response contract).
+        The generation profiler timestamps each yield: first yield =
+        TTFT, gaps = inter-token latencies."""
+        raise NotImplementedError(
+            "backend '{}' does not support generation mode".format(
+                self.kind))
+
+    def release_thread_resources(self):
+        """Called by a generation worker as it exits; backends that
+        pin per-thread resources (the gRPC stream client) free them
+        here so swept levels don't accumulate channels."""
+
+    def close(self):
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
+
+def _np_wire_dtype(arr):
+    from tritonclient.utils import np_to_triton_dtype
+
+    if arr.dtype == np.object_:
+        return "BYTES"
+    return np_to_triton_dtype(arr.dtype)
+
+
+def _prepare_infer_inputs(mod, inputs, binary_data=None):
+    """Shared input serialization for the socket backends: one
+    ``InferInput`` per tensor, dtype mapped once (``binary_data`` is
+    the HTTP wire toggle; gRPC's set_data_from_numpy takes no such
+    argument)."""
+    prepared = []
+    for name, arr in inputs.items():
+        tin = mod.InferInput(name, list(arr.shape), _np_wire_dtype(arr))
+        if binary_data is None:
+            tin.set_data_from_numpy(arr)
+        else:
+            tin.set_data_from_numpy(arr, binary_data=binary_data)
+        prepared.append(tin)
+    return prepared
+
+
+def _response_token_count(outputs):
+    """Tokens carried by one decoupled response, from its output list
+    (dicts with name/shape).  Prefer a TOKEN/OUTPUT_IDS tensor's
+    element count; fall back to 1 response = 1 step."""
+    for entry in outputs or []:
+        if entry.get("name") in ("TOKEN", "OUTPUT_IDS", "output_ids"):
+            n = 1
+            for d in entry.get("shape", []) or []:
+                n *= max(1, _coerce_int(d, 1))
+            data = entry.get("data")
+            if isinstance(data, list) and data:
+                n = len(data)
+            return max(1, n)
+    return 1
+
+
+# -- in-process backend ----------------------------------------------------
+
+
+class InProcessBackend(ClientBackend):
+    """Drives ``tpuserver.core.InferenceServer`` with no transport at
+    all — the floor every other backend's overhead is measured against
+    (the reference's C-API backend role)."""
+
+    kind = "inprocess"
+    supports_generation = True
+
+    def __init__(self, core, max_inflight=None):
+        super().__init__(max_inflight)
+        self.core = core
+
+    def model_metadata(self, model):
+        return self.core.model_metadata(model)
+
+    def model_config(self, model):
+        return self.core.model_config(model)
+
+    def server_statistics(self, model):
+        return self.core.model_statistics(model)
+
+    def _prepare_one(self, model, inputs):
+        from tpuserver.core import InferRequest
+
+        return InferRequest(model, inputs=dict(inputs))
+
+    def infer(self, prepared):
+        from tpuserver.core import InferRequest, ServerError
+
+        try:
+            # a fresh request object per call: InferRequest carries
+            # per-call deadline state the core stamps on it
+            req = InferRequest(prepared.model_name,
+                               inputs=prepared.inputs)
+            self.core.infer(req)
+        except ServerError as e:
+            raise BackendError(str(e)) from e
+
+    def generate_stream(self, model, inputs, parameters=None):
+        from tpuserver.core import InferRequest, ServerError
+
+        req = InferRequest(model, inputs=dict(inputs),
+                           parameters=dict(parameters or {}))
+        try:
+            for resp in self.core.infer_stream(req):
+                yield _response_token_count(
+                    [spec for spec, _, _ in resp.outputs])
+        except ServerError as e:
+            raise BackendError(str(e)) from e
+
+
+# -- HTTP backend ----------------------------------------------------------
+
+
+class HttpBackend(ClientBackend):
+    """``tritonclient.http`` against a live frontend; generation rides
+    the ``/v2/models/{m}/generate_stream`` SSE endpoint."""
+
+    kind = "http"
+    supports_generation = True
+
+    def __init__(self, url, max_inflight=None):
+        super().__init__(max_inflight)
+        import tritonclient.http as httpclient
+
+        self._mod = httpclient
+        self.url = url
+        # the pooled-connection count must match the executor: fewer
+        # connections than workers and requests queue INSIDE the
+        # client, polluting the measured latency
+        self.client = httpclient.InferenceServerClient(
+            url, concurrency=self._executor_workers)
+
+    def model_metadata(self, model):
+        return self.client.get_model_metadata(model)
+
+    def model_config(self, model):
+        return self.client.get_model_config(model)
+
+    def server_statistics(self, model):
+        return self.client.get_inference_statistics(model)
+
+    def _prepare_one(self, model, inputs):
+        return (model, _prepare_infer_inputs(
+            self._mod, inputs, binary_data=True))
+
+    def infer(self, prepared):
+        from tritonclient.utils import InferenceServerException
+
+        model, infer_inputs = prepared
+        try:
+            self.client.infer(model, infer_inputs)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+    def generate_stream(self, model, inputs, parameters=None):
+        """POST /generate_stream and yield per SSE data event.
+
+        Uses a raw ``http.client`` connection (not the pooled client):
+        SSE events must be surfaced as they arrive, which the pooled
+        request path — built around complete responses — cannot do.
+        """
+        import http.client
+        from urllib.parse import urlparse
+
+        parsed = urlparse("http://" + self.url)
+        body = {
+            "inputs": [
+                {
+                    "name": name,
+                    "shape": list(arr.shape),
+                    "datatype": _np_wire_dtype(arr),
+                    "data": arr.reshape(-1).tolist(),
+                }
+                for name, arr in inputs.items()
+            ],
+        }
+        if parameters:
+            body["parameters"] = dict(parameters)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port, timeout=600)
+        try:
+            conn.request(
+                "POST",
+                "/v2/models/{}/generate_stream".format(model),
+                json.dumps(body),
+                {"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise BackendError(
+                    "generate_stream HTTP {}: {}".format(
+                        resp.status, resp.read()[:512]))
+            for line in resp:
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                event = json.loads(line[len(b"data: "):])
+                if "error" in event:
+                    raise BackendError(event["error"])
+                yield _response_token_count(event.get("outputs"))
+        finally:
+            conn.close()
+
+    def close(self):
+        super().close()
+        self.client.close()
+
+
+# -- gRPC backend ----------------------------------------------------------
+
+
+class GrpcBackend(ClientBackend):
+    """``tritonclient.grpc``; ``submit`` uses the client's native
+    completion-callback async path (no extra thread per in-flight
+    request), and generation rides a decoupled bidi stream."""
+
+    kind = "grpc"
+    supports_generation = True
+
+    def __init__(self, url, max_inflight=None):
+        super().__init__(max_inflight)
+        # native async callbacks: the channel multiplexes without a
+        # fixed in-flight ceiling
+        self.capacity = None
+        import tritonclient.grpc as grpcclient
+
+        self._mod = grpcclient
+        self.url = url
+        self.client = grpcclient.InferenceServerClient(url)
+        # generation streams are per-thread: one gRPC client owns at
+        # most one bidi stream, and generation workers run concurrently
+        self._stream_local = threading.local()
+        self._stream_clients = []
+        self._stream_clients_lock = threading.Lock()
+
+    def model_metadata(self, model):
+        return self.client.get_model_metadata(model, as_json=True)
+
+    def model_config(self, model):
+        cfg = self.client.get_model_config(model, as_json=True)
+        return cfg.get("config", cfg)
+
+    def server_statistics(self, model):
+        return self.client.get_inference_statistics(model, as_json=True)
+
+    def _prepare_one(self, model, inputs):
+        return (model, _prepare_infer_inputs(self._mod, inputs))
+
+    def infer(self, prepared):
+        from tritonclient.utils import InferenceServerException
+
+        model, infer_inputs = prepared
+        try:
+            self.client.infer(model, infer_inputs)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+    def submit(self, prepared, on_done):
+        model, infer_inputs = prepared
+
+        def callback(result, error):
+            on_done(error)
+
+        self.client.async_infer(model, infer_inputs, callback)
+
+    def _thread_client(self):
+        client = getattr(self._stream_local, "client", None)
+        if client is None:
+            client = self._mod.InferenceServerClient(self.url)
+            self._stream_local.client = client
+            with self._stream_clients_lock:
+                self._stream_clients.append(client)
+        return client
+
+    def release_thread_resources(self):
+        # a generation worker's thread-local channel dies with the
+        # worker: a 1:64 sweep would otherwise hold every past level's
+        # channels open until backend.close()
+        client = getattr(self._stream_local, "client", None)
+        if client is None:
+            return
+        self._stream_local.client = None
+        with self._stream_clients_lock:
+            try:
+                self._stream_clients.remove(client)
+            except ValueError:
+                pass
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+    def generate_stream(self, model, inputs, parameters=None):
+        from tritonclient.utils import InferenceServerException
+
+        client = self._thread_client()
+        prepared = self._prepare_one(model, inputs)[1]
+        responses = queue.Queue()
+        client.start_stream(
+            lambda result, error: responses.put((result, error)))
+        try:
+            client.async_stream_infer(
+                model, prepared, enable_empty_final_response=True,
+                parameters=dict(parameters) if parameters else None)
+            while True:
+                result, error = responses.get(timeout=600)
+                if error is not None:
+                    raise BackendError(str(error))
+                resp = result.get_response()
+                final = resp.parameters.get("triton_final_response")
+                if final is not None and final.bool_param:
+                    return
+                yield _response_token_count([
+                    {"name": out.name, "shape": list(out.shape)}
+                    for out in resp.outputs
+                ])
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+        finally:
+            try:
+                client.stop_stream(cancel_requests=True)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def close(self):
+        super().close()
+        with self._stream_clients_lock:
+            clients, self._stream_clients = self._stream_clients, []
+        for client in clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.client.close()
+
+
+# -- multi-replica pool backend --------------------------------------------
+
+
+class PoolBackend(ClientBackend):
+    """Drives ``tritonclient.EndpointPool`` over N replica URLs, so the
+    failover/hedging layer itself can be put under measured load.
+
+    Server statistics are summed across ALL replicas (each queried
+    directly): the pool spreads requests over the fleet, so a single
+    endpoint's counters would undercount the window.
+    """
+
+    kind = "pool"
+    supports_generation = False
+
+    def __init__(self, urls, max_inflight=None, **pool_kwargs):
+        super().__init__(max_inflight)
+        import tritonclient.http as httpclient
+
+        self._mod = httpclient
+        self.urls = list(urls)
+        self.pool = httpclient.EndpointPool(self.urls, **pool_kwargs)
+        # direct per-replica clients for statistics aggregation only
+        self._stat_clients = [
+            httpclient.InferenceServerClient(u) for u in self.urls
+        ]
+
+    def model_metadata(self, model):
+        return self.pool.get_model_metadata(model)
+
+    def model_config(self, model):
+        return self.pool.get_model_config(model)
+
+    def _per_replica_snapshots(self, model):
+        from perfanalyzer.metrics import server_stats_snapshot
+
+        snaps = {}
+        for url, client in zip(self.urls, self._stat_clients):
+            try:
+                snaps[url] = server_stats_snapshot(
+                    client.get_inference_statistics(model), model)
+            except Exception:  # noqa: BLE001 — a drained/dead replica
+                # must not abort the profile: load-testing failover IS
+                # this backend's purpose; the delta pairing in
+                # metrics.server_stats_delta drops replicas missing
+                # from either end of a window.
+                continue
+        return snaps
+
+    def stats_snapshot(self, model):
+        """Summed flat snapshot PLUS the per-replica map: window deltas
+        pair each replica with itself, so a replica dying or reviving
+        mid-window never subtracts/adds its lifetime counters into one
+        window's delta."""
+        from perfanalyzer.metrics import zero_snapshot
+
+        snaps = self._per_replica_snapshots(model)
+        total = zero_snapshot()
+        for snap in snaps.values():
+            for key, val in snap.items():
+                total[key] += val
+        total["_replicas"] = snaps
+        return total
+
+    def server_statistics(self, model):
+        # summed model_stats shape for API parity with the other
+        # backends (the profiler itself uses stats_snapshot)
+        total = self.stats_snapshot(model)
+        merged = {
+            "name": model,
+            "inference_count": total["inference_count"],
+            "execution_count": total["execution_count"],
+            "inference_stats": {
+                key: {
+                    "count": total[key + "_count"],
+                    "ns": total[key + "_ns"],
+                }
+                for key in ("success", "fail", "queue", "compute_input",
+                            "compute_infer", "compute_output")
+            },
+        }
+        return {"model_stats": [merged]}
+
+    def _prepare_one(self, model, inputs):
+        return (model, _prepare_infer_inputs(
+            self._mod, inputs, binary_data=True))
+
+    def infer(self, prepared):
+        from tritonclient.utils import InferenceServerException
+
+        model, infer_inputs = prepared
+        try:
+            self.pool.infer(model, infer_inputs)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+    def close(self):
+        super().close()
+        for client in self._stat_clients:
+            try:
+                client.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.pool.close()
+
+
+# -- factory ---------------------------------------------------------------
+
+
+def create_backend(kind, url=None, urls=None, core=None,
+                   max_inflight=None, **kwargs):
+    """Build a backend by name (the CLI's ``--backend`` flag).
+
+    ``http``/``grpc`` need ``url``; ``pool`` needs ``urls`` (list);
+    ``inprocess`` needs ``core`` (an ``InferenceServer``).
+    ``max_inflight`` sizes the backend's executor/connection pool so
+    the requested load level actually reaches the server.
+    """
+    if kind == "inprocess":
+        if core is None:
+            raise ValueError("inprocess backend needs core=")
+        return InProcessBackend(core, max_inflight=max_inflight)
+    if kind == "http":
+        if not url:
+            raise ValueError("http backend needs url=")
+        return HttpBackend(url, max_inflight=max_inflight, **kwargs)
+    if kind == "grpc":
+        if not url:
+            raise ValueError("grpc backend needs url=")
+        return GrpcBackend(url, max_inflight=max_inflight)
+    if kind == "pool":
+        if not urls:
+            raise ValueError("pool backend needs urls=")
+        return PoolBackend(urls, max_inflight=max_inflight, **kwargs)
+    raise ValueError(
+        "unknown backend '{}' (want http, grpc, inprocess, or "
+        "pool)".format(kind))
+
+
+# -- input synthesis -------------------------------------------------------
+
+
+def build_input_pool(metadata, config, pool_size=16, batch_size=1,
+                     shape_overrides=None, const_overrides=None, seed=0):
+    """A rotating pool of DISTINCT random input sets for one model.
+
+    Measurement hygiene (docs/benchmarking.md rule 1): every context
+    rotates through distinct inputs so no (executable, values) pair
+    repeats back-to-back.  Shapes come from the model metadata; dynamic
+    dims (-1) must be pinned via ``shape_overrides`` (name -> dims).
+    ``const_overrides`` (name -> scalar) fills an input with one fixed
+    value instead of random data — for control inputs like a
+    ``DELAY_US`` knob that must not be randomized.  Models with
+    ``max_batch_size > 0`` get a leading ``batch_size`` axis, matching
+    Triton config semantics.
+    """
+    from tritonclient.utils import triton_to_np_dtype
+
+    shape_overrides = shape_overrides or {}
+    const_overrides = const_overrides or {}
+    batched = _coerce_int(config.get("max_batch_size", 0)) > 0
+    pool = []
+    for i in range(pool_size):
+        rng = np.random.RandomState(seed + i)
+        inputs = {}
+        for spec in metadata.get("inputs", []):
+            name = spec["name"]
+            dims = list(shape_overrides.get(name, spec["shape"]))
+            dims = [_coerce_int(d) for d in dims]
+            if any(d < 1 for d in dims):
+                raise ValueError(
+                    "input '{}' has dynamic dims {}; pin them with "
+                    "--shape {}:d1,d2,...".format(name, dims, name))
+            if batched:
+                dims = [batch_size] + dims
+            datatype = spec["datatype"]
+            if name in const_overrides:
+                np_dtype = (np.object_ if datatype == "BYTES"
+                            else triton_to_np_dtype(datatype))
+                value = const_overrides[name]
+                if datatype == "BYTES":
+                    value = str(value).encode("utf-8")
+                inputs[name] = np.full(dims, value, dtype=np_dtype)
+            elif datatype == "BYTES":
+                flat = np.array(
+                    ["req{}-{}".format(i, j).encode("utf-8")
+                     for j in range(int(np.prod(dims)))],
+                    dtype=np.object_)
+                inputs[name] = flat.reshape(dims)
+            else:
+                np_dtype = triton_to_np_dtype(datatype)
+                if np_dtype is None:
+                    raise ValueError(
+                        "cannot synthesize datatype '{}' for input "
+                        "'{}'".format(datatype, name))
+                if np.issubdtype(np_dtype, np.integer):
+                    inputs[name] = rng.randint(
+                        0, 100, size=dims).astype(np_dtype)
+                elif np_dtype == np.bool_:
+                    inputs[name] = rng.randint(
+                        0, 2, size=dims).astype(np.bool_)
+                else:
+                    inputs[name] = rng.rand(*dims).astype(np_dtype)
+        pool.append(inputs)
+    return pool

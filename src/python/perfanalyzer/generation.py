@@ -1,0 +1,289 @@
+"""Generation mode: token-level metrics for decoupled/streaming models.
+
+The serving-side scheduler (PR 1) exists to lift sustained generation
+throughput; these are the client-side numbers that prove it: TTFT
+(time-to-first-token), ITL (inter-token latency) percentiles, and
+aggregate tokens/sec, measured over ``/generate_stream`` SSE, decoupled
+gRPC streams, or the in-process core — whatever the backend speaks.
+
+Same window/stability machinery as the scalar profiler: tokens are
+counted the moment they ARRIVE (throughput is arrival-rate, not
+completion-rate), while TTFT/ITL samples are attributed to the window
+their generation completes in.
+"""
+
+import threading
+import time
+
+from perfanalyzer import metrics
+from perfanalyzer.profiler import ProfileResult
+from perfanalyzer.stability import StabilityDetector
+
+
+class _GenCollector:
+    """Window-gated sink for token arrivals + completed generations."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._open = False
+        self._lifetime_generations = 0  # counted window-open or not
+        self.reset()
+
+    def reset(self):
+        self._tokens = 0
+        self._ttfts = []
+        self._itls = []
+        self._generations = 0
+        self._errors = 0
+
+    def start_window(self):
+        with self._lock:
+            self._open = True
+            self.reset()
+
+    def end_window(self):
+        with self._lock:
+            self._open = False
+            return {
+                "tokens": self._tokens,
+                "ttfts_s": self._ttfts,
+                "itls_s": self._itls,
+                "generations": self._generations,
+                "errors": self._errors,
+            }
+
+    def record_tokens(self, count):
+        with self._lock:
+            if self._open:
+                self._tokens += count
+
+    def lifetime_generations(self):
+        with self._lock:
+            return self._lifetime_generations
+
+    def record_generation(self, ttft_s, itls_s, error):
+        with self._lock:
+            self._lifetime_generations += 1
+            if not self._open:
+                return
+            if error is not None:
+                self._errors += 1
+                return
+            self._generations += 1
+            if ttft_s is not None:
+                self._ttfts.append(ttft_s)
+            self._itls.extend(itls_s)
+
+
+class GenerationProfiler:
+    """Concurrency-mode load + windowed stability for streamed
+    generation.
+
+    N worker threads each run back-to-back generations (closed loop at
+    the *stream* level — the continuous-batching scheduler keeps N
+    slots busy), rotating DISTINCT prompts from the prepared pool.
+    Stability is judged on tokens/sec and average ITL across
+    ``stability_windows`` consecutive windows.
+    """
+
+    mode = "generation_concurrency"
+
+    def __init__(self, backend, model, input_pool, parameters=None,
+                 measurement_interval_s=1.0, stability_pct=10.0,
+                 stability_windows=3, max_trials=10, warmup_s=0.0,
+                 early_exit=None, verbose=False):
+        if not backend.supports_generation:
+            raise ValueError(
+                "backend '{}' does not support generation mode".format(
+                    backend.kind))
+        if not input_pool:
+            raise ValueError("need at least one prompt input set")
+        self.backend = backend
+        self.model = model
+        self.input_pool = list(input_pool)
+        self.parameters = dict(parameters or {})
+        self.measurement_interval_s = float(measurement_interval_s)
+        self.stability_pct = float(stability_pct)
+        self.stability_windows = int(stability_windows)
+        self.max_trials = int(max_trials)
+        self.warmup_s = float(warmup_s)
+        self.early_exit = early_exit
+        self.verbose = verbose
+        self.collector = _GenCollector()
+        self._workers = []
+        self._level_baseline = 0
+        self._stop_event = threading.Event()
+        self._cursor_lock = threading.Lock()
+        self._cursor = 0
+
+    # -- workers -----------------------------------------------------------
+
+    def _next_inputs(self):
+        with self._cursor_lock:
+            inputs = self.input_pool[self._cursor % len(self.input_pool)]
+            self._cursor += 1
+        return inputs
+
+    def _worker_loop(self, stop_event):
+        try:
+            while not stop_event.is_set():
+                inputs = self._next_inputs()
+                t0 = time.perf_counter()
+                ttft = None
+                prev = None
+                itls = []
+                error = None
+                try:
+                    for count in self.backend.generate_stream(
+                            self.model, inputs, self.parameters):
+                        now = time.perf_counter()
+                        if ttft is None:
+                            ttft = now - t0
+                        else:
+                            itls.append(now - prev)
+                        prev = now
+                        self.collector.record_tokens(count)
+                except Exception as e:  # noqa: BLE001 — a worker must
+                    # never die silently mid-profile; the error (typed
+                    # BackendError or not) is counted
+                    error = e
+                self.collector.record_generation(ttft, itls, error)
+        finally:
+            self.backend.release_thread_resources()
+
+    def _set_workers(self, concurrency):
+        self._stop_workers()
+        # baseline AFTER the old level's workers drained and BEFORE the
+        # new ones start: the warmup gate must see a completion from
+        # THIS level, not the previous level's final generations
+        self._level_baseline = self.collector.lifetime_generations()
+        self._stop_event = threading.Event()
+        self._workers = [
+            threading.Thread(
+                target=self._worker_loop, args=(self._stop_event,),
+                name="perfanalyzer-gen-{}".format(i), daemon=True)
+            for i in range(concurrency)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def _stop_workers(self):
+        if self._workers:
+            self._stop_event.set()
+            # workers finish their CURRENT generation then exit; joining
+            # bounds the wait so a wedged stream cannot hang the sweep
+            for w in self._workers:
+                w.join(timeout=120.0)
+            self._workers = []
+
+    # -- profiling ---------------------------------------------------------
+
+    def change_level(self, concurrency):
+        self._set_workers(int(concurrency))
+
+    def _run_window(self):
+        self.collector.start_window()
+        t0 = time.perf_counter()
+        deadline = t0 + self.measurement_interval_s
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            if self.early_exit is not None and self.early_exit.is_set():
+                break
+            time.sleep(min(0.05, remaining))
+        duration = time.perf_counter() - t0
+        window = self.collector.end_window()
+        window["duration_s"] = duration
+        return window
+
+    def profile_level(self, level):
+        self.change_level(level)
+        # warmup waits for a COMPLETED generation at THIS level, not
+        # just wall time: the first stream at a new level can carry XLA
+        # compiles that dwarf every window (hygiene rule 5 — compiles
+        # land outside measurement), then settles to the configured
+        # warmup
+        seen = self._level_baseline
+        deadline = time.monotonic() + 120.0
+        while (self.collector.lifetime_generations() <= seen
+               and time.monotonic() < deadline):
+            if self.early_exit is not None and self.early_exit.is_set():
+                break
+            time.sleep(0.02)
+        if self.warmup_s > 0:
+            if self.early_exit is not None:
+                self.early_exit.wait(self.warmup_s)
+            else:
+                time.sleep(self.warmup_s)
+        detector = StabilityDetector(
+            self.stability_pct, self.stability_windows,
+            check_latency=False)
+        windows = []
+        stable = False
+        interrupted = False
+        for trial in range(self.max_trials):
+            window = self._run_window()
+            if window["duration_s"] <= 0:
+                continue
+            windows.append(window)
+            tok_rate = window["tokens"] / window["duration_s"]
+            detector.add_window(tok_rate, 0.0)
+            if self.verbose:
+                print("  trial {:2d}: {:8.1f} tokens/sec".format(
+                    trial + 1, tok_rate), flush=True)
+            if self.early_exit is not None and self.early_exit.is_set():
+                interrupted = True
+                break
+            if len(windows) >= self.stability_windows and detector.stable():
+                stable = True
+                break
+        merged = windows[-self.stability_windows:]
+        duration = sum(w["duration_s"] for w in merged)
+        tokens = sum(w["tokens"] for w in merged)
+        ttfts = [t for w in merged for t in w["ttfts_s"]]
+        itls = [t for w in merged for t in w["itls_s"]]
+        generations = sum(w["generations"] for w in merged)
+        errors = sum(w["errors"] for w in merged)
+        result = ProfileResult(
+            mode=self.mode,
+            level=level,
+            stable=stable,
+            interrupted=interrupted,
+            trials=len(windows),
+            throughput=tokens / duration if duration > 0 else 0.0,
+            tokens=tokens,
+            generations=generations,
+            gen_per_sec=generations / duration if duration > 0 else 0.0,
+            errors=errors,
+            duration_s=duration,
+        )
+        for prefix, sample in (("ttft", ttfts), ("itl", itls)):
+            if sample:
+                ms = sorted(v * 1e3 for v in sample)
+                result[prefix + "_avg_ms"] = sum(ms) / len(ms)
+                for p in (50, 90, 95, 99):
+                    result["{}_p{}_ms".format(prefix, p)] = (
+                        metrics.percentile(ms, p, presorted=True))
+            else:
+                result[prefix + "_avg_ms"] = None
+                for p in (50, 90, 95, 99):
+                    result["{}_p{}_ms".format(prefix, p)] = None
+        return result
+
+    def sweep(self, levels):
+        results = []
+        try:
+            for level in levels:
+                if (self.early_exit is not None
+                        and self.early_exit.is_set()):
+                    break
+                results.append(self.profile_level(level))
+                if results[-1]["interrupted"]:
+                    break
+        finally:
+            self.stop()
+        return results
+
+    def stop(self):
+        self._stop_workers()

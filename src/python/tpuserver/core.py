@@ -415,7 +415,8 @@ class _XlaShmRegion:
 class _BatchSlot:
     """One queued request inside the dynamic batcher."""
 
-    __slots__ = ("inputs", "rows", "event", "outputs", "error")
+    __slots__ = ("inputs", "rows", "event", "outputs", "error",
+                 "enqueue_ns", "queue_ns")
 
     def __init__(self, inputs, rows):
         self.inputs = inputs
@@ -423,6 +424,10 @@ class _BatchSlot:
         self.event = threading.Event()
         self.outputs = None
         self.error = None
+        # KServe-style queue accounting: time from enqueue to the moment
+        # a worker starts executing the batch this slot landed in
+        self.enqueue_ns = time.monotonic_ns()
+        self.queue_ns = 0
 
 
 class _DynamicBatcher:
@@ -468,8 +473,10 @@ class _DynamicBatcher:
     def submit(self, inputs, rows):
         """Queue one request's inputs; blocks until its batch executes.
 
-        Returns the request's slice of the batched outputs (raises the
-        batch's error if execution failed)."""
+        Returns ``(outputs, queue_ns)`` — the request's slice of the
+        batched outputs plus the nanoseconds this request waited in the
+        batching window before execution started (the KServe ``queue``
+        stat bucket; raises the batch's error if execution failed)."""
         slot = _BatchSlot(inputs, rows)
         with self._cond:
             if self._stop:
@@ -481,7 +488,7 @@ class _DynamicBatcher:
         slot.event.wait()
         if slot.error is not None:
             raise slot.error
-        return slot.outputs
+        return slot.outputs, slot.queue_ns
 
     def stop(self):
         with self._cond:
@@ -614,6 +621,9 @@ class _DynamicBatcher:
         return stacked
 
     def _execute(self, batch, rows):
+        t_start = time.monotonic_ns()
+        for slot in batch:
+            slot.queue_ns = max(0, t_start - slot.enqueue_ns)
         try:
             padded = self._bucket(rows, self._model.max_batch_size)
             stacked = self._stack(batch, rows, padded)
@@ -1443,16 +1453,18 @@ class InferenceServer:
                     )
                 )
         t_cf0 = time.monotonic_ns()
+        batch_queue_ns = 0
         try:
             if model.ensemble_steps is not None:
                 outputs = self._execute_ensemble(model, inputs, request)
             elif model.sequence:
                 outputs = self._execute_sequence(model, inputs, request)
             elif self._batchable(model, inputs, request):
-                # the batching window shows up inside compute_infer
-                # (the split would be cosmetic; the client-visible
-                # latency is what perf_analyzer measures anyway)
-                outputs = self._batcher_of(model).submit(
+                # the batcher reports how long this request sat in its
+                # batching window: that wait lands in the KServe `queue`
+                # bucket, so the profiler's server-side breakdown can
+                # tell queueing from actual device compute
+                outputs, batch_queue_ns = self._batcher_of(model).submit(
                     inputs, int(next(iter(inputs.values())).shape[0])
                 )
             else:
@@ -1484,9 +1496,9 @@ class InferenceServer:
         t_end = time.monotonic_ns()
         stats.record(
             self._batch_of(model, inputs),
-            t_ci0 - t_queue0,
+            (t_ci0 - t_queue0) + batch_queue_ns,
             t_cf0 - t_ci0,
-            t_co0 - t_cf0,
+            max(0, (t_co0 - t_cf0) - batch_queue_ns),
             t_end - t_co0,
         )
         return resp

@@ -83,6 +83,25 @@ def test_example(zoo_servers, script, proto, extra):
     assert "PASS" in result.stdout, result.stdout
 
 
+@pytest.mark.perf
+def test_perf_analyzer_cli_against_live_server(zoo_servers):
+    """The perf_analyzer CLI as a user runs it: --backend http against
+    a live frontend, tiny windows, table + JSON out."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "src", "python")
+    env["JAX_PLATFORMS"] = "cpu"
+    result = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_analyzer.py"),
+         "-m", "simple", "--backend", "http", "-u", zoo_servers["http"],
+         "--concurrency-range", "2", "--measurement-interval", "250",
+         "--max-trials", "5", "--warmup", "0.1"],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert "*** perf_analyzer" in result.stdout
+    assert '"unit": "infer/sec"' in result.stdout
+
+
 def test_llama_streaming_example():
     """Token streaming with KV parked in XLA shm — BASELINE config #5's
     user-facing client (own tiny-llama server; the shared zoo omits

@@ -1,0 +1,188 @@
+"""End-to-end smoke tests for the perf_analyzer CLI.
+
+Spawn the real ``tools/perf_analyzer.py`` against an in-process server
+with tiny measurement windows: the concurrency and request-rate modes
+on the `simple` model, generation mode on tiny llama (TTFT/ITL fields
+present and sane), and the two-stage SIGINT contract (first = finish
+the window and report partial results with exit 0; second = abort
+nonzero) — the chaos-soak convention of tools/chaos_smoke.py."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "perf_analyzer.py")
+
+pytestmark = pytest.mark.perf
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(REPO, "src", "python")
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_cli(args, timeout=300):
+    result = subprocess.run(
+        [sys.executable, CLI] + args,
+        capture_output=True, text=True, timeout=timeout, env=_env(),
+    )
+    rows = [json.loads(line) for line in result.stdout.splitlines()
+            if line.startswith('{"')]
+    return result, rows
+
+
+def test_cli_concurrency_sweep_inprocess():
+    result, rows = _run_cli([
+        "-m", "simple", "--backend", "inprocess",
+        "--concurrency-range", "1:2",
+        "--measurement-interval", "250", "--max-trials", "6",
+        "--warmup", "0.1",
+    ])
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert len(rows) == 2
+    for row in rows:
+        assert row["unit"] == "infer/sec"
+        assert row["value"] > 0
+        # client percentiles + the server-side breakdown the profiler
+        # diffs out of get_inference_statistics()
+        for key in ("p50_usec", "p90_usec", "p95_usec", "p99_usec",
+                    "queue_usec", "compute_infer_usec",
+                    "client_overhead_pct"):
+            assert row[key] is not None, key
+        assert row["errors"] == 0
+        assert 0 <= row["client_overhead_pct"] <= 100
+        # latency ordering is a structural invariant of the percentiles
+        assert (row["p50_usec"] <= row["p90_usec"]
+                <= row["p95_usec"] <= row["p99_usec"])
+    assert "*** perf_analyzer" in result.stdout  # the stdout table
+
+
+def test_cli_request_rate_poisson_inprocess():
+    result, rows = _run_cli([
+        "-m", "simple", "--backend", "inprocess",
+        "--request-rate-range", "100", "--request-distribution",
+        "poisson", "--measurement-interval", "250", "--max-trials", "6",
+        "--warmup", "0.1",
+    ])
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["mode"] == "request_rate"
+    # open loop at 100 req/s: the measured arrival rate tracks the
+    # schedule, not the server's appetite
+    assert 50 < row["value"] < 150
+    assert row["p50_usec"] is not None
+
+
+def test_cli_generation_mode_reports_token_metrics():
+    result, rows = _run_cli([
+        "-m", "llama_generate", "--backend", "inprocess",
+        "--generation", "--concurrency-range", "2",
+        "--max-tokens", "8", "--measurement-interval", "300",
+        "--max-trials", "5", "--warmup", "0.1",
+    ])
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["unit"] == "tokens/sec"
+    assert row["value"] > 0
+    assert row["tokens"] > 0
+    assert row["generations"] > 0
+    # TTFT/ITL present and sane: positive, ordered percentiles, and
+    # TTFT (prefill + first decode) at least on the order of one ITL
+    assert row["ttft_p50_ms"] > 0
+    assert row["ttft_p50_ms"] <= row["ttft_p99_ms"]
+    assert row["itl_p50_ms"] > 0
+    assert row["itl_p50_ms"] <= row["itl_p99_ms"]
+    assert row["ttft_p50_ms"] >= 0.5 * row["itl_p50_ms"]
+    assert row["errors"] == 0
+
+
+class _Reader:
+    """Drains a pipe on a thread; flags when the settings banner (the
+    'measurement is underway' cue) has been printed."""
+
+    def __init__(self, pipe):
+        self.lines = []
+        self.banner = threading.Event()
+        self._thread = threading.Thread(
+            target=self._drain, args=(pipe,), daemon=True)
+        self._thread.start()
+
+    def _drain(self, pipe):
+        for line in pipe:
+            self.lines.append(line)
+            if "Measurement Settings" in line:
+                self.banner.set()
+
+    def text(self):
+        self._thread.join(timeout=10)
+        return "".join(self.lines)
+
+
+def _spawn_cli(args):
+    return subprocess.Popen(
+        [sys.executable, CLI] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=_env(),
+    )
+
+
+def test_first_sigint_yields_partial_report_exit_zero():
+    # a window far longer than the test: only SIGINT can end it
+    proc = _spawn_cli([
+        "-m", "simple", "--backend", "inprocess",
+        "--concurrency-range", "1:8",
+        "--measurement-interval", "120000", "--warmup", "0",
+    ])
+    reader = _Reader(proc.stdout)
+    try:
+        assert reader.banner.wait(timeout=120), "CLI never started"
+        time.sleep(1.0)  # inside the first (huge) window
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = reader.text()
+    assert rc == 0, out
+    rows = [json.loads(line) for line in out.splitlines()
+            if line.startswith('{"')]
+    # a VALID partial report: at least one measured level, flagged
+    assert rows, out
+    assert all(row["early_exit"] is True for row in rows)
+    assert rows[0]["value"] > 0
+    assert rows[0]["p50_usec"] is not None
+
+
+def test_second_sigint_aborts_nonzero():
+    # slow in-flight requests (delayed_identity pinned to 2s sleeps)
+    # keep the process draining after the first SIGINT, so the second
+    # SIGINT deterministically lands before any report
+    proc = _spawn_cli([
+        "-m", "delayed_identity", "--backend", "inprocess",
+        "--concurrency-range", "4", "--measurement-interval", "120000",
+        "--warmup", "0", "--shape", "INPUT0:16",
+        "--input-const", "DELAY_US:2000000",
+    ])
+    reader = _Reader(proc.stdout)
+    try:
+        assert reader.banner.wait(timeout=120), "CLI never started"
+        time.sleep(1.0)  # requests in flight, each sleeping 2s
+        proc.send_signal(signal.SIGINT)
+        time.sleep(0.5)  # first ^C is now draining those requests
+        proc.send_signal(signal.SIGINT)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc != 0, reader.text()

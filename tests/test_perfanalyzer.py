@@ -1,0 +1,509 @@
+"""Deterministic unit tests for the perfanalyzer math and managers.
+
+Everything here is clock-free or polling-based (no fixed sleeps in
+assertions): schedule distributions, percentile math, 3-window
+stability detection, client/server stat merging, the concurrency
+manager's context free-list, and the core's queue-vs-compute stat
+split (PR 4 satellite)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from perfanalyzer import metrics
+from perfanalyzer.client_backend import ClientBackend, build_input_pool
+from perfanalyzer.load_manager import ConcurrencyManager, LoadCollector
+from perfanalyzer.profiler import parse_range
+from perfanalyzer.schedule import schedule_distribution, take_gaps
+from perfanalyzer.stability import StabilityDetector
+
+
+# -- schedule_distribution -------------------------------------------------
+
+
+def test_constant_schedule_is_a_metronome():
+    gaps = take_gaps("constant", 10.0, 5)
+    assert gaps == [0.1] * 5
+
+
+def test_poisson_schedule_is_seed_deterministic():
+    a = take_gaps("poisson", 50.0, 100, seed=7)
+    b = take_gaps("poisson", 50.0, 100, seed=7)
+    c = take_gaps("poisson", 50.0, 100, seed=8)
+    assert a == b
+    assert a != c
+    assert all(g >= 0 for g in a)
+
+
+def test_poisson_schedule_mean_matches_rate():
+    rate = 200.0
+    gaps = take_gaps("poisson", rate, 20000, seed=3)
+    mean = sum(gaps) / len(gaps)
+    # law of large numbers: 20k exponential draws sit within a few
+    # percent of 1/rate
+    assert abs(mean - 1.0 / rate) < 0.05 / rate
+
+
+def test_schedule_rejects_bad_args():
+    with pytest.raises(ValueError):
+        take_gaps("constant", 0.0, 1)
+    with pytest.raises(ValueError):
+        take_gaps("uniform", 10.0, 1)
+
+
+# -- percentiles -----------------------------------------------------------
+
+
+def test_percentile_matches_numpy_linear():
+    rng = np.random.RandomState(0)
+    sample = list(rng.rand(257) * 1000)
+    for pct in (0, 10, 50, 90, 95, 99, 100):
+        assert metrics.percentile(sample, pct) == pytest.approx(
+            float(np.percentile(sample, pct)))
+
+
+def test_percentile_edges():
+    assert metrics.percentile([42.0], 99) == 42.0
+    assert metrics.percentile([1.0, 2.0], 50) == pytest.approx(1.5)
+    with pytest.raises(ValueError):
+        metrics.percentile([], 50)
+    with pytest.raises(ValueError):
+        metrics.percentile([1.0], 101)
+
+
+def test_latency_summary_units_and_keys():
+    summary = metrics.latency_summary([0.001, 0.002, 0.003])
+    assert summary["avg_usec"] == pytest.approx(2000.0)
+    assert summary["min_usec"] == pytest.approx(1000.0)
+    assert summary["max_usec"] == pytest.approx(3000.0)
+    assert summary["p50_usec"] == pytest.approx(2000.0)
+    assert set(summary) >= {"p50_usec", "p90_usec", "p95_usec",
+                            "p99_usec"}
+    empty = metrics.latency_summary([])
+    assert empty["p99_usec"] is None
+
+
+# -- stability detection ---------------------------------------------------
+
+
+def test_stability_converges_on_stable_input():
+    det = StabilityDetector(stability_pct=10.0, window_count=3)
+    det.add_window(100.0, 0.010)
+    assert not det.stable()  # only one window
+    det.add_window(104.0, 0.0102)
+    assert not det.stable()
+    det.add_window(98.0, 0.0099)
+    assert det.stable()
+
+
+def test_stability_keeps_sweeping_on_trending_input():
+    det = StabilityDetector(stability_pct=10.0, window_count=3)
+    rate, lat = 100.0, 0.010
+    for _ in range(10):
+        det.add_window(rate, lat)
+        assert not det.stable()
+        rate *= 1.25  # a system still ramping: +25% per window
+        lat *= 1.25
+
+
+def test_stability_slides_past_a_transient():
+    det = StabilityDetector(stability_pct=10.0, window_count=3)
+    for tp in (100.0, 300.0, 100.0):  # spike in the middle
+        det.add_window(tp, 0.01)
+    assert not det.stable()
+    for _ in range(3):  # three calm windows push the spike out
+        det.add_window(101.0, 0.01)
+    assert det.stable()
+
+
+def test_stability_rejects_zero_throughput_plateau():
+    det = StabilityDetector(stability_pct=10.0, window_count=3)
+    for _ in range(3):
+        det.add_window(0.0, 0.0)
+    assert not det.stable()
+
+
+def test_stability_latency_exemption():
+    # request-rate mode: open-loop latency trends with queue depth by
+    # design, so only throughput is judged
+    strict = StabilityDetector(10.0, 3, check_latency=True)
+    loose = StabilityDetector(10.0, 3, check_latency=False)
+    lat = 0.01
+    for _ in range(3):
+        strict.add_window(100.0, lat)
+        loose.add_window(100.0, lat)
+        lat *= 2.0
+    assert not strict.stable()
+    assert loose.stable()
+
+
+# -- client/server stat merging --------------------------------------------
+
+
+def _stats_payload(queue_ns, infer_ns, count, as_strings=False):
+    cast = str if as_strings else int
+    return {
+        "model_stats": [{
+            "name": "m",
+            "version": "1",
+            "inference_count": cast(count),
+            "execution_count": cast(count),
+            "inference_stats": {
+                "success": {"count": cast(count),
+                            "ns": cast(queue_ns + infer_ns)},
+                "fail": {"count": cast(0), "ns": cast(0)},
+                "queue": {"count": cast(count), "ns": cast(queue_ns)},
+                "compute_input": {"count": cast(count), "ns": cast(0)},
+                "compute_infer": {"count": cast(count),
+                                  "ns": cast(infer_ns)},
+                "compute_output": {"count": cast(count), "ns": cast(0)},
+            },
+        }],
+    }
+
+
+def test_server_stats_snapshot_accepts_both_client_forms():
+    # http returns ints; grpc MessageToDict returns proto int64s as
+    # STRINGS — both must normalize identically
+    plain = metrics.server_stats_snapshot(
+        _stats_payload(5000, 20000, 4), "m")
+    stringy = metrics.server_stats_snapshot(
+        _stats_payload(5000, 20000, 4, as_strings=True), "m")
+    assert plain == stringy
+    assert plain["queue_ns"] == 5000
+    assert plain["compute_infer_ns"] == 20000
+    assert plain["inference_count"] == 4
+    with pytest.raises(KeyError):
+        metrics.server_stats_snapshot(_stats_payload(1, 1, 1), "other")
+
+
+def test_server_stats_delta_isolates_the_window():
+    before = metrics.server_stats_snapshot(
+        _stats_payload(1000, 4000, 10), "m")
+    after = metrics.server_stats_snapshot(
+        _stats_payload(3000, 10000, 25), "m")
+    delta = metrics.server_stats_delta(before, after)
+    assert delta["queue_ns"] == 2000
+    assert delta["compute_infer_ns"] == 6000
+    assert delta["success_count"] == 15
+
+
+def test_server_stats_delta_pairs_replicas_across_flaps():
+    # pool snapshots carry per-replica maps; a replica that dies or
+    # revives mid-window must be dropped from that window's delta, not
+    # subtract/add its lifetime counters
+    def flat(queue_ns, infer_ns, count):
+        return metrics.server_stats_snapshot(
+            _stats_payload(queue_ns, infer_ns, count), "m")
+
+    before = dict(flat(1000, 4000, 10))
+    before["_replicas"] = {"a": flat(600, 2000, 6),
+                          "b": flat(400, 2000, 4)}
+    after = dict(flat(900, 3000, 9))  # b vanished mid-window
+    after["_replicas"] = {"a": flat(900, 3000, 9)}
+    delta = metrics.server_stats_delta(before, after)
+    assert delta["queue_ns"] == 300       # a's own progress only
+    assert delta["success_count"] == 3
+    assert all(v >= 0 for v in delta.values())
+    # b reviving mid-window likewise contributes nothing to THIS window
+    revived = dict(after)
+    revived["_replicas"] = dict(after["_replicas"], b=flat(999, 999, 9))
+    delta2 = metrics.server_stats_delta(before, revived)
+    assert delta2["queue_ns"] == 300 + (999 - 400)  # b paired with b
+    delta3 = metrics.server_stats_delta(after, revived)
+    assert delta3["queue_ns"] == 0  # b absent from `after`: dropped
+
+
+def test_server_breakdown_and_overhead_pct():
+    delta = {"success_count": 10, "queue_ns": 50_000,
+             "compute_input_ns": 10_000, "compute_infer_ns": 100_000,
+             "compute_output_ns": 40_000}
+    br = metrics.server_breakdown(delta)
+    assert br["queue_usec"] == pytest.approx(5.0)
+    assert br["compute_infer_usec"] == pytest.approx(10.0)
+    assert br["server_total_usec"] == pytest.approx(20.0)
+    # client saw 80us avg -> 75% overhead outside the server
+    assert metrics.client_overhead_pct(80.0, 20.0) == pytest.approx(75.0)
+    # skewed clocks can push server > client; clamp, don't go negative
+    assert metrics.client_overhead_pct(10.0, 20.0) == 0.0
+    assert metrics.client_overhead_pct(None, 20.0) is None
+
+
+def test_merge_window_records_weights_by_requests():
+    merged = metrics.merge_window_records([
+        (1.0, [0.01] * 10, 0),
+        (2.0, [0.03] * 40, 2),
+    ])
+    assert merged["completed"] == 50
+    assert merged["errors"] == 2
+    # 50 completions over 3 seconds, NOT mean(10/1, 40/2)
+    assert merged["throughput"] == pytest.approx(50 / 3.0)
+    assert len(merged["latencies_s"]) == 50
+
+
+# -- range parsing ---------------------------------------------------------
+
+
+def test_parse_range_forms():
+    assert parse_range("4") == [4]
+    assert parse_range("1:4") == [1, 2, 3, 4]
+    assert parse_range("1:8:2") == [1, 3, 5, 7]
+    with pytest.raises(ValueError):
+        parse_range("4:1")
+    with pytest.raises(ValueError):
+        parse_range("1:2:3:4")
+
+
+# -- input synthesis -------------------------------------------------------
+
+
+def test_build_input_pool_is_distinct_and_batched():
+    metadata = {"inputs": [
+        {"name": "INPUT0", "datatype": "INT32", "shape": [16]},
+        {"name": "TXT", "datatype": "BYTES", "shape": [2]},
+    ]}
+    config = {"max_batch_size": 8}
+    pool = build_input_pool(metadata, config, pool_size=4, batch_size=2)
+    assert len(pool) == 4
+    for inputs in pool:
+        assert inputs["INPUT0"].shape == (2, 16)
+        assert inputs["INPUT0"].dtype == np.int32
+        assert inputs["TXT"].shape == (2, 2)
+    # hygiene rule 1: sets are pairwise distinct
+    assert not np.array_equal(pool[0]["INPUT0"], pool[1]["INPUT0"])
+
+    unbatched = build_input_pool(
+        metadata, {"max_batch_size": 0}, pool_size=1)
+    assert unbatched[0]["INPUT0"].shape == (16,)
+
+    with pytest.raises(ValueError):
+        build_input_pool(
+            {"inputs": [{"name": "X", "datatype": "INT32",
+                         "shape": [-1]}]},
+            {"max_batch_size": 0})
+
+
+# -- concurrency manager: context free-list --------------------------------
+
+
+class _HarnessBackend(ClientBackend):
+    """Captures submissions; completions fire only when the test says
+    so — the manager's in-flight accounting is observable exactly."""
+
+    kind = "harness"
+
+    def __init__(self):
+        super().__init__()
+        self.lock = threading.Lock()
+        self.pending = []  # on_done callbacks not yet completed
+        self.submitted = 0
+
+    def submit(self, prepared, on_done):
+        with self.lock:
+            self.pending.append(on_done)
+            self.submitted += 1
+
+    def complete_one(self, error=None):
+        with self.lock:
+            on_done = self.pending.pop(0)
+        on_done(error)
+
+
+def _poll(predicate, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.005)
+    return predicate()
+
+
+def test_concurrency_manager_holds_exactly_n_inflight():
+    backend = _HarnessBackend()
+    manager = ConcurrencyManager(backend, "m", prepared=["req"])
+    try:
+        manager.change_level(3)
+        assert _poll(lambda: backend.submitted == 3)
+        # no completions -> the dispatcher must NOT send a 4th
+        time.sleep(0.05)
+        assert backend.submitted == 3
+        assert manager.inflight() == 3
+        # one completion frees one context: exactly one more dispatch
+        backend.complete_one()
+        assert _poll(lambda: backend.submitted == 4)
+        assert manager.inflight() == 3
+    finally:
+        with backend.lock:
+            pending = list(backend.pending)
+            backend.pending = []
+        for on_done in pending:
+            on_done(None)
+        manager.stop()
+
+
+def test_concurrency_manager_shrinks_by_retiring_contexts():
+    backend = _HarnessBackend()
+    manager = ConcurrencyManager(backend, "m", prepared=["req"])
+    try:
+        manager.change_level(4)
+        assert _poll(lambda: backend.submitted == 4)
+        manager.change_level(1)
+        # drain all four; surplus contexts retire instead of re-queueing
+        for _ in range(4):
+            backend.complete_one()
+        assert _poll(lambda: manager.inflight() <= 1)
+        time.sleep(0.05)
+        assert backend.submitted <= 5  # at most one new dispatch
+    finally:
+        with backend.lock:
+            pending = list(backend.pending)
+            backend.pending = []
+        for on_done in pending:
+            on_done(None)
+        manager.stop()
+
+
+def test_concurrency_manager_regrows_after_shrink():
+    # regression: contexts are fungible counters, so shrink-then-grow
+    # must reach the new target (an id-threshold free-list would strand
+    # retired ids and cap in-flight below the requested level forever)
+    backend = _HarnessBackend()
+    manager = ConcurrencyManager(backend, "m", prepared=["req"])
+    try:
+        manager.change_level(4)
+        assert _poll(lambda: backend.submitted == 4)
+        manager.change_level(2)
+        for _ in range(4):
+            backend.complete_one()
+        assert _poll(lambda: manager.inflight() == 2)
+        manager.change_level(3)
+        assert _poll(lambda: manager.inflight() == 3)
+        assert _poll(lambda: len(backend.pending) == 3)
+    finally:
+        with backend.lock:
+            pending = list(backend.pending)
+            backend.pending = []
+        for on_done in pending:
+            on_done(None)
+        manager.stop()
+
+
+def test_collector_gates_on_window():
+    collector = LoadCollector()
+    collector.record(0.0, 1.0, None)  # no window open: dropped
+    collector.start_window()
+    collector.record(1.0, 1.5, None)
+    collector.record(1.0, 2.5, RuntimeError("x"))
+    latencies, errors = collector.end_window()
+    assert latencies == [0.5]
+    assert errors == 1
+    collector.record(0.0, 1.0, None)  # closed again: dropped
+    assert collector.end_window() == ([0.5], 1)
+
+
+# -- satellite: queue vs compute split in the core -------------------------
+
+
+class _SleepyBatchModel:
+    """Dynamic-batching model whose execute sleeps: concurrent requests
+    spend real time in the batching window, which must now land in the
+    `queue` stat bucket, not `compute_infer`."""
+
+    def __new__(cls):
+        from tpuserver.core import Model, TensorSpec
+
+        class Impl(Model):
+            name = "sleepy_batch"
+            platform = "python"
+            backend = "python"
+            max_batch_size = 8
+            dynamic_batching = True
+            max_queue_delay_us = 30_000
+            inputs = (TensorSpec("IN", "FP32", [4]),)
+            outputs = (TensorSpec("OUT", "FP32", [4]),)
+
+            def execute(self, inputs, request):
+                time.sleep(0.02)
+                return {"OUT": np.asarray(inputs["IN"]) * 2.0}
+
+        return Impl()
+
+
+def test_core_splits_queue_from_compute():
+    from tpuserver.core import InferenceServer, InferRequest
+
+    core = InferenceServer([_SleepyBatchModel()])
+    try:
+        def one():
+            req = InferRequest(
+                "sleepy_batch",
+                inputs={"IN": np.ones((1, 4), np.float32)})
+            core.infer(req)
+
+        threads = [threading.Thread(target=one) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        snap = metrics.server_stats_snapshot(
+            core.model_statistics("sleepy_batch"), "sleepy_batch")
+        assert snap["success_count"] == 4
+        # every request waited out (part of) the 30ms batching window
+        assert snap["queue_ns"] > 4 * 1_000_000
+        # compute_infer is the 20ms execute, once per executed batch,
+        # charged per request — no longer inflated by the queue wait
+        assert snap["compute_infer_ns"] > 4 * 10_000_000
+        per_req_compute = snap["compute_infer_ns"] / 4
+        assert per_req_compute < 100_000_000  # well under wait+exec*4
+    finally:
+        core.close()
+
+
+def test_queue_split_surfaces_through_both_clients():
+    import tritonclient.grpc as grpcclient
+    import tritonclient.http as httpclient
+
+    from tpuserver.core import InferenceServer, InferRequest
+    from tpuserver.grpc_frontend import GrpcFrontend
+    from tpuserver.http_frontend import HttpFrontend
+
+    core = InferenceServer([_SleepyBatchModel()])
+    http = HttpFrontend(core, port=0).start()
+    grpc_f = GrpcFrontend(core, port=0).start()
+    try:
+        threads = [
+            threading.Thread(target=lambda: core.infer(InferRequest(
+                "sleepy_batch",
+                inputs={"IN": np.ones((1, 4), np.float32)})))
+            for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+
+        hc = httpclient.InferenceServerClient(
+            http.url.replace("http://", ""))
+        gc = grpcclient.InferenceServerClient(
+            "127.0.0.1:{}".format(grpc_f.port))
+        try:
+            via_http = metrics.server_stats_snapshot(
+                hc.get_inference_statistics("sleepy_batch"),
+                "sleepy_batch")
+            via_grpc = metrics.server_stats_snapshot(
+                gc.get_inference_statistics(
+                    "sleepy_batch", as_json=True),
+                "sleepy_batch")
+        finally:
+            hc.close()
+            gc.close()
+        # both transports surface the same non-zero queue bucket
+        assert via_http["queue_ns"] > 0
+        assert via_http["queue_count"] == 3
+        assert via_grpc == via_http
+    finally:
+        grpc_f.stop()
+        http.stop()
+        core.close()

@@ -1,0 +1,332 @@
+#!/usr/bin/env python3
+"""perf_analyzer CLI: measure a model's serving performance to
+stability and report a table + BENCH-schema JSON rows.
+
+Python port of the reference perf_analyzer front door
+(perf_analyzer.cc): pick a client backend, a load mode (concurrency
+sweep, request-rate sweep, or token-streaming generation), and a
+measurement config; the harness drives load, waits for 3 consecutive
+stable windows per level, and reports client percentiles plus the
+server-side queue/compute breakdown.
+
+Examples:
+
+    # in-process (no sockets): isolate model cost from transport
+    python tools/perf_analyzer.py -m simple --backend inprocess \
+        --concurrency-range 1:4
+
+    # against a live server
+    python tools/perf_analyzer.py -m simple --backend http \
+        -u 127.0.0.1:8000 --concurrency-range 1:8:2
+
+    # open-loop Poisson arrivals
+    python tools/perf_analyzer.py -m simple --backend inprocess \
+        --request-rate-range 100:400:100 --request-distribution poisson
+
+    # token-level generation metrics (TTFT / ITL / tokens/sec)
+    python tools/perf_analyzer.py -m llama_generate --backend inprocess \
+        --generation --concurrency-range 1:4 --max-tokens 16
+
+SIGINT is two-stage (reference perf_analyzer.cc:39-53): the first ^C
+finishes the current window and reports the partial results (exit 0);
+a second ^C aborts immediately (exit nonzero).
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+EARLY_EXIT = threading.Event()
+_SIGINTS = [0]
+
+
+def _sigint_handler(signum, frame):
+    _SIGINTS[0] += 1
+    if _SIGINTS[0] == 1:
+        EARLY_EXIT.set()
+        print("\ncaught SIGINT: finishing the current window and "
+              "reporting partial results (^C again to abort)",
+              file=sys.stderr, flush=True)
+    else:
+        print("\nsecond SIGINT: aborting", file=sys.stderr, flush=True)
+        os._exit(2)
+
+
+def build_parser():
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-m", "--model", required=True,
+                    help="model to profile")
+    ap.add_argument("--backend", default="http",
+                    choices=["http", "grpc", "inprocess", "pool"],
+                    help="client backend (default http)")
+    ap.add_argument("-u", "--url", default="127.0.0.1:8000",
+                    help="server host:port (http/grpc backends)")
+    ap.add_argument("--urls", default=None,
+                    help="comma-separated replica URLs (pool backend)")
+    ap.add_argument("--concurrency-range", default=None,
+                    help="start:end[:step] closed-loop concurrency sweep")
+    ap.add_argument("--request-rate-range", default=None,
+                    help="start:end[:step] open-loop request/sec sweep")
+    ap.add_argument("--request-distribution", default="constant",
+                    choices=["constant", "poisson"],
+                    help="inter-arrival distribution for rate mode")
+    ap.add_argument("--measurement-interval", type=int, default=2000,
+                    help="measurement window length in ms (default 2000)")
+    ap.add_argument("--measurement-mode", default="time_windows",
+                    choices=["time_windows", "count_windows"])
+    ap.add_argument("--measurement-request-count", type=int, default=50,
+                    help="completions per window in count_windows mode")
+    ap.add_argument("--stability-percentage", type=float, default=10.0,
+                    help="windows agree within this pct (default 10)")
+    ap.add_argument("--max-trials", type=int, default=10,
+                    help="max windows per level before giving up stable")
+    ap.add_argument("-b", "--batch-size", type=int, default=1)
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="NAME:d1,d2,...",
+                    help="pin a dynamic input dim (repeatable)")
+    ap.add_argument("--input-const", action="append", default=[],
+                    metavar="NAME:value",
+                    help="fill an input with one fixed value instead "
+                         "of random data (control knobs like DELAY_US; "
+                         "repeatable)")
+    ap.add_argument("--input-pool", type=int, default=16,
+                    help="distinct random input sets rotated per context")
+    ap.add_argument("--max-outstanding", type=int, default=512,
+                    help="request-rate mode: backend executor/connection "
+                         "capacity (the open-loop depth before the "
+                         "schedule would queue client-side)")
+    ap.add_argument("--warmup", type=float, default=0.3,
+                    help="seconds of load before the first window")
+    ap.add_argument("--seed", type=int, default=0)
+    # generation mode
+    ap.add_argument("--generation", action="store_true",
+                    help="token-streaming mode: TTFT/ITL/tokens-sec")
+    ap.add_argument("--max-tokens", type=int, default=16,
+                    help="generation: tokens requested per stream")
+    ap.add_argument("--prompt-len", type=int, default=8,
+                    help="generation: synthetic prompt length")
+    # in-process server construction
+    ap.add_argument("--llama-slots", type=int, default=None,
+                    help="inprocess generation: continuous-batching "
+                         "slots (default: the max swept concurrency)")
+    # output
+    ap.add_argument("--csv", default=None, help="write CSV here")
+    ap.add_argument("--json", default=None,
+                    help="write JSON rows here (also printed to stdout)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    return ap
+
+
+def parse_shapes(entries):
+    shapes = {}
+    for entry in entries:
+        name, _, dims = entry.partition(":")
+        if not dims:
+            raise SystemExit(
+                "--shape wants NAME:d1,d2,... (got {!r})".format(entry))
+        shapes[name] = [int(d) for d in dims.split(",")]
+    return shapes
+
+
+def parse_consts(entries):
+    consts = {}
+    for entry in entries:
+        name, _, value = entry.partition(":")
+        if not value:
+            raise SystemExit(
+                "--input-const wants NAME:value (got {!r})".format(entry))
+        try:
+            consts[name] = int(value)
+        except ValueError:
+            try:
+                consts[name] = float(value)
+            except ValueError:
+                consts[name] = value
+    return consts
+
+
+def build_inprocess_core(args, levels):
+    """An in-process InferenceServer shaped for the requested profile
+    (the analogue of the reference's Triton C-API backend server)."""
+    from tpuserver.core import InferenceServer
+
+    if args.generation or args.model == "llama_generate":
+        from tpuserver.models import llama
+        from tpuserver.models.llama_serving import LlamaGenerateModel
+
+        slots = args.llama_slots or max(levels)
+        model = LlamaGenerateModel(
+            cfg=llama.tiny(vocab=256),
+            max_seq=max(64, args.prompt_len + args.max_tokens + 8),
+            max_slots=slots)
+        core = InferenceServer([model])
+        model.warmup()
+        return core
+    from tpuserver.models import default_models
+
+    return InferenceServer(default_models())
+
+
+def build_generation_pool(metadata, args):
+    """Prompt pool for generation mode: DISTINCT random prompts per
+    stream; MAX_TOKENS pinned from the CLI."""
+    import numpy as np
+
+    pool = []
+    for i in range(args.input_pool):
+        rng = np.random.RandomState(args.seed + i)
+        inputs = {}
+        for spec in metadata.get("inputs", []):
+            name = spec["name"]
+            if name.upper() == "MAX_TOKENS":
+                inputs[name] = np.array([args.max_tokens], dtype=np.int32)
+            elif any(int(d) < 0 for d in spec["shape"]):
+                # dynamic prompt axis: synthesize at --prompt-len with
+                # small ids (valid for every vocab the zoo uses)
+                inputs[name] = rng.randint(
+                    1, 200, size=(args.prompt_len,)).astype(np.int32)
+            else:
+                dims = [int(d) for d in spec["shape"]]
+                inputs[name] = rng.randint(
+                    1, 200, size=dims).astype(np.int32)
+        pool.append(inputs)
+    return pool
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    signal.signal(signal.SIGINT, _sigint_handler)
+
+    from perfanalyzer.client_backend import build_input_pool, create_backend
+    from perfanalyzer.generation import GenerationProfiler
+    from perfanalyzer.load_manager import (
+        ConcurrencyManager,
+        RequestRateManager,
+    )
+    from perfanalyzer.profiler import InferenceProfiler, parse_range
+    from perfanalyzer.report import ReportWriter
+
+    if args.concurrency_range and args.request_rate_range:
+        raise SystemExit(
+            "--concurrency-range and --request-rate-range are mutually "
+            "exclusive")
+    if args.generation and args.request_rate_range:
+        raise SystemExit(
+            "generation mode is concurrency-based (N worker streams); "
+            "--request-rate-range is not supported with --generation")
+    rate_mode = bool(args.request_rate_range)
+    levels = parse_range(
+        args.request_rate_range or args.concurrency_range or "1")
+
+    core = None
+    if args.backend == "inprocess":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        core = build_inprocess_core(args, levels)
+    backend = create_backend(
+        args.backend,
+        url=args.url,
+        urls=args.urls.split(",") if args.urls else None,
+        core=core,
+        # size the backend for the load it must carry: swept
+        # concurrency (closed loop) or the open-loop outstanding depth
+        max_inflight=(args.max_outstanding if rate_mode
+                      else max(levels)),
+    )
+
+    interval_s = args.measurement_interval / 1000.0
+    mode = ("generation" if args.generation
+            else "request_rate" if rate_mode else "concurrency")
+    print("*** Measurement Settings ***\n"
+          "  model: {}  backend: {}  mode: {}\n"
+          "  levels: {}  window: {} ms ({})  stability: {}% over 3 "
+          "windows, max {} trials".format(
+              args.model, args.backend, mode, levels,
+              args.measurement_interval, args.measurement_mode,
+              args.stability_percentage, args.max_trials), flush=True)
+    manager = None
+    try:
+        metadata = backend.model_metadata(args.model)
+        if args.generation:
+            profiler = GenerationProfiler(
+                backend, args.model,
+                build_generation_pool(metadata, args),
+                measurement_interval_s=interval_s,
+                stability_pct=args.stability_percentage,
+                max_trials=args.max_trials,
+                warmup_s=args.warmup,
+                early_exit=EARLY_EXIT,
+                verbose=args.verbose)
+        else:
+            config = backend.model_config(args.model)
+            pool = build_input_pool(
+                metadata, config,
+                pool_size=args.input_pool,
+                batch_size=args.batch_size,
+                shape_overrides=parse_shapes(args.shape),
+                const_overrides=parse_consts(args.input_const),
+                seed=args.seed)
+            prepared = backend.prepare(args.model, pool)
+            if rate_mode:
+                manager = RequestRateManager(
+                    backend, args.model, prepared,
+                    distribution=args.request_distribution,
+                    seed=args.seed)
+            else:
+                manager = ConcurrencyManager(
+                    backend, args.model, prepared)
+            profiler = InferenceProfiler(
+                backend, args.model, manager,
+                measurement_mode=args.measurement_mode,
+                measurement_interval_s=interval_s,
+                measurement_request_count=args.measurement_request_count,
+                stability_pct=args.stability_percentage,
+                max_trials=args.max_trials,
+                # open-loop latencies trend with queue depth by design;
+                # judge rate-mode stability on throughput alone (the
+                # reference's request-rate exemption)
+                check_latency_stability=not rate_mode,
+                warmup_s=args.warmup,
+                early_exit=EARLY_EXIT,
+                verbose=args.verbose)
+        results = profiler.sweep(levels)
+    finally:
+        if manager is not None:
+            manager.stop()
+        backend.close()
+        if core is not None:
+            core.close()
+
+    if not results:
+        print(json.dumps({"error": "no measurements completed"}),
+              flush=True)
+        return 1
+    writer = ReportWriter(
+        args.model, args.backend,
+        extra_tags={"early_exit": True} if EARLY_EXIT.is_set() else None)
+    writer.print_table(results)
+    print()
+    writer.print_json(results)
+    if args.csv:
+        writer.write_csv(args.csv, results)
+    if args.json:
+        writer.write_json(args.json, results)
+    unstable = [r["level"] for r in results if not r["stable"]]
+    if unstable and not EARLY_EXIT.is_set():
+        print("warning: levels {} never reached {}% stability within "
+              "{} trials; numbers reported from the last {} windows"
+              .format(unstable, args.stability_percentage,
+                      args.max_trials, 3),
+              file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
