@@ -3,10 +3,16 @@
 cache parked in a TPU (XLA) shared-memory region between requests — the
 LLM-shaped client of BASELINE config #5 (decoupled ModelStreamInfer +
 TPU-shm KV-handle passing; reference's closest analogue is
-simple_grpc_custom_repeat.py plus CUDA-shm tensor passing)."""
+simple_grpc_custom_repeat.py plus CUDA-shm tensor passing).
+
+Generation rides ``client.generate_stream``, the auto-resuming helper:
+if the stream connection drops mid-generation the client transparently
+re-opens it with a resume token and the server (a continuous-batching
+replica) replays the missed tokens and splices the continuation — no
+duplicated or missing tokens (docs/resilience.md, "Self-healing &
+stream resume")."""
 
 import argparse
-import queue
 import sys
 
 import numpy as np
@@ -15,28 +21,23 @@ import tritonclient.grpc as grpcclient
 from tritonclient.utils import xla_shared_memory as xshm
 
 
-def generate(client, responses, prompt, max_tokens, parameters=None):
+def generate(client, prompt, max_tokens, parameters=None):
     p_in = grpcclient.InferInput("PROMPT_IDS", [len(prompt)], "INT32")
     p_in.set_data_from_numpy(np.asarray(prompt, dtype=np.int32))
     m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
     m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
-    client.async_stream_infer(
-        "llama_generate", [p_in, m_in],
-        enable_empty_final_response=True, parameters=parameters,
-    )
     tokens = []
-    while True:
-        result, error = responses.get(timeout=600)
-        if error is not None:
-            print("stream error: " + str(error))
-            sys.exit(1)
-        response = result.get_response()
-        final = response.parameters.get("triton_final_response")
-        if final is not None and final.bool_param:
-            return tokens
+    # generate_stream auto-resumes a dropped connection (same endpoint);
+    # on_reconnect is just visibility into how bumpy the ride was
+    for result in client.generate_stream(
+            "llama_generate", [p_in, m_in], parameters=parameters,
+            on_reconnect=lambda attempt, exc: print(
+                "reconnect {} after: {}".format(attempt, exc),
+                flush=True)):
         token = int(result.as_numpy("TOKEN")[0])
         tokens.append(token)
         print("token:", token, flush=True)
+    return tokens
 
 
 def main():
@@ -46,9 +47,6 @@ def main():
     args = parser.parse_args()
 
     client = grpcclient.InferenceServerClient(args.url)
-    responses = queue.Queue()
-    client.start_stream(
-        callback=lambda result, error: responses.put((result, error)))
 
     kv = xshm.create_shared_memory_region("llama_kv_park", 16 << 20)
     client.register_xla_shared_memory(
@@ -58,14 +56,14 @@ def main():
         # first pass: prefill + generate, parking the finished KV cache
         # (which then holds prompt + the generated tokens)
         first = generate(
-            client, responses, prompt, args.max_tokens,
+            client, prompt, args.max_tokens,
             parameters={"kv_cache_region": "llama_kv_park"})
         # resumed pass: the parked cache already contains the history, so
         # send ONLY the new continuation tokens with the position the
         # cache was left at — no re-prefill of the earlier sequence
         follow_up = [2, 6]
         resumed = generate(
-            client, responses, follow_up, args.max_tokens,
+            client, follow_up, args.max_tokens,
             parameters={"kv_cache_region": "llama_kv_park",
                         "kv_cache_resume": True,
                         "kv_cache_position": len(prompt) + len(first)})
@@ -75,7 +73,6 @@ def main():
             print("FAILED: wrong token counts")
             sys.exit(1)
     finally:
-        client.stop_stream()
         client.unregister_xla_shared_memory("llama_kv_park")
         xshm.destroy_shared_memory_region(kv)
         client.close()

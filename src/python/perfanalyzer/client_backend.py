@@ -22,8 +22,6 @@ statistics, and — where the transport supports decoupled models —
 streams generations token-by-token for the generation profiler.
 """
 
-import json
-import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
@@ -132,11 +130,17 @@ class ClientBackend:
 
     # -- generation (decoupled streaming) ---------------------------------
 
-    def generate_stream(self, model, inputs, parameters=None):
+    def generate_stream(self, model, inputs, parameters=None, stats=None):
         """Generator yielding the token count of each streamed response
         as it arrives (1 for the llama TOKEN-per-response contract).
         The generation profiler timestamps each yield: first yield =
-        TTFT, gaps = inter-token latencies."""
+        TTFT, gaps = inter-token latencies.
+
+        ``stats`` (optional dict) receives per-stream bookkeeping the
+        profiler folds into its report: backends that transparently
+        reconnect+resume a dropped stream bump ``stats["resumes"]`` per
+        reconnect, so under-chaos runs surface degradation instead of
+        silently re-splicing broken streams."""
         raise NotImplementedError(
             "backend '{}' does not support generation mode".format(
                 self.kind))
@@ -233,7 +237,7 @@ class InProcessBackend(ClientBackend):
         except ServerError as e:
             raise BackendError(str(e)) from e
 
-    def generate_stream(self, model, inputs, parameters=None):
+    def generate_stream(self, model, inputs, parameters=None, stats=None):
         from tpuserver.core import InferRequest, ServerError
 
         req = InferRequest(model, inputs=dict(inputs),
@@ -290,54 +294,27 @@ class HttpBackend(ClientBackend):
         except InferenceServerException as e:
             raise BackendError(str(e)) from e
 
-    def generate_stream(self, model, inputs, parameters=None):
-        """POST /generate_stream and yield per SSE data event.
+    def generate_stream(self, model, inputs, parameters=None, stats=None):
+        """Stream over /generate_stream SSE via the client's resumable
+        path: a connection dropped mid-generation transparently
+        reconnects with ``Last-Event-ID`` and splices (same-endpoint
+        resume); every reconnect is counted into ``stats["resumes"]``
+        so chaos runs report ``resumed_streams`` instead of silently
+        hiding the degradation."""
+        from tritonclient.utils import InferenceServerException
 
-        Uses a raw ``http.client`` connection (not the pooled client):
-        SSE events must be surfaced as they arrive, which the pooled
-        request path — built around complete responses — cannot do.
-        """
-        import http.client
-        from urllib.parse import urlparse
+        def on_reconnect(attempt, exc):
+            if stats is not None:
+                stats["resumes"] = stats.get("resumes", 0) + 1
 
-        parsed = urlparse("http://" + self.url)
-        body = {
-            "inputs": [
-                {
-                    "name": name,
-                    "shape": list(arr.shape),
-                    "datatype": _np_wire_dtype(arr),
-                    "data": arr.reshape(-1).tolist(),
-                }
-                for name, arr in inputs.items()
-            ],
-        }
-        if parameters:
-            body["parameters"] = dict(parameters)
-        conn = http.client.HTTPConnection(
-            parsed.hostname, parsed.port, timeout=600)
         try:
-            conn.request(
-                "POST",
-                "/v2/models/{}/generate_stream".format(model),
-                json.dumps(body),
-                {"Content-Type": "application/json"},
-            )
-            resp = conn.getresponse()
-            if resp.status != 200:
-                raise BackendError(
-                    "generate_stream HTTP {}: {}".format(
-                        resp.status, resp.read()[:512]))
-            for line in resp:
-                line = line.strip()
-                if not line.startswith(b"data: "):
-                    continue
-                event = json.loads(line[len(b"data: "):])
-                if "error" in event:
-                    raise BackendError(event["error"])
+            for event in self.client.generate_stream(
+                    model, dict(inputs),
+                    parameters=dict(parameters or {}),
+                    on_reconnect=on_reconnect):
                 yield _response_token_count(event.get("outputs"))
-        finally:
-            conn.close()
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
 
     def close(self):
         super().close()
@@ -428,37 +405,32 @@ class GrpcBackend(ClientBackend):
         except Exception:  # noqa: BLE001 — teardown best-effort
             pass
 
-    def generate_stream(self, model, inputs, parameters=None):
+    def generate_stream(self, model, inputs, parameters=None, stats=None):
+        """Decoupled bidi stream via the client's resumable path: a
+        stream-level drop re-opens the stream with a resume token and
+        splices (same-endpoint resume); reconnects are counted into
+        ``stats["resumes"]`` for the profiler's ``resumed_streams``."""
         from tritonclient.utils import InferenceServerException
 
         client = self._thread_client()
         prepared = self._prepare_one(model, inputs)[1]
-        responses = queue.Queue()
-        client.start_stream(
-            lambda result, error: responses.put((result, error)))
+
+        def on_reconnect(attempt, exc):
+            if stats is not None:
+                stats["resumes"] = stats.get("resumes", 0) + 1
+
         try:
-            client.async_stream_infer(
-                model, prepared, enable_empty_final_response=True,
-                parameters=dict(parameters) if parameters else None)
-            while True:
-                result, error = responses.get(timeout=600)
-                if error is not None:
-                    raise BackendError(str(error))
+            for result in client.generate_stream(
+                    model, prepared,
+                    parameters=dict(parameters) if parameters else None,
+                    on_reconnect=on_reconnect):
                 resp = result.get_response()
-                final = resp.parameters.get("triton_final_response")
-                if final is not None and final.bool_param:
-                    return
                 yield _response_token_count([
                     {"name": out.name, "shape": list(out.shape)}
                     for out in resp.outputs
                 ])
         except InferenceServerException as e:
             raise BackendError(str(e)) from e
-        finally:
-            try:
-                client.stop_stream(cancel_requests=True)
-            except Exception:  # noqa: BLE001 — teardown best-effort
-                pass
 
     def close(self):
         super().close()

@@ -35,6 +35,8 @@ class _GenCollector:
         self._itls = []
         self._generations = 0
         self._errors = 0
+        self._resumed_streams = 0
+        self._resume_events = 0
 
     def start_window(self):
         with self._lock:
@@ -50,6 +52,8 @@ class _GenCollector:
                 "itls_s": self._itls,
                 "generations": self._generations,
                 "errors": self._errors,
+                "resumed_streams": self._resumed_streams,
+                "resume_events": self._resume_events,
             }
 
     def record_tokens(self, count):
@@ -61,11 +65,18 @@ class _GenCollector:
         with self._lock:
             return self._lifetime_generations
 
-    def record_generation(self, ttft_s, itls_s, error):
+    def record_generation(self, ttft_s, itls_s, error, resumes=0):
         with self._lock:
             self._lifetime_generations += 1
             if not self._open:
                 return
+            if resumes:
+                # a stream that reconnected mid-generation is counted
+                # even when it ultimately errored: under-chaos perf runs
+                # must surface the degradation, not hide it behind the
+                # transparent splice
+                self._resumed_streams += 1
+                self._resume_events += resumes
             if error is not None:
                 self._errors += 1
                 return
@@ -133,9 +144,11 @@ class GenerationProfiler:
                 prev = None
                 itls = []
                 error = None
+                stream_stats = {}
                 try:
                     for count in self.backend.generate_stream(
-                            self.model, inputs, self.parameters):
+                            self.model, inputs, self.parameters,
+                            stats=stream_stats):
                         now = time.perf_counter()
                         if ttft is None:
                             ttft = now - t0
@@ -147,7 +160,9 @@ class GenerationProfiler:
                     # never die silently mid-profile; the error (typed
                     # BackendError or not) is counted
                     error = e
-                self.collector.record_generation(ttft, itls, error)
+                self.collector.record_generation(
+                    ttft, itls, error,
+                    resumes=stream_stats.get("resumes", 0))
         finally:
             self.backend.release_thread_resources()
 
@@ -256,6 +271,12 @@ class GenerationProfiler:
             generations=generations,
             gen_per_sec=generations / duration if duration > 0 else 0.0,
             errors=errors,
+            # streams that transparently reconnected+resumed mid-
+            # generation (and the raw reconnect count): nonzero under
+            # chaos means the transport is degrading even when every
+            # token was ultimately delivered
+            resumed_streams=sum(w["resumed_streams"] for w in merged),
+            resume_events=sum(w["resume_events"] for w in merged),
             duration_s=duration,
         )
         for prefix, sample in (("ttft", ttfts), ("itl", itls)):

@@ -153,6 +153,33 @@ class ShuttingDown(ServerError):
         super().__init__(msg, code=503, retry_after=retry_after)
 
 
+class SlotQuarantined(ServerError):
+    """The request's own generation poisoned its decode slot
+    (non-finite logits) and was quarantined; co-batched generations are
+    unaffected — HTTP 422 / gRPC INVALID_ARGUMENT.  NOT retryable: the
+    request, not the server, is at fault."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=422)
+
+
+class UnknownGeneration(ServerError):
+    """A stream-resume request named a generation id this replica does
+    not hold (never issued, already resumed, or aged out of the replay
+    buffer) — HTTP 404 / gRPC NOT_FOUND.  Resume is same-endpoint only:
+    generation replay state is replica-local."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=404)
+
+
+#: Reserved key a decoupled model may include in a yielded output dict
+#: to attach per-response parameters (e.g. the generation id and token
+#: sequence number resumable streams carry on the wire); popped before
+#: the dict is interpreted as output tensors.
+RESPONSE_PARAMS_KEY = "__response_parameters__"
+
+
 class Model:
     """Base model: subclasses define specs and ``execute``.
 
@@ -1383,8 +1410,14 @@ class InferenceServer:
                 # client has stopped waiting
                 self._check_deadline(request.deadline)
                 count += 1
+                extra_params = None
+                if RESPONSE_PARAMS_KEY in out:
+                    out = dict(out)
+                    extra_params = out.pop(RESPONSE_PARAMS_KEY)
                 resp = self._make_response(model, request, out,
                                            mark_final=False)
+                if extra_params:
+                    resp.parameters.update(extra_params)
                 if want_final:
                     resp.parameters["triton_final_response"] = False
                 yield resp
@@ -1394,11 +1427,14 @@ class InferenceServer:
                 raise
             # the scheduler's typed failures keep their meaning on the
             # wire: deadline -> 504, admission-full -> 429
-            # (+Retry-After), closed/draining -> 503 — instead of the
-            # generic 500 wrap
+            # (+Retry-After), closed/draining -> 503, quarantined slot
+            # -> 422, unknown resume id -> 404 — instead of the generic
+            # 500 wrap
             for sched_exc, wrapper in (
                 (_scheduler.DeadlineExceeded, DeadlineExceeded),
                 (_scheduler.AdmissionQueueFull, Overloaded),
+                (_scheduler.SlotQuarantined, SlotQuarantined),
+                (_scheduler.UnknownGeneration, UnknownGeneration),
                 (_scheduler.SchedulerClosed, ShuttingDown),
             ):
                 if isinstance(e, sched_exc):

@@ -12,17 +12,34 @@ hooks let tests force the failure paths on demand.
 
 Registered injection points:
 
-==================  ========================================================
-``scheduler.step``   before each batched decode-step dispatch
-                     (``mode="raise"`` = decode-step failure, the donated
-                     cache/logits recovery path; ``mode="sleep"`` = slow
-                     step, for deadline/overload pressure)
-``scheduler.fetch``  before the device->host token transfer of a completed
-                     step (host-transfer failure)
-``scheduler.admit``  before a prefill-on-admit (admission failure: the
-                     request fails, other slots keep decoding)
-``core.shm_read``    before a shared-memory input read (shm read error)
-==================  ========================================================
+==========================  ================================================
+``scheduler.step``           before each batched decode-step dispatch
+                             (``mode="raise"`` = decode-loop death, the
+                             supervised-restart path; ``mode="sleep"`` =
+                             slow step, for deadline/overload pressure;
+                             ``mode="hang"`` = a step stall long enough to
+                             trip the hung-step watchdog; ``mode="nan"`` =
+                             poison one slot's logits row with NaN — the
+                             per-slot quarantine path.  For ``nan`` the
+                             ``delay`` field is reused as the slot index
+                             to poison)
+``scheduler.fetch``          before the device->host token transfer of a
+                             completed step (host-transfer failure —
+                             handled as loop death / supervised restart)
+``scheduler.admit``          before a prefill-on-admit (admission failure:
+                             the request fails, other slots keep decoding)
+``core.shm_read``            before a shared-memory input read
+``http.generate_stream``     before each SSE event write of
+                             ``/generate_stream`` (``raise`` = sever the
+                             connection mid-stream, no terminal chunk —
+                             drives client auto-resume end-to-end)
+``grpc.stream_infer``        before each ModelStreamInfer response yield
+                             (``raise`` = kill the bidi stream mid-flight)
+==========================  ================================================
+
+``install(..., skip=N)`` lets the first ``N`` passes through an armed
+point succeed before it starts firing — the knob chaos tests use to
+drop a connection *mid*-stream rather than before the first token.
 
 **Scopes** (multi-replica chaos): several in-process servers share this
 process-global registry, so a point armed with ``scope="replica-b"``
@@ -60,13 +77,14 @@ class FaultInjected(RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("name", "mode", "remaining", "delay", "fired", "scope")
+    __slots__ = ("name", "mode", "remaining", "delay", "fired", "scope",
+                 "skip")
 
-    def __init__(self, name, mode, times, delay, scope=None):
-        if mode not in ("raise", "sleep"):
+    def __init__(self, name, mode, times, delay, scope=None, skip=0):
+        if mode not in ("raise", "sleep", "hang", "nan"):
             raise ValueError(
-                "fault mode must be 'raise' or 'sleep' (got {!r})".format(
-                    mode)
+                "fault mode must be 'raise', 'sleep', 'hang' or 'nan' "
+                "(got {!r})".format(mode)
             )
         self.name = name
         self.mode = mode
@@ -74,19 +92,25 @@ class _Fault:
         self.delay = float(delay)
         self.fired = 0
         self.scope = scope
+        self.skip = int(skip)
 
 
 _lock = threading.Lock()
 _points = {}  # (name, scope) -> _Fault
 
 
-def install(name, mode="raise", times=1, delay=0.0, scope=None):
+def install(name, mode="raise", times=1, delay=0.0, scope=None, skip=0):
     """Arm injection point ``name``: the next ``times`` fires raise
-    (``mode="raise"``) or sleep ``delay`` seconds (``mode="sleep"``).
-    ``times=-1`` keeps the point armed until :func:`clear`.  With a
-    ``scope``, only :func:`fire` calls carrying that scope trip the
-    point (per-replica chaos); scope None matches every firer."""
-    fault = _Fault(name, mode, times, delay, scope)
+    (``mode="raise"``), sleep ``delay`` seconds inside fire()
+    (``mode="sleep"``), or hand the site an action to implement —
+    ``mode="nan"`` poisons the logits row of slot ``int(delay)`` and
+    ``mode="hang"`` stalls ``delay`` seconds inside the site's
+    watchdog-heartbeat window (see :func:`fire`).  ``times=-1`` keeps
+    the point armed until :func:`clear`.  ``skip`` lets the first N
+    passes through succeed before firing starts (mid-stream chaos).
+    With a ``scope``, only :func:`fire` calls carrying that scope trip
+    the point (per-replica chaos); scope None matches every firer."""
+    fault = _Fault(name, mode, times, delay, scope, skip=skip)
     with _lock:
         _points[(name, scope)] = fault
     return fault
@@ -140,24 +164,35 @@ def fire(name, scope=None):
 
     ``scope`` identifies the firing replica (see module docstring);
     scope-less armings match every firer.  Raises
-    :class:`FaultInjected` (mode ``raise``) or sleeps (mode ``sleep``)
-    and decrements the point's remaining count.  The sleep happens
-    OUTSIDE the registry lock so a slow point never blocks
-    arming/disarming other points.
+    :class:`FaultInjected` (mode ``raise``), sleeps (mode ``sleep``),
+    or returns an action tuple the site must implement — mode ``nan``
+    returns ``("nan", slot_index)`` (the scheduler's step site poisons
+    that slot's logits row) and mode ``hang`` returns
+    ``("hang", seconds)`` (the step site sleeps AFTER stamping its
+    watchdog heartbeat: a sleep inside fire() would stall *before* the
+    heartbeat exists and the hung-step watchdog could never observe
+    it; sites that don't implement ``hang`` ignore it).  Returns None
+    for untripped passes.  The sleep happens OUTSIDE the registry lock
+    so a slow point never blocks arming/disarming other points.
     """
     if not _points:  # fast path: nothing armed anywhere
-        return
+        return None
     with _lock:
         fault = _lookup(name, scope)
         if fault is None or fault.remaining == 0:
-            return
+            return None
+        if fault.skip > 0:
+            fault.skip -= 1
+            return None
         if fault.remaining > 0:
             fault.remaining -= 1
         fault.fired += 1
         mode, delay = fault.mode, fault.delay
     if mode == "sleep":
         time.sleep(delay)
-        return
+        return None
+    if mode in ("nan", "hang"):
+        return (mode, int(delay) if mode == "nan" else delay)
     raise FaultInjected(name)
 
 
@@ -168,14 +203,17 @@ class injected:
     ...     # the next decode step raises FaultInjected
     """
 
-    def __init__(self, name, mode="raise", times=1, delay=0.0, scope=None):
+    def __init__(self, name, mode="raise", times=1, delay=0.0, scope=None,
+                 skip=0):
         self._name = name
         self._scope = scope
+        self._skip = skip
         self._args = (mode, times, delay)
         self.fault = None
 
     def __enter__(self):
-        self.fault = install(self._name, *self._args, scope=self._scope)
+        self.fault = install(self._name, *self._args, scope=self._scope,
+                             skip=self._skip)
         return self.fault
 
     def __exit__(self, exc_type, exc, tb):

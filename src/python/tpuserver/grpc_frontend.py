@@ -478,10 +478,17 @@ class _CoreBridge:
 
         _threading.Thread(target=feed, daemon=True).start()
         try:
+            from tpuserver import faults as _faults
+
             while True:
                 item = out.get()
                 if item is _SENTINEL:
                     return
+                # chaos hook: kill the bidi stream mid-flight (the
+                # raised FaultInjected aborts the RPC with a stream-
+                # level error) so client reconnect+resume is drivable
+                # end-to-end; skip=N drops after the Nth response
+                _faults.fire("grpc.stream_infer", self._core.fault_scope)
                 yield item
         finally:
             # reader gone (cancel/deadline/exit): release producers and
@@ -518,6 +525,7 @@ def _status_code(http_code):
     return {
         400: grpc.StatusCode.INVALID_ARGUMENT,
         404: grpc.StatusCode.NOT_FOUND,
+        422: grpc.StatusCode.INVALID_ARGUMENT,  # quarantined slot
         429: grpc.StatusCode.RESOURCE_EXHAUSTED,
         500: grpc.StatusCode.INTERNAL,
         501: grpc.StatusCode.UNIMPLEMENTED,

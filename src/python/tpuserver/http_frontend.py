@@ -67,6 +67,7 @@ _STATUS_LINE = {
     400: b"HTTP/1.1 400 Bad Request\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
     405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
     429: b"HTTP/1.1 429 Too Many Requests\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",
     503: b"HTTP/1.1 503 Service Unavailable\r\n",
@@ -169,6 +170,8 @@ class _Handler(socketserver.StreamRequestHandler):
             self._send_error_json(str(e), e.code, headers)
         except ValueError as e:
             self._send_error_json("malformed request: {}".format(e), 400)
+        except (BrokenPipeError, ConnectionResetError):
+            raise  # dead socket (incl. injected drops): handle() ends it
         except Exception as e:  # pragma: no cover
             self._send_error_json("internal error: {}".format(e), 500)
 
@@ -463,6 +466,25 @@ class _Handler(socketserver.StreamRequestHandler):
         body = self._read_body()
         request_json = json.loads(body)
         parameters = dict(request_json.get("parameters", {}))
+        if stream:
+            # SSE-standard reconnection: a client that lost its
+            # connection re-POSTs the same body with Last-Event-ID
+            # "<generation_id>/<seq>"; the scheduler replays from
+            # seq + 1 and splices the live continuation
+            last_id = self.headers.get("Last-Event-ID")
+            if last_id:
+                # LAST slash: a client-chosen generation_id may itself
+                # contain '/' (e.g. "tenant/abc"); the seq is always
+                # the final segment
+                gen_id, sep, seq = last_id.rpartition("/")
+                if sep and gen_id:
+                    try:
+                        parameters.setdefault(
+                            "resume_from_seq", int(seq) + 1)
+                        parameters.setdefault(
+                            "resume_generation_id", gen_id)
+                    except ValueError:
+                        pass  # malformed id: treat as a fresh request
         inputs = {}
         for tin in request_json.get("inputs", []):
             datatype = tin.get("datatype")
@@ -526,17 +548,42 @@ class _Handler(socketserver.StreamRequestHandler):
         # SSE over chunked transfer: the stream must start before the
         # generation finishes, so errors after the first token arrive
         # in-band as an {"error": ...} event (the status line is gone)
+        from tpuserver import faults as _faults
+
         started = False
         try:
             for resp in core.infer_stream(request):
                 if not started:
                     self._send_stream_start("text/event-stream")
                     started = True
+                payload = response_json(resp)
+                event = b""
+                if resp.parameters:
+                    wire = {k: v for k, v in resp.parameters.items()
+                            if not k.startswith("triton_")}
+                    if wire:
+                        payload["parameters"] = wire
+                    gen_id = resp.parameters.get("generation_id")
+                    seq = resp.parameters.get("seq")
+                    if gen_id is not None and seq is not None:
+                        # the SSE id the browser/client hands back as
+                        # Last-Event-ID on reconnect
+                        event += "id: {}/{}\n".format(
+                            gen_id, seq).encode("utf-8")
+                # chaos hook: sever the connection mid-stream (no
+                # terminal chunk) so client auto-resume is drivable
+                # end-to-end; skip=N drops after the Nth event
+                _faults.fire("http.generate_stream", core.fault_scope)
                 self._send_chunk(
-                    b"data: "
-                    + json.dumps(response_json(resp)).encode("utf-8")
+                    event + b"data: "
+                    + json.dumps(payload).encode("utf-8")
                     + b"\n\n"
                 )
+        except _faults.FaultInjected:
+            try:
+                self.connection.close()
+            finally:
+                raise BrokenPipeError("injected mid-stream disconnect")
         except ServerError as e:
             if not started:
                 raise
@@ -544,8 +591,17 @@ class _Handler(socketserver.StreamRequestHandler):
                 b"data: " + json.dumps({"error": str(e)}).encode("utf-8")
                 + b"\n\n"
             )
+            self._end_chunks()
+            return
         if not started:
             self._send_stream_start("text/event-stream")
+        # explicit terminal event: a premature TCP close mid-chunked
+        # stream is NOT reliably distinguishable from a clean end by
+        # every HTTP client (stdlib line iteration just stops), so
+        # completion is in-band — a stream that ends WITHOUT this
+        # marker (or an error event) was dropped, and resuming clients
+        # reconnect with Last-Event-ID
+        self._send_chunk(b'data: {"final": true}\n\n')
         self._end_chunks()
 
     # -- inference --------------------------------------------------------

@@ -72,7 +72,10 @@ class LlamaGenerateModel(Model):
 
     def __init__(self, cfg=None, max_seq=512, server=None,
                  decode_chunk=None, mesh=None, quantize=False,
-                 max_slots=1, max_pending=None, fault_scope=None):
+                 max_slots=1, max_pending=None, fault_scope=None,
+                 step_timeout_s=None, max_restarts=5,
+                 restart_window_s=60.0, restart_backoff_s=0.05,
+                 replay_ttl_s=60.0, replay_capacity=256):
         self._cfg = cfg or llama.tiny(vocab=2048)
         # replica identity threaded to the scheduler's fault-injection
         # points (multi-replica chaos harnesses)
@@ -90,6 +93,14 @@ class LlamaGenerateModel(Model):
                 "max_slots must be >= 1 (got {})".format(max_slots))
         self._max_slots = int(max_slots)
         self._max_pending = max_pending  # admission-queue bound override
+        # supervisor / replay-buffer knobs, threaded to DecodeScheduler
+        # (docs/resilience.md "Self-healing & stream resume")
+        self._step_timeout_s = step_timeout_s
+        self._max_restarts = max_restarts
+        self._restart_window_s = restart_window_s
+        self._restart_backoff_s = restart_backoff_s
+        self._replay_ttl_s = replay_ttl_s
+        self._replay_capacity = replay_capacity
         self._scheduler = None  # DecodeScheduler when max_slots > 1
         # continuous-batching models interleave many streams' responses;
         # the frontends must not serialize their stream requests
@@ -156,6 +167,12 @@ class LlamaGenerateModel(Model):
                         fns, params, self._max_slots, self._max_seq,
                         max_pending=self._max_pending,
                         fault_scope=self._fault_scope,
+                        step_timeout_s=self._step_timeout_s,
+                        max_restarts=self._max_restarts,
+                        restart_window_s=self._restart_window_s,
+                        restart_backoff_s=self._restart_backoff_s,
+                        replay_ttl_s=self._replay_ttl_s,
+                        replay_capacity=self._replay_capacity,
                     )
                 elif self._mesh is not None:
                     init_cache, prefill_fn, chunk_fn = (
@@ -384,50 +401,93 @@ class LlamaGenerateModel(Model):
 
     def _execute_scheduled(self, prompt, max_tokens, eos_id, request):
         """Continuous-batching path: submit to the shared decode loop and
-        fan its per-step tokens back out to this stream."""
+        fan its per-step tokens back out to this stream.
+
+        Every generation here is *resumable*: it gets an id (the
+        ``generation_id`` request parameter, or a fresh uuid) and every
+        response carries ``generation_id`` + a 0-based ``seq`` in its
+        response parameters (SSE ``id:`` lines / gRPC response fields).
+        A request carrying ``resume_generation_id`` (+
+        ``resume_from_seq``, the first sequence number not yet seen)
+        instead continues a parked generation: buffered tokens replay
+        first, then live tokens splice in — no duplicates, no gaps.
+        Resume is same-endpoint only (replay state is replica-local)."""
+        import uuid
+
         import jax.numpy as jnp
 
-        region = self._kv_region(request)
-        parked, pos = self._resume_state(request, region)
-        # the pos+prompt+max_tokens overflow check lives in
-        # DecodeScheduler.submit — one copy, same wording as this
-        # class's single-stream path
-        on_finish = None
-        if region is not None:
-            def on_finish(cache_rows):
-                # the slot's rows in the single-stream park shape, so a
-                # later request may resume on either path
-                region.put_device_array(0, cache_rows)
+        from tpuserver.core import RESPONSE_PARAMS_KEY
+        from tpuserver.scheduler import SchedulerClosed
 
         scheduler = self._scheduler
         if scheduler is None:
             # close() nulled the scheduler after this request was
             # admitted: same typed outcome as racing submit into it
-            from tpuserver.scheduler import SchedulerClosed
-
             raise SchedulerClosed("scheduler is shut down")
-        stream = scheduler.submit(
-            prompt, max_tokens, eos_id=eos_id,
-            resume_cache=jnp.asarray(parked) if parked is not None else None,
-            resume_pos=pos, on_finish=on_finish,
-            # the deadline the core resolved (timeout parameter / gRPC
-            # context): the scheduler expires pending admissions before
-            # prefill and retires in-flight slots past it
-            deadline=getattr(request, "deadline", None),
-        )
+
+        resume_id = request.parameters.get("resume_generation_id")
+        if resume_id:
+            from_seq = int(request.parameters.get("resume_from_seq", 0))
+            gen_id = str(resume_id)
+            # the reconnect's OWN deadline governs the continuation —
+            # the original request's bound died with its connection
+            stream = scheduler.resume(
+                gen_id, from_seq,
+                deadline=getattr(request, "deadline", None))
+            seq = from_seq
+        else:
+            region = self._kv_region(request)
+            parked, pos = self._resume_state(request, region)
+            # the pos+prompt+max_tokens overflow check lives in
+            # DecodeScheduler.submit — one copy, same wording as this
+            # class's single-stream path
+            on_finish = None
+            if region is not None:
+                def on_finish(cache_rows):
+                    # the slot's rows in the single-stream park shape,
+                    # so a later request may resume on either path
+                    region.put_device_array(0, cache_rows)
+
+            gen_id = str(request.parameters.get("generation_id")
+                         or uuid.uuid4().hex)
+            stream = scheduler.submit(
+                prompt, max_tokens, eos_id=eos_id,
+                resume_cache=(jnp.asarray(parked)
+                              if parked is not None else None),
+                resume_pos=pos, on_finish=on_finish,
+                # the deadline the core resolved (timeout parameter /
+                # gRPC context): the scheduler expires pending
+                # admissions before prefill and retires in-flight slots
+                # past it
+                deadline=getattr(request, "deadline", None),
+                generation_id=gen_id,
+            )
+            seq = 0
         for token, logprob in stream:
             yield {
                 "TOKEN": np.array([token], dtype=np.int32),
                 "LOGPROB": np.array([logprob], dtype=np.float32),
+                RESPONSE_PARAMS_KEY: {
+                    "generation_id": gen_id, "seq": seq,
+                },
             }
+            seq += 1
 
     def healthy(self):
-        """Readiness probe hook: False once the decode loop's watchdog
-        has tripped or the scheduler is closed (``InferenceServer
-        .server_ready``/``model_ready`` report it).  Bound once: a
-        concurrent close() nulls ``_scheduler`` between reads."""
+        """Readiness probe hook: False once the decode loop tripped
+        permanently (restart budget exhausted) or the scheduler is
+        closed (``InferenceServer.server_ready``/``model_ready`` report
+        it).  Bound once: a concurrent close() nulls ``_scheduler``
+        between reads."""
         scheduler = self._scheduler
         return scheduler is None or scheduler.healthy
+
+    def scheduler_stats(self):
+        """The decode scheduler's ``stats()`` dict (restart and
+        quarantine counters ops alert on), or None before first use /
+        in single-stream mode."""
+        scheduler = self._scheduler
+        return scheduler.stats() if scheduler is not None else None
 
     def drain(self, timeout=30.0):
         """Stop admission and let in-flight generations finish within

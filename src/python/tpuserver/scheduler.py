@@ -46,11 +46,38 @@ Greedy per-row math in the batched step is identical to the
 single-stream ``decode_step``'s, so N interleaved streams produce
 token-identical output to N sequential single-stream runs
 (test-enforced in tests/test_continuous_batching.py).
+
+Self-healing (tests/test_self_healing.py, docs/resilience.md):
+
+- **Per-slot quarantine.**  A slot whose own step output is poisoned
+  (non-finite logprob — NaN logits from a poison request) retires with
+  a typed :class:`SlotQuarantined` while every co-batched slot keeps
+  decoding; greedy tokens of the survivors are byte-identical to a
+  fault-free run (the batched step's math is row-independent).
+- **Supervised restart.**  The decode thread runs under a supervisor:
+  an unattributable step/fetch failure kills the loop, and the
+  supervisor rebuilds device state and *re-admits* every live stream by
+  re-prefilling ``prompt + tokens_emitted_so_far`` (greedy decode is
+  deterministic, so the continuation is token-identical), under a
+  bounded restart budget with exponential backoff.  A hung-step
+  watchdog (``step_timeout_s``) treats a wedged device dispatch the
+  same way, demoting the stuck thread via an epoch counter so a waking
+  zombie can never double-deliver into re-admitted streams.  Budget
+  exhausted ⇒ the scheduler trips permanently: unhealthy to readiness
+  probes (pools rotate the replica out), every stream failed typed,
+  new submits rejected, drain/close still deterministic.
+- **Resumable generations.**  ``submit(generation_id=...)`` records
+  every emitted ``(token, logprob)``; a disconnected (or completed)
+  generation parks in a bounded, TTL'd replay buffer and
+  :meth:`DecodeScheduler.resume` replays ``history[from_seq:]`` then
+  splices live tokens from a re-admitted continuation — no duplicated
+  or missing tokens.  Replay state is replica-local: resume is
+  same-endpoint only.
 """
 
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
 import numpy as np
 
@@ -74,17 +101,31 @@ class DeadlineExceeded(Exception):
     slot retires and frees immediately)."""
 
 
+class SlotQuarantined(Exception):
+    """Raised into a stream whose OWN decode output was poisoned
+    (non-finite logits — e.g. a NaN-producing prompt): only the
+    offending slot retires; co-batched streams keep decoding untouched.
+    Frontends map it to HTTP 422 / gRPC INVALID_ARGUMENT — the request
+    is at fault, not the server, so clients must not blind-retry it."""
+
+
+class UnknownGeneration(Exception):
+    """Raised by :meth:`DecodeScheduler.resume` for a generation id that
+    was never issued, already resumed, or aged out of the replay buffer
+    (TTL/capacity) — HTTP 404 / gRPC NOT_FOUND."""
+
+
 class _Stream:
     """One in-flight generation bound to a cache slot."""
 
     __slots__ = (
         "prompt", "max_tokens", "eos_id", "queue", "forced", "pos",
         "emitted", "on_finish", "resume_cache", "resume_pos", "finished",
-        "cancelled", "deadline",
+        "cancelled", "deadline", "generation_id", "history", "incarnation",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
-                 resume_pos, on_finish, deadline=None):
+                 resume_pos, on_finish, deadline=None, generation_id=None):
         import queue as _queue
 
         self.prompt = prompt
@@ -100,9 +141,22 @@ class _Stream:
         self.finished = False   # terminal queue event delivered
         self.cancelled = False  # consumer abandoned the token iterator
         self.deadline = deadline  # time.monotonic() bound, or None
+        self.generation_id = generation_id  # resumable when set
+        # every emitted (token, logprob): the replay buffer for
+        # client resume AND the re-admission feed for supervised restart
+        self.history = []
+        # bumped on every admission: step snapshots record it, so a
+        # pipelined step dispatched for a PREVIOUS admission of this
+        # same stream (cancelled, parked, resumed, re-admitted into the
+        # same slot) can never deliver its stale token
+        self.incarnation = 0
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
+
+
+class _HungStep(Exception):
+    """Internal: the watchdog's synthesized loop-death cause."""
 
 
 class DecodeScheduler:
@@ -114,10 +168,20 @@ class DecodeScheduler:
     per-slot logits are threaded (and donated) through its dispatches,
     so frontend threads never touch the device: they block on per-stream
     queues that the loop fans tokens into.
+
+    A supervisor thread watches the loop: loop death (an unattributable
+    step/fetch failure) restarts it with live streams re-admitted
+    (``max_restarts`` per ``restart_window_s``, exponential backoff from
+    ``restart_backoff_s``); a step stalled past ``step_timeout_s``
+    (None = watchdog off; leave it off, or warm up first, where the
+    first step's XLA compile could exceed it) is treated the same.
+    Budget exhausted ⇒ permanent trip (unhealthy + typed failures).
     """
 
     def __init__(self, fns, params, max_slots, max_seq, max_pending=None,
-                 fault_scope=None):
+                 fault_scope=None, step_timeout_s=None, max_restarts=5,
+                 restart_window_s=60.0, restart_backoff_s=0.05,
+                 replay_ttl_s=60.0, replay_capacity=256):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
@@ -137,12 +201,36 @@ class DecodeScheduler:
         self._max_pending = (
             max_pending if max_pending is not None else max(32, 8 * max_slots)
         )
+        self._step_timeout_s = step_timeout_s
+        self._max_restarts = int(max_restarts)
+        self._restart_window_s = float(restart_window_s)
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._replay_ttl_s = float(replay_ttl_s)
+        self._replay_capacity = int(replay_capacity)
         self._cond = threading.Condition()
         self._pending = deque()
         self._thread = None
+        self._supervisor = None
         self._closed = False
         self._draining = False
-        self._tripped = False  # decode loop died unexpectedly (watchdog)
+        self._tripped = False  # restart budget exhausted: permanent
+        # epoch demotes superseded (wedged) loop threads: every delivery
+        # into stream queues checks it under _cond, so a zombie waking
+        # after a watchdog restart can never double-emit into a stream
+        # the new loop re-admitted
+        self._epoch = 0
+        # (epoch, monotonic start) of the current device op, or None —
+        # epoch-tagged so a demoted zombie's stale stamps can neither
+        # trip the watchdog against a healthy successor loop nor erase
+        # the successor's own beat
+        self._heartbeat = None
+        self._loop_error = None  # set by a dying loop for the supervisor
+        self._restarts = 0       # lifetime count (stats/ops)
+        self._recent_restarts = deque()  # timestamps inside the window
+        self._quarantined = 0    # lifetime SlotQuarantined count
+        # generation_id -> (stream, completed, expires_monotonic):
+        # the bounded, TTL'd replay buffer for client resume
+        self._replay = OrderedDict()
         # every live (not yet terminally-delivered) stream, pending or
         # slotted: close() fails exactly this set when the loop cannot
         # (join timeout), and drain() waits on it emptying
@@ -151,7 +239,8 @@ class DecodeScheduler:
     # -- frontend side -----------------------------------------------------
 
     def submit(self, prompt, max_tokens, eos_id=None, resume_cache=None,
-               resume_pos=0, on_finish=None, deadline=None):
+               resume_pos=0, on_finish=None, deadline=None,
+               generation_id=None):
         """Enqueue one generation; returns an iterator of
         ``(token, logprob)`` pairs that blocks as the decode loop
         produces them.
@@ -162,7 +251,11 @@ class DecodeScheduler:
         the park hook.  ``deadline`` is a ``time.monotonic()`` bound:
         past it, a still-pending request fails before prefill and an
         in-flight one retires mid-generation, both with
-        :class:`DeadlineExceeded`."""
+        :class:`DeadlineExceeded`.  ``generation_id`` makes the
+        generation *resumable*: its tokens are retained in the replay
+        buffer after disconnect or completion and
+        :meth:`resume` continues it with no duplicated or missing
+        tokens."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("PROMPT_IDS must be non-empty")
@@ -176,10 +269,15 @@ class DecodeScheduler:
             )
         stream = _Stream(prompt, int(max_tokens), eos_id,
                          resume_cache, int(resume_pos), on_finish,
-                         deadline=deadline)
+                         deadline=deadline, generation_id=generation_id)
         with self._cond:
             if self._closed:
                 raise SchedulerClosed("scheduler is shut down")
+            if self._tripped:
+                raise SchedulerClosed(
+                    "decode loop restart budget exhausted; the scheduler "
+                    "is tripped — drain and restart the replica"
+                )
             if self._draining:
                 raise SchedulerClosed(
                     "scheduler is draining; not accepting new generations"
@@ -189,16 +287,120 @@ class DecodeScheduler:
                     "scheduler admission queue is full ({} waiting "
                     "generations); retry later".format(len(self._pending))
                 )
+            if generation_id is not None:
+                # a reused id supersedes any parked predecessor
+                self._replay.pop(generation_id, None)
             self._pending.append(stream)
             self._streams.add(stream)
-            if self._thread is None or not self._thread.is_alive():
-                self._tripped = False  # fresh loop, fresh device state
-                self._thread = threading.Thread(
-                    target=self._run, name="decode-scheduler", daemon=True
-                )
-                self._thread.start()
+            self._ensure_running_locked()
             self._cond.notify_all()
         return self._drain(stream)
+
+    def resume(self, generation_id, from_seq=0, wait_s=5.0,
+               deadline=None):
+        """Continue a parked generation: replays its buffered
+        ``(token, logprob)`` history from ``from_seq`` (the first
+        sequence number the caller has NOT seen), then — for an
+        interrupted generation — splices live tokens from a re-admitted
+        continuation (re-prefilled ``prompt + history``).  Raises
+        :class:`UnknownGeneration` when the id was never issued, was
+        already resumed, or aged out of the replay buffer.  Replay
+        state is replica-local: resume the SAME endpoint that served
+        the original request.
+
+        A disconnected stream is only PARKED when the decode loop next
+        reaps its cancelled slot, so a fast reconnect can arrive first;
+        while the id still names a live stream, resume waits (up to
+        ``wait_s``) for the park instead of turning the race into a
+        terminal unknown-generation error.  ``deadline`` is the RESUME
+        request's own monotonic bound (None lifts any bound): the
+        original request's deadline died with its connection — a
+        reconnect carrying a fresh timeout must not be killed by the
+        stale one."""
+        from_seq = int(from_seq)
+        deadline = time.monotonic() + float(wait_s)
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise SchedulerClosed("scheduler is shut down")
+                self._sweep_replay_locked(time.monotonic())
+                entry = self._replay.pop(generation_id, None)
+                if entry is not None:
+                    break
+                live = any(st.generation_id == generation_id
+                           for st in self._streams)
+                remaining = deadline - time.monotonic()
+                if not live or remaining <= 0:
+                    raise UnknownGeneration(
+                        "unknown or expired generation id '{}' (replay "
+                        "entries live {}s after disconnect; resume is "
+                        "same-endpoint only)".format(
+                            generation_id, self._replay_ttl_s)
+                    )
+                self._cond.wait(min(0.05, remaining))
+            stream, completed, _ = entry
+            if from_seq < 0 or from_seq > len(stream.history):
+                # put the entry back: a malformed resume must not
+                # destroy the (still valid) replay state
+                self._replay[generation_id] = entry
+                raise UnknownGeneration(
+                    "resume point {} is beyond generation '{}' ({} "
+                    "tokens emitted)".format(
+                        from_seq, generation_id, len(stream.history))
+                )
+            replay = list(stream.history[from_seq:])
+            if completed:
+                # a finished generation's tail stays replayable for its
+                # whole TTL (the client may lose more than one tail)
+                self._replay[generation_id] = entry
+            else:
+                if self._tripped:
+                    self._replay[generation_id] = entry
+                    raise SchedulerClosed(
+                        "decode loop restart budget exhausted; the "
+                        "scheduler is tripped"
+                    )
+                if self._draining:
+                    # same admission gate as submit(): re-admitting an
+                    # interrupted generation is NEW decode work and must
+                    # not sneak in mid-drain (completed-tail replays
+                    # above stay served — they cost no decode)
+                    self._replay[generation_id] = entry
+                    raise SchedulerClosed(
+                        "scheduler is draining; not accepting new "
+                        "generations"
+                    )
+                import queue as _queue
+
+                # fresh queue: the abandoned one may hold tokens the old
+                # consumer never took — those are re-delivered from the
+                # history snapshot above, never from the stale queue
+                stream.queue = _queue.Queue()
+                stream.cancelled = False
+                stream.finished = False
+                stream.deadline = deadline  # the reconnect's own bound
+                self._reset_for_readmission(stream)
+                self._pending.append(stream)
+                self._streams.add(stream)
+                self._ensure_running_locked()
+                self._cond.notify_all()
+
+        def gen():
+            live = None if completed else self._drain(stream)
+            try:
+                for tok, lp in replay:
+                    yield tok, lp
+                if live is not None:
+                    for item in live:
+                        yield item
+            finally:
+                if live is not None and not stream.finished:
+                    # consumer abandoned during the replay prefix: the
+                    # live generator's own cancel hook never ran
+                    stream.cancelled = True
+                    live.close()
+
+        return gen()
 
     @staticmethod
     def _drain(stream):
@@ -218,7 +420,8 @@ class DecodeScheduler:
                 # consumer gone mid-generation (client cancel/disconnect
                 # closes the generator): flag the stream so the decode
                 # loop retires its slot instead of burning batched steps
-                # on tokens nobody will read
+                # on tokens nobody will read (resumable streams park in
+                # the replay buffer at that point)
                 stream.cancelled = True
 
     def close(self, join_timeout=30):
@@ -234,18 +437,22 @@ class DecodeScheduler:
             self._closed = True
             self._cond.notify_all()
             thread = self._thread
+            supervisor = self._supervisor
         if thread is not None and not already_closed:
             # join once: a second close() (e.g. core.drain's final
             # close after the scheduler already drained) must not spend
             # another join_timeout re-waiting on a wedged thread —
             # the deterministic leftover-fail below still runs
             thread.join(timeout=join_timeout)
+        if supervisor is not None and not already_closed:
+            supervisor.join(timeout=5)
         # the loop normally fails every live stream on its way out; after
         # a join timeout (or a loop that never started) do it ourselves
         with self._cond:
             leftover = list(self._streams)
             self._streams.clear()
             self._pending.clear()
+            self._replay.clear()
             self._cond.notify_all()
         err = SchedulerClosed("scheduler is shut down")
         for stream in leftover:
@@ -269,15 +476,17 @@ class DecodeScheduler:
 
     @property
     def healthy(self):
-        """False after the decode loop died unexpectedly (watchdog
-        tripped) or the scheduler was closed — readiness probes report
-        this through ``ServerReady``/``ModelReady``."""
+        """False after the decode loop tripped permanently (restart
+        budget exhausted) or the scheduler was closed — readiness
+        probes report this through ``ServerReady``/``ModelReady`` so
+        pools rotate flapping replicas out."""
         return not self._tripped and not self._closed
 
     def stats(self):
         """Introspection for tests and ops: live stream / pending counts
         and lifecycle flags.  ``live_streams`` counting to zero after
-        traffic is the no-leaked-slots invariant chaos tests assert."""
+        traffic is the no-leaked-slots invariant chaos tests assert;
+        ``restarts`` rising is the flapping signal ops rotate on."""
         with self._cond:
             return {
                 "live_streams": len(self._streams),
@@ -285,46 +494,246 @@ class DecodeScheduler:
                 "draining": self._draining,
                 "closed": self._closed,
                 "healthy": self.healthy,
+                "tripped": self._tripped,
+                "restarts": self._restarts,
+                "quarantined": self._quarantined,
+                "replay_entries": len(self._replay),
             }
+
+    # -- supervisor --------------------------------------------------------
+
+    def _ensure_running_locked(self):
+        """Start (or restart) the supervisor; it owns the loop thread.
+        Called with ``_cond`` held."""
+        if self._supervisor is None or not self._supervisor.is_alive():
+            self._supervisor = threading.Thread(
+                target=self._supervise, name="decode-supervisor",
+                daemon=True,
+            )
+            self._supervisor.start()
+
+    def _start_loop_locked(self):
+        self._epoch += 1
+        self._heartbeat = None
+        self._loop_error = None
+        self._thread = threading.Thread(
+            target=self._run, args=(self._epoch,),
+            name="decode-scheduler", daemon=True,
+        )
+        self._thread.start()
+
+    def _beat(self, epoch, now):
+        """Stamp (or clear, ``now=None``) this loop's device-op
+        heartbeat.  A superseded loop's clear is dropped so a zombie
+        cannot erase the live loop's beat mid-step."""
+        if now is not None:
+            self._heartbeat = (epoch, now)
+        else:
+            hb = self._heartbeat
+            if hb is not None and hb[0] == epoch:
+                self._heartbeat = None
+
+    def _hung_locked(self, now):
+        hb = self._heartbeat
+        return (
+            self._step_timeout_s is not None
+            and hb is not None
+            and hb[0] == self._epoch  # a zombie's stale stamp is inert
+            and now - hb[1] > self._step_timeout_s
+        )
+
+    def _supervise(self):
+        """Own the decode thread: start it, watch for death or a hung
+        step, and restart it (re-admitting live streams) under the
+        budget — or trip permanently when the budget is spent."""
+        poll = 0.05 if self._step_timeout_s is not None else 0.5
+        while True:
+            with self._cond:
+                if self._closed or self._tripped:
+                    return
+                if self._thread is None:
+                    self._start_loop_locked()
+                thread = self._thread
+            thread.join(timeout=poll)
+            death = None
+            with self._cond:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                self._sweep_replay_locked(now)
+                if self._loop_error is not None:
+                    # the loop died; its except hook already salvaged
+                    # slotted streams back into _pending
+                    death = self._loop_error
+                    self._loop_error = None
+                elif thread.is_alive() and self._hung_locked(now):
+                    # wedged device dispatch: demote the thread (epoch
+                    # bump — every delivery it attempts after waking is
+                    # dropped) and salvage its streams from the registry
+                    death = _HungStep(
+                        "decode step exceeded step_timeout_s={}s".format(
+                            self._step_timeout_s)
+                    )
+                    self._epoch += 1
+                    self._heartbeat = None
+                    self._thread = None
+                    pending_set = set(self._pending)
+                    for st in [s for s in self._streams
+                               if s not in pending_set]:
+                        if st.cancelled:
+                            self._detach_locked(st)
+                        else:
+                            self._reset_for_readmission(st)
+                            self._pending.appendleft(st)
+                if death is None:
+                    continue
+                # restart budget: a sliding window of restart times
+                while (self._recent_restarts
+                       and now - self._recent_restarts[0]
+                       > self._restart_window_s):
+                    self._recent_restarts.popleft()
+                if len(self._recent_restarts) >= self._max_restarts:
+                    self._tripped = True
+                    to_fail = list(self._streams)
+                    self._streams.clear()
+                    self._pending.clear()
+                    self._cond.notify_all()
+                else:
+                    to_fail = None
+                    self._recent_restarts.append(now)
+                    self._restarts += 1
+                    backoff = min(
+                        self._restart_backoff_s
+                        * (2 ** (len(self._recent_restarts) - 1)),
+                        2.0,
+                    )
+                    # the FULL backoff must elapse (a transient device
+                    # fault needs the pause to clear): every submit /
+                    # delivery notify_all would otherwise cut the wait
+                    # short and burn the whole restart budget in
+                    # milliseconds.  Only close() interrupts.
+                    backoff_until = now + backoff
+                    while not self._closed:
+                        remaining = backoff_until - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    if self._closed:
+                        return
+                    if self._thread is None:
+                        self._start_loop_locked()
+            if to_fail is not None:
+                err = SchedulerClosed(
+                    "decode loop restart budget exhausted ({} restarts "
+                    "in {}s) after: {}".format(
+                        self._max_restarts, self._restart_window_s, death)
+                )
+                for st in to_fail:
+                    st.queue.put(("err", err, None))
+                return
+
+    def _reset_for_readmission(self, stream):
+        """Prepare a salvaged/resumed stream for a fresh admission: the
+        new loop re-prefills ``prompt + history`` (or forced-feeds both
+        over a parked cache), so emission continues exactly where it
+        stopped.  Called with ``_cond`` held."""
+        stream.pos = 0
+        stream.forced.clear()
+
+    # -- replay buffer -----------------------------------------------------
+
+    def _sweep_replay_locked(self, now):
+        expired = [
+            gid for gid, (_, _, expires) in self._replay.items()
+            if expires <= now
+        ]
+        for gid in expired:
+            self._replay.pop(gid, None)
+
+    def _park_locked(self, stream, completed):
+        """Retain a resumable generation's history for later resume.
+        Called with ``_cond`` held."""
+        now = time.monotonic()
+        self._sweep_replay_locked(now)
+        if completed:
+            # a completed park only ever serves history[from_seq:]
+            # replays — drop the device state NOW, or up to
+            # replay_capacity parked KV-cache copies (resume_cache) and
+            # shm-pinning on_finish closures would sit in the buffer
+            # for the whole TTL
+            stream.resume_cache = None
+            stream.on_finish = None
+        self._replay[stream.generation_id] = (
+            stream, completed, now + self._replay_ttl_s
+        )
+        self._replay.move_to_end(stream.generation_id)
+        while len(self._replay) > self._replay_capacity:
+            self._replay.popitem(last=False)  # evict oldest
+
+    def _detach_locked(self, stream):
+        """Retire a cancelled stream from the live registry; resumable
+        ones park in the replay buffer instead of vanishing.  Called
+        with ``_cond`` held."""
+        self._streams.discard(stream)
+        if stream.generation_id is not None and not stream.finished:
+            self._park_locked(stream, completed=False)
+        self._cond.notify_all()
 
     # -- decode loop -------------------------------------------------------
 
-    def _fail(self, stream, exc):
-        self._deliver(stream, ("err", exc, None))
+    def _fail(self, stream, exc, epoch=None):
+        self._deliver(stream, ("err", exc, None), epoch)
 
-    def _deliver(self, stream, event):
+    def _deliver(self, stream, event, epoch=None):
         """Deliver a terminal event and retire the stream from the live
-        registry (never call while holding ``_cond`` — it takes it)."""
+        registry (never call while holding ``_cond`` — it takes it).
+        With ``epoch``, delivery is dropped when the calling loop has
+        been superseded (the new loop owns the stream)."""
         with self._cond:
+            if epoch is not None and epoch != self._epoch:
+                return
             self._streams.discard(stream)
+            if event[0] == "done" and stream.generation_id is not None:
+                # completed generations stay resumable for the TTL so a
+                # client that lost the tail can replay it
+                self._park_locked(stream, completed=True)
             self._cond.notify_all()
-        stream.queue.put(event)
+            # under the lock: a racing watchdog salvage must either see
+            # this terminal delivery or run strictly before it
+            stream.queue.put(event)
 
-    def _run(self):
+    def _run(self, epoch):
         slots = [None] * self._max_slots  # slot -> _Stream | None
         try:
-            self._loop(slots)
-        except Exception as e:  # noqa: BLE001 — the loop must not die
-            # silently: an unexpected failure (e.g. OOM inside the
-            # step-recovery path) would otherwise leave every consumer
-            # blocked forever on its queue
+            self._loop(slots, epoch)
+        except Exception as e:  # noqa: BLE001 — loop death is the
+            # supervisor's restart (or trip) signal; swallowing it here
+            # would leave every consumer blocked forever on its queue
             with self._cond:
-                self._tripped = True  # watchdog: readiness reports it
+                if self._epoch != epoch:
+                    return  # superseded zombie: the new loop owns it all
+                self._loop_error = e
+                self._beat(epoch, None)
                 if self._thread is threading.current_thread():
                     # unregister NOW, under the lock: a submit racing
-                    # this cleanup must see no live thread and start a
-                    # fresh loop, not enqueue into a dying one whose
-                    # pending snapshot below would never include it
+                    # this cleanup must see no live thread; the
+                    # supervisor starts the replacement
                     self._thread = None
-                pending = list(self._pending)
-                self._pending.clear()
-            for stream in slots:
-                if stream is not None:
-                    self._fail(stream, e)
-            for stream in pending:
-                self._fail(stream, e)
+                # salvage: slotted streams re-enter the pending queue at
+                # the FRONT (they were admitted first) with their state
+                # reset for re-prefill of prompt + history
+                for st in reversed([s for s in slots if s is not None]):
+                    if st not in self._streams:
+                        continue  # already terminally delivered
+                    if st.cancelled:
+                        self._detach_locked(st)
+                        continue
+                    self._reset_for_readmission(st)
+                    self._pending.appendleft(st)
+                self._cond.notify_all()
+                self._ensure_running_locked()
 
-    def _loop(self, slots):
+    def _loop(self, slots, epoch):
         fns = self._fns
         cache = fns["init_cache"]()
         logits = fns["init_logits"]()
@@ -332,18 +741,29 @@ class DecodeScheduler:
 
         def finish(stream, slot):
             if stream.on_finish is not None:
+                # extract+park is a device dispatch too: under the
+                # watchdog, with the same compile headroom admissions
+                # get (a future-dated stamp = a 10x deadline)
+                t = self._step_timeout_s
+                self._beat(epoch,
+                           time.monotonic() + 9 * t if t else None)
                 try:
                     stream.on_finish(fns["extract"](cache, slot))
-                except Exception as e:  # noqa: BLE001 — park is per-stream
-                    self._fail(stream, e)
+                except Exception as e:  # noqa: BLE001 — park is
+                    # per-stream
+                    self._fail(stream, e, epoch)
                     slots[slot] = None
                     return
-            self._deliver(stream, ("done", None, None))
+                finally:
+                    self._beat(epoch, None)
+            self._deliver(stream, ("done", None, None), epoch)
             slots[slot] = None
 
         while True:
             expired = []
             with self._cond:
+                if self._epoch != epoch:
+                    return  # superseded by a watchdog restart
                 while (
                     not self._closed
                     and not self._draining
@@ -352,6 +772,8 @@ class DecodeScheduler:
                     and not any(s is not None for s in slots)
                 ):
                     self._cond.wait()
+                    if self._epoch != epoch:
+                        return
                 if self._closed:
                     pending = list(self._pending)
                     self._pending.clear()
@@ -368,12 +790,12 @@ class DecodeScheduler:
                     pending = []
                     break
                 # reap cancelled streams first: their consumers are gone,
-                # so the slot frees for waiting work (no park — the
-                # single-stream path abandoned mid-generation doesn't
-                # park either)
+                # so the slot frees for waiting work (no park of the KV —
+                # resumable streams keep only their token history and
+                # re-prefill on resume)
                 for i, st in enumerate(slots):
                     if st is not None and st.cancelled:
-                        self._streams.discard(st)
+                        self._detach_locked(st)
                         slots[i] = None
                 # deadline sweep: a pending request past its deadline
                 # fails BEFORE prefill (no slot or compute is ever spent
@@ -395,7 +817,7 @@ class DecodeScheduler:
                 while self._pending and free:
                     st = self._pending.popleft()
                     if st.cancelled:
-                        self._streams.discard(st)
+                        self._detach_locked(st)
                         continue  # abandoned while still queued
                     admissions.append((free.pop(0), st))
             # deadline failures deliver OUTSIDE the lock (delivery
@@ -403,15 +825,24 @@ class DecodeScheduler:
             for st in expired:
                 self._fail(st, DeadlineExceeded(
                     "request deadline exceeded after {} emitted "
-                    "tokens".format(st.emitted)))
+                    "tokens".format(st.emitted)), epoch)
             # device work runs OUTSIDE the lock: submitters must be able
             # to enqueue while the chip computes
             for slot, stream in admissions:
+                # prefill-on-admit is a full-model device dispatch and
+                # must be watchdogged like a step — but a novel prefill
+                # bucket may legitimately COMPILE here, so the stamp is
+                # future-dated 9x: the hang deadline becomes 10x the
+                # step deadline instead of a compile reading as a wedge
+                t = self._step_timeout_s
+                self._beat(epoch, time.monotonic() + 9 * t if t else None)
                 try:
                     cache, logits = self._admit(cache, logits, slot, stream)
                 except Exception as e:  # noqa: BLE001 — per-request fault
-                    self._fail(stream, e)
+                    self._fail(stream, e, epoch)
                     continue
+                finally:
+                    self._beat(epoch, None)
                 slots[slot] = stream
 
             current = None
@@ -433,104 +864,128 @@ class DecodeScheduler:
                     if was_forced:
                         forced_tok[i] = st.forced.popleft()
                         forced_mask[i] = True
-                    snapshot.append((i, st, was_forced))
+                    snapshot.append((i, st, was_forced, st.incarnation))
                     st.pos += 1
-                try:
-                    # chaos hook: "scheduler.step" raise = decode-step
-                    # failure (exercises the donated-cache recovery
-                    # below), sleep = slow step
-                    faults.fire("scheduler.step", self.fault_scope)
-                    tokens_dev, logps_dev, logits, cache = fns["step"](
-                        self._params, cache, logits, positions, active,
-                        forced_tok, forced_mask,
-                    )
-                    current = (tokens_dev, logps_dev, snapshot)
-                except Exception as e:  # noqa: BLE001
-                    # a failed dispatch may have consumed the donated
-                    # cache/logits: fail every live stream and reset
-                    for i, st, _ in snapshot:
-                        self._fail(st, e)
-                        slots[i] = None
-                    if inflight is not None:
-                        for i, st, _ in inflight[2]:
-                            if slots[i] is st:
-                                self._fail(st, e)
-                                slots[i] = None
-                    inflight = None
-                    cache = fns["init_cache"]()
-                    logits = fns["init_logits"]()
-                    continue
+                # chaos hook: "scheduler.step" raise = loop death (the
+                # supervised-restart path), sleep = slow step, nan =
+                # poison one slot's logits row (the quarantine path),
+                # hang = stall INSIDE the heartbeat window below so the
+                # watchdog provably observes it.  A raise here may have
+                # left the donated cache consumed — exactly what the
+                # restart rebuilds.
+                action = faults.fire("scheduler.step", self.fault_scope)
+                if action is not None and action[0] == "nan":
+                    row = min(max(0, action[1]), self._max_slots - 1)
+                    logits = logits.at[row].set(float("nan"))
+                self._beat(epoch, time.monotonic())
+                if action is not None and action[0] == "hang":
+                    time.sleep(action[1])
+                tokens_dev, logps_dev, logits, cache = fns["step"](
+                    self._params, cache, logits, positions, active,
+                    forced_tok, forced_mask,
+                )
+                self._beat(epoch, None)
+                current = (tokens_dev, logps_dev, snapshot)
 
             if inflight is not None:
                 tokens_dev, logps_dev, snapshot = inflight
-                try:
-                    # host-transfer chaos
-                    faults.fire("scheduler.fetch", self.fault_scope)
-                    toks = np.asarray(tokens_dev)
-                    lps = np.asarray(logps_dev)
-                except Exception as e:  # noqa: BLE001
-                    for i, st, _ in snapshot:
-                        if slots[i] is st:
-                            self._fail(st, e)
+                # host-transfer chaos; a raise is loop death (restart)
+                faults.fire("scheduler.fetch", self.fault_scope)
+                self._beat(epoch, time.monotonic())
+                toks = np.asarray(tokens_dev)
+                lps = np.asarray(logps_dev)
+                self._beat(epoch, None)
+                quarantined = []
+                finished = []
+                with self._cond:
+                    if self._epoch != epoch:
+                        return  # superseded mid-fetch: deliver nothing
+                    for i, st, was_forced, inc in snapshot:
+                        if slots[i] is not st or st.incarnation != inc:
+                            # slot retired (and possibly re-admitted —
+                            # even by the SAME stream, resumed after a
+                            # disconnect) after this step was
+                            # dispatched: its token is the one-deep
+                            # pipeline's wasted extra
+                            continue
+                        if st.cancelled:
+                            # consumer gone: free the slot AND retire
+                            # the stream (parking resumables)
+                            self._detach_locked(st)
                             slots[i] = None
-                    inflight = current
-                    continue
-                for i, st, was_forced in snapshot:
-                    if slots[i] is not st:
-                        # slot retired (and possibly re-admitted) after
-                        # this step was dispatched: its token is the
-                        # one-deep pipeline's wasted extra — discard
-                        continue
-                    if st.cancelled:
-                        # consumer gone: free the slot AND retire the
-                        # stream from the live registry — every other
-                        # retire site discards too; missing it here
-                        # left stats()['live_streams'] nonzero and made
-                        # drain() wait out its full timeout
-                        self._streams.discard(st)
-                        slots[i] = None
-                        continue
-                    if was_forced:
-                        continue  # resumed-prompt feed, nothing to emit
-                    tok = int(toks[i])
-                    if st.emitted < st.max_tokens:
-                        st.queue.put(("tok", tok, float(lps[i])))
-                        st.emitted += 1
-                    if st.emitted >= st.max_tokens or (
-                        st.eos_id is not None and tok == st.eos_id
-                    ):
-                        finish(st, i)
+                            continue
+                        if was_forced:
+                            continue  # resumed-prompt feed, no emission
+                        tok = int(toks[i])
+                        lp = float(lps[i])
+                        if not np.isfinite(lp):
+                            # poisoned output: THIS slot's logits went
+                            # non-finite.  The batched step's math is
+                            # row-independent, so co-batched slots are
+                            # untouched — retire only the offender.
+                            quarantined.append((i, st))
+                            slots[i] = None
+                            continue
+                        if st.emitted < st.max_tokens:
+                            st.history.append((tok, lp))
+                            st.queue.put(("tok", tok, lp))
+                            st.emitted += 1
+                        if st.emitted >= st.max_tokens or (
+                            st.eos_id is not None and tok == st.eos_id
+                        ):
+                            finished.append((st, i))
+                for i, st in quarantined:
+                    with self._cond:
+                        self._quarantined += 1
+                    self._fail(st, SlotQuarantined(
+                        "generation produced non-finite logits after {} "
+                        "emitted tokens; its slot was quarantined (co-"
+                        "batched generations are unaffected)".format(
+                            st.emitted)), epoch)
+                for st, i in finished:
+                    finish(st, i)
             inflight = current
 
         # closed: fail whatever is still queued or running
         err = SchedulerClosed("scheduler is shut down")
         if inflight is not None:
-            for i, st, _ in inflight[2]:
+            for i, st, _, _ in inflight[2]:
                 if slots[i] is st:
                     slots[i] = None
-                    self._fail(st, err)
+                    self._fail(st, err, epoch)
         for st in slots:
             if st is not None:
-                self._fail(st, err)
+                self._fail(st, err, epoch)
         for st in pending:
-            self._fail(st, err)
+            self._fail(st, err, epoch)
 
     def _admit(self, cache, logits, slot, stream):
-        """Prefill-on-admit (or parked-cache restore) into ``slot``."""
+        """Prefill-on-admit (or parked-cache restore) into ``slot``.
+
+        A stream with emitted history (supervised restart / client
+        resume) re-feeds ``prompt + history``: re-prefilling the full
+        emitted prefix reproduces the KV state greedy decode built
+        incrementally, so the continuation is token-identical."""
         import jax.numpy as jnp
 
         # admission-failure chaos hook
         faults.fire("scheduler.admit", self.fault_scope)
+        # new incarnation: step snapshots taken against a previous
+        # admission of this stream object become inert
+        stream.incarnation += 1
         fns = self._fns
+        replayed = [t for t, _ in stream.history]
         if stream.resume_cache is not None:
             # resumed generation: the parked rows become the slot's
-            # cache and the new prompt replays as forced tokens (the
+            # cache and the new prompt (plus any already-emitted
+            # history, after a restart) replays as forced tokens (the
             # single-stream resume path feeds them through decode the
             # same way).  The parked array itself is only READ — the
             # region's copy stays valid for the next resume.
             slot_cache = stream.resume_cache
             row = jnp.zeros((1, logits.shape[1]), logits.dtype)
             stream.forced.extend(int(t) for t in stream.prompt)
+            stream.forced.extend(replayed)
             stream.pos = stream.resume_pos
         else:
             # prompts pad to power-of-two buckets so admission compiles
@@ -541,10 +996,15 @@ class DecodeScheduler:
             # K/V stay masked behind the slot's position.  The model
             # decides the bucket (exact length where padding would flip
             # its prefill kernel choice and with it the greedy tokens).
-            true_len = len(stream.prompt)
+            full = (
+                np.concatenate(
+                    [stream.prompt, np.asarray(replayed, np.int32)])
+                if replayed else stream.prompt
+            )
+            true_len = len(full)
             bucket = self._fns["prefill_bucket"](true_len)
             padded = np.zeros((bucket,), np.int32)
-            padded[:true_len] = stream.prompt
+            padded[:true_len] = full
             slot_cache = fns["init_slot_cache"]()
             row, slot_cache = fns["prefill"](
                 self._params, slot_cache, jnp.asarray(padded)[None, :],

@@ -776,6 +776,55 @@ class EndpointPool:
         if ep is not None:
             return ep.client.stop_stream(*args, **kwargs)
 
+    def generate_stream(self, *args, **kwargs):
+        """Run ONE resumable generation on one healthy endpoint, pinned
+        for the generation's whole lifetime INCLUDING the client's
+        auto-resume reconnects: generation replay state (token history,
+        re-prefill source) is **replica-local**, so a resume against
+        any other replica would fail with an unknown-generation error.
+        Never hedged, never failed over mid-generation — the pooled
+        client's own same-endpoint reconnect+resume handles transport
+        drops; only a FRESH generate_stream call routes anew.
+
+        This is a generator: the endpoint is picked (and any half-open
+        breaker probe slot consumed) only when iteration starts, so a
+        handle that is created but never iterated cannot leak the
+        probe slot and blacklist the endpoint."""
+        if self._closed:
+            raise_error("EndpointPool is closed")
+        ep = self._pick()
+        if ep is None:
+            self._pool_unavailable(None)
+        recorded = [False]
+
+        def record_ok():
+            if not recorded[0]:
+                recorded[0] = True
+                ep.breaker.record_success()
+
+        try:
+            for event in ep.client.generate_stream(*args, **kwargs):
+                record_ok()
+                yield event
+        except Exception as exc:  # noqa: BLE001 — classified for the
+            # breaker (same contract as start_stream)
+            if not recorded[0]:
+                recorded[0] = True
+                kind, retry_after = classify_failure(exc)
+                if kind == FAILURE_OTHER:
+                    ep.breaker.record_success()  # typed answer: alive
+                else:
+                    ep.breaker.record_failure(
+                        retry_after if kind == FAILURE_OVERLOAD
+                        else None)
+                    ep.healthy = False
+            raise
+        finally:
+            # abandoned before the first event: release a possible
+            # half-open probe slot so the endpoint is not blacklisted
+            # forever
+            record_ok()
+
     # -- everything else: generic delegation with failover ----------------
 
     def __getattr__(self, name):

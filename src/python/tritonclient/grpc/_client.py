@@ -715,3 +715,142 @@ class InferenceServerClient:
         if self._verbose:
             print("async_stream_infer\n{}".format(request))
         self._stream._enqueue_request(request)
+
+    def generate_stream(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        parameters=None,
+        headers=None,
+        resume=True,
+        max_reconnects=5,
+        reconnect_backoff_s=0.05,
+        read_timeout=600.0,
+        on_reconnect=None,
+    ):
+        """Synchronous generator over ONE decoupled generation with
+        transparent reconnect+resume, yielding an ``InferResult`` per
+        streamed response (the terminal empty-final response is
+        consumed, not yielded).
+
+        Owns the client's single bidi-stream slot for the call's
+        duration (``start_stream`` semantics — raises if a stream is
+        already active).  Each response of a resumable server
+        generation carries ``generation_id`` and the 0-based token
+        ``seq`` in its response parameters; on a *stream-level* failure
+        (RpcError — the transport died) the call re-opens the stream
+        and sends a resume request (``resume_generation_id`` +
+        ``resume_from_seq``), the server replays the missed tokens and
+        splices the live continuation, and duplicates are dropped by
+        ``seq`` — no duplicated or missing tokens.  Resume is
+        **same-endpoint only** (replay state is replica-local).
+        In-band ``error_message`` responses raise immediately — those
+        are typed server failures (quarantined slot, expired resume
+        id), not transport faults.  ``on_reconnect(attempt, exc)``
+        fires before each reattempt."""
+        import queue as _queue
+
+        if self._stream is not None:
+            raise_error(
+                "cannot generate_stream with a stream already active"
+            )
+        base_params = dict(parameters or {})
+        gen_id = base_params.get("generation_id")
+        last_seq = -1
+        yielded_any = False
+        attempt = 0
+
+        class _StreamDropped(Exception):
+            def __init__(self, error):
+                self.error = error
+
+        while True:
+            responses = _queue.Queue()
+            try:
+                try:
+                    self.start_stream(
+                        lambda result, error: responses.put(
+                            (result, error)),
+                        headers=headers,
+                    )
+                    send_params = dict(base_params)
+                    if gen_id is not None and last_seq >= 0:
+                        # mid-generation reconnect: ask the server to
+                        # replay from the first seq we have not seen
+                        send_params.pop("generation_id", None)
+                        send_params["resume_generation_id"] = gen_id
+                        send_params["resume_from_seq"] = last_seq + 1
+                    self.async_stream_infer(
+                        model_name,
+                        inputs,
+                        model_version=model_version,
+                        outputs=outputs,
+                        request_id=request_id,
+                        enable_empty_final_response=True,
+                        parameters=send_params,
+                    )
+                except InferenceServerException as e:
+                    # the just-opened stream died before (or while) the
+                    # request was enqueued — a transport-level failure
+                    # (in-band server errors never deactivate the
+                    # stream), so it rides the same reconnect path;
+                    # prefer the stream's own delivered error (e.g.
+                    # "connection refused") over the generic
+                    # stream-invalid message
+                    try:
+                        _, delivered = responses.get_nowait()
+                    except _queue.Empty:
+                        delivered = None
+                    raise _StreamDropped(delivered or e)
+                while True:
+                    try:
+                        result, error = responses.get(timeout=read_timeout)
+                    except _queue.Empty:
+                        raise InferenceServerException(
+                            "generate_stream: no response within "
+                            "{}s".format(read_timeout))
+                    if error is not None:
+                        if getattr(error, "status", lambda: None)() is None:
+                            # in-band server error: terminal
+                            raise error
+                        raise _StreamDropped(error)
+                    resp = result.get_response()
+                    final = resp.parameters.get("triton_final_response")
+                    if final is not None and final.bool_param:
+                        return
+                    if "generation_id" in resp.parameters:
+                        gen_id = resp.parameters[
+                            "generation_id"].string_param
+                    if "seq" in resp.parameters:
+                        seq = resp.parameters["seq"].int64_param
+                        if seq <= last_seq:
+                            continue  # replayed duplicate
+                        last_seq = seq
+                    yielded_any = True
+                    yield result
+            except _StreamDropped as drop:
+                # resume is only safe with a resume token (the server
+                # marked the generation resumable) OR before anything
+                # was delivered (a fresh re-send cannot duplicate);
+                # re-running a non-resumable generation after yielding
+                # tokens would duplicate them
+                attempt += 1
+                if (not resume or attempt > max_reconnects
+                        or (yielded_any and (gen_id is None
+                                             or last_seq < 0))):
+                    if yielded_any and (gen_id is None or last_seq < 0):
+                        raise InferenceServerException(
+                            "stream lost mid-generation and the "
+                            "generation is not resumable (no "
+                            "generation_id/seq on its responses): "
+                            "{}".format(drop.error))
+                    raise drop.error
+                if on_reconnect is not None:
+                    on_reconnect(attempt, drop.error)
+                time.sleep(
+                    min(reconnect_backoff_s * (2 ** (attempt - 1)), 2.0))
+            finally:
+                self.stop_stream(cancel_requests=True)

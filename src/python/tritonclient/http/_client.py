@@ -934,6 +934,176 @@ class InferenceServerClient:
         self._infer_stat.update(timers, success=True)
         return result
 
+    def generate_stream(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        parameters=None,
+        request_id="",
+        headers=None,
+        resume=True,
+        max_reconnects=5,
+        reconnect_backoff_s=0.05,
+        read_timeout=600.0,
+        on_reconnect=None,
+    ):
+        """Stream a decoupled generation over ``/generate_stream`` SSE,
+        yielding one dict per event (the KServe generate-response JSON:
+        ``outputs`` plus, for resumable generations, ``parameters`` with
+        ``generation_id`` and the 0-based token ``seq``).
+
+        With ``resume=True`` (default) a connection dropped
+        *mid-generation* transparently reconnects: the client re-POSTs
+        the same body with the SSE-standard ``Last-Event-ID`` header
+        (``<generation_id>/<seq>`` of the last event received), the
+        server replays the missed tokens from its replay buffer and
+        splices the live continuation — no duplicated or missing
+        tokens.  Resume is **same-endpoint only** (generation replay
+        state is replica-local); ``EndpointPool.generate_stream`` pins
+        one endpoint for exactly this reason.  Up to ``max_reconnects``
+        reattempts with exponential backoff; ``on_reconnect(attempt,
+        exc)`` is called before each one (perf tooling counts resumes
+        through it).  In-band ``{"error": ...}`` events raise
+        InferenceServerException without reconnecting — those are
+        typed server-side failures (e.g. a quarantined slot), not
+        transport faults.
+
+        ``inputs`` is a dict name -> numpy array (serialized as JSON
+        data — generation prompts are small); ``parameters`` are the
+        request parameters (``eos_id``, ``generation_id``, ...).
+        """
+        import http.client as _http_client
+
+        import numpy as np
+
+        from tritonclient.utils import np_to_triton_dtype
+
+        body_json = {
+            "inputs": [
+                {
+                    "name": name,
+                    "shape": list(np.asarray(arr).shape),
+                    "datatype": ("BYTES"
+                                 if np.asarray(arr).dtype == np.object_
+                                 else np_to_triton_dtype(
+                                     np.asarray(arr).dtype)),
+                    "data": [
+                        v.decode("utf-8") if isinstance(v, bytes) else v
+                        for v in np.asarray(arr).reshape(-1).tolist()
+                    ],
+                }
+                for name, arr in inputs.items()
+            ],
+        }
+        if request_id:
+            body_json["id"] = request_id
+        if parameters:
+            body_json["parameters"] = dict(parameters)
+        body = json.dumps(body_json)
+        uri = "{}/v2/models/{}{}/generate_stream".format(
+            self._base_path, quote(model_name),
+            "/versions/{}".format(model_version) if model_version else "",
+        )
+
+        last_event_id = None
+        last_seq = -1
+        yielded_any = False
+        attempt = 0
+        while True:
+            conn = (
+                _http_client.HTTPSConnection(
+                    self._host, self._port, timeout=read_timeout,
+                    context=self._ssl_context)
+                if self._scheme == "https"
+                else _http_client.HTTPConnection(
+                    self._host, self._port, timeout=read_timeout)
+            )
+            dropped = None
+            try:
+                hdrs = dict(headers) if headers else {}
+                hdrs["Content-Type"] = "application/json"
+                if last_event_id is not None:
+                    hdrs["Last-Event-ID"] = last_event_id
+                try:
+                    conn.request("POST", uri, body, hdrs)
+                    resp = conn.getresponse()
+                except (ConnectionError, socket.timeout, OSError,
+                        _http_client.HTTPException) as e:
+                    dropped = e
+                    resp = None
+                if resp is not None:
+                    if resp.status != 200:
+                        raise InferenceServerException(
+                            "generate_stream failed: {}".format(
+                                _get_error_message(resp.read())),
+                            status=str(resp.status),
+                        )
+                    event_id = None
+                    try:
+                        for line in resp:
+                            line = line.strip()
+                            if line.startswith(b"id: "):
+                                event_id = line[4:].decode(
+                                    "utf-8", errors="replace")
+                                continue
+                            if not line.startswith(b"data: "):
+                                continue
+                            event = json.loads(line[len(b"data: "):])
+                            if "error" in event:
+                                # typed server failure: terminal, never
+                                # ridden out by reconnecting
+                                raise InferenceServerException(
+                                    event["error"])
+                            if event.get("final"):
+                                return  # in-band end: generation done
+                            seq = (event.get("parameters") or {}).get(
+                                "seq")
+                            if seq is not None and seq <= last_seq:
+                                event_id = None
+                                continue  # replayed duplicate
+                            if seq is not None:
+                                last_seq = seq
+                            if event_id is not None:
+                                last_event_id = event_id
+                                event_id = None
+                            yielded_any = True
+                            yield event
+                        # the stream ended WITHOUT the in-band terminal
+                        # event: a mid-generation connection drop (a
+                        # premature chunked EOF is not reliably an
+                        # exception in stdlib http.client)
+                        dropped = ConnectionError(
+                            "stream ended without terminal event")
+                    except (ConnectionError, socket.timeout, OSError,
+                            _http_client.HTTPException) as e:
+                        dropped = e
+            finally:
+                conn.close()
+            # reconnect path: the stream died mid-flight.  Resume is
+            # only safe when the server issued SSE ids (a resumable,
+            # scheduler-backed generation) OR nothing was delivered yet
+            # (a fresh re-send cannot duplicate); re-running a
+            # non-resumable generation after yielding tokens would
+            # duplicate them (and re-execute server-side effects like
+            # KV-cache parking), so that fails instead.
+            attempt += 1
+            if (not resume or attempt > max_reconnects
+                    or (yielded_any and last_event_id is None)):
+                reason = (
+                    " (resume disabled)" if not resume
+                    else " (generation is not resumable: the server sent"
+                         " no event ids)"
+                    if yielded_any and last_event_id is None
+                    else ""
+                )
+                raise InferenceServerException(
+                    "generate_stream connection lost{}: {}".format(
+                        reason, dropped))
+            if on_reconnect is not None:
+                on_reconnect(attempt, dropped)
+            time.sleep(min(reconnect_backoff_s * (2 ** (attempt - 1)), 2.0))
+
     def async_infer(
         self,
         model_name,

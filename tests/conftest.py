@@ -25,6 +25,38 @@ if SRC_PY not in sys.path:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """Chaos/pool tests spin up supervisors, probers, and replay
+    machinery; every one of those threads is contractually a *daemon*
+    that dies with its owner.  This guard fails the test that leaks a
+    NON-daemon thread — the kind that would wedge interpreter shutdown
+    — at the source, instead of letting the whole session hang at
+    exit."""
+    import threading
+    import time as _time
+
+    if not (request.node.get_closest_marker("chaos")
+            or request.node.get_closest_marker("pool")):
+        yield
+        return
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    leaked = []
+    deadline = _time.monotonic() + 5.0
+    while _time.monotonic() < deadline:
+        leaked = [
+            t for t in threading.enumerate()
+            if t.ident not in before and t.is_alive() and not t.daemon
+        ]
+        if not leaked:
+            return
+        _time.sleep(0.05)  # teardown grace: joins may still be running
+    pytest.fail(
+        "test leaked non-daemon thread(s): {}".format(
+            [t.name for t in leaked]))
+
+
 @pytest.fixture(scope="session")
 def server_core():
     """A shared in-process server core with the fixture model zoo."""

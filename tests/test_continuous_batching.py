@@ -237,6 +237,41 @@ def test_scheduler_closes_cleanly():
         _generate(core, PROMPTS[1], 3)
 
 
+def test_nan_poisoned_neighbor_leaves_cobatched_tokens_identical(
+        scheduled_core, reference_tokens):
+    """Quarantine determinism: greedy tokens of co-batched streams are
+    byte-identical with and without a NaN-poisoned neighbor.  The
+    poisoned slot fails alone with the typed SlotQuarantined (422); the
+    batched step's row-independent math means the survivors never see
+    the poison."""
+    from tpuserver import faults
+    from tpuserver.scheduler import SlotQuarantined
+
+    model = scheduled_core._models["llama_generate"]
+    # warm: the scheduler exists and slot 0 is free
+    _generate(scheduled_core, PROMPTS[3], 2)
+    sched = model._scheduler
+    victim = sched.submit(PROMPTS[0], MAX_TOKENS[0])
+    next(victim)  # victim is live in slot 0
+    try:
+        # poison slot 0's logits row on the next step
+        faults.install("scheduler.step", mode="nan", times=1, delay=0)
+        survivors = _generate_concurrently(
+            scheduled_core, PROMPTS[1:3], MAX_TOKENS[1:3])
+        assert survivors == reference_tokens[1:3]
+        with pytest.raises(SlotQuarantined):
+            list(victim)
+    finally:
+        faults.clear("scheduler.step")
+    # the loop survived: no restart, healthy, slot reusable with
+    # identical numerics
+    stats = sched.stats()
+    assert stats["restarts"] == 0 and stats["quarantined"] == 1
+    assert model.healthy()
+    assert _generate(
+        scheduled_core, PROMPTS[0], MAX_TOKENS[0]) == reference_tokens[0]
+
+
 # -- through the real frontends ----------------------------------------------
 
 
@@ -329,15 +364,25 @@ def test_http_generate_stream_matches_sequential(reference_tokens):
             assert resp.status == 200
             assert resp.getheader("Content-Type") == "text/event-stream"
             tokens = []
-            for event in resp.read().decode("utf-8").split("\n\n"):
-                if not event.startswith("data: "):
+            ids = []
+            for line in resp.read().decode("utf-8").split("\n"):
+                if line.startswith("id: "):
+                    ids.append(line[len("id: "):])
+                if not line.startswith("data: "):
                     continue
-                payload = json.loads(event[len("data: "):])
+                payload = json.loads(line[len("data: "):])
                 assert "error" not in payload, payload
                 for out in payload.get("outputs", []):
                     if out["name"] == "TOKEN":
                         tokens.append(out["data"][0])
             assert tokens == reference_tokens[0][:6]
+            # resumable-stream contract: every event carries an SSE id
+            # "<generation_id>/<seq>" with contiguous 0-based seqs
+            assert len(ids) == len(tokens)
+            gen_ids = {i.rsplit("/", 1)[0] for i in ids}
+            assert len(gen_ids) == 1
+            assert [int(i.rsplit("/", 1)[1]) for i in ids] == list(
+                range(len(tokens)))
         finally:
             conn.close()
     finally:

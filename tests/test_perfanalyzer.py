@@ -507,3 +507,40 @@ def test_queue_split_surfaces_through_both_clients():
         grpc_f.stop()
         http.stop()
         core.close()
+
+
+# -- generation profiler: resume accounting ---------------------------------
+
+
+class _FlakyGenBackend(ClientBackend):
+    """Generation backend whose every stream 'reconnects' once mid-way
+    — the shape a chaos run produces through the clients' auto-resume
+    paths."""
+
+    kind = "flaky-gen"
+    supports_generation = True
+
+    def generate_stream(self, model, inputs, parameters=None, stats=None):
+        yield 1
+        yield 1
+        if stats is not None:  # the transparent mid-stream reconnect
+            stats["resumes"] = stats.get("resumes", 0) + 1
+        yield 1
+
+
+def test_generation_profiler_reports_resumed_streams():
+    from perfanalyzer.generation import GenerationProfiler
+
+    profiler = GenerationProfiler(
+        _FlakyGenBackend(), "m", input_pool=[{}],
+        measurement_interval_s=0.05, max_trials=3, stability_windows=2)
+    try:
+        result = profiler.profile_level(2)
+    finally:
+        profiler.stop()
+    # every completed generation resumed exactly once: the report must
+    # surface the degradation instead of hiding it behind the splice
+    assert result["generations"] > 0
+    assert result["resumed_streams"] == result["generations"]
+    assert result["resume_events"] == result["resumed_streams"]
+    assert result["errors"] == 0

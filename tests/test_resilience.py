@@ -595,21 +595,23 @@ def test_timeout_parameter_keeps_request_batchable():
     np.testing.assert_array_equal(out, data + data)
 
 
-def test_loop_crash_fails_streams_and_trips_watchdog():
-    """An unexpected decode-loop death (not the step-recovery path)
-    trips the watchdog, delivers a terminal error to every consumer
-    (never a hang), and a later submit restarts a fresh loop."""
-    sched = DecodeScheduler({}, None, 2, 64)  # no fns: loop crashes
+def test_loop_crash_exhausts_restart_budget_and_trips():
+    """A persistent decode-loop death burns the supervisor's restart
+    budget, then trips permanently: every consumer gets a terminal
+    typed error (never a hang), readiness flips false, and later
+    submits are rejected typed."""
+    sched = DecodeScheduler({}, None, 2, 64, max_restarts=2,
+                            restart_backoff_s=0.01)  # no fns: crashes
     stream = sched.submit(np.array([1, 2], np.int32), 4)
-    with pytest.raises(KeyError):
+    with pytest.raises(SchedulerClosed, match="restart budget exhausted"):
         list(stream)
     assert not sched.healthy
-    assert sched.stats()["live_streams"] == 0
-    # the dying thread unregistered itself, so this submit starts a
-    # fresh loop (which crashes again) — and still delivers an error
-    stream2 = sched.submit(np.array([1], np.int32), 1)
-    with pytest.raises(KeyError):
-        list(stream2)
+    stats = sched.stats()
+    assert stats["tripped"] and stats["restarts"] == 2
+    assert stats["live_streams"] == 0
+    # tripped is sticky: the replica must be drained, not resubmitted
+    with pytest.raises(SchedulerClosed, match="tripped"):
+        sched.submit(np.array([1], np.int32), 1)
     sched.close()
 
 

@@ -19,6 +19,14 @@ the invariants PR 2 promises:
 Usage:
     python tools/chaos_smoke.py [--rounds N] [--slots K] [--budget T]
     python tools/chaos_smoke.py --pool [--cycles N] [--soak M]
+    python tools/chaos_smoke.py --kill-loop [--rounds N]
+
+``--kill-loop`` soaks the supervised-restart layer: every round kills
+the decode loop mid-traffic (injected step failure = loop death) while
+concurrent generations are in flight, and asserts the supervisor
+auto-restarted with ZERO lost or corrupted streams — every request
+completes with tokens identical to the fault-free reference, restart
+counters rise accordingly, and the scheduler never trips.
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -331,6 +339,73 @@ def pool_phase(cycles, soak):
             f.stop()
 
 
+def kill_loop_phase(rounds, slots, budget):
+    """Repeatedly kill the decode loop mid-traffic; assert supervised
+    auto-restart with zero lost or corrupted streams."""
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=slots,
+        max_restarts=rounds + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.01)
+    core = InferenceServer([model])
+    print("warming up (compiles the scheduler fns)...")
+    reference = [generate(core, p, budget) for p in PROMPTS]
+    print("reference captured; killing the loop {} times "
+          "mid-traffic".format(rounds))
+
+    for rnd in range(rounds):
+        restarts_before = model._scheduler.stats()["restarts"]
+        outcomes = [None] * len(PROMPTS)
+        started = threading.Event()
+
+        def worker(i):
+            if i == 0:
+                started.set()
+            try:
+                outcomes[i] = ("ok", generate(core, PROMPTS[i], budget))
+            except ServerError as e:
+                outcomes[i] = ("err", e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(PROMPTS))
+        ]
+        for t in threads:
+            t.start()
+        started.wait(timeout=10)
+        time.sleep(0.01)  # streams in flight on the loop
+        # one unattributable step failure = loop death
+        faults.install("scheduler.step", mode="raise", times=1)
+        for t in threads:
+            t.join(timeout=120)
+        faults.clear("scheduler.step")
+
+        stats = model._scheduler.stats()
+        for i, outcome in enumerate(outcomes):
+            if outcome is None:
+                fail("kill-loop round {}: request {} never "
+                     "terminated".format(rnd, i))
+            elif outcome[0] != "ok":
+                fail("kill-loop round {}: request {} failed instead of "
+                     "healing: {}".format(rnd, i, outcome[1]))
+            elif outcome[1] != reference[i]:
+                fail("kill-loop round {}: request {} tokens corrupted: "
+                     "{} != {}".format(rnd, i, outcome[1], reference[i]))
+        if stats["tripped"]:
+            fail("kill-loop round {}: scheduler tripped inside the "
+                 "budget".format(rnd))
+        if not model.healthy():
+            fail("kill-loop round {}: unhealthy after restart".format(rnd))
+        wait_no_leaks(model, "kill-loop round {}".format(rnd))
+        print("round {:2d} restarts {} -> {} outcomes={}".format(
+            rnd, restarts_before, stats["restarts"],
+            [o[0] if o else "hang" for o in outcomes]))
+
+    core.drain(timeout=10.0)
+    if core.server_state() != "stopped":
+        fail("kill-loop drain did not stop the server (state={})".format(
+            core.server_state()))
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -343,6 +418,11 @@ def main():
                         help="soak the multi-replica pool layer instead "
                              "(SIGTERM-drain one of two replicas on a "
                              "cycle)")
+    parser.add_argument("--kill-loop", action="store_true",
+                        help="soak the supervised-restart layer instead: "
+                             "kill the decode loop mid-traffic every "
+                             "round, assert auto-restart with zero lost "
+                             "or corrupted streams")
     parser.add_argument("--cycles", type=int, default=4,
                         help="pool mode: drain/revive cycles (default 4)")
     parser.add_argument("--soak", type=int, default=40,
@@ -363,8 +443,26 @@ def main():
               "all invariants held".format(args.cycles, elapsed))
         return 0
 
+    if args.kill_loop:
+        t0 = time.monotonic()
+        kill_loop_phase(args.rounds, args.slots, args.budget)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nkill-loop chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nkill-loop chaos smoke OK: {} loop kills healed, "
+              "{:.1f}s, zero lost or corrupted streams".format(
+                  args.rounds, elapsed))
+        return 0
+
     model = LlamaGenerateModel(
-        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=args.slots)
+        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=args.slots,
+        # every step/fetch round of the cycle costs one supervised
+        # restart on purpose; the budget must outlast the soak
+        max_restarts=args.rounds + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.01)
     core = InferenceServer([model])
     print("warming up (compiles the scheduler fns)...")
     reference = [generate(core, p, args.budget) for p in PROMPTS]
