@@ -30,10 +30,10 @@ class LoadCollector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._open = False
-        self._latencies = []
-        self._errors = 0
-        self._completions = 0
+        self._open = False       # guarded-by: _lock
+        self._latencies = []     # guarded-by: _lock
+        self._errors = 0         # guarded-by: _lock
+        self._completions = 0    # guarded-by: _lock
         self._cond = threading.Condition(self._lock)
 
     def start_window(self):
@@ -100,12 +100,13 @@ class ConcurrencyManager:
         self.prepared = list(prepared)
         self.collector = collector or LoadCollector()
         self._cond = threading.Condition()
-        self._free = 0    # contexts on the free-list
-        self._live = 0    # contexts in circulation (free + in flight)
-        self._target = 0
-        self._inflight = 0
-        self._stopping = False
-        self._cursor = 0
+        self._free = 0    # contexts on the free-list  # guarded-by: _cond
+        # contexts in circulation (free + in flight)  # guarded-by: _cond
+        self._live = 0
+        self._target = 0    # guarded-by: _cond
+        self._inflight = 0  # guarded-by: _cond
+        self._stopping = False  # guarded-by: _cond
+        self._cursor = 0    # guarded-by: _cond
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop,
             name="perfanalyzer-concurrency-dispatch", daemon=True)
@@ -208,9 +209,9 @@ class RequestRateManager:
         self.collector = collector or LoadCollector()
         self._sender = None
         self._stop_event = threading.Event()
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _inflight_lock
         self._inflight_lock = threading.Lock()
-        self._capacity_warned = False
+        self._capacity_warned = False  # guarded-by: _inflight_lock
 
     def change_level(self, rate):
         """(Re)start the sender at ``rate`` requests/second."""

@@ -1,0 +1,44 @@
+"""tpulint — project-specific concurrency & protocol-invariant static
+analysis for the tpuserver/tritonclient/perfanalyzer stack.
+
+The serving stack's correctness rests on conventions a type checker
+cannot see: which fields a lock guards, that nothing blocks while
+holding one, that every deadline is monotonic-clock math, that every
+typed error is mapped on both wire protocols and documented, that every
+thread dies with its owner, and that every fault-injection point is
+registered.  tpulint turns those conventions into a tier-1 gate: one
+shared AST pass (tpulint.analysis) feeds six rules, findings are
+suppressible inline (``# tpulint: disable=R1``) or via a checked-in
+baseline, and ``tools/tpulint.py`` is the CLI front door.
+
+Rule catalog (details + examples: docs/static_analysis.md):
+
+====  ======================  ============================================
+R1    guarded-by              annotated fields only touched under their
+                              lock (``# guarded-by: _lock``)
+R2    no-blocking-under-lock  no sleep/join/socket/Future.result inside a
+                              held-lock block; lock-order graph acyclic
+R3    monotonic-clock         no wall-clock reads; deadline math is
+                              time.monotonic() only
+R4    wire-map                every ServerError subclass mapped in HTTP +
+                              gRPC maps + docs table; one definition each
+R5    thread-lifecycle        every Thread daemon=True or joined on a
+                              close()/stop()/drain() path
+R6    fault-registry          every faults.fire() site registered in
+                              faults.POINTS, exactly one site per point
+====  ======================  ============================================
+"""
+
+from tpulint.findings import Finding
+from tpulint.runner import (
+    ALL_RULES,
+    RULES_BY_ID,
+    LintResult,
+    lint_paths,
+    select_rules,
+)
+
+__all__ = [
+    "ALL_RULES", "Finding", "LintResult", "RULES_BY_ID", "lint_paths",
+    "select_rules",
+]

@@ -1,0 +1,478 @@
+"""The shared AST pass every tpulint rule plugs into.
+
+One walk per module produces a :class:`ModuleInfo`: class/function scopes,
+the *lexical lock context* of every attribute access and call (which
+``with self._lock`` / ``with self._cond`` blocks enclose it), ``#
+guarded-by:`` annotations, and ``# tpulint: disable=`` suppressions.
+Rules (tpulint.rules_*) consume the finished ModuleInfos — they never
+re-walk the AST — so adding a rule costs one function over pre-indexed
+facts, not another traversal.
+
+Conventions the pass encodes (see docs/static_analysis.md):
+
+- A ``with self.X:`` / ``with X:`` statement whose context expression is
+  a bare name or ``self`` attribute is treated as acquiring lock ``X``
+  (locks are objects used as context managers without a call — files,
+  ``injected(...)`` and friends are calls and don't count).
+- A method whose name ends in ``_locked`` is *called with its class's
+  locks held* by project convention; accesses inside it satisfy R1 and
+  its body counts as lock context for R2's blocking-call check.
+- Lock context is **lexical**: a nested ``def`` (closure/callback) does
+  not inherit the enclosing ``with`` — its body runs later, on another
+  thread, without the lock.
+"""
+
+import ast
+import re
+import tokenize
+
+GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_]\w*)")
+SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+#: Synthetic lock token for ``*_locked``-suffix methods (convention:
+#: caller holds the class's locks).
+CONVENTION = "<locked-suffix>"
+
+
+class AttrAccess:
+    """One ``self.X`` load/store with its lexical lock context."""
+
+    __slots__ = ("attr", "lineno", "col", "is_store", "locks", "cls",
+                 "func")
+
+    def __init__(self, attr, lineno, col, is_store, locks, cls, func):
+        self.attr = attr
+        self.lineno = lineno
+        self.col = col
+        self.is_store = is_store
+        self.locks = locks  # frozenset of held lock names ('_cond', ...)
+        self.cls = cls      # ClassInfo or None
+        self.func = func    # FunctionInfo or None
+
+
+class CallSite:
+    """One call expression with its lexical lock context."""
+
+    __slots__ = ("node", "dotted", "lineno", "locks", "cls", "func")
+
+    def __init__(self, node, dotted, lineno, locks, cls, func):
+        self.node = node
+        self.dotted = dotted  # best-effort dotted repr ('time.sleep',
+        #                       'self._cond.wait', 'thread.join', ...)
+        self.lineno = lineno
+        self.locks = locks
+        self.cls = cls
+        self.func = func
+
+
+class WithLock:
+    """One ``with <lock>:`` acquisition and the locks already held."""
+
+    __slots__ = ("lock", "lineno", "held", "cls", "func")
+
+    def __init__(self, lock, lineno, held, cls, func):
+        self.lock = lock  # lock name ('_cond', module-level '_lock', ...)
+        self.lineno = lineno
+        self.held = held  # frozenset held at acquisition time
+        self.cls = cls
+        self.func = func
+
+
+class ThreadCreation:
+    """One ``threading.Thread(...)`` call."""
+
+    __slots__ = ("node", "lineno", "daemon", "target_attr", "cls", "func")
+
+    def __init__(self, node, lineno, daemon, target_attr, cls, func):
+        self.node = node
+        self.lineno = lineno
+        self.daemon = daemon  # True / False / None (absent or dynamic)
+        # the self attribute the Thread object lands in (best effort):
+        # 'self.X = Thread(...)', 'self.X = [Thread(...) ...]', or
+        # 'self.X.append(Thread(...))'
+        self.target_attr = target_attr
+        self.cls = cls
+        self.func = func
+
+
+class FunctionInfo:
+    __slots__ = ("name", "lineno", "node", "cls", "assume_locked")
+
+    def __init__(self, name, lineno, node, cls):
+        self.name = name
+        self.lineno = lineno
+        self.node = node
+        self.cls = cls
+        self.assume_locked = name.endswith("_locked")
+
+
+class ClassInfo:
+    __slots__ = ("name", "lineno", "node", "module", "bases", "methods",
+                 "guarded", "init_code_kw", "lock_aliases")
+
+    def __init__(self, name, lineno, node, module, bases):
+        self.name = name
+        self.lineno = lineno
+        self.node = node
+        self.module = module  # ModuleInfo backref
+        self.bases = bases    # list of dotted base names
+        self.methods = {}     # name -> FunctionInfo
+        self.guarded = {}     # attr -> (lock name, declaring lineno)
+        # code= kwarg of super().__init__(...) in this class's __init__,
+        # when it is a literal (R4's wire-code extraction)
+        self.init_code_kw = None
+        # 'self._cond = threading.Condition(self._lock)' makes _cond and
+        # _lock the SAME lock: holding either satisfies waits/guards on
+        # the other
+        self.lock_aliases = {}  # attr -> aliased attr
+
+
+class ModuleInfo:
+    """Everything one rule could need about one source file."""
+
+    def __init__(self, path, relpath):
+        self.path = path
+        self.relpath = relpath
+        self.tree = None
+        self.source = ""
+        self.comments = {}      # lineno -> full comment text
+        self.comment_only_lines = set()  # lines holding ONLY a comment
+        self.suppressions = {}  # lineno -> set of rule tokens (lowercase)
+        self.classes = {}       # name -> ClassInfo
+        self.functions = []     # every FunctionInfo (methods included)
+        self.attr_accesses = []  # [AttrAccess]
+        self.call_sites = []     # [CallSite]
+        self.with_locks = []     # [WithLock]
+        self.thread_creations = []  # [ThreadCreation]
+        self.dict_assignments = {}  # NAME -> dict literal node (top level)
+        self.func_dicts = {}     # func name -> first dict literal inside
+
+    def suppressed(self, lineno, rule_tokens):
+        """Whether a finding of a rule (any of its name tokens) is
+        suppressed on this line, or on a comment-only line directly
+        above (a trailing comment annotates ONLY its own line — it must
+        never leak onto the next statement)."""
+        for ln in (lineno, lineno - 1):
+            if ln != lineno and ln not in self.comment_only_lines:
+                continue
+            tokens = self.suppressions.get(ln)
+            if not tokens:
+                continue
+            if "all" in tokens:
+                return True
+            if tokens & rule_tokens:
+                return True
+        return False
+
+
+def _dotted(node):
+    """Best-effort dotted repr of a call target expression."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(_dotted(node.func) + "()")
+    else:
+        parts.append("<expr>")
+    return ".".join(reversed(parts))
+
+
+def _lock_name(expr):
+    """The lock name of a with-item context expression, or None.
+
+    ``with self._lock:`` -> '_lock'; ``with _lock:`` (module-level) ->
+    '_lock'.  Calls (``with injected(...):``), subscripts, and chained
+    attributes are not lock acquisitions.
+    """
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _collect_comments(source, info):
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                lineno = tok.start[0]
+                info.comments[lineno] = tok.string
+                if lineno <= len(lines) and \
+                        lines[lineno - 1].lstrip().startswith("#"):
+                    info.comment_only_lines.add(lineno)
+    except (tokenize.TokenError, IndentationError):
+        pass
+    for lineno, text in info.comments.items():
+        m = SUPPRESS_RE.search(text)
+        if m:
+            info.suppressions[lineno] = {
+                t.strip().lower() for t in m.group(1).split(",") if t.strip()
+            }
+
+
+class _Walker(ast.NodeVisitor):
+    def __init__(self, info):
+        self.info = info
+        self.cls = None        # innermost ClassInfo
+        self.func = None       # innermost FunctionInfo
+        self.locks = []        # held lock-name stack (lexical)
+
+    # -- scopes ------------------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        bases = [_dotted(b) for b in node.bases]
+        cls = ClassInfo(node.name, node.lineno, node, self.info, bases)
+        # nested classes register flat by name; duplicates keep the first
+        self.info.classes.setdefault(node.name, cls)
+        prev_cls, prev_func, prev_locks = self.cls, self.func, self.locks
+        self.cls, self.func, self.locks = cls, None, []
+        self.generic_visit(node)
+        self.cls, self.func, self.locks = prev_cls, prev_func, prev_locks
+
+    def _visit_function(self, node):
+        fn = FunctionInfo(node.name, node.lineno, node, self.cls)
+        self.info.functions.append(fn)
+        if self.cls is not None and self.func is None:
+            self.cls.methods.setdefault(node.name, fn)
+        prev_func, prev_locks = self.func, self.locks
+        # lexical lock context does NOT cross a def boundary: the body
+        # runs later, possibly on another thread, without the lock
+        self.func, self.locks = fn, []
+        self.generic_visit(node)
+        self.func, self.locks = prev_func, prev_locks
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- lock context ------------------------------------------------------
+
+    def _held(self):
+        held = set(self.locks)
+        if self.func is not None and self.func.assume_locked:
+            held.add(CONVENTION)
+        return frozenset(held)
+
+    def visit_With(self, node):
+        # items acquire SEQUENTIALLY: in `with self._a, self._b:` the
+        # second item's acquisition (and its context expression) runs
+        # with the first already held — so each item is recorded, and
+        # visited, under the locks of the items before it, building the
+        # a->b order edge a flattened treatment would miss
+        acquired = 0
+        for item in node.items:
+            name = _lock_name(item.context_expr)
+            # the context expression evaluates BEFORE its own lock is
+            # taken, but under every earlier item's lock
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            if name is not None:
+                self.info.with_locks.append(WithLock(
+                    name, item.context_expr.lineno, self._held(),
+                    self.cls, self.func,
+                ))
+                self.locks.append(name)
+                acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(acquired):
+            self.locks.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- facts -------------------------------------------------------------
+
+    def visit_Attribute(self, node):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            self.info.attr_accesses.append(AttrAccess(
+                node.attr, node.lineno, node.col_offset,
+                isinstance(node.ctx, (ast.Store, ast.Del)),
+                self._held(), self.cls, self.func,
+            ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        self.info.call_sites.append(CallSite(
+            node, dotted, node.lineno, self._held(), self.cls, self.func,
+        ))
+        if dotted in ("threading.Thread", "Thread", "_threading.Thread"):
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = (kw.value.value
+                              if isinstance(kw.value, ast.Constant)
+                              else None)
+            self.info.thread_creations.append(ThreadCreation(
+                node, node.lineno, daemon, None, self.cls, self.func,
+            ))
+        # R4: super().__init__(msg, code=N) inside an __init__
+        if (dotted.endswith("super().__init__")
+                and self.cls is not None
+                and self.func is not None
+                and self.func.name == "__init__"):
+            for kw in node.keywords:
+                if kw.arg == "code" and isinstance(kw.value, ast.Constant):
+                    self.cls.init_code_kw = kw.value.value
+        self.generic_visit(node)
+        # R5: `self.X.append(threading.Thread(...))` stores the thread
+        # in self.X just like `self.X = Thread(...)` — attribute it so
+        # a close() that joins the collection counts (generic_visit
+        # above already recorded the ThreadCreation nodes inside args)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("append", "add")
+                and isinstance(node.func.value, ast.Attribute)
+                and isinstance(node.func.value.value, ast.Name)
+                and node.func.value.value.id == "self"):
+            for tc in self.info.thread_creations:
+                if tc.target_attr is None and any(
+                        _contains(arg, tc.node) for arg in node.args):
+                    tc.target_attr = node.func.value.attr
+
+    def visit_Assign(self, node):
+        # guarded-by annotations: trailing comment on the assignment's
+        # first line, or an annotation comment on its own line above
+        for target in node.targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                    and self.cls is not None):
+                for ln in (node.lineno, node.lineno - 1):
+                    if ln != node.lineno and \
+                            ln not in self.info.comment_only_lines:
+                        continue  # a trailing comment annotates only
+                        #           its OWN line's assignment
+                    comment = self.info.comments.get(ln)
+                    if comment:
+                        m = GUARDED_BY_RE.search(comment)
+                        if m:
+                            self.cls.guarded.setdefault(
+                                target.attr, (m.group(1), node.lineno))
+                            break
+                # Condition-over-explicit-lock aliasing
+                if (isinstance(node.value, ast.Call)
+                        and _dotted(node.value.func).endswith("Condition")
+                        and node.value.args
+                        and isinstance(node.value.args[0], ast.Attribute)
+                        and isinstance(node.value.args[0].value, ast.Name)
+                        and node.value.args[0].value.id == "self"):
+                    self.cls.lock_aliases[target.attr] = (
+                        node.value.args[0].attr)
+        # top-level dict literals by name (R6's POINTS registry, R4's
+        # _STATUS_LINE map)
+        if (self.cls is None and self.func is None
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Dict)):
+            self.info.dict_assignments[node.targets[0].id] = node.value
+        self.generic_visit(node)
+        # late: Thread creations inside node.value were recorded by the
+        # generic visit above; attribute them now
+        for target in node.targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                for tc in self.info.thread_creations:
+                    if tc.target_attr is None and _contains(node.value,
+                                                            tc.node):
+                        tc.target_attr = target.attr
+
+
+def _contains(root, needle):
+    for sub in ast.walk(root):
+        if sub is needle:
+            return True
+    return False
+
+
+def _index_func_dicts(info):
+    """First dict literal returned/used inside each module-level
+    function (R4 reads the gRPC ``_status_code`` mapping this way)."""
+    for fn in info.functions:
+        if fn.cls is not None:
+            continue
+        for sub in ast.walk(fn.node):
+            if isinstance(sub, ast.Dict):
+                info.func_dicts.setdefault(fn.name, sub)
+                break
+
+
+def analyze_source(source, path, relpath):
+    """Parse one file into a ModuleInfo (raises SyntaxError upward)."""
+    info = ModuleInfo(path, relpath)
+    info.source = source
+    _collect_comments(source, info)
+    info.tree = ast.parse(source, filename=path)
+    _Walker(info).visit(info.tree)
+    _index_func_dicts(info)
+    return info
+
+
+def analyze_file(path, relpath):
+    with open(path, "r", encoding="utf-8") as fh:
+        return analyze_source(fh.read(), path, relpath)
+
+
+def resolve_hierarchy(modules, root_name):
+    """Map class name -> ClassInfo for every class whose base chain
+    (resolved by name across ``modules``) reaches ``root_name``.
+
+    The root class itself is excluded.  Name resolution is flat — this
+    codebase keeps exception hierarchies unique by class name, which is
+    exactly what rule R4's twin-definition check enforces.
+    """
+    by_name = {}
+    for mod in modules:
+        for cls in mod.classes.values():
+            by_name.setdefault(cls.name, cls)
+    result = {}
+
+    def reaches_root(name, seen):
+        if name == root_name:
+            return True
+        cls = by_name.get(name)
+        if cls is None or name in seen:
+            return False
+        seen.add(name)
+        return any(
+            reaches_root(base.rsplit(".", 1)[-1], seen)
+            for base in cls.bases
+        )
+
+    for mod in modules:
+        for cls in mod.classes.values():
+            if cls.name == root_name:
+                continue
+            if any(reaches_root(b.rsplit(".", 1)[-1], {cls.name})
+                   for b in cls.bases):
+                result.setdefault(cls.name, []).append(cls)
+    return result
+
+
+def resolve_wire_code(cls, hierarchy_modules):
+    """The HTTP code a ServerError subclass carries: its own literal
+    ``code=`` kwarg, or the nearest ancestor's.  None when dynamic."""
+    by_name = {}
+    for mod in hierarchy_modules:
+        for c in mod.classes.values():
+            by_name.setdefault(c.name, c)
+    seen = set()
+    cur = cls
+    while cur is not None and cur.name not in seen:
+        seen.add(cur.name)
+        if cur.init_code_kw is not None:
+            return cur.init_code_kw
+        nxt = None
+        for base in cur.bases:
+            cand = by_name.get(base.rsplit(".", 1)[-1])
+            if cand is not None:
+                nxt = cand
+                break
+        cur = nxt
+    return None

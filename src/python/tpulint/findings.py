@@ -1,0 +1,100 @@
+"""Findings, suppression filtering, and the checked-in baseline.
+
+A finding renders as ``path:line RULE(name) message``.  Its *fingerprint*
+deliberately omits the line number — ``path|RULE|message`` — so a
+baselined finding survives unrelated edits above it; messages are
+written to be stable (they name attributes/classes, never positions).
+
+Baseline file format: one fingerprint per line, ``#`` comments and blank
+lines ignored.  Matching is multiset semantics — two identical findings
+need two identical baseline lines.  Entries that no longer match any
+finding are *stale* and reported for expiry (``--update-baseline``
+rewrites the file from the current findings).
+"""
+
+
+class Finding:
+    __slots__ = ("rule", "rule_name", "path", "lineno", "message")
+
+    def __init__(self, rule, rule_name, path, lineno, message):
+        self.rule = rule            # 'R1'..'R6'
+        self.rule_name = rule_name  # 'guarded-by', ...
+        self.path = path            # repo-relative
+        self.lineno = lineno
+        self.message = message
+
+    @property
+    def fingerprint(self):
+        return "{}|{}|{}".format(self.path, self.rule, self.message)
+
+    def render(self):
+        return "{}:{} {}({}) {}".format(
+            self.path, self.lineno, self.rule, self.rule_name, self.message
+        )
+
+    def __repr__(self):
+        return "<Finding {}>".format(self.render())
+
+    def sort_key(self):
+        return (self.path, self.lineno, self.rule, self.message)
+
+
+def filter_suppressed(findings, modules_by_path):
+    """Drop findings carrying a ``# tpulint: disable=`` on their line
+    (or the line above).  Rule id and rule name both work as tokens."""
+    kept = []
+    for f in findings:
+        mod = modules_by_path.get(f.path)
+        tokens = {f.rule.lower(), f.rule_name.lower()}
+        if mod is not None and mod.suppressed(f.lineno, tokens):
+            continue
+        kept.append(f)
+    return kept
+
+
+def load_baseline(path):
+    """Baseline fingerprints as an ordered list (multiset semantics)."""
+    entries = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    entries.append(line)
+    except FileNotFoundError:
+        pass
+    return entries
+
+
+def apply_baseline(findings, baseline_entries):
+    """Split findings into (new, grandfathered) and report stale
+    baseline entries: ``(new_findings, grandfathered, stale_entries)``."""
+    budget = {}
+    for entry in baseline_entries:
+        budget[entry] = budget.get(entry, 0) + 1
+    new, grandfathered = [], []
+    for f in sorted(findings, key=Finding.sort_key):
+        fp = f.fingerprint
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for entry in baseline_entries:
+        if budget.get(entry, 0) > 0:
+            budget[entry] -= 1
+            stale.append(entry)
+    return new, grandfathered, stale
+
+
+def write_baseline(path, findings, header=""):
+    lines = ["# tpulint baseline — grandfathered findings.",
+             "# One fingerprint (path|RULE|message) per line; regenerate",
+             "# with: python tools/tpulint.py --update-baseline"]
+    if header:
+        lines.append("# " + header)
+    for f in sorted(findings, key=Finding.sort_key):
+        lines.append(f.fingerprint)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")

@@ -1,0 +1,133 @@
+"""R3 monotonic-clock discipline.
+
+Every deadline, timeout, liveness stamp, and interval in this codebase
+is ``time.monotonic()`` math — wall clocks jump (NTP steps, suspend)
+and a jumped deadline either fires years early or never.  The rule:
+
+1. **wall-clock reads are banned** in linted code: any call to
+   ``time.time`` / ``time.time_ns`` / ``datetime.now`` /
+   ``datetime.utcnow`` is a finding.  The single sanctioned wall-clock
+   site is ``tpuserver._clock.wall_clock_ms()`` — the wire-format
+   reporting boundary, suppressed inline where it is defined.
+2. **flow check**: a name assigned from a wall-clock call must not be
+   compared, used in arithmetic, passed to a ``timeout=``/``deadline=``
+   parameter or a ``.wait(...)`` call, or stored into a deadline-named
+   target — each such use is its own finding (the fixture suite's
+   taint cases; on a clean tree check 1 already keeps these at zero).
+"""
+
+import ast
+
+from tpulint.analysis import _dotted
+from tpulint.findings import Finding
+
+_WALL_CALLS = {
+    "time.time", "time.time_ns",
+    "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+_SINK_NAME = ("deadline", "expire", "expiry", "until", "timeout")
+
+
+def _is_wall_call(node):
+    return isinstance(node, ast.Call) and _dotted(node.func) in _WALL_CALLS
+
+
+def _nested_def(node):
+    return isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+
+
+def _walk_own_scope(fn_node):
+    """Every node of a function's OWN body, in DOCUMENT order — the
+    taint pass needs an assignment yielded before every later use,
+    regardless of how deeply the assignment is nested (pre-order DFS;
+    breadth-first would pop a shallow sink before a deeper, lexically
+    earlier assignment).  Nested def subtrees are pruned, not just
+    skipped: they have their own FunctionInfo, and analyzing them here
+    would double-report their defects and leak the outer scope's taint
+    into a different runtime scope."""
+    stack = [n for n in reversed(fn_node.body) if not _nested_def(n)]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(
+            child for child in
+            reversed(list(ast.iter_child_nodes(node)))
+            if not _nested_def(child))
+
+
+class MonotonicClockRule:
+    id = "R3"
+    name = "monotonic-clock"
+
+    def check(self, modules, config):
+        findings = []
+        for mod in modules:
+            findings.extend(self._check_module(mod))
+        return findings
+
+    def _check_module(self, mod):
+        findings = []
+        # check 1: ban the calls outright
+        for site in mod.call_sites:
+            if site.dotted in _WALL_CALLS:
+                findings.append(Finding(
+                    self.id, self.name, mod.relpath, site.lineno,
+                    "wall-clock read {}(): deadlines/timeouts/liveness "
+                    "must use time.monotonic(); wire-format wall-clock "
+                    "stamps go through tpuserver._clock.wall_clock_ms()"
+                    .format(site.dotted),
+                ))
+
+        # check 2: per-function taint of wall-clock values into
+        # deadline/timeout sinks
+        for fn in mod.functions:
+            findings.extend(self._check_flow(mod, fn))
+        return findings
+
+    def _check_flow(self, mod, fn):
+        findings = []
+        tainted = set()
+
+        def value_tainted(node):
+            for sub in ast.walk(node):
+                if _is_wall_call(sub):
+                    return True
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return True
+            return False
+
+        def flag(node, how):
+            findings.append(Finding(
+                self.id, self.name, mod.relpath, node.lineno,
+                "wall-clock-derived value {} in {}(): deadline/timeout "
+                "arithmetic must originate from time.monotonic()".format(
+                    how, fn.name),
+            ))
+
+        for node in _walk_own_scope(fn.node):
+            if isinstance(node, ast.Assign) and value_tainted(node.value):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        tainted.add(target.id)
+                    name = getattr(target, "attr",
+                                   getattr(target, "id", ""))
+                    if any(s in name.lower() for s in _SINK_NAME):
+                        flag(node, "stored into deadline-named "
+                                   "'{}'".format(name))
+            elif isinstance(node, ast.Compare):
+                if value_tainted(node):
+                    flag(node, "used in a comparison")
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg in ("timeout", "deadline",
+                                  "timeout_s", "deadline_s") and \
+                            value_tainted(kw.value):
+                        flag(node, "passed as {}=".format(kw.arg))
+                if not _is_wall_call(node) and \
+                        _dotted(node.func).endswith(".wait"):
+                    for arg in node.args:
+                        if value_tainted(arg):
+                            flag(node, "passed to .wait()")
+        return findings

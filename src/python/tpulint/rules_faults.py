@@ -1,0 +1,96 @@
+"""R6 fault-point registry.
+
+``tpuserver.faults.POINTS`` is the single source of truth for injection
+-point names: the fault table in ``docs/resilience.md`` is checked
+against it (tests/test_static_analysis.py) and chaos tooling enumerates
+it.  This rule keeps the code in sync with the registry:
+
+- every ``faults.fire("<name>", ...)`` site must use a **string
+  literal** name that is a registered key (a typo'd point silently
+  never fires — the chaos test arms a point production never hits);
+- every registered point must have **exactly one** fire site in the
+  analyzed tree (zero = dead registry entry the docs still advertise;
+  two = one armed fault trips an unintended second site).
+
+The rule only runs when the registry module (``faults.py`` defining
+``POINTS``) is part of the analyzed set, so single-file lint runs stay
+quiet.
+"""
+
+import ast
+
+from tpulint.findings import Finding
+
+REGISTRY_NAME = "POINTS"
+
+
+class FaultRegistryRule:
+    id = "R6"
+    name = "fault-registry"
+
+    def check(self, modules, config):
+        registry_mod = None
+        registry = None
+        for mod in modules:
+            if mod.relpath.endswith("faults.py") and \
+                    REGISTRY_NAME in mod.dict_assignments:
+                registry_mod = mod
+                registry = {}
+                node = mod.dict_assignments[REGISTRY_NAME]
+                for k in node.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        registry[k.value] = k.lineno
+        if registry is None:
+            return []
+
+        findings = []
+        fire_sites = {}  # name -> [(mod, lineno)]
+        for mod in modules:
+            if mod is registry_mod:
+                continue  # faults.fire's own definition/docs
+            for site in mod.call_sites:
+                if not (site.dotted.endswith(".fire")
+                        or site.dotted == "fire"):
+                    continue
+                if not site.node.args:
+                    continue
+                arg = site.node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    findings.append(Finding(
+                        self.id, self.name, mod.relpath, site.lineno,
+                        "faults.fire() must be called with a string-"
+                        "literal point name (dynamic names defeat the "
+                        "registry check)",
+                    ))
+                    continue
+                name = arg.value
+                fire_sites.setdefault(name, []).append(
+                    (mod, site.lineno))
+                if name not in registry:
+                    findings.append(Finding(
+                        self.id, self.name, mod.relpath, site.lineno,
+                        "fault point '{}' is not registered in "
+                        "faults.POINTS — register it (and document it "
+                        "in the resilience fault table) or fix the "
+                        "name".format(name),
+                    ))
+        for name, lineno in sorted(registry.items()):
+            sites = fire_sites.get(name, [])
+            if not sites:
+                findings.append(Finding(
+                    self.id, self.name, registry_mod.relpath, lineno,
+                    "registered fault point '{}' has no faults.fire() "
+                    "site in the analyzed tree — dead registry entry"
+                    .format(name),
+                ))
+            elif len(sites) > 1:
+                extra_mod, extra_line = sites[1]
+                findings.append(Finding(
+                    self.id, self.name, extra_mod.relpath, extra_line,
+                    "fault point '{}' fires at {} sites — one armed "
+                    "fault would trip unintended sites; give each site "
+                    "its own registered name".format(name, len(sites)),
+                ))
+        return findings

@@ -1,0 +1,70 @@
+"""R5 thread-lifecycle discipline.
+
+Every ``threading.Thread`` created in server code must be either
+``daemon=True`` (dies with its owner — the invariant the test suite's
+thread-leak guard checks dynamically) or joined on a reachable
+shutdown path: a method of the same class named ``close``/``stop``/
+``drain``/``shutdown``/``__exit__`` that calls ``.join(...)`` and
+mentions the attribute the thread was stored into.  A non-daemon
+thread with neither wedges interpreter shutdown the first time its
+loop outlives the owner.
+"""
+
+import ast
+
+from tpulint.findings import Finding
+from tpulint.rules_locks import _is_thread_join
+
+_STOP_NAMES = ("close", "stop", "drain", "shutdown", "__exit__",
+               "join", "_stop_sender", "_stop_workers")
+
+
+def _method_joins_attr(fn, attr):
+    """Does this method call ``.join`` and reference ``self.<attr>``?"""
+    mentions_attr = False
+    joins = False
+    for node in ast.walk(fn.node):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr == attr):
+            mentions_attr = True
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and _is_thread_join(node)):
+            joins = True
+    return mentions_attr and joins
+
+
+class ThreadLifecycleRule:
+    id = "R5"
+    name = "thread-lifecycle"
+
+    def check(self, modules, config):
+        findings = []
+        for mod in modules:
+            for tc in mod.thread_creations:
+                if tc.daemon is True:
+                    continue
+                if tc.cls is not None and tc.target_attr is not None:
+                    if any(
+                        _method_joins_attr(fn, tc.target_attr)
+                        for name, fn in tc.cls.methods.items()
+                        if name in _STOP_NAMES
+                    ):
+                        continue
+                where = "{}.{}".format(
+                    tc.cls.name if tc.cls else "<module>",
+                    tc.func.name if tc.func else "<module>")
+                if tc.daemon is None:
+                    detail = "has no daemon=True"
+                else:
+                    detail = "is daemon={!r}".format(tc.daemon)
+                findings.append(Finding(
+                    self.id, self.name, mod.relpath, tc.lineno,
+                    "threading.Thread created in {}() {} and is not "
+                    "joined in a close()/stop()/drain() path — it will "
+                    "outlive its owner and wedge interpreter shutdown"
+                    .format(where, detail),
+                ))
+        return findings

@@ -1,0 +1,247 @@
+"""R1 (guarded-by) and R2 (no-blocking-under-lock + lock-order graph).
+
+Both rules read the lexical lock contexts the shared pass computed; see
+docs/static_analysis.md for the annotation and suppression contract.
+"""
+
+import ast
+
+from tpulint.analysis import CONVENTION
+from tpulint.findings import Finding
+
+
+def _lock_satisfied(name, held, cls):
+    """Whether lock ``name`` is covered by the held set, following the
+    class's Condition-over-lock aliases in both directions."""
+    if name in held or CONVENTION in held:
+        return True
+    aliases = cls.lock_aliases if cls is not None else {}
+    if aliases.get(name) in held:
+        return True
+    return any(aliases.get(h) == name for h in held)
+
+
+class GuardedByRule:
+    """R1 guarded-by: a field declared ``# guarded-by: _lock`` may only
+    be read or written inside a ``with self._lock:`` block (or a
+    ``*_locked``-suffix method, which the project convention defines as
+    "called with the class's locks held") in its class's methods.
+
+    ``__init__`` is exempt (object construction happens-before any
+    sharing).  Double-checked-locking fields and cross-object protocols
+    stay UNannotated — annotation is the opt-in that turns the
+    convention into a checked invariant.
+    """
+
+    id = "R1"
+    name = "guarded-by"
+
+    def check(self, modules, config):
+        findings = []
+        for mod in modules:
+            for cls in mod.classes.values():
+                if not cls.guarded:
+                    continue
+                for acc in mod.attr_accesses:
+                    if acc.cls is not cls or acc.attr not in cls.guarded:
+                        continue
+                    if acc.func is not None and acc.func.name in (
+                            "__init__", "__new__"):
+                        continue
+                    lock, _decl_line = cls.guarded[acc.attr]
+                    if _lock_satisfied(lock, acc.locks, cls):
+                        continue
+                    findings.append(Finding(
+                        self.id, self.name, mod.relpath, acc.lineno,
+                        "{}.{} is declared guarded-by {} but is {} "
+                        "outside a 'with self.{}' block in {}()".format(
+                            cls.name, acc.attr, lock,
+                            "written" if acc.is_store else "read",
+                            lock,
+                            acc.func.name if acc.func else "<module>",
+                        ),
+                    ))
+        return findings
+
+
+#: Call patterns that block the calling thread.  ``.join()`` with zero
+#: positional args is a thread/process join (``str.join`` always takes
+#: the iterable positionally); ``.result()`` is a future wait.
+_BLOCKING_DOTTED = {"time.sleep", "sleep"}
+_BLOCKING_SUFFIXES = (
+    ".recv", ".recvfrom", ".accept", ".connect", ".sendall",
+    ".getresponse", ".urlopen",
+)
+_BLOCKING_NAMES = {"urlopen"}
+
+
+def _is_thread_join(node):
+    """``x.join()`` / ``x.join(5)`` / ``x.join(timeout=...)`` is a
+    thread/process join; ``str.join`` always takes a non-numeric
+    iterable positionally."""
+    if not node.args:
+        return True
+    return len(node.args) == 1 and isinstance(
+        node.args[0], ast.Constant) and isinstance(
+        node.args[0].value, (int, float))
+
+
+def _is_blocking_call(site):
+    dotted = site.dotted
+    if dotted in _BLOCKING_DOTTED or dotted in _BLOCKING_NAMES:
+        return "time.sleep" if "sleep" in dotted else dotted
+    if dotted.endswith(".join") and _is_thread_join(site.node):
+        return "Thread.join"
+    if dotted.endswith(".result"):
+        return "Future.result"
+    if dotted.endswith(_BLOCKING_SUFFIXES):
+        return "socket/HTTP call " + dotted
+    if dotted.startswith("requests."):
+        return "HTTP call " + dotted
+    return None
+
+
+def _wait_on_held_lock(site):
+    """``self._cond.wait(...)`` / ``.wait_for`` on a lock that is
+    lexically held (directly or via a Condition-over-lock alias) — the
+    one sanctioned block-under-lock."""
+    dotted = site.dotted
+    for suffix in (".wait", ".wait_for"):
+        if dotted.endswith(suffix):
+            receiver = dotted[: -len(suffix)]
+            if receiver.startswith("self."):
+                receiver = receiver[len("self."):]
+            return _lock_satisfied(receiver, site.locks, site.cls)
+    return False
+
+
+class BlockingUnderLockRule:
+    """R2 no-blocking-under-lock: no ``time.sleep``, ``Thread.join``,
+    socket/HTTP call, or ``Future.result()`` lexically inside a held-
+    lock block — every other thread needing that lock stalls for the
+    full blocking duration.  ``Condition.wait`` on the *held* lock is
+    the one exemption (it releases the lock while waiting).
+
+    The rule also builds a lock-acquisition-order graph — an edge for
+    every lock acquired while another is lexically held, plus one level
+    of ``self.method()`` resolution — and requires it to be acyclic:
+    a cycle is a latent AB/BA deadlock.
+    """
+
+    id = "R2"
+    name = "no-blocking-under-lock"
+
+    def check(self, modules, config):
+        findings = []
+        for mod in modules:
+            for site in mod.call_sites:
+                if not site.locks:
+                    continue
+                if _wait_on_held_lock(site):
+                    continue
+                desc = _is_blocking_call(site)
+                if desc is None:
+                    # .wait on something that is NOT the held lock
+                    # (e.g. an Event) blocks without releasing it
+                    if (site.dotted.endswith(".wait")
+                            or site.dotted.endswith(".wait_for")):
+                        desc = "wait on {} (not the held lock)".format(
+                            site.dotted.rsplit(".", 1)[0])
+                    else:
+                        continue
+                held = sorted(x for x in site.locks if x != CONVENTION)
+                findings.append(Finding(
+                    self.id, self.name, mod.relpath, site.lineno,
+                    "blocking {} while holding lock(s) {} in {}.{}()".format(
+                        desc,
+                        "/".join(held) if held else
+                        "(held by *_locked convention)",
+                        site.cls.name if site.cls else "<module>",
+                        site.func.name if site.func else "<module>",
+                    ),
+                ))
+        findings.extend(self._check_lock_order(modules))
+        return findings
+
+    # -- lock-acquisition-order graph --------------------------------------
+
+    def _lock_id(self, name, cls, mod):
+        return (cls.name if cls is not None else mod.relpath, name)
+
+    def _check_lock_order(self, modules):
+        edges = {}  # (from_id, to_id) -> (relpath, lineno)
+
+        def add_edge(a, b, relpath, lineno):
+            if a != b:
+                edges.setdefault((a, b), (relpath, lineno))
+
+        # methods that acquire a lock in their own body, for one level
+        # of self.method() call resolution
+        acquires = {}  # (class name, method name) -> set of lock ids
+        for mod in modules:
+            for wl in mod.with_locks:
+                if wl.cls is not None and wl.func is not None:
+                    acquires.setdefault(
+                        (wl.cls.name, wl.func.name), set()
+                    ).add(self._lock_id(wl.lock, wl.cls, mod))
+
+        for mod in modules:
+            for wl in mod.with_locks:
+                inner = self._lock_id(wl.lock, wl.cls, mod)
+                for held in wl.held:
+                    if held == CONVENTION:
+                        continue
+                    add_edge(self._lock_id(held, wl.cls, mod), inner,
+                             mod.relpath, wl.lineno)
+            for site in mod.call_sites:
+                if not site.locks or site.cls is None:
+                    continue
+                if not site.dotted.startswith("self."):
+                    continue
+                method = site.dotted[len("self."):]
+                if "." in method:
+                    continue
+                for target in acquires.get((site.cls.name, method), ()):
+                    for held in site.locks:
+                        if held == CONVENTION:
+                            continue
+                        add_edge(self._lock_id(held, site.cls, mod),
+                                 target, mod.relpath, site.lineno)
+
+        return self._report_cycles(edges)
+
+    def _report_cycles(self, edges):
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        findings = []
+        seen_cycles = set()
+        state = {}
+
+        def dfs(node, stack):
+            state[node] = 1
+            stack.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                if state.get(nxt, 0) == 1:
+                    cycle = tuple(stack[stack.index(nxt):] + [nxt])
+                    key = frozenset(cycle)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        relpath, lineno = edges[(cycle[-2], cycle[-1])]
+                        findings.append(Finding(
+                            self.id, self.name, relpath, lineno,
+                            "lock-acquisition-order cycle: {}".format(
+                                " -> ".join(
+                                    "{}.{}".format(scope, lock)
+                                    for scope, lock in cycle
+                                )),
+                        ))
+                elif state.get(nxt, 0) == 0:
+                    dfs(nxt, stack)
+            stack.pop()
+            state[node] = 2
+
+        for node in sorted(graph):
+            if state.get(node, 0) == 0:
+                dfs(node, [])
+        return findings

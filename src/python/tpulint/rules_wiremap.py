@@ -1,0 +1,164 @@
+"""R4 typed-error wire-map completeness.
+
+A typed error is only useful if every surface agrees on it.  For every
+class in the ``ServerError`` hierarchy (resolved by name across the
+analyzed modules) the rule requires its HTTP code to appear in:
+
+- the HTTP frontend's ``_STATUS_LINE`` map (else the wire falls back to
+  a blanket 500 status line),
+- the gRPC frontend's ``_status_code`` mapping dict (else the RPC
+  surfaces as UNKNOWN),
+- the status table in ``docs/resilience.md`` (else the contract is
+  undocumented).
+
+It also enforces the **one-definition rule** that replaced the old
+scheduler/core twin exceptions: a class name that appears in the
+ServerError hierarchy may be *defined* in exactly one analyzed module —
+other modules import/alias it (``tpuserver.errors`` is the canonical
+home).  Two same-named classes kept consistent only by convention is
+precisely the drift this rule exists to stop.
+"""
+
+import ast
+import re
+
+from tpulint.analysis import resolve_hierarchy, resolve_wire_code
+from tpulint.findings import Finding
+
+ROOT = "ServerError"
+HTTP_MAP_NAME = "_STATUS_LINE"
+GRPC_MAP_FUNC = "_status_code"
+
+
+def _dict_int_keys(dict_node):
+    keys = set()
+    for k in dict_node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, int):
+            keys.add(k.value)
+    return keys
+
+
+def _docs_codes(docs_path):
+    """HTTP codes present in the resilience doc's status table rows."""
+    codes = set()
+    try:
+        with open(docs_path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                stripped = line.strip()
+                if stripped.startswith("|"):
+                    for m in re.finditer(r"\b([1-5]\d\d)\b", stripped):
+                        codes.add(int(m.group(1)))
+    except FileNotFoundError:
+        return None
+    return codes
+
+
+class WireMapRule:
+    id = "R4"
+    name = "wire-map"
+
+    def check(self, modules, config):
+        findings = []
+        hierarchy = resolve_hierarchy(modules, ROOT)
+        if not hierarchy:
+            return findings
+
+        http_codes = None
+        grpc_codes = None
+        for mod in modules:
+            if HTTP_MAP_NAME in mod.dict_assignments:
+                http_codes = _dict_int_keys(
+                    mod.dict_assignments[HTTP_MAP_NAME])
+            if GRPC_MAP_FUNC in mod.func_dicts:
+                grpc_codes = _dict_int_keys(mod.func_dicts[GRPC_MAP_FUNC])
+        docs_codes = (
+            _docs_codes(config.docs_path)
+            if config.docs_path is not None else None
+        )
+
+        # a hierarchy with no discoverable wire map must FAIL, not
+        # silently degrade: renaming _STATUS_LINE (or moving the gRPC
+        # dict out of _status_code) would otherwise disable this rule
+        # with no signal.  Anchor at the hierarchy root's definition.
+        # An explicitly absent docs path (--docs '') is a deliberate
+        # opt-out and stays quiet; a CONFIGURED docs path that cannot
+        # be read is a finding.
+        anchor = None
+        for mod in modules:
+            if ROOT in mod.classes:
+                anchor = mod.classes[ROOT]
+                break
+        if anchor is not None:
+            for label, codeset in (
+                ("HTTP status map '{}'".format(HTTP_MAP_NAME),
+                 http_codes),
+                ("gRPC code map '{}()'".format(GRPC_MAP_FUNC),
+                 grpc_codes),
+            ):
+                if codeset is None:
+                    findings.append(Finding(
+                        self.id, self.name, anchor.module.relpath,
+                        anchor.lineno,
+                        "a {} hierarchy is defined but no {} exists in "
+                        "the analyzed set — wire-map completeness "
+                        "cannot be checked (renamed/moved map, or a "
+                        "partial lint run)".format(ROOT, label),
+                    ))
+            if config.docs_path is not None and docs_codes is None:
+                findings.append(Finding(
+                    self.id, self.name, anchor.module.relpath,
+                    anchor.lineno,
+                    "configured docs status table '{}' cannot be read "
+                    "— wire-map completeness against the docs cannot "
+                    "be checked".format(config.docs_path),
+                ))
+
+        for name, defs in sorted(hierarchy.items()):
+            # one-definition rule (incl. same-named non-ServerError
+            # classes anywhere else in the tree)
+            all_defs = list(defs)
+            for mod in modules:
+                cls = mod.classes.get(name)
+                if cls is not None and cls not in all_defs:
+                    all_defs.append(cls)
+            if len(all_defs) > 1:
+                canonical = defs[0]
+                for extra in all_defs:
+                    if extra is canonical:
+                        continue
+                    findings.append(Finding(
+                        self.id, self.name, extra.module.relpath,
+                        extra.lineno,
+                        "duplicate definition of wire-mapped error "
+                        "'{}' (canonical definition lives in {}); alias "
+                        "or import it instead — twin classes stay "
+                        "consistent only by convention".format(
+                            name, canonical.module.relpath),
+                    ))
+
+            cls = defs[0]
+            code = resolve_wire_code(cls, modules)
+            if code is None:
+                findings.append(Finding(
+                    self.id, self.name, cls.module.relpath, cls.lineno,
+                    "cannot statically resolve the HTTP code of "
+                    "ServerError subclass '{}' (pass code=<literal> to "
+                    "super().__init__)".format(name),
+                ))
+                continue
+            for label, codeset in (
+                ("HTTP status map ({})".format(HTTP_MAP_NAME), http_codes),
+                ("gRPC code map ({}())".format(GRPC_MAP_FUNC), grpc_codes),
+                ("status table in docs", docs_codes),
+            ):
+                if codeset is None:
+                    continue  # surface not in the analyzed set
+                if code not in codeset:
+                    findings.append(Finding(
+                        self.id, self.name, cls.module.relpath,
+                        cls.lineno,
+                        "ServerError subclass '{}' carries HTTP code {} "
+                        "which is missing from the {}".format(
+                            name, code, label),
+                    ))
+        return findings

@@ -1,0 +1,128 @@
+"""File discovery, rule dispatch, and the lint entry point."""
+
+import fnmatch
+import os
+
+from tpulint.analysis import analyze_file
+from tpulint.findings import (
+    apply_baseline,
+    filter_suppressed,
+    load_baseline,
+)
+from tpulint.rules_clocks import MonotonicClockRule
+from tpulint.rules_faults import FaultRegistryRule
+from tpulint.rules_lifecycle import ThreadLifecycleRule
+from tpulint.rules_locks import BlockingUnderLockRule, GuardedByRule
+from tpulint.rules_wiremap import WireMapRule
+
+#: Registration order is report order within a line.
+ALL_RULES = (
+    GuardedByRule(),
+    BlockingUnderLockRule(),
+    MonotonicClockRule(),
+    WireMapRule(),
+    ThreadLifecycleRule(),
+    FaultRegistryRule(),
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+#: Generated / vendored files never linted.
+EXCLUDE_PATTERNS = ("*_pb2.py", "*_pb2_grpc.py")
+
+
+class LintConfig:
+    def __init__(self, docs_path=None):
+        self.docs_path = docs_path
+
+
+class LintResult:
+    def __init__(self, new, grandfathered, stale, modules):
+        self.new = new                    # findings not in the baseline
+        self.grandfathered = grandfathered  # baseline-matched findings
+        self.stale = stale                # baseline entries with no match
+        self.modules = modules
+
+    @property
+    def all_findings(self):
+        return sorted(self.new + self.grandfathered,
+                      key=lambda f: f.sort_key())
+
+
+def discover(paths):
+    """Every lintable .py under the given files/directories."""
+    files = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for root, dirs, names in os.walk(path):
+            dirs[:] = [d for d in sorted(dirs)
+                       if d not in ("__pycache__",)]
+            for name in sorted(names):
+                if not name.endswith(".py"):
+                    continue
+                if any(fnmatch.fnmatch(name, pat)
+                       for pat in EXCLUDE_PATTERNS):
+                    continue
+                files.append(os.path.join(root, name))
+    return files
+
+
+def _relpath(path, root):
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:
+        return path
+    return path if rel.startswith("..") else rel
+
+
+def select_rules(spec):
+    """``spec`` is None (all rules) or an iterable of ids/names."""
+    if spec is None:
+        return list(ALL_RULES)
+    selected = []
+    for token in spec:
+        rule = RULES_BY_ID.get(token.upper()) or RULES_BY_NAME.get(
+            token.lower())
+        if rule is None:
+            raise ValueError(
+                "unknown rule {!r} (known: {})".format(
+                    token, ", ".join(sorted(RULES_BY_ID))))
+        if rule not in selected:
+            selected.append(rule)
+    return selected
+
+
+def lint_paths(paths, rules=None, baseline_path=None, docs_path=None,
+               repo_root=None):
+    """Run the selected rules over ``paths``; returns a LintResult.
+
+    Files that fail to parse produce a synthetic finding rather than
+    aborting the run (a syntax error in one module must not unlint the
+    rest of the tree).
+    """
+    from tpulint.findings import Finding
+
+    root = repo_root or os.getcwd()
+    config = LintConfig(docs_path=docs_path)
+    modules = []
+    parse_findings = []
+    for path in discover(paths):
+        rel = _relpath(path, root)
+        try:
+            modules.append(analyze_file(path, rel))
+        except SyntaxError as e:
+            parse_findings.append(Finding(
+                "R0", "parse", rel, e.lineno or 0,
+                "file does not parse: {}".format(e.msg)))
+    findings = list(parse_findings)
+    for rule in select_rules(rules):
+        findings.extend(rule.check(modules, config))
+    modules_by_path = {m.relpath: m for m in modules}
+    findings = filter_suppressed(findings, modules_by_path)
+    baseline_entries = (
+        load_baseline(baseline_path) if baseline_path else [])
+    new, grandfathered, stale = apply_baseline(findings, baseline_entries)
+    return LintResult(new, grandfathered, stale, modules)

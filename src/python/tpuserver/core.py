@@ -16,6 +16,16 @@ import numpy as np
 
 from tpuserver import faults
 from tpuserver import scheduler as _scheduler
+from tpuserver._clock import wall_clock_ms
+from tpuserver.errors import (  # noqa: F401 — re-exported: the public
+    # names every frontend/client/test imports from tpuserver.core
+    DeadlineExceeded,
+    Overloaded,
+    ServerError,
+    ShuttingDown,
+    SlotQuarantined,
+    UnknownGeneration,
+)
 from tritonclient.utils import (
     deserialize_bytes_tensor,
     serialize_byte_tensor,
@@ -113,64 +123,6 @@ class InferResponse:
         #          delivery dict) — array None when delivered via shm
         self.outputs = outputs
         self.parameters = parameters or {}
-
-
-class ServerError(Exception):
-    """Server-side error carrying an HTTP-ish status code.
-
-    ``retry_after`` (seconds, or None) is advisory: frontends surface it
-    as the HTTP ``Retry-After`` header / gRPC ``retry-after`` trailing
-    metadata so well-behaved clients back off instead of hammering."""
-
-    def __init__(self, msg, code=400, retry_after=None):
-        super().__init__(msg)
-        self.code = code
-        self.retry_after = retry_after
-
-
-class DeadlineExceeded(ServerError):
-    """The request's deadline (its ``timeout`` parameter or the gRPC
-    context deadline) expired — HTTP 504 / gRPC DEADLINE_EXCEEDED."""
-
-    def __init__(self, msg):
-        super().__init__(msg, code=504)
-
-
-class Overloaded(ServerError):
-    """The server shed this request under load (admission queue full or
-    in-flight cap reached) — HTTP 429 + Retry-After / gRPC
-    RESOURCE_EXHAUSTED.  Retryable by contract."""
-
-    def __init__(self, msg, retry_after=1):
-        super().__init__(msg, code=429, retry_after=retry_after)
-
-
-class ShuttingDown(ServerError):
-    """The server is draining or stopped and not accepting new work —
-    HTTP 503 / gRPC UNAVAILABLE.  Retryable against another replica."""
-
-    def __init__(self, msg, retry_after=None):
-        super().__init__(msg, code=503, retry_after=retry_after)
-
-
-class SlotQuarantined(ServerError):
-    """The request's own generation poisoned its decode slot
-    (non-finite logits) and was quarantined; co-batched generations are
-    unaffected — HTTP 422 / gRPC INVALID_ARGUMENT.  NOT retryable: the
-    request, not the server, is at fault."""
-
-    def __init__(self, msg):
-        super().__init__(msg, code=422)
-
-
-class UnknownGeneration(ServerError):
-    """A stream-resume request named a generation id this replica does
-    not hold (never issued, already resumed, or aged out of the replay
-    buffer) — HTTP 404 / gRPC NOT_FOUND.  Resume is same-endpoint only:
-    generation replay state is replica-local."""
-
-    def __init__(self, msg):
-        super().__init__(msg, code=404)
 
 
 #: Reserved key a decoupled model may include in a yielded output dict
@@ -475,8 +427,8 @@ class _DynamicBatcher:
     def __init__(self, model):
         self._model = model
         self._cond = threading.Condition()
-        self._queue = []  # of _BatchSlot
-        self._stop = False
+        self._queue = []   # of _BatchSlot  # guarded-by: _cond
+        self._stop = False  # guarded-by: _cond
         self._threads = [
             threading.Thread(
                 target=self._run,
@@ -524,8 +476,9 @@ class _DynamicBatcher:
         for t in self._threads:
             t.join(timeout=5)
         # snapshot under the lock: a worker that outlived the join may
-        # still rebind the queue in _take_batch; slots it has taken will
-        # complete normally, only still-queued slots get errored
+        # still rebind the queue in _take_batch_locked; slots it has
+        # taken will complete normally, only still-queued slots get
+        # errored
         with self._cond:
             pending, self._queue = self._queue, []
         for slot in pending:
@@ -534,8 +487,9 @@ class _DynamicBatcher:
             )
             slot.event.set()
 
-    def _take_batch(self):
-        """Collect one compatible batch (called with the lock held)."""
+    def _take_batch_locked(self):
+        """Collect one compatible batch.  Called with ``_cond`` held
+        (the ``_locked`` suffix is the convention tpulint R1 keys on)."""
         max_rows = self._model.max_batch_size
         sig = self._signature(self._queue[0].inputs)
         batch, rest, rows = [], [], 0
@@ -582,7 +536,7 @@ class _DynamicBatcher:
                     # a sibling instance thread drained the queue while
                     # this one sat in its batching window
                     continue
-                batch, rows = self._take_batch()
+                batch, rows = self._take_batch_locked()
             self._execute(batch, rows)
 
     def _bucket(self, rows, max_rows):
@@ -731,24 +685,30 @@ class _DynamicBatcher:
 class _ModelStats:
     def __init__(self):
         self.lock = threading.Lock()
-        self.inference_count = 0
-        self.execution_count = 0
-        self.last_inference_ms = 0
-        self.success_count = 0
-        self.success_ns = 0
-        self.fail_count = 0
-        self.fail_ns = 0
-        self.queue_ns = 0
-        self.compute_input_ns = 0
-        self.compute_infer_ns = 0
-        self.compute_output_ns = 0
+        self.inference_count = 0     # guarded-by: lock
+        self.execution_count = 0     # guarded-by: lock
+        # epoch ms, the KServe statistics wire contract — a REPORTING
+        # field, stamped through the sanctioned _clock.wall_clock_ms()
+        # boundary.  Nothing may do liveness/recency math on it (wall
+        # clocks jump; tpulint R3 bans wall-clock reads everywhere
+        # else, so a monotonic source must be added if such math ever
+        # appears).
+        self.last_inference_ms = 0   # guarded-by: lock
+        self.success_count = 0       # guarded-by: lock
+        self.success_ns = 0          # guarded-by: lock
+        self.fail_count = 0          # guarded-by: lock
+        self.fail_ns = 0             # guarded-by: lock
+        self.queue_ns = 0            # guarded-by: lock
+        self.compute_input_ns = 0    # guarded-by: lock
+        self.compute_infer_ns = 0    # guarded-by: lock
+        self.compute_output_ns = 0   # guarded-by: lock
 
     def record(self, batch, queue_ns, ci_ns, cf_ns, co_ns, ok=True):
         with self.lock:
             if ok:
                 self.inference_count += batch
                 self.execution_count += 1
-                self.last_inference_ms = int(time.time() * 1000)
+                self.last_inference_ms = wall_clock_ms()
                 self.success_count += 1
                 self.success_ns += queue_ns + ci_ns + cf_ns + co_ns
                 self.queue_ns += queue_ns
@@ -813,16 +773,21 @@ class InferenceServer:
         self._ready = {}  # name -> bool
         self._stats = {}  # name -> _ModelStats
         self._lock = threading.Lock()
-        self._state = "ready" if ready else "starting"
-        self._max_inflight = max_inflight
-        self._inflight = 0
+        # lifecycle state machine; reads go through server_state() so
+        # probes never see a torn transition
+        self._state = "ready" if ready else "starting"  # guarded-by: _inflight_cond
+        self._max_inflight = max_inflight  # guarded-by: _inflight_cond
+        self._inflight = 0  # guarded-by: _inflight_cond
         self._inflight_cond = threading.Condition()
         self._system_shm = {}
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
-        self._batchers = {}  # name -> _DynamicBatcher (lazily created)
-        self._closed = False
-        self._frontends = 0  # attached frontends; last detach closes
+        self._batchers = {}  # name -> _DynamicBatcher (lazily created;
+        # double-checked locking — deliberately unannotated, see
+        # docs/static_analysis.md R1)
+        self._closed = False  # guarded-by: _lock
+        # attached frontends; last detach closes  # guarded-by: _lock
+        self._frontends = 0
         self._sequence_state = {}  # (model, seq_id) -> (state, touched)
         self._last_sequence_sweep = 0.0
         self._trace_settings = {
@@ -910,7 +875,7 @@ class InferenceServer:
             model is not None
             and version in ("", model.version)
             and self._ready.get(name, False)
-            and self._state == "ready"
+            and self.server_state() == "ready"
             and self._model_healthy(model)
         )
 
@@ -928,14 +893,15 @@ class InferenceServer:
 
     def server_state(self):
         """``starting`` | ``ready`` | ``draining`` | ``stopped``."""
-        return self._state
+        with self._inflight_cond:
+            return self._state
 
     def server_ready(self):
         """Real readiness for load balancers: True only when serving
         (not starting/draining/stopped) and every registered model's
         health probe passes (a tripped scheduler watchdog reports
         here)."""
-        if self._state != "ready":
+        if self.server_state() != "ready":
             return False
         with self._lock:  # snapshot: register_model mutates under _lock
             models = list(self._models.items())
@@ -1424,21 +1390,26 @@ class InferenceServer:
         except Exception as e:
             self._stats[model.name].record(0, 0, 0, 0, 0, ok=False)
             if isinstance(e, ServerError):
+                # the scheduler raises the canonical tpuserver.errors
+                # types directly (deadline 504, quarantined slot 422,
+                # unknown resume id 404 — one definition, R4-enforced).
+                # Class/code/retry_after pass through untouched, but a
+                # multi-model server needs attribution: scheduler
+                # messages carry no model name, so logs/clients could
+                # not tell whose stream failed
+                prefix = "model '{}': ".format(model.name)
+                if (e.args and isinstance(e.args[0], str)
+                        and not e.args[0].startswith(prefix)):
+                    e.args = (prefix + e.args[0],) + e.args[1:]
                 raise
-            # the scheduler's typed failures keep their meaning on the
-            # wire: deadline -> 504, admission-full -> 429
-            # (+Retry-After), closed/draining -> 503, quarantined slot
-            # -> 422, unknown resume id -> 404 — instead of the generic
-            # 500 wrap
-            for sched_exc, wrapper in (
-                (_scheduler.DeadlineExceeded, DeadlineExceeded),
-                (_scheduler.AdmissionQueueFull, Overloaded),
-                (_scheduler.SlotQuarantined, SlotQuarantined),
-                (_scheduler.UnknownGeneration, UnknownGeneration),
-                (_scheduler.SchedulerClosed, ShuttingDown),
-            ):
-                if isinstance(e, sched_exc):
-                    raise wrapper("model '{}': {}".format(model.name, e))
+            # the two scheduler-lifecycle signals that stay scheduler-
+            # local types map to their typed wire forms here:
+            # admission-full -> 429 (+Retry-After), closed/draining ->
+            # 503 — instead of the generic 500 wrap
+            if isinstance(e, _scheduler.AdmissionQueueFull):
+                raise Overloaded("model '{}': {}".format(model.name, e))
+            if isinstance(e, _scheduler.SchedulerClosed):
+                raise ShuttingDown("model '{}': {}".format(model.name, e))
             raise ServerError(
                 "inference failed for model '{}': {}".format(model.name, e),
                 code=500,

@@ -1,0 +1,83 @@
+"""Canonical typed serving errors — the single definition site.
+
+One class per wire-mapped condition, each carrying its HTTP code (the
+gRPC frontend derives its status from the same code).  tpulint rule R4
+enforces the contract this module exists for: every subclass here must
+appear in the HTTP frontend's ``_STATUS_LINE`` map, the gRPC frontend's
+``_status_code`` map, and the status table in ``docs/resilience.md`` —
+and **no other module may define a class with the same name** (the
+scheduler and core used to carry twin ``SlotQuarantined`` /
+``UnknownGeneration`` definitions kept consistent only by convention;
+now both import from here).
+
+``tpuserver.core`` re-exports everything for backward compatibility —
+``from tpuserver.core import ServerError`` keeps working.
+"""
+
+__all__ = [
+    "DeadlineExceeded",
+    "Overloaded",
+    "ServerError",
+    "ShuttingDown",
+    "SlotQuarantined",
+    "UnknownGeneration",
+]
+
+
+class ServerError(Exception):
+    """Server-side error carrying an HTTP-ish status code.
+
+    ``retry_after`` (seconds, or None) is advisory: frontends surface it
+    as the HTTP ``Retry-After`` header / gRPC ``retry-after`` trailing
+    metadata so well-behaved clients back off instead of hammering."""
+
+    def __init__(self, msg, code=400, retry_after=None):
+        super().__init__(msg)
+        self.code = code
+        self.retry_after = retry_after
+
+
+class DeadlineExceeded(ServerError):
+    """The request's deadline (its ``timeout`` parameter, the gRPC
+    context deadline, or the scheduler's per-stream bound) expired —
+    HTTP 504 / gRPC DEADLINE_EXCEEDED."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=504)
+
+
+class Overloaded(ServerError):
+    """The server shed this request under load (admission queue full or
+    in-flight cap reached) — HTTP 429 + Retry-After / gRPC
+    RESOURCE_EXHAUSTED.  Retryable by contract."""
+
+    def __init__(self, msg, retry_after=1):
+        super().__init__(msg, code=429, retry_after=retry_after)
+
+
+class ShuttingDown(ServerError):
+    """The server is draining or stopped and not accepting new work —
+    HTTP 503 / gRPC UNAVAILABLE.  Retryable against another replica."""
+
+    def __init__(self, msg, retry_after=None):
+        super().__init__(msg, code=503, retry_after=retry_after)
+
+
+class SlotQuarantined(ServerError):
+    """The request's own generation poisoned its decode slot
+    (non-finite logits) and was quarantined; co-batched generations are
+    unaffected — HTTP 422 / gRPC INVALID_ARGUMENT.  NOT retryable: the
+    request, not the server, is at fault."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=422)
+
+
+class UnknownGeneration(ServerError):
+    """A stream-resume request named a generation id this replica does
+    not hold (never issued, already resumed, or aged out of the replay
+    buffer) — HTTP 404 / gRPC NOT_FOUND.  Resume is same-endpoint only:
+    generation replay state is replica-local."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=404)

@@ -63,9 +63,38 @@ import threading
 import time
 
 __all__ = [
-    "FaultInjected", "fire", "install", "clear", "fired", "active",
-    "injected", "load_env",
+    "FaultInjected", "POINTS", "fire", "install", "clear", "fired",
+    "active", "injected", "load_env",
 ]
+
+#: The fault-point registry — the single source of truth for injection
+#: point names.  tpulint rule R6 statically checks that every
+#: ``faults.fire("<name>")`` site in the server tree uses exactly one
+#: registered name (a typo'd point silently never fires), and
+#: tests/test_static_analysis.py checks the fault table in
+#: docs/resilience.md against these keys, so docs, registry, and code
+#: cannot drift apart.  Adding an injection point = add the fire()
+#: site, register it here, and document it in the resilience table.
+POINTS = {
+    "scheduler.step": (
+        "before each batched decode-step dispatch (raise = loop death "
+        "/ supervised restart; sleep = slow step; hang = stall past "
+        "the watchdog deadline; nan = poison slot int(delay)'s logits "
+        "row / quarantine)"),
+    "scheduler.fetch": (
+        "before the device->host token transfer of a completed step "
+        "(raise = loop death / supervised restart)"),
+    "scheduler.admit": (
+        "before a prefill-on-admit (admission failure: the request "
+        "fails, other slots keep decoding)"),
+    "core.shm_read": "before a shared-memory input read",
+    "http.generate_stream": (
+        "before each /generate_stream SSE event write (raise = sever "
+        "the connection mid-stream — drives client auto-resume)"),
+    "grpc.stream_infer": (
+        "before each ModelStreamInfer response yield (raise = kill "
+        "the bidi stream mid-flight)"),
+}
 
 
 class FaultInjected(RuntimeError):

@@ -83,36 +83,32 @@ import numpy as np
 
 from tpuserver import faults
 
+# The wire-mapped stream failures are the CANONICAL tpuserver.errors
+# types (one definition site, tpulint R4-enforced): DeadlineExceeded
+# (504) for an expired per-request bound — while waiting for admission
+# or mid-generation; SlotQuarantined (422) for a stream whose OWN
+# decode output went non-finite (only the offender retires, co-batched
+# streams keep decoding); UnknownGeneration (404) for a resume id this
+# replica does not hold.  Re-exported here so the historical
+# ``from tpuserver.scheduler import SlotQuarantined`` keeps working.
+from tpuserver.errors import (  # noqa: F401 — re-exported
+    DeadlineExceeded,
+    SlotQuarantined,
+    UnknownGeneration,
+)
+
 
 class SchedulerClosed(Exception):
     """Raised on submit after the scheduler has been shut down (or while
-    it is draining), and into streams the shutdown failed."""
+    it is draining), and into streams the shutdown failed.  Scheduler-
+    local (not a ServerError): the core maps it to ShuttingDown (503)."""
 
 
 class AdmissionQueueFull(RuntimeError):
     """Raised on submit when the pending queue is at capacity — the
     scheduler-level overload signal (RuntimeError subclass for backward
-    compatibility; frontends map it to HTTP 429 / RESOURCE_EXHAUSTED)."""
-
-
-class DeadlineExceeded(Exception):
-    """Raised into a stream whose per-request deadline expired — either
-    while waiting for admission (before prefill) or mid-generation (the
-    slot retires and frees immediately)."""
-
-
-class SlotQuarantined(Exception):
-    """Raised into a stream whose OWN decode output was poisoned
-    (non-finite logits — e.g. a NaN-producing prompt): only the
-    offending slot retires; co-batched streams keep decoding untouched.
-    Frontends map it to HTTP 422 / gRPC INVALID_ARGUMENT — the request
-    is at fault, not the server, so clients must not blind-retry it."""
-
-
-class UnknownGeneration(Exception):
-    """Raised by :meth:`DecodeScheduler.resume` for a generation id that
-    was never issued, already resumed, or aged out of the replay buffer
-    (TTL/capacity) — HTTP 404 / gRPC NOT_FOUND."""
+    compatibility; the core maps it to Overloaded — HTTP 429 /
+    RESOURCE_EXHAUSTED)."""
 
 
 class _Stream:
@@ -208,32 +204,36 @@ class DecodeScheduler:
         self._replay_ttl_s = float(replay_ttl_s)
         self._replay_capacity = int(replay_capacity)
         self._cond = threading.Condition()
-        self._pending = deque()
-        self._thread = None
-        self._supervisor = None
-        self._closed = False
-        self._draining = False
-        self._tripped = False  # restart budget exhausted: permanent
+        self._pending = deque()  # guarded-by: _cond
+        self._thread = None      # guarded-by: _cond
+        self._supervisor = None  # guarded-by: _cond
+        self._closed = False     # guarded-by: _cond
+        self._draining = False   # guarded-by: _cond
+        # restart budget exhausted: permanent  # guarded-by: _cond
+        self._tripped = False
         # epoch demotes superseded (wedged) loop threads: every delivery
         # into stream queues checks it under _cond, so a zombie waking
         # after a watchdog restart can never double-emit into a stream
-        # the new loop re-admitted
+        # the new loop re-admitted  # guarded-by: _cond
         self._epoch = 0
         # (epoch, monotonic start) of the current device op, or None —
         # epoch-tagged so a demoted zombie's stale stamps can neither
         # trip the watchdog against a healthy successor loop nor erase
-        # the successor's own beat
+        # the successor's own beat  # guarded-by: _cond
         self._heartbeat = None
-        self._loop_error = None  # set by a dying loop for the supervisor
-        self._restarts = 0       # lifetime count (stats/ops)
-        self._recent_restarts = deque()  # timestamps inside the window
-        self._quarantined = 0    # lifetime SlotQuarantined count
+        # set by a dying loop for the supervisor  # guarded-by: _cond
+        self._loop_error = None
+        self._restarts = 0       # lifetime count (stats/ops)  # guarded-by: _cond
+        # timestamps inside the window  # guarded-by: _cond
+        self._recent_restarts = deque()
+        # lifetime SlotQuarantined count  # guarded-by: _cond
+        self._quarantined = 0
         # generation_id -> (stream, completed, expires_monotonic):
-        # the bounded, TTL'd replay buffer for client resume
+        # the bounded, TTL'd replay buffer  # guarded-by: _cond
         self._replay = OrderedDict()
         # every live (not yet terminally-delivered) stream, pending or
         # slotted: close() fails exactly this set when the loop cannot
-        # (join timeout), and drain() waits on it emptying
+        # (join timeout), and drain() waits on it  # guarded-by: _cond
         self._streams = set()
 
     # -- frontend side -----------------------------------------------------
@@ -479,8 +479,11 @@ class DecodeScheduler:
         """False after the decode loop tripped permanently (restart
         budget exhausted) or the scheduler was closed — readiness
         probes report this through ``ServerReady``/``ModelReady`` so
-        pools rotate flapping replicas out."""
-        return not self._tripped and not self._closed
+        pools rotate flapping replicas out.  Reads under ``_cond``
+        (reentrant — stats() calls this with it held) so a probe never
+        sees a half-applied trip."""
+        with self._cond:
+            return not self._tripped and not self._closed
 
     def stats(self):
         """Introspection for tests and ops: live stream / pending counts
@@ -525,13 +528,18 @@ class DecodeScheduler:
     def _beat(self, epoch, now):
         """Stamp (or clear, ``now=None``) this loop's device-op
         heartbeat.  A superseded loop's clear is dropped so a zombie
-        cannot erase the live loop's beat mid-step."""
-        if now is not None:
-            self._heartbeat = (epoch, now)
-        else:
-            hb = self._heartbeat
-            if hb is not None and hb[0] == epoch:
-                self._heartbeat = None
+        cannot erase the live loop's beat mid-step.  Takes ``_cond``
+        (reentrant — the loop's except hook calls this with it held):
+        the watchdog compares (epoch, stamp) pairs, and a torn
+        read-modify-write against a concurrent supervisor demotion
+        could resurrect a cleared beat."""
+        with self._cond:
+            if now is not None:
+                self._heartbeat = (epoch, now)
+            else:
+                hb = self._heartbeat
+                if hb is not None and hb[0] == epoch:
+                    self._heartbeat = None
 
     def _hung_locked(self, now):
         hb = self._heartbeat

@@ -189,10 +189,10 @@ class CircuitBreaker:
         self.cooldown_s = float(cooldown_s)
         self._now = now
         self._lock = threading.Lock()
-        self._state = BREAKER_CLOSED
-        self._consecutive_failures = 0
-        self._open_until = 0.0
-        self._probe_inflight = False
+        self._state = BREAKER_CLOSED  # guarded-by: _lock
+        self._consecutive_failures = 0  # guarded-by: _lock
+        self._open_until = 0.0  # guarded-by: _lock
+        self._probe_inflight = False  # guarded-by: _lock
 
     def _poll_locked(self):
         if self._state == BREAKER_OPEN and self._now() >= self._open_until:
@@ -360,11 +360,11 @@ class EndpointPool:
         self._hedge_delay_s = hedge_delay_s
         self._verbose = verbose
         self._lock = threading.Lock()
-        self._rr = 0  # round-robin cursor
+        self._rr = 0  # round-robin cursor  # guarded-by: _lock
         self._closed = False
         self._stream_endpoint = None
-        self._hedges_fired = 0
-        self._hedges_won = 0
+        self._hedges_fired = 0  # guarded-by: _lock
+        self._hedges_won = 0  # guarded-by: _lock
         self._endpoints = []
         for url in urls:
             client = client_factory(url)
@@ -393,8 +393,8 @@ class EndpointPool:
         # attempts queued behind themselves, a permanent deadlock.
         # Hedge tasks never submit further tasks, so the hedge executor
         # always makes progress.
-        self._executor = None
-        self._hedge_executor = None
+        self._executor = None  # guarded-by: _executor_lock
+        self._hedge_executor = None  # guarded-by: _executor_lock
         self._executor_lock = threading.Lock()
         self._prober = None
         self._prober_stop = threading.Event()
@@ -425,12 +425,20 @@ class EndpointPool:
         self._prober_stop.set()
         if self._prober is not None:
             self._prober.join(timeout=5)
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-        if self._hedge_executor is not None:
+        # snapshot under the executor lock: _closed was set above, so a
+        # concurrent _ensure_* either published its executor before this
+        # snapshot (and it is shut down here) or acquires the lock after
+        # and refuses on _closed — a post-close executor can never be
+        # created and leak its non-daemon workers
+        with self._executor_lock:
+            executor = self._executor
+            hedge_executor = self._hedge_executor
+        if executor is not None:
+            executor.shutdown(wait=True)
+        if hedge_executor is not None:
             # joins hedge losers too: a discarded attempt fully resolves
             # (and lands its breaker bookkeeping) before clients close
-            self._hedge_executor.shutdown(wait=True)
+            hedge_executor.shutdown(wait=True)
         for ep in self._endpoints:
             try:
                 ep.client.close()
@@ -442,10 +450,13 @@ class EndpointPool:
     def stats(self):
         """Per-endpoint health/breaker/traffic counters plus hedging
         totals — the pool's routing decisions, inspectable."""
+        with self._lock:
+            hedges_fired = self._hedges_fired
+            hedges_won = self._hedges_won
         return {
             "endpoints": [ep.stats() for ep in self._endpoints],
-            "hedges_fired": self._hedges_fired,
-            "hedges_won": self._hedges_won,
+            "hedges_fired": hedges_fired,
+            "hedges_won": hedges_won,
         }
 
     def endpoint_states(self):
@@ -619,6 +630,12 @@ class EndpointPool:
 
     def _ensure_executor(self):
         with self._executor_lock:
+            if self._closed:
+                # close() flips _closed BEFORE taking this lock for its
+                # shutdown snapshot: refusing here means an executor can
+                # never be created after the snapshot ran (it would leak
+                # its non-daemon workers with nothing left to join them)
+                raise_error("EndpointPool is closed")
             if self._executor is None:
                 self._executor = ThreadPoolExecutor(
                     max_workers=max(8, 4 * len(self._endpoints)),
@@ -628,6 +645,8 @@ class EndpointPool:
 
     def _ensure_hedge_executor(self):
         with self._executor_lock:
+            if self._closed:
+                raise_error("EndpointPool is closed")  # see _ensure_executor
             if self._hedge_executor is None:
                 self._hedge_executor = ThreadPoolExecutor(
                     max_workers=max(16, 8 * len(self._endpoints)),

@@ -1,0 +1,317 @@
+"""tpulint: the project's static-analysis gate, and the gate's own tests.
+
+Three layers:
+
+1. **The real gate** — all six rules over ``src/python`` must be clean
+   (modulo the checked-in baseline, which is kept empty).  This is the
+   tier-1 invariant every future PR inherits: guarded fields stay
+   locked, nothing blocks under a lock, deadline math stays monotonic,
+   typed errors stay wire-mapped, threads stay daemon-or-joined, fault
+   points stay registered.
+2. **The fixture suite** — known-bad snippets under
+   ``tests/tpulint_fixtures/`` pin each rule's exact ``file:line``
+   findings, the suppression comment, and baseline add/expire.
+3. **Doc-drift checks** — the resilience doc's fault table must match
+   ``faults.POINTS`` and its stats paragraph must document every
+   ``DecodeScheduler.stats()`` key.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint  # `pytest -m lint` runs just this gate
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+FIXTURES = os.path.join(REPO_ROOT, "tests", "tpulint_fixtures")
+BASELINE = os.path.join(REPO_ROOT, "tools", "tpulint_baseline.txt")
+RESILIENCE_MD = os.path.join(REPO_ROOT, "docs", "resilience.md")
+
+from tpulint import RULES_BY_ID, lint_paths  # noqa: E402
+from tpulint.findings import apply_baseline  # noqa: E402
+
+
+def _lint_fixture(subdir, rule, docs_path=None, baseline_path=None):
+    result = lint_paths(
+        [os.path.join(FIXTURES, subdir)], rules=[rule],
+        docs_path=docs_path, baseline_path=baseline_path,
+        repo_root=REPO_ROOT)
+    return result
+
+
+def _lines(findings):
+    return sorted(f.lineno for f in findings)
+
+
+# -- layer 1: the real tree is clean -----------------------------------------
+
+
+def test_real_tree_is_clean_under_all_six_rules():
+    """The tier-1 gate: src/python lints clean (empty baseline)."""
+    result = lint_paths(
+        [SRC_PY], rules=None, baseline_path=BASELINE,
+        docs_path=RESILIENCE_MD, repo_root=REPO_ROOT)
+    assert not result.new, "new tpulint findings:\n" + "\n".join(
+        f.render() for f in result.new)
+    assert not result.stale, (
+        "stale baseline entries (run tools/tpulint.py --update-baseline): "
+        "{}".format(result.stale))
+
+
+def test_every_rule_ran_over_the_real_tree():
+    """All six rules are registered and selected by default."""
+    assert sorted(RULES_BY_ID) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+
+
+def test_exception_twins_are_one_class():
+    """The satellite dedup, runtime-pinned: scheduler and core raise
+    the SAME canonical tpuserver.errors classes (historically two
+    definitions kept in sync only by convention)."""
+    from tpuserver import core, errors, scheduler
+
+    for name in ("DeadlineExceeded", "SlotQuarantined",
+                 "UnknownGeneration"):
+        canonical = getattr(errors, name)
+        assert getattr(scheduler, name) is canonical, name
+        assert getattr(core, name) is canonical, name
+    assert issubclass(errors.SlotQuarantined, errors.ServerError)
+    assert errors.SlotQuarantined("x").code == 422
+    assert errors.UnknownGeneration("x").code == 404
+    assert errors.DeadlineExceeded("x").code == 504
+
+
+# -- layer 2: the fixture suite ----------------------------------------------
+
+
+def test_r1_guarded_by_fixture():
+    findings = _lint_fixture("r1", "R1").new
+    assert _lines(findings) == [16, 19, 34]
+    by_line = {f.lineno: f.message for f in findings}
+    assert "written outside" in by_line[16]
+    assert "read outside" in by_line[19]
+    # the closure case: a callback defined under the lock runs later,
+    # without it
+    assert "callback()" in by_line[34]
+    # the suppressed read (line 25) and the *_locked-convention and
+    # Condition-alias accesses produced no findings
+    assert all(f.path.endswith("r1/bad.py") for f in findings)
+
+
+def test_r2_blocking_and_lock_order_fixture():
+    findings = _lint_fixture("r2", "R2").new
+    assert _lines(findings) == [14, 18, 26, 49, 64]
+    by_line = {f.lineno: f.message for f in findings}
+    assert "time.sleep" in by_line[14]
+    assert "Thread.join" in by_line[18]
+    # join(5.0) positionally is a thread join too (str.join never
+    # takes a numeric literal); line 30's ",".join stays clean
+    assert "Thread.join" in by_line[26]
+    assert "lock-acquisition-order cycle" in by_line[49]
+    assert "Deadlock._a -> Deadlock._b -> Deadlock._a" in by_line[49]
+    # the multi-item form `with self._c, self._d:` acquires
+    # sequentially — the c->d edge exists, so reversed nesting cycles
+    assert ("MultiItemDeadlock._c -> MultiItemDeadlock._d -> "
+            "MultiItemDeadlock._c") in by_line[64]
+
+
+def test_r3_monotonic_clock_fixture():
+    findings = _lint_fixture("r3", "R3").new
+    assert _lines(findings) == [6, 10, 11, 13, 28, 29, 39]
+    by_line = {f.lineno: f.message for f in findings}
+    assert "wall-clock read time.time()" in by_line[6]
+    assert "used in a comparison" in by_line[11]
+    assert "passed as timeout=" in by_line[13]
+    # line 23 (suppressed) and monotonic_is_fine produced nothing;
+    # the closure's defect reports EXACTLY once, attributed to the
+    # closure's own scope (nested defs are pruned from the outer walk)
+    assert "in inner()" in by_line[29]
+    # taint tracking walks in document order: an assignment nested two
+    # levels deep still taints a shallow sink below it
+    assert "passed to .wait()" in by_line[39]
+
+
+def test_r4_wire_map_fixture():
+    findings = _lint_fixture(
+        "r4", "R4",
+        docs_path=os.path.join(FIXTURES, "r4", "docs.md")).new
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 4
+    assert sum("HTTP status map" in m for m in msgs) == 1
+    assert sum("gRPC code map" in m for m in msgs) == 1
+    assert sum("status table in docs" in m for m in msgs) == 1
+    assert sum("duplicate definition" in m for m in msgs) == 1
+    # the unmapped code is named, and the twin anchors in twin.py
+    assert all("418" in m for m in msgs if "missing" in m)
+    twin = [f for f in findings if "duplicate" in f.message][0]
+    assert twin.path.endswith("r4/twin.py") and twin.lineno == 4
+
+
+def test_r4_missing_wire_map_is_a_finding_not_a_skip():
+    """Renaming/moving _STATUS_LINE or _status_code must fail the
+    gate, not silently disable R4."""
+    result = lint_paths(
+        [os.path.join(FIXTURES, "r4", "errors_like.py")], rules=["R4"],
+        repo_root=REPO_ROOT)
+    msgs = [f.message for f in result.new]
+    assert len(msgs) == 2
+    assert any("no HTTP status map" in m for m in msgs)
+    assert any("no gRPC code map" in m for m in msgs)
+
+
+def test_r5_thread_lifecycle_fixture():
+    findings = _lint_fixture("r5", "R5").new
+    assert _lines(findings) == [44, 49]
+    # DaemonOwner (daemon=True), JoinedOwner (join(timeout=5)),
+    # JoinedPositionalOwner (join(5) positional), and AppendOwner
+    # (`self._threads.append(Thread(...))` idiom, joined in close())
+    # produced no findings
+    assert all("daemon=True" in f.message for f in findings)
+
+
+def test_r6_fault_registry_fixture():
+    findings = _lint_fixture("r6", "R6").new
+    by_line = {(os.path.basename(f.path), f.lineno): f.message
+               for f in findings}
+    assert len(findings) == 4
+    assert "dead registry entry" in by_line[("faults.py", 6)]
+    assert "not registered" in by_line[("site.py", 7)]
+    assert "string-literal" in by_line[("site.py", 8)]
+    assert "2 sites" in by_line[("site.py", 10)]
+
+
+def test_suppression_comment_silences_exactly_its_line():
+    # r1/bad.py line 25 carries `# tpulint: disable=R1` on a guarded
+    # read; the identical unsuppressed read on line 19 still fires
+    findings = _lint_fixture("r1", "R1").new
+    assert 25 not in _lines(findings)
+    assert 19 in _lines(findings)
+
+
+def test_baseline_grandfathers_and_expires(tmp_path):
+    result = _lint_fixture("r1", "R1")
+    assert len(result.new) == 3
+    # adding the current findings to a baseline silences them ...
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "# comment line\n"
+        + "\n".join(f.fingerprint for f in result.new) + "\n")
+    rebased = _lint_fixture("r1", "R1", baseline_path=str(baseline))
+    assert rebased.new == []
+    assert len(rebased.grandfathered) == 3
+    assert rebased.stale == []
+    # ... and an entry whose finding was fixed reports as stale
+    baseline.write_text(
+        "\n".join(f.fingerprint for f in result.new)
+        + "\nsrc/python/fixed.py|R1|finding that no longer exists\n")
+    stale = _lint_fixture("r1", "R1", baseline_path=str(baseline))
+    assert stale.new == []
+    assert stale.stale == [
+        "src/python/fixed.py|R1|finding that no longer exists"]
+
+
+def test_baseline_matching_is_multiset():
+    result = _lint_fixture("r1", "R1")
+    one_entry = [result.new[0].fingerprint]
+    # duplicate findings need duplicate entries: one entry absorbs one
+    new, grandfathered, stale = apply_baseline(result.new, one_entry)
+    assert len(grandfathered) == 1 and len(new) == 2 and not stale
+
+
+# -- the CLI and the check.py wrapper ----------------------------------------
+
+
+def _run(cmd):
+    return subprocess.run(
+        cmd, cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_clean_tree_exits_zero():
+    proc = _run([sys.executable, "tools/tpulint.py"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_findings_exit_nonzero_and_render_file_line():
+    proc = _run([
+        sys.executable, "tools/tpulint.py", "--rules", "R2",
+        "--baseline", "", "--docs", "",
+        os.path.join("tests", "tpulint_fixtures", "r2")])
+    assert proc.returncode == 1
+    assert "r2/bad.py:14 R2(no-blocking-under-lock)" in proc.stdout.replace(
+        os.sep, "/")
+
+
+def test_cli_explain():
+    proc = _run([sys.executable, "tools/tpulint.py", "--explain", "R3"])
+    assert proc.returncode == 0
+    assert "monotonic" in proc.stdout
+    proc = _run([sys.executable, "tools/tpulint.py", "--explain", "R9"])
+    assert proc.returncode == 2
+
+
+def test_check_py_wrapper_is_clean():
+    """The one-command lint gate (tpulint + optional ruff) passes on
+    the tree; a missing ruff binary is a skip, never a failure."""
+    proc = _run([sys.executable, "tools/check.py"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+# -- layer 3: doc drift ------------------------------------------------------
+
+
+def _resilience_text():
+    with open(RESILIENCE_MD, "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_fault_table_matches_points_registry():
+    """docs/resilience.md's fault-injection table documents exactly the
+    points registered in faults.POINTS (R6 pins code<->registry; this
+    pins registry<->docs)."""
+    import re
+
+    from tpuserver import faults
+
+    text = _resilience_text()
+    documented = set(re.findall(r"^\|\s*`([a-z_.]+)`\s*\|", text,
+                                flags=re.MULTILINE))
+    assert documented == set(faults.POINTS), (
+        "fault table drift: documented-only={}, registry-only={}".format(
+            documented - set(faults.POINTS),
+            set(faults.POINTS) - documented))
+
+
+def test_scheduler_stats_keys_are_documented():
+    """Every counter DecodeScheduler.stats() returns is named (as
+    `backticked` code) in docs/resilience.md — ops docs cannot drift
+    from the introspection surface."""
+    from tpuserver.scheduler import DecodeScheduler
+
+    # stats() touches no device state: fns/params may be None
+    sched = DecodeScheduler(None, None, max_slots=1, max_seq=8)
+    try:
+        keys = set(sched.stats())
+    finally:
+        sched.close(join_timeout=0.1)
+    text = _resilience_text()
+    missing = {k for k in keys if "`{}`".format(k) not in text}
+    assert not missing, (
+        "DecodeScheduler.stats() keys undocumented in "
+        "docs/resilience.md: {}".format(sorted(missing)))
+
+
+def test_points_registry_is_importable_and_described():
+    from tpuserver import faults
+
+    assert set(faults.POINTS) == {
+        "scheduler.step", "scheduler.fetch", "scheduler.admit",
+        "core.shm_read", "http.generate_stream", "grpc.stream_infer",
+    }
+    assert all(isinstance(v, str) and v for v in faults.POINTS.values())
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

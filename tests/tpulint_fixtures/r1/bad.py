@@ -1,0 +1,35 @@
+"""R1 fixture: guarded-by annotation, violations, and sanctioned forms."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0  # guarded-by: _lock
+        self._cond = threading.Condition(self._lock)
+
+    def locked_increment(self):  # OK: lexical with-block
+        with self._lock:
+            self._count += 1
+
+    def unlocked_write(self):  # FINDING (line 16)
+        self._count = 2
+
+    def unlocked_read(self):  # FINDING (line 19)
+        return self._count
+
+    def _bump_locked(self):  # OK: *_locked convention — caller holds it
+        self._count += 1
+
+    def suppressed_read(self):  # OK: inline suppression
+        return self._count  # tpulint: disable=R1
+
+    def alias_read(self):  # OK: _cond wraps _lock (Condition alias)
+        with self._cond:
+            return self._count
+
+    def closure_escapes_lock(self):
+        with self._lock:
+            def callback():
+                self._count += 1  # FINDING (line 33): runs later, unlocked
+            return callback

@@ -1,0 +1,65 @@
+"""R2 fixture: blocking calls under a lock, and a lock-order cycle."""
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=lambda: None, daemon=True)
+
+    def sleeps_under_lock(self):
+        with self._lock:
+            time.sleep(0.5)  # FINDING (line 14)
+
+    def joins_under_lock(self):
+        with self._lock:
+            self._thread.join()  # FINDING (line 18)
+
+    def waits_on_own_cond(self):  # OK: Condition.wait releases the lock
+        with self._cond:
+            self._cond.wait(0.1)
+
+    def joins_positionally_under_lock(self):
+        with self._lock:
+            self._thread.join(5.0)  # FINDING (line 26): positional timeout
+
+    def string_join_is_fine(self):
+        with self._lock:
+            return ",".join(["a", "b"])  # OK: str.join, not Thread.join
+
+    def suppressed(self):
+        with self._lock:
+            time.sleep(0.1)  # tpulint: disable=R2
+
+
+class Deadlock:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def ba(self):
+        with self._b:
+            with self._a:  # FINDING (line 44): cycle a -> b -> a
+                pass
+
+
+class MultiItemDeadlock:
+    def __init__(self):
+        self._c = threading.Lock()
+        self._d = threading.Lock()
+
+    def cd(self):
+        with self._c, self._d:  # one statement, but c is held when d
+            pass                # is acquired: builds the c -> d edge
+
+    def dc(self):
+        with self._d:
+            with self._c:  # FINDING: cycle c -> d -> c
+                pass

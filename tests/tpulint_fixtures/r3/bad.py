@@ -1,0 +1,39 @@
+"""R3 fixture: wall-clock reads and tainted deadline flow."""
+import time
+
+
+def stamps_wall_clock():
+    return time.time()  # FINDING (line 6): banned wall-clock read
+
+
+def wall_clock_deadline(cond):
+    t = time.time()  # FINDING (line 10): banned wall-clock read
+    if time.monotonic() >= t:  # FINDING (line 11): tainted comparison
+        return True
+    cond.wait(timeout=t)  # FINDING (line 13): tainted timeout kwarg
+    return False
+
+
+def monotonic_is_fine():
+    deadline = time.monotonic() + 5.0  # OK
+    return time.monotonic() >= deadline
+
+
+def suppressed_reporting():
+    return time.time()  # tpulint: disable=R3
+
+
+def outer_with_closure():
+    def inner():
+        now = time.time()  # FINDING — exactly once, not double-walked
+        return now > 5     # FINDING (comparison) — exactly once
+    return inner
+
+
+def deeply_nested_taint(cond, flag):
+    if flag:
+        if flag:
+            t = time.time()  # tpulint: disable=R3 (sanctioned read)
+        else:
+            t = 0.0
+    cond.wait(t)  # FINDING (line 39): taint survives deep nesting

@@ -1,0 +1,17 @@
+"""R4 fixture: a ServerError hierarchy with an unmapped member."""
+
+
+class ServerError(Exception):
+    def __init__(self, msg, code=400, retry_after=None):
+        super().__init__(msg)
+        self.code = code
+
+
+class MappedError(ServerError):  # OK: 429 present on every surface
+    def __init__(self, msg):
+        super().__init__(msg, code=429)
+
+
+class TeapotError(ServerError):  # FINDINGS: 418 missing from all maps
+    def __init__(self, msg):
+        super().__init__(msg, code=418)

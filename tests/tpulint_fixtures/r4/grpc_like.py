@@ -1,0 +1,9 @@
+"""R4 fixture: the gRPC code map (418 deliberately absent)."""
+
+
+def _status_code(http_code):
+    return {
+        400: "INVALID_ARGUMENT",
+        429: "RESOURCE_EXHAUSTED",
+        500: "INTERNAL",
+    }.get(http_code, "UNKNOWN")

@@ -1,0 +1,8 @@
+"""R4 fixture: the HTTP status-line map (418 deliberately absent)."""
+
+_STATUS_LINE = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+}

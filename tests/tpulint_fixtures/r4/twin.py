@@ -1,0 +1,5 @@
+"""R4 fixture: a twin definition of a wire-mapped error name."""
+
+
+class TeapotError(Exception):  # FINDING: duplicate of errors_like's
+    pass

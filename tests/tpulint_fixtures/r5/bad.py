@@ -1,0 +1,50 @@
+"""R5 fixture: thread lifecycle — daemon, joined, and leaked."""
+import threading
+
+
+class DaemonOwner:
+    def start(self):  # OK: daemon dies with its owner
+        self._t = threading.Thread(target=lambda: None, daemon=True)
+        self._t.start()
+
+
+class JoinedOwner:
+    def start(self):  # OK: joined on the close() path
+        self._worker = threading.Thread(target=lambda: None)
+        self._worker.start()
+
+    def close(self):
+        self._worker.join(timeout=5)
+
+
+class JoinedPositionalOwner:
+    def start(self):  # OK: join(5) positional counts as a thread join
+        self._worker = threading.Thread(target=lambda: None)
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join(5)
+
+
+class AppendOwner:
+    def __init__(self):
+        self._threads = []
+
+    def start(self):  # OK: append idiom, all joined on the close() path
+        for _ in range(2):
+            self._threads.append(threading.Thread(target=lambda: None))
+
+    def close(self):
+        for t in self._threads:
+            t.join()
+
+
+class Leaker:
+    def start(self):
+        self._t = threading.Thread(target=lambda: None)  # FINDING (line 21)
+        self._t.start()
+
+
+def module_level_leak():
+    t = threading.Thread(target=lambda: None)  # FINDING (line 26)
+    t.start()

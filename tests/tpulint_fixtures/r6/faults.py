@@ -1,0 +1,7 @@
+"""R6 fixture: a fault-point registry with a dead entry."""
+
+POINTS = {
+    "used.point": "fires once - OK",
+    "dup.point": "fires twice - duplicate finding",
+    "orphan.point": "never fires - dead-entry finding",
+}

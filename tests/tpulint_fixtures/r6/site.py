@@ -1,0 +1,10 @@
+"""R6 fixture: fire() sites — registered, unknown, dynamic, duplicated."""
+import faults
+
+
+def serve(name):
+    faults.fire("used.point")     # OK: registered, unique
+    faults.fire("unknown.point")  # FINDING (line 7): not registered
+    faults.fire(name)             # FINDING (line 8): dynamic name
+    faults.fire("dup.point")
+    faults.fire("dup.point")      # FINDING (line 10): second site

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""tpulint CLI — the tier-1 static-analysis gate.
+
+    python tools/tpulint.py [paths...]            # lint (default: src/python)
+    python tools/tpulint.py --explain R1          # rule documentation
+    python tools/tpulint.py --rules R1,R3 src/python/tpuserver
+    python tools/tpulint.py --update-baseline     # grandfather current findings
+
+Exit codes: 0 clean (stale baseline entries warn unless
+--strict-baseline), 1 new findings (or stale entries under
+--strict-baseline), 2 usage error.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+if SRC_PY not in sys.path:
+    sys.path.insert(0, SRC_PY)
+
+from tpulint import RULES_BY_ID, lint_paths, select_rules  # noqa: E402
+from tpulint.findings import write_baseline  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "tpulint_baseline.txt")
+DEFAULT_DOCS = os.path.join(REPO_ROOT, "docs", "resilience.md")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to lint "
+                             "(default: src/python)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids/names "
+                             "(default: all six)")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file of grandfathered findings "
+                             "(default: tools/tpulint_baseline.txt; "
+                             "'' disables)")
+    parser.add_argument("--docs", default=DEFAULT_DOCS,
+                        help="resilience doc whose status table R4 "
+                             "checks ('' disables the docs check)")
+    parser.add_argument("--explain", metavar="RULE", default=None,
+                        help="print a rule's documentation and exit")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current "
+                             "findings (expiring stale entries)")
+    parser.add_argument("--strict-baseline", action="store_true",
+                        help="stale baseline entries fail the run")
+    args = parser.parse_args(argv)
+
+    if args.explain:
+        try:
+            (rule,) = select_rules([args.explain])
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        print("{} ({})".format(rule.id, rule.name))
+        print((rule.__doc__ or "(no documentation)").strip())
+        return 0
+
+    paths = args.paths or [SRC_PY]
+    rules = ([t.strip() for t in args.rules.split(",") if t.strip()]
+             if args.rules else None)
+    try:
+        result = lint_paths(
+            paths, rules=rules,
+            baseline_path=args.baseline or None,
+            docs_path=args.docs or None,
+            repo_root=REPO_ROOT)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline needs --baseline", file=sys.stderr)
+            return 2
+        write_baseline(args.baseline, result.all_findings)
+        print("wrote {} baseline entr{} to {}".format(
+            len(result.all_findings),
+            "y" if len(result.all_findings) == 1 else "ies",
+            args.baseline))
+        return 0
+
+    for f in sorted(result.new, key=lambda f: f.sort_key()):
+        print(f.render())
+    if result.grandfathered:
+        print("({} grandfathered finding{} suppressed by the baseline)"
+              .format(len(result.grandfathered),
+                      "" if len(result.grandfathered) == 1 else "s"))
+    for entry in result.stale:
+        print("stale baseline entry (no longer matches): {}".format(entry),
+              file=sys.stderr)
+    if result.stale:
+        print("re-run with --update-baseline to expire stale entries",
+              file=sys.stderr)
+
+    if result.new:
+        print("tpulint: {} new finding{}".format(
+            len(result.new), "" if len(result.new) == 1 else "s"),
+            file=sys.stderr)
+        return 1
+    if result.stale and args.strict_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
