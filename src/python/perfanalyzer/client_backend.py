@@ -84,6 +84,15 @@ class ClientBackend:
 
         return server_stats_snapshot(self.server_statistics(model), model)
 
+    def router_snapshot(self):
+        """Cumulative fleet-router counters (``failovers``,
+        ``handoffs``, ``resumed_streams``, ``shed``) when the target is
+        a ``tpuserver.router.FleetRouter``, else None.  Only transports
+        that can reach ``/router/stats`` override this — the profiler
+        diffs the snapshot per load level so router-absorbed faults
+        surface in the report next to ``resumed_streams``."""
+        return None
+
     # -- inference --------------------------------------------------------
 
     def prepare(self, model, input_sets):
@@ -271,6 +280,45 @@ class HttpBackend(ClientBackend):
         # client, polluting the measured latency
         self.client = httpclient.InferenceServerClient(
             url, concurrency=self._executor_workers)
+        # tri-state: None = not yet probed, False = target is a plain
+        # replica (the 404 verdict is cached), True = fleet router
+        self._is_router = None
+
+    def router_snapshot(self):
+        """``/router/stats`` counters when the url fronts a
+        FleetRouter; a plain replica answers 404 once and is never
+        probed again."""
+        if self._is_router is False:
+            return None
+        import http.client as _http_client
+        import json as _json
+
+        host, sep, port = self.url.rpartition(":")
+        if not sep or not port.isdigit():
+            # base-path or port-less url: the raw /router/stats probe
+            # cannot reach a router through it — permanent verdict, and
+            # never a crashed profile sweep
+            self._is_router = False
+            return None
+        conn = _http_client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/router/stats")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                self._is_router = False
+                return None
+            snap = _json.loads(resp.read())
+        except (OSError, ValueError, _http_client.HTTPException):
+            return None  # transient: do not latch the verdict
+        finally:
+            conn.close()
+        self._is_router = True
+        return {
+            "failovers": _coerce_int(snap.get("failovers")),
+            "handoffs": _coerce_int(snap.get("handoffs")),
+            "resumed_streams": _coerce_int(snap.get("resumed_streams")),
+            "shed": _coerce_int(snap.get("shed")),
+        }
 
     def model_metadata(self, model):
         return self.client.get_model_metadata(model)

@@ -234,6 +234,7 @@ class GenerationProfiler:
         detector = StabilityDetector(
             self.stability_pct, self.stability_windows,
             check_latency=False)
+        router_before = self.backend.router_snapshot()
         windows = []
         stable = False
         interrupted = False
@@ -279,6 +280,8 @@ class GenerationProfiler:
             resume_events=sum(w["resume_events"] for w in merged),
             duration_s=duration,
         )
+        metrics.attach_router_delta(result, router_before,
+                                    self.backend.router_snapshot())
         for prefix, sample in (("ttft", ttfts), ("itl", itls)):
             if sample:
                 ms = sorted(v * 1e3 for v in sample)

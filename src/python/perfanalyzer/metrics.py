@@ -174,3 +174,21 @@ def merge_window_records(windows):
         "errors": errors,
         "duration_s": total_s,
     }
+
+
+def attach_router_delta(result, before, after):
+    """Fold a load level's fleet-router counter deltas into a
+    :class:`~perfanalyzer.profiler.ProfileResult` as ``router_*``
+    fields.
+
+    Only set when the backend target IS a router (both snapshots
+    non-None; see ``ClientBackend.router_snapshot``).  Level-scoped on
+    purpose — a router absorbs faults *between* the client and the
+    fleet, so its failover/handoff counters are the server-side twin of
+    the client-side ``resumed_streams``: nonzero means replicas were
+    dying or shedding under this level even though every request still
+    succeeded."""
+    if before is None or after is None:
+        return
+    for key in ("failovers", "handoffs", "resumed_streams", "shed"):
+        result["router_" + key] = after[key] - before[key]

@@ -117,6 +117,7 @@ class InferenceProfiler:
         detector = StabilityDetector(
             self.stability_pct, self.stability_windows,
             check_latency=self.check_latency_stability)
+        router_before = self.backend.router_snapshot()
         windows = []  # (duration, latencies, errors, server_delta)
         stable = False
         interrupted = False
@@ -166,6 +167,8 @@ class InferenceProfiler:
         )
         result.update(latency)
         result.update(breakdown)
+        metrics.attach_router_delta(result, router_before,
+                                    self.backend.router_snapshot())
         return result
 
     # -- the sweep ---------------------------------------------------------

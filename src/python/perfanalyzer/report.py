@@ -107,6 +107,21 @@ class ReportWriter:
             "perf_analyzer", self.model, self.backend_kind, mode),
             file=file)
         print(self.table(results), file=file, flush=True)
+        if any(r.get("router_handoffs") is not None for r in results):
+            # the target is a fleet router: its per-level resilience
+            # counters sit next to the client-side resumed_streams —
+            # nonzero means replicas died or shed under this level even
+            # when every request above still succeeded
+            for r in results:
+                if r.get("router_handoffs") is None:
+                    continue  # this level's snapshot transiently failed
+                print("  level {}: router failovers={} handoffs={} "
+                      "resumed_streams={} shed={}".format(
+                          r.get("level"),
+                          r.get("router_failovers"),
+                          r.get("router_handoffs"),
+                          r.get("router_resumed_streams"),
+                          r.get("router_shed")), file=file, flush=True)
 
     def write_csv(self, path, results):
         """Reference-style CSV: one row per load level."""

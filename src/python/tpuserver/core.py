@@ -912,6 +912,42 @@ class InferenceServer:
                 return False
         return True
 
+    def health_snapshot(self):
+        """Cheap machine-readable health/load snapshot — the routing
+        signal a fleet router's prober polls (`/v2/health/stats`).
+
+        Deliberately NOT the per-model inference-statistics verb: this
+        touches only the lifecycle state, the in-flight counter, and
+        each model's scheduler counters (one lock hold apiece), so a
+        sub-second probe cadence across a fleet costs nothing.  Shape::
+
+            {"state": "ready", "ready": true, "inflight": 3,
+             "max_inflight": 64,
+             "models": {"llama_generate": {<DecodeScheduler.stats()>}}}
+
+        ``models`` maps each registered model to its scheduler stats
+        dict (``None`` for models with no scheduler, or before first
+        use) — ``tripped``/``restarts``/``replay_entries`` and the
+        ``live_streams``/``pending`` vs ``max_slots``/``max_pending``
+        utilization are the routing and shed signals."""
+        with self._inflight_cond:
+            state = self._state
+            inflight = self._inflight
+            max_inflight = self._max_inflight
+        with self._lock:
+            items = list(self._models.items())
+        models = {}
+        for name, model in items:
+            stats_fn = getattr(model, "scheduler_stats", None)
+            models[name] = stats_fn() if callable(stats_fn) else None
+        return {
+            "state": state,
+            "ready": self.server_ready(),
+            "inflight": inflight,
+            "max_inflight": max_inflight,
+            "models": models,
+        }
+
     def mark_ready(self):
         """Flip a ``starting`` server to ``ready`` (after warmup), or
         cancel an in-progress ``begin_drain()`` (an ops undrain: the

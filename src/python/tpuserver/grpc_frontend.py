@@ -546,7 +546,9 @@ class GrpcFrontend:
         self._port = None
 
     def start(self):
-        bridge = _CoreBridge(self._core)
+        # kept on the frontend so a fleet-transition test (or an ops
+        # hot-swap) can repoint the serving core under a fixed address
+        self._bridge = bridge = _CoreBridge(self._core)
         handlers = {}
         for name, (req_cls, resp_cls, kind) in METHODS.items():
             if kind == "unary":

@@ -333,6 +333,11 @@ class _Handler(socketserver.StreamRequestHandler):
             # real readiness (starting/draining/watchdog-tripped all
             # report 503), not a constant — load balancers route on this
             return self._send(200 if core.server_ready() else 503)
+        if path == "/v2/health/stats":
+            # cheap routing-signal snapshot (lifecycle + scheduler
+            # counters, no per-model inference statistics): what the
+            # fleet router's prober polls at sub-second cadence
+            return self._send_json(core.health_snapshot())
         if path == "/v2" or path == "/v2/":
             return self._send_json(core.server_metadata())
         if path == "/v2/models/stats":

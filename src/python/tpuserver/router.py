@@ -1,0 +1,1449 @@
+"""Fleet router: a server-side front-tier that makes N replicas look
+like ONE resilient KServe server.
+
+The resilience stack so far lives either in the replica (deadlines,
+shedding, the self-healing scheduler) or in the client
+(``tritonclient.EndpointPool``) — so every one of "millions of users"
+must run a smart client, and a replica death still strands its
+replica-local replay state (stream resume is same-endpoint only).
+:class:`FleetRouter` moves that intelligence server-side: a thin HTTP
+process speaking the same KServe v2 + ``/generate_stream`` surface as a
+replica, load-balancing N backends with four robustness behaviors:
+
+1. **Health/drain-aware routing.**  A background prober polls every
+   replica's ``/v2/health/stats`` (the cheap lifecycle + scheduler-
+   counter snapshot — no per-model inference statistics) and folds it
+   into a per-replica eligibility flag and load score: draining,
+   tripped (restart budget exhausted), or stopped replicas rotate out
+   *before* a request lands on them, and requests go to the
+   least-loaded eligible replica.  A router-level ``max_inflight`` cap
+   sheds excess load with a typed 429 + ``Retry-After`` instead of
+   queueing.
+2. **Sticky resume.**  Every routed generation gets a router-assigned
+   ``generation_id`` and a generation→home-replica map whose TTL
+   matches the replicas' ``replay_ttl_s``; a reconnect carrying
+   ``Last-Event-ID`` (or ``resume_generation_id``) replays the
+   client-acked gap from the router's own event buffer and routes the
+   live continuation home to the replica that owns the replay state.
+3. **Cross-replica resume handoff.**  When the home replica is dead or
+   tripped, the router re-admits ``prompt + emitted-token history`` on
+   a healthy replica (greedy decode is deterministic, so the
+   continuation is token-identical — the same invariant the
+   scheduler's supervised restart relies on) and splices it behind the
+   replayed prefix with continued sequence numbers: a replica loss no
+   longer kills in-flight generations, and the client never learns a
+   handoff happened.  Handoff needs the ``PROMPT_IDS`` /
+   ``MAX_TOKENS`` / ``TOKEN`` generate contract; other streams degrade
+   to passthrough (failover before the first token only).
+4. **Passthrough resilience.**  Unary requests ride a failover loop:
+   connect-phase and typed-overload failures (the
+   ``tritonclient._auxiliary.FAILURE_*`` classification) fall through
+   to another replica under the request's own deadline budget (its
+   ``timeout`` parameter); typed non-overload answers relay untouched
+   — every replica would say the same.
+
+A **plain** ``tritonclient.http`` client pointed at the router gets all
+of this for free — resume included — with no ``EndpointPool``.  The
+router's own surface adds ``/router/stats`` (failover/handoff/shed
+counters + per-replica routing state) for perf tooling and ops.
+
+Run one with ``python tools/router.py --backends a:8000,b:8000``; see
+docs/resilience.md "Fleet router" for the full semantics and
+``tools/chaos_smoke.py --router`` for the soak.
+"""
+
+import http.client
+import json
+import re
+import socket
+import socketserver
+import sys
+import threading
+import time
+import uuid
+from collections import OrderedDict
+
+from tritonclient._auxiliary import (
+    FAILURE_CONNECT,
+    FAILURE_INTERRUPTED,
+    RetryPolicy,
+)
+
+__all__ = ["FleetRouter"]
+
+_GENERATE_STREAM_URI = re.compile(
+    r"^/v2/models/[^/]+(/versions/[^/]+)?/generate_stream$"
+)
+
+#: Mutating verbs whose side effect lives on ONE server (shm regions,
+#: repository state, settings): the router broadcasts them to every
+#: replica — routing them through failover would land the mutation on
+#: an arbitrary replica and desync the fleet (same contract as
+#: ``EndpointPool``'s broadcast set).
+_BROADCAST_URI = re.compile(
+    r"^/v2/(repository/models/[^/]+/(load|unload)"
+    r"|(system|cuda|xla)sharedmemory(/region/[^/]+)?/(register|unregister)"
+    r"|logging|trace/setting)$"
+)
+
+_STATUS_LINE = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    502: b"HTTP/1.1 502 Bad Gateway\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
+}
+
+#: Request headers forwarded to replicas (lowercased).  Hop-by-hop
+#: headers (connection, transfer framing) are the router's own;
+#: Content-Encoding is absent because the router decodes once and
+#: forwards identity.
+_FORWARD_REQUEST_HEADERS = (
+    "content-type",
+    "inference-header-content-length",
+    "accept-encoding",
+)
+
+#: Replica response headers relayed to the client, in canonical casing
+#: (the raw-socket client reads them case-sensitively).
+_RELAY_RESPONSE_HEADERS = {
+    "retry-after": "Retry-After",
+    "inference-header-content-length": "Inference-Header-Content-Length",
+    "content-encoding": "Content-Encoding",
+}
+
+
+def _relay_headers(resp_headers):
+    """The upstream response headers a client must see, re-keyed to
+    canonical casing."""
+    lowered = {k.lower(): v for k, v in resp_headers.items()}
+    return {canon: lowered[k]
+            for k, canon in _RELAY_RESPONSE_HEADERS.items()
+            if k in lowered}
+
+
+def _coerce_int(value, default=0):
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        return default
+
+
+def _snapshot_signals(snap):
+    """``(eligible, load)`` routing signals from one replica's
+    ``/v2/health/stats`` snapshot.
+
+    ``ready`` already folds in the lifecycle state machine AND the
+    model health veto (a tripped scheduler reports unhealthy), so
+    eligibility is the server's own truthful readiness; the load score
+    is in-flight requests plus every scheduler's live + queued
+    generations — what "least-loaded" means for this stack."""
+    if not isinstance(snap, dict):
+        return False, float("inf")
+    eligible = bool(snap.get("ready")) and snap.get("state") == "ready"
+    load = _coerce_int(snap.get("inflight"))
+    for stats in (snap.get("models") or {}).values():
+        if not isinstance(stats, dict):
+            continue
+        if stats.get("tripped") or stats.get("closed"):
+            eligible = False  # belt over the ready veto
+        load += _coerce_int(stats.get("live_streams"))
+        load += _coerce_int(stats.get("pending"))
+    return eligible, float(load)
+
+
+def _generation_contract(request_json):
+    """``(prompt, max_tokens, eos_id)`` when the request follows the
+    PROMPT_IDS / MAX_TOKENS generate contract (what cross-replica
+    handoff re-prefills), else ``(None, None, None)``."""
+    prompt = max_tokens = None
+    try:
+        for tin in request_json.get("inputs") or []:
+            if tin.get("name") == "PROMPT_IDS":
+                prompt = [int(v) for v in tin.get("data") or []]
+            elif tin.get("name") == "MAX_TOKENS":
+                max_tokens = int((tin.get("data") or [0])[0])
+    except (TypeError, ValueError):
+        return None, None, None
+    eos = (request_json.get("parameters") or {}).get("eos_id")
+    try:
+        eos = int(eos) if eos is not None else None
+    except (TypeError, ValueError):
+        eos = None
+    return prompt, max_tokens, eos
+
+
+def _token_of(payload):
+    """The emitted token an SSE event carries (the handoff re-prefill
+    feed), or None when the event has no TOKEN output."""
+    for out in payload.get("outputs") or []:
+        if out.get("name") == "TOKEN":
+            data = out.get("data") or []
+            try:
+                return int(data[0]) if data else None
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+def _request_deadline(body, headers):
+    """The request's own monotonic deadline from its ``timeout``
+    parameter (microseconds, Triton semantics), or None.  Failover
+    attempts must fit inside the caller's single budget — a router
+    retrying past it would answer a client that stopped waiting."""
+    if not body:
+        return None
+    try:
+        hlen = headers.get("inference-header-content-length")
+        blob = body[: int(hlen)] if hlen else body
+        t = (json.loads(blob).get("parameters") or {}).get("timeout")
+        return time.monotonic() + int(t) / 1e6 if t else None
+    except (AttributeError, TypeError, ValueError, UnicodeDecodeError):
+        # AttributeError: valid JSON that is not an object (e.g. "[]")
+        # — the replica owns the typed 400, not the router
+        return None
+
+
+class _Replica:
+    """One routed backend: its address plus the prober-fed routing
+    state (eligibility, load score, router-local in-flight count)."""
+
+    def __init__(self, url):
+        host, sep, port = url.rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                "replica url must be host:port (got {!r})".format(url))
+        self.url = url
+        self.host = host
+        self.port = int(port)
+        self._lock = threading.Lock()
+        # optimistic until the first probe lands, like the pool's
+        # endpoints — a router must be able to serve before its first
+        # probe cycle completes  # guarded-by: _lock
+        self._eligible = True
+        self._load = 0.0            # guarded-by: _lock
+        self._local_inflight = 0    # guarded-by: _lock
+        self._requests = 0          # guarded-by: _lock
+        self._failures = 0          # guarded-by: _lock
+        self._snapshot = None       # guarded-by: _lock
+
+    def update_snapshot(self, snap):
+        eligible, load = _snapshot_signals(snap)
+        with self._lock:
+            self._snapshot = snap
+            self._eligible = eligible
+            self._load = load
+
+    def mark_unreachable(self):
+        """A probe or request could not reach the replica: rotate it
+        out until a probe sees it healthy again."""
+        with self._lock:
+            self._eligible = False
+            self._snapshot = None
+            self._failures += 1
+
+    def note_typed_failure(self):
+        """A typed shed (429/503): the replica answered — count it but
+        leave rotation to the prober's readiness signal."""
+        with self._lock:
+            self._failures += 1
+
+    def begin_request(self):
+        with self._lock:
+            self._requests += 1
+            self._local_inflight += 1
+
+    def end_request(self):
+        with self._lock:
+            self._local_inflight -= 1
+
+    def routable(self):
+        """``(eligible, effective_load)``: the probe's load score plus
+        the router's own in-flight count against this replica — the
+        between-probes signal that keeps routing least-loaded."""
+        with self._lock:
+            return self._eligible, self._load + self._local_inflight
+
+    def stats(self):
+        with self._lock:
+            return {
+                "url": self.url,
+                "eligible": self._eligible,
+                "load": self._load + self._local_inflight,
+                "requests": self._requests,
+                "failures": self._failures,
+            }
+
+
+class _Generation:
+    """Router-side record of one streamed generation: the original
+    request (the handoff re-prefill source), every event relayed so far
+    (the resume replay buffer), and the home replica that owns the live
+    replay state."""
+
+    def __init__(self, gen_id, path, request_json):
+        self.gen_id = gen_id
+        self.path = path
+        self.request = request_json  # read-only after construction
+        prompt, max_tokens, eos_id = _generation_contract(request_json)
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.eos_id = eos_id
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # rendered SSE blocks, list index == router seq  # guarded-by: _lock
+        self._events = []
+        # emitted TOKEN ints (None once an event arrives without one:
+        # the generation is not handoff-capable)  # guarded-by: _lock
+        self._tokens = [] if prompt is not None else None
+        # router seq = _offset + backend seq (bumped at each handoff:
+        # a re-admitted generation restarts backend numbering at 0)
+        self._offset = 0        # guarded-by: _lock
+        self._home = None       # guarded-by: _lock
+        self._completed = False  # guarded-by: _lock
+        # one serving connection at a time: a fast reconnect waits for
+        # the previous relay to notice its dead client  # guarded-by: _lock
+        self._busy = False
+
+    # -- serving-slot ownership -------------------------------------------
+
+    def acquire(self, wait_s=5.0):
+        deadline = time.monotonic() + wait_s
+        with self._cond:
+            while self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            self._busy = True
+            return True
+
+    def release(self):
+        with self._cond:
+            self._busy = False
+            self._cond.notify_all()
+
+    # -- event recording ---------------------------------------------------
+
+    def record_event(self, backend_seq, payload):
+        """Rewrite one upstream event into router numbering and append
+        it to the replay buffer.  Returns ``(router_seq, block_bytes)``
+        or ``(None, None)`` for an upstream replay duplicate."""
+        token = _token_of(payload)
+        with self._lock:
+            seq = self._offset + int(backend_seq)
+            expected = len(self._events)
+            if seq < expected:
+                return None, None  # upstream replayed an acked event
+            params = payload.setdefault("parameters", {})
+            params["generation_id"] = self.gen_id
+            params["seq"] = expected
+            # post-handoff events mark their id line with the handoff
+            # epoch ("gen~offset/seq"): router seqs no longer equal the
+            # serving replica's own numbering, and a RESTARTED router
+            # (registry gone) must see that in the client's
+            # Last-Event-ID and fail the resume typed instead of
+            # forwarding a misaligned replay point to a replica
+            gid = (self.gen_id if not self._offset
+                   else "{}~{}".format(self.gen_id, self._offset))
+            block = (
+                "id: {}/{}\n".format(gid, expected).encode("utf-8")
+                + b"data: " + json.dumps(payload).encode("utf-8") + b"\n\n"
+            )
+            self._events.append(block)
+            if self._tokens is not None:
+                if token is None:
+                    self._tokens = None  # not re-prefillable
+                else:
+                    self._tokens.append(token)
+            return expected, block
+
+    def mark_unresumable(self):
+        """The upstream sent an event without a seq (a non-scheduler
+        generation): no replay buffer, no handoff — passthrough only."""
+        with self._lock:
+            self._tokens = None
+
+    def replay_from(self, from_seq):
+        """``(blocks, completed, next_seq)`` for a client resume."""
+        with self._lock:
+            return (
+                list(self._events[from_seq:]),
+                self._completed,
+                len(self._events),
+            )
+
+    # -- home / lifecycle --------------------------------------------------
+
+    def set_home(self, url, rebase=False):
+        """Point the generation at a (new) owning replica; ``rebase``
+        restarts backend seq numbering at the current router seq (a
+        handed-off generation is a FRESH admission on its new home)."""
+        with self._lock:
+            self._home = url
+            if rebase:
+                self._offset = len(self._events)
+
+    def complete(self):
+        with self._lock:
+            self._completed = True
+
+    def emitted(self):
+        with self._lock:
+            return len(self._events)
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "home": self._home,
+                "seq": len(self._events),
+                "offset": self._offset,
+                "completed": self._completed,
+                "handoff_capable": self._tokens is not None,
+            }
+
+    # -- upstream request builders ----------------------------------------
+
+    def upstream_request(self, resuming):
+        """``(body, headers)`` that (re)establishes the upstream
+        stream: the original request with the router's generation id
+        injected; when ``resuming``, a ``Last-Event-ID`` in the home
+        replica's OWN numbering so it replays exactly the gap the
+        router has not buffered (usually nothing) and splices live."""
+        with self._lock:
+            request = dict(self.request)
+            params = dict(request.get("parameters") or {})
+            params.pop("resume_generation_id", None)
+            params.pop("resume_from_seq", None)
+            params["generation_id"] = self.gen_id
+            request["parameters"] = params
+            headers = {"Content-Type": "application/json"}
+            if resuming:
+                backend_last = len(self._events) - self._offset - 1
+                headers["Last-Event-ID"] = "{}/{}".format(
+                    self.gen_id, backend_last)
+            return json.dumps(request).encode("utf-8"), headers
+
+    def handoff_request(self):
+        """The re-admission body for a healthy replica: the original
+        inputs with ``PROMPT_IDS`` extended by every emitted token and
+        ``MAX_TOKENS`` shrunk by the emitted count — greedy decode is
+        deterministic, so re-prefilling the full emitted prefix yields
+        a token-identical continuation (the supervised-restart
+        invariant, applied across replicas).  Returns ``None`` when the
+        generation is not handoff-capable, or ``b""`` when every token
+        was already emitted and only the terminal marker was lost."""
+        with self._lock:
+            if (self._tokens is None or self.prompt is None
+                    or self.max_tokens is None):
+                return None
+            emitted = len(self._tokens)
+            remaining = self.max_tokens - emitted
+            if remaining <= 0 or (
+                self.eos_id is not None and emitted
+                and self._tokens[-1] == self.eos_id
+            ):
+                return b""
+            request = dict(self.request)
+            inputs = []
+            for tin in request.get("inputs") or []:
+                tin = dict(tin)
+                if tin.get("name") == "PROMPT_IDS":
+                    data = list(self.prompt) + list(self._tokens)
+                    tin["data"] = data
+                    tin["shape"] = [len(data)]
+                elif tin.get("name") == "MAX_TOKENS":
+                    tin["data"] = [remaining]
+                inputs.append(tin)
+            request["inputs"] = inputs
+            params = dict(request.get("parameters") or {})
+            params.pop("resume_generation_id", None)
+            params.pop("resume_from_seq", None)
+            params["generation_id"] = self.gen_id
+            request["parameters"] = params
+            return json.dumps(request).encode("utf-8")
+
+
+class FleetRouter:
+    """The router process core: replica set, prober, generation
+    registry, counters, and the embedded HTTP front-tier.
+
+    Parameters
+    ----------
+    backends : list[str]
+        ``host:port`` of each replica.
+    probe_interval_s / probe_timeout_s
+        Health-prober cadence and per-probe timeout.  One synchronous
+        probe round runs inside :meth:`start` so routing state is real
+        before the first request.
+    max_inflight : int or None
+        Router-level cap on concurrently forwarded requests; excess
+        sheds with a typed 429 + ``Retry-After`` instead of queueing.
+    gen_ttl_s / gen_capacity
+        Generation-registry bounds: match ``gen_ttl_s`` to the
+        replicas' ``replay_ttl_s`` (the windows must agree for sticky
+        resume to mean anything).
+    read_timeout_s / stream_wait_s
+        Upstream socket read timeout, and how long a resume waits for
+        a previous relay of the same generation to release it.
+    """
+
+    def __init__(self, backends, host="127.0.0.1", port=0,
+                 probe_interval_s=1.0, probe_timeout_s=2.0,
+                 max_inflight=None, gen_ttl_s=60.0, gen_capacity=1024,
+                 read_timeout_s=600.0, stream_wait_s=5.0, verbose=False):
+        if not backends:
+            raise ValueError("FleetRouter requires at least one backend")
+        if len(set(backends)) != len(backends):
+            raise ValueError(
+                "FleetRouter backends must be unique: {}".format(backends))
+        self._replicas = [_Replica(url) for url in backends]
+        self._policy = RetryPolicy(
+            max_attempts=max(2, len(self._replicas)))
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._max_inflight = max_inflight
+        self._gen_ttl_s = float(gen_ttl_s)
+        self._gen_capacity = int(gen_capacity)
+        self._read_timeout_s = float(read_timeout_s)
+        self._stream_wait_s = float(stream_wait_s)
+        self._verbose = verbose
+        self._lock = threading.Lock()
+        # generation_id -> (generation, expires_monotonic): the sticky
+        # map + replay buffer registry, TTL'd and capacity-bounded like
+        # the replicas' own replay buffers  # guarded-by: _lock
+        self._gens = OrderedDict()
+        self._inflight = 0   # guarded-by: _lock
+        self._shed = 0       # guarded-by: _lock
+        self._failovers = 0  # guarded-by: _lock
+        self._handoffs = 0   # guarded-by: _lock
+        self._resumed = 0    # guarded-by: _lock
+        self._stop = threading.Event()
+        self._httpd = _RouterServer((host, port), _RouterHandler)
+        self._httpd.router = self
+        self._thread = None
+        self._probers = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self):
+        return "{}:{}".format(self._httpd.server_address[0], self.port)
+
+    def start(self):
+        # one synchronous probe round before serving: routing decisions
+        # start from real replica state, not optimism
+        self._probe_round()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.05},
+            name="fleet-router-http", daemon=True,
+        )
+        self._thread.start()
+        # one persistent prober thread per replica: a black-holed peer
+        # costs its own probe_timeout_s without stalling anyone else's
+        # cadence, and no per-round thread churn
+        self._probers = [
+            threading.Thread(
+                target=self._probe_loop_one, args=(rep,),
+                name="fleet-router-prober", daemon=True)
+            for rep in self._replicas
+        ]
+        for t in self._probers:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        for t in self._probers:
+            t.join(timeout=5)
+        self._probers = []
+
+    # -- health probing ----------------------------------------------------
+
+    def _probe_round(self):
+        """One synchronous probe of every replica (the pre-serving round
+        :meth:`start` runs, so routing decisions begin from real state —
+        an already-draining replica never sees even the first request)."""
+        for rep in self._replicas:
+            snap = self._fetch_snapshot(rep)
+            if snap is None:
+                rep.mark_unreachable()
+            else:
+                rep.update_snapshot(snap)
+
+    def _probe_loop_one(self, rep):
+        while not self._stop.wait(self._probe_interval_s):
+            snap = self._fetch_snapshot(rep)
+            if self._stop.is_set():
+                return
+            if snap is None:
+                rep.mark_unreachable()
+            else:
+                rep.update_snapshot(snap)
+
+    def _fetch_snapshot(self, rep):
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self._probe_timeout_s)
+        try:
+            conn.request("GET", "/v2/health/stats")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read())
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    # -- routing -----------------------------------------------------------
+
+    def pick_replica(self, exclude=()):
+        """The least-loaded eligible replica (ties break on backend
+        order), or — when nothing is eligible — the least-failed
+        ineligible one as a last resort, so a fleet whose probes all
+        failed transiently still self-heals instead of hard-failing
+        every request.  ``exclude`` holds urls already tried."""
+        eligible, fallback = [], []
+        for idx, rep in enumerate(self._replicas):
+            if rep.url in exclude:
+                continue
+            ok, load = rep.routable()
+            (eligible if ok else fallback).append((load, idx, rep))
+        for pool in (eligible, fallback):
+            if pool:
+                return min(pool)[2]
+        return None
+
+    def replica_by_url(self, url):
+        for rep in self._replicas:
+            if rep.url == url:
+                return rep
+        return None
+
+    def any_routable(self):
+        return any(rep.routable()[0] for rep in self._replicas)
+
+    # -- router-level admission valve --------------------------------------
+
+    def enter_inflight(self):
+        with self._lock:
+            if (self._max_inflight is not None
+                    and self._inflight >= self._max_inflight):
+                self._shed += 1
+                shed = True
+            else:
+                self._inflight += 1
+                shed = False
+        if shed:
+            self._log("shed: in-flight cap {} reached".format(
+                self._max_inflight))
+        return not shed
+
+    def exit_inflight(self):
+        with self._lock:
+            self._inflight -= 1
+
+    # -- counters ----------------------------------------------------------
+
+    def _log(self, msg):
+        if self._verbose:
+            print("[fleet-router] " + msg, file=sys.stderr, flush=True)
+
+    def count_failover(self):
+        with self._lock:
+            self._failovers += 1
+        self._log("failover")
+
+    def count_handoff(self):
+        with self._lock:
+            self._handoffs += 1
+        self._log("handoff")
+
+    def count_resume(self):
+        with self._lock:
+            self._resumed += 1
+        self._log("resume")
+
+    # -- generation registry -----------------------------------------------
+
+    def _sweep_gens_locked(self, now):
+        expired = [gid for gid, (_, expires) in self._gens.items()
+                   if expires <= now]
+        for gid in expired:
+            self._gens.pop(gid, None)
+
+    def register_generation(self, gen, if_absent=False):
+        """Register ``gen`` in the id registry.  With ``if_absent`` the
+        insert is atomic with the existence check: a live or parked
+        record with the same id wins and the call returns False (a
+        fresh admission must never clobber an existing replay
+        buffer)."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_gens_locked(now)
+            if if_absent and gen.gen_id in self._gens:
+                return False
+            self._gens[gen.gen_id] = (gen, now + self._gen_ttl_s)
+            self._gens.move_to_end(gen.gen_id)
+            while len(self._gens) > self._gen_capacity:
+                self._gens.popitem(last=False)
+            return True
+
+    def lookup_generation(self, gen_id):
+        """The generation record for a resume, with its TTL refreshed
+        (a generation being actively resumed is live state)."""
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_gens_locked(now)
+            entry = self._gens.get(gen_id)
+            if entry is None:
+                return None
+            gen, _ = entry
+            self._gens[gen_id] = (gen, now + self._gen_ttl_s)
+            self._gens.move_to_end(gen_id)
+            return gen
+
+    def drop_generation(self, gen_id):
+        with self._lock:
+            self._gens.pop(gen_id, None)
+
+    def generation_snapshot(self, gen_id):
+        with self._lock:
+            entry = self._gens.get(gen_id)
+        return entry[0].snapshot() if entry is not None else None
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            out = {
+                "inflight": self._inflight,
+                "max_inflight": self._max_inflight,
+                "shed": self._shed,
+                "failovers": self._failovers,
+                "handoffs": self._handoffs,
+                "resumed_streams": self._resumed,
+                "generations": len(self._gens),
+            }
+        out["replicas"] = [rep.stats() for rep in self._replicas]
+        return out
+
+    def health_snapshot(self):
+        """The router's own replica-shaped ``/v2/health/stats`` answer,
+        so routers stack (a router can front other routers) and pools
+        can probe them."""
+        routable = self.any_routable()
+        snap = self.stats()
+        snap.update({
+            "state": "ready" if routable else "unavailable",
+            "ready": routable,
+            "router": True,
+            "models": {},
+        })
+        return snap
+
+    # -- unary forwarding --------------------------------------------------
+
+    @staticmethod
+    def _upstream_once(rep, method, path, body, headers, timeout_s):
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=timeout_s)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, dict(resp.headers), resp.read()
+        finally:
+            conn.close()
+
+    def forward_unary(self, method, path, body, headers, idempotent=False):
+        """One logical request with failover: connect-phase and typed-
+        overload failures fall through to the next replica under the
+        request's own deadline budget; a typed 4xx/5xx outside the
+        overload set relays untouched (it would be the same on every
+        replica).  A request that was *sent* and then lost its
+        connection mid-response may already have executed, so it fails
+        over only when the caller marks it ``idempotent`` (GETs) —
+        otherwise it surfaces as a typed 502 the client's retry policy
+        will not blindly re-execute.  Returns
+        ``(status, headers, body)``."""
+        deadline = _request_deadline(body, headers)
+        tried = set()
+        last_response = None
+        for _ in range(max(1, 2 * len(self._replicas))):
+            timeout_s = self._read_timeout_s
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return (504, {}, json.dumps({
+                        "error": "router: request deadline exhausted during "
+                                 "failover"}).encode("utf-8"))
+                # each attempt gets at most the budget that is left: a
+                # replica that accepted the connection and then wedged
+                # must not hold the request past its own deadline
+                timeout_s = min(timeout_s, remaining)
+            rep = self.pick_replica(exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.url)
+            error = kind = None
+            response = None
+            rep.begin_request()
+            try:
+                response = self._upstream_once(
+                    rep, method, path, body, headers, timeout_s)
+            except (ConnectionRefusedError, socket.gaierror) as e:
+                error, kind = e, FAILURE_CONNECT
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException) as e:
+                error, kind = e, FAILURE_INTERRUPTED
+            finally:
+                rep.end_request()
+            if error is None:
+                kind = self._policy.classify_http_status(response[0])
+                if not self._policy.should_failover(kind, idempotent):
+                    return response
+                # typed overload: the replica did no work — another may
+                rep.note_typed_failure()
+                last_response = response
+                self.count_failover()
+                continue
+            # transport failure: rotate the replica out until a probe
+            # sees it again; fail over when the classification allows
+            rep.mark_unreachable()
+            if not self._policy.should_failover(kind, idempotent):
+                if kind == FAILURE_INTERRUPTED:
+                    # the replica may have executed the request;
+                    # re-execution elsewhere is not safe and 429/503
+                    # would invite a blind client retry
+                    return (502, {}, json.dumps({
+                        "error": "router: replica {} dropped the "
+                                 "connection mid-request: {}".format(
+                                     rep.url, error)
+                    }).encode("utf-8"))
+                break
+            self.count_failover()
+        if last_response is not None:
+            return last_response  # the fleet-wide typed overload answer
+        return (503, {"Retry-After": "1"}, json.dumps({
+            "error": "router: no replica available for {} {}".format(
+                method, path)}).encode("utf-8"))
+
+    def forward_broadcast(self, method, path, body, headers):
+        """Apply a per-server mutation to EVERY replica; the first
+        failure is relayed after all were attempted (replicas must
+        agree on shm regions / repository state or the next routed
+        request lands on one missing the side effect)."""
+        first_bad = None
+        last_ok = None
+        for rep in self._replicas:
+            try:
+                response = self._upstream_once(
+                    rep, method, path, body, headers, self._read_timeout_s)
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException) as e:
+                rep.mark_unreachable()
+                if first_bad is None:
+                    first_bad = (503, {}, json.dumps({
+                        "error": "router: replica {} unreachable during "
+                                 "broadcast: {}".format(rep.url, e)
+                    }).encode("utf-8"))
+                continue
+            if response[0] >= 400:
+                if first_bad is None:
+                    first_bad = response
+            else:
+                last_ok = response
+        if first_bad is not None:
+            return first_bad
+        if last_ok is not None:
+            return last_ok
+        return (503, {}, json.dumps(
+            {"error": "router: no replica reachable"}).encode("utf-8"))
+
+
+class _RouterServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _ClientGone(Exception):
+    """The downstream client hung up mid-relay (its resume state stays
+    parked in the generation registry)."""
+
+
+class _RouterHandler(socketserver.StreamRequestHandler):
+    """The router's HTTP surface: same wire shape as the replica
+    frontend (tpuserver.http_frontend), but every model-facing route
+    forwards to the fleet instead of executing locally."""
+
+    disable_nagle_algorithm = True
+
+    @property
+    def router(self):
+        return self.server.router
+
+    # -- request loop (same framing rules as the replica frontend) ---------
+
+    def handle(self):
+        rfile = self.rfile
+        while True:
+            line = rfile.readline(65537)
+            if not line:
+                return
+            if line in (b"\r\n", b"\n"):
+                continue
+            try:
+                method, target, version = (
+                    line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+                )
+            except ValueError:
+                self._send(400, b'{"error": "malformed request line"}')
+                return
+            raw_headers = {}
+            while True:
+                h = rfile.readline(65537)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                colon = h.find(b":")
+                if colon > 0:
+                    raw_headers[
+                        h[:colon].decode("latin-1").strip().lower()
+                    ] = h[colon + 1:].decode("latin-1").strip()
+            self.headers = raw_headers
+            self.path = target
+            self._chunked_ok = version != "HTTP/1.0"
+            close = (
+                raw_headers.get("connection", "").lower() == "close"
+                or version == "HTTP/1.0"
+            )
+            self._body = None
+            self._started = False
+            try:
+                if method in ("POST", "GET"):
+                    if method == "POST":
+                        try:
+                            self._read_body()
+                        except (ValueError, OSError, EOFError) as e:
+                            self._send_error_json(
+                                "malformed request body: {}".format(e), 400)
+                            return
+                    self._dispatch(method)
+                else:
+                    self._send(405, b'{"error": "unsupported method"}')
+                    return
+            except (BrokenPipeError, ConnectionResetError, _ClientGone):
+                return
+            if close:
+                return
+
+    def _dispatch(self, method):
+        try:
+            self._route(method)
+        except (BrokenPipeError, ConnectionResetError, _ClientGone):
+            raise  # dead client socket: handle() ends the connection
+        except Exception as e:  # noqa: BLE001 — the router must answer
+            # typed even on internal faults; a raw traceback would tear
+            # the keep-alive connection instead
+            if self._started:
+                raise _ClientGone() from e
+            self._send_error_json("router error: {}".format(e), 500)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _read_body(self):
+        if self._body is None:
+            length = int(self.headers.get("content-length", 0))
+            body = self.rfile.read(length) if length else b""
+            encoding = self.headers.get("content-encoding")
+            if encoding == "gzip":
+                import gzip
+
+                body = gzip.decompress(body)
+            elif encoding == "deflate":
+                import zlib
+
+                body = zlib.decompress(body)
+            self._body = body
+        return self._body
+
+    def _send(self, code, body=b"", headers=None,
+              content_type="application/json"):
+        head = (
+            _STATUS_LINE.get(code, _STATUS_LINE[500])
+            + b"Server: tpu-triton-router\r\nContent-Type: "
+            + content_type.encode("latin-1")
+            + b"\r\nContent-Length: "
+            + str(len(body)).encode("latin-1")
+            + b"\r\n"
+        )
+        for key, val in (headers or {}).items():
+            head += (
+                key.encode("latin-1") + b": "
+                + str(val).encode("latin-1") + b"\r\n"
+            )
+        self.wfile.write(head + b"\r\n" + body)
+
+    def _send_json(self, obj, code=200, headers=None):
+        self._send(code, json.dumps(obj).encode("utf-8"), headers)
+
+    def _send_error_json(self, msg, code=400, headers=None):
+        self._send_json({"error": msg}, code, headers)
+
+    def _send_stream_start(self):
+        head = (
+            _STATUS_LINE[200]
+            + b"Server: tpu-triton-router\r\n"
+            + b"Content-Type: text/event-stream"
+        )
+        if self._chunked_ok:
+            head += b"\r\nTransfer-Encoding: chunked\r\n\r\n"
+        else:
+            head += b"\r\nConnection: close\r\n\r\n"
+        try:
+            self.wfile.write(head)
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            # a dead CLIENT socket must not read as an upstream replica
+            # death: raw ConnectionError here would be caught by
+            # _run_generation's upstream-transport handler and mark a
+            # healthy replica unreachable
+            raise _ClientGone() from e
+
+    def _ensure_started(self):
+        if not self._started:
+            self._send_stream_start()
+            self._started = True
+
+    def _emit(self, data):
+        """One SSE block to the client; a dead client raises
+        :class:`_ClientGone` so relay loops can close the upstream
+        (parking the generation for resume) instead of spinning."""
+        try:
+            if self._chunked_ok:
+                data = ("%x\r\n" % len(data)).encode("latin-1") + data + b"\r\n"
+            self.wfile.write(data)
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            raise _ClientGone() from e
+
+    def _end_chunks(self):
+        if self._chunked_ok:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise _ClientGone() from e
+
+    def _forward_headers(self):
+        fwd = {}
+        for key in _FORWARD_REQUEST_HEADERS:
+            val = self.headers.get(key)
+            if val is not None:
+                fwd[key] = val
+        return fwd
+
+    # -- routing -----------------------------------------------------------
+
+    def _route(self, method):
+        path = self.path.split("?", 1)[0]
+        router = self.router
+        if path == "/v2/health/live":
+            return self._send(200)
+        if path == "/v2/health/ready":
+            return self._send(200 if router.any_routable() else 503)
+        if path == "/v2/health/stats":
+            return self._send_json(router.health_snapshot())
+        if path == "/router/stats":
+            return self._send_json(router.stats())
+        if not (path == "/v2" or path.startswith("/v2/")):
+            return self._send_error_json("unknown endpoint: " + path, 404)
+        if not router.enter_inflight():
+            # the router-level shed valve: typed, with the backoff
+            # contract the clients' retry policies key on
+            return self._send_error_json(
+                "router is at its in-flight request cap; retry later",
+                429, {"Retry-After": 1})
+        try:
+            if (method == "POST"
+                    and _GENERATE_STREAM_URI.match(path) is not None):
+                return self._route_generate_stream(path)
+            body = self._read_body() if method == "POST" else None
+            fwd_headers = self._forward_headers()
+            if method == "POST" and _BROADCAST_URI.match(path) is not None:
+                response = router.forward_broadcast(
+                    method, path, body, fwd_headers)
+            else:
+                response = router.forward_unary(
+                    method, path, body, fwd_headers,
+                    idempotent=(method == "GET"))
+            status, resp_headers, resp_body = response
+            relay = _relay_headers(resp_headers)
+            content_type = {
+                k.lower(): v for k, v in resp_headers.items()
+            }.get("content-type", "application/json")
+            return self._send(status, resp_body, relay, content_type)
+        finally:
+            router.exit_inflight()
+
+    # -- streaming: sticky resume + cross-replica handoff ------------------
+
+    def _route_generate_stream(self, path):
+        router = self.router
+        try:
+            request_json = json.loads(self._read_body())
+        except ValueError as e:
+            return self._send_error_json(
+                "malformed generate request: {}".format(e), 400)
+        parameters = dict(request_json.get("parameters") or {})
+        resume_id = None
+        resume_from = 0
+        last_id = self.headers.get("last-event-id")
+        if last_id:
+            gid, sep, seq = last_id.rpartition("/")
+            if sep and gid:
+                try:
+                    resume_from = int(seq) + 1
+                    resume_id = gid
+                except ValueError:
+                    pass
+        if resume_id is None and parameters.get("resume_generation_id"):
+            resume_id = str(parameters["resume_generation_id"])
+            resume_from = _coerce_int(parameters.get("resume_from_seq"), 0)
+        if resume_id is not None:
+            gen = router.lookup_generation(resume_id)
+            handoff_marked = False
+            if gen is None:
+                # a "gen~offset" id names a handoff epoch (record_event
+                # marks post-handoff events): strip it for the registry
+                # lookup — the record lives under the bare id
+                base, tilde, off = resume_id.rpartition("~")
+                if tilde and base and off.isdigit():
+                    handoff_marked = True
+                    gen = router.lookup_generation(base)
+            if gen is None:
+                if handoff_marked:
+                    # the generation was handed off across replicas and
+                    # this router holds no offset map (restart / aged
+                    # out): router seqs are unreconstructable, and a
+                    # guessed replay point could silently gap or
+                    # duplicate tokens — fail typed instead
+                    return self._send_error_json(
+                        "generation '{}' was handed off across replicas "
+                        "and its resume state is gone with the "
+                        "router".format(resume_id), 404)
+                # router restarted or the entry aged out: a replica may
+                # still hold the replay state — seq continuity, not
+                # endpoint identity, is the contract
+                return self._resume_passthrough(path, resume_id, resume_from)
+            router.count_resume()
+            return self._serve_resume(gen, resume_from)
+        gen_id = str(parameters.get("generation_id") or uuid.uuid4().hex)
+        gen = _Generation(gen_id, path, request_json)
+        if not router.register_generation(gen, if_absent=True):
+            # the id names a live or parked generation: a fresh
+            # admission must never clobber a replay buffer the client
+            # could still resume from — with ONE exception.  A
+            # predecessor that never relayed an event has no resumable
+            # state, and the plain client's own reconnect after a
+            # drop-before-first-token blind-re-POSTs the identical
+            # admission (it has no Last-Event-ID to resume with): that
+            # predecessor is superseded, exactly as the replica
+            # scheduler supersedes a reused id's parked record.  The
+            # insert is atomic with the existence check: two concurrent
+            # admissions with the same explicit id cannot both pass.
+            superseded = False
+            prior = router.lookup_generation(gen_id)
+            if (prior is not None and prior.emitted() == 0
+                    and prior.acquire(wait_s=router._stream_wait_s)):
+                try:
+                    if prior.emitted() == 0:
+                        router.drop_generation(gen_id)
+                        superseded = router.register_generation(
+                            gen, if_absent=True)
+                finally:
+                    prior.release()
+            if not superseded:
+                return self._send_error_json(
+                    "generation id '{}' is already in use".format(gen_id),
+                    400)
+        gen.acquire(wait_s=0.0)  # fresh record: never contended
+        try:
+            return self._run_generation(gen, resuming=False)
+        finally:
+            gen.release()
+
+    def _serve_resume(self, gen, from_seq):
+        """Sticky resume: replay the client's gap from the router's own
+        buffer, then splice the live continuation from the home replica
+        (or hand off when the home is gone)."""
+        router = self.router
+        if not gen.acquire(wait_s=router._stream_wait_s):
+            return self._send_error_json(
+                "generation '{}' is busy on another connection".format(
+                    gen.gen_id), 503, {"Retry-After": 1})
+        try:
+            blocks, completed, next_seq = gen.replay_from(from_seq)
+            if from_seq > next_seq:
+                return self._send_error_json(
+                    "resume point {} is beyond generation '{}' ({} events "
+                    "relayed)".format(from_seq, gen.gen_id, next_seq), 404)
+            self._ensure_started()
+            for block in blocks:
+                self._emit(block)
+            if completed:
+                self._emit(b'data: {"final": true}\n\n')
+                self._end_chunks()
+                return
+            return self._run_generation(gen, resuming=True)
+        finally:
+            gen.release()
+
+    def _run_generation(self, gen, resuming):
+        """Drive one generation to its terminal event, failing over
+        (before the first token) or handing off (after it) when the
+        serving replica dies.  Caller holds the generation's busy slot
+        and has already replayed any client-acked prefix."""
+        router = self.router
+        snapshot = gen.snapshot()
+        if resuming and snapshot["home"] is not None:
+            rep = router.replica_by_url(snapshot["home"])
+            body, headers = gen.upstream_request(resuming=True)
+        else:
+            rep = router.pick_replica()
+            body, headers = gen.upstream_request(resuming=False)
+            if rep is not None:
+                gen.set_home(rep.url)
+        attempts = 0
+        max_attempts = 2 * len(router._replicas) + 2
+        while True:
+            attempts += 1
+            if rep is None or attempts > max_attempts:
+                return self._stream_fail(
+                    gen, "no replica available for generation '{}'".format(
+                        gen.gen_id))
+            outcome = None
+            status_error = None
+            conn = None
+            rep.begin_request()
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=router._read_timeout_s)
+                conn.request("POST", gen.path, body=body, headers=headers)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    status_error = (
+                        resp.status, dict(resp.headers), resp.read())
+                else:
+                    outcome = self._relay_events(gen, resp)
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException):
+                outcome = "died"
+            finally:
+                rep.end_request()
+                if conn is not None:
+                    conn.close()
+            if outcome == "final":
+                gen.complete()
+                self._ensure_started()
+                self._emit(b'data: {"final": true}\n\n')
+                self._end_chunks()
+                return
+            if outcome == "error":
+                # a typed in-band failure already relayed: terminal —
+                # the generation is dead fleet-wide, drop its state
+                router.drop_generation(gen.gen_id)
+                self._end_chunks()
+                return
+            if status_error is not None:
+                status, resp_headers, resp_body = status_error
+                kind = router._policy.classify_http_status(status)
+                failover_ok = (
+                    router._policy.should_failover(kind, idempotent=True)
+                    or (resuming and status == 404)
+                )
+                if not failover_ok:
+                    # a typed non-overload answer every replica would
+                    # repeat: relay it
+                    if self._started:
+                        try:
+                            msg = json.loads(resp_body).get(
+                                "error", "upstream failure")
+                        except (ValueError, AttributeError):
+                            msg = "upstream failure (status {})".format(
+                                status)
+                        self._emit(b"data: " + json.dumps(
+                            {"error": msg}).encode("utf-8") + b"\n\n")
+                        self._end_chunks()
+                        return
+                    return self._send(
+                        status, resp_body, _relay_headers(resp_headers))
+                rep.note_typed_failure()
+            else:
+                # transport death mid-request: rotate the replica out
+                rep.mark_unreachable()
+            if gen.emitted() == 0 and not self._started and not resuming:
+                # nothing delivered anywhere yet: a plain failover —
+                # re-sending the same admission cannot duplicate tokens.
+                # ``_started`` matters independently of the buffer: an
+                # unresumable upstream (no seqs) relays events WITHOUT
+                # recording them, and re-sending after any of those
+                # reached the client would duplicate its tokens
+                router.count_failover()
+                rep = router.pick_replica(exclude={rep.url})
+                if rep is not None:
+                    gen.set_home(rep.url)
+                body, headers = gen.upstream_request(resuming=False)
+                continue
+            # tokens are out: only a token-identical re-admission keeps
+            # the stream gap- and duplicate-free
+            handoff_body = gen.handoff_request()
+            if handoff_body is None:
+                return self._stream_fail(
+                    gen,
+                    "replica {} lost mid-generation and generation '{}' "
+                    "is not handoff-capable".format(rep.url, gen.gen_id))
+            if handoff_body == b"":
+                # every token was already relayed; only the terminal
+                # marker was lost with the replica
+                gen.complete()
+                self._ensure_started()
+                self._emit(b'data: {"final": true}\n\n')
+                self._end_chunks()
+                return
+            new_rep = (router.pick_replica(exclude={rep.url})
+                       or router.pick_replica())
+            if new_rep is None:
+                return self._stream_fail(
+                    gen, "no replica available to hand off generation "
+                         "'{}'".format(gen.gen_id))
+            router.count_handoff()
+            gen.set_home(new_rep.url, rebase=True)
+            rep = new_rep
+            body = handoff_body
+            headers = {"Content-Type": "application/json"}
+            resuming = False
+
+    def _relay_events(self, gen, resp):
+        """Relay one upstream SSE response: record + rewrite each event
+        into router numbering and emit it.  Returns ``"final"``,
+        ``"error"`` (typed in-band failure, already relayed), or
+        ``"died"`` (EOF without a terminal event — the handoff
+        trigger).  Upstream socket failures propagate to the caller's
+        transport handler; a dead client raises :class:`_ClientGone`."""
+        for raw in resp:
+            line = raw.rstrip(b"\r\n")
+            if not line.startswith(b"data: "):
+                continue  # id lines are rebuilt from the payload's seq
+            payload = json.loads(line[len(b"data: "):])
+            if payload.get("final"):
+                return "final"
+            if "error" in payload:
+                self._ensure_started()
+                self._emit(b"data: " + json.dumps(payload).encode("utf-8")
+                           + b"\n\n")
+                return "error"
+            backend_seq = (payload.get("parameters") or {}).get("seq")
+            if backend_seq is None:
+                # a non-resumable upstream (no scheduler ids): pure
+                # passthrough, no replay buffer, no handoff
+                gen.mark_unresumable()
+                self._ensure_started()
+                self._emit(b"data: " + json.dumps(payload).encode("utf-8")
+                           + b"\n\n")
+                continue
+            seq, block = gen.record_event(backend_seq, payload)
+            if seq is None:
+                continue  # upstream replayed an event the client acked
+            self._ensure_started()
+            self._emit(block)
+        return "died"
+
+    def _resume_passthrough(self, path, resume_id, resume_from):
+        """Resume of a generation the router does not hold: one of the
+        replicas may still own the replay state (router restart), so
+        try each in turn — a 404 from one replica is not the fleet's
+        answer.  Relayed raw: without buffered history the router can
+        neither rewrite seqs nor hand off."""
+        router = self.router
+        body = self._read_body()
+        headers = self._forward_headers()
+        headers["Last-Event-ID"] = "{}/{}".format(resume_id, resume_from - 1)
+        tried = set()
+        last_status = None
+        for _ in range(len(router._replicas)):
+            rep = router.pick_replica(exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.url)
+            conn = None
+            rep.begin_request()
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=router._read_timeout_s)
+                conn.request("POST", path, body=body, headers=headers)
+                resp = conn.getresponse()
+                if resp.status == 404:
+                    last_status = (resp.status, dict(resp.headers),
+                                   resp.read())
+                    continue  # another replica may hold the state
+                if resp.status != 200:
+                    return self._send(
+                        resp.status, resp.read(),
+                        _relay_headers(dict(resp.headers)))
+                router.count_resume()
+                for raw in resp:
+                    line = raw.rstrip(b"\r\n")
+                    if line.startswith(b"id: ") or line.startswith(
+                            b"data: "):
+                        self._ensure_started()
+                        self._emit(line + b"\n\n" if line.startswith(
+                            b"data: ") else line + b"\n")
+                # a clean upstream end carries its own final event; a
+                # mid-stream death simply ends the chunked body with no
+                # terminal event, and the resuming client retries
+                if self._started:
+                    self._end_chunks()
+                else:
+                    self._send_error_json(
+                        "generation '{}' produced no events on "
+                        "resume".format(resume_id), 502)
+                return
+            except (ConnectionError, socket.timeout, OSError,
+                    http.client.HTTPException):
+                rep.mark_unreachable()
+                if self._started:
+                    raise _ClientGone()  # mid-relay loss: client retries
+                continue
+            finally:
+                rep.end_request()
+                if conn is not None:
+                    conn.close()
+        if last_status is not None:
+            status, resp_headers, resp_body = last_status
+            return self._send(status, resp_body)
+        return self._send_error_json(
+            "unknown generation '{}' and no replica holds it".format(
+                resume_id), 404)
+
+    def _stream_fail(self, gen, message):
+        """Terminal router-side stream failure: typed 503 before the
+        stream started, in-band error event after."""
+        self.router.drop_generation(gen.gen_id)
+        if self._started:
+            self._emit(b"data: " + json.dumps(
+                {"error": message}).encode("utf-8") + b"\n\n")
+            self._end_chunks()
+            return
+        self._send_error_json(message, 503, {"Retry-After": 1})

@@ -489,11 +489,16 @@ class DecodeScheduler:
         """Introspection for tests and ops: live stream / pending counts
         and lifecycle flags.  ``live_streams`` counting to zero after
         traffic is the no-leaked-slots invariant chaos tests assert;
-        ``restarts`` rising is the flapping signal ops rotate on."""
+        ``restarts`` rising is the flapping signal ops rotate on.  The
+        capacity bounds ``max_slots`` / ``max_pending`` ride along so a
+        consumer (the fleet router's prober) can turn the counts into a
+        utilization signal without extra configuration plumbing."""
         with self._cond:
             return {
                 "live_streams": len(self._streams),
                 "pending": len(self._pending),
+                "max_slots": self._max_slots,
+                "max_pending": self._max_pending,
                 "draining": self._draining,
                 "closed": self._closed,
                 "healthy": self.healthy,

@@ -777,7 +777,8 @@ class InferenceServerClient:
                         headers=headers,
                     )
                     send_params = dict(base_params)
-                    if gen_id is not None and last_seq >= 0:
+                    sent_resume = gen_id is not None and last_seq >= 0
+                    if sent_resume:
                         # mid-generation reconnect: ask the server to
                         # replay from the first seq we have not seen
                         send_params.pop("generation_id", None)
@@ -814,6 +815,17 @@ class InferenceServerClient:
                             "{}s".format(read_timeout))
                     if error is not None:
                         if getattr(error, "status", lambda: None)() is None:
+                            if (sent_resume and "unknown or expired "
+                                    "generation id" in str(error)):
+                                # OUR resume named a generation this
+                                # server does not (yet) hold — under a
+                                # fleet router that's a transition
+                                # (restart, handoff in progress), not a
+                                # verdict: seq continuity is the resume
+                                # contract, not endpoint identity, so
+                                # ride the reconnect path bounded by
+                                # max_reconnects
+                                raise _StreamDropped(error)
                             # in-band server error: terminal
                             raise error
                         raise _StreamDropped(error)

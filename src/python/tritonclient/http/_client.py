@@ -1033,12 +1033,35 @@ class InferenceServerClient:
                     dropped = e
                     resp = None
                 if resp is not None:
-                    if resp.status != 200:
+                    if (resp.status in (404, 429, 503)
+                            and last_event_id is not None):
+                        # a RESUME answered 404 (server does not — yet —
+                        # know this generation) or a typed overload
+                        # (429/503: a router's shed valve or busy
+                        # serving slot) — under a fleet router these are
+                        # transitions, not verdicts (router restart,
+                        # handoff in progress, momentary saturation):
+                        # the replay state still exists, so ride the
+                        # reconnect path and let the retries bound it.
+                        # The same statuses on the FIRST request (no
+                        # last_event_id) still raise typed below.
+                        reason = (
+                            "resume target does not know generation"
+                            if resp.status == 404
+                            else "resume target is overloaded")
+                        dropped = InferenceServerException(
+                            "{}: {}".format(
+                                reason, _get_error_message(resp.read())),
+                            status=str(resp.status),
+                        )
+                        resp = None
+                    elif resp.status != 200:
                         raise InferenceServerException(
                             "generate_stream failed: {}".format(
                                 _get_error_message(resp.read())),
                             status=str(resp.status),
                         )
+                if resp is not None:
                     event_id = None
                     try:
                         for line in resp:
@@ -1097,6 +1120,11 @@ class InferenceServerClient:
                     if yielded_any and last_event_id is None
                     else ""
                 )
+                if isinstance(dropped, InferenceServerException):
+                    # retries exhausted on a typed answer (e.g. the
+                    # resume 404 every reattempt repeated): surface it
+                    # with its status intact
+                    raise dropped
                 raise InferenceServerException(
                     "generate_stream connection lost{}: {}".format(
                         reason, dropped))

@@ -37,7 +37,8 @@ def _thread_leak_guard(request):
     import time as _time
 
     if not (request.node.get_closest_marker("chaos")
-            or request.node.get_closest_marker("pool")):
+            or request.node.get_closest_marker("pool")
+            or request.node.get_closest_marker("router")):
         yield
         return
     before = {t.ident for t in threading.enumerate()}

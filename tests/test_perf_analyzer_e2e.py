@@ -107,6 +107,81 @@ def test_cli_generation_mode_reports_token_metrics():
     assert row["errors"] == 0
 
 
+def test_cli_generation_through_router_reports_handoffs():
+    """Point the http generation backend at a fleet router over two
+    replicas while injected faults sever live replica streams
+    mid-generation: the run still exits 0 with ZERO errors (the router
+    absorbs every fault), and the report carries the router-level
+    resilience counters next to the client-side resumed_streams."""
+    import numpy as np
+
+    from tpuserver import faults
+    from tpuserver.core import InferenceServer
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models import llama
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+    from tpuserver.router import FleetRouter
+
+    cfg = llama.tiny(vocab=512)
+    scopes = ("pa-router-a", "pa-router-b")
+    cores = [
+        InferenceServer(
+            [LlamaGenerateModel(cfg=cfg, max_seq=64, max_slots=4,
+                                restart_backoff_s=0.01)],
+            fault_scope=scope)
+        for scope in scopes
+    ]
+    frontends = [HttpFrontend(c, port=0).start() for c in cores]
+    router = FleetRouter(
+        ["127.0.0.1:{}".format(f.port) for f in frontends],
+        probe_interval_s=0.1).start()
+    # warm both replicas outside the CLI's measurement (compiles)
+    from tpuserver.core import InferRequest
+
+    for core in cores:
+        req = InferRequest("llama_generate", inputs={
+            "PROMPT_IDS": np.array([3, 1, 4, 1], np.int32),
+            "MAX_TOKENS": np.array([4], np.int32)})
+        for _ in core.infer_stream(req):
+            pass
+    try:
+        # sever a few live upstream streams mid-run on each replica:
+        # every sever is a replica-connection death the router must
+        # absorb via handoff (tokens out) or failover (before any)
+        for scope in scopes:
+            faults.install("http.generate_stream", mode="raise",
+                           times=3, skip=8, scope=scope)
+        result, rows = _run_cli([
+            "-m", "llama_generate", "--backend", "http",
+            "-u", router.url, "--generation",
+            "--concurrency-range", "2", "--max-tokens", "8",
+            "--measurement-interval", "400", "--max-trials", "5",
+            "--warmup", "0.1",
+        ])
+        absorbed = router.stats()
+    finally:
+        faults.clear("http.generate_stream")
+        router.stop()
+        for f in frontends:
+            f.stop()
+        for c in cores:
+            c.close()
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["value"] > 0 and row["tokens"] > 0
+    # the router absorbed every injected fault: nothing user-visible
+    assert row["errors"] == 0
+    # the injected severs landed and the router had to act (cumulative
+    # over the whole run — warmup severs count here, not in the row)
+    assert absorbed["handoffs"] + absorbed["failovers"] > 0, absorbed
+    # ... and the per-level router counters surfaced in the report
+    for key in ("router_failovers", "router_handoffs",
+                "router_resumed_streams", "router_shed"):
+        assert key in row and row[key] >= 0, row
+    assert "router failovers=" in result.stdout  # the table footer
+
+
 class _Reader:
     """Drains a pipe on a thread; flags when the settings banner (the
     'measurement is underway' cue) has been printed."""

@@ -1,0 +1,698 @@
+"""Fleet-router tests (ISSUE 7 acceptance).
+
+The router makes N replicas look like one resilient KServe server for
+PLAIN clients — no EndpointPool.  The bar:
+
+(a) kill the home replica mid-generation and the stream completes
+    THROUGH the router with token-identical, gap-free, duplicate-free
+    output, without the client ever reconnecting (cross-replica
+    handoff: greedy re-prefill of prompt + emitted history);
+(b) a client that reconnects with Last-Event-ID routes home to the
+    replica that owns the replay state (sticky resume);
+(c) a draining replica rotates out BEFORE a request lands on it, and
+    rotates back in after mark_ready;
+(d) the router-level in-flight cap sheds with a typed 429 +
+    Retry-After instead of queueing, and connect-phase failures fail
+    over with zero user-visible errors;
+(e) every replica exposes the cheap /v2/health/stats routing snapshot
+    the prober polls (no per-model inference-statistics calls).
+
+``tools/chaos_smoke.py --router`` soaks (a)-(d) against real replica
+processes under SIGTERM/revive.
+"""
+
+import http.client as http_client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpuserver import faults
+from tpuserver.core import InferenceServer
+from tpuserver.http_frontend import HttpFrontend
+from tpuserver.models import default_models, llama
+from tpuserver.models.llama_serving import LlamaGenerateModel
+from tpuserver.router import FleetRouter
+
+pytestmark = pytest.mark.router
+
+CFG = llama.tiny(vocab=512)
+MAX_SEQ = 64
+PROMPT = [3, 1, 4, 1, 5]
+N_TOK = 8
+
+STREAM_PATH = "/v2/models/llama_generate/generate_stream"
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_replica(scope=None, with_llama=True):
+    models = default_models()
+    if with_llama:
+        models.append(LlamaGenerateModel(
+            cfg=CFG, max_seq=MAX_SEQ, max_slots=2,
+            restart_backoff_s=0.01))
+    core = InferenceServer(models, fault_scope=scope)
+    frontend = HttpFrontend(core, port=0).start()
+    return core, frontend
+
+
+def _make_unresumable_replica(scope):
+    """max_slots=1 = the pre-scheduler single-stream path: no stream
+    ids on the wire, so routed streams are passthrough-only."""
+    models = default_models()
+    models.append(LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=1))
+    core = InferenceServer(models, fault_scope=scope)
+    frontend = HttpFrontend(core, port=0).start()
+    return core, frontend
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Two llama replicas behind one router (probes at 10 Hz so drain
+    rotation is visible within a test timeout)."""
+    core_a, fe_a = _make_replica("router-a")
+    core_b, fe_b = _make_replica("router-b")
+    backends = ["127.0.0.1:{}".format(fe_a.port),
+                "127.0.0.1:{}".format(fe_b.port)]
+    router = FleetRouter(backends, probe_interval_s=0.1,
+                         gen_ttl_s=30.0).start()
+    yield {
+        "router": router,
+        "backends": backends,
+        "cores": (core_a, core_b),
+        "frontends": (fe_a, fe_b),
+        "scopes": ("router-a", "router-b"),
+    }
+    router.stop()
+    fe_a.stop()
+    fe_b.stop()
+    core_a.close()
+    core_b.close()
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(fleet):
+    """Greedy decode is deterministic and both replicas share weights:
+    one replica's direct answer is the fleet-wide truth every routed /
+    handed-off stream must reproduce byte-for-byte."""
+    import tritonclient.http as httpclient
+
+    client = httpclient.InferenceServerClient(fleet["backends"][0])
+    try:
+        return _stream_tokens(client)
+    finally:
+        client.close()
+
+
+def _stream_tokens(client, parameters=None, on_reconnect=None):
+    tokens = []
+    for event in client.generate_stream(
+            "llama_generate",
+            {"PROMPT_IDS": np.array(PROMPT, np.int32),
+             "MAX_TOKENS": np.array([N_TOK], np.int32)},
+            parameters=parameters, on_reconnect=on_reconnect):
+        for out in event.get("outputs", []):
+            if out["name"] == "TOKEN":
+                tokens.append(int(out["data"][0]))
+    return tokens
+
+
+def _stream_body(gen_id=None):
+    body = {
+        "inputs": [
+            {"name": "PROMPT_IDS", "shape": [len(PROMPT)],
+             "datatype": "INT32", "data": PROMPT},
+            {"name": "MAX_TOKENS", "shape": [1], "datatype": "INT32",
+             "data": [N_TOK]},
+        ],
+    }
+    if gen_id is not None:
+        body["parameters"] = {"generation_id": gen_id}
+    return json.dumps(body)
+
+
+def _open_stream(url, body, last_event_id=None):
+    host, _, port = url.rpartition(":")
+    conn = http_client.HTTPConnection(host, int(port), timeout=30)
+    headers = {"Content-Type": "application/json"}
+    if last_event_id is not None:
+        headers["Last-Event-ID"] = last_event_id
+    conn.request("POST", STREAM_PATH, body=body, headers=headers)
+    return conn, conn.getresponse()
+
+
+def _read_events(resp, limit=None):
+    """``(payloads, finished)``: data events until the in-band final
+    marker (or ``limit`` events)."""
+    events = []
+    for raw in resp:
+        line = raw.strip()
+        if not line.startswith(b"data: "):
+            continue
+        payload = json.loads(line[len(b"data: "):])
+        if payload.get("final"):
+            return events, True
+        assert "error" not in payload, payload
+        events.append(payload)
+        if limit is not None and len(events) >= limit:
+            return events, False
+    return events, False
+
+
+def _tokens_of(events):
+    return [int(out["data"][0]) for ev in events
+            for out in ev.get("outputs", [])
+            if out["name"] == "TOKEN"]
+
+
+def _get_json(url, path):
+    host, _, port = url.rpartition(":")
+    conn = http_client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def _wait_until(predicate, timeout_s=5.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+# -- health/load snapshot (satellite: routing signal) -------------------------
+
+
+def test_replica_health_stats_snapshot_shape_and_bounds(fleet,
+                                                        reference_tokens):
+    """/v2/health/stats is the cheap machine-readable routing signal:
+    lifecycle + in-flight bounds + each model's scheduler counters with
+    their capacity bounds — and NOT the per-model inference-statistics
+    verb (the prober polls this at sub-second cadence fleet-wide)."""
+    status, snap = _get_json(fleet["backends"][0], "/v2/health/stats")
+    assert status == 200
+    assert snap["state"] == "ready" and snap["ready"] is True
+    assert snap["inflight"] >= 0
+    if snap["max_inflight"] is not None:  # None = uncapped server
+        assert snap["inflight"] <= snap["max_inflight"]
+    assert "llama_generate" in snap["models"]
+    sched = snap["models"]["llama_generate"]
+    # reference_tokens ran a generation on replica A: its scheduler
+    # stats must be live, with count <= bound (the utilization signal)
+    assert sched is not None
+    assert 0 <= sched["live_streams"] <= sched["max_slots"]
+    assert 0 <= sched["pending"] <= sched["max_pending"]
+    for key in ("tripped", "restarts", "replay_entries", "draining",
+                "healthy"):
+        assert key in sched
+    # schedulerless models report None, not a stats blob — the snapshot
+    # stays O(models), never O(inference history)
+    assert snap["models"]["simple"] is None
+    # cheap enough to poll: 50 snapshots well under a second apiece
+    t0 = time.monotonic()
+    for _ in range(50):
+        _get_json(fleet["backends"][0], "/v2/health/stats")
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_router_surface_matches_replica(fleet):
+    """The router speaks the replica's own surface (live/ready/stats)
+    plus /router/stats, so routers stack and pools can probe them."""
+    router_url = fleet["router"].url
+    status, snap = _get_json(router_url, "/v2/health/stats")
+    assert status == 200
+    assert snap["ready"] is True and snap["router"] is True
+    status, stats = _get_json(router_url, "/router/stats")
+    assert status == 200
+    assert {r["url"] for r in stats["replicas"]} == set(fleet["backends"])
+    for rep in stats["replicas"]:
+        assert rep["eligible"] is True
+    assert stats["shed"] >= 0 and stats["inflight"] >= 0
+    host, _, port = router_url.rpartition(":")
+    conn = http_client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        conn.request("GET", "/v2/health/ready")
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+
+
+# -- routing ------------------------------------------------------------------
+
+
+def test_unary_routes_through_router(fleet):
+    import tritonclient.http as httpclient
+
+    client = httpclient.InferenceServerClient(fleet["router"].url)
+    try:
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+        in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+        in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+        in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+        result = client.infer("simple", [in0, in1])
+        np.testing.assert_array_equal(
+            result.as_numpy("OUTPUT0"),
+            np.arange(16, dtype=np.int32) + 1)
+    finally:
+        client.close()
+
+
+def test_least_loaded_spreads_concurrent_requests(fleet):
+    """With one replica occupied, the next request routes to the other:
+    the probe load score plus the router's own in-flight accounting."""
+    import tritonclient.http as httpclient
+
+    router = fleet["router"]
+    before = {r["url"]: r["requests"] for r in router.stats()["replicas"]}
+    client = httpclient.InferenceServerClient(router.url)
+    slow_done = threading.Event()
+
+    def slow():
+        c = httpclient.InferenceServerClient(router.url)
+        try:
+            in0 = httpclient.InferInput("INPUT0", [4], "INT32")
+            in0.set_data_from_numpy(np.arange(4, dtype=np.int32))
+            d = httpclient.InferInput("DELAY_US", [1], "UINT32")
+            d.set_data_from_numpy(np.array([400000], dtype=np.uint32))
+            c.infer("delayed_identity", [in0, d])
+        finally:
+            c.close()
+            slow_done.set()
+
+    t = threading.Thread(target=slow, daemon=True)
+    t.start()
+    try:
+        # wait until the slow request is counted against some replica
+        assert _wait_until(lambda: any(
+            r["load"] > 0 for r in router.stats()["replicas"]))
+        busy = next(r["url"] for r in router.stats()["replicas"]
+                    if r["load"] > 0)
+        in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+        in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+        in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+        in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+        client.infer("simple", [in0, in1])
+        after = {r["url"]: r["requests"]
+                 for r in router.stats()["replicas"]}
+        other = next(u for u in after if u != busy)
+        assert after[other] == before[other] + 1
+    finally:
+        t.join(timeout=10)
+        client.close()
+    assert slow_done.is_set()
+
+
+def test_drain_rotates_replica_out_before_requests_land(fleet):
+    """begin_drain flips the replica's own readiness; the prober folds
+    it into eligibility so requests stop landing there BEFORE one
+    fails — and mark_ready rotates it back in (ops undrain)."""
+    import tritonclient.http as httpclient
+
+    router = fleet["router"]
+    core_a = fleet["cores"][0]
+    url_a, url_b = fleet["backends"]
+    core_a.begin_drain()
+    try:
+        assert _wait_until(lambda: not next(
+            r["eligible"] for r in router.stats()["replicas"]
+            if r["url"] == url_a))
+        before_a = next(r["requests"] for r in router.stats()["replicas"]
+                        if r["url"] == url_a)
+        client = httpclient.InferenceServerClient(router.url)
+        try:
+            in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+            in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+            in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+            in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+            for _ in range(6):
+                client.infer("simple", [in0, in1])  # zero errors
+        finally:
+            client.close()
+        after_a = next(r["requests"] for r in router.stats()["replicas"]
+                       if r["url"] == url_a)
+        assert after_a == before_a  # drained replica saw none of them
+    finally:
+        core_a.mark_ready()
+    assert _wait_until(lambda: next(
+        r["eligible"] for r in router.stats()["replicas"]
+        if r["url"] == url_a))
+
+
+# -- streaming: handoff + sticky resume --------------------------------------
+
+
+def test_home_replica_death_mid_generation_hands_off(fleet,
+                                                     reference_tokens):
+    """THE acceptance case: the serving replica's connection dies
+    mid-generation (times=1 on each scope: whichever replica is home
+    drops the stream after 3 events); the router re-admits
+    prompt + emitted history on the other replica and the client sees
+    one continuous, token-identical, gap-free, duplicate-free stream —
+    it never reconnects, never learns a handoff happened."""
+    import tritonclient.http as httpclient
+
+    router = fleet["router"]
+    for scope in fleet["scopes"]:
+        faults.install("http.generate_stream", mode="raise", times=1,
+                       skip=3, scope=scope)
+    handoffs_before = router.stats()["handoffs"]
+    reconnects = []
+    client = httpclient.InferenceServerClient(router.url)
+    try:
+        tokens = _stream_tokens(
+            client, parameters={"generation_id": "t-handoff"},
+            on_reconnect=lambda a, e: reconnects.append(a))
+    finally:
+        client.close()
+    assert tokens == reference_tokens
+    assert reconnects == []  # the handoff is invisible to the client
+    assert router.stats()["handoffs"] > handoffs_before
+
+
+def test_sticky_resume_routes_home_and_replays_gap(fleet,
+                                                   reference_tokens):
+    """A client that drops and reconnects with Last-Event-ID gets the
+    gap replayed from the router's buffer and the continuation spliced
+    from the generation's home replica — same id, continuous seqs."""
+    router = fleet["router"]
+    resumed_before = router.stats()["resumed_streams"]
+    body = _stream_body("t-sticky")
+    conn, resp = _open_stream(router.url, body)
+    try:
+        head, finished = _read_events(resp, limit=3)
+        assert not finished and len(head) == 3
+    finally:
+        conn.close()  # the client vanishes mid-stream
+    home = router.generation_snapshot("t-sticky")["home"]
+    assert home in fleet["backends"]
+    last_seq = head[-1]["parameters"]["seq"]
+    assert last_seq == 2
+    conn, resp = _open_stream(
+        router.url, body, last_event_id="t-sticky/{}".format(last_seq))
+    try:
+        tail, finished = _read_events(resp)
+        assert finished
+    finally:
+        conn.close()
+    assert _tokens_of(head) + _tokens_of(tail) == reference_tokens
+    seqs = [ev["parameters"]["seq"] for ev in head + tail]
+    assert seqs == list(range(N_TOK))
+    assert router.stats()["resumed_streams"] > resumed_before
+    # stickiness: the resume did not migrate a live home
+    assert router.generation_snapshot("t-sticky")["home"] == home
+
+
+def test_duplicate_generation_id_is_typed_400(fleet):
+    """A fresh submit reusing a known generation_id must NOT clobber
+    the existing record's replay buffer and home mapping — it gets a
+    typed 400 (resume, don't resubmit)."""
+    url = fleet["router"].url
+    conn, resp = _open_stream(url, _stream_body(gen_id="dup-id"))
+    try:
+        assert resp.status == 200
+        events, finished = _read_events(resp)
+        assert finished
+        first_tokens = _tokens_of(events)
+        assert len(first_tokens) == N_TOK
+    finally:
+        conn.close()
+    conn, resp = _open_stream(url, _stream_body(gen_id="dup-id"))
+    try:
+        assert resp.status == 400
+        assert "already in use" in json.loads(resp.read())["error"]
+    finally:
+        conn.close()
+    # the original record survived the rejected duplicate: its replay
+    # buffer still answers a sticky resume with the same tokens
+    conn, resp = _open_stream(url, _stream_body(),
+                              last_event_id="dup-id/-1")
+    try:
+        assert resp.status == 200
+        events, finished = _read_events(resp)
+        assert finished
+        assert _tokens_of(events) == first_tokens
+    finally:
+        conn.close()
+
+
+def test_resume_of_unknown_generation_is_typed_404(fleet):
+    """Neither the router nor any replica knows the id: the fleet-wide
+    answer is the replicas' own typed 404, not a router-invented
+    shape."""
+    conn, resp = _open_stream(fleet["router"].url, _stream_body(),
+                              last_event_id="never-issued/4")
+    try:
+        assert resp.status == 404
+        assert "generation" in json.loads(resp.read())["error"]
+    finally:
+        conn.close()
+
+
+# -- shedding + failover ------------------------------------------------------
+
+
+def test_router_inflight_cap_sheds_typed_429(fleet):
+    """Past max_inflight the router answers 429 + Retry-After without
+    forwarding — the shed is a router-level valve, not a replica
+    error."""
+    import tritonclient.http as httpclient
+    from tritonclient.utils import InferenceServerException
+
+    capped = FleetRouter(fleet["backends"], probe_interval_s=60.0,
+                         max_inflight=1).start()
+    try:
+        slow_started = threading.Event()
+        done = []
+
+        def slow():
+            c = httpclient.InferenceServerClient(capped.url)
+            try:
+                in0 = httpclient.InferInput("INPUT0", [4], "INT32")
+                in0.set_data_from_numpy(np.arange(4, dtype=np.int32))
+                d = httpclient.InferInput("DELAY_US", [1], "UINT32")
+                d.set_data_from_numpy(
+                    np.array([500000], dtype=np.uint32))
+                slow_started.set()
+                c.infer("delayed_identity", [in0, d])
+                done.append(True)
+            finally:
+                c.close()
+
+        t = threading.Thread(target=slow, daemon=True)
+        t.start()
+        assert slow_started.wait(5)
+        assert _wait_until(lambda: capped.stats()["inflight"] >= 1)
+        client = httpclient.InferenceServerClient(capped.url)
+        try:
+            in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+            in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+            in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+            in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+            with pytest.raises(InferenceServerException) as exc:
+                client.infer("simple", [in0, in1])
+            assert "429" in str(exc.value.status())
+            assert "in-flight request cap" in str(exc.value)
+            t.join(timeout=10)
+            assert done == [True]  # the in-flight request was untouched
+            # capacity freed: the next request goes through
+            client.infer("simple", [in0, in1])
+        finally:
+            client.close()
+        assert capped.stats()["shed"] >= 1
+    finally:
+        capped.stop()
+
+
+def test_connect_failure_fails_over_with_zero_user_errors(fleet):
+    """A replica that dies between probe rounds: requests routed to it
+    hit connection-refused and silently fail over to a live replica
+    under the FAILURE_CONNECT classification."""
+    import tritonclient.http as httpclient
+
+    core_a, fe_a = _make_replica(with_llama=False)
+    core_b, fe_b = _make_replica(with_llama=False)
+    router = FleetRouter(
+        ["127.0.0.1:{}".format(fe_a.port),
+         "127.0.0.1:{}".format(fe_b.port)],
+        probe_interval_s=60.0,  # the prober must NOT save us here
+    ).start()
+    try:
+        # replica A dies right after the initial probe marked it
+        # eligible: the router still believes in it
+        fe_a.stop()
+        core_a.close()
+        client = httpclient.InferenceServerClient(router.url)
+        try:
+            in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+            in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+            in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+            in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+            for _ in range(4):
+                result = client.infer("simple", [in0, in1])
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"),
+                    np.arange(16, dtype=np.int32) + 1)
+        finally:
+            client.close()
+        stats = router.stats()
+        assert stats["failovers"] >= 1
+        dead = next(r for r in stats["replicas"]
+                    if r["url"].endswith(str(fe_a.port)))
+        assert dead["eligible"] is False  # rotated out on first failure
+    finally:
+        router.stop()
+        fe_b.stop()
+        core_b.close()
+
+
+# -- review hardening: passthrough duplication, blind re-POST, markers --------
+
+
+def test_unresumable_stream_sever_fails_typed_without_duplicates():
+    """A max_slots=1 llama puts no stream ids on the wire, so the
+    router relays it passthrough (no replay buffer, no handoff).  When
+    its connection dies AFTER tokens reached the client, re-sending the
+    admission elsewhere would duplicate them: the router must fail the
+    stream in-band and typed instead."""
+    core_a, fe_a = _make_unresumable_replica("router-unres-a")
+    core_b, fe_b = _make_unresumable_replica("router-unres-b")
+    for scope in ("router-unres-a", "router-unres-b"):
+        faults.install("http.generate_stream", mode="raise", times=1,
+                       skip=2, scope=scope)
+    router = FleetRouter(
+        ["127.0.0.1:{}".format(fe_a.port),
+         "127.0.0.1:{}".format(fe_b.port)],
+        probe_interval_s=0.1).start()
+    try:
+        conn, resp = _open_stream(router.url, _stream_body())
+        try:
+            assert resp.status == 200
+            tokens, error = [], None
+            for raw in resp:
+                line = raw.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                payload = json.loads(line[len(b"data: "):])
+                if payload.get("final"):
+                    break
+                if "error" in payload:
+                    error = payload["error"]
+                    break
+                tokens.extend(int(out["data"][0])
+                              for out in payload.get("outputs", [])
+                              if out["name"] == "TOKEN")
+        finally:
+            conn.close()
+        # the sever landed after 2 relayed events: typed in-band
+        # failure, and the 2 delivered tokens were never re-sent
+        assert error is not None and "not handoff-capable" in error
+        assert len(tokens) == 2
+    finally:
+        router.stop()
+        fe_a.stop()
+        fe_b.stop()
+        core_a.close()
+        core_b.close()
+
+
+def test_reused_id_with_no_relayed_events_is_superseded(fleet,
+                                                       reference_tokens):
+    """The plain client's reconnect after a drop-before-first-token
+    blind-re-POSTs the same admission (it has no Last-Event-ID): a
+    registered predecessor that never relayed an event must be
+    superseded — like the scheduler supersedes a reused id's parked
+    record — not answered 400 until the TTL."""
+    from tpuserver.router import _Generation
+
+    router = fleet["router"]
+    prior = _Generation("t-blind-repost", STREAM_PATH,
+                        json.loads(_stream_body("t-blind-repost")))
+    assert router.register_generation(prior, if_absent=True)
+    conn, resp = _open_stream(router.url, _stream_body("t-blind-repost"))
+    try:
+        assert resp.status == 200
+        events, finished = _read_events(resp)
+        assert finished
+        assert _tokens_of(events) == reference_tokens
+    finally:
+        conn.close()
+
+
+def test_handoff_marks_id_lines_and_marked_resume_strips(fleet,
+                                                         reference_tokens):
+    """Post-handoff events mark their SSE id line with the handoff
+    epoch (``gen~offset/seq``) because router seqs no longer equal the
+    serving replica's numbering.  A live router strips the marker and
+    resumes from its own buffer; the payload seqs stay continuous."""
+    router = fleet["router"]
+    for scope in fleet["scopes"]:
+        faults.install("http.generate_stream", mode="raise", times=1,
+                       skip=3, scope=scope)
+    conn, resp = _open_stream(router.url, _stream_body("t-marked"))
+    ids = []
+    try:
+        assert resp.status == 200
+        events = []
+        for raw in resp:
+            line = raw.strip()
+            if line.startswith(b"id: "):
+                ids.append(line[4:].decode("utf-8"))
+                continue
+            if not line.startswith(b"data: "):
+                continue
+            payload = json.loads(line[len(b"data: "):])
+            if payload.get("final"):
+                break
+            assert "error" not in payload, payload
+            events.append(payload)
+    finally:
+        conn.close()
+    assert _tokens_of(events) == reference_tokens
+    assert [ev["parameters"]["seq"] for ev in events] == list(range(N_TOK))
+    marked = [i for i in ids if i.startswith("t-marked~")]
+    assert marked, ids  # the handoff epoch is visible on the wire
+    assert ids[0] == "t-marked/0"  # pre-handoff events stay bare
+    # a reconnect presenting the marked id resumes against the LIVE
+    # router: the marker strips to the registry id and the completed
+    # generation answers with its terminal event
+    conn, resp = _open_stream(router.url, _stream_body(),
+                              last_event_id=ids[-1])
+    try:
+        assert resp.status == 200
+        tail, finished = _read_events(resp)
+        assert finished and tail == []
+    finally:
+        conn.close()
+
+
+def test_marked_resume_on_fresh_router_fails_typed_404(fleet):
+    """A RESTARTED router (empty registry) cannot reconstruct the
+    seq offset a handoff introduced: a handoff-marked resume must fail
+    typed instead of forwarding a misaligned replay point that could
+    silently gap or duplicate tokens."""
+    fresh = FleetRouter(fleet["backends"], probe_interval_s=60.0).start()
+    try:
+        conn, resp = _open_stream(fresh.url, _stream_body(),
+                                  last_event_id="t-anything~3/5")
+        try:
+            assert resp.status == 404
+            assert "handed off" in json.loads(resp.read())["error"]
+        finally:
+            conn.close()
+    finally:
+        fresh.stop()

@@ -495,3 +495,112 @@ def test_pool_generate_stream_pins_one_endpoint(reference_tokens):
             f.stop()
         for c in cores:
             c.close()
+
+
+# -- fleet transitions (ISSUE 7 client gap) ----------------------------------
+
+
+def test_http_resume_404_is_a_fleet_transition_not_a_verdict(
+        heal_core, reference_tokens):
+    """A resume attempt that lands on a server which does not know the
+    generation id answers 404 — but behind a fleet router the backend
+    set can change under one address mid-generation (router restart,
+    handoff in progress), so the HTTP auto-resume helper retries the
+    resume instead of dying typed: seq continuity is the contract, not
+    endpoint identity.  A 404 on the FIRST request (no Last-Event-ID)
+    stays terminal — that is pinned by
+    test_clients_refuse_to_rerun_non_resumable_generations."""
+    import tritonclient.http as httpclient
+
+    from tpuserver.http_frontend import HttpFrontend
+
+    stranger = InferenceServer([
+        LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=2)
+    ])
+    frontend = HttpFrontend(heal_core, port=0).start()
+    client = httpclient.InferenceServerClient(
+        "127.0.0.1:{}".format(frontend.port))
+    try:
+        faults.install("http.generate_stream", mode="raise", times=1,
+                       skip=3)
+        attempts = []
+
+        def on_reconnect(attempt, exc):
+            attempts.append(str(exc))
+            # reconnect 1 lands on a backend that has never seen the
+            # generation (the fleet changed under the address) -> 404;
+            # reconnect 2 finds the owning backend again
+            frontend._httpd.core = (
+                stranger if attempt == 1 else heal_core)
+
+        tokens, seqs = [], []
+        for event in client.generate_stream(
+                "llama_generate",
+                {"PROMPT_IDS": PROMPTS[2],
+                 "MAX_TOKENS": np.array([BUDGETS[2]], np.int32)},
+                on_reconnect=on_reconnect):
+            for out in event.get("outputs", []):
+                if out["name"] == "TOKEN":
+                    tokens.append(out["data"][0])
+            seqs.append(event["parameters"]["seq"])
+        assert tokens == reference_tokens[2]
+        assert seqs == list(range(BUDGETS[2]))
+        assert len(attempts) == 2
+        # the second reattempt was triggered by the typed resume 404,
+        # not a transport fault — the new retryable classification
+        assert "does not know generation" in attempts[1]
+    finally:
+        faults.clear("http.generate_stream")
+        frontend._httpd.core = heal_core
+        client.close()
+        frontend.stop()
+        stranger.close()
+
+
+def test_grpc_resume_unknown_generation_retries_as_fleet_transition(
+        heal_core, reference_tokens):
+    """gRPC side of the same gap: the in-band unknown-generation answer
+    to OUR resume request rides the reconnect path (bounded by
+    max_reconnects) instead of raising terminally.  Other in-band
+    errors (quarantine, deadline) stay terminal."""
+    import tritonclient.grpc as grpcclient
+
+    from tpuserver.grpc_frontend import GrpcFrontend
+
+    stranger = InferenceServer([
+        LlamaGenerateModel(cfg=CFG, max_seq=MAX_SEQ, max_slots=2)
+    ])
+    frontend = GrpcFrontend(heal_core, port=0).start()
+    client = grpcclient.InferenceServerClient(
+        "127.0.0.1:{}".format(frontend.port))
+    try:
+        faults.install("grpc.stream_infer", mode="raise", times=1, skip=3)
+        attempts = []
+
+        def on_reconnect(attempt, exc):
+            attempts.append(str(exc))
+            frontend._bridge._core = (
+                stranger if attempt == 1 else heal_core)
+
+        p_in = grpcclient.InferInput(
+            "PROMPT_IDS", [len(PROMPTS[2])], "INT32")
+        p_in.set_data_from_numpy(PROMPTS[2])
+        m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+        m_in.set_data_from_numpy(np.array([BUDGETS[2]], dtype=np.int32))
+        tokens, seqs = [], []
+        for result in client.generate_stream(
+                "llama_generate", [p_in, m_in],
+                on_reconnect=on_reconnect):
+            tokens.append(int(result.as_numpy("TOKEN")[0]))
+            resp = result.get_response()
+            seqs.append(resp.parameters["seq"].int64_param)
+        assert tokens == reference_tokens[2]
+        assert seqs == list(range(BUDGETS[2]))
+        assert len(attempts) == 2
+        assert "unknown or expired generation id" in attempts[1]
+    finally:
+        faults.clear("grpc.stream_infer")
+        frontend._bridge._core = heal_core
+        client.close()
+        frontend.stop()
+        stranger.close()

@@ -20,6 +20,7 @@ Usage:
     python tools/chaos_smoke.py [--rounds N] [--slots K] [--budget T]
     python tools/chaos_smoke.py --pool [--cycles N] [--soak M]
     python tools/chaos_smoke.py --kill-loop [--rounds N]
+    python tools/chaos_smoke.py --router [--cycles N] [--soak M]
 
 ``--kill-loop`` soaks the supervised-restart layer: every round kills
 the decode loop mid-traffic (injected step failure = loop death) while
@@ -27,6 +28,17 @@ concurrent generations are in flight, and asserts the supervisor
 auto-restarted with ZERO lost or corrupted streams — every request
 completes with tokens identical to the fault-free reference, restart
 counters rise accordingly, and the scheduler never trips.
+
+``--router`` soaks the server-side fleet tier (ISSUE 7): PLAIN clients
+stream generations through a FleetRouter over two llama replicas while
+every cycle (a) SIGTERM-drains and revives one replica mid-traffic and
+(b) severs live upstream streams mid-generation (scoped fault = the
+serving replica's connection dying).  Invariants: ZERO user-visible
+errors, every stream's tokens identical to the fault-free reference
+with gap-free duplicate-free seqs (the router's cross-replica handoff
+and failover absorb every fault), the drained replica rotates out
+before requests land on it and rotates back in after revival, and no
+replica leaks streams.
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -339,6 +351,156 @@ def pool_phase(cycles, soak):
             f.stop()
 
 
+def router_phase(cycles, soak, budget):
+    """Fleet-router soak: plain clients stream through a FleetRouter
+    over two replicas while one replica SIGTERM-drains/revives and live
+    upstream streams are severed mid-generation every cycle."""
+    import signal
+
+    import tritonclient.http as httpclient
+
+    from tpuserver.core import install_sigterm_drain
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models.simple import SimpleModel
+    from tpuserver.router import FleetRouter
+
+    scopes = ("router-a", "router-b")
+    models = [
+        LlamaGenerateModel(
+            cfg=llama.tiny(vocab=512), max_seq=64, max_slots=4,
+            max_restarts=64, restart_window_s=3600.0,
+            restart_backoff_s=0.01)
+        for _ in scopes
+    ]
+    cores = [
+        InferenceServer([model, SimpleModel()], fault_scope=scope)
+        for model, scope in zip(models, scopes)
+    ]
+    frontends = [HttpFrontend(core, port=0).start() for core in cores]
+    urls = ["127.0.0.1:{}".format(f.port) for f in frontends]
+    router = FleetRouter(urls, probe_interval_s=0.05,
+                         probe_timeout_s=1.0).start()
+    previous = install_sigterm_drain(cores[1], drain_timeout=10.0)
+
+    print("warming up both replicas (compiles the scheduler fns)...")
+    reference = [generate(cores[0], p, budget) for p in PROMPTS]
+    twin = [generate(cores[1], p, budget) for p in PROMPTS]
+    if reference != twin:
+        fail("router: replicas disagree on greedy reference tokens — "
+             "cross-replica handoff cannot be token-identical")
+    print("reference captured; {} cycles of SIGTERM-drain + mid-stream "
+          "severs through the router".format(cycles))
+
+    resumes = [0]
+
+    def replica_stats(url):
+        return [r for r in router.stats()["replicas"]
+                if r["url"] == url][0]
+
+    def worker(wid, n, cycle):
+        client = httpclient.InferenceServerClient(router.url)
+        try:
+            for i in range(n):
+                which = (wid + i) % len(PROMPTS)
+                tokens = []
+                seqs = []
+                try:
+                    for event in client.generate_stream(
+                            "llama_generate",
+                            {"PROMPT_IDS": PROMPTS[which],
+                             "MAX_TOKENS": np.array([budget], np.int32)},
+                            on_reconnect=lambda a, e: resumes.__setitem__(
+                                0, resumes[0] + 1)):
+                        for out in event.get("outputs", []):
+                            if out["name"] == "TOKEN":
+                                tokens.append(int(out["data"][0]))
+                        params = event.get("parameters") or {}
+                        if "seq" in params:
+                            seqs.append(params["seq"])
+                except Exception as e:  # noqa: BLE001 — the invariant
+                    fail("router cycle {}: user-visible stream error "
+                         "({}: {})".format(cycle, type(e).__name__, e))
+                    continue
+                if tokens != reference[which]:
+                    fail("router cycle {}: stream tokens diverged: "
+                         "{} != {}".format(cycle, tokens, reference[which]))
+                if seqs != list(range(len(seqs))) or len(seqs) != budget:
+                    fail("router cycle {}: seq gap/duplicate: {}".format(
+                        cycle, seqs))
+        finally:
+            client.close()
+
+    try:
+        for cycle in range(cycles):
+            stats_before = router.stats()
+            # sever the serving connection of up to 2 live streams per
+            # replica this cycle: a mid-generation replica-connection
+            # death the router must absorb via handoff
+            for scope in scopes:
+                faults.install("http.generate_stream", mode="raise",
+                               times=2, skip=3, scope=scope)
+            threads = [
+                threading.Thread(target=worker, args=(w, soak, cycle))
+                for w in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # streams in flight through the router
+            # SIGTERM-drain replica b mid-traffic: in-flight work
+            # finishes, new work sheds typed 503 the router routes
+            # around, and the prober rotates b out
+            os.kill(os.getpid(), signal.SIGTERM)
+            for t in threads:
+                t.join(timeout=300)
+            faults.clear("http.generate_stream")
+
+            deadline = time.monotonic() + 15.0
+            while (cores[1].server_state() != "stopped"
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            if cores[1].server_state() != "stopped":
+                fail("router cycle {}: SIGTERM drain never completed "
+                     "(state={})".format(cycle, cores[1].server_state()))
+            if replica_stats(urls[1])["eligible"]:
+                # the prober had a whole drain to notice
+                fail("router cycle {}: drained replica still "
+                     "eligible".format(cycle))
+            # revive: re-attach flips stopped -> ready, the prober
+            # rotates b back in
+            cores[1].attach_frontend()
+            cores[1].detach_frontend()
+            deadline = time.monotonic() + 10.0
+            while (not replica_stats(urls[1])["eligible"]
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            if not replica_stats(urls[1])["eligible"]:
+                fail("router cycle {}: revived replica never rotated "
+                     "back in".format(cycle))
+            for model, scope in zip(models, scopes):
+                if model._scheduler is not None:
+                    wait_no_leaks(model, "router cycle {} ({})".format(
+                        cycle, scope))
+            stats = router.stats()
+            print("cycle {:2d} handoffs={} failovers={} shed={} "
+                  "client_resumes={}".format(
+                      cycle,
+                      stats["handoffs"] - stats_before["handoffs"],
+                      stats["failovers"] - stats_before["failovers"],
+                      stats["shed"] - stats_before["shed"],
+                      resumes[0]))
+        stats = router.stats()
+        if stats["handoffs"] == 0:
+            fail("router: the soak never exercised a cross-replica "
+                 "handoff (severs did not land mid-stream?)")
+    finally:
+        signal.signal(signal.SIGTERM, previous)
+        router.stop()
+        for f in frontends:
+            f.stop()
+        for c in cores:
+            c.close()
+
+
 def kill_loop_phase(rounds, slots, budget):
     """Repeatedly kill the decode loop mid-traffic; assert supervised
     auto-restart with zero lost or corrupted streams."""
@@ -418,6 +580,11 @@ def main():
                         help="soak the multi-replica pool layer instead "
                              "(SIGTERM-drain one of two replicas on a "
                              "cycle)")
+    parser.add_argument("--router", action="store_true",
+                        help="soak the fleet-router tier instead: plain "
+                             "clients stream through a FleetRouter while "
+                             "one replica SIGTERM-drains/revives and live "
+                             "streams are severed mid-generation")
     parser.add_argument("--kill-loop", action="store_true",
                         help="soak the supervised-restart layer instead: "
                              "kill the decode loop mid-traffic every "
@@ -425,14 +592,33 @@ def main():
                              "or corrupted streams")
     parser.add_argument("--cycles", type=int, default=4,
                         help="pool mode: drain/revive cycles (default 4)")
-    parser.add_argument("--soak", type=int, default=40,
-                        help="pool mode: requests per worker per cycle "
-                             "(default 40)")
+    parser.add_argument("--soak", type=int, default=None,
+                        help="requests per worker per cycle (default: "
+                             "40 in pool mode, 6 full generations in "
+                             "router mode)")
     args = parser.parse_args()
+
+    if args.router:
+        t0 = time.monotonic()
+        # router soak default: fewer, heavier cycles (each cycle runs
+        # 4 workers x soak full generations through the router)
+        soak = args.soak if args.soak is not None else 6
+        router_phase(args.cycles, soak, args.budget)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nrouter chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nrouter chaos smoke OK: {} drain/sever cycles, {:.1f}s, "
+              "zero user-visible errors, zero lost or duplicated "
+              "tokens".format(args.cycles, elapsed))
+        return 0
 
     if args.pool:
         t0 = time.monotonic()
-        pool_phase(args.cycles, args.soak)
+        pool_phase(args.cycles,
+                   args.soak if args.soak is not None else 40)
         elapsed = time.monotonic() - t0
         if _failures:
             print("\npool chaos smoke FAILED: {} violation(s) in "

@@ -68,7 +68,11 @@ def build_parser():
                     choices=["http", "grpc", "inprocess", "pool"],
                     help="client backend (default http)")
     ap.add_argument("-u", "--url", default="127.0.0.1:8000",
-                    help="server host:port (http/grpc backends)")
+                    help="server host:port (http/grpc backends); an "
+                         "http target may be a tools/router.py fleet "
+                         "router, in which case per-level router "
+                         "failover/handoff/shed counters land in the "
+                         "report")
     ap.add_argument("--urls", default=None,
                     help="comma-separated replica URLs (pool backend)")
     ap.add_argument("--concurrency-range", default=None,

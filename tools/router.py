@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Fleet router CLI: one resilient front-tier over N replica servers.
+
+    python tools/router.py --backends 10.0.0.1:8000,10.0.0.2:8000 \
+        --port 9000 --probe-interval 0.5 --max-inflight 256
+
+The router speaks the same KServe v2 + /generate_stream surface as a
+replica, so any plain tritonclient.http client points at it unchanged
+and gets health-aware routing, typed shedding, sticky stream resume,
+and cross-replica resume handoff for free (docs/resilience.md "Fleet
+router").  SIGTERM/SIGINT stop it cleanly.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--backends", required=True,
+                    help="comma-separated replica host:port list")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9000,
+                    help="router listen port (0 = pick free)")
+    ap.add_argument("--probe-interval", type=float, default=1.0,
+                    help="health-prober cadence in seconds (default 1.0)")
+    ap.add_argument("--probe-timeout", type=float, default=2.0)
+    ap.add_argument("--max-inflight", type=int, default=None,
+                    help="router-level in-flight cap; excess sheds with "
+                         "typed 429 + Retry-After (default: uncapped)")
+    ap.add_argument("--gen-ttl", type=float, default=60.0,
+                    help="generation registry TTL seconds — match the "
+                         "replicas' replay_ttl_s (default 60)")
+    ap.add_argument("--gen-capacity", type=int, default=1024)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    from tpuserver.router import FleetRouter
+
+    backends = [u.strip() for u in args.backends.split(",") if u.strip()]
+    router = FleetRouter(
+        backends,
+        host=args.host,
+        port=args.port,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        max_inflight=args.max_inflight,
+        gen_ttl_s=args.gen_ttl,
+        gen_capacity=args.gen_capacity,
+        verbose=args.verbose,
+    ).start()
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print("fleet router listening on {} over {} replica(s): {}".format(
+        router.url, len(backends), ", ".join(backends)), flush=True)
+    try:
+        stop.wait()
+    finally:
+        router.stop()
+    print("router stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
