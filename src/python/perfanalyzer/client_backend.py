@@ -393,7 +393,7 @@ class GrpcBackend(ClientBackend):
         # generation streams are per-thread: one gRPC client owns at
         # most one bidi stream, and generation workers run concurrently
         self._stream_local = threading.local()
-        self._stream_clients = []
+        self._stream_clients = []  # guarded-by: _stream_clients_lock
         self._stream_clients_lock = threading.Lock()
 
     def model_metadata(self, model):

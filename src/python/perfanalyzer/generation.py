@@ -25,23 +25,24 @@ class _GenCollector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._open = False
-        self._lifetime_generations = 0  # counted window-open or not
-        self.reset()
+        self._open = False  # guarded-by: _lock
+        # counted window-open or not  # guarded-by: _lock
+        self._lifetime_generations = 0
+        self._reset_locked()
 
-    def reset(self):
-        self._tokens = 0
-        self._ttfts = []
-        self._itls = []
-        self._generations = 0
-        self._errors = 0
-        self._resumed_streams = 0
-        self._resume_events = 0
+    def _reset_locked(self):
+        self._tokens = 0             # guarded-by: _lock
+        self._ttfts = []             # guarded-by: _lock
+        self._itls = []              # guarded-by: _lock
+        self._generations = 0        # guarded-by: _lock
+        self._errors = 0             # guarded-by: _lock
+        self._resumed_streams = 0    # guarded-by: _lock
+        self._resume_events = 0      # guarded-by: _lock
 
     def start_window(self):
         with self._lock:
             self._open = True
-            self.reset()
+            self._reset_locked()
 
     def end_window(self):
         with self._lock:
@@ -125,7 +126,7 @@ class GenerationProfiler:
         self._level_baseline = 0
         self._stop_event = threading.Event()
         self._cursor_lock = threading.Lock()
-        self._cursor = 0
+        self._cursor = 0  # guarded-by: _cursor_lock
 
     # -- workers -----------------------------------------------------------
 

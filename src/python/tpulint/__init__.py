@@ -7,9 +7,10 @@ holding one, that every deadline is monotonic-clock math, that every
 typed error is mapped on both wire protocols and documented, that every
 thread dies with its owner, and that every fault-injection point is
 registered.  tpulint turns those conventions into a tier-1 gate: one
-shared AST pass (tpulint.analysis) feeds six rules, findings are
-suppressible inline (``# tpulint: disable=R1``) or via a checked-in
-baseline, and ``tools/tpulint.py`` is the CLI front door.
+shared AST pass (tpulint.analysis) plus one whole-program call graph
+(tpulint.callgraph) feed eight rules, findings are suppressible inline
+(``# tpulint: disable=R1``) or via a checked-in baseline, and
+``tools/tpulint.py`` is the CLI front door.
 
 Rule catalog (details + examples: docs/static_analysis.md):
 
@@ -17,7 +18,9 @@ Rule catalog (details + examples: docs/static_analysis.md):
 R1    guarded-by              annotated fields only touched under their
                               lock (``# guarded-by: _lock``)
 R2    no-blocking-under-lock  no sleep/join/socket/Future.result inside a
-                              held-lock block; lock-order graph acyclic
+                              held-lock block, at ANY call depth via the
+                              project call graph; interprocedural
+                              lock-order graph acyclic
 R3    monotonic-clock         no wall-clock reads; deadline math is
                               time.monotonic() only
 R4    wire-map                every ServerError subclass mapped in HTTP +
@@ -26,19 +29,26 @@ R5    thread-lifecycle        every Thread daemon=True or joined on a
                               close()/stop()/drain() path
 R6    fault-registry          every faults.fire() site registered in
                               faults.POINTS, exactly one site per point
+R7    atomicity               no check-then-act split across a lock
+                              release on guarded state
+R8    protocol-parity         router re-serves the replica's surface:
+                              routes, verbs, status lines, SSE/resume
+                              grammar, HTTP<->gRPC code maps
 ====  ======================  ============================================
 """
 
 from tpulint.findings import Finding
 from tpulint.runner import (
     ALL_RULES,
+    CACHE_STATS,
     RULES_BY_ID,
     LintResult,
+    clear_module_cache,
     lint_paths,
     select_rules,
 )
 
 __all__ = [
-    "ALL_RULES", "Finding", "LintResult", "RULES_BY_ID", "lint_paths",
-    "select_rules",
+    "ALL_RULES", "CACHE_STATS", "Finding", "LintResult", "RULES_BY_ID",
+    "clear_module_cache", "lint_paths", "select_rules",
 ]

@@ -146,6 +146,9 @@ class ModuleInfo:
         self.thread_creations = []  # [ThreadCreation]
         self.dict_assignments = {}  # NAME -> dict literal node (top level)
         self.func_dicts = {}     # func name -> first dict literal inside
+        # local name -> source-module basename, from `from X import name`
+        # (the call graph only cross-module-resolves imported names)
+        self.from_imports = {}
 
     def suppressed(self, lineno, rule_tokens):
         """Whether a finding of a rule (any of its name tokens) is
@@ -334,6 +337,13 @@ class _Walker(ast.NodeVisitor):
                 if tc.target_attr is None and any(
                         _contains(arg, tc.node) for arg in node.args):
                     tc.target_attr = node.func.value.attr
+
+    def visit_ImportFrom(self, node):
+        if node.module:
+            base = node.module.rsplit(".", 1)[-1]
+            for alias in node.names:
+                self.info.from_imports[alias.asname or alias.name] = base
+        self.generic_visit(node)
 
     def visit_Assign(self, node):
         # guarded-by annotations: trailing comment on the assignment's

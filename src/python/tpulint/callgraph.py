@@ -1,0 +1,288 @@
+"""Project-wide call graph over the shared pass's ModuleInfos.
+
+The lexical facts ``tpulint.analysis`` computes stop at function
+boundaries: a ``with self._lock: self._helper()`` looks innocent even
+when ``_helper`` reaches ``time.sleep`` three calls down.  This module
+builds one best-effort call graph across every analyzed module and
+derives two transitive properties the interprocedural rules consume:
+
+- **blocking-ness** (R2i): a function *blocks* when it directly calls a
+  blocking primitive (``time.sleep`` / ``Thread.join`` /
+  ``Future.result()`` / socket-HTTP I/O — the same set the lexical R2
+  check uses; ``Condition.wait`` stays a purely lexical concern because
+  its legality depends on the caller's held locks) or when any resolved
+  callee blocks.  Reported findings carry the witness chain
+  (``_helper -> _deep -> time.sleep``).
+- **lock acquisitions** (R2i's lock-order graph): the set of locks a
+  function acquires anywhere in its call tree, so an AB/BA deadlock
+  split across ``a(): with _x: self.b()`` / ``b(): with _y: ...`` in
+  two different methods is an edge, not a blind spot.
+
+Call resolution is *name-based and best-effort* (this is Python):
+
+- ``self.method()`` resolves in the receiver class, then its base
+  classes (name-resolved across the analyzed set, the R4 hierarchy
+  index).
+- ``name()`` resolves to a module-level function of the same module,
+  else — only when the calling module has ``from <m> import name`` —
+  to the module-level ``name`` of the analyzed module whose basename
+  is ``<m>`` (an imported helper).  A bare name with no matching
+  import stays unresolved: binding by name alone could attach an
+  unrelated same-named function from another module and fabricate a
+  witness chain.
+- ``Class.method()`` resolves when ``Class`` is an analyzed class;
+  ``module.func()`` resolves when ``module`` matches an analyzed
+  module's basename and defines ``func`` at top level.
+- Everything else (``obj.attr.method()``, dynamic dispatch) stays
+  unresolved — unresolved calls are assumed non-blocking, so the
+  analysis under-reports rather than false-positives.
+
+Two annotation escape hatches close the gaps (on the ``def`` line or
+alone on the line above):
+
+- ``# tpulint: blocks`` — force the function blocking (e.g. a wrapper
+  around an unanalyzed C extension that sleeps).
+- ``# tpulint: nonblocking`` — force it non-blocking (e.g. a callee
+  that only ever runs with a bounded, sub-millisecond timeout).
+"""
+
+import re
+
+from tpulint.analysis import CONVENTION
+
+BLOCKS_RE = re.compile(r"#\s*tpulint:\s*(blocks|nonblocking)\b")
+
+
+def _annotation(mod, fn):
+    """'blocks' / 'nonblocking' / None for a function, read from the
+    def line's trailing comment or a comment-only line above it."""
+    for ln in (fn.lineno, fn.lineno - 1):
+        if ln != fn.lineno and ln not in mod.comment_only_lines:
+            continue
+        comment = mod.comments.get(ln)
+        if comment:
+            m = BLOCKS_RE.search(comment)
+            if m:
+                return m.group(1)
+    return None
+
+
+class CallGraph:
+    """Nodes are FunctionInfos; edges are resolved call sites."""
+
+    def __init__(self, modules):
+        self.modules = list(modules)
+        # (class name, method name) -> FunctionInfo (first definition
+        # wins, matching the one-definition rule R4 enforces)
+        self.methods = {}
+        # (module relpath, func name) -> FunctionInfo  (module-level)
+        self.module_funcs = {}
+        # module basename (no .py) -> ModuleInfo
+        self.mod_by_basename = {}
+        # class name -> ClassInfo (flat, first wins)
+        self.classes = {}
+        self.mod_of = {}          # FunctionInfo -> ModuleInfo
+        self.annotations = {}     # FunctionInfo -> 'blocks'/'nonblocking'
+        self.edges = {}           # FunctionInfo -> [(CallSite, callee)]
+        self._blocking = None     # FunctionInfo -> witness chain list
+        self._acquires = None     # FunctionInfo -> set(lock ids)
+        self._build()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self):
+        for mod in self.modules:
+            base = mod.relpath.rsplit("/", 1)[-1]
+            if base.endswith(".py"):
+                base = base[:-3]
+            self.mod_by_basename.setdefault(base, mod)
+            for cls in mod.classes.values():
+                self.classes.setdefault(cls.name, cls)
+                for name, fn in cls.methods.items():
+                    self.methods.setdefault((cls.name, name), fn)
+            for fn in mod.functions:
+                self.mod_of[fn] = mod
+                ann = _annotation(mod, fn)
+                if ann:
+                    self.annotations[fn] = ann
+                if fn.cls is None:
+                    self.module_funcs.setdefault((mod.relpath, fn.name), fn)
+        for mod in self.modules:
+            for site in mod.call_sites:
+                if site.func is None:
+                    continue
+                callee = self.resolve(site, mod)
+                if callee is not None:
+                    self.edges.setdefault(site.func, []).append(
+                        (site, callee))
+
+    def _method_in_hierarchy(self, cls, name, seen=None):
+        """Resolve a method in ``cls`` or its (name-resolved) bases."""
+        seen = seen if seen is not None else set()
+        while cls is not None and cls.name not in seen:
+            seen.add(cls.name)
+            fn = cls.methods.get(name)
+            if fn is not None:
+                return fn
+            nxt = None
+            for base in cls.bases:
+                cand = self.classes.get(base.rsplit(".", 1)[-1])
+                if cand is not None:
+                    nxt = cand
+                    break
+            cls = nxt
+        return None
+
+    def resolve(self, site, mod):
+        """The FunctionInfo a call site dispatches to, or None."""
+        dotted = site.dotted
+        if dotted.startswith("self."):
+            rest = dotted[len("self."):]
+            if "." in rest or site.cls is None:
+                return None  # self.attr.method(): unresolvable receiver
+            return self._method_in_hierarchy(site.cls, rest)
+        if "." not in dotted:
+            fn = self.module_funcs.get((mod.relpath, dotted))
+            if fn is not None:
+                return fn
+            # cross-module only through an explicit `from X import name`
+            # in the CALLING module — by-name binding alone could attach
+            # an unrelated same-named function and fabricate a chain
+            src = mod.from_imports.get(dotted)
+            if src:
+                target_mod = self.mod_by_basename.get(src)
+                if target_mod is not None:
+                    return self.module_funcs.get(
+                        (target_mod.relpath, dotted))
+            return None
+        head, _, tail = dotted.partition(".")
+        if "." in tail:
+            return None
+        cls = self.classes.get(head)
+        if cls is not None:
+            return self._method_in_hierarchy(cls, tail)
+        target_mod = self.mod_by_basename.get(head)
+        if target_mod is not None:
+            return self.module_funcs.get((target_mod.relpath, tail))
+        return None
+
+    # -- transitive blocking-ness ------------------------------------------
+
+    def _ensure_blocking(self):
+        """Least-fixpoint blocking set with witness chains.
+
+        Computed whole-graph rather than per-query recursion so the
+        result is order-independent: a member of a call cycle is
+        blocking iff anything reachable from the cycle blocks, no
+        matter which function a rule happens to ask about first (a
+        recursive memo would finalize "non-blocking" for a node whose
+        only callee was still open on the stack)."""
+        if self._blocking is not None:
+            return
+        from tpulint.rules_locks import _is_blocking_call
+
+        blocking = {}  # FunctionInfo -> witness chain
+        for fn, ann in self.annotations.items():
+            if ann == "blocks":
+                blocking[fn] = ["(annotated '# tpulint: blocks')"]
+        for mod in self.modules:
+            for site in mod.call_sites:
+                fn = site.func
+                if (fn is None or fn in blocking
+                        or self.annotations.get(fn) == "nonblocking"):
+                    continue
+                desc = _is_blocking_call(site)
+                if desc is not None:
+                    blocking[fn] = [desc]
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.mod_of:
+                if fn in blocking or \
+                        self.annotations.get(fn) == "nonblocking":
+                    continue
+                for site, callee in self.edges.get(fn, ()):
+                    sub = blocking.get(callee)
+                    if sub is not None:
+                        # extends a FINAL chain, so chains stay finite
+                        # and end in a primitive/annotation witness
+                        blocking[fn] = [site.dotted] + sub
+                        changed = True
+                        break
+        self._blocking = blocking
+
+    def blocking_chain(self, fn):
+        """None when ``fn`` cannot be shown to block; else the witness
+        chain ``['helper', '_deep', 'time.sleep']`` (call names ending
+        in the blocking primitive's description)."""
+        self._ensure_blocking()
+        return self._blocking.get(fn)
+
+    # -- transitive lock acquisition ---------------------------------------
+
+    @staticmethod
+    def _lock_id(name, cls, mod):
+        # mirror rules_locks: Condition-over-lock aliases collapse to
+        # the underlying lock so the two names cannot fabricate edges
+        if cls is not None:
+            name = cls.lock_aliases.get(name, name)
+        return (cls.name if cls is not None else mod.relpath, name)
+
+    def acquires(self, fn):
+        """Every lock id ``fn`` acquires directly or via resolved
+        callees, as ``frozenset((scope, lock))``.
+
+        Least fixpoint over the whole graph (not per-query recursion)
+        so call cycles cannot drop acquisitions depending on which
+        function is asked about first."""
+        if self._acquires is None:
+            result = {f: set() for f in self.mod_of}
+            for mod in self.modules:
+                for wl in mod.with_locks:
+                    if wl.func is not None:
+                        result.setdefault(wl.func, set()).add(
+                            self._lock_id(wl.lock, wl.cls, mod))
+            changed = True
+            while changed:
+                changed = False
+                for f in self.mod_of:
+                    acc = result[f]
+                    before = len(acc)
+                    for _site, callee in self.edges.get(f, ()):
+                        acc |= result.get(callee, set())
+                    if len(acc) != before:
+                        changed = True
+            self._acquires = result
+        return frozenset(self._acquires.get(fn, ()))
+
+    def acquisition_edges(self):
+        """Interprocedural lock-order edges: for every call site made
+        while lock(s) are lexically held, an edge from each held lock
+        to every lock the callee's call tree acquires.  Returns
+        ``{(held_id, acquired_id): (relpath, lineno)}`` (first witness
+        wins).  Walks the already-resolved ``self.edges`` — no second
+        resolution pass over the tree."""
+        edges = {}
+        for fn, pairs in self.edges.items():
+            mod = self.mod_of.get(fn)
+            if mod is None:
+                continue
+            for site, callee in pairs:
+                if not site.locks:
+                    continue
+                targets = self.acquires(callee)
+                if not targets:
+                    continue
+                for held in site.locks:
+                    if held == CONVENTION:
+                        continue
+                    held_id = self._lock_id(held, site.cls, mod)
+                    for tgt in targets:
+                        if held_id != tgt:
+                            edges.setdefault(
+                                (held_id, tgt), (mod.relpath, site.lineno))
+        return edges
+
+
+def build_call_graph(modules):
+    return CallGraph(modules)

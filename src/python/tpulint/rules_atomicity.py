@@ -1,0 +1,258 @@
+"""R7 atomicity-violation (check-then-act across a lock release).
+
+The torn shape that caused PR 6's real ``_beat``/``healthy`` findings,
+caught structurally: within ONE function, a ``# guarded-by:`` field is
+read under its lock, the lock is released, and the stale value then
+either
+
+- **guards a branch** that re-acquires the lock and stores to guarded
+  state (check-then-act: the state may have changed between the two
+  critical sections), or
+- **feeds the value stored back** into guarded state under a later
+  re-acquisition (read-modify-write torn in half: a concurrent update
+  between the sections is silently lost).
+
+Either way the decision rests on a value another thread may have
+invalidated.  The fix is almost always to widen the critical section
+(one ``with`` around read + decide + act) or to re-read under the
+second acquisition.  Deliberate snapshot-then-act protocols (DCL,
+cross-object handoffs) stay out of scope the same way they do for R1:
+their fields are deliberately NOT ``# guarded-by:``-annotated —
+annotation is the opt-in.
+
+Scope and precision:
+
+- Only **top-level** (non-nested) ``with <lock>`` regions of one
+  function body are paired; the lock is provably released between two
+  disjoint regions.
+- The read must bind a **local name** inside region A (``x =
+  self._state`` or any assignment whose right side mentions the
+  guarded read); taint follows plain local assignments between
+  regions.
+- Region B must acquire the **same lock** (Condition aliases count)
+  and store to a field guarded by it.
+- "Guards a branch" means region B sits inside an ``if``/``while``
+  whose test mentions a tainted name; "feeds the store" means the
+  stored value does.
+"""
+
+import ast
+
+from tpulint.analysis import _lock_name
+from tpulint.findings import Finding
+from tpulint.rules_locks import _lock_satisfied
+
+
+class _Region:
+    """One top-level ``with <lock>`` region of a function body."""
+
+    __slots__ = ("lock", "node", "lineno", "reads", "writes", "bound",
+                 "tests")
+
+    def __init__(self, lock, node, tests):
+        self.lock = lock
+        self.node = node
+        self.lineno = node.lineno
+        self.reads = set()    # guarded attrs loaded inside
+        self.writes = {}      # guarded attr -> store lineno
+        self.bound = {}       # local name -> guarded attr it snapshots
+        self.tests = tests    # enclosing if/while test nodes (lexical)
+
+
+def _nested_def(node):
+    return isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda))
+
+
+def _guarded_loads(node, guarded):
+    """Guarded ``self.X`` attrs loaded anywhere under ``node``."""
+    found = set()
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and isinstance(sub.ctx, ast.Load)
+                and sub.attr in guarded):
+            found.add(sub.attr)
+    return found
+
+
+def _names_in(node):
+    return {sub.id for sub in ast.walk(node) if isinstance(sub, ast.Name)}
+
+
+def _collect_regions(fn_node, cls):
+    """Top-level lock regions of a function, in document order, each
+    carrying the ``if``/``while`` tests that lexically enclose it
+    (shape A's "decide" step)."""
+    regions = []
+
+    def scan(body, tests):
+        for stmt in body:
+            if _nested_def(stmt):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                lock = None
+                for item in stmt.items:
+                    name = _lock_name(item.context_expr)
+                    if name is not None:
+                        lock = name
+                        break
+                if lock is not None:
+                    region = _Region(lock, stmt, list(tests))
+                    _fill_region(region, stmt, cls)
+                    regions.append(region)
+                else:
+                    # a non-lock with (file, injected(...)): transparent
+                    scan(stmt.body, tests)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                scan(stmt.body, tests + [stmt.test])
+                scan(stmt.orelse, tests + [stmt.test])
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan(stmt.body, tests)
+                scan(stmt.orelse, tests)
+            elif isinstance(stmt, ast.Try):
+                scan(stmt.body, tests)
+                for handler in stmt.handlers:
+                    scan(handler.body, tests)
+                scan(stmt.orelse, tests)
+                scan(stmt.finalbody, tests)
+
+    scan(fn_node.body, [])
+    return regions
+
+
+def _walk_no_defs(root):
+    """Pre-order walk that prunes nested def/lambda subtrees (their
+    bodies run later, without the lock)."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        stack.extend(child for child in ast.iter_child_nodes(node)
+                     if not _nested_def(child))
+
+
+def _fill_region(region, with_node, cls):
+    guarded = {a for a, (lock, _ln) in cls.guarded.items()
+               if _lock_satisfied(lock, frozenset([region.lock]), cls)}
+    for sub in _walk_no_defs(with_node):
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in guarded):
+            if isinstance(sub.ctx, ast.Load):
+                region.reads.add(sub.attr)
+            else:
+                region.writes.setdefault(sub.attr, sub.lineno)
+        if isinstance(sub, ast.Assign):
+            loads = _guarded_loads(sub.value, guarded)
+            if loads:
+                for target in sub.targets:
+                    if isinstance(target, ast.Name):
+                        region.bound[target.id] = sorted(loads)[0]
+
+
+class AtomicityRule:
+    id = "R7"
+    name = "atomicity"
+
+    def check(self, modules, config):
+        findings = []
+        for mod in modules:
+            for cls in mod.classes.values():
+                if not cls.guarded:
+                    continue
+                for name, fn in cls.methods.items():
+                    if name in ("__init__", "__new__") or \
+                            name.endswith("_locked"):
+                        continue
+                    findings.extend(self._check_function(mod, cls, fn))
+        return findings
+
+    def _check_function(self, mod, cls, fn):
+        regions = _collect_regions(fn.node, cls)
+        if len(regions) < 2:
+            return []
+        findings = []
+        for i, first in enumerate(regions):
+            if not first.bound:
+                continue
+            # taint: locals snapshotting guarded state in region i,
+            # widened through plain assignments later in the function
+            tainted = dict(first.bound)  # name -> source attr
+            for later in regions[i + 1:]:
+                if later.lock != first.lock and not (
+                        _lock_satisfied(later.lock,
+                                        frozenset([first.lock]), cls)):
+                    continue
+                if not later.writes:
+                    continue
+                self._propagate_taint(fn.node, first, later, tainted)
+                hit = self._torn_pair(first, later, tainted, cls)
+                if hit is not None:
+                    findings.append(Finding(
+                        self.id, self.name, mod.relpath, hit["lineno"],
+                        "check-then-act across a lock release in "
+                        "{}.{}(): {}.{} is read under {} into '{}' and "
+                        "{} after the lock is released — widen the "
+                        "critical section or re-read under the second "
+                        "acquisition".format(
+                            cls.name, fn.name, cls.name, hit["attr"],
+                            first.lock, hit["local"], hit["how"]),
+                    ))
+        return findings
+
+    def _propagate_taint(self, fn_node, first, later, tainted):
+        """Follow ``y = f(x)`` assignments lexically between the two
+        regions (outside any lock region)."""
+        for stmt in ast.walk(fn_node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not (first.node.end_lineno < stmt.lineno
+                    < later.node.lineno):
+                continue
+            if _names_in(stmt.value) & set(tainted):
+                src = next(iter(_names_in(stmt.value) & set(tainted)))
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        tainted.setdefault(target.id, tainted[src])
+
+    def _torn_pair(self, first, later, tainted, cls):
+        """A (read-region, act-region) pair is torn when the act is
+        conditioned on, or computed from, the stale snapshot."""
+        # shape B: the stored value is computed from the snapshot
+        for sub in ast.walk(later.node):
+            if isinstance(sub, ast.Assign):
+                stores = [
+                    t for t in sub.targets
+                    if isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr in later.writes
+                ]
+                if stores and _names_in(sub.value) & set(tainted):
+                    local = next(iter(_names_in(sub.value) & set(tainted)))
+                    return {
+                        "attr": tainted[local], "local": local,
+                        "lineno": sub.lineno,
+                        "how": "the value stored into guarded "
+                               "'{}' is computed from it".format(
+                                   stores[0].attr),
+                    }
+        # shape A: the act region sits inside a branch testing the
+        # snapshot
+        for test in later.tests:
+            hit = _names_in(test) & set(tainted)
+            if not hit:
+                continue
+            local = next(iter(hit))
+            attr = sorted(later.writes.items(), key=lambda kv: kv[1])[0][0]
+            return {
+                "attr": tainted[local], "local": local,
+                "lineno": later.lineno,
+                "how": "the branch guarding the store to '{}' "
+                       "tests it".format(attr),
+            }
+        return None

@@ -122,16 +122,32 @@ class BlockingUnderLockRule:
     full blocking duration.  ``Condition.wait`` on the *held* lock is
     the one exemption (it releases the lock while waiting).
 
+    The check is **interprocedural** (R2i): blocking-ness propagates
+    through the project call graph (``tpulint.callgraph``), so ``with
+    self._lock: self._helper()`` is a finding when ``_helper`` reaches
+    ``time.sleep`` / socket I/O / ``Future.result()`` at ANY depth —
+    the finding names the witness chain.  ``# tpulint: nonblocking``
+    on the callee's ``def`` line vouches for a callee the resolver
+    over-approximates; ``# tpulint: blocks`` forces one it cannot see
+    into (an unanalyzed extension that sleeps).
+
     The rule also builds a lock-acquisition-order graph — an edge for
-    every lock acquired while another is lexically held, plus one level
-    of ``self.method()`` resolution — and requires it to be acyclic:
-    a cycle is a latent AB/BA deadlock.
+    every lock acquired while another is lexically held, plus every
+    lock the call graph shows a callee's call TREE acquiring — and
+    requires it to be acyclic: a cycle is a latent AB/BA deadlock,
+    even when the two acquisition chains live in different methods or
+    modules.
     """
 
     id = "R2"
     name = "no-blocking-under-lock"
 
     def check(self, modules, config):
+        from tpulint.callgraph import build_call_graph
+
+        graph = getattr(config, "callgraph", None)
+        if graph is None:
+            graph = build_call_graph(modules)
         findings = []
         for mod in modules:
             for site in mod.call_sites:
@@ -140,6 +156,7 @@ class BlockingUnderLockRule:
                 if _wait_on_held_lock(site):
                     continue
                 desc = _is_blocking_call(site)
+                via = None
                 if desc is None:
                     # .wait on something that is NOT the held lock
                     # (e.g. an Event) blocks without releasing it
@@ -148,42 +165,47 @@ class BlockingUnderLockRule:
                         desc = "wait on {} (not the held lock)".format(
                             site.dotted.rsplit(".", 1)[0])
                     else:
-                        continue
+                        # R2i: does the callee's call tree block?
+                        callee = graph.resolve(site, mod)
+                        if callee is None:
+                            continue
+                        chain = graph.blocking_chain(callee)
+                        if chain is None:
+                            continue
+                        desc = "call"
+                        via = " -> ".join([site.dotted] + chain)
                 held = sorted(x for x in site.locks if x != CONVENTION)
                 findings.append(Finding(
                     self.id, self.name, mod.relpath, site.lineno,
-                    "blocking {} while holding lock(s) {} in {}.{}()".format(
+                    "blocking {}{} while holding lock(s) {} in "
+                    "{}.{}()".format(
                         desc,
+                        " ({})".format(via) if via else "",
                         "/".join(held) if held else
                         "(held by *_locked convention)",
                         site.cls.name if site.cls else "<module>",
                         site.func.name if site.func else "<module>",
                     ),
                 ))
-        findings.extend(self._check_lock_order(modules))
+        findings.extend(self._check_lock_order(modules, graph))
         return findings
 
     # -- lock-acquisition-order graph --------------------------------------
 
     def _lock_id(self, name, cls, mod):
+        # Condition-over-lock aliases collapse to the underlying lock:
+        # `_cond = threading.Condition(self._lock)` is ONE lock, and
+        # treating the two names as distinct would fabricate orderings
+        if cls is not None:
+            name = cls.lock_aliases.get(name, name)
         return (cls.name if cls is not None else mod.relpath, name)
 
-    def _check_lock_order(self, modules):
+    def _check_lock_order(self, modules, graph):
         edges = {}  # (from_id, to_id) -> (relpath, lineno)
 
         def add_edge(a, b, relpath, lineno):
             if a != b:
                 edges.setdefault((a, b), (relpath, lineno))
-
-        # methods that acquire a lock in their own body, for one level
-        # of self.method() call resolution
-        acquires = {}  # (class name, method name) -> set of lock ids
-        for mod in modules:
-            for wl in mod.with_locks:
-                if wl.cls is not None and wl.func is not None:
-                    acquires.setdefault(
-                        (wl.cls.name, wl.func.name), set()
-                    ).add(self._lock_id(wl.lock, wl.cls, mod))
 
         for mod in modules:
             for wl in mod.with_locks:
@@ -193,20 +215,10 @@ class BlockingUnderLockRule:
                         continue
                     add_edge(self._lock_id(held, wl.cls, mod), inner,
                              mod.relpath, wl.lineno)
-            for site in mod.call_sites:
-                if not site.locks or site.cls is None:
-                    continue
-                if not site.dotted.startswith("self."):
-                    continue
-                method = site.dotted[len("self."):]
-                if "." in method:
-                    continue
-                for target in acquires.get((site.cls.name, method), ()):
-                    for held in site.locks:
-                        if held == CONVENTION:
-                            continue
-                        add_edge(self._lock_id(held, site.cls, mod),
-                                 target, mod.relpath, site.lineno)
+        # interprocedural edges: a call made under a held lock orders
+        # that lock before every lock the callee's call tree acquires
+        for (a, b), where in graph.acquisition_edges().items():
+            add_edge(a, b, *where)
 
         return self._report_cycles(edges)
 

@@ -1,0 +1,353 @@
+"""R8 cross-surface protocol parity.
+
+The fleet router (``tpuserver/router.py``) deliberately re-serves the
+replica frontend's surface (``tpuserver/http_frontend.py``): same
+routes, same SSE resume grammar, same status lines — that identity is
+what lets a plain client point at either tier unchanged, and what the
+shared ``_http_base`` handler now carries structurally.  The parts
+that *cannot* be shared (the router's route table, the literals it
+keys relaying on) can still drift silently; this rule extracts both
+surfaces statically and fails on divergence:
+
+- **Health-route parity** — every ``/v2/health/*`` route the replica
+  serves must be served by the router itself (routers stack: a router
+  is probed exactly like a replica), and the router must re-serve the
+  ``generate_stream`` streaming surface.
+- **Verb parity** — the router dispatches every HTTP verb the
+  replica's route table keys on.
+- **Status-line parity** — when the two surfaces carry separate
+  status-line maps, the router's must contain every code the replica
+  can emit (a missing code relays as a blanket 500).  With the shared
+  ``_http_base`` map this is structural; the check guards a future
+  re-fork.
+- **HTTP/gRPC code parity** — every code in the gRPC frontend's
+  ``_status_code()`` map must have an HTTP status line, and every
+  HTTP status-line code must be gRPC-mapped unless it is framing-only
+  (``200``/``405``/``502`` — success, method-not-allowed raised below
+  the typed-error layer, and the router's own bad-gateway answer,
+  none of which exist on a gRPC stream).
+- **SSE grammar parity** — the replica and the router must build
+  ``id:`` lines from the same ``gen/seq`` format and emit the
+  byte-identical terminal ``{"final": true}`` event; a resuming
+  client's ``Last-Event-ID`` must parse the same against either tier.
+- **Resume-grammar parity** — every resume/stream parameter key the
+  replica surface uses (``generation_id``, ``seq``,
+  ``resume_generation_id``, ``resume_from_seq``, ``Last-Event-ID``)
+  must be used by the router too, and the generation-parameter keys a
+  producer publishes under ``core.RESPONSE_PARAMS_KEY`` must be among
+  the keys both tiers read.
+
+Surfaces are identified by module basename (``http_frontend.py`` /
+``router.py`` / ``grpc_frontend.py``) *and* shape: the HTTP surfaces
+must define a class with a ``_route`` method (so ``tools/router.py``,
+the CLI, is not a surface), the gRPC surface a ``_status_code``
+mapping.  When a surface is absent from the analyzed set the
+comparisons that need it are skipped — partial runs stay quiet, the
+full gate checks everything.
+"""
+
+import ast
+
+from tpulint.findings import Finding
+
+HTTP_BASENAME = "http_frontend.py"
+ROUTER_BASENAME = "router.py"
+GRPC_BASENAME = "grpc_frontend.py"
+STATUS_MAP_NAME = "_STATUS_LINE"
+GRPC_MAP_FUNC = "_status_code"
+
+#: HTTP codes with no gRPC twin by design: 200 (success is not an
+#: error mapping), 405 (raised by the framing layer below typed
+#: errors), 502 (the router's own mid-request-loss answer; gRPC
+#: streams surface that in-band).
+FRAMING_ONLY_CODES = frozenset({200, 405, 502})
+
+#: The resume grammar the replica and router must agree on.
+RESUME_KEYS = ("generation_id", "seq", "resume_generation_id",
+               "resume_from_seq")
+RESUME_HEADER = "last-event-id"
+
+HEALTH_PREFIX = "/v2/health/"
+STREAM_ROUTE_TOKEN = "generate_stream"
+
+
+def _has_route_method(mod):
+    return any("_route" in cls.methods for cls in mod.classes.values())
+
+
+def _str_constants(mod):
+    """Every string constant in the module (the literal surface)."""
+    out = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+    return out
+
+
+def _bytes_constants(mod):
+    out = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+            out.add(node.value)
+    return out
+
+
+def _routes(mod):
+    """Path literals the surface serves locally (``/v2...``,
+    ``/metrics``, ``/router/...``), regex route patterns (``^/v2...``),
+    and simple path suffixes the dispatcher endswith-matches
+    (``/generate_stream``)."""
+    lits = _str_constants(mod)
+    return {s for s in lits
+            if s.startswith("/v2") or s == "/metrics"
+            or s.startswith("/router") or s.startswith("^/v2")
+            or (s.startswith("/") and s[1:].replace("_", "").isalnum())}
+
+
+def _verbs(mod):
+    """HTTP verb literals the module's route code compares against."""
+    verbs = set()
+    known = {"GET", "POST", "PUT", "DELETE", "HEAD", "PATCH", "OPTIONS"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Compare):
+            parts = [node.left] + list(node.comparators)
+            names = set()
+            consts = set()
+            for part in parts:
+                for sub in ast.walk(part):
+                    if isinstance(sub, ast.Name):
+                        names.add(sub.id)
+                    elif isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        consts.add(sub.value)
+            if "method" in names:
+                verbs |= consts & known
+    return verbs
+
+
+def _status_map_keys(mod):
+    node = mod.dict_assignments.get(STATUS_MAP_NAME)
+    if node is None:
+        return None
+    return {k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, int)}
+
+
+def _sse_id_formats(mod):
+    """Format-string literals that build SSE ``id:`` lines."""
+    return {s for s in _str_constants(mod) if s.startswith("id: ")}
+
+
+def _final_markers(mod):
+    """The terminal-event byte literals (``{"final": true}``)."""
+    return {b for b in _bytes_constants(mod) if b'"final"' in b}
+
+
+def _response_params_keys(modules):
+    """Keys of every dict literal published under the
+    ``RESPONSE_PARAMS_KEY`` name (the generation producers' parameter
+    grammar, e.g. ``{"generation_id": ..., "seq": ...}``)."""
+    keys = set()
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Dict):
+                continue
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Name)
+                        and k.id == "RESPONSE_PARAMS_KEY"
+                        and isinstance(v, ast.Dict)):
+                    for vk in v.keys:
+                        if isinstance(vk, ast.Constant) and \
+                                isinstance(vk.value, str):
+                            keys.add(vk.value)
+    return keys
+
+
+class ProtocolParityRule:
+    id = "R8"
+    name = "protocol-parity"
+
+    def check(self, modules, config):
+        http_mod = router_mod = grpc_mod = None
+        for mod in modules:
+            base = mod.relpath.rsplit("/", 1)[-1]
+            if base == HTTP_BASENAME and _has_route_method(mod):
+                http_mod = http_mod or mod
+            elif base == ROUTER_BASENAME and _has_route_method(mod):
+                router_mod = router_mod or mod
+            elif base == GRPC_BASENAME and GRPC_MAP_FUNC in mod.func_dicts:
+                grpc_mod = grpc_mod or mod
+
+        findings = []
+        if http_mod is not None and router_mod is not None:
+            findings.extend(self._check_router_parity(http_mod, router_mod))
+            findings.extend(self._check_resume_grammar(
+                modules, http_mod, router_mod))
+        if http_mod is not None and grpc_mod is not None:
+            findings.extend(self._check_code_parity(
+                modules, http_mod, grpc_mod))
+        return findings
+
+    # -- router vs replica frontend ----------------------------------------
+
+    def _check_router_parity(self, http_mod, router_mod):
+        findings = []
+        anchor = self._route_anchor(router_mod)
+
+        http_routes = _routes(http_mod)
+        router_routes = _routes(router_mod)
+        for route in sorted(http_routes):
+            if route.startswith(HEALTH_PREFIX) and \
+                    route not in router_routes:
+                findings.append(Finding(
+                    self.id, self.name, router_mod.relpath, anchor,
+                    "router does not serve replica health route "
+                    "'{}' — routers must stack (a router is probed "
+                    "exactly like a replica)".format(route),
+                ))
+        if any(STREAM_ROUTE_TOKEN in r for r in http_routes) and not any(
+                STREAM_ROUTE_TOKEN in r for r in router_routes):
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router does not re-serve the replica's "
+                "generate_stream streaming surface (no route literal "
+                "or pattern mentions '{}')".format(STREAM_ROUTE_TOKEN),
+            ))
+
+        missing_verbs = _verbs(http_mod) - _verbs(router_mod)
+        if missing_verbs:
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router route table never dispatches on verb(s) {} "
+                "that the replica frontend keys on".format(
+                    "/".join(sorted(missing_verbs))),
+            ))
+
+        http_codes = _status_map_keys(http_mod)
+        router_codes = _status_map_keys(router_mod)
+        if http_codes is not None and router_codes is not None:
+            missing = http_codes - router_codes
+            if missing:
+                findings.append(Finding(
+                    self.id, self.name, router_mod.relpath, anchor,
+                    "router status-line map is missing code(s) {} the "
+                    "replica frontend can emit — they would relay as a "
+                    "blanket 500".format(
+                        ", ".join(str(c) for c in sorted(missing))),
+                ))
+
+        http_ids = _sse_id_formats(http_mod)
+        router_ids = _sse_id_formats(router_mod)
+        if http_ids and router_ids and not (http_ids & router_ids):
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router SSE id-line format(s) {} share nothing with "
+                "the replica's {} — a client's Last-Event-ID would "
+                "parse differently per tier".format(
+                    sorted(router_ids), sorted(http_ids)),
+            ))
+        http_final = _final_markers(http_mod)
+        router_final = _final_markers(router_mod)
+        if http_final and not router_final:
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router never emits the replica's terminal SSE event "
+                "{} — clients key stream completion on the exact "
+                "marker".format(sorted(http_final)),
+            ))
+        elif http_final and router_final and not (http_final & router_final):
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router terminal SSE event {} differs from the "
+                "replica's {} — clients key stream completion on the "
+                "exact marker".format(
+                    sorted(router_final), sorted(http_final)),
+            ))
+        return findings
+
+    def _check_resume_grammar(self, modules, http_mod, router_mod):
+        findings = []
+        anchor = self._route_anchor(router_mod)
+        http_lits = _str_constants(http_mod)
+        router_lits = _str_constants(router_mod)
+        router_lits_lower = {s.lower() for s in router_lits}
+        for key in RESUME_KEYS:
+            if key in http_lits and key not in router_lits:
+                findings.append(Finding(
+                    self.id, self.name, router_mod.relpath, anchor,
+                    "router never references resume-grammar key '{}' "
+                    "that the replica frontend keys on — sticky resume "
+                    "would silently drift".format(key),
+                ))
+        http_has_header = any(
+            s.lower() == RESUME_HEADER for s in http_lits)
+        if http_has_header and RESUME_HEADER not in router_lits_lower:
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router never reads the replica's resume header "
+                "'Last-Event-ID'",
+            ))
+        produced = _response_params_keys(modules)
+        for surface, lits in (("replica frontend", http_lits),
+                              ("router", router_lits)):
+            missing = {k for k in produced if k not in lits}
+            if missing:
+                mod = http_mod if surface == "replica frontend" \
+                    else router_mod
+                findings.append(Finding(
+                    self.id, self.name, mod.relpath,
+                    self._route_anchor(mod),
+                    "{} never references generation parameter key(s) "
+                    "{} that a producer publishes under "
+                    "RESPONSE_PARAMS_KEY".format(
+                        surface, ", ".join(sorted(missing))),
+                ))
+        return findings
+
+    # -- http vs grpc typed-code maps --------------------------------------
+
+    def _check_code_parity(self, modules, http_mod, grpc_mod):
+        findings = []
+        http_codes = _status_map_keys(http_mod)
+        if http_codes is None:
+            # shared framing module: find the one _STATUS_LINE in the set
+            for mod in modules:
+                http_codes = _status_map_keys(mod)
+                if http_codes is not None:
+                    break
+        if http_codes is None:
+            return findings  # R4 already reports the missing map
+        grpc_dict = grpc_mod.func_dicts[GRPC_MAP_FUNC]
+        grpc_codes = {k.value for k in grpc_dict.keys
+                      if isinstance(k, ast.Constant)
+                      and isinstance(k.value, int)}
+        anchor = grpc_dict.lineno
+        unrenderable = grpc_codes - http_codes
+        if unrenderable:
+            findings.append(Finding(
+                self.id, self.name, grpc_mod.relpath, anchor,
+                "gRPC code map translates HTTP code(s) {} that have no "
+                "HTTP status line — the same typed error would render "
+                "as a blanket 500 on the HTTP surface".format(
+                    ", ".join(str(c) for c in sorted(unrenderable))),
+            ))
+        unmapped = http_codes - grpc_codes - FRAMING_ONLY_CODES
+        if unmapped:
+            findings.append(Finding(
+                self.id, self.name, grpc_mod.relpath, anchor,
+                "HTTP status-line code(s) {} have no gRPC mapping in "
+                "{}() and are not framing-only — the same typed error "
+                "would surface as UNKNOWN on gRPC".format(
+                    ", ".join(str(c) for c in sorted(unmapped)),
+                    GRPC_MAP_FUNC),
+            ))
+        return findings
+
+    @staticmethod
+    def _route_anchor(mod):
+        """Anchor surface-level findings at the handler's _route."""
+        for cls in mod.classes.values():
+            fn = cls.methods.get("_route")
+            if fn is not None:
+                return fn.lineno
+        return 1

@@ -1,0 +1,253 @@
+"""Shared stdlib HTTP request framing for the replica frontend and the
+fleet router.
+
+Both ``tpuserver.http_frontend._Handler`` and
+``tpuserver.router._RouterHandler`` speak the same hand-rolled
+HTTP/1.1 dialect: request-line + header parsing with byte splits (the
+stdlib ``BaseHTTPRequestHandler`` parses headers through the email
+package at ~300us/request), one-``write`` responses, chunked streaming
+for SSE, and gzip/deflate body decoding.  PR 7 left that framing
+duplicated (~120 lines); this module is now its single home — the
+router and the replica subclass :class:`BaseHttpHandler` and differ
+only in *dispatch* (execute locally vs. forward to the fleet), which
+is exactly the divergence tpulint's R8 protocol-parity rule verifies.
+
+Framing rules encoded here:
+
+- Responses leave in one ``write`` (status + headers + body), with
+  Nagle disabled — a multi-write response interacts with delayed ACKs
+  for ~40ms stalls.
+- A POST body is always drained before responding (an unconsumed body
+  would be parsed as the next request on the keep-alive socket); a
+  body that cannot be read (bad Content-Length / encoding) answers 400
+  and drops the connection, whose stream position is undefined.
+- Chunked transfer framing is HTTP/1.1; a 1.0 client gets streamed
+  bodies raw, delimited by connection close.
+- Streaming writes (``_send_stream_start`` / ``_send_chunk`` /
+  ``_end_chunks``) convert a dead DOWNSTREAM socket into
+  :class:`ClientGone` so relay/generate loops can distinguish "my
+  client hung up" from an upstream failure.
+"""
+
+import gzip
+import json
+import socketserver
+import zlib
+
+#: One status line per code either surface can emit.  This map is the
+#: single source of truth for both tiers (R4 checks every ServerError
+#: code appears here; R8 checks it stays a superset of the gRPC code
+#: map): a code missing from it silently degrades to the blanket 500
+#: line on the wire.
+_STATUS_LINE = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    501: b"HTTP/1.1 501 Not Implemented\r\n",
+    502: b"HTTP/1.1 502 Bad Gateway\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
+}
+
+
+class ClientGone(Exception):
+    """The downstream client hung up mid-stream.  Raised by the
+    streaming writers instead of the raw ``ConnectionError`` so a relay
+    loop cannot mistake its own dead client for an upstream failure
+    (the router would otherwise mark a healthy replica unreachable)."""
+
+
+class _Headers:
+    """Case-insensitive header view over a plain dict of lowercased
+    keys."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d):
+        self._d = d
+
+    def get(self, key, default=None):
+        return self._d.get(key.lower(), default)
+
+
+class BaseHttpHandler(socketserver.StreamRequestHandler):
+    """The shared request loop + response plumbing.  Subclasses provide
+    ``_dispatch(method)`` (and ``server_token`` for the Server:
+    header); everything on the wire below the route table lives here.
+    """
+
+    # Send responses in one TCP segment: without NODELAY the write
+    # would interact with delayed ACKs for ~40ms stalls.
+    disable_nagle_algorithm = True
+
+    #: The Server: response header value.
+    server_token = b"tpu-triton-server"
+
+    # -- request loop ------------------------------------------------------
+
+    def handle(self):
+        rfile = self.rfile
+        while True:
+            line = rfile.readline(65537)
+            if not line:
+                return
+            if line in (b"\r\n", b"\n"):
+                continue
+            try:
+                method, target, version = (
+                    line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+                )
+            except ValueError:
+                self._send(400, b'{"error": "malformed request line"}')
+                return
+            raw_headers = {}
+            while True:
+                h = rfile.readline(65537)
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                colon = h.find(b":")
+                if colon > 0:
+                    raw_headers[
+                        h[:colon].decode("latin-1").strip().lower()
+                    ] = h[colon + 1:].decode("latin-1").strip()
+            self.headers = _Headers(raw_headers)
+            self.path = target
+            # chunked transfer framing is HTTP/1.1; a 1.0 client gets
+            # streamed bodies raw, delimited by connection close
+            self._chunked_ok = version != "HTTP/1.0"
+            close = (
+                raw_headers.get("connection", "").lower() == "close"
+                or version == "HTTP/1.0"
+            )
+            self._body = None
+            self._started = False
+            try:
+                if method == "POST":
+                    try:
+                        self._read_body()  # drain before any response
+                    except (ValueError, OSError, EOFError, zlib.error) as e:
+                        # body unreadable (bad Content-Length / encoding):
+                        # respond, then drop the connection — the socket
+                        # position is undefined for further requests
+                        self._send_error_json(
+                            "malformed request body: {}".format(e), 400
+                        )
+                        return
+                    self._dispatch("POST")
+                elif method == "GET":
+                    self._dispatch("GET")
+                else:
+                    # unknown method: the body (if any) was not drained,
+                    # so this connection cannot be reused
+                    self._send(405, b'{"error": "unsupported method"}')
+                    return
+            except (BrokenPipeError, ConnectionResetError, ClientGone):
+                return
+            if close:
+                return
+
+    def _dispatch(self, method):
+        raise NotImplementedError
+
+    # -- body --------------------------------------------------------------
+
+    def _read_body(self):
+        """Read (once) and cache the request body.
+
+        Always called before responding — an unconsumed body would be
+        parsed as the start of the next request on this keep-alive
+        socket.
+        """
+        if self._body is None:
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            encoding = self.headers.get("Content-Encoding")
+            if encoding == "gzip":
+                body = gzip.decompress(body)
+            elif encoding == "deflate":
+                body = zlib.decompress(body)
+            self._body = body
+        return self._body
+
+    # -- unary responses ---------------------------------------------------
+
+    def _send(self, code, body=b"", headers=None,
+              content_type="application/json"):
+        head = (
+            _STATUS_LINE.get(code, _STATUS_LINE[500])
+            + b"Server: " + self.server_token
+            + b"\r\nContent-Type: "
+            + content_type.encode("latin-1")
+            + b"\r\nContent-Length: "
+            + str(len(body)).encode("latin-1")
+            + b"\r\n"
+        )
+        for key, val in (headers or {}).items():
+            head += (
+                key.encode("latin-1")
+                + b": "
+                + str(val).encode("latin-1")
+                + b"\r\n"
+            )
+        # single write: status + headers + body in one segment
+        self.wfile.write(head + b"\r\n" + body)
+
+    def _send_json(self, obj, code=200, headers=None):
+        self._send(code, json.dumps(obj).encode("utf-8"), headers)
+
+    def _send_error_json(self, msg, code=400, headers=None):
+        self._send_json({"error": msg}, code, headers)
+
+    # -- streaming responses -----------------------------------------------
+
+    def _send_stream_start(self, content_type="text/event-stream"):
+        """Open a streaming 200 response; the body follows as
+        ``_send_chunk`` frames ended by ``_end_chunks``.  Used by
+        ``/generate_stream`` — token count is data-dependent, so
+        Content-Length cannot be known up front and each token must
+        leave the socket as its decode step produces it."""
+        head = (
+            _STATUS_LINE[200]
+            + b"Server: " + self.server_token
+            + b"\r\nContent-Type: "
+            + content_type.encode("latin-1")
+        )
+        if self._chunked_ok:
+            head += b"\r\nTransfer-Encoding: chunked\r\n\r\n"
+        else:
+            head += b"\r\nConnection: close\r\n\r\n"
+        try:
+            self.wfile.write(head)
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            raise ClientGone() from e
+
+    def _ensure_started(self, content_type="text/event-stream"):
+        if not self._started:
+            self._send_stream_start(content_type)
+            self._started = True
+
+    def _send_chunk(self, data):
+        """One streamed frame to the client, flushed immediately; a
+        dead client raises :class:`ClientGone` so streaming loops can
+        stop generating (or park resume state) instead of spinning."""
+        try:
+            if self._chunked_ok:
+                data = (("%x\r\n" % len(data)).encode("latin-1")
+                        + data + b"\r\n")
+            self.wfile.write(data)
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError) as e:
+            raise ClientGone() from e
+
+    def _end_chunks(self):
+        """Terminal zero-length chunk: the connection stays reusable
+        (no-op for HTTP/1.0, whose end-of-body is the close)."""
+        if self._chunked_ok:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError) as e:
+                raise ClientGone() from e

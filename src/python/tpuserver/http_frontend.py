@@ -6,8 +6,10 @@ The request plumbing is hand-rolled rather than ``BaseHTTPRequestHandler``:
 the stdlib handler parses headers through the email package (~300us per
 request) and writes status/headers/body in separate syscalls; at the
 quick-start benchmark's ~700us round trip that is most of the budget.
-Here headers parse with byte splits and each response leaves in one
-``write`` (role of the reference server's C++ evhtp frontend on the
+The framing itself (byte-split header parsing, one-``write`` responses,
+chunked SSE) lives in ``tpuserver._http_base.BaseHttpHandler``, shared
+with the fleet router — this module owns only the replica's route
+table (role of the reference server's C++ evhtp frontend on the
 latency-critical path)."""
 
 import gzip
@@ -20,6 +22,7 @@ from urllib.parse import unquote
 
 import numpy as np
 
+from tpuserver._http_base import BaseHttpHandler, ClientGone
 from tpuserver.tensor_io import (
     array_from_binary as _array_from_binary,
     binary_from_array as _binary_from_array,
@@ -62,101 +65,15 @@ def _array_from_json_data(data, datatype, shape):
     return np.asarray(data, dtype=np_dtype).reshape(shape)
 
 
-_STATUS_LINE = {
-    200: b"HTTP/1.1 200 OK\r\n",
-    400: b"HTTP/1.1 400 Bad Request\r\n",
-    404: b"HTTP/1.1 404 Not Found\r\n",
-    405: b"HTTP/1.1 405 Method Not Allowed\r\n",
-    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
-    429: b"HTTP/1.1 429 Too Many Requests\r\n",
-    500: b"HTTP/1.1 500 Internal Server Error\r\n",
-    503: b"HTTP/1.1 503 Service Unavailable\r\n",
-    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
-}
+class _Handler(BaseHttpHandler):
+    """The replica's route table over the shared framing: every
+    request executes against the local ``InferenceServer``."""
 
-
-class _Headers:
-    """Case-insensitive header view over a plain dict of lowercased keys."""
-
-    __slots__ = ("_d",)
-
-    def __init__(self, d):
-        self._d = d
-
-    def get(self, key, default=None):
-        return self._d.get(key.lower(), default)
-
-
-class _Handler(socketserver.StreamRequestHandler):
-    # Send responses in one TCP segment: without NODELAY the write would
-    # interact with delayed ACKs for ~40ms stalls.
-    disable_nagle_algorithm = True
+    server_token = b"tpu-triton-server"
 
     @property
     def core(self):
         return self.server.core
-
-    # -- request loop ------------------------------------------------------
-
-    def handle(self):
-        rfile = self.rfile
-        while True:
-            line = rfile.readline(65537)
-            if not line:
-                return
-            if line in (b"\r\n", b"\n"):
-                continue
-            try:
-                method, target, version = (
-                    line.decode("latin-1").rstrip("\r\n").split(" ", 2)
-                )
-            except ValueError:
-                self._send(400, b'{"error": "malformed request line"}')
-                return
-            raw_headers = {}
-            while True:
-                h = rfile.readline(65537)
-                if h in (b"\r\n", b"\n", b""):
-                    break
-                colon = h.find(b":")
-                if colon > 0:
-                    raw_headers[
-                        h[:colon].decode("latin-1").strip().lower()
-                    ] = h[colon + 1 :].decode("latin-1").strip()
-            self.headers = _Headers(raw_headers)
-            self.path = target
-            # chunked transfer framing is HTTP/1.1; a 1.0 client gets
-            # streamed bodies raw, delimited by connection close
-            self._chunked_ok = version != "HTTP/1.0"
-            close = (
-                raw_headers.get("connection", "").lower() == "close"
-                or version == "HTTP/1.0"
-            )
-            self._body = None
-            try:
-                if method == "POST":
-                    try:
-                        self._read_body()  # drain before any response
-                    except (ValueError, OSError, EOFError, zlib.error) as e:
-                        # body unreadable (bad Content-Length / encoding):
-                        # respond, then drop the connection — the socket
-                        # position is undefined for further requests
-                        self._send_error_json(
-                            "malformed request body: {}".format(e), 400
-                        )
-                        return
-                    self._dispatch("POST")
-                elif method == "GET":
-                    self._dispatch("GET")
-                else:
-                    # unknown method: the body (if any) was not drained,
-                    # so this connection cannot be reused
-                    self._send(405, b'{"error": "unsupported method"}')
-                    return
-            except (BrokenPipeError, ConnectionResetError):
-                return
-            if close:
-                return
 
     def _dispatch(self, method):
         try:
@@ -170,67 +87,10 @@ class _Handler(socketserver.StreamRequestHandler):
             self._send_error_json(str(e), e.code, headers)
         except ValueError as e:
             self._send_error_json("malformed request: {}".format(e), 400)
-        except (BrokenPipeError, ConnectionResetError):
+        except (BrokenPipeError, ConnectionResetError, ClientGone):
             raise  # dead socket (incl. injected drops): handle() ends it
         except Exception as e:  # pragma: no cover
             self._send_error_json("internal error: {}".format(e), 500)
-
-    # -- plumbing ---------------------------------------------------------
-
-    def _send(self, code, body=b"", headers=None, content_type="application/json"):
-        head = (
-            _STATUS_LINE.get(code, _STATUS_LINE[500])
-            + b"Server: tpu-triton-server\r\nContent-Type: "
-            + content_type.encode("latin-1")
-            + b"\r\nContent-Length: "
-            + str(len(body)).encode("latin-1")
-            + b"\r\n"
-        )
-        if headers:
-            for key, val in headers.items():
-                head += (
-                    key.encode("latin-1")
-                    + b": "
-                    + str(val).encode("latin-1")
-                    + b"\r\n"
-                )
-        # single write: status + headers + body in one segment
-        self.wfile.write(head + b"\r\n" + body)
-
-    def _send_json(self, obj, code=200, headers=None):
-        self._send(code, json.dumps(obj).encode("utf-8"), headers)
-
-    def _send_stream_start(self, content_type):
-        """Open a streaming 200 response; the body follows as
-        ``_send_chunk`` frames ended by ``_end_chunks``.  Used by
-        /generate_stream — token count is data-dependent, so
-        Content-Length cannot be known up front and each token must
-        leave the socket as its decode step produces it.  HTTP/1.1
-        clients get Transfer-Encoding: chunked; HTTP/1.0 predates
-        chunked framing, so those get the raw bytes delimited by
-        connection close (``handle`` already closes 1.0 connections)."""
-        head = (
-            _STATUS_LINE[200]
-            + b"Server: tpu-triton-server\r\nContent-Type: "
-            + content_type.encode("latin-1")
-        )
-        if self._chunked_ok:
-            head += b"\r\nTransfer-Encoding: chunked\r\n\r\n"
-        else:
-            head += b"\r\nConnection: close\r\n\r\n"
-        self.wfile.write(head)
-
-    def _send_chunk(self, data):
-        if self._chunked_ok:
-            data = ("%x\r\n" % len(data)).encode("latin-1") + data + b"\r\n"
-        self.wfile.write(data)
-        self.wfile.flush()
-
-    def _end_chunks(self):
-        """Terminal zero-length chunk: the connection stays reusable
-        (no-op for HTTP/1.0, whose end-of-body is the close)."""
-        if self._chunked_ok:
-            self.wfile.write(b"0\r\n\r\n")
 
     def _send_metrics(self, core):
         """Prometheus-style exposition (role of Triton's :8002/metrics;
@@ -302,26 +162,6 @@ class _Handler(socketserver.StreamRequestHandler):
         self._send(
             200, ("\n".join(lines) + "\n").encode("utf-8"),
             content_type="text/plain")
-
-    def _send_error_json(self, msg, code=400, headers=None):
-        self._send_json({"error": msg}, code, headers)
-
-    def _read_body(self):
-        """Read (once) and cache the request body.
-
-        Always called before responding — an unconsumed body would be
-        parsed as the start of the next request on this keep-alive socket.
-        """
-        if self._body is None:
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length) if length else b""
-            encoding = self.headers.get("Content-Encoding")
-            if encoding == "gzip":
-                body = gzip.decompress(body)
-            elif encoding == "deflate":
-                body = zlib.decompress(body)
-            self._body = body
-        return self._body
 
     def _route(self, method):
         path = self.path.split("?", 1)[0]
@@ -555,12 +395,9 @@ class _Handler(socketserver.StreamRequestHandler):
         # in-band as an {"error": ...} event (the status line is gone)
         from tpuserver import faults as _faults
 
-        started = False
         try:
             for resp in core.infer_stream(request):
-                if not started:
-                    self._send_stream_start("text/event-stream")
-                    started = True
+                self._ensure_started()
                 payload = response_json(resp)
                 event = b""
                 if resp.parameters:
@@ -590,7 +427,7 @@ class _Handler(socketserver.StreamRequestHandler):
             finally:
                 raise BrokenPipeError("injected mid-stream disconnect")
         except ServerError as e:
-            if not started:
+            if not self._started:
                 raise
             self._send_chunk(
                 b"data: " + json.dumps({"error": str(e)}).encode("utf-8")
@@ -598,8 +435,7 @@ class _Handler(socketserver.StreamRequestHandler):
             )
             self._end_chunks()
             return
-        if not started:
-            self._send_stream_start("text/event-stream")
+        self._ensure_started()
         # explicit terminal event: a premature TCP close mid-chunked
         # stream is NOT reliably distinguishable from a clean end by
         # every HTTP client (stdlib line iteration just stops), so

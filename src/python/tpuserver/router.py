@@ -63,6 +63,7 @@ import time
 import uuid
 from collections import OrderedDict
 
+from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
 from tritonclient._auxiliary import (
     FAILURE_CONNECT,
     FAILURE_INTERRUPTED,
@@ -85,19 +86,6 @@ _BROADCAST_URI = re.compile(
     r"|(system|cuda|xla)sharedmemory(/region/[^/]+)?/(register|unregister)"
     r"|logging|trace/setting)$"
 )
-
-_STATUS_LINE = {
-    200: b"HTTP/1.1 200 OK\r\n",
-    400: b"HTTP/1.1 400 Bad Request\r\n",
-    404: b"HTTP/1.1 404 Not Found\r\n",
-    405: b"HTTP/1.1 405 Method Not Allowed\r\n",
-    422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
-    429: b"HTTP/1.1 429 Too Many Requests\r\n",
-    500: b"HTTP/1.1 500 Internal Server Error\r\n",
-    502: b"HTTP/1.1 502 Bad Gateway\r\n",
-    503: b"HTTP/1.1 503 Service Unavailable\r\n",
-    504: b"HTTP/1.1 504 Gateway Timeout\r\n",
-}
 
 #: Request headers forwarded to replicas (lowercased).  Hop-by-hop
 #: headers (connection, transfer framing) are the router's own;
@@ -880,75 +868,20 @@ class _RouterServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-class _ClientGone(Exception):
-    """The downstream client hung up mid-relay (its resume state stays
-    parked in the generation registry)."""
-
-
-class _RouterHandler(socketserver.StreamRequestHandler):
+class _RouterHandler(BaseHttpHandler):
     """The router's HTTP surface: same wire shape as the replica
-    frontend (tpuserver.http_frontend), but every model-facing route
-    forwards to the fleet instead of executing locally."""
+    frontend (tpuserver.http_frontend) — the framing is literally the
+    same class, ``tpuserver._http_base.BaseHttpHandler`` — but every
+    model-facing route forwards to the fleet instead of executing
+    locally.  A dead downstream client surfaces as the base class's
+    :class:`~tpuserver._http_base.ClientGone`, which the relay loops
+    use to park resume state instead of blaming a healthy replica."""
 
-    disable_nagle_algorithm = True
+    server_token = b"tpu-triton-router"
 
     @property
     def router(self):
         return self.server.router
-
-    # -- request loop (same framing rules as the replica frontend) ---------
-
-    def handle(self):
-        rfile = self.rfile
-        while True:
-            line = rfile.readline(65537)
-            if not line:
-                return
-            if line in (b"\r\n", b"\n"):
-                continue
-            try:
-                method, target, version = (
-                    line.decode("latin-1").rstrip("\r\n").split(" ", 2)
-                )
-            except ValueError:
-                self._send(400, b'{"error": "malformed request line"}')
-                return
-            raw_headers = {}
-            while True:
-                h = rfile.readline(65537)
-                if h in (b"\r\n", b"\n", b""):
-                    break
-                colon = h.find(b":")
-                if colon > 0:
-                    raw_headers[
-                        h[:colon].decode("latin-1").strip().lower()
-                    ] = h[colon + 1:].decode("latin-1").strip()
-            self.headers = raw_headers
-            self.path = target
-            self._chunked_ok = version != "HTTP/1.0"
-            close = (
-                raw_headers.get("connection", "").lower() == "close"
-                or version == "HTTP/1.0"
-            )
-            self._body = None
-            self._started = False
-            try:
-                if method in ("POST", "GET"):
-                    if method == "POST":
-                        try:
-                            self._read_body()
-                        except (ValueError, OSError, EOFError) as e:
-                            self._send_error_json(
-                                "malformed request body: {}".format(e), 400)
-                            return
-                    self._dispatch(method)
-                else:
-                    self._send(405, b'{"error": "unsupported method"}')
-                    return
-            except (BrokenPipeError, ConnectionResetError, _ClientGone):
-                return
-            if close:
-                return
 
     def _dispatch(self, method):
         try:
@@ -961,90 +894,6 @@ class _RouterHandler(socketserver.StreamRequestHandler):
             if self._started:
                 raise _ClientGone() from e
             self._send_error_json("router error: {}".format(e), 500)
-
-    # -- plumbing ----------------------------------------------------------
-
-    def _read_body(self):
-        if self._body is None:
-            length = int(self.headers.get("content-length", 0))
-            body = self.rfile.read(length) if length else b""
-            encoding = self.headers.get("content-encoding")
-            if encoding == "gzip":
-                import gzip
-
-                body = gzip.decompress(body)
-            elif encoding == "deflate":
-                import zlib
-
-                body = zlib.decompress(body)
-            self._body = body
-        return self._body
-
-    def _send(self, code, body=b"", headers=None,
-              content_type="application/json"):
-        head = (
-            _STATUS_LINE.get(code, _STATUS_LINE[500])
-            + b"Server: tpu-triton-router\r\nContent-Type: "
-            + content_type.encode("latin-1")
-            + b"\r\nContent-Length: "
-            + str(len(body)).encode("latin-1")
-            + b"\r\n"
-        )
-        for key, val in (headers or {}).items():
-            head += (
-                key.encode("latin-1") + b": "
-                + str(val).encode("latin-1") + b"\r\n"
-            )
-        self.wfile.write(head + b"\r\n" + body)
-
-    def _send_json(self, obj, code=200, headers=None):
-        self._send(code, json.dumps(obj).encode("utf-8"), headers)
-
-    def _send_error_json(self, msg, code=400, headers=None):
-        self._send_json({"error": msg}, code, headers)
-
-    def _send_stream_start(self):
-        head = (
-            _STATUS_LINE[200]
-            + b"Server: tpu-triton-router\r\n"
-            + b"Content-Type: text/event-stream"
-        )
-        if self._chunked_ok:
-            head += b"\r\nTransfer-Encoding: chunked\r\n\r\n"
-        else:
-            head += b"\r\nConnection: close\r\n\r\n"
-        try:
-            self.wfile.write(head)
-        except (BrokenPipeError, ConnectionResetError, OSError) as e:
-            # a dead CLIENT socket must not read as an upstream replica
-            # death: raw ConnectionError here would be caught by
-            # _run_generation's upstream-transport handler and mark a
-            # healthy replica unreachable
-            raise _ClientGone() from e
-
-    def _ensure_started(self):
-        if not self._started:
-            self._send_stream_start()
-            self._started = True
-
-    def _emit(self, data):
-        """One SSE block to the client; a dead client raises
-        :class:`_ClientGone` so relay loops can close the upstream
-        (parking the generation for resume) instead of spinning."""
-        try:
-            if self._chunked_ok:
-                data = ("%x\r\n" % len(data)).encode("latin-1") + data + b"\r\n"
-            self.wfile.write(data)
-            self.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError, OSError) as e:
-            raise _ClientGone() from e
-
-    def _end_chunks(self):
-        if self._chunked_ok:
-            try:
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError, OSError) as e:
-                raise _ClientGone() from e
 
     def _forward_headers(self):
         fwd = {}
@@ -1201,9 +1050,9 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                     "relayed)".format(from_seq, gen.gen_id, next_seq), 404)
             self._ensure_started()
             for block in blocks:
-                self._emit(block)
+                self._send_chunk(block)
             if completed:
-                self._emit(b'data: {"final": true}\n\n')
+                self._send_chunk(b'data: {"final": true}\n\n')
                 self._end_chunks()
                 return
             return self._run_generation(gen, resuming=True)
@@ -1257,7 +1106,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
             if outcome == "final":
                 gen.complete()
                 self._ensure_started()
-                self._emit(b'data: {"final": true}\n\n')
+                self._send_chunk(b'data: {"final": true}\n\n')
                 self._end_chunks()
                 return
             if outcome == "error":
@@ -1283,7 +1132,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                         except (ValueError, AttributeError):
                             msg = "upstream failure (status {})".format(
                                 status)
-                        self._emit(b"data: " + json.dumps(
+                        self._send_chunk(b"data: " + json.dumps(
                             {"error": msg}).encode("utf-8") + b"\n\n")
                         self._end_chunks()
                         return
@@ -1319,7 +1168,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 # marker was lost with the replica
                 gen.complete()
                 self._ensure_started()
-                self._emit(b'data: {"final": true}\n\n')
+                self._send_chunk(b'data: {"final": true}\n\n')
                 self._end_chunks()
                 return
             new_rep = (router.pick_replica(exclude={rep.url})
@@ -1351,7 +1200,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 return "final"
             if "error" in payload:
                 self._ensure_started()
-                self._emit(b"data: " + json.dumps(payload).encode("utf-8")
+                self._send_chunk(b"data: " + json.dumps(payload).encode("utf-8")
                            + b"\n\n")
                 return "error"
             backend_seq = (payload.get("parameters") or {}).get("seq")
@@ -1360,14 +1209,14 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                 # passthrough, no replay buffer, no handoff
                 gen.mark_unresumable()
                 self._ensure_started()
-                self._emit(b"data: " + json.dumps(payload).encode("utf-8")
+                self._send_chunk(b"data: " + json.dumps(payload).encode("utf-8")
                            + b"\n\n")
                 continue
             seq, block = gen.record_event(backend_seq, payload)
             if seq is None:
                 continue  # upstream replayed an event the client acked
             self._ensure_started()
-            self._emit(block)
+            self._send_chunk(block)
         return "died"
 
     def _resume_passthrough(self, path, resume_id, resume_from):
@@ -1408,7 +1257,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                     if line.startswith(b"id: ") or line.startswith(
                             b"data: "):
                         self._ensure_started()
-                        self._emit(line + b"\n\n" if line.startswith(
+                        self._send_chunk(line + b"\n\n" if line.startswith(
                             b"data: ") else line + b"\n")
                 # a clean upstream end carries its own final event; a
                 # mid-stream death simply ends the chunked body with no
@@ -1442,7 +1291,7 @@ class _RouterHandler(socketserver.StreamRequestHandler):
         stream started, in-band error event after."""
         self.router.drop_generation(gen.gen_id)
         if self._started:
-            self._emit(b"data: " + json.dumps(
+            self._send_chunk(b"data: " + json.dumps(
                 {"error": message}).encode("utf-8") + b"\n\n")
             self._end_chunks()
             return

@@ -2,18 +2,26 @@
 
 Three layers:
 
-1. **The real gate** — all six rules over ``src/python`` must be clean
-   (modulo the checked-in baseline, which is kept empty).  This is the
-   tier-1 invariant every future PR inherits: guarded fields stay
-   locked, nothing blocks under a lock, deadline math stays monotonic,
-   typed errors stay wire-mapped, threads stay daemon-or-joined, fault
-   points stay registered.
+1. **The real gate** — all eight rules over ``src/python`` + ``tools``
+   must be clean (modulo the checked-in baseline, which is kept
+   empty).  This is the tier-1 invariant every future PR inherits:
+   guarded fields stay locked, nothing blocks under a lock at any call
+   depth, deadline math stays monotonic, typed errors stay
+   wire-mapped, threads stay daemon-or-joined, fault points stay
+   registered, guarded decisions stay inside one critical section, and
+   the router stays protocol-identical to the replica surface it
+   re-serves.
 2. **The fixture suite** — known-bad snippets under
    ``tests/tpulint_fixtures/`` pin each rule's exact ``file:line``
    findings, the suppression comment, and baseline add/expire.
 3. **Doc-drift checks** — the resilience doc's fault table must match
    ``faults.POINTS`` and its stats paragraph must document every
    ``DecodeScheduler.stats()`` key.
+
+Plus the gate's own moving parts: the per-file ModuleInfo cache
+(cold-vs-warm + mtime invalidation), the tier-1 environmental-noise
+ratchet (``tools/t1_noise.py``), and ``tools/check.py
+--changed-only``.
 """
 
 import os
@@ -26,6 +34,7 @@ pytestmark = pytest.mark.lint  # `pytest -m lint` runs just this gate
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+TOOLS = os.path.join(REPO_ROOT, "tools")
 FIXTURES = os.path.join(REPO_ROOT, "tests", "tpulint_fixtures")
 BASELINE = os.path.join(REPO_ROOT, "tools", "tpulint_baseline.txt")
 RESILIENCE_MD = os.path.join(REPO_ROOT, "docs", "resilience.md")
@@ -49,10 +58,11 @@ def _lines(findings):
 # -- layer 1: the real tree is clean -----------------------------------------
 
 
-def test_real_tree_is_clean_under_all_six_rules():
-    """The tier-1 gate: src/python lints clean (empty baseline)."""
+def test_real_tree_is_clean_under_all_rules():
+    """The tier-1 gate: src/python + tools lint clean (empty
+    baseline) under all eight rules — interprocedural ones included."""
     result = lint_paths(
-        [SRC_PY], rules=None, baseline_path=BASELINE,
+        [SRC_PY, TOOLS], rules=None, baseline_path=BASELINE,
         docs_path=RESILIENCE_MD, repo_root=REPO_ROOT)
     assert not result.new, "new tpulint findings:\n" + "\n".join(
         f.render() for f in result.new)
@@ -62,8 +72,42 @@ def test_real_tree_is_clean_under_all_six_rules():
 
 
 def test_every_rule_ran_over_the_real_tree():
-    """All six rules are registered and selected by default."""
-    assert sorted(RULES_BY_ID) == ["R1", "R2", "R3", "R4", "R5", "R6"]
+    """All eight rules are registered and selected by default."""
+    assert sorted(RULES_BY_ID) == [
+        "R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8"]
+
+
+def test_r8_engages_on_the_real_surfaces():
+    """R8 clean must mean "compared and equal", not "never found the
+    surfaces" — pin that extraction sees all three real modules and
+    their protocol facts (a rename that blinds the rule fails HERE,
+    not silently)."""
+    from tpulint import rules_protocol as rp
+    from tpulint.runner import discover, _analyze_cached, _relpath
+
+    mods = [_analyze_cached(p, _relpath(p, REPO_ROOT))
+            for p in discover([SRC_PY])]
+    http = router = grpc = None
+    for m in mods:
+        base = m.relpath.rsplit("/", 1)[-1]
+        if base == "http_frontend.py" and rp._has_route_method(m):
+            http = m
+        elif base == "router.py" and rp._has_route_method(m):
+            router = m
+        elif base == "grpc_frontend.py" and rp.GRPC_MAP_FUNC in m.func_dicts:
+            grpc = m
+    assert http is not None and router is not None and grpc is not None
+    # the facts each comparison keys on are actually extracted
+    assert "/v2/health/stats" in rp._routes(http)
+    assert any("generate_stream" in r for r in rp._routes(http))
+    assert any("generate_stream" in r for r in rp._routes(router))
+    assert rp._sse_id_formats(http) == rp._sse_id_formats(router) != set()
+    assert rp._final_markers(http) == rp._final_markers(router) != set()
+    assert rp._response_params_keys(mods) >= {"generation_id", "seq"}
+    # the status-line map is structurally shared (_http_base) — R8's
+    # per-surface map comparison only re-arms on a re-fork
+    assert rp._status_map_keys(http) is None
+    assert rp._status_map_keys(router) is None
 
 
 def test_exception_twins_are_one_class():
@@ -182,6 +226,108 @@ def test_r6_fault_registry_fixture():
     assert "2 sites" in by_line[("site.py", 10)]
 
 
+def test_r2i_interprocedural_blocking_fixture():
+    """R2i: blocking-ness propagates through the call graph, the
+    annotation escape hatches are honored, and a two-hop AB/BA
+    acquisition split across methods is a cycle."""
+    findings = _lint_fixture("r2i", "R2").new
+    assert _lines(findings) == [17, 35, 54, 58, 82, 110]
+    by_line = {f.lineno: f.message for f in findings}
+    # the witness chain names every hop down to the primitive
+    assert ("self._helper -> self._nap -> time.sleep"
+            in by_line[17])
+    assert "DeepBlock.outer()" in by_line[17]
+    # `# tpulint: blocks` forces a callee the resolver can't see into
+    assert "annotated '# tpulint: blocks'" in by_line[35]
+    # `# tpulint: nonblocking` vouched for _bounded_wait: no finding
+    # for vouched() even though its callee transitively sleeps
+    assert not any("vouched" in f.message for f in findings)
+    # blocking-ness is a whole-graph fixpoint: the _head<->_shim cycle
+    # must flag BOTH entry sites, including blocked(), whose only
+    # callee is the cycle member a per-query memo would have finalized
+    # non-blocking while the cycle head was still open
+    assert ("self._head -> self._sleepy -> time.sleep"
+            in by_line[54])
+    assert ("self._shim -> self._head -> self._sleepy -> time.sleep"
+            in by_line[58])
+    # bare names cross modules ONLY through a `from X import name` in
+    # the caller: the helpers.slow_flush import resolves (and blocks),
+    # while `unrelated` — imported from an UNANALYZED module but
+    # sharing its name with a helpers function — must not bind (a
+    # by-name bind would fabricate the witness chain)
+    assert "slow_flush -> time.sleep" in by_line[82]
+    assert "CrossModule.flush()" in by_line[82]
+    assert not any("clean" in f.message for f in findings)
+    # the cycle needed TWO hops of resolution (ab -> _mid -> _take_b):
+    # one-level resolution could not see it
+    assert "lock-acquisition-order cycle" in by_line[110]
+    assert ("CrossOrder._a -> CrossOrder._b -> CrossOrder._a"
+            in by_line[110])
+
+
+def test_r7_atomicity_fixture():
+    """R7: both torn shapes fire, widened/unrelated critical sections
+    stay clean, and the suppression comment works."""
+    findings = _lint_fixture("r7", "R7").new
+    assert _lines(findings) == [17, 23]
+    by_line = {f.lineno: f.message for f in findings}
+    # shape B anchors at the store computed from the stale snapshot
+    assert "Torn.lost_update()" in by_line[17]
+    assert "_count is read under _lock into 'total'" in by_line[17]
+    assert "computed from it" in by_line[17]
+    # shape A anchors at the re-acquisition inside the stale branch
+    assert "Torn.stale_decision()" in by_line[23]
+    assert "branch guarding the store to '_state' tests it" in by_line[23]
+    # widened_ok / unrelated_ok produced nothing; the suppressed
+    # re-acquisition (line 43) is silenced by its disable comment
+    assert 43 not in _lines(findings)
+
+
+def test_r8_protocol_parity_fixture():
+    """R8: every drift class between the fixture router and the
+    fixture replica surface is a finding — the
+    router-vs-frontend divergence cases the real tree must never
+    grow."""
+    findings = _lint_fixture("r8", "R8").new
+    assert len(findings) == 14
+    router = [f for f in findings if f.path.endswith("r8/router.py")]
+    grpc = [f for f in findings if f.path.endswith("r8/grpc_frontend.py")]
+    http = [f for f in findings if f.path.endswith("r8/http_frontend.py")]
+    assert len(router) == 11 and len(grpc) == 2 and len(http) == 1
+    # surface-level router findings anchor at the route table
+    assert all(f.lineno == 5 for f in router + http)
+    msgs = sorted(f.message for f in router)
+    assert sum("health route" in m for m in msgs) == 2
+    assert any("'/v2/health/live'" in m for m in msgs)
+    assert any("'/v2/health/stats'" in m for m in msgs)
+    assert sum("generate_stream streaming surface" in m
+               for m in msgs) == 1
+    assert sum("verb(s) GET" in m for m in msgs) == 1
+    assert sum("missing code(s) 429, 503" in m for m in msgs) == 1
+    assert sum("SSE id-line format" in m for m in msgs) == 1
+    assert sum("terminal SSE event" in m for m in msgs) == 1
+    assert sum("resume-grammar key" in m for m in msgs) == 2
+    assert sum("'Last-Event-ID'" in m for m in msgs) == 1
+    assert sum("checkpoint" in m for m in msgs) == 1  # producer key
+    # the replica itself can drift from a producer's published grammar
+    assert "checkpoint" in http[0].message
+    # HTTP<->gRPC code-map parity anchors at the gRPC map
+    grpc_msgs = sorted(f.message for f in grpc)
+    assert any("418" in m and "no HTTP status line" in m
+               for m in grpc_msgs)
+    assert any("503" in m and "no gRPC mapping" in m for m in grpc_msgs)
+    assert all(f.lineno == 5 for f in grpc)
+
+
+def test_r8_partial_runs_stay_quiet():
+    """Linting one surface alone skips the comparisons that need its
+    peer (file-scoped runs must not fail on absent modules)."""
+    result = lint_paths(
+        [os.path.join(FIXTURES, "r8", "http_frontend.py")], rules=["R8"],
+        repo_root=REPO_ROOT)
+    assert result.new == []
+
+
 def test_suppression_comment_silences_exactly_its_line():
     # r1/bad.py line 25 carries `# tpulint: disable=R1` on a guarded
     # read; the identical unsuppressed read on line 19 still fires
@@ -220,6 +366,116 @@ def test_baseline_matching_is_multiset():
     assert len(grandfathered) == 1 and len(new) == 2 and not stale
 
 
+# -- the per-file ModuleInfo cache -------------------------------------------
+
+
+def test_module_cache_cold_then_warm():
+    """lint_paths memoizes per-file analysis by (path, mtime, size):
+    the second identical run re-parses NOTHING — the property that
+    keeps tools/check.py and the tier-1 lint tests roughly flat
+    despite the interprocedural pass re-linting the tree."""
+    from tpulint import CACHE_STATS, clear_module_cache
+
+    clear_module_cache()
+    target = os.path.join(FIXTURES, "r1")
+    lint_paths([target], rules=["R1"], repo_root=REPO_ROOT)
+    cold = dict(CACHE_STATS)
+    assert cold["misses"] > 0 and cold["hits"] == 0
+    lint_paths([target], rules=["R1"], repo_root=REPO_ROOT)
+    warm = dict(CACHE_STATS)
+    assert warm["misses"] == cold["misses"], "warm run re-parsed a file"
+    assert warm["hits"] == cold["misses"]
+
+
+def test_module_cache_invalidates_on_file_change(tmp_path):
+    """A changed file (new mtime/size) re-analyzes — stale ModuleInfos
+    must never outlive the bytes they describe."""
+    from tpulint import clear_module_cache
+
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\nimport time\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f():\n    with _lock:\n        time.sleep(1)\n")
+    clear_module_cache()
+    first = lint_paths([str(mod)], rules=["R2"], repo_root=str(tmp_path))
+    assert len(first.new) == 1
+    mod.write_text(
+        "import threading\nimport time\n"
+        "_lock = threading.Lock()\n\n\n"
+        "def f():\n    with _lock:\n        pass\n    time.sleep(1)\n")
+    os.utime(mod, ns=(0, 0))  # distinct stamp even on a fast rewrite
+    second = lint_paths([str(mod)], rules=["R2"], repo_root=str(tmp_path))
+    assert second.new == []
+    clear_module_cache()
+
+
+# -- the tier-1 environmental-noise ratchet ----------------------------------
+
+
+SNAPSHOT = os.path.join(TOOLS, "t1_noise_snapshot.txt")
+
+
+def test_noise_snapshot_is_the_known_environmental_set():
+    """The checked-in snapshot holds exactly the ROADMAP's 9F+7E
+    (cc_tls openssl, llama sharding, tp_served numerics) — growing it
+    needs the same justification as a baseline entry."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import t1_noise
+    finally:
+        sys.path.remove(TOOLS)
+    ids = t1_noise.load_snapshot(SNAPSHOT)
+    assert len(ids) == 16
+    by_file = {}
+    for nodeid in ids:
+        by_file.setdefault(nodeid.split("::")[0], []).append(nodeid)
+    assert sorted(by_file) == [
+        "tests/test_cc_tls.py", "tests/test_llama.py",
+        "tests/test_tp_served_server.py"]
+    assert len(by_file["tests/test_cc_tls.py"]) == 8
+    assert len(by_file["tests/test_llama.py"]) == 6
+    assert len(by_file["tests/test_tp_served_server.py"]) == 2
+
+
+def test_noise_ratchet_fails_only_when_the_set_grows(tmp_path):
+    """The mechanized "don't let it grow" note: a new failure id exits
+    1 naming it; a fixed one exits 0 with a ratchet-down notice; the
+    identical set is quiet.  FAILED<->ERROR flips are not growth."""
+    with open(SNAPSHOT, "r", encoding="utf-8") as fh:
+        known = [ln for ln in fh.read().splitlines()
+                 if ln and not ln.startswith("#")]
+    log = tmp_path / "t1.log"
+
+    def run(lines):
+        log.write_text("\n".join(lines) + "\n")
+        return _run([sys.executable, "tools/t1_noise.py", str(log)])
+
+    same = run(["= short test summary info ="] + known)
+    assert same.returncode == 0, same.stdout + same.stderr
+    assert "no new tier-1 noise" in same.stdout
+
+    grown = run(known + [
+        "FAILED tests/test_new.py::test_regression - AssertionError: x"])
+    assert grown.returncode == 1
+    assert "tests/test_new.py::test_regression" in grown.stderr
+
+    # a module-level collection error has no '::' — an entire broken
+    # test module is growth too
+    collect = run(known + [
+        "ERROR tests/test_broken.py - ImportError: boom"])
+    assert collect.returncode == 1
+    assert "tests/test_broken.py" in collect.stderr
+
+    fixed = run(known[1:])
+    assert fixed.returncode == 0
+    assert "ratchet down" in fixed.stdout
+
+    flipped = run(["ERROR " + known[-1].split(None, 1)[1]]
+                  + known[:-1])
+    assert flipped.returncode == 0, flipped.stdout + flipped.stderr
+
+
 # -- the CLI and the check.py wrapper ----------------------------------------
 
 
@@ -253,10 +509,29 @@ def test_cli_explain():
 
 def test_check_py_wrapper_is_clean():
     """The one-command lint gate (tpulint + optional ruff) passes on
-    the tree; a missing ruff binary is a skip, never a failure."""
+    the tree — its default scope is src/python AND tools; a missing
+    ruff binary is a skip, never a failure."""
     proc = _run([sys.executable, "tools/check.py"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "clean" in proc.stdout
+
+
+def test_check_py_changed_only_mode():
+    """--changed-only (the pre-commit loop) exits clean on the repo:
+    either no lintable diffs from merge-base, or the changed files
+    lint clean — and a broken git never breaks the gate (full-tree
+    fallback, exercised via a bogus GIT_DIR)."""
+    proc = _run([sys.executable, "tools/check.py", "--changed-only",
+                 "--no-ruff"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+    env = dict(os.environ, GIT_DIR=os.path.join(REPO_ROOT, "nonexistent"))
+    proc = subprocess.run(
+        [sys.executable, "tools/check.py", "--changed-only", "--no-ruff"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+        env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "full tree" in proc.stderr
 
 
 # -- layer 3: doc drift ------------------------------------------------------

@@ -1,0 +1,114 @@
+"""R2i fixtures: blocking reached only through the call graph, plus a
+cross-method lock-order cycle no single function exhibits."""
+
+import threading
+import time
+
+from elsewhere import unrelated  # unanalyzed module: never resolves
+from helpers import slow_flush
+
+
+class DeepBlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self._helper()  # blocks two hops down
+
+    def _helper(self):
+        self._nap()
+
+    def _nap(self):
+        time.sleep(0.05)
+
+    def vouched(self):
+        with self._lock:
+            self._bounded_wait()  # clean: callee vouched nonblocking
+
+    # tpulint: nonblocking
+    def _bounded_wait(self):
+        self._nap()
+
+    def forced(self):
+        with self._lock:
+            self._ffi_sleep()  # blocks only via annotation
+
+    # tpulint: blocks
+    def _ffi_sleep(self):
+        pass
+
+
+class OrderPoison:
+    """Call cycle whose blocking source sits past the cycle: _shim's
+    only callee is the cycle head, so a recursive memo evaluated from
+    first() would finalize _shim as non-blocking and miss blocked().
+    The fixpoint must flag BOTH sites regardless of query order."""
+
+    def __init__(self):
+        self._m = threading.Lock()
+        self._n = threading.Lock()
+
+    def first(self):
+        with self._m:
+            self._head()  # blocks via the cycle's escape to _sleepy
+
+    def blocked(self):
+        with self._n:
+            self._shim()  # blocks too — shim -> head -> _sleepy
+
+    def _head(self):
+        self._shim()  # cycle: head -> shim -> head
+        self._sleepy()
+
+    def _shim(self):
+        self._head()
+
+    def _sleepy(self):
+        time.sleep(0.01)
+
+
+class CrossModule:
+    """Bare-name calls resolve across modules ONLY through a matching
+    `from X import name` — helpers.unrelated defines the same name as
+    the unanalyzed import, and binding it by name alone would fabricate
+    a witness chain."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def flush(self):
+        with self._lock:
+            slow_flush()  # imported from analyzed helpers: resolves
+
+    def clean(self):
+        with self._lock:
+            unrelated()  # import source unanalyzed: must stay clean
+
+
+class CrossOrder:
+    """AB/BA deadlock split across methods with a middle hop — invisible
+    to one-level call resolution."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            self._mid()
+
+    def _mid(self):
+        self._take_b()
+
+    def _take_b(self):
+        with self._b:
+            pass
+
+    def ba(self):
+        with self._b:
+            self._take_a()
+
+    def _take_a(self):
+        with self._a:
+            pass

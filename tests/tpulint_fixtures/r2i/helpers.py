@@ -1,0 +1,13 @@
+"""Imported helper module for the cross-module R2i cases."""
+
+import time
+
+
+def slow_flush():
+    time.sleep(0.01)
+
+
+def unrelated():
+    # same bare name as bad.py's `from elsewhere import unrelated`;
+    # without a matching import the resolver must NOT bind here
+    time.sleep(0.01)

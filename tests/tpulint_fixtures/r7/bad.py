@@ -1,0 +1,43 @@
+"""R7 fixtures: check-then-act torn across a lock release."""
+
+import threading
+
+
+class Torn:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0       # guarded-by: _lock
+        self._state = "idle"  # guarded-by: _lock
+
+    def lost_update(self):
+        with self._lock:
+            count = self._count
+        total = count + 1  # compute outside the lock
+        with self._lock:
+            self._count = total  # shape B: store computed from snapshot
+
+    def stale_decision(self):
+        with self._lock:
+            state = self._state
+        if state == "idle":
+            with self._lock:  # shape A: branch tests the snapshot
+                self._state = "stopped"
+
+    def widened_ok(self):
+        with self._lock:
+            count = self._count
+            self._count = count + 1
+
+    def unrelated_ok(self):
+        with self._lock:
+            state = self._state
+        log = state  # snapshot used only for reporting
+        with self._lock:
+            self._count = 0
+        return log
+
+    def suppressed(self):
+        with self._lock:
+            count = self._count
+        with self._lock:
+            self._count = count + 1  # tpulint: disable=R7

@@ -1,0 +1,12 @@
+"""gRPC surface fixture: code map drifted from the HTTP status lines."""
+
+
+def _status_code(code):
+    return {
+        400: 3,
+        404: 5,
+        418: 13,  # no HTTP status line renders 418
+        429: 8,
+        500: 13,
+        # 503 unmapped (and not framing-only)
+    }.get(code, 2)

@@ -1,0 +1,34 @@
+"""Replica-frontend surface fixture for R8 (the reference surface)."""
+
+
+class _Handler:
+    def _route(self, method, path):
+        if method == "GET":
+            if path == "/v2/health/ready":
+                return "ready"
+            if path == "/v2/health/live":
+                return "live"
+            if path == "/v2/health/stats":
+                return "stats"
+        if method == "POST":
+            if path.endswith("/generate_stream"):
+                return self._generate_stream()
+        return None
+
+    def _generate_stream(self):
+        params = {"generation_id": "g", "seq": 0,
+                  "resume_generation_id": "g", "resume_from_seq": 0}
+        header = self.headers.get("Last-Event-ID")
+        sse_id = "id: {}/{}\n".format("g", 0)
+        final = b'data: {"final": true}\n\n'
+        return params, header, sse_id, final
+
+
+_STATUS_LINE = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+}

@@ -1,0 +1,9 @@
+"""Generation producer fixture: publishes a parameter key neither
+surface reads (``checkpoint``)."""
+
+RESPONSE_PARAMS_KEY = "params"
+
+
+def publish(gid, seq):
+    return {RESPONSE_PARAMS_KEY: {"generation_id": gid, "seq": seq,
+                                  "checkpoint": 1}}

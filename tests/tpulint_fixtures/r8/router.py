@@ -1,0 +1,29 @@
+"""Router surface fixture: deliberately drifted from the replica."""
+
+
+class _RouterHandler:
+    def _route(self, method, path):
+        # verb drift: never dispatches GET
+        if method == "POST":
+            if path == "/v2/health/ready":
+                return self._relay()
+            # route drift: health/live + health/stats unserved;
+            # stream drift: no generate_stream surface
+        return None
+
+    def _relay(self):
+        params = {"generation_id": "g", "seq": 0}
+        # resume drift: resume_generation_id / resume_from_seq /
+        # Last-Event-ID never referenced
+        sse_id = "id: {}:{}\n".format("g", 0)  # grammar drift
+        final = b'data: {"done": true}\n\n'  # terminal-event drift
+        return params, sse_id, final
+
+
+_STATUS_LINE = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    # code drift: 429/503 missing — they would relay as a blanket 500
+}

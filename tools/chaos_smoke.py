@@ -147,7 +147,7 @@ def chaos_round(core, model, reference, budget, rnd):
             outcomes[i] = ("err", e)
 
     threads = [
-        threading.Thread(target=worker, args=(i,))
+        threading.Thread(target=worker, args=(i,), daemon=True)
         for i in range(len(PROMPTS))
     ]
     for t in threads:
@@ -306,7 +306,7 @@ def pool_phase(cycles, soak):
                              "{}".format(cycle, type(e).__name__, e))
 
             threads = [
-                threading.Thread(target=worker, args=(soak,))
+                threading.Thread(target=worker, args=(soak,), daemon=True)
                 for _ in range(4)
             ]
             for t in threads:
@@ -440,7 +440,7 @@ def router_phase(cycles, soak, budget):
                 faults.install("http.generate_stream", mode="raise",
                                times=2, skip=3, scope=scope)
             threads = [
-                threading.Thread(target=worker, args=(w, soak, cycle))
+                threading.Thread(target=worker, args=(w, soak, cycle), daemon=True)
                 for w in range(4)
             ]
             for t in threads:
@@ -528,7 +528,7 @@ def kill_loop_phase(rounds, slots, budget):
                 outcomes[i] = ("err", e)
 
         threads = [
-            threading.Thread(target=worker, args=(i,))
+            threading.Thread(target=worker, args=(i,), daemon=True)
             for i in range(len(PROMPTS))
         ]
         for t in threads:

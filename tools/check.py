@@ -1,8 +1,21 @@
 #!/usr/bin/env python
 """One-command lint gate: tpulint + (when available) pyflakes-level ruff.
 
-    python tools/check.py            # what the tier-1 gate runs
-    python tools/check.py --no-ruff  # tpulint only
+    python tools/check.py                 # what the tier-1 gate runs
+    python tools/check.py --no-ruff       # tpulint only
+    python tools/check.py --changed-only  # fast pre-commit loop
+
+The default scope is the library tree AND the operational tooling
+(``src/python`` + ``tools``) — the chaos/perf/router CLIs spawn
+threads and hold deadlines too.
+
+``--changed-only`` lints only the .py files that differ from ``git
+merge-base HEAD main`` (plus untracked ones), for a fast pre-commit
+loop.  The interprocedural rules (R2i call graph, R8 surface parity)
+see only the changed modules in that mode — cross-file findings can
+hide until the full-tree run, so the tier-1 gate always runs the full
+scope.  When git is unavailable (no repo, no ``main``), the flag falls
+back to the full tree with a notice.
 
 tpulint always runs (it ships in-tree).  ruff is optional tooling the
 container may not have: when the binary is missing the ruff step is
@@ -19,26 +32,57 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+TOOLS = os.path.join(REPO_ROOT, "tools")
+DEFAULT_SCOPE = (SRC_PY, TOOLS)
 
 
-def run_tpulint():
+def changed_paths():
+    """Lintable .py files differing from merge-base(HEAD, main), or
+    None when git cannot answer (fall back to the full scope)."""
+    def git(*args):
+        proc = subprocess.run(
+            ["git"] + list(args), cwd=REPO_ROOT, capture_output=True,
+            text=True, timeout=15)
+        if proc.returncode != 0:
+            raise OSError(proc.stderr.strip() or "git failed")
+        return proc.stdout
+
+    try:
+        base = git("merge-base", "HEAD", "main").strip()
+        names = git("diff", "--name-only", base, "--").splitlines()
+        names += git("ls-files", "--others",
+                     "--exclude-standard").splitlines()
+    except (OSError, subprocess.SubprocessError) as e:
+        print("check.py: --changed-only needs git ({}) — linting the "
+              "full tree".format(e), file=sys.stderr)
+        return None
+    scope = tuple(os.path.join(p, "") for p in DEFAULT_SCOPE)
+    out = []
+    for name in sorted(set(names)):
+        path = os.path.join(REPO_ROOT, name)
+        if (name.endswith(".py") and os.path.isfile(path)
+                and path.startswith(scope)):
+            out.append(path)
+    return out
+
+
+def run_tpulint(paths):
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "tools", "tpulint.py"),
-         SRC_PY],
+        [sys.executable, os.path.join(TOOLS, "tpulint.py")] + list(paths),
         cwd=REPO_ROOT,
     )
     return proc.returncode
 
 
-def run_ruff():
+def run_ruff(paths):
     ruff = shutil.which("ruff")
     if ruff is None:
         print("check.py: ruff not installed — skipping the pyflakes "
               "pass (tpulint still gates)", file=sys.stderr)
         return 0
     proc = subprocess.run(
-        [ruff, "check", "--config",
-         os.path.join(REPO_ROOT, "ruff.toml"), SRC_PY],
+        [ruff, "check", "--config", os.path.join(REPO_ROOT, "ruff.toml")]
+        + list(paths),
         cwd=REPO_ROOT,
     )
     return proc.returncode
@@ -46,9 +90,17 @@ def run_ruff():
 
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else argv
-    rc = run_tpulint()
+    paths = list(DEFAULT_SCOPE)
+    if "--changed-only" in argv:
+        changed = changed_paths()
+        if changed is not None:
+            if not changed:
+                print("check.py: no changed python files — clean")
+                return 0
+            paths = changed
+    rc = run_tpulint(paths)
     if "--no-ruff" not in argv:
-        rc = run_ruff() or rc
+        rc = run_ruff(paths) or rc
     if rc == 0:
         print("check.py: clean")
     return rc

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """tpulint CLI — the tier-1 static-analysis gate.
 
-    python tools/tpulint.py [paths...]            # lint (default: src/python)
+    python tools/tpulint.py [paths...]   # lint (default: src/python + tools)
     python tools/tpulint.py --explain R1          # rule documentation
     python tools/tpulint.py --rules R1,R3 src/python/tpuserver
     python tools/tpulint.py --update-baseline     # grandfather current findings
@@ -17,6 +17,11 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC_PY = os.path.join(REPO_ROOT, "src", "python")
+TOOLS = os.path.join(REPO_ROOT, "tools")
+#: The gate's default scope: the library tree AND the operational
+#: tooling (chaos_smoke, perf_analyzer, router CLIs) — tools spawn
+#: threads and hold deadlines too.
+DEFAULT_PATHS = (SRC_PY, TOOLS)
 if SRC_PY not in sys.path:
     sys.path.insert(0, SRC_PY)
 
@@ -33,10 +38,10 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("paths", nargs="*",
                         help="files/directories to lint "
-                             "(default: src/python)")
+                             "(default: src/python + tools)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids/names "
-                             "(default: all six)")
+                             "(default: all eight)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline file of grandfathered findings "
                              "(default: tools/tpulint_baseline.txt; "
@@ -63,7 +68,7 @@ def main(argv=None):
         print((rule.__doc__ or "(no documentation)").strip())
         return 0
 
-    paths = args.paths or [SRC_PY]
+    paths = args.paths or list(DEFAULT_PATHS)
     rules = ([t.strip() for t in args.rules.split(",") if t.strip()]
              if args.rules else None)
     try:
