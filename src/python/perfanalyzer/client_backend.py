@@ -313,12 +313,23 @@ class HttpBackend(ClientBackend):
         finally:
             conn.close()
         self._is_router = True
-        return {
+        out = {
             "failovers": _coerce_int(snap.get("failovers")),
             "handoffs": _coerce_int(snap.get("handoffs")),
             "resumed_streams": _coerce_int(snap.get("resumed_streams")),
             "shed": _coerce_int(snap.get("shed")),
         }
+        supervisor = snap.get("supervisor")
+        if isinstance(supervisor, dict):
+            # the router fronts a supervised fleet: its process-level
+            # healing/scaling counters window-diff exactly like the
+            # router's own (metrics.SUPERVISOR_COUNTERS)
+            for key in ("replica_restarts", "scale_up_events",
+                        "scale_down_events", "retired_replicas"):
+                if key in supervisor:
+                    out["supervisor_" + key] = _coerce_int(
+                        supervisor.get(key))
+        return out
 
     def model_metadata(self, model):
         return self.client.get_model_metadata(model)

@@ -176,6 +176,17 @@ def merge_window_records(windows):
     }
 
 
+#: Fleet-supervisor counters relayed through ``/router/stats`` when a
+#: supervisor is attached — the process-level twin of the router's own
+#: failover/handoff counters.
+SUPERVISOR_COUNTERS = (
+    "supervisor_replica_restarts",
+    "supervisor_scale_up_events",
+    "supervisor_scale_down_events",
+    "supervisor_retired_replicas",
+)
+
+
 def attach_router_delta(result, before, after):
     """Fold a load level's fleet-router counter deltas into a
     :class:`~perfanalyzer.profiler.ProfileResult` as ``router_*``
@@ -187,8 +198,17 @@ def attach_router_delta(result, before, after):
     fleet, so its failover/handoff counters are the server-side twin of
     the client-side ``resumed_streams``: nonzero means replicas were
     dying or shedding under this level even though every request still
-    succeeded."""
+    succeeded.
+
+    When the router fronts a supervised fleet (``tpuserver.fleet``) the
+    snapshot also carries the supervisor's process-level healing
+    counters (``supervisor_replica_restarts`` etc.); those diff the
+    same way — a nonzero per-window delta means whole replica
+    PROCESSES died, scaled, or retired under this level."""
     if before is None or after is None:
         return
     for key in ("failovers", "handoffs", "resumed_streams", "shed"):
         result["router_" + key] = after[key] - before[key]
+    for key in SUPERVISOR_COUNTERS:
+        if key in before and key in after:
+            result[key] = after[key] - before[key]

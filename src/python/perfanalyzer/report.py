@@ -115,13 +115,25 @@ class ReportWriter:
             for r in results:
                 if r.get("router_handoffs") is None:
                     continue  # this level's snapshot transiently failed
-                print("  level {}: router failovers={} handoffs={} "
-                      "resumed_streams={} shed={}".format(
-                          r.get("level"),
-                          r.get("router_failovers"),
-                          r.get("router_handoffs"),
-                          r.get("router_resumed_streams"),
-                          r.get("router_shed")), file=file, flush=True)
+                line = ("  level {}: router failovers={} handoffs={} "
+                        "resumed_streams={} shed={}".format(
+                            r.get("level"),
+                            r.get("router_failovers"),
+                            r.get("router_handoffs"),
+                            r.get("router_resumed_streams"),
+                            r.get("router_shed")))
+                if r.get("supervisor_replica_restarts") is not None:
+                    # a supervised fleet sits behind the router: its
+                    # per-window process-healing counters ride along —
+                    # nonzero means whole replica processes died or
+                    # scaled under this level
+                    line += (" | supervisor restarts={} scale_up={} "
+                             "scale_down={} retired={}".format(
+                                 r.get("supervisor_replica_restarts"),
+                                 r.get("supervisor_scale_up_events"),
+                                 r.get("supervisor_scale_down_events"),
+                                 r.get("supervisor_retired_replicas")))
+                print(line, file=file, flush=True)
 
     def write_csv(self, path, results):
         """Reference-style CSV: one row per load level."""

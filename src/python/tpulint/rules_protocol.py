@@ -36,6 +36,12 @@ surfaces statically and fails on divergence:
   must be used by the router too, and the generation-parameter keys a
   producer publishes under ``core.RESPONSE_PARAMS_KEY`` must be among
   the keys both tiers read.
+- **Admin-surface coverage** — the router's own declared admin routes
+  (``ROUTER_ADMIN_ROUTES``: ``/router/stats``, ``/router/replicas``)
+  must all be served, and the membership route must reference both
+  ``add`` and ``remove`` verbs: the fleet supervisor and ops tooling
+  drive elastic scaling and planned replacement through exactly this
+  surface, so a dropped route or verb silently strands them.
 
 Surfaces are identified by module basename (``http_frontend.py`` /
 ``router.py`` / ``grpc_frontend.py``) *and* shape: the HTTP surfaces
@@ -69,6 +75,14 @@ RESUME_HEADER = "last-event-id"
 
 HEALTH_PREFIX = "/v2/health/"
 STREAM_ROUTE_TOKEN = "generate_stream"
+
+#: The router's declared admin surface.  Every route here must be
+#: served by the real router module; ``/router/replicas`` must also
+#: reference both membership actions — the fleet supervisor
+#: (``tpuserver.fleet``) and ops tooling key on exactly this contract.
+ROUTER_ADMIN_ROUTES = ("/router/stats", "/router/replicas")
+MEMBERSHIP_ROUTE = "/router/replicas"
+MEMBERSHIP_ACTIONS = ("add", "remove")
 
 
 def _has_route_method(mod):
@@ -179,6 +193,8 @@ class ProtocolParityRule:
                 grpc_mod = grpc_mod or mod
 
         findings = []
+        if router_mod is not None:
+            findings.extend(self._check_admin_surface(router_mod))
         if http_mod is not None and router_mod is not None:
             findings.extend(self._check_router_parity(http_mod, router_mod))
             findings.extend(self._check_resume_grammar(
@@ -186,6 +202,34 @@ class ProtocolParityRule:
         if http_mod is not None and grpc_mod is not None:
             findings.extend(self._check_code_parity(
                 modules, http_mod, grpc_mod))
+        return findings
+
+    # -- the router's own admin surface ------------------------------------
+
+    def _check_admin_surface(self, router_mod):
+        findings = []
+        anchor = self._route_anchor(router_mod)
+        routes = _routes(router_mod)
+        lits = _str_constants(router_mod)
+        for route in ROUTER_ADMIN_ROUTES:
+            if route not in routes:
+                findings.append(Finding(
+                    self.id, self.name, router_mod.relpath, anchor,
+                    "router does not serve its declared admin route "
+                    "'{}' — the fleet supervisor and ops tooling key "
+                    "on the admin surface".format(route),
+                ))
+        if MEMBERSHIP_ROUTE in routes:
+            for action in MEMBERSHIP_ACTIONS:
+                if action not in lits:
+                    findings.append(Finding(
+                        self.id, self.name, router_mod.relpath, anchor,
+                        "router serves '{}' but never references "
+                        "membership action '{}' — add/remove are the "
+                        "route's contract (elastic scaling and planned "
+                        "replacement drive it)".format(
+                            MEMBERSHIP_ROUTE, action),
+                    ))
         return findings
 
     # -- router vs replica frontend ----------------------------------------

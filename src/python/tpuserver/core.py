@@ -922,8 +922,12 @@ class InferenceServer:
         sub-second probe cadence across a fleet costs nothing.  Shape::
 
             {"state": "ready", "ready": true, "inflight": 3,
-             "max_inflight": 64,
+             "max_inflight": 64, "pid": 4242,
              "models": {"llama_generate": {<DecodeScheduler.stats()>}}}
+
+        ``pid`` identifies the serving *process*: a fleet supervisor
+        restarting replicas at a stable address can tell a healed
+        process from a survivor without tracking anything else.
 
         ``models`` maps each registered model to its scheduler stats
         dict (``None`` for models with no scheduler, or before first
@@ -945,6 +949,7 @@ class InferenceServer:
             "ready": self.server_ready(),
             "inflight": inflight,
             "max_inflight": max_inflight,
+            "pid": os.getpid(),
             "models": models,
         }
 

@@ -1,0 +1,649 @@
+"""Fleet supervisor: process-level replica healing and elastic scaling.
+
+PR 5 made the scheduler self-heal *inside* a process (supervised decode
+loop) and the fleet router routes *around* a dead replica — but nothing
+brought a replica *back*: a SIGKILL'd server process was gone forever
+and the router's replica set was frozen at construction.
+:class:`FleetSupervisor` lifts the supervised-restart pattern from
+thread granularity (``DecodeScheduler._supervise``) to **process**
+granularity — the reference survey's multi-process coordination role
+(SURVEY §2.2/§5) applied to the serving tier — so the *fleet* becomes
+the unit that survives, not any single replica:
+
+1. **Ownership.**  The supervisor spawns N replica server processes
+   from one command template (per-replica port, fault scope, index),
+   fronts them with a :class:`~tpuserver.router.FleetRouter`, and keeps
+   the router's live membership in sync: a replica joins the routing
+   set only once its ``/v2/health/stats`` probe reports ready, and
+   leaves it *before* the supervisor touches the process.
+2. **Liveness.**  Two signals, both necessary: process exit (SIGKILL,
+   crash, OOM) restarts immediately; an alive-but-unhealthy process —
+   tripped scheduler (restart budget exhausted inside the process) or
+   a wedge (consecutive probe failures while the process runs) — gets
+   a **SIGTERM drain first** (the replica's ``install_sigterm_drain``
+   path finishes in-flight generations; the router's cross-replica
+   splice absorbs the rest), then SIGKILL past the grace window.
+3. **Restart budget.**  Restarts per replica are bounded by
+   ``max_restarts`` inside ``restart_window_s`` with exponential
+   backoff between attempts; a replica that exhausts the budget is
+   **retired** — the fleet degrades deterministically instead of
+   flapping, exactly like the in-process scheduler's sticky trip.
+4. **Elastic scaling.**  The supervisor reads each replica's scheduler
+   utilization from the same health snapshot the router probes
+   (``pending/max_pending``, ``live_streams/max_slots``) and scales the
+   replica count between ``min_replicas``/``max_replicas`` with
+   hysteresis: only *sustained* spill pressure scales up, only
+   *sustained* idleness drains one replica down, a middle-band reading
+   resets both streaks, and a cooldown follows every action — a single
+   noisy window can never flap the fleet.
+
+``tools/fleet.py`` is the CLI (and the default replica entry point);
+``tools/chaos_smoke.py --fleet`` soaks SIGKILL-mid-traffic healing;
+docs/resilience.md "Fleet supervisor & elastic scaling" has the full
+semantics.
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from tpuserver.router import FleetRouter
+
+__all__ = ["FleetSupervisor", "ReplicaProcess"]
+
+
+def _free_port(host):
+    """Ask the kernel for a free port.  The tiny bind-to-spawn race is
+    accepted: replica servers fail fast on a taken port and the restart
+    budget absorbs the retry."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def _fetch_health(host, port, timeout_s):
+    """One ``/v2/health/stats`` probe, or None when unreachable —
+    the same snapshot (and the same cheapness argument) as the
+    router's prober."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        conn.request("GET", "/v2/health/stats")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return None
+        return json.loads(resp.read())
+    except (OSError, ValueError, http.client.HTTPException):
+        return None
+    finally:
+        conn.close()
+
+
+def _snapshot_utilization(snap):
+    """A replica's load factor in ``[0, 1]`` from its health snapshot:
+    the max of every scheduler's slot and admission-queue occupancy
+    (sustained ``pending`` pressure == spill — the scale-up signal),
+    falling back to the server-wide in-flight ratio for replicas with
+    no scheduler-backed model."""
+    if not isinstance(snap, dict):
+        return 0.0
+    util = 0.0
+    seen_scheduler = False
+    for stats in (snap.get("models") or {}).values():
+        if not isinstance(stats, dict):
+            continue
+        seen_scheduler = True
+        slots = stats.get("max_slots") or 0
+        if slots:
+            util = max(util, float(stats.get("live_streams") or 0) / slots)
+        pending_cap = stats.get("max_pending") or 0
+        if pending_cap:
+            util = max(
+                util, float(stats.get("pending") or 0) / pending_cap)
+    if not seen_scheduler:
+        cap = snap.get("max_inflight") or 0
+        if cap:
+            util = float(snap.get("inflight") or 0) / cap
+    return min(1.0, util)
+
+
+def _snapshot_tripped(snap):
+    """Whether any model's scheduler reports a sticky trip (in-process
+    restart budget exhausted): the replica is alive but will never
+    serve again without a process restart."""
+    if not isinstance(snap, dict):
+        return False
+    return any(
+        isinstance(stats, dict) and stats.get("tripped")
+        for stats in (snap.get("models") or {}).values()
+    )
+
+
+class ReplicaProcess:
+    """One supervised replica: the OS process, its address, and the
+    healing state machine (``starting`` → ``up`` → ``stopping`` /
+    ``backoff`` → … → ``retired``).  All mutable state is owned by the
+    supervisor's monitor thread; readers go through :meth:`stats`."""
+
+    def __init__(self, index, host, port, scope):
+        self.index = index
+        self.host = host
+        self.port = port
+        self.scope = scope
+        self.url = "{}:{}".format(host, port)
+        self._lock = threading.Lock()
+        self.proc = None           # guarded-by: _lock
+        self.state = "starting"    # guarded-by: _lock
+        self.in_router = False     # guarded-by: _lock
+        self.restarts = 0          # guarded-by: _lock
+        self.started_at = 0.0      # guarded-by: _lock
+        self.stop_deadline = 0.0   # guarded-by: _lock
+        self.spawn_at = 0.0        # guarded-by: _lock
+        self.probe_failures = 0    # guarded-by: _lock
+        self.last_util = 0.0       # guarded-by: _lock
+        self.scale_down = False    # guarded-by: _lock
+        # restart timestamps inside the sliding budget window
+        self.restart_times = deque()  # guarded-by: _lock
+
+    def pid(self):
+        with self._lock:
+            return self.proc.pid if self.proc is not None else None
+
+    def stats(self):
+        with self._lock:
+            return {
+                "index": self.index,
+                "url": self.url,
+                "scope": self.scope,
+                "state": self.state,
+                "pid": self.proc.pid if self.proc is not None else None,
+                "restarts": self.restarts,
+                "in_router": self.in_router,
+                "utilization": round(self.last_util, 4),
+            }
+
+
+class FleetSupervisor:
+    """Own N replica server processes end-to-end and front them with a
+    dynamically-membered :class:`~tpuserver.router.FleetRouter`.
+
+    Parameters
+    ----------
+    command : list[str]
+        argv template for one replica process; ``{port}``, ``{scope}``
+        and ``{index}`` are substituted per spawn (see
+        ``tools/fleet.py --serve-replica`` for the default server).
+    replicas / min_replicas / max_replicas
+        Initial process count and the elastic-scaling bounds.
+    probe_interval_s / probe_timeout_s
+        Monitor cadence and per-probe timeout.
+    start_timeout_s
+        How long a spawned replica may stay not-ready (warmup compiles
+        included) before the start counts as a failed restart.
+    drain_grace_s
+        SIGTERM-to-SIGKILL window for planned restarts and scale-down
+        (the replica's ``install_sigterm_drain`` drains inside it).
+    max_restarts / restart_window_s / restart_backoff_s
+        Per-replica restart budget (sliding window) and the exponential
+        backoff base between attempts; budget exhausted ⇒ retired.
+    unhealthy_after
+        Consecutive failed probes of a live process that count as a
+        wedge (a booted replica that stops answering without exiting).
+    scale_high / scale_low
+        Fleet-mean utilization thresholds (hysteresis band edges).
+    scale_up_windows / scale_down_windows
+        Consecutive monitor ticks the signal must persist before a
+        scaling action fires; a middle-band tick resets both streaks.
+    scale_cooldown_s
+        Dead time after any scaling action (and any restart) before the
+        next one may fire — boot transients never read as pressure.
+    router_kwargs
+        Extra :class:`FleetRouter` construction kwargs (e.g.
+        ``probe_interval_s``, ``max_inflight``, ``port``).
+    env
+        Extra environment for replica processes (merged over
+        ``os.environ``).
+    """
+
+    def __init__(self, command, replicas=2, min_replicas=1,
+                 max_replicas=None, host="127.0.0.1",
+                 probe_interval_s=0.5, probe_timeout_s=2.0,
+                 start_timeout_s=120.0, drain_grace_s=10.0,
+                 max_restarts=5, restart_window_s=60.0,
+                 restart_backoff_s=0.2, unhealthy_after=3,
+                 scale_high=0.85, scale_low=0.10,
+                 scale_up_windows=3, scale_down_windows=6,
+                 scale_cooldown_s=2.0, scope_prefix="fleet-r",
+                 router_kwargs=None, env=None, verbose=False):
+        if replicas < 1:
+            raise ValueError("a fleet needs at least one replica")
+        if min_replicas < 1 or (max_replicas is not None
+                                and max_replicas < min_replicas):
+            raise ValueError(
+                "need 1 <= min_replicas <= max_replicas (got {}..{})"
+                .format(min_replicas, max_replicas))
+        if not (0.0 <= scale_low < scale_high <= 1.0):
+            raise ValueError(
+                "hysteresis band must satisfy 0 <= scale_low < "
+                "scale_high <= 1 (got {}..{})".format(
+                    scale_low, scale_high))
+        self._command = list(command)
+        self._host = host
+        self._min_replicas = int(min_replicas)
+        self._max_replicas = (int(max_replicas)
+                              if max_replicas is not None else None)
+        self._probe_interval_s = float(probe_interval_s)
+        self._probe_timeout_s = float(probe_timeout_s)
+        self._start_timeout_s = float(start_timeout_s)
+        self._drain_grace_s = float(drain_grace_s)
+        self._max_restarts = int(max_restarts)
+        self._restart_window_s = float(restart_window_s)
+        self._restart_backoff_s = float(restart_backoff_s)
+        self._unhealthy_after = int(unhealthy_after)
+        self._scale_high = float(scale_high)
+        self._scale_low = float(scale_low)
+        self._scale_up_windows = int(scale_up_windows)
+        self._scale_down_windows = int(scale_down_windows)
+        self._scale_cooldown_s = float(scale_cooldown_s)
+        self._scope_prefix = scope_prefix
+        self._env = dict(env or {})
+        self._verbose = verbose
+        self._lock = threading.Lock()
+        # the managed set; retired handles stay (visible in stats) but
+        # are skipped by every healing/scaling path
+        # guarded-by: _lock
+        self._handles = []
+        self._next_index = 0       # guarded-by: _lock
+        self._restarts_total = 0   # guarded-by: _lock
+        self._scale_ups = 0        # guarded-by: _lock
+        self._scale_downs = 0      # guarded-by: _lock
+        self._retired = 0          # guarded-by: _lock
+        self._up_streak = 0
+        self._down_streak = 0
+        self._cooldown_until = 0.0
+        self._stop = threading.Event()
+        self._monitor = None
+        for _ in range(int(replicas)):
+            self._register_handle()
+        self.router = FleetRouter(
+            [h.url for h in self._handles_snapshot()],
+            **dict(router_kwargs or {}))
+        self.router.attach_supervisor(self.stats)
+        # the initial handles ARE the router's constructed membership;
+        # record that so a replica dying before its first ready probe
+        # still leaves the routing set instead of lingering as a stale
+        # member
+        for handle in self._handles_snapshot():
+            with handle._lock:
+                handle.in_router = True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _register_handle(self):
+        """Allocate a port + scope and register a fresh handle (called
+        from __init__ and scale-up)."""
+        port = _free_port(self._host)
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+            handle = ReplicaProcess(
+                index, self._host, port,
+                "{}{}".format(self._scope_prefix, index))
+            self._handles.append(handle)
+        return handle
+
+    def _handles_snapshot(self):
+        with self._lock:
+            return list(self._handles)
+
+    def start(self):
+        for handle in self._handles_snapshot():
+            self._spawn(handle)
+        self.router.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="fleet-supervisor",
+            daemon=True)
+        self._monitor.start()
+        return self
+
+    def stop(self, drain_timeout_s=None):
+        """Stop the fleet: SIGTERM every live replica (drain-first),
+        SIGKILL whatever outlives the grace window, stop the router."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=10)
+            self._monitor = None
+        grace = (self._drain_grace_s if drain_timeout_s is None
+                 else drain_timeout_s)
+        handles = self._handles_snapshot()
+        for handle in handles:
+            self._signal(handle, signal.SIGTERM)
+        deadline = time.monotonic() + grace
+        for handle in handles:
+            self._reap(handle, deadline - time.monotonic())
+        self.router.stop()
+
+    def wait_ready(self, count=None, timeout_s=60.0):
+        """Block until ``count`` replicas (default: every non-retired
+        one) are up and routed; returns True on success."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            stats = self.stats()
+            want = count if count is not None else sum(
+                1 for r in stats["replicas"] if r["state"] != "retired")
+            if sum(1 for r in stats["replicas"]
+                   if r["state"] == "up") >= want:
+                return True
+            if self._stop.wait(0.05):
+                return False
+        return False
+
+    # -- process plumbing --------------------------------------------------
+
+    def _log(self, msg):
+        if self._verbose:
+            print("[fleet-supervisor] " + msg, file=sys.stderr,
+                  flush=True)
+
+    def _spawn(self, handle):
+        argv = [
+            t.format(port=handle.port, scope=handle.scope,
+                     index=handle.index)
+            for t in self._command
+        ]
+        env = dict(os.environ)
+        env.update(self._env)
+        try:
+            proc = subprocess.Popen(argv, env=env)
+        except OSError as e:
+            self._log("spawn of replica {} failed: {}".format(
+                handle.url, e))
+            proc = None
+        now = time.monotonic()
+        with handle._lock:
+            handle.proc = proc
+            handle.state = "starting"
+            handle.started_at = now
+            handle.probe_failures = 0
+        self._log("spawned replica {} (pid {})".format(
+            handle.url, proc.pid if proc else "-"))
+
+    def _signal(self, handle, signum):
+        with handle._lock:
+            proc = handle.proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            proc.send_signal(signum)
+        except (ProcessLookupError, OSError):
+            pass
+
+    def _reap(self, handle, timeout_s):
+        with handle._lock:
+            proc = handle.proc
+        if proc is None:
+            return
+        try:
+            proc.wait(timeout=max(0.0, timeout_s))
+        except subprocess.TimeoutExpired:
+            try:
+                proc.kill()
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _leave_router(self, handle):
+        with handle._lock:
+            was_member = handle.in_router
+            handle.in_router = False
+        if not was_member:
+            return
+        try:
+            self.router.remove_replica(handle.url)
+        except KeyError:
+            pass
+
+    def _join_router(self, handle):
+        with handle._lock:
+            if handle.in_router:
+                return
+            handle.in_router = True
+        try:
+            self.router.add_replica(handle.url)
+        except ValueError:
+            pass  # already a member (initial membership)
+
+    # -- healing -----------------------------------------------------------
+
+    def _begin_restart(self, handle, reason, drain):
+        """Take a replica out of rotation and (drain-)stop its process;
+        the monitor finishes the restart once the process exits."""
+        self._log("restarting replica {} ({}{})".format(
+            handle.url, reason, ", drain-first" if drain else ""))
+        self._leave_router(handle)
+        now = time.monotonic()
+        with handle._lock:
+            handle.state = "stopping"
+            handle.stop_deadline = now + (self._drain_grace_s
+                                          if drain else 0.0)
+        if drain:
+            self._signal(handle, signal.SIGTERM)
+        else:
+            self._signal(handle, signal.SIGKILL)
+
+    def _finish_stop(self, handle, now):
+        """The process is gone: either drop it (scale-down), retire it
+        (budget exhausted), or schedule the respawn with backoff."""
+        with handle._lock:
+            scale_down = handle.scale_down
+        if scale_down:
+            with self._lock:
+                if handle in self._handles:
+                    self._handles.remove(handle)
+            self._log("scale-down of replica {} complete".format(
+                handle.url))
+            return
+        with handle._lock:
+            window = handle.restart_times
+            while window and now - window[0] > self._restart_window_s:
+                window.popleft()
+            if len(window) >= self._max_restarts:
+                handle.state = "retired"
+                retired = True
+            else:
+                window.append(now)
+                handle.restarts += 1
+                handle.state = "backoff"
+                handle.spawn_at = now + self._restart_backoff_s * (
+                    2 ** max(0, len(window) - 1))
+                retired = False
+        with self._lock:
+            if retired:
+                self._retired += 1
+            else:
+                self._restarts_total += 1
+        if retired:
+            self._log(
+                "replica {} exhausted its restart budget ({} in {}s) — "
+                "retired; the fleet degrades, it does not flap".format(
+                    handle.url, self._max_restarts,
+                    self._restart_window_s))
+
+    # -- the monitor -------------------------------------------------------
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self._probe_interval_s):
+            try:
+                self._tick()
+            except Exception as e:  # noqa: BLE001 — the supervisor
+                # must outlive any single bad tick (a dying monitor
+                # would silently end all healing)
+                self._log("monitor tick failed: {}".format(e))
+
+    def _tick(self):
+        now = time.monotonic()
+        utils = []
+        for handle in self._handles_snapshot():
+            with handle._lock:
+                state = handle.state
+                proc = handle.proc
+                stop_deadline = handle.stop_deadline
+                spawn_at = handle.spawn_at
+                started_at = handle.started_at
+            if state == "retired":
+                continue
+            exited = proc is None or proc.poll() is not None
+            if state == "stopping":
+                if exited:
+                    self._finish_stop(handle, now)
+                elif now >= stop_deadline:
+                    self._signal(handle, signal.SIGKILL)
+                continue
+            if state == "backoff":
+                if now >= spawn_at:
+                    self._spawn(handle)
+                continue
+            if exited:
+                # unplanned death (SIGKILL, crash, OOM): there is
+                # nothing left to drain — restart immediately
+                self._leave_router(handle)
+                self._finish_stop(handle, now)
+                continue
+            snap = _fetch_health(handle.host, handle.port,
+                                 self._probe_timeout_s)
+            if snap is None:
+                with handle._lock:
+                    handle.probe_failures += 1
+                    failures = handle.probe_failures
+                if state == "starting":
+                    if now - started_at > self._start_timeout_s:
+                        self._begin_restart(
+                            handle, "never became ready", drain=False)
+                elif failures >= self._unhealthy_after:
+                    # alive but not answering: a wedge — drain what can
+                    # still drain, then replace the process
+                    self._begin_restart(handle, "wedged", drain=True)
+                continue
+            with handle._lock:
+                handle.probe_failures = 0
+                handle.last_util = _snapshot_utilization(snap)
+                utils.append((handle, handle.last_util))
+            if _snapshot_tripped(snap):
+                self._begin_restart(
+                    handle, "scheduler tripped", drain=True)
+                continue
+            if snap.get("ready"):
+                if state == "starting":
+                    with handle._lock:
+                        handle.state = "up"
+                    self._join_router(handle)
+                    self._log("replica {} is up".format(handle.url))
+                    # boot is not a utilization signal; let the
+                    # cooldown absorb the membership change
+                    self._cooldown_until = max(
+                        self._cooldown_until,
+                        now + self._scale_cooldown_s)
+            elif (state == "starting"
+                    and now - started_at > self._start_timeout_s):
+                # answers probes but never reports ready: the start
+                # failed just as surely as a dead socket — without
+                # this branch such a replica would sit in 'starting'
+                # forever (probes succeed, so neither the timeout-on-
+                # unreachable nor the wedge path can fire).  The
+                # process is alive: drain what can drain.
+                self._begin_restart(
+                    handle, "never became ready", drain=True)
+        self._evaluate_scaling(
+            [u for h, u in utils if h.stats()["state"] == "up"], now)
+
+    # -- elastic scaling ---------------------------------------------------
+
+    def _evaluate_scaling(self, utils, now):
+        if not utils:
+            return
+        fleet_util = sum(utils) / len(utils)
+        if fleet_util >= self._scale_high:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif fleet_util <= self._scale_low:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            # the hysteresis band: a noisy middle window resets both
+            # streaks — scaling only ever fires on SUSTAINED signal
+            self._up_streak = 0
+            self._down_streak = 0
+        if now < self._cooldown_until:
+            return
+        states = [h.stats()["state"] for h in self._handles_snapshot()]
+        if any(s in ("starting", "backoff", "stopping") for s in states):
+            # the fleet is still SETTLING from a previous action (a
+            # spawn booting, a drain in flight, a respawn pending):
+            # the utilization mean does not yet reflect that decision,
+            # so acting again would double-fire — e.g. a scale-up's
+            # replica boots slower than the streak re-accumulates
+            return
+        active = [h for h in self._handles_snapshot()
+                  if h.stats()["state"] != "retired"]
+        if (self._up_streak >= self._scale_up_windows
+                and (self._max_replicas is None
+                     or len(active) < self._max_replicas)):
+            self._up_streak = 0
+            self._cooldown_until = now + self._scale_cooldown_s
+            with self._lock:
+                self._scale_ups += 1
+            handle = self._register_handle()
+            self._log(
+                "scale-up: fleet utilization {:.2f} sustained — "
+                "spawning replica {}".format(fleet_util, handle.url))
+            self._spawn(handle)
+        elif (self._down_streak >= self._scale_down_windows
+                and len(active) > self._min_replicas):
+            self._down_streak = 0
+            self._cooldown_until = now + self._scale_cooldown_s
+            ups = [h for h in active if h.stats()["state"] == "up"]
+            if not ups:
+                return
+            # drain the least-loaded, youngest replica
+            victim = min(
+                ups, key=lambda h: (h.stats()["utilization"], -h.index))
+            with self._lock:
+                self._scale_downs += 1
+            with victim._lock:
+                victim.scale_down = True
+            self._log(
+                "scale-down: fleet utilization {:.2f} sustained — "
+                "draining replica {}".format(fleet_util, victim.url))
+            self._begin_restart(victim, "scale-down", drain=True)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self):
+        """Counters + per-replica state; the flat counter names are
+        what ``/router/stats`` (and with it the perf tooling's
+        ``router_snapshot`` window diffs) carry."""
+        with self._lock:
+            out = {
+                "replica_restarts": self._restarts_total,
+                "scale_up_events": self._scale_ups,
+                "scale_down_events": self._scale_downs,
+                "retired_replicas": self._retired,
+                "min_replicas": self._min_replicas,
+                "max_replicas": self._max_replicas,
+            }
+            handles = list(self._handles)
+        out["replicas"] = [h.stats() for h in handles]
+        out["up"] = sum(1 for r in out["replicas"] if r["state"] == "up")
+        return out

@@ -45,15 +45,29 @@ replica, load-balancing N backends with four robustness behaviors:
 A **plain** ``tritonclient.http`` client pointed at the router gets all
 of this for free — resume included — with no ``EndpointPool``.  The
 router's own surface adds ``/router/stats`` (failover/handoff/shed
-counters + per-replica routing state) for perf tooling and ops.
+counters + per-replica routing state) and ``/router/replicas`` (the
+membership admin surface: list / add / remove) for perf tooling, ops,
+and the fleet supervisor (``tpuserver.fleet``).
+
+**Dynamic membership.**  The replica set is live state, not a
+construction-time constant: :meth:`FleetRouter.add_replica` joins a
+replica (its prober spins up, traffic routes to it once a probe sees
+it ready) and :meth:`FleetRouter.remove_replica` retires one
+mid-flight.  Removal keeps sticky state honest: a removed replica's
+homed generations never route to the dead address again — a resume
+either hands off (handoff-capable streams re-admit prompt + history on
+a live replica) or answers a typed 404.  Every forwarding loop
+snapshots the membership once per request, so a concurrent removal can
+neither skew an attempt budget nor index into a mutated list.
 
 Run one with ``python tools/router.py --backends a:8000,b:8000``; see
 docs/resilience.md "Fleet router" for the full semantics and
-``tools/chaos_smoke.py --router`` for the soak.
+``tools/chaos_smoke.py --router`` / ``--fleet`` for the soaks.
 """
 
 import http.client
 import json
+import random
 import re
 import socket
 import socketserver
@@ -61,6 +75,7 @@ import sys
 import threading
 import time
 import uuid
+import zlib
 from collections import OrderedDict
 
 from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
@@ -120,6 +135,19 @@ def _coerce_int(value, default=0):
         return int(value)
     except (TypeError, ValueError):
         return default
+
+
+def _probe_phase(url, interval_s):
+    """Deterministic per-replica phase offset in ``[0, interval_s)``
+    for the health prober.
+
+    Probers created together (router start, a supervisor's fleet-wide
+    restart or scale-up) would otherwise all fire on the same cadence
+    from the same instant — a synchronized probe storm landing on
+    just-booted replicas every ``interval_s``.  Hashing the replica url
+    spreads the phases across the whole interval, and stays stable
+    across router restarts so the spread never collapses."""
+    return (zlib.crc32(url.encode("utf-8")) % 4096) / 4096.0 * interval_s
 
 
 def _snapshot_signals(snap):
@@ -209,6 +237,10 @@ class _Replica:
         self.url = url
         self.host = host
         self.port = int(port)
+        # set on remove_replica: the prober loop exits and routing
+        # state is latched ineligible (a re-added url gets a FRESH
+        # _Replica — no breaker/score carryover by construction)
+        self.removed = threading.Event()
         self._lock = threading.Lock()
         # optimistic until the first probe lands, like the pool's
         # endpoints — a router must be able to serve before its first
@@ -240,6 +272,15 @@ class _Replica:
         leave rotation to the prober's readiness signal."""
         with self._lock:
             self._failures += 1
+
+    def retire(self):
+        """The replica left the membership: stop its prober and latch
+        it ineligible so an in-flight request holding a stale snapshot
+        never picks it again."""
+        self.removed.set()
+        with self._lock:
+            self._eligible = False
+            self._snapshot = None
 
     def begin_request(self):
         with self._lock:
@@ -293,6 +334,9 @@ class _Generation:
         # a re-admitted generation restarts backend numbering at 0)
         self._offset = 0        # guarded-by: _lock
         self._home = None       # guarded-by: _lock
+        # the home was REMOVED from the membership (vs never assigned):
+        # a resume must never dial it again — hand off or typed-404
+        self._home_lost = False  # guarded-by: _lock
         self._completed = False  # guarded-by: _lock
         # one serving connection at a time: a fast reconnect waits for
         # the previous relay to notice its dead client  # guarded-by: _lock
@@ -374,8 +418,18 @@ class _Generation:
         handed-off generation is a FRESH admission on its new home)."""
         with self._lock:
             self._home = url
+            self._home_lost = False
             if rebase:
                 self._offset = len(self._events)
+
+    def home_removed(self, url):
+        """The membership dropped ``url``: if it was this generation's
+        home, forget the address (resumes must hand off or fail typed,
+        never dial a removed replica)."""
+        with self._lock:
+            if self._home == url and not self._completed:
+                self._home = None
+                self._home_lost = True
 
     def complete(self):
         with self._lock:
@@ -389,6 +443,7 @@ class _Generation:
         with self._lock:
             return {
                 "home": self._home,
+                "home_lost": self._home_lost,
                 "seq": len(self._events),
                 "offset": self._offset,
                 "completed": self._completed,
@@ -490,9 +545,15 @@ class FleetRouter:
         if len(set(backends)) != len(backends):
             raise ValueError(
                 "FleetRouter backends must be unique: {}".format(backends))
+        self._replicas_lock = threading.Lock()
+        # live membership: add_replica/remove_replica mutate it while
+        # requests are in flight, so every consumer goes through
+        # _replicas_snapshot()  # guarded-by: _replicas_lock
         self._replicas = [_Replica(url) for url in backends]
-        self._policy = RetryPolicy(
-            max_attempts=max(2, len(self._replicas)))
+        # the policy is only the failure classifier here (classify /
+        # should_failover are stateless); attempt budgets are sized
+        # per request from the membership snapshot
+        self._policy = RetryPolicy(max_attempts=max(2, len(backends)))
         self._probe_interval_s = float(probe_interval_s)
         self._probe_timeout_s = float(probe_timeout_s)
         self._max_inflight = max_inflight
@@ -515,7 +576,11 @@ class FleetRouter:
         self._httpd = _RouterServer((host, port), _RouterHandler)
         self._httpd.router = self
         self._thread = None
-        self._probers = []
+        self._started = False    # guarded-by: _replicas_lock
+        self._probers = []       # guarded-by: _replicas_lock
+        # optional fleet-supervisor stats hook: folded into /router/
+        # stats so perf tooling sees restart/scale counters per window
+        self._supervisor_stats = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -539,14 +604,11 @@ class FleetRouter:
         # one persistent prober thread per replica: a black-holed peer
         # costs its own probe_timeout_s without stalling anyone else's
         # cadence, and no per-round thread churn
-        self._probers = [
-            threading.Thread(
-                target=self._probe_loop_one, args=(rep,),
-                name="fleet-router-prober", daemon=True)
-            for rep in self._replicas
-        ]
-        for t in self._probers:
-            t.start()
+        with self._replicas_lock:
+            self._started = True
+            replicas = list(self._replicas)
+        for rep in replicas:
+            self._spawn_prober(rep)
         return self
 
     def stop(self):
@@ -556,9 +618,89 @@ class FleetRouter:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
-        for t in self._probers:
+        with self._replicas_lock:
+            self._started = False
+            probers, self._probers = self._probers, []
+        for t in probers:
             t.join(timeout=5)
-        self._probers = []
+
+    def _spawn_prober(self, rep):
+        thread = threading.Thread(
+            target=self._probe_loop_one, args=(rep,),
+            name="fleet-router-prober", daemon=True)
+        with self._replicas_lock:
+            # prune exited probers (removed replicas) so membership
+            # churn — a supervisor healing and scaling for days —
+            # cannot grow the list without bound
+            self._probers = [t for t in self._probers if t.is_alive()]
+            self._probers.append(thread)
+        thread.start()
+
+    # -- membership --------------------------------------------------------
+
+    def _replicas_snapshot(self):
+        """The membership at one instant.  Every request-scoped loop
+        works off ONE snapshot so a concurrent add/remove cannot skew
+        its attempt budget or index into a mutated list."""
+        with self._replicas_lock:
+            return list(self._replicas)
+
+    def add_replica(self, url):
+        """Join ``url`` to the live membership.  The replica is probed
+        once synchronously (outside the lock — a dead address must not
+        stall routing) so it either enters with real state or starts
+        rotated-out until its prober sees it ready.  Raises
+        ``ValueError`` on a malformed or duplicate url."""
+        rep = _Replica(url)  # validates host:port
+        snap = self._fetch_snapshot(rep)
+        if snap is None:
+            rep.mark_unreachable()
+        else:
+            rep.update_snapshot(snap)
+        with self._replicas_lock:
+            if any(r.url == url for r in self._replicas):
+                raise ValueError(
+                    "replica {} is already a member".format(url))
+            self._replicas.append(rep)
+            started = self._started
+        if started:
+            self._spawn_prober(rep)
+        self._log("membership: added replica {}".format(url))
+        return rep.stats()
+
+    def remove_replica(self, url):
+        """Retire ``url`` from the live membership.  Its prober exits,
+        in-flight snapshots see it latched ineligible, and every
+        generation homed on it forgets the address — a later resume
+        hands off (handoff-capable) or answers typed-404, never dials
+        the removed replica.  Raises ``KeyError`` when ``url`` is not a
+        member."""
+        with self._replicas_lock:
+            for i, rep in enumerate(self._replicas):
+                if rep.url == url:
+                    del self._replicas[i]
+                    break
+            else:
+                raise KeyError(
+                    "replica {} is not a member".format(url))
+        rep.retire()
+        with self._lock:
+            gens = [gen for gen, _ in self._gens.values()]
+        for gen in gens:
+            gen.home_removed(url)
+        self._log("membership: removed replica {}".format(url))
+        return rep.stats()
+
+    def membership(self):
+        """The admin view of the replica set (``/router/replicas``)."""
+        return [rep.stats() for rep in self._replicas_snapshot()]
+
+    def attach_supervisor(self, stats_fn):
+        """Register a fleet supervisor's ``stats()`` callable: its
+        restart/scale counters ride ``/router/stats`` so the perf
+        tooling that already diffs router counters per window sees
+        process-level healing too."""
+        self._supervisor_stats = stats_fn
 
     # -- health probing ----------------------------------------------------
 
@@ -566,7 +708,7 @@ class FleetRouter:
         """One synchronous probe of every replica (the pre-serving round
         :meth:`start` runs, so routing decisions begin from real state —
         an already-draining replica never sees even the first request)."""
-        for rep in self._replicas:
+        for rep in self._replicas_snapshot():
             snap = self._fetch_snapshot(rep)
             if snap is None:
                 rep.mark_unreachable()
@@ -574,14 +716,24 @@ class FleetRouter:
                 rep.update_snapshot(snap)
 
     def _probe_loop_one(self, rep):
-        while not self._stop.wait(self._probe_interval_s):
+        # phase-staggered cadence: a fleet-wide restart or scale-up
+        # creates many probers at the same instant; without per-replica
+        # jitter they would synchronize into probe storms against
+        # just-booted replicas every interval
+        interval = self._probe_interval_s
+        rng = random.Random(zlib.crc32(rep.url.encode("utf-8")))
+        if self._stop.wait(_probe_phase(rep.url, interval)):
+            return
+        while not (self._stop.is_set() or rep.removed.is_set()):
             snap = self._fetch_snapshot(rep)
-            if self._stop.is_set():
+            if self._stop.is_set() or rep.removed.is_set():
                 return
             if snap is None:
                 rep.mark_unreachable()
             else:
                 rep.update_snapshot(snap)
+            if self._stop.wait(interval * rng.uniform(0.9, 1.1)):
+                return
 
     def _fetch_snapshot(self, rep):
         conn = http.client.HTTPConnection(
@@ -599,15 +751,20 @@ class FleetRouter:
 
     # -- routing -----------------------------------------------------------
 
-    def pick_replica(self, exclude=()):
+    def pick_replica(self, exclude=(), replicas=None):
         """The least-loaded eligible replica (ties break on backend
         order), or — when nothing is eligible — the least-failed
         ineligible one as a last resort, so a fleet whose probes all
         failed transiently still self-heals instead of hard-failing
-        every request.  ``exclude`` holds urls already tried."""
+        every request.  ``exclude`` holds urls already tried;
+        ``replicas`` lets a request-scoped loop pick from its own
+        membership snapshot.  A removed replica is never picked, even
+        from a stale snapshot."""
         eligible, fallback = [], []
-        for idx, rep in enumerate(self._replicas):
-            if rep.url in exclude:
+        if replicas is None:
+            replicas = self._replicas_snapshot()
+        for idx, rep in enumerate(replicas):
+            if rep.url in exclude or rep.removed.is_set():
                 continue
             ok, load = rep.routable()
             (eligible if ok else fallback).append((load, idx, rep))
@@ -617,13 +774,13 @@ class FleetRouter:
         return None
 
     def replica_by_url(self, url):
-        for rep in self._replicas:
+        for rep in self._replicas_snapshot():
             if rep.url == url:
                 return rep
         return None
 
     def any_routable(self):
-        return any(rep.routable()[0] for rep in self._replicas)
+        return any(rep.routable()[0] for rep in self._replicas_snapshot())
 
     # -- router-level admission valve --------------------------------------
 
@@ -727,7 +884,14 @@ class FleetRouter:
                 "resumed_streams": self._resumed,
                 "generations": len(self._gens),
             }
-        out["replicas"] = [rep.stats() for rep in self._replicas]
+        out["replicas"] = [rep.stats() for rep in self._replicas_snapshot()]
+        stats_fn = self._supervisor_stats
+        if stats_fn is not None:
+            try:
+                out["supervisor"] = stats_fn()
+            except Exception:  # noqa: BLE001 — observability must not
+                # take the routing surface down with a dying supervisor
+                out["supervisor"] = None
         return out
 
     def health_snapshot(self):
@@ -769,9 +933,13 @@ class FleetRouter:
         will not blindly re-execute.  Returns
         ``(status, headers, body)``."""
         deadline = _request_deadline(body, headers)
+        # ONE membership snapshot per logical request: a concurrent
+        # remove_replica must not shrink the attempt budget mid-loop
+        # or hand the loop a list whose indices shifted under it
+        replicas = self._replicas_snapshot()
         tried = set()
         last_response = None
-        for _ in range(max(1, 2 * len(self._replicas))):
+        for _ in range(max(1, 2 * len(replicas))):
             timeout_s = self._read_timeout_s
             if deadline is not None:
                 remaining = deadline - time.monotonic()
@@ -783,7 +951,7 @@ class FleetRouter:
                 # replica that accepted the connection and then wedged
                 # must not hold the request past its own deadline
                 timeout_s = min(timeout_s, remaining)
-            rep = self.pick_replica(exclude=tried)
+            rep = self.pick_replica(exclude=tried, replicas=replicas)
             if rep is None:
                 break
             tried.add(rep.url)
@@ -837,7 +1005,9 @@ class FleetRouter:
         request lands on one missing the side effect)."""
         first_bad = None
         last_ok = None
-        for rep in self._replicas:
+        for rep in self._replicas_snapshot():
+            if rep.removed.is_set():
+                continue
             try:
                 response = self._upstream_once(
                     rep, method, path, body, headers, self._read_timeout_s)
@@ -916,6 +1086,8 @@ class _RouterHandler(BaseHttpHandler):
             return self._send_json(router.health_snapshot())
         if path == "/router/stats":
             return self._send_json(router.stats())
+        if path == "/router/replicas":
+            return self._route_replicas_admin(method)
         if not (path == "/v2" or path.startswith("/v2/")):
             return self._send_error_json("unknown endpoint: " + path, 404)
         if not router.enter_inflight():
@@ -945,6 +1117,42 @@ class _RouterHandler(BaseHttpHandler):
             return self._send(status, resp_body, relay, content_type)
         finally:
             router.exit_inflight()
+
+    # -- membership admin surface ------------------------------------------
+
+    def _route_replicas_admin(self, method):
+        """``/router/replicas``: GET lists the live membership; POST
+        ``{"action": "add"|"remove", "url": "host:port"}`` mutates it —
+        the surface the fleet supervisor (and ops) drives elastic
+        scaling and planned replacement through."""
+        router = self.router
+        if method == "GET":
+            return self._send_json({"replicas": router.membership()})
+        if method != "POST":
+            return self._send_error_json(
+                "/router/replicas supports GET and POST only", 400)
+        try:
+            request = json.loads(self._read_body() or b"{}")
+            action = request.get("action")
+            url = request.get("url")
+        except (ValueError, AttributeError):
+            return self._send_error_json(
+                "malformed /router/replicas request: JSON object with "
+                "'action' and 'url' required", 400)
+        if action not in ("add", "remove") or not isinstance(url, str):
+            return self._send_error_json(
+                "bad membership request: action must be 'add' or "
+                "'remove' with a 'url' string", 400)
+        try:
+            if action == "add":
+                router.add_replica(url)
+            else:
+                router.remove_replica(url)
+        except (ValueError, KeyError) as e:
+            # KeyError reprs its argument with quotes; unwrap
+            msg = e.args[0] if e.args else str(e)
+            return self._send_error_json(str(msg), 400)
+        return self._send_json({"replicas": router.membership()})
 
     # -- streaming: sticky resume + cross-replica handoff ------------------
 
@@ -1048,6 +1256,20 @@ class _RouterHandler(BaseHttpHandler):
                 return self._send_error_json(
                     "resume point {} is beyond generation '{}' ({} events "
                     "relayed)".format(from_seq, gen.gen_id, next_seq), 404)
+            snapshot = gen.snapshot()
+            if (not completed and snapshot["home_lost"]
+                    and not snapshot["handoff_capable"]
+                    and gen.emitted() > 0):
+                # the home replica was REMOVED from the membership and
+                # the stream cannot be reconstructed elsewhere: fail
+                # typed before the response starts — a partial replay
+                # with no continuation would only masquerade as a live
+                # stream (and the dead address is never dialed)
+                router.drop_generation(gen.gen_id)
+                return self._send_error_json(
+                    "generation '{}' was homed on a replica that was "
+                    "removed from the fleet and is not handoff-capable"
+                    .format(gen.gen_id), 404)
             self._ensure_started()
             for block in blocks:
                 self._send_chunk(block)
@@ -1066,16 +1288,60 @@ class _RouterHandler(BaseHttpHandler):
         and has already replayed any client-acked prefix."""
         router = self.router
         snapshot = gen.snapshot()
+        rep = None
         if resuming and snapshot["home"] is not None:
             rep = router.replica_by_url(snapshot["home"])
+        if resuming and rep is not None:
             body, headers = gen.upstream_request(resuming=True)
+        elif resuming and (snapshot["home_lost"]
+                           or snapshot["home"] is not None):
+            # the home replica LEFT THE MEMBERSHIP (remove_replica
+            # latched home_lost, or it vanished between the snapshot
+            # and the lookup): the dead address is never dialed again.
+            # A handoff-capable stream re-admits its emitted history on
+            # a live replica; anything else answers typed-404 — unless
+            # nothing was ever delivered, where re-routing the original
+            # admission cannot duplicate tokens.
+            handoff_body = gen.handoff_request()
+            if handoff_body is None:
+                if gen.emitted() == 0 and not self._started:
+                    rep = router.pick_replica()
+                    body, headers = gen.upstream_request(resuming=False)
+                    if rep is not None:
+                        gen.set_home(rep.url)
+                    resuming = False
+                else:
+                    return self._stream_fail(
+                        gen,
+                        "generation '{}' was homed on a replica that was "
+                        "removed from the fleet and is not "
+                        "handoff-capable".format(gen.gen_id), status=404)
+            elif handoff_body == b"":
+                # every token already reached the client; only the
+                # terminal marker went down with the removed replica
+                gen.complete()
+                self._ensure_started()
+                self._send_chunk(b'data: {"final": true}\n\n')
+                self._end_chunks()
+                return
+            else:
+                rep = router.pick_replica()
+                if rep is None:
+                    return self._stream_fail(
+                        gen, "no replica available to hand off generation "
+                             "'{}'".format(gen.gen_id))
+                router.count_handoff()
+                gen.set_home(rep.url, rebase=True)
+                body = handoff_body
+                headers = {"Content-Type": "application/json"}
+                resuming = False
         else:
             rep = router.pick_replica()
             body, headers = gen.upstream_request(resuming=False)
             if rep is not None:
                 gen.set_home(rep.url)
         attempts = 0
-        max_attempts = 2 * len(router._replicas) + 2
+        max_attempts = 2 * len(router._replicas_snapshot()) + 2
         while True:
             attempts += 1
             if rep is None or attempts > max_attempts:
@@ -1229,10 +1495,11 @@ class _RouterHandler(BaseHttpHandler):
         body = self._read_body()
         headers = self._forward_headers()
         headers["Last-Event-ID"] = "{}/{}".format(resume_id, resume_from - 1)
+        replicas = router._replicas_snapshot()
         tried = set()
         last_status = None
-        for _ in range(len(router._replicas)):
-            rep = router.pick_replica(exclude=tried)
+        for _ in range(len(replicas)):
+            rep = router.pick_replica(exclude=tried, replicas=replicas)
             if rep is None:
                 break
             tried.add(rep.url)
@@ -1286,13 +1553,15 @@ class _RouterHandler(BaseHttpHandler):
             "unknown generation '{}' and no replica holds it".format(
                 resume_id), 404)
 
-    def _stream_fail(self, gen, message):
-        """Terminal router-side stream failure: typed 503 before the
-        stream started, in-band error event after."""
+    def _stream_fail(self, gen, message, status=503):
+        """Terminal router-side stream failure: typed (503 by default,
+        404 for unresumable-after-removal) before the stream started,
+        in-band error event after."""
         self.router.drop_generation(gen.gen_id)
         if self._started:
             self._send_chunk(b"data: " + json.dumps(
                 {"error": message}).encode("utf-8") + b"\n\n")
             self._end_chunks()
             return
-        self._send_error_json(message, 503, {"Retry-After": 1})
+        headers = {"Retry-After": 1} if status == 503 else None
+        self._send_error_json(message, status, headers)

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Stub replica for fleet-supervisor tests: a pure-stdlib process that
+speaks just enough of the replica health surface to be supervised.
+
+Boots in ~100ms (no jax import), serves ``/v2/health/stats`` with an
+injectable scheduler-utilization snapshot, and honors the drain-first
+contract: SIGTERM flips the snapshot to ``draining``, appends a
+``drain`` marker line to ``--marker`` (how tests prove a planned
+restart SIGTERMed before any SIGKILL), and exits cleanly after
+``--drain-s``.
+
+Control surface (what tests poke):
+
+    POST /stub/state {"pending": 16}         # scheduler counters
+    POST /stub/state {"tripped": true}       # alive-but-tripped
+    POST /stub/state {"wedged": true}        # stop answering probes
+
+``--ttl S`` makes the process exit nonzero after S seconds — the
+always-crashing replica that exhausts a restart budget.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--scope", default="stub")
+    ap.add_argument("--drain-s", type=float, default=0.1)
+    ap.add_argument("--marker", default="")
+    ap.add_argument("--ttl", type=float, default=0.0,
+                    help="exit 1 after this many seconds (0 = never)")
+    ap.add_argument("--never-ready", action="store_true",
+                    help="answer probes but report ready=false forever "
+                         "(a start that never completes)")
+    args = ap.parse_args()
+
+    lock = threading.Lock()
+    state = {"state": "starting" if args.never_ready else "ready",
+             "ready": not args.never_ready, "wedged": False}
+    model = {
+        "live_streams": 0, "pending": 0, "max_slots": 4,
+        "max_pending": 16, "tripped": False, "draining": False,
+        "closed": False, "healthy": True, "restarts": 0,
+        "quarantined": 0, "replay_entries": 0,
+    }
+
+    def snapshot():
+        with lock:
+            return {
+                "state": state["state"],
+                "ready": state["ready"] and not model["tripped"],
+                "inflight": 0,
+                "max_inflight": None,
+                "pid": os.getpid(),
+                "models": {"stub": dict(model)},
+            }
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, obj, code=200):
+            body = json.dumps(obj).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            with lock:
+                wedged = state["wedged"]
+            if wedged:
+                time.sleep(60)  # probe times out: the wedge signal
+                return
+            if self.path == "/v2/health/stats":
+                return self._json(snapshot())
+            if self.path == "/v2/health/live":
+                return self._json({})
+            if self.path == "/v2/health/ready":
+                with lock:
+                    ready = state["ready"]
+                return self._json({}, 200 if ready else 503)
+            self._json({"error": "unknown: " + self.path}, 404)
+
+        def do_POST(self):
+            if self.path != "/stub/state":
+                return self._json({"error": "unknown: " + self.path}, 404)
+            length = int(self.headers.get("Content-Length") or 0)
+            update = json.loads(self.rfile.read(length) or b"{}")
+            with lock:
+                for key, val in update.items():
+                    if key in model:
+                        model[key] = val
+                    else:
+                        state[key] = val
+            self._json(snapshot())
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    httpd.daemon_threads = True
+
+    def on_sigterm(signum, frame):
+        with lock:
+            state["state"] = "draining"
+            state["ready"] = False
+        if args.marker:
+            with open(args.marker, "a") as fh:
+                fh.write("drain\n")
+        # drain window, then a clean exit (what install_sigterm_drain
+        # does on a real replica, compressed)
+        threading.Timer(args.drain_s, lambda: os._exit(0)).start()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    if args.ttl > 0:
+        threading.Timer(args.ttl, lambda: os._exit(1)).start()
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    print("stub replica [{}] on 127.0.0.1:{} pid {}".format(
+        args.scope, args.port, os.getpid()), flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,7 @@ CASES = [
     ("simple_grpc_custom_repeat.py", "grpc", []),
     ("simple_http_pool_failover.py", "http", ["-n", "24"]),
     ("simple_http_router.py", "http", []),
+    ("simple_fleet.py", "http", []),
     ("simple_http_shm_client.py", "http", []),
     ("simple_grpc_shm_client.py", "grpc", []),
     ("simple_http_shm_string_client.py", "http", []),

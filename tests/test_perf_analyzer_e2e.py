@@ -182,6 +182,76 @@ def test_cli_generation_through_router_reports_handoffs():
     assert "router failovers=" in result.stdout  # the table footer
 
 
+def test_cli_through_supervised_fleet_surfaces_restart_counters():
+    """Drive the CLI through a FleetSupervisor-owned router while one
+    replica PROCESS is SIGKILLed mid-run: the run completes (exit 0)
+    and the report rows + table footer carry the supervisor's
+    per-window process-healing counters next to the router's."""
+    import signal as _signal
+
+    from tpuserver.fleet import FleetSupervisor
+
+    command = [
+        sys.executable, os.path.join(REPO, "tools", "fleet.py"),
+        "--serve-replica", "--port", "{port}", "--scope", "{scope}",
+        "--models", "simple",
+    ]
+    supervisor = FleetSupervisor(
+        command, replicas=2, min_replicas=2, max_replicas=2,
+        probe_interval_s=0.15, probe_timeout_s=5.0, unhealthy_after=20,
+        start_timeout_s=120.0, drain_grace_s=5.0,
+        max_restarts=6, restart_window_s=3600.0,
+        restart_backoff_s=0.05, scope_prefix="pa-fleet-r",
+        router_kwargs={"probe_interval_s": 0.1},
+        env={"PYTHONPATH": os.path.join(REPO, "src", "python"),
+             "JAX_PLATFORMS": "cpu"},
+    ).start()
+    try:
+        assert supervisor.wait_ready(timeout_s=120)
+
+        def kill_one():
+            time.sleep(1.6)  # lands inside the level's windows
+            ups = [r for r in supervisor.stats()["replicas"]
+                   if r["state"] == "up" and r["pid"]]
+            if ups:
+                os.kill(ups[-1]["pid"], _signal.SIGKILL)
+
+        killer = threading.Thread(target=kill_one, daemon=True)
+        killer.start()
+        result, rows = _run_cli([
+            "-m", "simple", "--backend", "http",
+            "-u", supervisor.router.url,
+            "--concurrency-range", "2",
+            "--measurement-interval", "600", "--max-trials", "8",
+            "--warmup", "0.5",
+        ])
+        killer.join(timeout=30)
+        # give the supervisor time to notice before asserting on it
+        deadline = time.monotonic() + 60
+        while (supervisor.stats()["replica_restarts"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        stats = supervisor.stats()
+    finally:
+        supervisor.stop()
+    assert result.returncode == 0, result.stdout + result.stderr
+    assert len(rows) == 1
+    row = rows[0]
+    # the row carries BOTH counter families, per window: the router's
+    # absorption counters and the supervisor's process healing.  (A
+    # mid-request SIGKILL may surface one typed 502 by design — this
+    # test pins the counters, not zero-error unary semantics.)
+    for key in ("router_failovers", "router_handoffs",
+                "supervisor_replica_restarts",
+                "supervisor_scale_up_events",
+                "supervisor_scale_down_events",
+                "supervisor_retired_replicas"):
+        assert key in row and row[key] is not None, (key, row)
+    assert stats["replica_restarts"] >= 1  # the SIGKILL was healed
+    assert stats["retired_replicas"] == 0
+    assert "supervisor restarts=" in result.stdout  # table footer
+
+
 class _Reader:
     """Drains a pipe on a thread; flags when the settings banner (the
     'measurement is underway' cue) has been printed."""

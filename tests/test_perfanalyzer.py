@@ -544,3 +544,33 @@ def test_generation_profiler_reports_resumed_streams():
     assert result["resumed_streams"] == result["generations"]
     assert result["resume_events"] == result["resumed_streams"]
     assert result["errors"] == 0
+
+
+def test_attach_router_delta_diffs_supervisor_counters():
+    """Supervisor process-healing counters window-diff exactly like the
+    router's own — and only when BOTH snapshots carry them (a
+    supervisor attached mid-run must not fabricate a delta)."""
+    from perfanalyzer.metrics import attach_router_delta
+
+    base = {"failovers": 1, "handoffs": 0, "resumed_streams": 2,
+            "shed": 0}
+    before = dict(base, supervisor_replica_restarts=1,
+                  supervisor_scale_up_events=0,
+                  supervisor_scale_down_events=0,
+                  supervisor_retired_replicas=0)
+    after = dict(base, failovers=4, supervisor_replica_restarts=3,
+                 supervisor_scale_up_events=1,
+                 supervisor_scale_down_events=0,
+                 supervisor_retired_replicas=0)
+    result = {}
+    attach_router_delta(result, before, after)
+    assert result["router_failovers"] == 3
+    assert result["supervisor_replica_restarts"] == 2
+    assert result["supervisor_scale_up_events"] == 1
+    assert result["supervisor_scale_down_events"] == 0
+    assert result["supervisor_retired_replicas"] == 0
+    # plain-router snapshots (no supervisor attached): no fabricated keys
+    result = {}
+    attach_router_delta(result, dict(base), dict(base, shed=2))
+    assert result["router_shed"] == 2
+    assert "supervisor_replica_restarts" not in result

@@ -680,6 +680,225 @@ def test_handoff_marks_id_lines_and_marked_resume_strips(fleet,
         conn.close()
 
 
+# -- dynamic membership (ISSUE 9) ---------------------------------------------
+
+
+def test_probe_jitter_spreads_phases():
+    """Per-replica prober phases are deterministic, inside one probe
+    interval, and SPREAD across it — a fleet-wide restart (supervisor
+    scale-up, rolling restart) can never synchronize its probers into
+    storms against just-booted replicas."""
+    from tpuserver.router import _probe_phase
+
+    urls = ["127.0.0.1:{}".format(8000 + i) for i in range(16)]
+    phases = [_probe_phase(u, 1.0) for u in urls]
+    assert all(0.0 <= p < 1.0 for p in phases)
+    assert len(set(phases)) == 16  # distinct per replica
+    assert max(phases) - min(phases) > 0.25  # genuinely staggered
+    # deterministic (restart-stable) and interval-proportional
+    assert _probe_phase(urls[0], 1.0) == phases[0]
+    assert _probe_phase(urls[0], 4.0) == pytest.approx(4.0 * phases[0])
+
+
+def test_add_replica_while_request_in_flight(fleet):
+    """Membership grows live through /router/replicas: a slow request
+    in flight during the add is untouched, the attempt budget it
+    snapshotted stays coherent, and the new replica starts serving."""
+    import tritonclient.http as httpclient
+
+    url_a, url_b = fleet["backends"]
+    router = FleetRouter([url_a], probe_interval_s=0.1).start()
+    try:
+        done = []
+
+        def slow():
+            c = httpclient.InferenceServerClient(router.url)
+            try:
+                in0 = httpclient.InferInput("INPUT0", [4], "INT32")
+                in0.set_data_from_numpy(np.arange(4, dtype=np.int32))
+                d = httpclient.InferInput("DELAY_US", [1], "UINT32")
+                d.set_data_from_numpy(np.array([300000], dtype=np.uint32))
+                c.infer("delayed_identity", [in0, d])
+                done.append(True)
+            finally:
+                c.close()
+
+        t = threading.Thread(target=slow, daemon=True)
+        t.start()
+        assert _wait_until(lambda: router.stats()["inflight"] >= 1)
+        host, _, port = router.url.rpartition(":")
+        conn = http_client.HTTPConnection(host, int(port), timeout=10)
+        try:
+            conn.request(
+                "POST", "/router/replicas",
+                body=json.dumps({"action": "add", "url": url_b}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        assert {r["url"] for r in body["replicas"]} == {url_a, url_b}
+        t.join(timeout=10)
+        assert done == [True]  # the in-flight request never noticed
+        # the joined replica takes traffic: load url_a and check the
+        # next request lands on url_b
+        assert _wait_until(lambda: next(
+            r["eligible"] for r in router.stats()["replicas"]
+            if r["url"] == url_b))
+        before_b = next(r["requests"] for r in router.stats()["replicas"]
+                        if r["url"] == url_b)
+        t2 = threading.Thread(target=slow, daemon=True)
+        t2.start()
+        try:
+            assert _wait_until(lambda: any(
+                r["load"] > 0 for r in router.stats()["replicas"]))
+            client = httpclient.InferenceServerClient(router.url)
+            try:
+                in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+                in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+                in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+                in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+                client.infer("simple", [in0, in1])
+            finally:
+                client.close()
+        finally:
+            t2.join(timeout=10)
+        after_b = next(r["requests"] for r in router.stats()["replicas"]
+                       if r["url"] == url_b)
+        assert after_b >= before_b + 1
+    finally:
+        router.stop()
+
+
+def test_remove_home_replica_hands_off_capable_stream(fleet,
+                                                      reference_tokens):
+    """Removing the home replica of a live sticky generation: the
+    resume NEVER dials the removed address — a handoff-capable stream
+    re-admits prompt + history on a remaining replica and completes
+    token-identical with continuous seqs."""
+    router = FleetRouter(fleet["backends"], probe_interval_s=0.1,
+                         gen_ttl_s=30.0).start()
+    try:
+        body = _stream_body("t-member-remove")
+        conn, resp = _open_stream(router.url, body)
+        try:
+            head, finished = _read_events(resp, limit=3)
+            assert not finished and len(head) == 3
+        finally:
+            conn.close()
+        home = router.generation_snapshot("t-member-remove")["home"]
+        assert home in fleet["backends"]
+        handoffs_before = router.stats()["handoffs"]
+        router.remove_replica(home)
+        snap = router.generation_snapshot("t-member-remove")
+        assert snap["home"] is None and snap["home_lost"] is True
+        conn, resp = _open_stream(
+            router.url, body, last_event_id="t-member-remove/2")
+        try:
+            tail, finished = _read_events(resp)
+            assert finished
+        finally:
+            conn.close()
+        assert _tokens_of(head) + _tokens_of(tail) == reference_tokens
+        seqs = [ev["parameters"]["seq"] for ev in head + tail]
+        assert seqs == list(range(N_TOK))
+        assert router.stats()["handoffs"] > handoffs_before
+        new_home = router.generation_snapshot("t-member-remove")["home"]
+        assert new_home in fleet["backends"] and new_home != home
+    finally:
+        router.stop()
+
+
+def test_remove_home_replica_is_typed_404_when_not_handoff_capable(fleet):
+    """The other half of removal semantics: a generation that cannot be
+    reconstructed elsewhere (no PROMPT_IDS contract) answers resumes
+    with a typed 404 after its home leaves — never a dial of the dead
+    address, never a silent token gap."""
+    from tpuserver.router import _Generation
+
+    url_b = fleet["backends"][1]
+    router = FleetRouter(fleet["backends"], probe_interval_s=60.0).start()
+    try:
+        gen = _Generation("t-removed-404", STREAM_PATH, {"inputs": []})
+        assert router.register_generation(gen, if_absent=True)
+        gen.record_event(0, {"outputs": []})  # relayed, no TOKEN
+        gen.set_home(url_b)
+        router.remove_replica(url_b)
+        conn, resp = _open_stream(router.url, _stream_body(),
+                                  last_event_id="t-removed-404/0")
+        try:
+            assert resp.status == 404
+            err = json.loads(resp.read())["error"]
+            assert "removed from the fleet" in err
+            assert "not handoff-capable" in err
+        finally:
+            conn.close()
+    finally:
+        router.stop()
+
+
+def test_remove_then_readd_same_url_resets_replica_state(fleet):
+    """Remove-then-re-add of the same url is a FRESH membership entry:
+    no request/failure-counter or eligibility carryover from the
+    previous incarnation."""
+    import tritonclient.http as httpclient
+
+    url_a, url_b = fleet["backends"]
+    router = FleetRouter(fleet["backends"], probe_interval_s=0.1).start()
+    try:
+        client = httpclient.InferenceServerClient(router.url)
+        try:
+            in0 = httpclient.InferInput("INPUT0", [16], "INT32")
+            in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
+            in1 = httpclient.InferInput("INPUT1", [16], "INT32")
+            in1.set_data_from_numpy(np.ones(16, dtype=np.int32))
+            # accrue routing state on url_b's incarnation (deterministic
+            # white-box: sequential routed requests tie-break to url_a)
+            rep_b = router.replica_by_url(url_b)
+            rep_b.begin_request()
+            rep_b.end_request()
+            rep_b.note_typed_failure()
+            old = next(r for r in router.stats()["replicas"]
+                       if r["url"] == url_b)
+            assert old["requests"] >= 1 and old["failures"] >= 1
+            router.remove_replica(url_b)
+            assert {r["url"] for r in router.stats()["replicas"]} == {
+                url_a}
+            # re-add: a fresh _Replica, probed on entry
+            router.add_replica(url_b)
+            fresh = next(r for r in router.stats()["replicas"]
+                         if r["url"] == url_b)
+            assert fresh["requests"] == 0 and fresh["failures"] == 0
+            assert fresh["eligible"] is True  # sync probe saw it ready
+            client.infer("simple", [in0, in1])  # and it serves
+            # prober bookkeeping stays bounded under membership churn:
+            # the re-add pruned exited prober threads instead of
+            # accumulating one entry per historical membership
+            assert len(router._probers) <= 3
+        finally:
+            client.close()
+        # duplicate add and unknown remove are typed 400s on the wire
+        host, _, port = router.url.rpartition(":")
+        for payload, needle in (
+                ({"action": "add", "url": url_b}, "already a member"),
+                ({"action": "remove", "url": "1.2.3.4:1"}, "not a member"),
+                ({"action": "recycle", "url": url_b}, "action"),
+        ):
+            conn = http_client.HTTPConnection(host, int(port), timeout=10)
+            try:
+                conn.request("POST", "/router/replicas",
+                             body=json.dumps(payload),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                assert resp.status == 400
+                assert needle in json.loads(resp.read())["error"]
+            finally:
+                conn.close()
+    finally:
+        router.stop()
+
+
 def test_marked_resume_on_fresh_router_fails_typed_404(fleet):
     """A RESTARTED router (empty registry) cannot reconstruct the
     seq offset a handoff introduced: a handoff-marked resume must fail

@@ -101,6 +101,10 @@ def test_r8_engages_on_the_real_surfaces():
     assert "/v2/health/stats" in rp._routes(http)
     assert any("generate_stream" in r for r in rp._routes(http))
     assert any("generate_stream" in r for r in rp._routes(router))
+    # the admin surface (fleet-supervisor contract) is extracted too:
+    # every declared admin route and both membership verbs
+    assert set(rp.ROUTER_ADMIN_ROUTES) <= rp._routes(router)
+    assert set(rp.MEMBERSHIP_ACTIONS) <= rp._str_constants(router)
     assert rp._sse_id_formats(http) == rp._sse_id_formats(router) != set()
     assert rp._final_markers(http) == rp._final_markers(router) != set()
     assert rp._response_params_keys(mods) >= {"generation_id", "seq"}
@@ -289,11 +293,11 @@ def test_r8_protocol_parity_fixture():
     router-vs-frontend divergence cases the real tree must never
     grow."""
     findings = _lint_fixture("r8", "R8").new
-    assert len(findings) == 14
+    assert len(findings) == 17
     router = [f for f in findings if f.path.endswith("r8/router.py")]
     grpc = [f for f in findings if f.path.endswith("r8/grpc_frontend.py")]
     http = [f for f in findings if f.path.endswith("r8/http_frontend.py")]
-    assert len(router) == 11 and len(grpc) == 2 and len(http) == 1
+    assert len(router) == 14 and len(grpc) == 2 and len(http) == 1
     # surface-level router findings anchor at the route table
     assert all(f.lineno == 5 for f in router + http)
     msgs = sorted(f.message for f in router)
@@ -308,6 +312,11 @@ def test_r8_protocol_parity_fixture():
     assert sum("terminal SSE event" in m for m in msgs) == 1
     assert sum("resume-grammar key" in m for m in msgs) == 2
     assert sum("'Last-Event-ID'" in m for m in msgs) == 1
+    # the router's own admin surface: /router/stats unserved, and the
+    # served membership route references neither add nor remove
+    assert sum("declared admin route '/router/stats'" in m
+               for m in msgs) == 1
+    assert sum("membership action" in m for m in msgs) == 2
     assert sum("checkpoint" in m for m in msgs) == 1  # producer key
     # the replica itself can drift from a producer's published grammar
     assert "checkpoint" in http[0].message
@@ -510,8 +519,10 @@ def test_cli_explain():
 def test_check_py_wrapper_is_clean():
     """The one-command lint gate (tpulint + optional ruff) passes on
     the tree — its default scope is src/python AND tools; a missing
-    ruff binary is a skip, never a failure."""
-    proc = _run([sys.executable, "tools/check.py"])
+    ruff binary is a skip, never a failure.  (--no-t1 keeps the
+    verdict hermetic: it must not depend on whatever tier-1 log an
+    earlier run left in /tmp.)"""
+    proc = _run([sys.executable, "tools/check.py", "--no-t1"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "clean" in proc.stdout
 
@@ -522,16 +533,50 @@ def test_check_py_changed_only_mode():
     lint clean — and a broken git never breaks the gate (full-tree
     fallback, exercised via a bogus GIT_DIR)."""
     proc = _run([sys.executable, "tools/check.py", "--changed-only",
-                 "--no-ruff"])
+                 "--no-ruff", "--no-t1"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "clean" in proc.stdout
     env = dict(os.environ, GIT_DIR=os.path.join(REPO_ROOT, "nonexistent"))
     proc = subprocess.run(
-        [sys.executable, "tools/check.py", "--changed-only", "--no-ruff"],
+        [sys.executable, "tools/check.py", "--changed-only", "--no-ruff",
+         "--no-t1"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
         env=env)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "full tree" in proc.stderr
+
+
+def test_check_py_t1_noise_ratchet_wiring(tmp_path):
+    """check.py folds the tier-1 noise ratchet in exactly when a
+    COMPLETED tier-1 log is named: new failures beyond the snapshot
+    fail the check, a log with no pytest summary (a run still in
+    flight — check.py runs inside that suite) is skipped, and naming a
+    missing log explicitly is an error."""
+    base = [sys.executable, "tools/check.py", "--no-ruff"]
+    # a completed log with a failure the snapshot does not grandfather
+    bad = tmp_path / "t1_bad.log"
+    bad.write_text("FAILED tests/test_x.py::test_new - boom\n"
+                   "1 failed, 2 passed in 3.21s\n")
+    proc = _run(base + ["--t1-log", str(bad)])
+    assert proc.returncode == 1
+    assert "NEW tier-1 failure" in proc.stdout + proc.stderr
+    # the same failure in a log WITHOUT a summary line: run in flight,
+    # ratchet skipped, gate clean
+    partial = tmp_path / "t1_partial.log"
+    partial.write_text("FAILED tests/test_x.py::test_new - boom\n")
+    proc = _run(base + ["--t1-log", str(partial)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no pytest summary" in proc.stderr
+    # explicitly naming a log that does not exist is an error ...
+    proc = _run(base + ["--t1-log", str(tmp_path / "nope.log")])
+    assert proc.returncode == 1
+    # ... as is the flag with no value (typed, not a traceback)
+    proc = _run(base + ["--t1-log"])
+    assert proc.returncode == 2
+    assert "needs a path" in proc.stderr
+    # ... but --no-t1 bypasses the ratchet entirely
+    proc = _run(base + ["--no-t1", "--t1-log", str(bad)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 # -- layer 3: doc drift ------------------------------------------------------

@@ -7,6 +7,11 @@ class _RouterHandler:
         if method == "POST":
             if path == "/v2/health/ready":
                 return self._relay()
+            if path == "/router/replicas":
+                # admin drift: the membership route is served but
+                # neither 'add' nor 'remove' is ever referenced; and
+                # '/router/stats' is not served at all
+                return self._relay()
             # route drift: health/live + health/stats unserved;
             # stream drift: no generate_stream surface
         return None

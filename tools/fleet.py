@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Fleet CLI: a supervised, elastically-scaled replica fleet behind one
+router address.
+
+    python tools/fleet.py --replicas 2 --min-replicas 1 \
+        --max-replicas 4 --router-port 9000 --models llama,simple
+
+Spawns N replica server processes (each a real OS process with its own
+port and fault scope), fronts them with a FleetRouter whose membership
+the supervisor keeps live, heals replica death (SIGKILL/crash) and
+wedges (SIGTERM-drain first) under a bounded restart budget, and
+scales the replica count with the fleet's queue pressure
+(docs/resilience.md "Fleet supervisor & elastic scaling").
+SIGTERM/SIGINT drains the whole fleet cleanly.
+
+The hidden ``--serve-replica`` mode is the replica entry point the
+supervisor spawns: one InferenceServer + HttpFrontend on ``--port``
+with ``install_sigterm_drain`` installed, exiting once drained.
+"""
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+
+def build_models(names, slots):
+    from tpuserver.models.simple import SimpleModel
+
+    models = []
+    if "llama" in names:
+        from tpuserver.models import llama
+        from tpuserver.models.llama_serving import LlamaGenerateModel
+
+        models.append(LlamaGenerateModel(
+            cfg=llama.tiny(vocab=512), max_seq=64, max_slots=slots,
+            restart_backoff_s=0.01))
+    if "simple" in names:
+        models.append(SimpleModel())
+    if not models:
+        raise SystemExit("--models must name llama and/or simple")
+    return models
+
+
+def serve_replica(args):
+    """Child mode: one replica server process.  SIGTERM drains first
+    (in-flight generations finish, the prober rotates the replica out)
+    and the process exits once the server reaches ``stopped``."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tpuserver.core import InferenceServer, install_sigterm_drain
+    from tpuserver.http_frontend import HttpFrontend
+
+    core = InferenceServer(
+        build_models(args.models.split(","), args.slots),
+        fault_scope=args.scope or None)
+    frontend = HttpFrontend(core, port=args.port).start()
+    install_sigterm_drain(core, drain_timeout=args.drain_timeout)
+    print("replica[{}] serving on {} (pid {})".format(
+        args.scope or "-", frontend.url, os.getpid()), flush=True)
+    try:
+        while core.server_state() != "stopped":
+            time.sleep(0.1)
+    finally:
+        frontend.stop()
+    print("replica[{}] drained and stopped".format(args.scope or "-"),
+          flush=True)
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--serve-replica", action="store_true",
+                    help=argparse.SUPPRESS)  # the spawned child mode
+    ap.add_argument("--port", type=int, default=0,
+                    help="(child mode) replica listen port")
+    ap.add_argument("--scope", default="",
+                    help="(child mode) fault-injection scope name")
+    ap.add_argument("--models", default="llama,simple",
+                    help="comma list of replica models (llama, simple)")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="llama scheduler slots per replica (default 4)")
+    ap.add_argument("--drain-timeout", type=float, default=10.0,
+                    help="replica SIGTERM drain budget in seconds")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="initial replica process count (default 2)")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--router-host", default="127.0.0.1")
+    ap.add_argument("--router-port", type=int, default=9000,
+                    help="router listen port (0 = pick free)")
+    ap.add_argument("--probe-interval", type=float, default=0.5,
+                    help="supervisor monitor cadence (default 0.5s)")
+    ap.add_argument("--max-restarts", type=int, default=5,
+                    help="per-replica restart budget inside the window")
+    ap.add_argument("--restart-window", type=float, default=60.0)
+    ap.add_argument("--scale-high", type=float, default=0.85,
+                    help="sustained fleet utilization that scales UP")
+    ap.add_argument("--scale-low", type=float, default=0.10,
+                    help="sustained fleet utilization that scales DOWN")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.serve_replica:
+        return serve_replica(args)
+
+    from tpuserver.fleet import FleetSupervisor
+
+    command = [
+        sys.executable, os.path.abspath(__file__), "--serve-replica",
+        "--port", "{port}", "--scope", "{scope}",
+        "--models", args.models, "--slots", str(args.slots),
+        "--drain-timeout", str(args.drain_timeout),
+    ]
+    supervisor = FleetSupervisor(
+        command,
+        replicas=args.replicas,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        probe_interval_s=args.probe_interval,
+        max_restarts=args.max_restarts,
+        restart_window_s=args.restart_window,
+        scale_high=args.scale_high,
+        scale_low=args.scale_low,
+        router_kwargs={"host": args.router_host, "port": args.router_port},
+        env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
+        verbose=args.verbose,
+    ).start()
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    print("fleet supervisor: router on {} over {} replica(s) "
+          "(min {}, max {})".format(
+              supervisor.router.url, args.replicas, args.min_replicas,
+              args.max_replicas), flush=True)
+    supervisor.wait_ready(timeout_s=120.0)
+    for rep in supervisor.stats()["replicas"]:
+        print("  replica {url} [{scope}] pid={pid} state={state}".format(
+            **rep), flush=True)
+    try:
+        stop.wait()
+    finally:
+        supervisor.stop()
+    print("fleet stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

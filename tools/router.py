@@ -8,7 +8,9 @@ The router speaks the same KServe v2 + /generate_stream surface as a
 replica, so any plain tritonclient.http client points at it unchanged
 and gets health-aware routing, typed shedding, sticky stream resume,
 and cross-replica resume handoff for free (docs/resilience.md "Fleet
-router").  SIGTERM/SIGINT stop it cleanly.
+router").  Membership is live: GET/POST /router/replicas lists, adds,
+and removes replicas at runtime (the surface tools/fleet.py's
+supervisor drives scaling through).  SIGTERM/SIGINT stop it cleanly.
 """
 
 import argparse
