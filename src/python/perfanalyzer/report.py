@@ -53,6 +53,33 @@ _GEN_HEADERS = [
     "errors", "stable",
 ]
 
+#: Per-window CSV schema: the reference ReportWriter's columns
+#: (``Concurrency,Inferences/Second,Client Send,Network+Server
+#: Send/Recv,Server Queue,Server Compute Input,Server Compute Infer,
+#: Server Compute Output,Client Recv,p50/p90/p95/p99 latency`` —
+#: report_writer.cc:73-260, SURVEY §6) plus this stack's generation
+#: columns (TTFT/ITL/tokens-per-sec).  One row per measurement
+#: window; absent fields render empty, never zero (a 0 is a
+#: measurement, an empty cell is "not measured").
+WINDOW_CSV_COLUMNS = [
+    ("Concurrency", "concurrency"),
+    ("Inferences/Second", "throughput"),
+    ("Client Send", "client_send_usec"),
+    ("Network+Server Send/Recv", "network_usec"),
+    ("Server Queue", "queue_usec"),
+    ("Server Compute Input", "compute_input_usec"),
+    ("Server Compute Infer", "compute_infer_usec"),
+    ("Server Compute Output", "compute_output_usec"),
+    ("Client Recv", "client_recv_usec"),
+    ("p50 latency", "p50_usec"),
+    ("p90 latency", "p90_usec"),
+    ("p95 latency", "p95_usec"),
+    ("p99 latency", "p99_usec"),
+    ("TTFT avg ms", "ttft_avg_ms"),
+    ("ITL p50 ms", "itl_p50_ms"),
+    ("Tokens/Second", "tokens_per_sec"),
+]
+
 
 def _fmt(value, fmt):
     if value is None:
@@ -146,6 +173,21 @@ class ReportWriter:
             writer.writerow([key for key, _ in columns])
             for r in results:
                 writer.writerow([r.get(key) for key, _ in columns])
+
+    def write_window_csv(self, path, windows):
+        """Per-window CSV (``--report-csv``): one row per synchronized
+        measurement window in the reference schema
+        (:data:`WINDOW_CSV_COLUMNS`).  ``windows`` is the list of
+        merged window rows the distributed coordinator produces —
+        round-trip pinned (parse back, row count == windows) in
+        tests/test_coordinator.py."""
+        with open(path, "w", newline="") as fh:
+            writer = csv.writer(fh)
+            writer.writerow([header for header, _ in WINDOW_CSV_COLUMNS])
+            for w in windows:
+                writer.writerow([
+                    "" if w.get(key) is None else w.get(key)
+                    for _, key in WINDOW_CSV_COLUMNS])
 
     def json_rows(self, results):
         """BENCH-schema dicts, one per load level."""

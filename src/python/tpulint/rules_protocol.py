@@ -75,6 +75,10 @@ RESUME_HEADER = "last-event-id"
 
 HEALTH_PREFIX = "/v2/health/"
 STREAM_ROUTE_TOKEN = "generate_stream"
+#: The telemetry scrape surface: served by BOTH HTTP tiers (the
+#: replica's own exposition; the router re-serves it fleet-aggregated)
+#: so observability tooling points at either address unchanged.
+METRICS_ROUTE = "/metrics"
 
 #: The router's declared admin surface.  Every route here must be
 #: served by the real router module; ``/router/replicas`` must also
@@ -256,6 +260,15 @@ class ProtocolParityRule:
                 "router does not re-serve the replica's "
                 "generate_stream streaming surface (no route literal "
                 "or pattern mentions '{}')".format(STREAM_ROUTE_TOKEN),
+            ))
+        if METRICS_ROUTE in http_routes and \
+                METRICS_ROUTE not in router_routes:
+            findings.append(Finding(
+                self.id, self.name, router_mod.relpath, anchor,
+                "router does not serve the replica's '{}' telemetry "
+                "route — both HTTP surfaces must expose the scrape "
+                "surface (the router re-serves it "
+                "fleet-aggregated)".format(METRICS_ROUTE),
             ))
 
         missing_verbs = _verbs(http_mod) - _verbs(router_mod)

@@ -17,6 +17,7 @@ import numpy as np
 from tpuserver import faults
 from tpuserver import scheduler as _scheduler
 from tpuserver._clock import wall_clock_ms
+from tpuserver.metrics import MetricsRegistry
 from tpuserver.errors import (  # noqa: F401 — re-exported: the public
     # names every frontend/client/test imports from tpuserver.core
     DeadlineExceeded,
@@ -805,6 +806,27 @@ class InferenceServer:
             "log_verbose_level": 0,
             "log_format": "default",
         }
+        # the replica's telemetry plane (docs/observability.md):
+        # owned per-verb instruments plus a scrape-time collector over
+        # every model's scheduler counters — the scheduler stays the
+        # single account of its own events, the registry is a view.
+        # Verb children are pre-bound so the per-request hot path
+        # costs two lock-free adds, never a family-lock lookup.
+        self.metrics = MetricsRegistry()
+        requests_family = self.metrics.counter(
+            "tpu_requests_total", labelnames=("verb",))
+        seconds_family = self.metrics.histogram(
+            "tpu_request_seconds", labelnames=("verb",))
+        self._metric_errors = self.metrics.counter(
+            "tpu_request_errors_total", labelnames=("verb", "code"))
+        self._m_infer_count = requests_family.labels(verb="infer")
+        self._m_infer_hist = seconds_family.labels(verb="infer")
+        self._m_stream_count = requests_family.labels(verb="stream_infer")
+        self._m_stream_hist = seconds_family.labels(verb="stream_infer")
+        # (verb, code) -> bound counter child; plain-dict cache so the
+        # error path never re-pays the family lock
+        self._metric_error_children = {}
+        self.metrics.register_collector(self._collect_metrics)
         for m in models or []:
             self.register_model(m)
 
@@ -952,6 +974,119 @@ class InferenceServer:
             "pid": os.getpid(),
             "models": models,
         }
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _count_error(self, verb, code):
+        key = (verb, str(code))
+        child = self._metric_error_children.get(key)
+        if child is None:
+            child = self._metric_errors.labels(verb=verb, code=key[1])
+            self._metric_error_children[key] = child
+        child.inc()
+
+    def _collect_metrics(self):
+        """Scrape-time collector: the in-flight gauge plus every
+        scheduler-backed model's counters, read straight from
+        ``scheduler_stats()`` — one source of truth, no double
+        accounting (test-pinned in tests/test_metrics.py)."""
+        with self._inflight_cond:
+            inflight = self._inflight
+        families = [("tpu_inflight_requests", [({}, inflight)])]
+        with self._lock:
+            items = list(self._models.items())
+        per_family = {
+            "tpu_scheduler_admissions_total": "admitted",
+            "tpu_scheduler_tokens_total": "tokens",
+            "tpu_scheduler_restarts_total": "restarts",
+            "tpu_scheduler_quarantined_total": "quarantined",
+            "tpu_scheduler_replay_hits_total": "replay_hits",
+            "tpu_scheduler_live_streams": "live_streams",
+            "tpu_scheduler_pending": "pending",
+        }
+        samples = {name: [] for name in per_family}
+        for model_name, model in items:
+            stats_fn = getattr(model, "scheduler_stats", None)
+            stats = stats_fn() if callable(stats_fn) else None
+            if not isinstance(stats, dict):
+                continue
+            for fam_name, key in per_family.items():
+                samples[fam_name].append(
+                    ({"model": model_name}, int(stats.get(key) or 0)))
+        families.extend(
+            (name, rows) for name, rows in samples.items() if rows)
+        return families
+
+    def metrics_text(self):
+        """The replica's full ``/metrics`` exposition: the ``nv_*``
+        compatibility gauges (what the reference server publishes on
+        :8002 and perf_analyzer ``--collect-metrics`` scrapes,
+        metrics_manager.h:44-91) followed by the ``tpu_*`` registry.
+        One snapshot for both transports: the HTTP frontend serves it
+        at ``GET /metrics`` and the gRPC frontend via the
+        ``ServerMetrics`` unary."""
+        lines = []
+        rss_bytes = None
+        try:
+            # current RSS (ru_maxrss is the PEAK, and its unit is
+            # platform-dependent; /proc is authoritative on Linux)
+            with open("/proc/self/statm") as f:
+                rss_bytes = int(f.read().split()[1]) * os.sysconf(
+                    "SC_PAGE_SIZE")
+        except Exception:
+            try:
+                import resource
+                import sys
+
+                peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                # Linux reports KB, macOS bytes; label it as the peak
+                # it is rather than mislabeling it current
+                rss_bytes = peak * (1 if sys.platform == "darwin" else 1024)
+            except Exception:
+                pass
+        if rss_bytes is not None:
+            lines.append(
+                "# HELP nv_cpu_memory_used_bytes Server RSS.\n"
+                "# TYPE nv_cpu_memory_used_bytes gauge\n"
+                "nv_cpu_memory_used_bytes {}".format(rss_bytes))
+        try:
+            import jax
+
+            devices = [
+                d for d in jax.devices() if d.platform != "cpu"
+            ]
+            for i, dev in enumerate(devices):
+                stats = {}
+                try:
+                    stats = dev.memory_stats() or {}
+                except Exception:
+                    pass
+                used = stats.get("bytes_in_use", 0)
+                total = stats.get("bytes_limit", 0)
+                label = '{{tpu="{}"}}'.format(i)
+                lines.append(
+                    "nv_gpu_memory_used_bytes{} {}".format(label, used))
+                lines.append(
+                    "nv_gpu_memory_total_bytes{} {}".format(label, total))
+                if total:
+                    # a memory fraction, NOT compute duty-cycle — keep it
+                    # out of nv_gpu_utilization (whose nv_* semantics,
+                    # and perf_analyzer's averaging, mean busy-percent)
+                    lines.append(
+                        "nv_gpu_memory_utilization{} {}".format(
+                            label, used / total))
+        except Exception:
+            pass
+        for stat in self.model_statistics()["model_stats"]:
+            label = '{{model="{}"}}'.format(stat["name"])
+            lines.append(
+                "nv_inference_count{} {}".format(
+                    label, stat["inference_count"]))
+            lines.append(
+                "nv_inference_exec_count{} {}".format(
+                    label, stat["execution_count"]))
+        return ("\n".join(lines) + "\n" if lines else "") \
+            + self.metrics.render()
 
     def mark_ready(self):
         """Flip a ``starting`` server to ``ready`` (after warmup), or
@@ -1362,21 +1497,33 @@ class InferenceServer:
         Decoupled models are rejected here (use ``infer_stream``), matching
         server behavior for non-streaming endpoints.
         """
-        deadline = self._resolve_deadline(request)
-        self._check_deadline(deadline)
-        self._enter_inflight()
+        t0 = time.monotonic()
+        self._m_infer_count.inc()
         try:
-            model = self._get_model(
-                request.model_name, request.model_version
-            )
-            if model.decoupled:
-                raise ServerError(
-                    "model '{}' is a decoupled model: it can only be served "
-                    "over the streaming endpoint".format(model.name)
+            deadline = self._resolve_deadline(request)
+            self._check_deadline(deadline)
+            self._enter_inflight()
+            try:
+                model = self._get_model(
+                    request.model_name, request.model_version
                 )
-            return self._execute(model, request)
+                if model.decoupled:
+                    raise ServerError(
+                        "model '{}' is a decoupled model: it can only be "
+                        "served over the streaming endpoint".format(
+                            model.name)
+                    )
+                return self._execute(model, request)
+            finally:
+                self._exit_inflight()
+        except ServerError as e:
+            # typed failures count by wire code: 429 = shed, 504 =
+            # deadline, 503 = draining/shutdown — the shed/deadline/
+            # error breakdown /metrics carries per verb
+            self._count_error("infer", getattr(e, "code", 500))
+            raise
         finally:
-            self._exit_inflight()
+            self._m_infer_hist.observe(time.monotonic() - t0)
 
     def infer_stream(self, request):
         """Execute a (possibly decoupled) request; yields InferResponse(s).
@@ -1385,13 +1532,23 @@ class InferenceServer:
         trailing empty response marked ``triton_final_response`` is emitted
         so clients can detect completion of data-dependent-length streams.
         """
-        deadline = self._resolve_deadline(request)
-        self._check_deadline(deadline)
-        self._enter_inflight()
+        t0 = time.monotonic()
+        self._m_stream_count.inc()
         try:
-            yield from self._infer_stream_inner(request)
+            deadline = self._resolve_deadline(request)
+            self._check_deadline(deadline)
+            self._enter_inflight()
+            try:
+                yield from self._infer_stream_inner(request)
+            finally:
+                self._exit_inflight()
+        except ServerError as e:
+            self._count_error("stream_infer", getattr(e, "code", 500))
+            raise
         finally:
-            self._exit_inflight()
+            # streamed verbs measure submit-to-terminal-event: the
+            # duration covers the whole generation, not just dispatch
+            self._m_stream_hist.observe(time.monotonic() - t0)
 
     def _infer_stream_inner(self, request):
         want_final = bool(

@@ -184,6 +184,16 @@ class _CoreBridge:
             extensions=SERVER_EXTENSIONS,
         )
 
+    def ServerMetrics(self, request, context):
+        """The Prometheus exposition over gRPC: the SAME snapshot the
+        HTTP frontend serves at ``GET /metrics``
+        (``core.metrics_text()``), carried in the response's
+        ``metrics`` string param — scrapers behind a gRPC-only
+        deployment lose nothing."""
+        resp = pb.LogSettingsResponse()
+        resp.settings["metrics"].string_param = self._core.metrics_text()
+        return resp
+
     def ModelMetadata(self, request, context):
         md = self._core.model_metadata(request.name, request.version)
         resp = pb.ModelMetadataResponse(

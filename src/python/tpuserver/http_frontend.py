@@ -93,74 +93,14 @@ class _Handler(BaseHttpHandler):
             self._send_error_json("internal error: {}".format(e), 500)
 
     def _send_metrics(self, core):
-        """Prometheus-style exposition (role of Triton's :8002/metrics;
-        scraped by perf_analyzer --collect-metrics,
-        reference metrics_manager.h:44-91).  Gauge names mirror the
-        nv_* families with TPU labels where the reference reports GPU."""
-        lines = []
-        rss_bytes = None
-        try:
-            # current RSS (ru_maxrss is the PEAK, and its unit is
-            # platform-dependent; /proc is authoritative on Linux)
-            import os
-
-            with open("/proc/self/statm") as f:
-                rss_bytes = int(f.read().split()[1]) * os.sysconf(
-                    "SC_PAGE_SIZE")
-        except Exception:
-            try:
-                import resource
-                import sys
-
-                peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
-                # Linux reports KB, macOS bytes; label it as the peak
-                # it is rather than mislabeling it current
-                rss_bytes = peak * (1 if sys.platform == "darwin" else 1024)
-            except Exception:
-                pass
-        if rss_bytes is not None:
-            lines.append(
-                "# HELP nv_cpu_memory_used_bytes Server RSS.\n"
-                "# TYPE nv_cpu_memory_used_bytes gauge\n"
-                "nv_cpu_memory_used_bytes {}".format(rss_bytes))
-        try:
-            import jax
-
-            devices = [
-                d for d in jax.devices() if d.platform != "cpu"
-            ]
-            for i, dev in enumerate(devices):
-                stats = {}
-                try:
-                    stats = dev.memory_stats() or {}
-                except Exception:
-                    pass
-                used = stats.get("bytes_in_use", 0)
-                total = stats.get("bytes_limit", 0)
-                label = '{{tpu="{}"}}'.format(i)
-                lines.append(
-                    "nv_gpu_memory_used_bytes{} {}".format(label, used))
-                lines.append(
-                    "nv_gpu_memory_total_bytes{} {}".format(label, total))
-                if total:
-                    # a memory fraction, NOT compute duty-cycle — keep it
-                    # out of nv_gpu_utilization (whose nv_* semantics,
-                    # and perf_analyzer's averaging, mean busy-percent)
-                    lines.append(
-                        "nv_gpu_memory_utilization{} {}".format(
-                            label, used / total))
-        except Exception:
-            pass
-        for stat in core.model_statistics()["model_stats"]:
-            label = '{{model="{}"}}'.format(stat["name"])
-            lines.append(
-                "nv_inference_count{} {}".format(
-                    label, stat["inference_count"]))
-            lines.append(
-                "nv_inference_exec_count{} {}".format(
-                    label, stat["execution_count"]))
+        """Prometheus exposition (role of Triton's :8002/metrics;
+        scraped by perf_analyzer --collect-metrics, reference
+        metrics_manager.h:44-91).  The snapshot itself is the core's
+        ``metrics_text()`` — the nv_* compatibility families plus the
+        tpu_* registry (docs/observability.md) — so the HTTP route and
+        the gRPC ServerMetrics unary serve identical bytes."""
         self._send(
-            200, ("\n".join(lines) + "\n").encode("utf-8"),
+            200, core.metrics_text().encode("utf-8"),
             content_type="text/plain")
 
     def _route(self, method):

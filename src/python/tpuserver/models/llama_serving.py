@@ -173,6 +173,12 @@ class LlamaGenerateModel(Model):
                         restart_backoff_s=self._restart_backoff_s,
                         replay_ttl_s=self._replay_ttl_s,
                         replay_capacity=self._replay_capacity,
+                        # queue-wait/step latency histograms land in
+                        # the attached server's /metrics registry
+                        # (lock-free observes — the decode loop never
+                        # pays a lock to be observable)
+                        metrics=getattr(self._server, "metrics", None),
+                        metric_labels={"model": self.name},
                     )
                 elif self._mesh is not None:
                     init_cache, prefill_fn, chunk_fn = (

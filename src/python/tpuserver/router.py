@@ -79,6 +79,13 @@ import zlib
 from collections import OrderedDict
 
 from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
+from tpuserver.metrics import (
+    MetricsRegistry,
+    is_cumulative,
+    parse_prometheus_text,
+    _fmt_value,
+    _render_labels,
+)
 from tritonclient._auxiliary import (
     FAILURE_CONNECT,
     FAILURE_INTERRUPTED,
@@ -512,6 +519,137 @@ class _Generation:
             return json.dumps(request).encode("utf-8")
 
 
+class _FleetMetricsAggregator:
+    """Churn-safe fleet aggregation of replica ``/metrics`` scrapes.
+
+    The router's ``GET /metrics`` must present ONE fleet view whose
+    monotonic counters never decrease — across replica process
+    restarts (a respawned replica's counters reset to zero) and
+    membership churn (scale-down removes a replica's exposition
+    entirely).  Standard federation math: per ``(replica, sample)``
+    last-seen values plus a retained base.
+
+    - A **cumulative** sample (``TYPE counter``/``histogram``, or an
+      untyped ``*_total``/``*_count`` compatibility family like
+      ``nv_inference_count``) whose new value is LOWER than its last
+      seen one is a process restart: the pre-reset total folds into
+      the base and counting restarts from the new value.
+    - A replica that leaves the membership folds its whole last
+      contribution into the base — the fleet view keeps everything it
+      ever served.
+    - **Gauges** are point-in-time: they sum over the replicas
+      reachable in THIS scrape, no retained state.
+
+    All state lives under one small lock held only for dict math —
+    the scrapes themselves happen outside (R2: no blocking under a
+    lock).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (url, sample_key) -> last seen value  # guarded-by: _lock
+        self._last = {}
+        # sample_key -> folded pre-reset/pre-removal total  # guarded-by: _lock
+        self._base = {}
+        # family -> (kind, help)  # guarded-by: _lock
+        self._meta = {}
+        # monotonic stamp of the last APPLIED fold: concurrent
+        # /metrics handlers scrape outside any lock, so an older
+        # scrape landing after a newer one must not fold — its lower
+        # values would read as counter resets and permanently inflate
+        # the fleet totals  # guarded-by: _lock
+        self._last_stamp = float("-inf")
+
+    def render(self, live_urls, scrapes, stamp=None, exclude=()):
+        """Fold this round of ``scrapes`` (url -> parsed families of
+        the replica's exposition) and render the aggregate lines.
+        ``stamp`` is the monotonic instant the scrape round STARTED:
+        a round older than the last applied one renders the current
+        aggregate without folding (stale values never corrupt the
+        reset detection).  ``exclude`` names families the caller's own
+        registry already rendered — when replicas are themselves
+        routers (routers stack), re-emitting their ``tpu_router_*``
+        families would declare the same family twice and invalidate
+        the exposition."""
+        live = set(live_urls)
+        exclude = set(exclude)
+        with self._lock:
+            fold = stamp is None or stamp >= self._last_stamp
+            if fold and stamp is not None:
+                self._last_stamp = stamp
+            gauges = {}
+            if fold:
+                for url, key in list(self._last):
+                    if url not in live:
+                        # membership churn: the departed replica's
+                        # totals are history the fleet view must keep
+                        self._base[key] = (self._base.get(key, 0.0)
+                                           + self._last.pop((url, key)))
+            for url, families in scrapes.items():
+                for fam_name, fam in families.items():
+                    if fam_name in exclude:
+                        continue
+                    kind = fam["type"]
+                    self._meta[fam_name] = (kind, fam["help"])
+                    cumulative = is_cumulative(fam_name, kind)
+                    for sample_name, labels, value in fam["samples"]:
+                        key = (fam_name, sample_name,
+                               tuple(sorted(labels.items())))
+                        if not cumulative:
+                            gauges[key] = gauges.get(key, 0.0) + value
+                        elif fold:
+                            prev = self._last.get((url, key))
+                            if prev is not None and value < prev:
+                                # counter reset: a healed process
+                                self._base[key] = (
+                                    self._base.get(key, 0.0) + prev)
+                            self._last[(url, key)] = value
+            totals = dict(self._base)
+            for (_url, key), value in self._last.items():
+                totals[key] = totals.get(key, 0.0) + value
+            totals.update(gauges)
+            meta = dict(self._meta)
+        by_family = {}
+        for (fam_name, sample_name, labels), value in totals.items():
+            if fam_name in exclude:
+                continue  # retained state from before an exclusion
+            by_family.setdefault(fam_name, []).append(
+                (sample_name, labels, value))
+
+        def sample_order(sample):
+            # histogram buckets must leave in ascending numeric ``le``
+            # order (OpenMetrics consumers reject lexicographic order:
+            # "+Inf" < "0.0001" as strings); every other label sorts
+            # lexicographically for a stable exposition
+            sample_name, labels, _value = sample
+            le = dict(labels).get("le")
+            if le is None:
+                le_key = float("-inf")
+            elif le == "+Inf":
+                le_key = float("inf")
+            else:
+                try:
+                    le_key = float(le)
+                except ValueError:
+                    le_key = float("inf")
+            rest = tuple(kv for kv in labels if kv[0] != "le")
+            return (sample_name, rest, le_key)
+
+        lines = []
+        for fam_name in sorted(by_family):
+            kind, help_text = meta.get(fam_name, (None, None))
+            if help_text:
+                lines.append("# HELP {} {}".format(fam_name, help_text))
+            if kind:
+                lines.append("# TYPE {} {}".format(fam_name, kind))
+            for sample_name, labels, value in sorted(
+                    by_family[fam_name], key=sample_order):
+                lines.append("{}{} {}".format(
+                    sample_name, _render_labels(labels),
+                    _fmt_value(value)))
+        return "\n".join(lines) + "\n" if lines else ""
+
+
 class FleetRouter:
     """The router process core: replica set, prober, generation
     registry, counters, and the embedded HTTP front-tier.
@@ -581,6 +719,12 @@ class FleetRouter:
         # optional fleet-supervisor stats hook: folded into /router/
         # stats so perf tooling sees restart/scale counters per window
         self._supervisor_stats = None
+        # the router tier's own telemetry (collector over stats() — the
+        # counters stay singly accounted) + the fleet aggregator behind
+        # GET /metrics (docs/observability.md)
+        self.metrics = MetricsRegistry()
+        self.metrics.register_collector(self._collect_metrics)
+        self._aggregator = _FleetMetricsAggregator()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -894,6 +1038,109 @@ class FleetRouter:
                 out["supervisor"] = None
         return out
 
+    def _collect_metrics(self):
+        """Scrape-time collector over :meth:`stats`: the router's
+        counters (and an attached fleet supervisor's process-healing
+        counters) surface in /metrics without a second account of any
+        event."""
+        snap = self.stats()
+        families = [
+            ("tpu_router_failovers_total", [({}, snap["failovers"])]),
+            ("tpu_router_handoffs_total", [({}, snap["handoffs"])]),
+            ("tpu_router_resumed_streams_total",
+             [({}, snap["resumed_streams"])]),
+            ("tpu_router_shed_total", [({}, snap["shed"])]),
+            ("tpu_router_inflight_requests", [({}, snap["inflight"])]),
+            ("tpu_router_generations", [({}, snap["generations"])]),
+        ]
+        eligible, load = [], []
+        for rep in snap["replicas"]:
+            labels = {"replica": rep["url"]}
+            eligible.append((labels, 1 if rep["eligible"] else 0))
+            load.append((labels, rep["load"]))
+        if eligible:
+            families.append(("tpu_router_replica_eligible", eligible))
+            families.append(("tpu_router_replica_load", load))
+        sup = snap.get("supervisor")
+        if isinstance(sup, dict):
+            families.extend([
+                ("tpu_fleet_replica_restarts_total",
+                 [({}, sup.get("replica_restarts", 0))]),
+                ("tpu_fleet_scale_up_total",
+                 [({}, sup.get("scale_up_events", 0))]),
+                ("tpu_fleet_scale_down_total",
+                 [({}, sup.get("scale_down_events", 0))]),
+                ("tpu_fleet_retired_replicas_total",
+                 [({}, sup.get("retired_replicas", 0))]),
+                ("tpu_fleet_replicas_up", [({}, sup.get("up", 0))]),
+            ])
+        return families
+
+    def _fetch_metrics(self, rep):
+        """One replica's raw ``/metrics`` text, or None when
+        unreachable (the aggregator keeps its last contribution)."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self._probe_timeout_s)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return resp.read().decode("utf-8", errors="replace")
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    def metrics_text(self):
+        """The router's ``GET /metrics`` exposition: its own tier
+        counters followed by the FLEET-AGGREGATED replica families —
+        every replica's ``/metrics`` scraped (no locks held across the
+        sockets) and folded churn-safe, so a scraper pointed at the
+        router sees one monotonic fleet view that survives replica
+        restarts, scale events, and retirements."""
+        replicas = [rep for rep in self._replicas_snapshot()
+                    if not rep.removed.is_set()]
+        live_urls = [rep.url for rep in replicas]
+        # stamp BEFORE the fetches start: concurrent scrapes fold in
+        # start order, so a slower round can never overwrite a newer
+        # one's last-seen values (see _FleetMetricsAggregator.render)
+        stamp = time.monotonic()
+        # fan the fetches out like the prober does: a dead replica
+        # costs its own probe_timeout_s, never N of them in sequence
+        # (a post-SIGKILL scrape must still answer within one timeout)
+        results = {}
+        results_lock = threading.Lock()
+
+        def fetch_one(rep):
+            text = self._fetch_metrics(rep)
+            if text is not None:
+                with results_lock:
+                    results[rep.url] = parse_prometheus_text(text)
+
+        threads = [
+            threading.Thread(target=fetch_one, args=(rep,),
+                             name="fleet-router-metrics", daemon=True)
+            for rep in replicas
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self._probe_timeout_s + 1.0)
+        with results_lock:
+            scrapes = dict(results)
+        own = self.metrics.render()
+        # families this tier already declared must not re-emit from
+        # the aggregate: when replicas are themselves routers (routers
+        # stack), their tpu_router_*/tpu_fleet_* families would
+        # otherwise appear twice and invalidate the exposition
+        own_names = {
+            line.split(" ", 3)[2] for line in own.splitlines()
+            if line.startswith("# TYPE ")
+        }
+        return own + self._aggregator.render(
+            live_urls, scrapes, stamp=stamp, exclude=own_names)
+
     def health_snapshot(self):
         """The router's own replica-shaped ``/v2/health/stats`` answer,
         so routers stack (a router can front other routers) and pools
@@ -1084,6 +1331,13 @@ class _RouterHandler(BaseHttpHandler):
             return self._send(200 if router.any_routable() else 503)
         if path == "/v2/health/stats":
             return self._send_json(router.health_snapshot())
+        if path == "/metrics":
+            # the fleet scrape surface: router-tier counters + the
+            # churn-safe aggregate of every replica's /metrics —
+            # protocol parity with the replica frontend (tpulint R8)
+            return self._send(
+                200, router.metrics_text().encode("utf-8"),
+                content_type="text/plain")
         if path == "/router/stats":
             return self._send_json(router.stats())
         if path == "/router/replicas":

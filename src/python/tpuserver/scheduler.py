@@ -118,6 +118,7 @@ class _Stream:
         "prompt", "max_tokens", "eos_id", "queue", "forced", "pos",
         "emitted", "on_finish", "resume_cache", "resume_pos", "finished",
         "cancelled", "deadline", "generation_id", "history", "incarnation",
+        "enqueued_at",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
@@ -146,6 +147,9 @@ class _Stream:
         # same stream (cancelled, parked, resumed, re-admitted into the
         # same slot) can never deliver its stale token
         self.incarnation = 0
+        # monotonic stamp of the latest (re-)enqueue: the scheduler's
+        # queue-wait histogram measures submit -> slot admission
+        self.enqueued_at = time.monotonic()
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -177,7 +181,8 @@ class DecodeScheduler:
     def __init__(self, fns, params, max_slots, max_seq, max_pending=None,
                  fault_scope=None, step_timeout_s=None, max_restarts=5,
                  restart_window_s=60.0, restart_backoff_s=0.05,
-                 replay_ttl_s=60.0, replay_capacity=256):
+                 replay_ttl_s=60.0, replay_capacity=256,
+                 metrics=None, metric_labels=None):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
@@ -235,6 +240,31 @@ class DecodeScheduler:
         # slotted: close() fails exactly this set when the loop cannot
         # (join timeout), and drain() waits on it  # guarded-by: _cond
         self._streams = set()
+        # cumulative observability counters (stats() + /metrics).
+        # Written only by the decode loop / resume path with _cond
+        # already held where it is held anyway — never a NEW lock
+        # acquisition on the hot path (open item 3's regression
+        # lesson); they only ever grow, so a racing stats() read can
+        # lag one step but never see a decrease.
+        self._admitted_total = 0
+        self._tokens_total = 0
+        self._replay_hits = 0
+        # optional tpuserver.metrics latency histograms: the decode
+        # loop is their ONLY writer, so single_writer children observe
+        # lock-free (exact, and never a lock acquisition in _loop)
+        self._queue_hist = None
+        self._step_hist = None
+        if metrics is not None:
+            labels = dict(metric_labels or {})
+            names = tuple(sorted(labels))
+            self._queue_hist = metrics.histogram(
+                "tpu_scheduler_queue_wait_seconds", labelnames=names,
+                single_writer=True,
+            ).labels(**labels)
+            self._step_hist = metrics.histogram(
+                "tpu_scheduler_step_seconds", labelnames=names,
+                single_writer=True,
+            ).labels(**labels)
 
     # -- frontend side -----------------------------------------------------
 
@@ -318,7 +348,10 @@ class DecodeScheduler:
         reconnect carrying a fresh timeout must not be killed by the
         stale one."""
         from_seq = int(from_seq)
-        deadline = time.monotonic() + float(wait_s)
+        # the park-race wait has its own bound; it must not clobber the
+        # ``deadline`` parameter, which is the RECONNECT's own request
+        # bound (None = unbounded) stamped onto the re-admitted stream
+        wait_deadline = time.monotonic() + float(wait_s)
         with self._cond:
             while True:
                 if self._closed:
@@ -329,7 +362,7 @@ class DecodeScheduler:
                     break
                 live = any(st.generation_id == generation_id
                            for st in self._streams)
-                remaining = deadline - time.monotonic()
+                remaining = wait_deadline - time.monotonic()
                 if not live or remaining <= 0:
                     raise UnknownGeneration(
                         "unknown or expired generation id '{}' (replay "
@@ -384,6 +417,9 @@ class DecodeScheduler:
                 self._streams.add(stream)
                 self._ensure_running_locked()
                 self._cond.notify_all()
+            # counted only once every validation gate passed: a
+            # malformed/rejected resume served nothing from the buffer
+            self._replay_hits += 1
 
         def gen():
             live = None if completed else self._drain(stream)
@@ -506,6 +542,9 @@ class DecodeScheduler:
                 "restarts": self._restarts,
                 "quarantined": self._quarantined,
                 "replay_entries": len(self._replay),
+                "admitted": self._admitted_total,
+                "tokens": self._tokens_total,
+                "replay_hits": self._replay_hits,
             }
 
     # -- supervisor --------------------------------------------------------
@@ -652,6 +691,7 @@ class DecodeScheduler:
         stopped.  Called with ``_cond`` held."""
         stream.pos = 0
         stream.forced.clear()
+        stream.enqueued_at = time.monotonic()
 
     # -- replay buffer -----------------------------------------------------
 
@@ -857,6 +897,10 @@ class DecodeScheduler:
                 finally:
                     self._beat(epoch, None)
                 slots[slot] = stream
+                self._admitted_total += 1
+                if self._queue_hist is not None:
+                    self._queue_hist.observe(
+                        time.monotonic() - stream.enqueued_at)
 
             current = None
             active_ids = [i for i, s in enumerate(slots) if s is not None]
@@ -890,7 +934,8 @@ class DecodeScheduler:
                 if action is not None and action[0] == "nan":
                     row = min(max(0, action[1]), self._max_slots - 1)
                     logits = logits.at[row].set(float("nan"))
-                self._beat(epoch, time.monotonic())
+                step_start = time.monotonic()
+                self._beat(epoch, step_start)
                 if action is not None and action[0] == "hang":
                     time.sleep(action[1])
                 tokens_dev, logps_dev, logits, cache = fns["step"](
@@ -898,6 +943,11 @@ class DecodeScheduler:
                     forced_tok, forced_mask,
                 )
                 self._beat(epoch, None)
+                if self._step_hist is not None:
+                    # lock-free observe: the loop must never acquire a
+                    # lock per step just to be observable
+                    self._step_hist.observe(
+                        time.monotonic() - step_start)
                 current = (tokens_dev, logps_dev, snapshot)
 
             if inflight is not None:
@@ -943,6 +993,7 @@ class DecodeScheduler:
                             st.history.append((tok, lp))
                             st.queue.put(("tok", tok, lp))
                             st.emitted += 1
+                            self._tokens_total += 1
                         if st.emitted >= st.max_tokens or (
                             st.eos_id is not None and tok == st.eos_id
                         ):

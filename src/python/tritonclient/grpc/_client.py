@@ -436,6 +436,16 @@ class InferenceServerClient:
             as_json,
         )
 
+    def get_metrics(self, headers=None, client_timeout=None):
+        """The server's Prometheus text exposition via the
+        ServerMetrics-style unary — byte-identical to the HTTP
+        frontend's ``GET /metrics`` (the gRPC twin of scraping it)."""
+        resp = self._call(
+            "ServerMetrics", pb.ServerMetadataRequest(), headers,
+            client_timeout,
+        )
+        return resp.settings["metrics"].string_param
+
     # -- shared memory -----------------------------------------------------
 
     def get_system_shared_memory_status(

@@ -21,6 +21,13 @@ METHODS = {
     "ModelReady": (pb.ModelReadyRequest, pb.ModelReadyResponse, "unary"),
     "ServerMetadata": (
         pb.ServerMetadataRequest, pb.ServerMetadataResponse, "unary"),
+    # ServerMetrics-style unary (role of the reference server's
+    # :8002/metrics plane on the gRPC transport): the Prometheus text
+    # exposition rides a LogSettingsResponse string param ("metrics")
+    # — the vendored descriptor pool cannot grow a new message without
+    # protoc, and the wire is just length-delimited proto either way.
+    "ServerMetrics": (
+        pb.ServerMetadataRequest, pb.LogSettingsResponse, "unary"),
     "ModelMetadata": (
         pb.ModelMetadataRequest, pb.ModelMetadataResponse, "unary"),
     "ModelInfer": (pb.ModelInferRequest, pb.ModelInferResponse, "unary"),

@@ -17,6 +17,14 @@ Control surface (what tests poke):
 
 ``--ttl S`` makes the process exit nonzero after S seconds — the
 always-crashing replica that exhausts a restart budget.
+
+The stub also speaks just enough of the KServe inference surface for
+the distributed perf_analyzer coordinator's tier-1 tests (N real
+worker processes driving N stub replicas, zero jax imports): model
+``stub`` (INPUT0 FP32[8] -> OUTPUT0 FP32[1]) with metadata / config /
+stats / infer plus a ``/metrics`` Prometheus exposition whose
+``stub_requests_total`` counter moves with served inferences
+(``--infer-delay-ms`` pins a synthetic latency floor).
 """
 
 import argparse
@@ -40,6 +48,8 @@ def main():
     ap.add_argument("--never-ready", action="store_true",
                     help="answer probes but report ready=false forever "
                          "(a start that never completes)")
+    ap.add_argument("--infer-delay-ms", type=float, default=0.0,
+                    help="synthetic latency floor per /infer request")
     args = ap.parse_args()
 
     lock = threading.Lock()
@@ -52,6 +62,8 @@ def main():
         "quarantined": 0, "replay_entries": 0,
     }
 
+    served = {"count": 0, "ns": 0}
+
     def snapshot():
         with lock:
             return {
@@ -62,6 +74,45 @@ def main():
                 "pid": os.getpid(),
                 "models": {"stub": dict(model)},
             }
+
+    STUB_METADATA = {
+        "name": "stub", "versions": ["1"], "platform": "stub",
+        "inputs": [
+            {"name": "INPUT0", "datatype": "FP32", "shape": [8]}],
+        "outputs": [
+            {"name": "OUTPUT0", "datatype": "FP32", "shape": [1]}],
+    }
+    STUB_CONFIG = {
+        "name": "stub", "platform": "stub", "max_batch_size": 0,
+        "input": [{"name": "INPUT0", "data_type": "TYPE_FP32",
+                   "dims": [8]}],
+        "output": [{"name": "OUTPUT0", "data_type": "TYPE_FP32",
+                    "dims": [1]}],
+    }
+
+    def model_statistics():
+        with lock:
+            count, ns = served["count"], served["ns"]
+        buckets = {
+            key: {"count": count, "ns": ns if key == "success" else 0}
+            for key in ("success", "queue", "compute_input",
+                        "compute_infer", "compute_output")
+        }
+        buckets["fail"] = {"count": 0, "ns": 0}
+        return {"model_stats": [{
+            "name": "stub", "version": "1", "last_inference": 0,
+            "inference_count": count, "execution_count": count,
+            "inference_stats": buckets, "batch_stats": [],
+        }]}
+
+    def metrics_text():
+        with lock:
+            count = served["count"]
+        return (
+            "# HELP stub_requests_total Inferences served by this "
+            "stub replica.\n"
+            "# TYPE stub_requests_total counter\n"
+            "stub_requests_total {}\n".format(count))
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -89,13 +140,41 @@ def main():
                 with lock:
                     ready = state["ready"]
                 return self._json({}, 200 if ready else 503)
+            if self.path == "/v2/models/stub":
+                return self._json(STUB_METADATA)
+            if self.path == "/v2/models/stub/config":
+                return self._json(STUB_CONFIG)
+            if self.path in ("/v2/models/stats", "/v2/models/stub/stats"):
+                return self._json(model_statistics())
+            if self.path == "/metrics":
+                body = metrics_text().encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             self._json({"error": "unknown: " + self.path}, 404)
 
         def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if self.path == "/v2/models/stub/infer":
+                t0 = time.perf_counter()
+                if args.infer_delay_ms > 0:
+                    time.sleep(args.infer_delay_ms / 1000.0)
+                with lock:
+                    served["count"] += 1
+                    served["ns"] += int(
+                        (time.perf_counter() - t0) * 1e9)
+                return self._json({
+                    "model_name": "stub", "model_version": "1",
+                    "outputs": [{"name": "OUTPUT0", "datatype": "FP32",
+                                 "shape": [1], "data": [0.0]}],
+                })
             if self.path != "/stub/state":
                 return self._json({"error": "unknown: " + self.path}, 404)
-            length = int(self.headers.get("Content-Length") or 0)
-            update = json.loads(self.rfile.read(length) or b"{}")
+            update = json.loads(body or b"{}")
             with lock:
                 for key, val in update.items():
                     if key in model:

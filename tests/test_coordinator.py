@@ -1,0 +1,258 @@
+"""Distributed multi-process perfanalyzer coordination
+(perfanalyzer/coordinator.py + ``tools/perf_analyzer.py --workers``).
+
+The merge math is unit-pinned against a single-process computation on
+identical synthetic latencies (merge raw samples, never average
+percentiles; fleet throughput = sum of worker inferences over the
+synchronized window), the barrier protocol is exercised in-process,
+and the CLI runs end-to-end with N=2 real worker processes against a
+2-replica ``tests/fleet_stub.py`` stub fleet — pure-stdlib replicas,
+no jax import, small pinned windows (the tier-1 runtime budget)."""
+
+import csv
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "perf_analyzer.py")
+STUB = os.path.join(REPO, "tests", "fleet_stub.py")
+
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+from perfanalyzer import metrics  # noqa: E402
+from perfanalyzer.coordinator import (  # noqa: E402
+    Coordinator,
+    WorkerChannel,
+    merge_windows,
+    merge_worker_windows,
+)
+
+pytestmark = [pytest.mark.perf, pytest.mark.metrics]
+
+
+# -- merge math: unit-pinned against the single-process computation ---------
+
+
+def test_merge_worker_windows_pools_raw_samples():
+    """The merged percentiles must equal a single-process run over the
+    concatenated samples — and must NOT equal averaged per-worker
+    percentiles (the classic wrong merge), on a sample built to make
+    the two differ."""
+    w1 = {"completed": 4, "errors": 1, "duration_s": 2.0,
+          "latencies_s": [0.001, 0.002, 0.003, 0.004]}
+    w2 = {"completed": 4, "errors": 0, "duration_s": 1.9,
+          "latencies_s": [0.100, 0.200, 0.300, 0.400]}
+    merged = merge_worker_windows([w1, w2])
+    assert merged["completed"] == 8
+    assert merged["errors"] == 1
+    assert merged["workers"] == 2
+    # sum of worker inferences over the synchronized window span
+    assert merged["duration_s"] == 2.0
+    assert merged["throughput"] == pytest.approx(8 / 2.0)
+    pooled = metrics.latency_summary(
+        w1["latencies_s"] + w2["latencies_s"])
+    for key in ("avg_usec", "p50_usec", "p90_usec", "p95_usec",
+                "p99_usec"):
+        assert merged[key] == pytest.approx(pooled[key]), key
+    # averaging the per-worker p50s would give (2.5us+250us)/2 — the
+    # pooled p50 sits elsewhere entirely; pin that they differ
+    avg_of_p50 = (
+        metrics.latency_summary(w1["latencies_s"])["p50_usec"]
+        + metrics.latency_summary(w2["latencies_s"])["p50_usec"]) / 2
+    assert merged["p50_usec"] != pytest.approx(avg_of_p50)
+
+
+def test_merge_windows_collapses_the_run():
+    rows = [
+        merge_worker_windows([
+            {"completed": 3, "errors": 0, "duration_s": 1.0,
+             "latencies_s": [0.01, 0.02, 0.03]},
+            {"completed": 2, "errors": 0, "duration_s": 1.0,
+             "latencies_s": [0.04, 0.05]},
+        ]),
+        merge_worker_windows([
+            {"completed": 1, "errors": 1, "duration_s": 1.0,
+             "latencies_s": [0.06]},
+            {"completed": 2, "errors": 0, "duration_s": 1.0,
+             "latencies_s": [0.07, 0.08]},
+        ]),
+    ]
+    merged = merge_windows(rows)
+    assert merged["completed"] == 8
+    assert merged["errors"] == 1
+    assert merged["windows"] == 2
+    assert merged["duration_s"] == pytest.approx(2.0)
+    assert merged["throughput"] == pytest.approx(4.0)
+    pooled = metrics.latency_summary(
+        [0.01, 0.02, 0.03, 0.04, 0.05, 0.06, 0.07, 0.08])
+    assert merged["p99_usec"] == pytest.approx(pooled["p99_usec"])
+
+
+# -- the barrier protocol (in-process workers) ------------------------------
+
+
+def test_coordinator_barrier_synchronizes_windows():
+    """Window k+1 must not start on ANY worker before every worker
+    finished window k — the broadcast-after-gather IS the barrier."""
+    coord = Coordinator(workers=2, result_timeout_s=30.0).listen()
+    spans = []  # (worker, index, start, end)
+    spans_lock = threading.Lock()
+
+    def worker(worker_id, delay_s):
+        channel = WorkerChannel(coord.address, worker_id)
+
+        def run_window(duration_s, index):
+            start = time.monotonic()
+            time.sleep(delay_s)
+            end = time.monotonic()
+            with spans_lock:
+                spans.append((worker_id, index, start, end))
+            return {"completed": worker_id + 1, "errors": 0,
+                    "duration_s": delay_s,
+                    "latencies_s": [0.001 * (worker_id + 1)]}
+
+        channel.serve(run_window)
+        channel.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(i, 0.05 * (i + 1)),
+                         daemon=True)
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    coord.wait_for_workers(timeout_s=30.0)
+    rows = coord.run_windows(windows=3, window_s=0.05)
+    coord.shutdown()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(rows) == 3
+    for row in rows:
+        assert row["workers"] == 2
+        assert row["completed"] == 3  # 1 + 2
+        # the span is the slowest worker's (released together)
+        assert row["duration_s"] == pytest.approx(0.10)
+    # the barrier: every window-k span ends before ANY window-k+1 span
+    # begins, on both workers
+    by_index = {}
+    for worker_id, index, start, end in spans:
+        by_index.setdefault(index, []).append((start, end))
+    for index in range(2):
+        latest_end = max(end for _, end in by_index[index])
+        earliest_next = min(start for start, _ in by_index[index + 1])
+        assert earliest_next >= latest_end
+
+
+def test_coordinator_surfaces_a_dead_worker():
+    coord = Coordinator(workers=1, result_timeout_s=5.0).listen()
+
+    def worker():
+        channel = WorkerChannel(coord.address, 0)
+        # read the start_window, then die without answering
+        channel._reader.recv(10.0)
+        channel.close()
+
+    thread = threading.Thread(target=worker, daemon=True)
+    thread.start()
+    coord.wait_for_workers(timeout_s=10.0)
+    with pytest.raises(RuntimeError, match="worker 0"):
+        coord.run_window(0, 0.05)
+    coord.shutdown()
+    thread.join(timeout=10)
+
+
+# -- the CLI against a stub fleet (the acceptance path) ---------------------
+
+
+def _free_port():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def _wait_ready(port, timeout_s=20.0):
+    import http.client
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        try:
+            conn.request("GET", "/v2/health/ready")
+            if conn.getresponse().status == 200:
+                return True
+        except OSError:
+            pass
+        finally:
+            conn.close()
+        time.sleep(0.05)
+    return False
+
+
+def test_workers_cli_merges_a_two_replica_stub_fleet(tmp_path):
+    """``--workers 2`` against 2 stub replicas: one merged report whose
+    throughput is exactly sum-of-completions over the synchronized
+    window, plus the per-window ``--report-csv`` round-trip (row count
+    == windows, reference schema header)."""
+    ports = [_free_port(), _free_port()]
+    env = dict(os.environ,
+               PYTHONPATH=os.path.join(REPO, "src", "python"))
+    stubs = [
+        subprocess.Popen([sys.executable, STUB, "--port", str(p),
+                          "--infer-delay-ms", "1"])
+        for p in ports
+    ]
+    csv_path = str(tmp_path / "windows.csv")
+    try:
+        for p in ports:
+            assert _wait_ready(p), "stub replica never became ready"
+        result = subprocess.run(
+            [sys.executable, CLI, "-m", "stub", "--backend", "http",
+             "--urls", ",".join(
+                 "127.0.0.1:{}".format(p) for p in ports),
+             "--workers", "2", "--concurrency-range", "2",
+             "--windows", "3", "--measurement-interval", "250",
+             "--warmup", "0.2", "--report-csv", csv_path],
+            capture_output=True, text=True, timeout=180, env=env)
+    finally:
+        for stub in stubs:
+            stub.kill()
+    assert result.returncode == 0, result.stdout + result.stderr
+    rows = [json.loads(line) for line in result.stdout.splitlines()
+            if line.startswith('{"')]
+    assert len(rows) == 1  # ONE merged report, not one per worker
+    row = rows[0]
+    assert row["mode"] == "distributed_concurrency"
+    assert row["workers"] == 2
+    assert row["level"] == 4  # 2 workers x concurrency 2
+    assert row["windows"] == 3
+    assert row["errors"] == 0
+    assert row["completed"] > 0
+    # fleet throughput == sum of worker inferences over the
+    # synchronized windows (json rows round to 2/3 decimals)
+    assert row["value"] == pytest.approx(
+        row["completed"] / row["duration_s"], rel=0.01)
+    assert row["p50_usec"] <= row["p90_usec"] <= row["p99_usec"]
+    # per-window CSV round-trip: reference schema, one row per window
+    with open(csv_path, newline="") as fh:
+        parsed = list(csv.reader(fh))
+    header, data = parsed[0], parsed[1:]
+    assert header[:2] == ["Concurrency", "Inferences/Second"]
+    assert "Server Queue" in header and "p99 latency" in header
+    assert header[-1] == "Tokens/Second"
+    assert len(data) == 3  # row count == windows
+    for window_row in data:
+        assert int(window_row[0]) == 4
+        assert float(window_row[1]) > 0
+        p50 = float(window_row[header.index("p50 latency")])
+        p99 = float(window_row[header.index("p99 latency")])
+        assert 0 < p50 <= p99

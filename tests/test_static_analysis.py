@@ -101,6 +101,10 @@ def test_r8_engages_on_the_real_surfaces():
     assert "/v2/health/stats" in rp._routes(http)
     assert any("generate_stream" in r for r in rp._routes(http))
     assert any("generate_stream" in r for r in rp._routes(router))
+    # the telemetry scrape surface is served by BOTH tiers (the router
+    # fleet-aggregates it) — the /metrics parity check has real teeth
+    assert rp.METRICS_ROUTE in rp._routes(http)
+    assert rp.METRICS_ROUTE in rp._routes(router)
     # the admin surface (fleet-supervisor contract) is extracted too:
     # every declared admin route and both membership verbs
     assert set(rp.ROUTER_ADMIN_ROUTES) <= rp._routes(router)
@@ -293,11 +297,11 @@ def test_r8_protocol_parity_fixture():
     router-vs-frontend divergence cases the real tree must never
     grow."""
     findings = _lint_fixture("r8", "R8").new
-    assert len(findings) == 17
+    assert len(findings) == 18
     router = [f for f in findings if f.path.endswith("r8/router.py")]
     grpc = [f for f in findings if f.path.endswith("r8/grpc_frontend.py")]
     http = [f for f in findings if f.path.endswith("r8/http_frontend.py")]
-    assert len(router) == 14 and len(grpc) == 2 and len(http) == 1
+    assert len(router) == 15 and len(grpc) == 2 and len(http) == 1
     # surface-level router findings anchor at the route table
     assert all(f.lineno == 5 for f in router + http)
     msgs = sorted(f.message for f in router)
@@ -306,6 +310,9 @@ def test_r8_protocol_parity_fixture():
     assert any("'/v2/health/stats'" in m for m in msgs)
     assert sum("generate_stream streaming surface" in m
                for m in msgs) == 1
+    # the fixture replica serves /metrics, the fixture router does not:
+    # the telemetry-parity drift class fires exactly once
+    assert sum("'/metrics' telemetry route" in m for m in msgs) == 1
     assert sum("verb(s) GET" in m for m in msgs) == 1
     assert sum("missing code(s) 429, 503" in m for m in msgs) == 1
     assert sum("SSE id-line format" in m for m in msgs) == 1
@@ -621,6 +628,46 @@ def test_scheduler_stats_keys_are_documented():
     assert not missing, (
         "DecodeScheduler.stats() keys undocumented in "
         "docs/resilience.md: {}".format(sorted(missing)))
+
+
+OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
+
+
+def test_metric_catalog_matches_observability_doc():
+    """docs/observability.md's metric catalog documents exactly the
+    families declared in tpuserver.metrics.CATALOG — the faults.POINTS
+    code<->registry<->docs triangle, applied to the telemetry plane
+    (the registry itself enforces code<->CATALOG; this pins
+    CATALOG<->docs)."""
+    import re
+
+    from tpuserver import metrics as tmetrics
+
+    with open(OBSERVABILITY_MD, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    documented = set(re.findall(r"`(tpu_[a-z0-9_]+)`", text))
+    assert documented == set(tmetrics.CATALOG), (
+        "metric catalog drift: documented-only={}, registry-only={}"
+        .format(documented - set(tmetrics.CATALOG),
+                set(tmetrics.CATALOG) - documented))
+
+
+def test_metric_catalog_is_well_formed():
+    """Every CATALOG entry carries a valid type and a help string, and
+    counters follow the Prometheus ``*_total`` naming convention."""
+    from tpuserver import metrics as tmetrics
+
+    for name, (kind, help_text) in tmetrics.CATALOG.items():
+        assert kind in ("counter", "gauge", "histogram"), name
+        assert isinstance(help_text, str) and help_text, name
+        if kind == "counter":
+            assert name.endswith("_total"), (
+                "counter '{}' must end in _total".format(name))
+    # the registry refuses names outside the catalog (the code<->
+    # CATALOG leg of the triangle is enforcement, not convention)
+    registry = tmetrics.MetricsRegistry()
+    with pytest.raises(ValueError):
+        registry.counter("tpu_not_in_catalog_total")
 
 
 def test_points_registry_is_importable_and_described():

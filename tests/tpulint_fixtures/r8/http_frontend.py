@@ -10,6 +10,8 @@ class _Handler:
                 return "live"
             if path == "/v2/health/stats":
                 return "stats"
+            if path == "/metrics":
+                return "metrics"
         if method == "POST":
             if path.endswith("/generate_stream"):
                 return self._generate_stream()

@@ -110,6 +110,67 @@ def fail(msg):
     print("INVARIANT VIOLATED: {}".format(msg), file=sys.stderr)
 
 
+class RouterMetricsCheck:
+    """Per-cycle telemetry invariant for the router/fleet soaks
+    (ISSUE 10): ``GET /metrics`` on the router must stay scrapeable
+    under chaos, and its cumulative families (counters, histogram
+    buckets, and the ``*_total``/``*_count`` compatibility gauges)
+    must NEVER decrease or vanish across cycles — the fleet-aggregated
+    view must survive replica restarts and membership churn without
+    resetting."""
+
+    def __init__(self, router_url, context):
+        host, _, port = router_url.rpartition(":")
+        self.host, self.port = host, int(port)
+        self.context = context
+        self._prev = {}
+
+    def _scrape(self):
+        import http.client
+
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=10)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return resp.read().decode("utf-8", errors="replace")
+        except (OSError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    def check(self, cycle):
+        from tpuserver.metrics import is_cumulative, parse_prometheus_text
+
+        text = self._scrape()
+        if text is None:
+            fail("{} cycle {}: router /metrics not scrapeable".format(
+                self.context, cycle))
+            return
+        current = {}
+        for name, fam in parse_prometheus_text(text).items():
+            # the SAME cumulative-family rule the router's aggregator
+            # folds by — the soak checks what the router aggregates
+            if not is_cumulative(name, fam["type"]):
+                continue
+            for sample_name, labels, value in fam["samples"]:
+                current[(sample_name,
+                         tuple(sorted(labels.items())))] = value
+        for key, prev in self._prev.items():
+            now = current.get(key)
+            if now is None:
+                fail("{} cycle {}: fleet counter {} vanished from "
+                     "/metrics (aggregation reset?)".format(
+                         self.context, cycle, key))
+            elif now < prev:
+                fail("{} cycle {}: fleet counter {} DECREASED {} -> "
+                     "{} across a replica restart".format(
+                         self.context, cycle, key, prev, now))
+        self._prev = current
+
+
 def generate(core, prompt, n_tokens, parameters=None):
     req = InferRequest(
         "llama_generate",
@@ -401,6 +462,8 @@ def router_phase(cycles, soak, budget):
     print("reference captured; {} cycles of SIGTERM-drain + mid-stream "
           "severs through the router".format(cycles))
 
+    metrics_check = RouterMetricsCheck(router.url, "router")
+    metrics_check.check(-1)  # seed the baseline pre-chaos
     resumes = [0]
 
     def replica_stats(url):
@@ -490,6 +553,9 @@ def router_phase(cycles, soak, budget):
                 if model._scheduler is not None:
                     wait_no_leaks(model, "router cycle {} ({})".format(
                         cycle, scope))
+            # telemetry invariant: scrapeable + monotonic across the
+            # drain/revive (the fleet view must not reset)
+            metrics_check.check(cycle)
             stats = router.stats()
             print("cycle {:2d} handoffs={} failovers={} shed={} "
                   "client_resumes={}".format(
@@ -602,6 +668,10 @@ def fleet_phase(cycles, soak, budget):
         print("reference captured; {} cycles of SIGKILL "
               "mid-traffic".format(cycles))
 
+        metrics_check = RouterMetricsCheck(
+            supervisor.router.url, "fleet")
+        metrics_check.check(-1)  # seed the baseline pre-chaos
+
         for cycle in range(cycles):
             restarts_before = supervisor.stats()["replica_restarts"]
 
@@ -663,6 +733,11 @@ def fleet_phase(cycles, soak, budget):
                 fail("fleet cycle {}: replica count never recovered "
                      "to target (stats={})".format(
                          cycle, supervisor.stats()))
+            # telemetry invariant: the SIGKILLed replica's counters
+            # reset to zero in ITS exposition, but the router's
+            # fleet-aggregated view must stay monotonic — and stay
+            # scrapeable mid-heal
+            metrics_check.check(cycle)
             stats = supervisor.stats()
             print("cycle {:2d} restarts {} -> {} up={} handoffs={}"
                   .format(cycle, restarts_before,

@@ -38,6 +38,7 @@ import os
 import signal
 import sys
 import threading
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "src", "python"))
@@ -121,6 +122,27 @@ def build_parser():
     ap.add_argument("--llama-slots", type=int, default=None,
                     help="inprocess generation: continuous-batching "
                          "slots (default: the max swept concurrency)")
+    # distributed multi-process mode (perfanalyzer.coordinator — the
+    # reference's MPI-barrier coordination, SURVEY §2.2, over a
+    # localhost socket control channel)
+    ap.add_argument("--workers", type=int, default=0,
+                    help="fork N perf_analyzer worker processes, each "
+                         "pinned round-robin to one of --urls (or all "
+                         "driving -u, e.g. a fleet router); "
+                         "barrier-synchronized windows, ONE merged "
+                         "report (throughput = sum of worker "
+                         "inferences, percentiles from merged raw "
+                         "samples)")
+    ap.add_argument("--windows", type=int, default=3,
+                    help="distributed mode: synchronized measurement "
+                         "windows per run (default 3)")
+    ap.add_argument("--report-csv", default=None,
+                    help="distributed mode: per-window CSV in the "
+                         "reference report_writer schema")
+    ap.add_argument("--worker-connect", default=None,
+                    help=argparse.SUPPRESS)  # the spawned child mode
+    ap.add_argument("--worker-id", type=int, default=0,
+                    help=argparse.SUPPRESS)
     # output
     ap.add_argument("--csv", default=None, help="write CSV here")
     ap.add_argument("--json", default=None,
@@ -205,9 +227,181 @@ def build_generation_pool(metadata, args):
     return pool
 
 
+def run_worker(args):
+    """Hidden child mode (``--worker-connect``): one worker process of
+    a distributed run.  Drives closed-loop concurrency against its
+    pinned replica (``--urls`` round-robined by ``--worker-id``, else
+    ``-u``) continuously, and measures exactly the windows the
+    coordinator's barrier releases — raw latency records ship back so
+    the parent merges samples, never percentiles."""
+    from perfanalyzer.client_backend import build_input_pool, create_backend
+    from perfanalyzer.coordinator import WorkerChannel
+    from perfanalyzer.load_manager import ConcurrencyManager
+    from perfanalyzer.profiler import parse_range
+
+    level = parse_range(args.concurrency_range or "1")[0]
+    urls = ([u.strip() for u in args.urls.split(",") if u.strip()]
+            if args.urls else [args.url])
+    url = urls[args.worker_id % len(urls)]
+    backend = create_backend("http", url=url, max_inflight=level)
+    manager = None
+    channel = None
+    try:
+        metadata = backend.model_metadata(args.model)
+        config = backend.model_config(args.model)
+        pool = build_input_pool(
+            metadata, config,
+            pool_size=args.input_pool,
+            batch_size=args.batch_size,
+            shape_overrides=parse_shapes(args.shape),
+            const_overrides=parse_consts(args.input_const),
+            # distinct per-worker streams of inputs: no two workers
+            # replay the same request sequence in lockstep
+            seed=args.seed + 1000 * args.worker_id)
+        prepared = backend.prepare(args.model, pool)
+        manager = ConcurrencyManager(backend, args.model, prepared)
+        manager.change_level(level)
+        collector = manager.collector
+        channel = WorkerChannel(args.worker_connect, args.worker_id)
+
+        def run_window(duration_s, index):
+            collector.start_window()
+            t0 = time.perf_counter()
+            deadline = t0 + duration_s
+            while True:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or EARLY_EXIT.is_set():
+                    break
+                time.sleep(min(0.05, remaining))
+            duration = time.perf_counter() - t0
+            latencies, errors = collector.end_window()
+            # tokens is part of the window-result contract; scalar
+            # workers always send 0 (generation-mode workers are the
+            # ROADMAP item-5 leftover that will fill it)
+            return {"completed": len(latencies), "errors": errors,
+                    "duration_s": duration, "latencies_s": latencies,
+                    "tokens": 0}
+
+        channel.serve(run_window)
+    finally:
+        if channel is not None:
+            channel.close()
+        if manager is not None:
+            manager.stop()
+        backend.close()
+    return 0
+
+
+def run_coordinator(args):
+    """Parent mode (``--workers N``): fork N worker processes, run
+    barrier-synchronized windows, merge, and emit ONE report."""
+    import subprocess
+
+    from perfanalyzer.coordinator import (
+        Coordinator,
+        merge_windows,
+        reap_workers,
+    )
+    from perfanalyzer.profiler import ProfileResult, parse_range
+    from perfanalyzer.report import ReportWriter
+
+    if args.generation or args.request_rate_range:
+        raise SystemExit(
+            "--workers drives the closed-loop concurrency mode; "
+            "generation and request-rate modes are single-process")
+    if args.backend not in ("http",):
+        raise SystemExit(
+            "--workers spawns http worker processes; --backend {} is "
+            "single-process".format(args.backend))
+    levels = parse_range(args.concurrency_range or "1")
+    if len(levels) != 1:
+        raise SystemExit(
+            "--workers measures ONE concurrency level per run "
+            "(got sweep {})".format(levels))
+    level = levels[0]
+    window_s = args.measurement_interval / 1000.0
+    coord = Coordinator(args.workers).listen()
+    print("*** Measurement Settings ***\n"
+          "  model: {}  backend: http  mode: distributed_concurrency\n"
+          "  workers: {}  concurrency/worker: {}  windows: {} x {} ms "
+          "(barrier-synchronized)".format(
+              args.model, args.workers, level, args.windows,
+              args.measurement_interval), flush=True)
+    argv = [sys.executable, os.path.abspath(__file__),
+            "-m", args.model, "--backend", "http", "-u", args.url,
+            "--concurrency-range", str(level),
+            "--input-pool", str(args.input_pool),
+            "-b", str(args.batch_size), "--seed", str(args.seed)]
+    if args.urls:
+        argv += ["--urls", args.urls]
+    for entry in args.shape:
+        argv += ["--shape", entry]
+    for entry in args.input_const:
+        argv += ["--input-const", entry]
+    procs = []
+    window_rows = []
+    try:
+        for i in range(args.workers):
+            procs.append(subprocess.Popen(
+                argv + ["--worker-connect", coord.address,
+                        "--worker-id", str(i)]))
+        coord.wait_for_workers(timeout_s=120.0)
+        if args.warmup > 0:
+            # load is already flowing (workers start their managers
+            # before dialing in); the parent just waits it out
+            EARLY_EXIT.wait(args.warmup)
+        for index in range(args.windows):
+            if EARLY_EXIT.is_set():
+                break
+            row = coord.run_window(index, window_s)
+            row["concurrency"] = level * args.workers
+            if row.get("tokens") and row["duration_s"] > 0:
+                row["tokens_per_sec"] = row["tokens"] / row["duration_s"]
+            window_rows.append(row)
+            if args.verbose:
+                print("  window {:2d}: {:8.1f} infer/sec over {} "
+                      "workers".format(index + 1, row["throughput"],
+                                       row["workers"]), flush=True)
+    finally:
+        coord.shutdown()
+        reap_workers(procs)
+    if not window_rows:
+        print(json.dumps({"error": "no synchronized windows completed"}),
+              flush=True)
+        return 1
+    merged = merge_windows(window_rows)
+    result = ProfileResult(
+        mode="distributed_concurrency",
+        level=level * args.workers,
+        stable=True,
+        interrupted=EARLY_EXIT.is_set(),
+        trials=len(window_rows),
+        workers=args.workers,
+    )
+    result.update(merged)
+    writer = ReportWriter(
+        args.model, "http-x{}".format(args.workers),
+        extra_tags={"early_exit": True} if EARLY_EXIT.is_set() else None)
+    writer.print_table([result])
+    print()
+    writer.print_json([result])
+    if args.csv:
+        writer.write_csv(args.csv, [result])
+    if args.json:
+        writer.write_json(args.json, [result])
+    if args.report_csv:
+        writer.write_window_csv(args.report_csv, window_rows)
+    return 0
+
+
 def main(argv=None):
     args = build_parser().parse_args(argv)
     signal.signal(signal.SIGINT, _sigint_handler)
+
+    if args.worker_connect:
+        return run_worker(args)
+    if args.workers:
+        return run_coordinator(args)
 
     from perfanalyzer.client_backend import build_input_pool, create_backend
     from perfanalyzer.generation import GenerationProfiler
