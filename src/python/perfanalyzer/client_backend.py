@@ -93,6 +93,17 @@ class ClientBackend:
         surface in the report next to ``resumed_streams``."""
         return None
 
+    def prefix_cache_snapshot(self):
+        """Cumulative radix prefix-cache counters ``{"hits": tokens,
+        "misses": tokens}`` from the target's telemetry
+        (``tpu_prefix_cache_*_total``), or None when the transport
+        cannot reach them.  Against a fleet router the counters are
+        the churn-safe FLEET aggregate, so the generation profiler's
+        window delta is the fleet-wide hit rate — the number that
+        proves prefix-affinity routing keeps sibling prompts on warm
+        replicas."""
+        return None
+
     # -- inference --------------------------------------------------------
 
     def prepare(self, model, input_sets):
@@ -258,6 +269,17 @@ class InProcessBackend(ClientBackend):
         except ServerError as e:
             raise BackendError(str(e)) from e
 
+    def prefix_cache_snapshot(self):
+        hits = misses = 0
+        seen = False
+        for stats in (self.core.health_snapshot().get("models")
+                      or {}).values():
+            if isinstance(stats, dict) and "prefix_hits" in stats:
+                seen = True
+                hits += _coerce_int(stats.get("prefix_hits"))
+                misses += _coerce_int(stats.get("prefix_misses"))
+        return {"hits": hits, "misses": misses} if seen else None
+
 
 # -- HTTP backend ----------------------------------------------------------
 
@@ -284,34 +306,51 @@ class HttpBackend(ClientBackend):
         # replica (the 404 verdict is cached), True = fleet router
         self._is_router = None
 
+    def _http_get(self, path):
+        """One raw GET against the target's host:port, outside the
+        triton client (these probe NON-KServe surfaces: /router/stats,
+        /metrics).  Returns ``(status, body_bytes)``, or None on a
+        port-less url or a transport/protocol error — the shared
+        plumbing of every snapshot probe on this backend."""
+        import http.client as _http_client
+
+        host, sep, port = self.url.rpartition(":")
+        if not sep or not port.isdigit():
+            return None
+        conn = _http_client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (OSError, ValueError, _http_client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
     def router_snapshot(self):
         """``/router/stats`` counters when the url fronts a
         FleetRouter; a plain replica answers 404 once and is never
         probed again."""
         if self._is_router is False:
             return None
-        import http.client as _http_client
         import json as _json
 
-        host, sep, port = self.url.rpartition(":")
-        if not sep or not port.isdigit():
-            # base-path or port-less url: the raw /router/stats probe
-            # cannot reach a router through it — permanent verdict, and
-            # never a crashed profile sweep
+        got = self._http_get("/router/stats")
+        if got is None:
+            # port-less url can never reach a router: latch; a
+            # transport error is transient: do not latch the verdict
+            host, sep, port = self.url.rpartition(":")
+            if not sep or not port.isdigit():
+                self._is_router = False
+            return None
+        status, body = got
+        if status != 200:
             self._is_router = False
             return None
-        conn = _http_client.HTTPConnection(host, int(port), timeout=5)
         try:
-            conn.request("GET", "/router/stats")
-            resp = conn.getresponse()
-            if resp.status != 200:
-                self._is_router = False
-                return None
-            snap = _json.loads(resp.read())
-        except (OSError, ValueError, _http_client.HTTPException):
-            return None  # transient: do not latch the verdict
-        finally:
-            conn.close()
+            snap = _json.loads(body)
+        except ValueError:
+            return None
         self._is_router = True
         out = {
             "failovers": _coerce_int(snap.get("failovers")),
@@ -329,6 +368,26 @@ class HttpBackend(ClientBackend):
                 if key in supervisor:
                     out["supervisor_" + key] = _coerce_int(
                         supervisor.get(key))
+        return out
+
+    def prefix_cache_snapshot(self):
+        """The target's ``/metrics`` prefix-cache counters summed
+        across label sets — against a router this is the fleet
+        aggregate (replica restarts and churn already folded in)."""
+        from tpuserver.metrics import parse_prometheus_text
+
+        got = self._http_get("/metrics")
+        if got is None or got[0] != 200:
+            return None
+        families = parse_prometheus_text(
+            got[1].decode("utf-8", errors="replace"))
+        out = {}
+        for key, fam_name in (("hits", "tpu_prefix_cache_hits_total"),
+                              ("misses", "tpu_prefix_cache_misses_total")):
+            fam = families.get(fam_name)
+            if fam is None:
+                return None  # pre-paging server: no column
+            out[key] = int(sum(v for _, _, v in fam["samples"]))
         return out
 
     def model_metadata(self, model):

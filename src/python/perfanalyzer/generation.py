@@ -236,6 +236,11 @@ class GenerationProfiler:
             self.stability_pct, self.stability_windows,
             check_latency=False)
         router_before = self.backend.router_snapshot()
+        # radix prefix-cache counters (replica /metrics, or the fleet
+        # aggregate through a router): the level delta becomes the
+        # report's hit-rate column — post-warmup, so compile-time
+        # admissions stay out of the rate
+        prefix_before = self.backend.prefix_cache_snapshot()
         windows = []
         stable = False
         interrupted = False
@@ -283,6 +288,16 @@ class GenerationProfiler:
         )
         metrics.attach_router_delta(result, router_before,
                                     self.backend.router_snapshot())
+        prefix_after = self.backend.prefix_cache_snapshot()
+        if prefix_before is not None and prefix_after is not None:
+            # counters are cumulative and churn-safe (the router view
+            # never decreases); max() guards a replaced plain replica
+            dh = max(0, prefix_after["hits"] - prefix_before["hits"])
+            dm = max(0, prefix_after["misses"] - prefix_before["misses"])
+            result["prefix_cache_hits"] = dh
+            result["prefix_cache_misses"] = dm
+            result["prefix_hit_pct"] = (
+                100.0 * dh / (dh + dm) if dh + dm else None)
         for prefix, sample in (("ttft", ttfts), ("itl", itls)):
             if sample:
                 ms = sorted(v * 1e3 for v in sample)

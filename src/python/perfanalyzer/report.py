@@ -43,6 +43,7 @@ _GEN_COLUMNS = [
     ("itl_p50_ms", "{:.2f}"),
     ("itl_p90_ms", "{:.2f}"),
     ("itl_p99_ms", "{:.2f}"),
+    ("prefix_hit_pct", "{:.1f}"),
     ("errors", "{:d}"),
     ("stable", "{}"),
 ]
@@ -50,7 +51,7 @@ _GEN_COLUMNS = [
 _GEN_HEADERS = [
     "Streams", "tokens/sec", "gen/sec", "TTFT avg(ms)", "TTFT p50(ms)",
     "TTFT p99(ms)", "ITL p50(ms)", "ITL p90(ms)", "ITL p99(ms)",
-    "errors", "stable",
+    "prefix-hit%", "errors", "stable",
 ]
 
 #: Per-window CSV schema: the reference ReportWriter's columns

@@ -1003,6 +1003,15 @@ class InferenceServer:
             "tpu_scheduler_replay_hits_total": "replay_hits",
             "tpu_scheduler_live_streams": "live_streams",
             "tpu_scheduler_pending": "pending",
+            # paged KV + radix prefix cache (PR 11): the counters
+            # perfanalyzer's hit-rate column window-diffs, and the
+            # page-utilization gauges
+            "tpu_prefix_cache_hits_total": "prefix_hits",
+            "tpu_prefix_cache_misses_total": "prefix_misses",
+            "tpu_prefix_cache_evictions_total": "prefix_evictions",
+            "tpu_kv_pages_total": "pages_total",
+            "tpu_kv_pages_free": "pages_free",
+            "tpu_kv_pages_cached": "pages_cached",
         }
         samples = {name: [] for name in per_family}
         for model_name, model in items:
@@ -1133,7 +1142,12 @@ class InferenceServer:
     def _exit_inflight(self):
         with self._inflight_cond:
             self._inflight -= 1
-            self._inflight_cond.notify_all()
+            # the only waiter is drain()'s inflight==0 loop, and it can
+            # only be waiting after begin_drain() flipped the state (a
+            # flip this exit cannot miss: both run under the cond) — a
+            # ready-state exit pays no wakeup syscall on the hot path
+            if self._state != "ready":
+                self._inflight_cond.notify_all()
 
     def inflight_count(self):
         with self._inflight_cond:
@@ -1893,20 +1907,47 @@ class InferenceServer:
             out = out.reshape(-1)
         return out
 
+    #: delivery options of a default (no requested_outputs) response:
+    #: one shared immutable dict instead of a per-output allocation on
+    #: the hot path — consumers only read it
+    _DEFAULT_DELIVERY = {"binary_data": True, "shm_region": None,
+                         "shm_byte_size": 0, "shm_offset": 0}
+
     def _make_response(self, model, request, outputs, mark_final=True):
         declared = {t.name: t for t in model.outputs}
         requested = request.requested_outputs
-        if requested:
-            wanted = []
-            for ro in requested:
-                if ro.name not in outputs:
-                    raise ServerError(
-                        "unexpected inference output '{}' for model "
-                        "'{}'".format(ro.name, model.name)
-                    )
-                wanted.append(ro)
-        else:
-            wanted = [RequestedOutput(name) for name in outputs]
+        if not requested:
+            # the overwhelmingly common shape (every output, wire
+            # delivery, no classification): skip the RequestedOutput
+            # and per-output delivery-dict allocations entirely —
+            # measured at several percent of the simple-model
+            # per-request hot path (ISSUE 11 headline recapture)
+            resp_outputs = []
+            for name, array in outputs.items():
+                spec = declared.get(name)
+                datatype = spec.datatype if spec is not None else None
+                if not datatype:
+                    datatype = _np_to_wire(array)
+                np_arr = np.asarray(array) if not hasattr(
+                    array, "addressable_shards"
+                ) else array
+                resp_outputs.append((
+                    {"name": name, "datatype": datatype,
+                     "shape": list(np_arr.shape)},
+                    np.asarray(np_arr),
+                    self._DEFAULT_DELIVERY,
+                ))
+            return InferResponse(
+                model.name, model.version, request.id, resp_outputs
+            )
+        wanted = []
+        for ro in requested:
+            if ro.name not in outputs:
+                raise ServerError(
+                    "unexpected inference output '{}' for model "
+                    "'{}'".format(ro.name, model.name)
+                )
+            wanted.append(ro)
 
         resp_outputs = []
         for ro in wanted:

@@ -105,6 +105,27 @@ CATALOG = {
     "tpu_scheduler_step_seconds": (
         "histogram",
         "Batched decode-step dispatch latency, per model, seconds."),
+    # -- paged KV + radix prefix cache -------------------------------------
+    "tpu_prefix_cache_hits_total": (
+        "counter",
+        "Prompt tokens served from shared radix-cache pages at "
+        "admission (skipped prefill), per model."),
+    "tpu_prefix_cache_misses_total": (
+        "counter",
+        "Prompt tokens actually prefilled at admission (cold or "
+        "unshared), per model."),
+    "tpu_prefix_cache_evictions_total": (
+        "counter",
+        "KV pages evicted from the radix prefix cache under memory "
+        "pressure (LRU, unpinned branches only), per model."),
+    "tpu_kv_pages_total": (
+        "gauge", "KV page pool size, per model."),
+    "tpu_kv_pages_free": (
+        "gauge", "KV pages on the free list, per model."),
+    "tpu_kv_pages_cached": (
+        "gauge",
+        "KV pages held only by the radix prefix cache (unpinned, "
+        "evictable), per model."),
     # -- fleet router ------------------------------------------------------
     "tpu_router_failovers_total": (
         "counter", "Requests re-routed to another replica."),
@@ -127,6 +148,10 @@ CATALOG = {
         "gauge",
         "Routing load score per replica (probe load + router-local "
         "in-flight)."),
+    "tpu_router_affinity_routed_total": (
+        "counter",
+        "Generation admissions routed to their prompt prefix's warm "
+        "(affine) replica — the radix cache was already primed."),
     # -- fleet supervisor (process-level healing) --------------------------
     "tpu_fleet_replica_restarts_total": (
         "counter", "Replica processes healed by the supervisor."),

@@ -867,30 +867,239 @@ def scheduler_extract(cache, slot):
     return lax.dynamic_slice_in_dim(cache, slot, 1, axis=2)
 
 
-def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
-    """Compiled function bundle for the continuous-batching scheduler.
+# -- paged KV (block-granular cache pool) ------------------------------------
+
+
+def init_paged_kv_cache(cfg, n_pages, page_size, dtype=None):
+    """[n_layers, 2, n_pages, page_size, n_kv_heads, head_dim] page
+    pool — the paged form of :func:`init_kv_cache`.  A sequence's KV
+    lives scattered across pages named by its page table; page id
+    ``n_pages`` is the out-of-bounds scatter sentinel (writes drop)."""
+    dtype = dtype or cfg.dtype
+    return jnp.zeros(
+        (cfg.n_layers, 2, n_pages, page_size, cfg.n_kv_heads,
+         cfg.head_dim),
+        dtype,
+    )
+
+
+def paged_batched_decode_step(params, pages, tokens, page_tables,
+                              positions, cfg):
+    """:func:`batched_decode_step` over a paged pool: one decode token
+    per sequence row, with each row's KV scattered across the physical
+    pages its ``page_tables`` row names.
+
+    ``pages`` is the pool from :func:`init_paged_kv_cache`;
+    ``page_tables`` [S, pages_per_seq] int32 maps each row's logical
+    pages to physical ids (entries may be the sentinel ``n_pages`` for
+    unreserved logical pages — they are never read below the row's
+    valid length and never written).  Per layer the row's pages gather
+    into the same contiguous [S, max_seq] view the slotted step
+    attends over — identical values in identical order, so greedy
+    tokens are bitwise equal to the contiguous step's (A/B-pinned in
+    tests/test_paged_kv.py).  The gather is the CPU-sim functional
+    model of paged attention; a production TPU path would stream pages
+    inside a Pallas kernel instead of materializing the view.
+
+    New K/V writes land at (``page_tables[s, positions[s] //
+    page_size]``, ``positions[s] % page_size``); rows at the sentinel
+    position ``max_seq`` drop their writes, exactly like the slotted
+    step's out-of-bounds rows.
+    """
+    S = tokens.shape[0]
+    n_pages, page = pages.shape[2], pages.shape[3]
+    ppseq = page_tables.shape[1]
+    max_seq = ppseq * page
+    # inert rows clamp to length 1 (see batched_decode_step)
+    lengths = jnp.where(positions >= max_seq, 1, positions + 1)
+    logical = jnp.clip(positions // page, 0, ppseq - 1)
+    phys = jnp.take_along_axis(page_tables, logical[:, None], axis=1)[:, 0]
+    # sentinel rows scatter out of bounds -> dropped (mode="drop")
+    phys = jnp.where(positions >= max_seq, n_pages, phys)
+    offs = positions % page
+    q_pos = positions[:, None]
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    x = _embed_rows(params, tokens, cfg)[:, None, :]  # [S, 1, Dm]
+    new_pages = pages
+    # unreserved logical pages clip to a valid (arbitrary) physical
+    # page: everything they contribute sits beyond the row's valid
+    # length and is masked
+    tbl = jnp.clip(page_tables, 0, n_pages - 1)
+    pallas_block = next((b for b in (256, 128) if max_seq % b == 0), None)
+    impl = cfg.decode_impl
+    if impl == "auto":
+        impl = _select_decode_impl(max_seq, None)
+
+    for i, layer in enumerate(params["layers"]):
+        def attn_fn(q, k, v, i=i):
+            nonlocal new_pages
+            new_pages = new_pages.at[i, 0, phys, offs].set(
+                k[:, 0].astype(new_pages.dtype), mode="drop"
+            )
+            new_pages = new_pages.at[i, 1, phys, offs].set(
+                v[:, 0].astype(new_pages.dtype), mode="drop"
+            )
+            tail = new_pages.shape[4:]
+            k_seq = new_pages[i, 0][tbl].reshape(S, max_seq, *tail)
+            v_seq = new_pages[i, 1][tbl].reshape(S, max_seq, *tail)
+            if impl == "pallas" and pallas_block is not None:
+                # the gathered view is a standard contiguous cache:
+                # the decode-attention kernel applies unchanged
+                from tpuserver.ops import decode_attention
+
+                out = decode_attention(
+                    q[:, 0], k_seq, v_seq, lengths.astype(jnp.int32),
+                    block_k=pallas_block,
+                )
+                return out[:, None]
+            return _attend_cached(q, k_seq, v_seq, q_pos, lengths, n_rep)
+
+        x = _block(layer, x, q_pos, cfg, attn_fn)
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = _mm(x[:, 0, :], params["lm_head"]).astype(jnp.float32)
+    return logits, new_pages
+
+
+def paged_scheduler_step(params, pages, logits_all, page_tables,
+                         positions, active, forced, forced_mask, cfg):
+    """:func:`scheduler_step` on the paged pool: greedy-or-forced
+    token per row, then one :func:`paged_batched_decode_step`.  Same
+    sampling math as the slotted form — the page indirection changes
+    where K/V bytes live, never what they are."""
+    logp = jax.nn.log_softmax(logits_all, axis=-1)
+    greedy = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(forced_mask, forced, greedy)
+    tok_logp = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    new_logits, new_pages = paged_batched_decode_step(
+        params, pages, tokens, page_tables, positions, cfg
+    )
+    new_logits = jnp.where(active[:, None], new_logits, logits_all)
+    return tokens, tok_logp, new_logits, new_pages
+
+
+def paged_admit(pages, logits_all, slot_cache, slot_logits, dest_ids,
+                slot):
+    """Admit one prefilled request into the paged pool: the single-row
+    contiguous cache [L, 2, 1, max_seq, Hkv, hd] splits into
+    ``pages_per_seq`` logical pages and scatters to the physical ids
+    ``dest_ids`` names (the sentinel ``n_pages`` drops a page — shared
+    prefix pages already live in the pool and must not be rewritten).
+    The row's next-token logits land in ``logits_all`` row ``slot``."""
+    page = pages.shape[3]
+    ppseq = dest_ids.shape[0]
+    src = slot_cache.reshape(
+        slot_cache.shape[0], 2, ppseq, page, *slot_cache.shape[4:]
+    )
+    pages = pages.at[:, :, dest_ids].set(
+        src.astype(pages.dtype), mode="drop"
+    )
+    logits_all = lax.dynamic_update_slice_in_dim(
+        logits_all, slot_logits.astype(logits_all.dtype), slot, axis=0
+    )
+    return pages, logits_all
+
+
+def paged_gather(pages, page_ids):
+    """One sequence's pages as a fresh single-row contiguous cache
+    [L, 2, 1, max_seq, Hkv, hd] — the park/extract shape (so paged
+    park/resume interoperates with the single-stream path) and the
+    prefix-restore source a shared-prefix admission prefills on top
+    of.  Sentinel/unreserved ids gather as zeros."""
+    n_pages, page = pages.shape[2], pages.shape[3]
+    ppseq = page_ids.shape[0]
+    valid = (page_ids >= 0) & (page_ids < n_pages)
+    ids = jnp.clip(page_ids, 0, n_pages - 1)
+    rows = pages[:, :, ids]  # [L, 2, ppseq, page, Hkv, hd]
+    rows = jnp.where(
+        valid[None, None, :, None, None, None], rows,
+        jnp.zeros((), rows.dtype),
+    )
+    return rows.reshape(
+        pages.shape[0], 2, 1, ppseq * page, *pages.shape[4:]
+    )
+
+
+def prefill_span(params, cache, tokens, start, logits_at, cfg):
+    """Prefill a token span at positions ``start..start+T-1`` into a
+    single-row contiguous cache — the chunked-prefill and
+    shared-prefix-suffix building block.
+
+    Generalizes :func:`prefill_to_length`: K/V land at ``write_pos =
+    start`` and queries attend the cache's first ``start + T``
+    positions under the causal mask, so a span conditioned on an
+    already-present prefix (earlier chunks, or a radix-cache restore)
+    computes exactly what a from-zero prefill would.  All keys read
+    from the cache post-write (the dense cached path), so chunked
+    output is bitwise identical to one-shot dense prefill — the
+    token-identity contract tests/test_paged_kv.py pins.  The caller
+    guarantees ``start + T <= max_seq`` (XLA would silently clamp the
+    write start otherwise) and that the flash prefill kernel is not in
+    play for this model (``make_scheduler_fns`` gates chunking/sharing
+    with ``span_safe`` exactly like :func:`prefill_bucket` gates
+    padding).
+
+    Returns the logits at chunk-relative index ``logits_at`` (only
+    meaningful on the span containing the prompt's last token) and
+    the updated cache."""
+    B, T = tokens.shape
+    positions = start + jnp.tile(jnp.arange(T)[None, :], (B, 1))
+    x = _embed_rows(params, tokens, cfg)
+    x, new_cache = _run_cached(
+        params, cache, x, positions, start, start + T, cfg
+    )
+    x = _rms_norm(x, params["norm"], cfg.norm_eps)
+    last = lax.dynamic_slice_in_dim(x, logits_at, 1, axis=1)[:, 0]
+    logits = _mm(last, params["lm_head"]).astype(jnp.float32)
+    return logits, new_cache
+
+
+def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False,
+                       page_size=16, kv_pages=None):
+    """Compiled function bundle for the continuous-batching scheduler,
+    over a block-paged KV pool.
+
+    The device cache is a page pool [n_layers, 2, kv_pages, page_size,
+    n_kv_heads, head_dim] (:func:`init_paged_kv_cache`) rather than
+    ``max_slots`` contiguous rows: a sequence occupies only the pages
+    its length spans, page tables map logical to physical pages, and
+    the scheduler's host-side allocator/radix tree
+    (``tpuserver.paging``) decides who owns what.  ``kv_pages``
+    defaults to ``max_slots * max_seq / page_size`` — byte-identical
+    capacity to the old slotted cache, which shared prefixes and short
+    spans then stretch across MORE concurrent streams.
 
     Returns a dict of:
 
-    - ``init_cache()`` — the slotted KV cache
-      [n_layers, 2, max_slots, max_seq, n_kv_heads, head_dim]
-    - ``init_slot_cache()`` — a single-row cache for prefill-on-admit
+    - ``init_cache()`` — the page pool
+    - ``init_slot_cache()`` — a single-row contiguous cache for
+      prefill-on-admit (scattered into pages by ``admit``)
     - ``init_logits()`` — [max_slots, vocab] fp32 zeros
-    - ``prefill(params, slot_cache, tokens, true_len)`` — the admission
-      prefill (:func:`prefill_to_length`: prompts arrive padded to a
-      bucket so the compile set stays bounded)
+    - ``prefill(params, slot_cache, tokens, true_len)`` — the one-shot
+      admission prefill (:func:`prefill_to_length`)
+    - ``prefill_span(params, slot_cache, tokens, start, logits_at)`` —
+      the chunked / shared-prefix-suffix prefill
+      (:func:`prefill_span`)
     - ``prefill_bucket(true_len)`` — the padded length to use
-      (:func:`prefill_bucket`: exact length where padding would change
-      the flash/dense prefill decision and with it the greedy tokens)
-    - ``step(params, cache, logits, positions, active, forced,
-      forced_mask)`` — :func:`scheduler_step`, cache and logits donated
-    - ``admit(cache, logits, slot_cache, slot_logits, slot)`` — donated
-    - ``extract(cache, slot)`` — the park copy (cache NOT donated)
+    - ``step(params, pages, logits, page_tables, positions, active,
+      forced, forced_mask)`` — :func:`paged_scheduler_step`, pages and
+      logits donated
+    - ``admit(pages, logits, slot_cache, slot_logits, dest_ids,
+      slot)`` — :func:`paged_admit`, pages and logits donated
+    - ``gather(pages, page_ids)`` — :func:`paged_gather`: the park
+      copy AND the shared-prefix restore (pages NOT donated)
+    - ``page_size`` / ``pages_per_seq`` / ``n_pages`` — the pool
+      geometry the scheduler's allocator mirrors
+    - ``span_safe`` — whether chunked/shared prefill preserves the
+      one-shot kernel choice (False for flash-prefill configs: a
+      dense chunk vs a one-shot flash pass could flip a near-tie
+      greedy argmax, the same hazard :func:`prefill_bucket` guards,
+      so the scheduler falls back to whole-prompt prefill there)
 
-    With a ``mesh`` the bundle is the GSPMD form: params Megatron-split,
-    both caches kv-head-sharded over tp (``cache_spec``), logits and the
-    per-slot control vectors replicated — the same sharding rules as
-    ``make_tp_serving``, applied to the slotted shape.
+    With a ``mesh`` the bundle is the GSPMD form: params
+    Megatron-split, the page pool and slot cache kv-head-sharded over
+    tp (``cache_spec`` — the page axes are unsharded, so the
+    gather/scatter indexing stays collective-free), control vectors
+    replicated.
     """
     if mesh is not None and (cfg.n_heads % mesh.shape["tp"]
                              or cfg.n_kv_heads % mesh.shape["tp"]):
@@ -899,17 +1108,39 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
                 mesh.shape["tp"], cfg.n_heads, cfg.n_kv_heads
             )
         )
+    page_size = int(page_size)
+    if page_size < 1 or max_seq % page_size:
+        raise ValueError(
+            "page_size must be >= 1 and divide max_seq (got page_size="
+            "{}, max_seq={}): the park/extract row shape must stay "
+            "[.., max_seq, ..] for single-stream interop".format(
+                page_size, max_seq
+            )
+        )
+    pages_per_seq = max_seq // page_size
+    n_pages = int(kv_pages) if kv_pages is not None \
+        else max_slots * pages_per_seq
+    if n_pages < pages_per_seq:
+        raise ValueError(
+            "kv_pages={} cannot hold even one full-length sequence "
+            "({} pages of {} tokens)".format(
+                n_pages, pages_per_seq, page_size
+            )
+        )
     if mesh is None:
         step = jax.jit(
-            functools.partial(scheduler_step, cfg=cfg),
+            functools.partial(paged_scheduler_step, cfg=cfg),
             donate_argnums=(1, 2),
         )
-        admit = jax.jit(scheduler_admit, donate_argnums=(0, 1))
-        extract = jax.jit(scheduler_extract)
+        admit = jax.jit(paged_admit, donate_argnums=(0, 1))
+        gather = jax.jit(paged_gather)
         prefill_fn = jax.jit(functools.partial(prefill_to_length, cfg=cfg))
+        prefill_span_fn = jax.jit(
+            functools.partial(prefill_span, cfg=cfg),
+        )
 
         def init_cache():
-            return init_kv_cache(cfg, max_slots, max_seq)
+            return init_paged_kv_cache(cfg, n_pages, page_size)
 
         def init_slot_cache():
             return init_kv_cache(cfg, 1, max_seq)
@@ -922,19 +1153,20 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
             mesh, cfg, quantized=quantized
         )
         step = jax.jit(
-            functools.partial(scheduler_step, cfg=cfg),
-            in_shardings=(param_sh, cache_sh, repl, repl, repl, repl, repl),
+            functools.partial(paged_scheduler_step, cfg=cfg),
+            in_shardings=(param_sh, cache_sh, repl, repl, repl, repl,
+                          repl, repl),
             out_shardings=(repl, repl, repl, cache_sh),
             donate_argnums=(1, 2),
         )
         admit = jax.jit(
-            scheduler_admit,
-            in_shardings=(cache_sh, repl, cache_sh, repl, repl),
+            paged_admit,
+            in_shardings=(cache_sh, repl, cache_sh, repl, repl, repl),
             out_shardings=(cache_sh, repl),
             donate_argnums=(0, 1),
         )
-        extract = jax.jit(
-            scheduler_extract,
+        gather = jax.jit(
+            paged_gather,
             in_shardings=(cache_sh, repl),
             out_shardings=cache_sh,
         )
@@ -943,10 +1175,15 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
             in_shardings=(param_sh, cache_sh, repl, repl),
             out_shardings=(repl, cache_sh),
         )
+        prefill_span_fn = jax.jit(
+            functools.partial(prefill_span, cfg=cfg),
+            in_shardings=(param_sh, cache_sh, repl, repl, repl),
+            out_shardings=(repl, cache_sh),
+        )
 
         def init_cache():
             return jax.device_put(
-                init_kv_cache(cfg, max_slots, max_seq), cache_sh
+                init_paged_kv_cache(cfg, n_pages, page_size), cache_sh
             )
 
         def init_slot_cache():
@@ -962,10 +1199,15 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False):
         "init_slot_cache": init_slot_cache,
         "init_logits": init_logits,
         "prefill": prefill_fn,
+        "prefill_span": prefill_span_fn,
         "prefill_bucket": functools.partial(prefill_bucket, cfg, max_seq),
         "step": step,
         "admit": admit,
-        "extract": extract,
+        "gather": gather,
+        "page_size": page_size,
+        "pages_per_seq": pages_per_seq,
+        "n_pages": n_pages,
+        "span_safe": cfg.attn_impl != "pallas",
     }
 
 

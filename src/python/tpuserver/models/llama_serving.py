@@ -27,15 +27,19 @@ re-prefilling — the TPU-shm analogue of the reference's CUDA-shm tensor
 passing, applied to generation state.
 
 Continuous batching (``max_slots > 1``): generation routes through the
-``tpuserver.scheduler.DecodeScheduler`` — a slotted KV cache and a
-background loop running one batched decode step for ALL in-flight
-streams per iteration, admitting waiting requests into freed slots
-mid-flight.  Greedy tokens are identical to the single-stream path
-(test-enforced); ``max_slots=1`` (the default) keeps the original
-single-stream pipelined path byte-for-byte, so existing tests and BENCH
-numbers stay reproducible.  An optional ``eos_id`` request parameter
-ends a generation early on that token (emitted, then the slot retires
-and is reused), on both paths.
+``tpuserver.scheduler.DecodeScheduler`` — a block-paged KV pool
+(``page_size``-token pages, ``kv_pages`` bound, radix prefix cache
+deduplicating shared prompt prefixes, chunked prefill past
+``prefill_chunk_tokens``) and a background loop running one batched
+decode step for ALL in-flight streams per iteration, admitting waiting
+requests into freed slots mid-flight as long as pages remain.  Greedy
+tokens are identical to the single-stream path (test-enforced);
+``max_slots=1`` (the default) keeps the original single-stream
+pipelined path byte-for-byte, so existing tests and BENCH numbers stay
+reproducible.  An optional ``eos_id`` request parameter ends a
+generation early on that token (emitted, then the slot retires and is
+reused), on both paths.  See docs/resilience.md "Paged KV cache &
+radix prefix cache".
 """
 
 import threading
@@ -75,7 +79,9 @@ class LlamaGenerateModel(Model):
                  max_slots=1, max_pending=None, fault_scope=None,
                  step_timeout_s=None, max_restarts=5,
                  restart_window_s=60.0, restart_backoff_s=0.05,
-                 replay_ttl_s=60.0, replay_capacity=256):
+                 replay_ttl_s=60.0, replay_capacity=256,
+                 page_size=16, kv_pages=None, prefill_chunk_tokens=256,
+                 prefix_cache=True):
         self._cfg = cfg or llama.tiny(vocab=2048)
         # replica identity threaded to the scheduler's fault-injection
         # points (multi-replica chaos harnesses)
@@ -101,6 +107,14 @@ class LlamaGenerateModel(Model):
         self._restart_backoff_s = restart_backoff_s
         self._replay_ttl_s = replay_ttl_s
         self._replay_capacity = replay_capacity
+        # paged-KV geometry (continuous batching only): fixed-size KV
+        # pages, pool bound (None = max_slots full-length sequences —
+        # byte-identical capacity to the old slotted cache), chunked-
+        # prefill bound, and the radix prefix-cache toggle
+        self._page_size = page_size
+        self._kv_pages = kv_pages
+        self._prefill_chunk_tokens = prefill_chunk_tokens
+        self._prefix_cache = prefix_cache
         self._scheduler = None  # DecodeScheduler when max_slots > 1
         # continuous-batching models interleave many streams' responses;
         # the frontends must not serialize their stream requests
@@ -162,6 +176,8 @@ class LlamaGenerateModel(Model):
                     fns = llama.make_scheduler_fns(
                         self._cfg, self._max_seq, self._max_slots,
                         mesh=self._mesh, quantized=self._quantize,
+                        page_size=self._page_size,
+                        kv_pages=self._kv_pages,
                     )
                     self._scheduler = DecodeScheduler(
                         fns, params, self._max_slots, self._max_seq,
@@ -173,6 +189,8 @@ class LlamaGenerateModel(Model):
                         restart_backoff_s=self._restart_backoff_s,
                         replay_ttl_s=self._replay_ttl_s,
                         replay_capacity=self._replay_capacity,
+                        prefill_chunk_tokens=self._prefill_chunk_tokens,
+                        prefix_cache=self._prefix_cache,
                         # queue-wait/step latency histograms land in
                         # the attached server's /metrics registry
                         # (lock-free observes — the decode loop never

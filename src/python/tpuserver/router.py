@@ -16,9 +16,14 @@ replica, load-balancing N backends with four robustness behaviors:
    into a per-replica eligibility flag and load score: draining,
    tripped (restart budget exhausted), or stopped replicas rotate out
    *before* a request lands on them, and requests go to the
-   least-loaded eligible replica.  A router-level ``max_inflight`` cap
-   sheds excess load with a typed 429 + ``Retry-After`` instead of
-   queueing.
+   least-loaded eligible replica.  Generation admissions additionally
+   carry **prefix affinity**: the router hashes the prompt's leading
+   tokens and prefers (as a load-score bonus, never an eligibility
+   override) the replica that last served that prefix — whose radix
+   prefix cache is already warm, so the shared-system-prompt traffic
+   shape prefills nearly free fleet-wide.  A router-level
+   ``max_inflight`` cap sheds excess load with a typed 429 +
+   ``Retry-After`` instead of queueing.
 2. **Sticky resume.**  Every routed generation gets a router-assigned
    ``generation_id`` and a generation→home-replica map whose TTL
    matches the replicas' ``replay_ttl_s``; a reconnect carrying
@@ -677,7 +682,8 @@ class FleetRouter:
     def __init__(self, backends, host="127.0.0.1", port=0,
                  probe_interval_s=1.0, probe_timeout_s=2.0,
                  max_inflight=None, gen_ttl_s=60.0, gen_capacity=1024,
-                 read_timeout_s=600.0, stream_wait_s=5.0, verbose=False):
+                 read_timeout_s=600.0, stream_wait_s=5.0, verbose=False,
+                 affinity_bonus=2.0, affinity_prefix_tokens=16):
         if not backends:
             raise ValueError("FleetRouter requires at least one backend")
         if len(set(backends)) != len(backends):
@@ -710,6 +716,20 @@ class FleetRouter:
         self._failovers = 0  # guarded-by: _lock
         self._handoffs = 0   # guarded-by: _lock
         self._resumed = 0    # guarded-by: _lock
+        # prefix-affinity routing (the fleet half of the replicas'
+        # radix prefix cache): prompt-prefix hash -> (replica url,
+        # expires_monotonic).  A generation admission whose prefix was
+        # recently served routes to the replica whose radix cache is
+        # already warm — as a LOAD-SCORE BONUS only: health, drain and
+        # eligibility always win, and a busier-by-more-than-the-bonus
+        # warm replica loses to a colder idle one.
+        # ``affinity_bonus <= 0`` disables the signal (hash-blind
+        # routing — the perfanalyzer A/B control).
+        self._affinity_bonus = float(affinity_bonus)
+        self._affinity_prefix_tokens = int(affinity_prefix_tokens)
+        # prefix hash -> (url, expires)  # guarded-by: _lock
+        self._affinity = OrderedDict()
+        self._affinity_routed = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self._httpd = _RouterServer((host, port), _RouterHandler)
         self._httpd.router = self
@@ -895,7 +915,7 @@ class FleetRouter:
 
     # -- routing -----------------------------------------------------------
 
-    def pick_replica(self, exclude=(), replicas=None):
+    def pick_replica(self, exclude=(), replicas=None, prefer=None):
         """The least-loaded eligible replica (ties break on backend
         order), or — when nothing is eligible — the least-failed
         ineligible one as a last resort, so a fleet whose probes all
@@ -903,7 +923,13 @@ class FleetRouter:
         every request.  ``exclude`` holds urls already tried;
         ``replicas`` lets a request-scoped loop pick from its own
         membership snapshot.  A removed replica is never picked, even
-        from a stale snapshot."""
+        from a stale snapshot.
+
+        ``prefer`` names a url whose load score gets the affinity
+        bonus subtracted (its radix prefix cache is presumed warm for
+        this request) — a bonus on an ELIGIBLE replica's score only,
+        never an eligibility override: a draining, tripped or
+        much-busier preferred replica still loses."""
         eligible, fallback = [], []
         if replicas is None:
             replicas = self._replicas_snapshot()
@@ -911,11 +937,67 @@ class FleetRouter:
             if rep.url in exclude or rep.removed.is_set():
                 continue
             ok, load = rep.routable()
+            if ok and prefer is not None and rep.url == prefer:
+                load -= self._affinity_bonus
             (eligible if ok else fallback).append((load, idx, rep))
         for pool in (eligible, fallback):
             if pool:
                 return min(pool)[2]
         return None
+
+    def _affinity_key(self, prompt):
+        """The routing hash of a generation's prompt prefix, or None
+        when the request carries no generate contract (or affinity is
+        disabled).  Only the first ``affinity_prefix_tokens`` ids
+        hash: sharers of a long system prompt must collide even when
+        their suffixes differ, so the span must not exceed the SHARED
+        part of the traffic's prompts.  The default (16) matches the
+        scheduler's default ``page_size`` — the smallest prefix the
+        radix cache can share at all, so any population the replica
+        tier could deduplicate also collides here.  (A longer span
+        only discriminates better when the shared prefix is known to
+        be longer — tune ``--affinity-prefix-tokens`` with the
+        workload.)"""
+        if not prompt or self._affinity_bonus <= 0:
+            return None
+        head = prompt[:self._affinity_prefix_tokens]
+        return zlib.crc32(
+            ",".join(str(int(t)) for t in head).encode("ascii"))
+
+    def pick_for_generation(self, gen, exclude=()):
+        """Route one generation admission (or handoff) with prefix
+        affinity: siblings of a recently routed prompt prefix land on
+        the replica whose radix cache already holds it, so the
+        fleet-wide prefix-cache hit rate tracks the per-replica one.
+        The chosen replica (affine or not) becomes the prefix's new
+        home, so a failover or handoff moves the warm set with it."""
+        key = self._affinity_key(gen.prompt)
+        prefer = None
+        if key is not None:
+            now = time.monotonic()
+            with self._lock:
+                entry = self._affinity.get(key)
+                if entry is not None and entry[1] > now:
+                    prefer = entry[0]
+        rep = self.pick_replica(exclude=exclude, prefer=prefer)
+        if rep is None or key is None:
+            return rep
+        # the map update is last-writer-wins by design: two racing
+        # sibling admissions both record a home and the later one
+        # simply re-points the prefix — next siblings converge on it.
+        # The counter marks admissions that LANDED on their prefix's
+        # warm replica (whether or not the bonus was decisive: ties
+        # the affine replica would have won anyway still count).
+        hit = prefer is not None and rep.url == prefer
+        now = time.monotonic()
+        with self._lock:  # tpulint: disable=R7 — benign last-writer-wins
+            if hit:
+                self._affinity_routed += 1
+            self._affinity[key] = (rep.url, now + self._gen_ttl_s)
+            self._affinity.move_to_end(key)
+            while len(self._affinity) > self._gen_capacity:
+                self._affinity.popitem(last=False)
+        return rep
 
     def replica_by_url(self, url):
         for rep in self._replicas_snapshot():
@@ -1027,6 +1109,8 @@ class FleetRouter:
                 "handoffs": self._handoffs,
                 "resumed_streams": self._resumed,
                 "generations": len(self._gens),
+                "affinity_routed": self._affinity_routed,
+                "affinity_entries": len(self._affinity),
             }
         out["replicas"] = [rep.stats() for rep in self._replicas_snapshot()]
         stats_fn = self._supervisor_stats
@@ -1052,6 +1136,8 @@ class FleetRouter:
             ("tpu_router_shed_total", [({}, snap["shed"])]),
             ("tpu_router_inflight_requests", [({}, snap["inflight"])]),
             ("tpu_router_generations", [({}, snap["generations"])]),
+            ("tpu_router_affinity_routed_total",
+             [({}, snap["affinity_routed"])]),
         ]
         eligible, load = [], []
         for rep in snap["replicas"]:
@@ -1559,7 +1645,7 @@ class _RouterHandler(BaseHttpHandler):
             handoff_body = gen.handoff_request()
             if handoff_body is None:
                 if gen.emitted() == 0 and not self._started:
-                    rep = router.pick_replica()
+                    rep = router.pick_for_generation(gen)
                     body, headers = gen.upstream_request(resuming=False)
                     if rep is not None:
                         gen.set_home(rep.url)
@@ -1579,7 +1665,7 @@ class _RouterHandler(BaseHttpHandler):
                 self._end_chunks()
                 return
             else:
-                rep = router.pick_replica()
+                rep = router.pick_for_generation(gen)
                 if rep is None:
                     return self._stream_fail(
                         gen, "no replica available to hand off generation "
@@ -1590,7 +1676,9 @@ class _RouterHandler(BaseHttpHandler):
                 headers = {"Content-Type": "application/json"}
                 resuming = False
         else:
-            rep = router.pick_replica()
+            # fresh admission: prefix affinity steers siblings of a
+            # warm prompt prefix to the replica already holding it
+            rep = router.pick_for_generation(gen)
             body, headers = gen.upstream_request(resuming=False)
             if rep is not None:
                 gen.set_home(rep.url)
@@ -1670,7 +1758,7 @@ class _RouterHandler(BaseHttpHandler):
                 # recording them, and re-sending after any of those
                 # reached the client would duplicate its tokens
                 router.count_failover()
-                rep = router.pick_replica(exclude={rep.url})
+                rep = router.pick_for_generation(gen, exclude={rep.url})
                 if rep is not None:
                     gen.set_home(rep.url)
                 body, headers = gen.upstream_request(resuming=False)
@@ -1691,8 +1779,8 @@ class _RouterHandler(BaseHttpHandler):
                 self._send_chunk(b'data: {"final": true}\n\n')
                 self._end_chunks()
                 return
-            new_rep = (router.pick_replica(exclude={rep.url})
-                       or router.pick_replica())
+            new_rep = (router.pick_for_generation(gen, exclude={rep.url})
+                       or router.pick_for_generation(gen))
             if new_rep is None:
                 return self._stream_fail(
                     gen, "no replica available to hand off generation "

@@ -5,33 +5,46 @@ lever at *batching across rows*: a single-stream decode step streams the
 whole weight set from HBM to produce ONE token, so served throughput
 equals single-stream throughput while every concurrent gRPC stream
 queues on the model's lock.  This module is the missing subsystem: a
-per-model background decode loop that owns a slotted, padded KV cache
-(``[n_layers, 2, max_slots, max_seq, n_kv_heads, head_dim]``, kv-head
+per-model background decode loop that owns a block-paged KV pool
+(``[n_layers, 2, kv_pages, page_size, n_kv_heads, head_dim]``, kv-head
 sharded over the tp mesh when present) and runs **one batched decode
 step for all active slots per iteration**, so the weight stream is paid
-once per step and amortized over every in-flight generation.
+once per step and amortized over every in-flight generation.  Each
+generation's KV lives in fixed-size pages named by a per-slot page
+table (``tpuserver.paging``): admission is bounded by *free pages*,
+not slot count, shared prompt prefixes deduplicate into ref-counted
+radix-cache pages (a shared-system-prompt admission prefills only its
+unique suffix), and long prefills chunk into bounded steps interleaved
+with decode — see docs/resilience.md "Paged KV cache & radix prefix
+cache".
 
 Lifecycle of a request (vLLM-style continuous batching, TPU-shaped):
 
-1. **admit** — between decode steps, a waiting request takes a free
-   slot: its prompt prefills into a single-row cache (one batched
-   MXU-shaped pass) whose rows are then written into the slot
-   (``llama.scheduler_admit``).  A resumed request (``kv_cache_region``
-   park/resume) instead copies its parked cache into the slot and
-   replays its new prompt tokens through the batched step as *forced*
-   tokens (fed, not emitted).
-2. **step** — every iteration runs ``llama.scheduler_step``: greedy
-   sample per slot from the slot's logits row, then one batched decode
-   dispatch writing each row's K/V at its own position with per-row
-   length masks.  Steps are software-pipelined one deep: step *i+1* is
-   dispatched before step *i*'s tokens are fetched, so the device→host
-   fetch overlaps the next step's compute.
+1. **admit** — between decode steps, a waiting request reserves a free
+   slot row and its whole page span, matches its prompt against the
+   radix prefix cache (shared full pages restore via
+   ``llama.paged_gather``; only the unique suffix prefills — in one
+   bucketed pass, or chunk-by-chunk interleaved with decode when it
+   exceeds ``prefill_chunk_tokens``), and scatters the prefilled
+   single-row cache into its physical pages
+   (``llama.paged_admit``).  A resumed request (``kv_cache_region``
+   park/resume) instead scatters its parked cache into the reserved
+   pages and replays its new prompt tokens through the batched step
+   as *forced* tokens (fed, not emitted).
+2. **step** — every iteration runs ``llama.paged_scheduler_step``:
+   greedy sample per slot from the slot's logits row, then one batched
+   decode dispatch following the per-slot page tables, writing each
+   row's K/V at its own position with per-row length masks.  Steps are
+   software-pipelined one deep: step *i+1* is dispatched before step
+   *i*'s tokens are fetched, so the device→host fetch overlaps the
+   next step's compute.
 3. **retire** — a slot finishes on its max_tokens budget or its
-   ``eos_id``; the slot frees immediately, so a waiting request joins
-   **mid-flight** while other slots keep decoding.  A finishing request
-   that asked for cache parking gets its slot rows extracted
-   (``llama.scheduler_extract`` — the same ``[L, 2, 1, S, Hkv, hd]``
-   shape the single-stream path parks) and handed to its ``on_finish``
+   ``eos_id``; the slot (and its pages — full ones donate back to the
+   radix cache) frees immediately, so a waiting request joins
+   **mid-flight** while other slots keep decoding.  A finishing
+   request that asked for cache parking gets its pages gathered
+   (``llama.paged_gather`` — the same ``[L, 2, 1, S, Hkv, hd]`` shape
+   the single-stream path parks) and handed to its ``on_finish``
    callback.
 
 Because of the one-deep pipeline, retirement lags its trigger token by
@@ -82,6 +95,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from tpuserver import faults
+from tpuserver.paging import PageAllocator, RadixPrefixCache, pages_for
 
 # The wire-mapped stream failures are the CANONICAL tpuserver.errors
 # types (one definition site, tpulint R4-enforced): DeadlineExceeded
@@ -119,6 +133,12 @@ class _Stream:
         "emitted", "on_finish", "resume_cache", "resume_pos", "finished",
         "cancelled", "deadline", "generation_id", "history", "incarnation",
         "enqueued_at",
+        # paged-KV state, owned by the decode loop that admitted the
+        # stream (reset for re-admission when a loop dies): the np
+        # page-table row, the pinned radix path (table[:len(nodes)]
+        # are tree pages, the rest up to span_pages are owned), and
+        # the reserved span in pages
+        "table", "radix_nodes", "span_pages",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
@@ -150,6 +170,9 @@ class _Stream:
         # monotonic stamp of the latest (re-)enqueue: the scheduler's
         # queue-wait histogram measures submit -> slot admission
         self.enqueued_at = time.monotonic()
+        self.table = None        # np [pages_per_seq] page-table row
+        self.radix_nodes = None  # pinned radix path (prefix pages)
+        self.span_pages = 0      # reserved logical pages
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -157,6 +180,34 @@ class _Stream:
 
 class _HungStep(Exception):
     """Internal: the watchdog's synthesized loop-death cause."""
+
+
+class _PrefillTask:
+    """A chunked admission in progress.
+
+    The stream's slot is reserved (it sits in ``slots`` un-``ready``)
+    while its padded prompt prefills ``chunk`` tokens per loop
+    iteration — so one 2k-token prompt costs each co-batched decode
+    stream a chunk's latency per step, never a whole-prompt stall.
+    ``dest`` is the page-scatter vector for the final admit and
+    ``full`` the token prefix the radix tree indexes on completion."""
+
+    __slots__ = ("stream", "slot", "slot_cache", "padded", "start",
+                 "logits_at", "chunk", "dest", "full", "done", "total")
+
+    def __init__(self, stream, slot, slot_cache, padded, start,
+                 logits_at, chunk, dest, full):
+        self.stream = stream
+        self.slot = slot
+        self.slot_cache = slot_cache
+        self.padded = padded        # np [pad_len] suffix token ids
+        self.start = start          # absolute position of padded[0]
+        self.logits_at = logits_at  # pad-relative last-prompt-token
+        self.chunk = chunk
+        self.dest = dest            # np [pages_per_seq] scatter ids
+        self.full = full            # np full token prefix (radix key)
+        self.done = 0               # padded positions prefilled
+        self.total = len(padded)
 
 
 class DecodeScheduler:
@@ -182,7 +233,8 @@ class DecodeScheduler:
                  fault_scope=None, step_timeout_s=None, max_restarts=5,
                  restart_window_s=60.0, restart_backoff_s=0.05,
                  replay_ttl_s=60.0, replay_capacity=256,
-                 metrics=None, metric_labels=None):
+                 metrics=None, metric_labels=None,
+                 prefill_chunk_tokens=256, prefix_cache=True):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
@@ -249,6 +301,30 @@ class DecodeScheduler:
         self._admitted_total = 0
         self._tokens_total = 0
         self._replay_hits = 0
+        # paged-KV knobs: prompts whose padded prefill exceeds
+        # ``prefill_chunk_tokens`` prefill in chunks of that many
+        # tokens, ONE chunk per loop iteration, so a long prompt never
+        # stalls co-batched decode for its whole length (None disables
+        # chunking); ``prefix_cache`` enables the radix tree that
+        # deduplicates shared prompt prefixes into shared pages.  Both
+        # engage only when the model's fns say chunked/span prefill is
+        # kernel-choice-safe (``span_safe``) — the same determinism
+        # guard prefill_bucket applies to padding.
+        self._prefill_chunk_tokens = (
+            int(prefill_chunk_tokens) if prefill_chunk_tokens else None
+        )
+        self._prefix_cache = bool(prefix_cache)
+        # prefix-cache accounting in TOKENS (hits = prompt tokens
+        # served from shared pages, misses = prompt tokens prefilled)
+        # and EVICTIONS in pages.  Same discipline as the counters
+        # above: loop-written, only ever grow, racy reads may lag one
+        # step but never decrease.
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._prefix_evictions = 0
+        # (allocator, radix) of the CURRENT loop, for stats/gauges
+        # (a restart rebuilds both with the device pool)
+        self._pager = None  # guarded-by: _cond
         # optional tpuserver.metrics latency histograms: the decode
         # loop is their ONLY writer, so single_writer children observe
         # lock-free (exact, and never a lock acquisition in _loop)
@@ -530,6 +606,20 @@ class DecodeScheduler:
         consumer (the fleet router's prober) can turn the counts into a
         utilization signal without extra configuration plumbing."""
         with self._cond:
+            pager = self._pager
+            if pager is not None:
+                alloc, radix = pager
+                pages_total = alloc.n_pages
+                pages_free = alloc.free_count
+                pages_cached = radix.unreferenced if radix is not None else 0
+            else:
+                # before the first loop start (or after close): the
+                # pool is whatever the fns bundle will build
+                fns = self._fns
+                pages_total = int(fns.get("n_pages", 0) or 0) \
+                    if fns is not None else 0
+                pages_free = pages_total
+                pages_cached = 0
             return {
                 "live_streams": len(self._streams),
                 "pending": len(self._pending),
@@ -545,6 +635,12 @@ class DecodeScheduler:
                 "admitted": self._admitted_total,
                 "tokens": self._tokens_total,
                 "replay_hits": self._replay_hits,
+                "prefix_hits": self._prefix_hits,
+                "prefix_misses": self._prefix_misses,
+                "prefix_evictions": self._prefix_evictions,
+                "pages_total": pages_total,
+                "pages_free": pages_free,
+                "pages_cached": pages_cached,
             }
 
     # -- supervisor --------------------------------------------------------
@@ -692,6 +788,12 @@ class DecodeScheduler:
         stream.pos = 0
         stream.forced.clear()
         stream.enqueued_at = time.monotonic()
+        # paging state belonged to the dead loop's pool: the new loop
+        # re-reserves pages (and re-matches the radix tree) on
+        # re-admission
+        stream.table = None
+        stream.radix_nodes = None
+        stream.span_pages = 0
 
     # -- replay buffer -----------------------------------------------------
 
@@ -787,30 +889,358 @@ class DecodeScheduler:
                 self._ensure_running_locked()
 
     def _loop(self, slots, epoch):
+        import jax.numpy as jnp
+
         fns = self._fns
-        cache = fns["init_cache"]()
+        page = fns["page_size"]
+        ppseq = fns["pages_per_seq"]
+        n_pages = fns["n_pages"]
+        # chunked/shared prefill runs spans through the dense cached
+        # path; on a flash-prefill config that could flip a near-tie
+        # greedy argmax vs the one-shot kernel, so both fall back to
+        # whole-prompt prefill there (the prefill_bucket determinism
+        # policy, applied to spans)
+        span_safe = fns["span_safe"]
+        chunk = self._prefill_chunk_tokens if span_safe else None
+        pages = fns["init_cache"]()
         logits = fns["init_logits"]()
+        alloc = PageAllocator(n_pages, page)
+        radix = (RadixPrefixCache(page)
+                 if self._prefix_cache and span_safe else None)
+        with self._cond:
+            # stats/gauges read the live pool through this reference;
+            # a supervised restart rebuilds pool, allocator and radix
+            # together (the radix cache restarts cold and re-warms)
+            self._pager = (alloc, radix)
+        # per-slot page tables, re-scattered to the device each step
+        # (sentinel rows are inert); mutated in place as slots turn
+        # over — each dispatch converts the then-current content
+        tables = np.full((self._max_slots, ppseq), n_pages, np.int32)
+        ready = [False] * self._max_slots  # prefill complete
+        prefilling = {}                    # slot -> _PrefillTask
         inflight = None  # (tokens_dev, logps_dev, snapshot)
+
+        def clear_slot(slot):
+            slots[slot] = None
+            ready[slot] = False
+            tables[slot] = n_pages
+
+        def superseded():
+            """True once a watchdog demotion replaced this loop: a
+            thread waking from a hung dispatch must stop mutating
+            stream state the successor loop now owns (its own pool,
+            tables and tasks die with it and need no cleanup)."""
+            with self._cond:
+                return self._epoch != epoch
+
+        def release_pages(stream, insert=True):
+            """Return a stream's pages to the pool.  The pinned radix
+            path unrefs; full pages covered by fed tokens donate back
+            as unpinned cached entries (a later resume, restart
+            re-admission, or sibling prompt hits them instead of
+            re-prefilling — content-addressed, so always safe);
+            everything else frees.  ``insert=False`` for poisoned or
+            failed streams whose written KV must not be cached."""
+            with self._cond:
+                if self._epoch != epoch:
+                    # superseded (watchdog demotion mid-dispatch): the
+                    # stream may already be re-admitted by the NEW
+                    # loop with paging state from the NEW pool —
+                    # touching stream.table/radix_nodes here would
+                    # corrupt it (this loop's own pool dies with it)
+                    return
+            table = stream.table
+            nodes = stream.radix_nodes or []
+            if table is None:
+                # failed before the span reserved: only the matched
+                # pins (if any) need returning
+                if nodes:
+                    radix.release(nodes)
+                stream.radix_nodes = None
+                return
+            path_len = len(nodes)
+            owned = [int(table[d])
+                     for d in range(path_len, stream.span_pages)]
+            if (insert and radix is not None
+                    and stream.resume_cache is None):
+                known = (list(int(t) for t in stream.prompt)
+                         + [t for t, _ in stream.history])
+                insertable = min(stream.pos, len(known)) // page
+                donate = max(0, insertable - path_len)
+                if donate:
+                    _, _, dup_ids = radix.insert_tail(
+                        nodes, known, path_len, owned[:donate],
+                        pin=False)
+                    alloc.free(dup_ids)
+                    owned = owned[donate:]
+            alloc.free(owned)
+            if nodes:
+                radix.release(nodes)
+            stream.table = None
+            stream.radix_nodes = None
+            stream.span_pages = 0
+
+        def complete_admission(slot, stream, full):
+            """Post-admit bookkeeping: donate the prompt's full pages
+            to the radix tree NOW (pinned — siblings admitted next
+            iteration already share them), publish the page table, and
+            count the admission."""
+            if superseded():
+                return  # zombie: the stream belongs to the new loop
+            if (radix is not None and full is not None
+                    and stream.resume_cache is None):
+                path_len = len(stream.radix_nodes)
+                donate = stream.pos // page - path_len
+                if donate > 0:
+                    owned = [int(stream.table[d])
+                             for d in range(path_len, path_len + donate)]
+                    appended, dups, dup_ids = radix.insert_tail(
+                        stream.radix_nodes, full, path_len, owned,
+                        pin=True)
+                    for d, existing in dups:
+                        # a concurrent sibling already donated this
+                        # page's content: the tree copy wins (equal
+                        # bytes — content-addressed) and ours frees
+                        stream.table[d] = existing
+                    alloc.free(dup_ids)
+                    stream.radix_nodes.extend(appended)
+            tables[slot] = stream.table
+            ready[slot] = True
+            self._admitted_total += 1
+            if self._queue_hist is not None:
+                self._queue_hist.observe(
+                    time.monotonic() - stream.enqueued_at)
+
+        def start_admission(slot, stream):
+            """Reserve the stream's page span and run (or begin) its
+            prefill.  The slot is already reserved in ``slots``; on a
+            shed or per-request fault it is cleared here."""
+            nonlocal pages, logits
+            t = self._step_timeout_s
+            try:
+                if superseded():
+                    # a previous admission's hung dispatch demoted this
+                    # loop mid-iteration: the remaining admissions are
+                    # the NEW loop's to make
+                    return
+                # admission-failure chaos hook
+                faults.fire("scheduler.admit", self.fault_scope)
+                # new incarnation: step snapshots taken against a
+                # previous admission of this stream object become inert
+                stream.incarnation += 1
+                replayed = [t_ for t_, _ in stream.history]
+                start = (stream.resume_pos
+                         if stream.resume_cache is not None else 0)
+                full = (
+                    np.concatenate(
+                        [stream.prompt, np.asarray(replayed, np.int32)])
+                    if replayed else stream.prompt
+                )
+                prefill_len = start + len(full)
+                # the whole potential span reserves up front, so decode
+                # can never run out of pages mid-generation: exhaustion
+                # is a typed admission-time shed, not an OOM
+                span_end = start + len(stream.prompt) + stream.max_tokens
+                span_pages = pages_for(span_end, page)
+                matched_nodes = []
+                shared_pages = 0
+                if radix is not None and stream.resume_cache is None:
+                    nodes, _ids = radix.match(full)
+                    # cap so the prompt's LAST token always re-runs:
+                    # its logits seed the first decode step
+                    shared_pages = min(
+                        len(nodes), (prefill_len - 1) // page)
+                    matched_nodes = nodes[:shared_pages]
+                    # recorded on the stream BEFORE anything can fail:
+                    # the exception/shed paths unpin via
+                    # release_pages(stream), which reads this field
+                    stream.radix_nodes = list(matched_nodes)
+                    if matched_nodes:
+                        # pin BEFORE any eviction can run for this
+                        # admission's own allocation
+                        radix.acquire(matched_nodes)
+                shared_len = shared_pages * page
+                needed = span_pages - shared_pages
+                owned = alloc.alloc(needed)
+                if owned is None and radix is not None:
+                    freed = radix.evict(needed - alloc.free_count)
+                    self._prefix_evictions += len(freed)
+                    alloc.free(freed)
+                    owned = alloc.alloc(needed)
+                if owned is None:
+                    release_pages(stream, insert=False)  # unpin only
+                    self._fail(stream, AdmissionQueueFull(
+                        "kv page pool exhausted: admission needs {} "
+                        "pages but only {} are free and every cached "
+                        "page is pinned by a live stream; retry "
+                        "later".format(needed, alloc.free_count)), epoch)
+                    clear_slot(slot)
+                    return
+                # counted only once the reservation SUCCEEDED: a shed
+                # admission served nothing and prefilled nothing, so it
+                # must not skew the hit-rate perfanalyzer window-diffs
+                if stream.resume_cache is None:
+                    if radix is not None:
+                        self._prefix_hits += shared_len
+                    self._prefix_misses += prefill_len - shared_len
+                table = np.full((ppseq,), n_pages, np.int32)
+                for d, node in enumerate(matched_nodes):
+                    table[d] = node.page
+                table[shared_pages:span_pages] = owned
+                stream.table = table
+                if stream.radix_nodes is None:
+                    stream.radix_nodes = []  # radix off / resume path
+                stream.span_pages = span_pages
+                # prefill dispatches are watchdogged like steps, with
+                # the compile headroom admissions get (future-dated
+                # stamp = a 10x deadline: a novel bucket may
+                # legitimately compile)
+                self._beat(epoch, time.monotonic() + 9 * t if t else None)
+                if stream.resume_cache is not None:
+                    # parked-cache restore: the parked contiguous row
+                    # scatters into the reserved pages (only READ —
+                    # the region's copy stays valid for the next
+                    # resume) and the prompt (+ history, after a
+                    # restart) replays as forced tokens
+                    slot_logits = jnp.zeros(
+                        (1, logits.shape[1]), logits.dtype)
+                    stream.forced.extend(int(t_) for t_ in stream.prompt)
+                    stream.forced.extend(int(t_) for t_ in replayed)
+                    stream.pos = start
+                    pages, logits = fns["admit"](
+                        pages, logits, jnp.asarray(stream.resume_cache),
+                        slot_logits, table, slot)
+                    complete_admission(slot, stream, None)
+                    return
+                suffix = np.asarray(full[shared_len:], np.int32)
+                suffix_len = len(suffix)
+                if shared_pages:
+                    # restore the shared prefix into the single-row
+                    # cache, then prefill only the unique suffix on
+                    # top of it — the shared-system-prompt admission
+                    # pays for its suffix alone
+                    prefix_table = np.full((ppseq,), n_pages, np.int32)
+                    prefix_table[:shared_pages] = table[:shared_pages]
+                    slot_cache = fns["gather"](pages, prefix_table)
+                    dest = table.copy()
+                    # shared pages live in the pool already: never
+                    # rewrite them from this admission's scatter
+                    dest[:shared_pages] = n_pages
+                else:
+                    slot_cache = None
+                    dest = table
+                if chunk is not None and suffix_len > chunk:
+                    pad_len = min(-(-suffix_len // chunk) * chunk,
+                                  self._max_seq - shared_len)
+                    padded = np.zeros((pad_len,), np.int32)
+                    padded[:suffix_len] = suffix
+                    if slot_cache is None:
+                        slot_cache = fns["init_slot_cache"]()
+                    prefilling[slot] = _PrefillTask(
+                        stream, slot, slot_cache, padded, shared_len,
+                        suffix_len - 1, chunk, dest, full)
+                    return
+                if shared_pages:
+                    bucket = 8
+                    while bucket < suffix_len:
+                        bucket <<= 1
+                    bucket = min(bucket, self._max_seq - shared_len)
+                    padded = np.zeros((bucket,), np.int32)
+                    padded[:suffix_len] = suffix
+                    slot_logits, slot_cache = fns["prefill_span"](
+                        self._params, slot_cache,
+                        jnp.asarray(padded)[None, :], shared_len,
+                        suffix_len - 1)
+                    if superseded():
+                        return  # demoted mid-dispatch: mutate nothing
+                else:
+                    # cold one-shot admission: the pre-paging bucketed
+                    # prefill, byte-for-byte (prefill_bucket keeps the
+                    # kernel choice, padding rows stay masked)
+                    bucket = fns["prefill_bucket"](suffix_len)
+                    padded = np.zeros((bucket,), np.int32)
+                    padded[:suffix_len] = suffix
+                    slot_cache = fns["init_slot_cache"]()
+                    slot_logits, slot_cache = fns["prefill"](
+                        self._params, slot_cache,
+                        jnp.asarray(padded)[None, :], suffix_len)
+                    if superseded():
+                        return  # demoted mid-dispatch: mutate nothing
+                stream.pos = prefill_len
+                pages, logits = fns["admit"](
+                    pages, logits, slot_cache, slot_logits, dest, slot)
+                complete_admission(slot, stream, full)
+            except Exception as e:  # noqa: BLE001 — per-request fault
+                release_pages(stream, insert=False)
+                self._fail(stream, e, epoch)
+                clear_slot(slot)
+            finally:
+                self._beat(epoch, None)
+
+        def run_prefill_chunk():
+            """One chunk of the oldest in-progress chunked prefill —
+            a single bounded dispatch interleaved with the decode
+            step, so co-batched streams keep emitting."""
+            nonlocal pages, logits
+            if superseded():
+                return
+            slot, task = next(iter(prefilling.items()))
+            stream = task.stream
+            n = min(task.chunk, task.total - task.done)
+            tok = jnp.asarray(
+                task.padded[task.done:task.done + n])[None, :]
+            rel = task.logits_at - task.done
+            rel = rel if 0 <= rel < n else 0
+            t = self._step_timeout_s
+            self._beat(epoch, time.monotonic() + 9 * t if t else None)
+            try:
+                chunk_logits, task.slot_cache = fns["prefill_span"](
+                    self._params, task.slot_cache, tok,
+                    task.start + task.done, rel)
+                if superseded():
+                    return  # demoted mid-dispatch: mutate nothing
+                task.done += n
+                if task.done < task.total:
+                    return
+                del prefilling[slot]
+                stream.pos = task.start + task.logits_at + 1
+                pages, logits = fns["admit"](
+                    pages, logits, task.slot_cache, chunk_logits,
+                    task.dest, slot)
+                complete_admission(slot, stream, task.full)
+            except Exception as e:  # noqa: BLE001 — per-request fault
+                prefilling.pop(slot, None)
+                release_pages(stream, insert=False)
+                self._fail(stream, e, epoch)
+                clear_slot(slot)
+            finally:
+                self._beat(epoch, None)
 
         def finish(stream, slot):
             if stream.on_finish is not None:
-                # extract+park is a device dispatch too: under the
+                # gather+park is a device dispatch too: under the
                 # watchdog, with the same compile headroom admissions
                 # get (a future-dated stamp = a 10x deadline)
                 t = self._step_timeout_s
                 self._beat(epoch,
                            time.monotonic() + 9 * t if t else None)
                 try:
-                    stream.on_finish(fns["extract"](cache, slot))
+                    parked = fns["gather"](pages, stream.table)
+                    if superseded():
+                        return  # never park a stale copy over the
+                        # successor loop's own park
+                    stream.on_finish(parked)
                 except Exception as e:  # noqa: BLE001 — park is
                     # per-stream
                     self._fail(stream, e, epoch)
-                    slots[slot] = None
+                    release_pages(stream)
+                    clear_slot(slot)
                     return
                 finally:
                     self._beat(epoch, None)
+            release_pages(stream)
             self._deliver(stream, ("done", None, None), epoch)
-            slots[slot] = None
+            clear_slot(slot)
 
         while True:
             expired = []
@@ -843,17 +1273,20 @@ class DecodeScheduler:
                     pending = []
                     break
                 # reap cancelled streams first: their consumers are gone,
-                # so the slot frees for waiting work (no park of the KV —
-                # resumable streams keep only their token history and
-                # re-prefill on resume)
+                # so the slot (and its pages) free for waiting work (no
+                # park of the KV — resumable streams keep only their
+                # token history; their full pages donate to the radix
+                # cache, so the resume's re-prefill is mostly a hit)
                 for i, st in enumerate(slots):
                     if st is not None and st.cancelled:
+                        prefilling.pop(i, None)
+                        release_pages(st)
                         self._detach_locked(st)
-                        slots[i] = None
+                        clear_slot(i)
                 # deadline sweep: a pending request past its deadline
                 # fails BEFORE prefill (no slot or compute is ever spent
                 # on it); an in-flight one retires mid-generation, its
-                # slot freeing for waiting work this same iteration
+                # slot and pages freeing for waiting work this iteration
                 now = time.monotonic()
                 if self._pending:
                     keep = deque()
@@ -863,7 +1296,9 @@ class DecodeScheduler:
                 for i, st in enumerate(slots):
                     if st is not None and st.expired(now):
                         expired.append(st)
-                        slots[i] = None
+                        prefilling.pop(i, None)
+                        release_pages(st)
+                        clear_slot(i)
                 self._cond.notify_all()
                 admissions = []
                 free = [i for i, s in enumerate(slots) if s is None]
@@ -872,7 +1307,12 @@ class DecodeScheduler:
                     if st.cancelled:
                         self._detach_locked(st)
                         continue  # abandoned while still queued
-                    admissions.append((free.pop(0), st))
+                    slot = free.pop(0)
+                    # reserve NOW, under the lock: the cancel-reap and
+                    # the watchdog salvage must see prefilling streams
+                    # as slotted
+                    slots[slot] = st
+                    admissions.append((slot, st))
             # deadline failures deliver OUTSIDE the lock (delivery
             # re-takes it to retire the stream from the live registry)
             for st in expired:
@@ -882,28 +1322,15 @@ class DecodeScheduler:
             # device work runs OUTSIDE the lock: submitters must be able
             # to enqueue while the chip computes
             for slot, stream in admissions:
-                # prefill-on-admit is a full-model device dispatch and
-                # must be watchdogged like a step — but a novel prefill
-                # bucket may legitimately COMPILE here, so the stamp is
-                # future-dated 9x: the hang deadline becomes 10x the
-                # step deadline instead of a compile reading as a wedge
-                t = self._step_timeout_s
-                self._beat(epoch, time.monotonic() + 9 * t if t else None)
-                try:
-                    cache, logits = self._admit(cache, logits, slot, stream)
-                except Exception as e:  # noqa: BLE001 — per-request fault
-                    self._fail(stream, e, epoch)
-                    continue
-                finally:
-                    self._beat(epoch, None)
-                slots[slot] = stream
-                self._admitted_total += 1
-                if self._queue_hist is not None:
-                    self._queue_hist.observe(
-                        time.monotonic() - stream.enqueued_at)
+                start_admission(slot, stream)
+            if prefilling:
+                # exactly one bounded chunk per iteration: long
+                # prompts trickle in while decode keeps stepping
+                run_prefill_chunk()
 
             current = None
-            active_ids = [i for i, s in enumerate(slots) if s is not None]
+            active_ids = [i for i, s in enumerate(slots)
+                          if s is not None and ready[i]]
             if active_ids:
                 # sentinel position max_seq on inert rows: their cache
                 # writes drop instead of corrupting a parked slot
@@ -938,9 +1365,9 @@ class DecodeScheduler:
                 self._beat(epoch, step_start)
                 if action is not None and action[0] == "hang":
                     time.sleep(action[1])
-                tokens_dev, logps_dev, logits, cache = fns["step"](
-                    self._params, cache, logits, positions, active,
-                    forced_tok, forced_mask,
+                tokens_dev, logps_dev, logits, pages = fns["step"](
+                    self._params, pages, logits, tables, positions,
+                    active, forced_tok, forced_mask,
                 )
                 self._beat(epoch, None)
                 if self._step_hist is not None:
@@ -972,10 +1399,13 @@ class DecodeScheduler:
                             # pipeline's wasted extra
                             continue
                         if st.cancelled:
-                            # consumer gone: free the slot AND retire
-                            # the stream (parking resumables)
+                            # consumer gone: free the slot (and its
+                            # pages — full ones donate to the radix
+                            # cache) AND retire the stream (parking
+                            # resumables)
+                            release_pages(st)
                             self._detach_locked(st)
-                            slots[i] = None
+                            clear_slot(i)
                             continue
                         if was_forced:
                             continue  # resumed-prompt feed, no emission
@@ -987,7 +1417,10 @@ class DecodeScheduler:
                             # row-independent, so co-batched slots are
                             # untouched — retire only the offender.
                             quarantined.append((i, st))
-                            slots[i] = None
+                            # poisoned KV must never enter the radix
+                            # cache: free without donating
+                            release_pages(st, insert=False)
+                            clear_slot(i)
                             continue
                         if st.emitted < st.max_tokens:
                             st.history.append((tok, lp))
@@ -1022,58 +1455,3 @@ class DecodeScheduler:
                 self._fail(st, err, epoch)
         for st in pending:
             self._fail(st, err, epoch)
-
-    def _admit(self, cache, logits, slot, stream):
-        """Prefill-on-admit (or parked-cache restore) into ``slot``.
-
-        A stream with emitted history (supervised restart / client
-        resume) re-feeds ``prompt + history``: re-prefilling the full
-        emitted prefix reproduces the KV state greedy decode built
-        incrementally, so the continuation is token-identical."""
-        import jax.numpy as jnp
-
-        # admission-failure chaos hook
-        faults.fire("scheduler.admit", self.fault_scope)
-        # new incarnation: step snapshots taken against a previous
-        # admission of this stream object become inert
-        stream.incarnation += 1
-        fns = self._fns
-        replayed = [t for t, _ in stream.history]
-        if stream.resume_cache is not None:
-            # resumed generation: the parked rows become the slot's
-            # cache and the new prompt (plus any already-emitted
-            # history, after a restart) replays as forced tokens (the
-            # single-stream resume path feeds them through decode the
-            # same way).  The parked array itself is only READ — the
-            # region's copy stays valid for the next resume.
-            slot_cache = stream.resume_cache
-            row = jnp.zeros((1, logits.shape[1]), logits.dtype)
-            stream.forced.extend(int(t) for t in stream.prompt)
-            stream.forced.extend(replayed)
-            stream.pos = stream.resume_pos
-        else:
-            # prompts pad to power-of-two buckets so admission compiles
-            # a handful of prefill shapes, not one per length — a novel
-            # length's full-model compile would stall EVERY in-flight
-            # stream's token emission.  Causal attention keeps the
-            # result exact (prefill_to_length); padding rows' garbage
-            # K/V stay masked behind the slot's position.  The model
-            # decides the bucket (exact length where padding would flip
-            # its prefill kernel choice and with it the greedy tokens).
-            full = (
-                np.concatenate(
-                    [stream.prompt, np.asarray(replayed, np.int32)])
-                if replayed else stream.prompt
-            )
-            true_len = len(full)
-            bucket = self._fns["prefill_bucket"](true_len)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:true_len] = full
-            slot_cache = fns["init_slot_cache"]()
-            row, slot_cache = fns["prefill"](
-                self._params, slot_cache, jnp.asarray(padded)[None, :],
-                true_len,
-            )
-            stream.pos = true_len
-        cache, logits = fns["admit"](cache, logits, slot_cache, row, slot)
-        return cache, logits

@@ -37,6 +37,39 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+def free_port():
+    """An OS-assigned free localhost port — the one spawn-a-stub
+    helper every stub-fleet test shares (import it; don't copy it)."""
+    import socket
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.bind(("127.0.0.1", 0))
+        return sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def wait_ready(port, timeout_s=20.0):
+    """Poll a just-spawned stub's ``/v2/health/ready`` until it
+    answers 200 (or the timeout passes)."""
+    import http.client
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+        try:
+            conn.request("GET", "/v2/health/ready")
+            if conn.getresponse().status == 200:
+                return True
+        except OSError:
+            pass
+        finally:
+            conn.close()
+        time.sleep(0.05)
+    return False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
@@ -62,7 +95,7 @@ def main():
         "quarantined": 0, "replay_entries": 0,
     }
 
-    served = {"count": 0, "ns": 0}
+    served = {"count": 0, "ns": 0, "gen": 0}
 
     def snapshot():
         with lock:
@@ -108,11 +141,16 @@ def main():
     def metrics_text():
         with lock:
             count = served["count"]
+            gens = served["gen"]
         return (
             "# HELP stub_requests_total Inferences served by this "
             "stub replica.\n"
             "# TYPE stub_requests_total counter\n"
-            "stub_requests_total {}\n".format(count))
+            "stub_requests_total {}\n"
+            "# HELP stub_generations_total Generation streams served "
+            "by this stub replica.\n"
+            "# TYPE stub_generations_total counter\n"
+            "stub_generations_total {}\n".format(count, gens))
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -172,6 +210,46 @@ def main():
                     "outputs": [{"name": "OUTPUT0", "datatype": "FP32",
                                  "shape": [1], "data": [0.0]}],
                 })
+            if self.path == "/v2/models/stub/generate_stream":
+                # just enough of the scheduler-backed SSE generate
+                # contract (TOKEN events with generation_id/seq
+                # parameters + the explicit terminal event) for
+                # router-tier routing tests — prefix-affinity
+                # placement is observable via stub_generations_total
+                try:
+                    request = json.loads(body or b"{}")
+                    inputs = {t.get("name"): t.get("data") or []
+                              for t in request.get("inputs") or []}
+                    prompt = [int(v) for v in inputs.get(
+                        "PROMPT_IDS") or [0]]
+                    max_tokens = int(
+                        (inputs.get("MAX_TOKENS") or [4])[0])
+                    gid = str((request.get("parameters") or {}).get(
+                        "generation_id") or "stubgen")
+                except (TypeError, ValueError):
+                    return self._json(
+                        {"error": "malformed generate request"}, 400)
+                with lock:
+                    served["gen"] += 1
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.end_headers()
+                for i in range(max_tokens):
+                    token = (prompt[i % len(prompt)] + i) % 100
+                    payload = {
+                        "model_name": "stub",
+                        "outputs": [{"name": "TOKEN",
+                                     "datatype": "INT32", "shape": [1],
+                                     "data": [token]}],
+                        "parameters": {"generation_id": gid, "seq": i},
+                    }
+                    self.wfile.write(
+                        "id: {}/{}\n".format(gid, i).encode("ascii")
+                        + b"data: " + json.dumps(payload).encode("ascii")
+                        + b"\n\n")
+                self.wfile.write(b'data: {"final": true}\n\n')
+                self.close_connection = True
+                return
             if self.path != "/stub/state":
                 return self._json({"error": "unknown: " + self.path}, 404)
             update = json.loads(body or b"{}")

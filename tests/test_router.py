@@ -915,3 +915,163 @@ def test_marked_resume_on_fresh_router_fails_typed_404(fleet):
             conn.close()
     finally:
         fresh.stop()
+
+
+# -- prefix-affinity routing (paged KV fleet tier, ISSUE 11) -----------------
+#
+# These run against tests/fleet_stub.py processes (pure stdlib, ~100ms
+# boot, a minimal SSE generate surface) per the tier-1 runtime budget:
+# the routing DECISION under test lives entirely in the router.
+
+import os as _os
+import subprocess as _subprocess
+import sys as _sys
+
+from fleet_stub import free_port as _free_port  # noqa: E402
+from fleet_stub import wait_ready as _stub_wait_ready  # noqa: E402
+
+_STUB = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "fleet_stub.py")
+_STUB_STREAM_PATH = "/v2/models/stub/generate_stream"
+
+
+def _stub_generations(port):
+    conn = http_client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode("utf-8")
+    finally:
+        conn.close()
+    for line in text.splitlines():
+        if line.startswith("stub_generations_total "):
+            return int(float(line.split()[1]))
+    return 0
+
+
+def _stub_set_state(port, **state):
+    conn = http_client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("POST", "/stub/state", body=json.dumps(state),
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+
+
+def _stub_generate(router_url, prompt, n_tokens=4):
+    host, _, port = router_url.rpartition(":")
+    body = json.dumps({"inputs": [
+        {"name": "PROMPT_IDS", "datatype": "INT32",
+         "shape": [len(prompt)], "data": list(prompt)},
+        {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1],
+         "data": [n_tokens]},
+    ]})
+    conn = http_client.HTTPConnection(host, int(port), timeout=30)
+    tokens = []
+    try:
+        conn.request("POST", _STUB_STREAM_PATH, body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        for raw in resp:
+            line = raw.rstrip(b"\r\n")
+            if not line.startswith(b"data: "):
+                continue
+            payload = json.loads(line[len(b"data: "):])
+            if payload.get("final"):
+                break
+            assert "error" not in payload, payload
+            for out in payload.get("outputs", []):
+                if out["name"] == "TOKEN":
+                    tokens.append(int(out["data"][0]))
+    finally:
+        conn.close()
+    return tokens
+
+
+@pytest.fixture
+def stub_fleet():
+    ports = [_free_port(), _free_port()]
+    procs = [
+        _subprocess.Popen([_sys.executable, _STUB, "--port", str(p)])
+        for p in ports
+    ]
+    try:
+        for p in ports:
+            assert _stub_wait_ready(p), "stub replica never became ready"
+        yield ports
+    finally:
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            proc.wait(timeout=10)
+
+
+def test_prefix_affinity_routes_siblings_to_warm_replica(stub_fleet):
+    """Sibling generations sharing a prompt prefix all land on ONE
+    replica (whose radix cache is warm) instead of spreading
+    least-loaded — and the router counts the decisions the bonus
+    swung."""
+    ports = stub_fleet
+    urls = ["127.0.0.1:{}".format(p) for p in ports]
+    router = FleetRouter(urls, probe_interval_s=0.1,
+                         affinity_bonus=2.0).start()
+    prompt = list(range(1, 20))
+    try:
+        for _ in range(6):
+            tokens = _stub_generate(router.url, prompt)
+            assert len(tokens) == 4
+        counts = [_stub_generations(p) for p in ports]
+        # every sibling converged on the first pick's replica
+        assert sorted(counts) == [0, 6], counts
+        stats = router.stats()
+        # the first admission had no affinity entry; the other five
+        # were steered by the bonus
+        assert stats["affinity_routed"] == 5
+        assert stats["affinity_entries"] == 1
+    finally:
+        router.stop()
+
+
+def test_prefix_affinity_never_overrides_eligibility(stub_fleet):
+    """A draining/ineligible warm replica loses its affinity traffic:
+    the bonus is a score tweak among ELIGIBLE replicas, never a
+    health/drain override."""
+    ports = stub_fleet
+    urls = ["127.0.0.1:{}".format(p) for p in ports]
+    router = FleetRouter(urls, probe_interval_s=0.05,
+                         affinity_bonus=2.0).start()
+    prompt = list(range(30, 50))
+    try:
+        assert len(_stub_generate(router.url, prompt)) == 4
+        counts = [_stub_generations(p) for p in ports]
+        warm = counts.index(1)
+        cold = 1 - warm
+        _stub_set_state(ports[warm], ready=False)
+        deadline = time.monotonic() + 5.0
+        warm_url = urls[warm]
+        while time.monotonic() < deadline:
+            snap = [r for r in router.stats()["replicas"]
+                    if r["url"] == warm_url][0]
+            if not snap["eligible"]:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("drained stub never rotated out")
+        assert len(_stub_generate(router.url, prompt)) == 4
+        assert _stub_generations(ports[cold]) >= 1
+        # the prefix re-homed: once the old home revives, siblings
+        # keep going to the NEW home (last-writer-wins map)
+        _stub_set_state(ports[warm], ready=True)
+        cold_before = _stub_generations(ports[cold])
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            snap = [r for r in router.stats()["replicas"]
+                    if r["url"] == warm_url][0]
+            if snap["eligible"]:
+                break
+            time.sleep(0.02)
+        assert len(_stub_generate(router.url, prompt)) == 4
+        assert _stub_generations(ports[cold]) == cold_before + 1
+    finally:
+        router.stop()

@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""Paged-KV / radix-prefix-cache benchmark (ISSUE 11 acceptance).
+
+CPU-sim (``JAX_PLATFORMS=cpu``) evidence for the PR's three claims,
+written as BENCH-schema rows (default ``BENCH_r07.json``):
+
+1. **Warm prefill ≪ cold prefill.**  Time-to-first-token of a
+   256-token prompt against a scheduler whose radix cache already
+   holds the prompt's pages (≥90% token hit rate) vs a cold cache —
+   the shared-system-prompt admission pays only its unique suffix.
+2. **Admission bounded by pages, not slots.**  16 concurrent short
+   streams decode simultaneously over a page pool holding FOUR
+   full-length sequences — 4x the old ``max_slots`` bound at equal
+   KV memory.
+3. **Affinity routing beats hash-blind fleet-wide.**  The perfanalyzer
+   generation profiler (its ``prefix_hit_pct`` column, window-diffed
+   from the router's fleet-aggregated ``/metrics``) drives a
+   6-shared-prefix workload through a 2-replica fleet whose per-replica
+   cache cannot hold every prefix: with the router's prefix-affinity
+   bonus each replica serves its own prefix partition (high hit rate);
+   hash-blind (``affinity_bonus=0``) duplicates every prefix on every
+   replica and LRU-thrashes.
+
+Plus the ISSUE's headline recapture: one `tools/perf_analyzer.py -m
+simple --backend inprocess` run recording the post-optimization
+per-request p50 (see ``_exit_inflight`` / ``_make_response`` notes in
+tpuserver/core.py).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _ttft(sched, prompt, max_tokens=4):
+    t0 = time.perf_counter()
+    stream = sched.submit(np.asarray(prompt, np.int32), max_tokens)
+    next(stream)
+    ttft = time.perf_counter() - t0
+    for _ in stream:
+        pass
+    return ttft
+
+
+def bench_warm_vs_cold_prefill(rows):
+    import jax
+
+    from tpuserver.models import llama
+    from tpuserver.scheduler import DecodeScheduler
+
+    cfg = llama.tiny(vocab=512)
+    max_seq, prompt_len = 512, 256
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    fns = llama.make_scheduler_fns(cfg, max_seq, max_slots=2)
+    sched = DecodeScheduler(fns, params, 2, max_seq)
+    rng = np.random.RandomState(0)
+    target = rng.randint(1, 500, size=(prompt_len,)).astype(np.int32)
+    warmup = rng.randint(1, 500, size=(prompt_len,)).astype(np.int32)
+    try:
+        # compile the 256-bucket prefill (and everything else) OUT of
+        # the measurement with a DIFFERENT prompt (no cache overlap)
+        _ttft(sched, warmup)
+        cold = _ttft(sched, target)  # cache miss: full-prompt prefill
+        before = sched.stats()
+        warm = [_ttft(sched, target) for _ in range(8)]
+        stats = sched.stats()
+    finally:
+        sched.close()
+    # hit rate OF THE WARM ADMISSIONS (delta over the warm phase —
+    # the warmup/cold prefills are misses by construction)
+    dh = stats["prefix_hits"] - before["prefix_hits"]
+    dm = stats["prefix_misses"] - before["prefix_misses"]
+    hit_rate = 100.0 * dh / (dh + dm)
+    warm_ms = statistics.median(warm) * 1e3
+    cold_ms = cold * 1e3
+    print("prefill TTFT: cold {:.1f} ms -> warm {:.1f} ms "
+          "({:.2f}x) at {:.1f}% radix hit rate".format(
+              cold_ms, warm_ms, cold_ms / warm_ms, hit_rate))
+    rows.append({
+        "config": "paged_kv", "metric": "prefill_ttft_cold_256tok",
+        "value": round(cold_ms, 2), "unit": "ms", "vs_baseline": None,
+        "prompt_tokens": prompt_len})
+    rows.append({
+        "config": "paged_kv", "metric": "prefill_ttft_warm_256tok",
+        "value": round(warm_ms, 2), "unit": "ms", "vs_baseline": None,
+        "prompt_tokens": prompt_len,
+        "speedup_vs_cold": round(cold_ms / warm_ms, 2),
+        "radix_hit_rate_pct": round(hit_rate, 1)})
+
+
+def bench_capacity_beyond_slots(rows):
+    import jax
+
+    from tpuserver.models import llama
+    from tpuserver.scheduler import DecodeScheduler
+
+    cfg = llama.tiny(vocab=512)
+    max_seq, page = 128, 16
+    ppseq = max_seq // page
+    old_bound = 4  # full-length sequences this memory used to hold
+    streams_target = 16
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    fns = llama.make_scheduler_fns(
+        cfg, max_seq, max_slots=streams_target,
+        kv_pages=old_bound * ppseq)
+    sched = DecodeScheduler(fns, params, streams_target, max_seq,
+                            prefix_cache=False)
+    try:
+        streams = [
+            sched.submit(np.array([i + 1, i + 2, i + 3], np.int32), 16)
+            for i in range(streams_target)
+        ]
+        for s in streams:
+            next(s)  # every stream admitted and decoding
+        live = sched.stats()["live_streams"]
+        for s in streams:
+            for _ in s:
+                pass
+    finally:
+        sched.close()
+    assert live == streams_target, live
+    print("concurrent streams at the memory of {} full-length slots: "
+          "{}".format(old_bound, live))
+    rows.append({
+        "config": "paged_kv", "metric": "concurrent_streams_equal_memory",
+        "value": live, "unit": "streams", "vs_baseline": None,
+        "old_max_slots_bound": old_bound,
+        "kv_pages": old_bound * ppseq, "page_size": page})
+
+
+def _fleet_hit_rate(affinity_bonus, groups=8, suffixes=4):
+    """One 2-replica fleet + router run through the perfanalyzer
+    generation profiler; returns its prefix_hit_pct."""
+    from perfanalyzer.client_backend import create_backend
+    from perfanalyzer.generation import GenerationProfiler
+    from tpuserver.core import InferenceServer
+    from tpuserver.http_frontend import HttpFrontend
+    from tpuserver.models import llama
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+    from tpuserver.router import FleetRouter
+
+    cfg = llama.tiny(vocab=512)
+    max_seq = 96  # prefix 64 + suffix 8 + 8 tokens + slack
+    # per-replica pool: 32 pages.  ~4 in-flight streams pin ~10-20;
+    # the 8 prefix groups' cached pages (5 each) need 40 — one replica
+    # holds its HALF of the groups warm (4x5=20), but nowhere near all
+    # 8: hash-blind duplication LRU-thrashes, affinity partitioning
+    # does not.
+    models = [
+        LlamaGenerateModel(cfg=cfg, max_seq=max_seq, max_slots=4,
+                           kv_pages=32)
+        for _ in range(2)
+    ]
+    cores = [InferenceServer([m]) for m in models]
+    frontends = [HttpFrontend(core, port=0).start() for core in cores]
+    urls = ["127.0.0.1:{}".format(f.port) for f in frontends]
+    router = FleetRouter(urls, probe_interval_s=0.1,
+                         affinity_bonus=affinity_bonus).start()
+    backend = None
+    try:
+        rng = np.random.RandomState(42)
+        prefixes = [rng.randint(1, 500, size=(64,)).astype(np.int32)
+                    for _ in range(groups)]
+        pool = []
+        for g in range(groups):
+            for s in range(suffixes):
+                suffix = np.random.RandomState(
+                    100 * g + s).randint(1, 500, size=(8,)).astype(
+                        np.int32)
+                pool.append({
+                    "PROMPT_IDS": np.concatenate([prefixes[g], suffix]),
+                    "MAX_TOKENS": np.array([8], np.int32),
+                })
+        backend = create_backend("http", url=router.url, max_inflight=4)
+        profiler = GenerationProfiler(
+            backend, "llama_generate", pool,
+            measurement_interval_s=1.5, max_trials=3, warmup_s=0.5)
+        result = profiler.profile_level(4)
+        profiler.stop()
+        return result
+    finally:
+        if backend is not None:
+            backend.close()
+        router.stop()
+        for f in frontends:
+            f.stop()
+        for c in cores:
+            c.close()
+
+
+def bench_affinity_vs_blind(rows):
+    affine = _fleet_hit_rate(affinity_bonus=2.0)
+    blind = _fleet_hit_rate(affinity_bonus=0.0)
+    print("fleet prefix-cache hit rate: affinity {:.1f}% vs "
+          "hash-blind {:.1f}% (tokens/sec {:.0f} vs {:.0f})".format(
+              affine["prefix_hit_pct"], blind["prefix_hit_pct"],
+              affine["throughput"], blind["throughput"]))
+    for name, res in (("affinity", affine), ("hash_blind", blind)):
+        rows.append({
+            "config": "fleet_prefix_cache",
+            "metric": "hit_rate_{}".format(name),
+            "value": round(res["prefix_hit_pct"] or 0.0, 1),
+            "unit": "percent", "vs_baseline": None,
+            "tokens_per_sec": round(res["throughput"], 1),
+            "ttft_p50_ms": round(res["ttft_p50_ms"] or 0.0, 2),
+            "replicas": 2, "prefix_groups": 8,
+            "kv_pages_per_replica": 32})
+
+
+def bench_simple_headline(rows):
+    """The ISSUE's small half of ROADMAP item 3: re-capture the
+    simple-model inprocess per-request latency after the hot-path
+    reclaim (conditional drain wakeup + allocation-free default
+    response)."""
+    cli = os.path.join(REPO, "tools", "perf_analyzer.py")
+    result = subprocess.run(
+        [sys.executable, cli, "-m", "simple", "--backend", "inprocess",
+         "--concurrency-range", "1", "--measurement-interval", "2000"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    if result.returncode != 0:
+        print("headline run failed:\n" + result.stderr, file=sys.stderr)
+        return
+    row = next(json.loads(line) for line in result.stdout.splitlines()
+               if line.startswith('{"'))
+    print("simple inprocess: {:.0f} infer/sec, p50 {:.1f} us".format(
+        row["value"], row["p50_usec"]))
+    rows.append({
+        "config": 1, "metric": "simple_inprocess_headline",
+        "value": row["value"], "unit": "infer/sec",
+        "vs_baseline": None, "p50_usec": row["p50_usec"],
+        "p99_usec": row["p99_usec"],
+        "note": "post hot-path reclaim (conditional drain wakeup, "
+                "allocation-free default response)"})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_r07.json"))
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="skip the 2-replica fleet A/B (the slow part)")
+    args = ap.parse_args(argv)
+
+    rows = []
+    bench_warm_vs_cold_prefill(rows)
+    bench_capacity_beyond_slots(rows)
+    if not args.skip_fleet:
+        bench_affinity_vs_blind(rows)
+    bench_simple_headline(rows)
+
+    payload = {
+        "n": 7,
+        "cmd": "JAX_PLATFORMS=cpu python tools/bench_prefix_cache.py",
+        "rc": 0,
+        "note": "paged KV + radix prefix cache + affinity routing "
+                "(PR 11); CPU-sim numbers — relative deltas are the "
+                "signal, absolute latencies are simulator-bound",
+        "rows": rows,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    print("wrote {} rows to {}".format(len(rows), args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

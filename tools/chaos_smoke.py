@@ -95,6 +95,14 @@ PROMPTS = [
     np.array([1, 2, 3, 4], dtype=np.int32),
 ]
 
+# long enough to span a full KV page (page_size 16): repeated streams
+# of this prompt exercise the radix prefix cache, whose fleet-view
+# counters the router/fleet soaks assert stay monotonic and keep
+# MOVING (cold caches re-warm) across SIGKILL healing
+SHARED_PROMPT = np.array(
+    [7, 3, 11, 4, 9, 2, 6, 13, 5, 1, 8, 12, 10, 14, 15, 7,
+     9, 4, 2, 11, 6, 3, 13, 5], dtype=np.int32)
+
 FAULT_CYCLE = [
     ("scheduler.step", "raise", 1, 0.0),
     ("scheduler.fetch", "raise", 1, 0.0),
@@ -119,11 +127,19 @@ class RouterMetricsCheck:
     view must survive replica restarts and membership churn without
     resetting."""
 
-    def __init__(self, router_url, context):
+    def __init__(self, router_url, context, require_prefix=False):
         host, _, port = router_url.rpartition(":")
         self.host, self.port = host, int(port)
         self.context = context
         self._prev = {}
+        # PR 11: the paged-KV prefix-cache counters must be present in
+        # the fleet view (and, like every cumulative family, monotonic
+        # across healing).  ``prefix_hits`` holds the last scraped
+        # fleet-wide hit total so phases can assert a respawned
+        # replica's cold radix cache RE-WARMS (the counter keeps
+        # moving) instead of just not regressing.
+        self.require_prefix = require_prefix
+        self.prefix_hits = None
 
     def _scrape(self):
         import http.client
@@ -169,6 +185,59 @@ class RouterMetricsCheck:
                      "{} across a replica restart".format(
                          self.context, cycle, key, prev, now))
         self._prev = current
+        hits = [v for (name, _labels), v in current.items()
+                if name == "tpu_prefix_cache_hits_total"]
+        if hits:
+            self.prefix_hits = sum(hits)
+        elif self.require_prefix:
+            fail("{} cycle {}: tpu_prefix_cache_hits_total missing "
+                 "from the fleet /metrics view".format(
+                     self.context, cycle))
+
+
+def drive_shared_streams(url, context, cycle, shared_ref, budget, n=2):
+    """A burst of the page-spanning ``SHARED_PROMPT`` through a router
+    at ``url``: back-to-back siblings exercise the radix prefix cache
+    (and prefix-affinity routing), and a replica whose scheduler was
+    rebuilt this cycle re-warms its cold cache here — with zero
+    user-visible errors and token-identical output.  Shared by the
+    ``--router`` and ``--fleet`` soaks."""
+    import tritonclient.http as httpclient
+
+    client = httpclient.InferenceServerClient(url)
+    try:
+        for _ in range(n):
+            tokens = []
+            try:
+                for event in client.generate_stream(
+                        "llama_generate",
+                        {"PROMPT_IDS": SHARED_PROMPT,
+                         "MAX_TOKENS": np.array([budget], np.int32)}):
+                    for out in event.get("outputs", []):
+                        if out["name"] == "TOKEN":
+                            tokens.append(int(out["data"][0]))
+            except Exception as e:  # noqa: BLE001 — the invariant
+                fail("{} cycle {}: shared-prefix stream error "
+                     "({}: {})".format(context, cycle,
+                                       type(e).__name__, e))
+                continue
+            if tokens != shared_ref:
+                fail("{} cycle {}: shared-prefix tokens diverged: "
+                     "{} != {}".format(context, cycle, tokens,
+                                       shared_ref))
+    finally:
+        client.close()
+
+
+def assert_prefix_rewarmed(metrics_check, hits_before, cycle):
+    """The fleet-aggregated hit counter must have MOVED since the last
+    cycle's scrape: a healed replica's cold radix cache re-warmed."""
+    if (hits_before is not None
+            and metrics_check.prefix_hits is not None
+            and metrics_check.prefix_hits <= hits_before):
+        fail("{} cycle {}: prefix cache did not re-warm (fleet hits "
+             "stuck at {})".format(
+                 metrics_check.context, cycle, hits_before))
 
 
 def generate(core, prompt, n_tokens, parameters=None):
@@ -459,10 +528,15 @@ def router_phase(cycles, soak, budget):
     if reference != twin:
         fail("router: replicas disagree on greedy reference tokens — "
              "cross-replica handoff cannot be token-identical")
+    shared_ref = generate(cores[0], SHARED_PROMPT, budget)
+    if shared_ref != generate(cores[1], SHARED_PROMPT, budget):
+        fail("router: replicas disagree on the shared-prefix prompt's "
+             "greedy tokens")
     print("reference captured; {} cycles of SIGTERM-drain + mid-stream "
           "severs through the router".format(cycles))
 
-    metrics_check = RouterMetricsCheck(router.url, "router")
+    metrics_check = RouterMetricsCheck(
+        router.url, "router", require_prefix=True)
     metrics_check.check(-1)  # seed the baseline pre-chaos
     resumes = [0]
 
@@ -554,8 +628,15 @@ def router_phase(cycles, soak, budget):
                     wait_no_leaks(model, "router cycle {} ({})".format(
                         cycle, scope))
             # telemetry invariant: scrapeable + monotonic across the
-            # drain/revive (the fleet view must not reset)
+            # drain/revive (the fleet view must not reset), and the
+            # prefix cache keeps WARMING: the drained replica's
+            # scheduler (and radix cache) was rebuilt, so these
+            # streams must both succeed and move the fleet hit counter
+            hits_before = metrics_check.prefix_hits
+            drive_shared_streams(router.url, "router", cycle,
+                                 shared_ref, budget)
             metrics_check.check(cycle)
+            assert_prefix_rewarmed(metrics_check, hits_before, cycle)
             stats = router.stats()
             print("cycle {:2d} handoffs={} failovers={} shed={} "
                   "client_resumes={}".format(
@@ -640,11 +721,11 @@ def fleet_phase(cycles, soak, budget):
         print("warming up both replica processes (compiles each "
               "scheduler)...")
 
-        def stream_once(which):
+        def stream_prompt(prompt):
             tokens, seqs = [], []
             for event in client.generate_stream(
                     "llama_generate",
-                    {"PROMPT_IDS": PROMPTS[which],
+                    {"PROMPT_IDS": prompt,
                      "MAX_TOKENS": np.array([budget], np.int32)}):
                 for out in event.get("outputs", []):
                     if out["name"] == "TOKEN":
@@ -653,6 +734,9 @@ def fleet_phase(cycles, soak, budget):
                 if "seq" in params:
                     seqs.append(params["seq"])
             return tokens, seqs
+
+        def stream_once(which):
+            return stream_prompt(PROMPTS[which])
 
         reference = []
         for which in range(len(PROMPTS)):
@@ -664,12 +748,17 @@ def fleet_phase(cycles, soak, budget):
                 fail("fleet: replicas disagree on greedy reference "
                      "tokens for prompt {}".format(which))
             reference.append(tokens)
+        shared_ref, _ = stream_prompt(SHARED_PROMPT)
+        shared_twin, _ = stream_prompt(SHARED_PROMPT)
+        if shared_ref != shared_twin:
+            fail("fleet: shared-prefix greedy tokens disagree across "
+                 "streams")
         client.close()
         print("reference captured; {} cycles of SIGKILL "
               "mid-traffic".format(cycles))
 
         metrics_check = RouterMetricsCheck(
-            supervisor.router.url, "fleet")
+            supervisor.router.url, "fleet", require_prefix=True)
         metrics_check.check(-1)  # seed the baseline pre-chaos
 
         for cycle in range(cycles):
@@ -736,8 +825,14 @@ def fleet_phase(cycles, soak, budget):
             # telemetry invariant: the SIGKILLed replica's counters
             # reset to zero in ITS exposition, but the router's
             # fleet-aggregated view must stay monotonic — and stay
-            # scrapeable mid-heal
+            # scrapeable mid-heal.  The respawned replica's cold radix
+            # cache must also RE-WARM: shared-prompt siblings succeed
+            # and the fleet hit counter keeps moving.
+            hits_before = metrics_check.prefix_hits
+            drive_shared_streams(supervisor.router.url, "fleet", cycle,
+                                 shared_ref, budget)
             metrics_check.check(cycle)
+            assert_prefix_rewarmed(metrics_check, hits_before, cycle)
             stats = supervisor.stats()
             print("cycle {:2d} restarts {} -> {} up={} handoffs={}"
                   .format(cycle, restarts_before,

@@ -118,6 +118,15 @@ def build_parser():
                     help="generation: tokens requested per stream")
     ap.add_argument("--prompt-len", type=int, default=8,
                     help="generation: synthetic prompt length")
+    ap.add_argument("--shared-prefix-tokens", type=int, default=0,
+                    help="generation: prepend ONE common prefix of N "
+                         "tokens to every prompt (the shared-system-"
+                         "prompt traffic shape of millions of users; "
+                         "each prompt keeps its own --prompt-len "
+                         "unique suffix).  The report's prefix-hit%% "
+                         "column, window-diffed from the target's "
+                         "/metrics, shows how much of it the radix "
+                         "prefix cache absorbed")
     # in-process server construction
     ap.add_argument("--llama-slots", type=int, default=None,
                     help="inprocess generation: continuous-batching "
@@ -189,9 +198,12 @@ def build_inprocess_core(args, levels):
         from tpuserver.models.llama_serving import LlamaGenerateModel
 
         slots = args.llama_slots or max(levels)
+        need = (args.shared_prefix_tokens + args.prompt_len
+                + args.max_tokens + 8)
+        # the paged KV pool wants page_size (16) | max_seq
+        max_seq = -(-max(64, need) // 16) * 16
         model = LlamaGenerateModel(
-            cfg=llama.tiny(vocab=256),
-            max_seq=max(64, args.prompt_len + args.max_tokens + 8),
+            cfg=llama.tiny(vocab=256), max_seq=max_seq,
             max_slots=slots)
         core = InferenceServer([model])
         model.warmup()
@@ -203,9 +215,17 @@ def build_inprocess_core(args, levels):
 
 def build_generation_pool(metadata, args):
     """Prompt pool for generation mode: DISTINCT random prompts per
-    stream; MAX_TOKENS pinned from the CLI."""
+    stream; MAX_TOKENS pinned from the CLI.  With
+    ``--shared-prefix-tokens N`` every prompt carries the SAME leading
+    N tokens (seeded independently of the pool index) ahead of its
+    unique suffix — the shared-system-prompt shape the radix prefix
+    cache and the router's prefix-affinity signal exist for."""
     import numpy as np
 
+    shared = None
+    if args.shared_prefix_tokens > 0:
+        shared = np.random.RandomState(args.seed + 7777).randint(
+            1, 200, size=(args.shared_prefix_tokens,)).astype(np.int32)
     pool = []
     for i in range(args.input_pool):
         rng = np.random.RandomState(args.seed + i)
@@ -217,8 +237,11 @@ def build_generation_pool(metadata, args):
             elif any(int(d) < 0 for d in spec["shape"]):
                 # dynamic prompt axis: synthesize at --prompt-len with
                 # small ids (valid for every vocab the zoo uses)
-                inputs[name] = rng.randint(
+                suffix = rng.randint(
                     1, 200, size=(args.prompt_len,)).astype(np.int32)
+                inputs[name] = (
+                    np.concatenate([shared, suffix])
+                    if shared is not None else suffix)
             else:
                 dims = [int(d) for d in spec["shape"]]
                 inputs[name] = rng.randint(

@@ -42,6 +42,16 @@ def main(argv=None):
                     help="generation registry TTL seconds — match the "
                          "replicas' replay_ttl_s (default 60)")
     ap.add_argument("--gen-capacity", type=int, default=1024)
+    ap.add_argument("--affinity-bonus", type=float, default=2.0,
+                    help="prefix-affinity load-score bonus for the "
+                         "replica whose radix cache is warm for a "
+                         "prompt prefix (0 disables: hash-blind "
+                         "routing; default 2)")
+    ap.add_argument("--affinity-prefix-tokens", type=int, default=16,
+                    help="prompt tokens hashed into the affinity key; "
+                         "must not exceed the workload's SHARED prefix "
+                         "length (default 16 = one KV page, the "
+                         "smallest radix-shareable prefix)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -57,6 +67,8 @@ def main(argv=None):
         max_inflight=args.max_inflight,
         gen_ttl_s=args.gen_ttl,
         gen_capacity=args.gen_capacity,
+        affinity_bonus=args.affinity_bonus,
+        affinity_prefix_tokens=args.affinity_prefix_tokens,
         verbose=args.verbose,
     ).start()
 
