@@ -40,13 +40,71 @@ def generate(client, prompt, max_tokens, parameters=None):
     return tokens
 
 
+def generate_shm(client, prompt, max_tokens):
+    """The zero-copy data plane: PROMPT_IDS travels as a shared-memory
+    reference and every generated TOKEN/LOGPROB lands in a token-ring
+    slot of the same region — the decoupled responses shrink to
+    ``seq -> offset`` descriptors and this side reads the ring."""
+    prompt = np.asarray(prompt, dtype=np.int32)
+    ring_base = 64  # prompt at offset 0, ring slots (8 B each) above
+    region = xshm.create_shared_memory_region(
+        "llama_shm_plane", ring_base + 8 * max_tokens)
+    xshm.set_shared_memory_region(region, [prompt])
+    client.register_xla_shared_memory(
+        "llama_shm_plane", xshm.get_raw_handle(region), 0,
+        ring_base + 8 * max_tokens)
+    try:
+        p_in = grpcclient.InferInput("PROMPT_IDS", [len(prompt)], "INT32")
+        p_in.set_shared_memory("llama_shm_plane", prompt.nbytes, 0)
+        m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+        m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
+        events = 0
+        for _result in client.generate_stream(
+                "llama_generate", [p_in, m_in],
+                parameters={"shm_ring_region": "llama_shm_plane",
+                            "shm_ring_slots": max_tokens,
+                            "shm_ring_offset": ring_base}):
+            events += 1  # descriptor-only event; tensors are in the ring
+        tokens = [
+            int(xshm.get_contents_as_numpy(
+                region, "INT32", [1], ring_base + 8 * s)[0])
+            for s in range(events)
+        ]
+        print("ring tokens:", tokens, flush=True)
+        return tokens
+    finally:
+        client.unregister_xla_shared_memory("llama_shm_plane")
+        xshm.destroy_shared_memory_region(region)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("-u", "--url", default="localhost:8001")
     parser.add_argument("-n", "--max-tokens", type=int, default=8)
+    parser.add_argument("--shared-memory", default="none",
+                        choices=["none", "xla"],
+                        help="xla: send the prompt by shm reference and "
+                             "read TOKEN/LOGPROB from a token ring in "
+                             "the same region (zero-copy in-process; "
+                             "host-window staging cross-process)")
     args = parser.parse_args()
 
     client = grpcclient.InferenceServerClient(args.url)
+
+    if args.shared_memory == "xla":
+        prompt = [1, 5, 9, 13]
+        try:
+            # token identity across planes: the in-band stream and the
+            # shm-ring stream must carry the same greedy tokens
+            inband = generate(client, prompt, args.max_tokens)
+            ring = generate_shm(client, prompt, args.max_tokens)
+            if ring != inband:
+                print("FAILED: ring tokens diverged from in-band")
+                sys.exit(1)
+        finally:
+            client.close()
+        print("PASS: llama streaming (xla shared memory)")
+        return
 
     kv = xshm.create_shared_memory_region("llama_kv_park", 16 << 20)
     client.register_xla_shared_memory(

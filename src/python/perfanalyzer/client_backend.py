@@ -170,6 +170,27 @@ class ClientBackend:
         pin per-thread resources (the gRPC stream client) free them
         here so swept levels don't accumulate channels."""
 
+    # -- shared-memory data plane -----------------------------------------
+
+    def shm_register(self, name, kind, key=None, raw_handle=None,
+                     byte_size=0, device_ordinal=0):
+        """Register a client-created region (``kind`` 'system' or
+        'xla') with the serving target."""
+        raise NotImplementedError(
+            "backend '{}' does not support shared memory".format(self.kind))
+
+    def shm_unregister(self, name, kind):
+        raise NotImplementedError(
+            "backend '{}' does not support shared memory".format(self.kind))
+
+    def prepare_shm(self, model, input_refs, output_refs=None):
+        """Prepared requests whose inputs are :func:`shm_input_ref`
+        descriptors (one dict per input set) and whose outputs land in
+        shared memory (``output_refs``: list of ``(name, region,
+        byte_size, offset)``), for :meth:`infer`/``submit``."""
+        raise NotImplementedError(
+            "backend '{}' does not support shared memory".format(self.kind))
+
     def close(self):
         if self._executor is not None:
             self._executor.shutdown(wait=False)
@@ -184,13 +205,41 @@ def _np_wire_dtype(arr):
     return np_to_triton_dtype(arr.dtype)
 
 
+def shm_input_ref(region, byte_size, offset, datatype, shape):
+    """A shared-memory input reference: the value a prepared request
+    carries instead of tensor bytes (the wire then moves ~40 bytes of
+    descriptor where the data plane moves the tensor).  Understood by
+    every backend's prepare/generate path and by the clients'
+    ``generate_stream``."""
+    return {
+        "shared_memory_region": region,
+        "shared_memory_byte_size": int(byte_size),
+        "shared_memory_offset": int(offset),
+        "datatype": datatype,
+        "shape": list(shape),
+    }
+
+
+def _is_shm_ref(value):
+    return isinstance(value, dict) and "shared_memory_region" in value
+
+
 def _prepare_infer_inputs(mod, inputs, binary_data=None):
     """Shared input serialization for the socket backends: one
     ``InferInput`` per tensor, dtype mapped once (``binary_data`` is
     the HTTP wire toggle; gRPC's set_data_from_numpy takes no such
-    argument)."""
+    argument).  A :func:`shm_input_ref` value becomes a shared-memory
+    reference instead of inline bytes."""
     prepared = []
     for name, arr in inputs.items():
+        if _is_shm_ref(arr):
+            tin = mod.InferInput(name, list(arr["shape"]), arr["datatype"])
+            tin.set_shared_memory(
+                arr["shared_memory_region"],
+                arr["shared_memory_byte_size"],
+                arr.get("shared_memory_offset", 0))
+            prepared.append(tin)
+            continue
         tin = mod.InferInput(name, list(arr.shape), _np_wire_dtype(arr))
         if binary_data is None:
             tin.set_data_from_numpy(arr)
@@ -245,10 +294,74 @@ class InProcessBackend(ClientBackend):
 
         return InferRequest(model, inputs=dict(inputs))
 
-    def infer(self, prepared):
-        from tpuserver.core import InferRequest, ServerError
+    def shm_register(self, name, kind, key=None, raw_handle=None,
+                     byte_size=0, device_ordinal=0):
+        from tpuserver.core import ServerError
 
         try:
+            if kind == "system":
+                self.core.register_system_shm(name, key, 0, byte_size)
+            else:
+                self.core.register_xla_shm(
+                    name, raw_handle, device_ordinal, byte_size)
+        except ServerError as e:
+            raise BackendError(str(e)) from e
+
+    def shm_unregister(self, name, kind):
+        from tpuserver.core import ServerError
+
+        try:
+            if kind == "system":
+                self.core.unregister_system_shm(name)
+            else:
+                self.core.unregister_xla_shm(name)
+        except ServerError as e:
+            raise BackendError(str(e)) from e
+
+    def prepare_shm(self, model, input_refs, output_refs=None):
+        return [("shm", model, dict(refs), list(output_refs or []))
+                for refs in input_refs]
+
+    def _resolve_refs(self, inputs):
+        """Materialize shm references through the core's bounds-checked
+        resolve path — for an in-process XLA region this returns the
+        live device segment itself: the zero-copy plane."""
+        out = {}
+        for name, value in inputs.items():
+            if _is_shm_ref(value):
+                out[name] = self.core.read_shm_input(
+                    value["shared_memory_region"],
+                    value["shared_memory_byte_size"],
+                    value.get("shared_memory_offset", 0),
+                    value["datatype"],
+                    value["shape"])
+            else:
+                out[name] = value
+        return out
+
+    def infer(self, prepared):
+        from tpuserver.core import (
+            InferRequest,
+            RequestedOutput,
+            ServerError,
+        )
+
+        try:
+            if isinstance(prepared, tuple) and prepared[0] == "shm":
+                _, model, refs, out_refs = prepared
+                requested = None
+                if out_refs:
+                    requested = [
+                        RequestedOutput(
+                            n, binary_data=False, shm_region=region,
+                            shm_byte_size=size, shm_offset=offset)
+                        for n, region, size, offset in out_refs
+                    ]
+                req = InferRequest(
+                    model, inputs=self._resolve_refs(refs),
+                    requested_outputs=requested)
+                self.core.infer(req)
+                return
             # a fresh request object per call: InferRequest carries
             # per-call deadline state the core stamps on it
             req = InferRequest(prepared.model_name,
@@ -260,9 +373,9 @@ class InProcessBackend(ClientBackend):
     def generate_stream(self, model, inputs, parameters=None, stats=None):
         from tpuserver.core import InferRequest, ServerError
 
-        req = InferRequest(model, inputs=dict(inputs),
-                           parameters=dict(parameters or {}))
         try:
+            req = InferRequest(model, inputs=self._resolve_refs(inputs),
+                               parameters=dict(parameters or {}))
             for resp in self.core.infer_stream(req):
                 yield _response_token_count(
                     [spec for spec, _, _ in resp.outputs])
@@ -281,10 +394,73 @@ class InProcessBackend(ClientBackend):
         return {"hits": hits, "misses": misses} if seen else None
 
 
+# -- socket-backend shared shm support --------------------------------------
+
+
+class _TritonClientShmMixin:
+    """Shared-memory support for the socket backends: the tritonclient
+    http/grpc APIs are name-identical (register/unregister verbs,
+    ``InferInput.set_shared_memory``, ``InferRequestedOutput``), so the
+    register/unregister/prepare/infer plumbing lives once here —
+    ``self.client`` is the transport client, ``self._mod`` its module."""
+
+    def shm_register(self, name, kind, key=None, raw_handle=None,
+                     byte_size=0, device_ordinal=0):
+        from tritonclient.utils import InferenceServerException
+
+        try:
+            if kind == "system":
+                self.client.register_system_shared_memory(
+                    name, key, byte_size)
+            else:
+                self.client.register_xla_shared_memory(
+                    name, raw_handle, device_ordinal, byte_size)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+    def shm_unregister(self, name, kind):
+        from tritonclient.utils import InferenceServerException
+
+        try:
+            if kind == "system":
+                self.client.unregister_system_shared_memory(name)
+            else:
+                self.client.unregister_xla_shared_memory(name)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+    def prepare_shm(self, model, input_refs, output_refs=None):
+        prepared = []
+        for refs in input_refs:
+            tins = _prepare_infer_inputs(self._mod, refs)
+            touts = None
+            if output_refs:
+                touts = []
+                for name, region, size, offset in output_refs:
+                    tout = self._mod.InferRequestedOutput(name)
+                    tout.set_shared_memory(region, size, offset)
+                    touts.append(tout)
+            prepared.append((model, tins, touts))
+        return prepared
+
+    def infer(self, prepared):
+        from tritonclient.utils import InferenceServerException
+
+        model, infer_inputs = prepared[0], prepared[1]
+        outputs = prepared[2] if len(prepared) > 2 else None
+        try:
+            if outputs is not None:
+                self.client.infer(model, infer_inputs, outputs=outputs)
+            else:
+                self.client.infer(model, infer_inputs)
+        except InferenceServerException as e:
+            raise BackendError(str(e)) from e
+
+
 # -- HTTP backend ----------------------------------------------------------
 
 
-class HttpBackend(ClientBackend):
+class HttpBackend(_TritonClientShmMixin, ClientBackend):
     """``tritonclient.http`` against a live frontend; generation rides
     the ``/v2/models/{m}/generate_stream`` SSE endpoint."""
 
@@ -403,15 +579,6 @@ class HttpBackend(ClientBackend):
         return (model, _prepare_infer_inputs(
             self._mod, inputs, binary_data=True))
 
-    def infer(self, prepared):
-        from tritonclient.utils import InferenceServerException
-
-        model, infer_inputs = prepared
-        try:
-            self.client.infer(model, infer_inputs)
-        except InferenceServerException as e:
-            raise BackendError(str(e)) from e
-
     def generate_stream(self, model, inputs, parameters=None, stats=None):
         """Stream over /generate_stream SSE via the client's resumable
         path: a connection dropped mid-generation transparently
@@ -442,7 +609,7 @@ class HttpBackend(ClientBackend):
 # -- gRPC backend ----------------------------------------------------------
 
 
-class GrpcBackend(ClientBackend):
+class GrpcBackend(_TritonClientShmMixin, ClientBackend):
     """``tritonclient.grpc``; ``submit`` uses the client's native
     completion-callback async path (no extra thread per in-flight
     request), and generation rides a decoupled bidi stream."""
@@ -479,22 +646,18 @@ class GrpcBackend(ClientBackend):
     def _prepare_one(self, model, inputs):
         return (model, _prepare_infer_inputs(self._mod, inputs))
 
-    def infer(self, prepared):
-        from tritonclient.utils import InferenceServerException
-
-        model, infer_inputs = prepared
-        try:
-            self.client.infer(model, infer_inputs)
-        except InferenceServerException as e:
-            raise BackendError(str(e)) from e
-
     def submit(self, prepared, on_done):
-        model, infer_inputs = prepared
+        model, infer_inputs = prepared[0], prepared[1]
+        outputs = prepared[2] if len(prepared) > 2 else None
 
         def callback(result, error):
             on_done(error)
 
-        self.client.async_infer(model, infer_inputs, callback)
+        if outputs is not None:
+            self.client.async_infer(
+                model, infer_inputs, callback, outputs=outputs)
+        else:
+            self.client.async_infer(model, infer_inputs, callback)
 
     def _thread_client(self):
         client = getattr(self._stream_local, "client", None)
@@ -666,6 +829,164 @@ class PoolBackend(ClientBackend):
             except Exception:  # noqa: BLE001 — teardown best-effort
                 pass
         self.pool.close()
+
+
+# -- shared-memory infer-data manager ---------------------------------------
+
+
+class ShmInferDataManager:
+    """Client-side shared-memory staging for one perf_analyzer worker
+    (role of the reference's ``InferDataManagerShm``): every input set
+    of the rotation pool is written ONCE into a created-and-registered
+    region outside any measurement window; the prepared requests then
+    carry ``{region, offset}`` references, so the timed wire moves
+    ~40-byte descriptors while the tensors ride the shm data plane.
+    ``kind='xla'`` regions also park device segments — against an
+    in-process server the resolve path returns the live ``jax.Array``
+    itself (zero host copies).
+
+    Region names are namespaced by a per-worker ``tag`` (default: the
+    pid plus a random suffix), so N distributed workers driving one
+    server never collide; :meth:`close` unregisters and unlinks every
+    region this manager created — the per-worker region lifecycle.
+    """
+
+    def __init__(self, backend, kind, tag=None):
+        if kind not in ("system", "xla"):
+            raise ValueError(
+                "shared-memory kind must be 'system' or 'xla' "
+                "(got {!r})".format(kind))
+        import os as _os
+        import uuid as _uuid
+
+        self.backend = backend
+        self.kind = kind
+        self.tag = "{}_{}".format(
+            tag if tag is not None else _os.getpid(),
+            _uuid.uuid4().hex[:6])
+        self._regions = []  # (name, handle)
+
+    # -- region lifecycle --------------------------------------------------
+
+    def create_region(self, label, byte_size):
+        """Create + register one region; returns ``(name, handle)``.
+        The handle stays client-owned (this side reads rings / output
+        regions through it)."""
+        name = "pa_{}_{}".format(self.tag, label)
+        if self.kind == "system":
+            from tritonclient.utils import shared_memory as sysshm
+
+            key = "/" + name
+            handle = sysshm.create_shared_memory_region(
+                name, key, byte_size)
+            try:
+                self.backend.shm_register(
+                    name, "system", key=key, byte_size=byte_size)
+            except Exception:
+                sysshm.destroy_shared_memory_region(handle)
+                raise
+        else:
+            from tritonclient.utils import xla_shared_memory as xshm
+
+            handle = xshm.create_shared_memory_region(name, byte_size)
+            try:
+                self.backend.shm_register(
+                    name, "xla", raw_handle=xshm.get_raw_handle(handle),
+                    byte_size=byte_size)
+            except Exception:
+                xshm.destroy_shared_memory_region(handle)
+                raise
+        self._regions.append((name, handle))
+        return name, handle
+
+    def write(self, handle, arrays, offset=0):
+        """Stage arrays at ``offset`` — for xla regions as device
+        arrays when jax is importable (the zero-copy in-process form;
+        the host window syncs automatically for a cross-process
+        server), host bytes otherwise."""
+        if self.kind == "system":
+            from tritonclient.utils import shared_memory as sysshm
+
+            sysshm.set_shared_memory_region(handle, arrays, offset=offset)
+            return
+        from tritonclient.utils import xla_shared_memory as xshm
+
+        try:
+            import jax.numpy as jnp
+
+            arrays = [jnp.asarray(a) for a in arrays]
+        except Exception:  # noqa: BLE001 — host staging still works
+            pass
+        xshm.set_shared_memory_region(handle, arrays, offset=offset)
+
+    def close(self):
+        """Unregister (server side) and unlink (client side) every
+        region this worker created."""
+        regions, self._regions = self._regions, []
+        for name, handle in regions:
+            try:
+                self.backend.shm_unregister(name, self.kind)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+            try:
+                if self.kind == "system":
+                    from tritonclient.utils import shared_memory as sysshm
+
+                    sysshm.destroy_shared_memory_region(handle)
+                else:
+                    from tritonclient.utils import (
+                        xla_shared_memory as xshm,
+                    )
+
+                    xshm.destroy_shared_memory_region(handle)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    # -- staging -----------------------------------------------------------
+
+    def stage_input_sets(self, input_sets):
+        """Write the whole rotation pool into per-input regions (one
+        region per input name, one slot per set) and return the
+        reference dicts ``prepare_shm`` consumes — one per set."""
+        sets = list(input_sets)
+        if not sets:
+            return []
+        refs = [dict() for _ in sets]
+        for name in sets[0]:
+            arrays = [np.ascontiguousarray(s[name]) for s in sets]
+            first = arrays[0]
+            if first.dtype == np.object_:
+                raise ValueError(
+                    "shared-memory mode needs fixed-size dtypes; input "
+                    "'{}' is BYTES".format(name))
+            nbytes = first.nbytes
+            if any(a.nbytes != nbytes for a in arrays):
+                raise ValueError(
+                    "input '{}': every pool set must share one shape "
+                    "in shared-memory mode".format(name))
+            label = "in_" + "".join(
+                c for c in name.lower() if c.isalnum())[:24]
+            region, handle = self.create_region(
+                label, nbytes * len(arrays))
+            datatype = _np_wire_dtype(first)
+            for i, a in enumerate(arrays):
+                self.write(handle, [a], offset=i * nbytes)
+                refs[i][name] = shm_input_ref(
+                    region, nbytes, i * nbytes, datatype, a.shape)
+        return refs
+
+    def stage_outputs(self, output_names, byte_size):
+        """One output region with a ``byte_size`` slot per declared
+        output; returns the ``(name, region, byte_size, offset)`` list
+        ``prepare_shm`` consumes."""
+        names = list(output_names)
+        if not names:
+            return []
+        region, _ = self.create_region("out", byte_size * len(names))
+        return [
+            (n, region, byte_size, j * byte_size)
+            for j, n in enumerate(names)
+        ]
 
 
 # -- factory ---------------------------------------------------------------

@@ -113,7 +113,10 @@ class GenerationProfiler:
         self.backend = backend
         self.model = model
         self.input_pool = list(input_pool)
-        self.parameters = dict(parameters or {})
+        # a callable builds per-stream parameters (the shm token-ring
+        # mode hands every stream its own ring lane); a dict is shared
+        self.parameters = (parameters if callable(parameters)
+                           else dict(parameters or {}))
         self.measurement_interval_s = float(measurement_interval_s)
         self.stability_pct = float(stability_pct)
         self.stability_windows = int(stability_windows)
@@ -146,9 +149,11 @@ class GenerationProfiler:
                 itls = []
                 error = None
                 stream_stats = {}
+                params = (self.parameters() if callable(self.parameters)
+                          else self.parameters)
                 try:
                     for count in self.backend.generate_stream(
-                            self.model, inputs, self.parameters,
+                            self.model, inputs, params,
                             stats=stream_stats):
                         now = time.perf_counter()
                         if ttft is None:

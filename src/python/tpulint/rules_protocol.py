@@ -75,6 +75,14 @@ RESUME_HEADER = "last-event-id"
 
 HEALTH_PREFIX = "/v2/health/"
 STREAM_ROUTE_TOKEN = "generate_stream"
+#: The shared-memory mutation verbs of the data plane.  When the
+#: replica serves the shm register/unregister routes, the router's
+#: route set must reference the same tokens: these verbs BROADCAST to
+#: every replica (a region registered on one replica only would desync
+#: the fleet the moment a failover or handoff lands a shm-referencing
+#: request elsewhere), so a router that stops naming them silently
+#: strands the zero-copy data plane.
+SHM_ROUTE_TOKENS = ("sharedmemory", "register", "unregister")
 #: The telemetry scrape surface: served by BOTH HTTP tiers (the
 #: replica's own exposition; the router re-serves it fleet-aggregated)
 #: so observability tooling points at either address unchanged.
@@ -261,6 +269,21 @@ class ProtocolParityRule:
                 "generate_stream streaming surface (no route literal "
                 "or pattern mentions '{}')".format(STREAM_ROUTE_TOKEN),
             ))
+        if all(any(tok in r for r in http_routes)
+               for tok in SHM_ROUTE_TOKENS):
+            missing_tokens = [
+                tok for tok in SHM_ROUTE_TOKENS
+                if not any(tok in r for r in router_routes)
+            ]
+            if missing_tokens:
+                findings.append(Finding(
+                    self.id, self.name, router_mod.relpath, anchor,
+                    "router route set never references shm verb "
+                    "token(s) {} the replica serves — shm "
+                    "register/unregister must broadcast to every "
+                    "replica or the zero-copy data plane desyncs on "
+                    "failover".format("/".join(missing_tokens)),
+                ))
         if METRICS_ROUTE in http_routes and \
                 METRICS_ROUTE not in router_routes:
             findings.append(Finding(

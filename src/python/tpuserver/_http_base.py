@@ -44,6 +44,7 @@ _STATUS_LINE = {
     400: b"HTTP/1.1 400 Bad Request\r\n",
     404: b"HTTP/1.1 404 Not Found\r\n",
     405: b"HTTP/1.1 405 Method Not Allowed\r\n",
+    409: b"HTTP/1.1 409 Conflict\r\n",
     422: b"HTTP/1.1 422 Unprocessable Entity\r\n",
     429: b"HTTP/1.1 429 Too Many Requests\r\n",
     500: b"HTTP/1.1 500 Internal Server Error\r\n",

@@ -23,6 +23,7 @@ from tpuserver.errors import (  # noqa: F401 — re-exported: the public
     DeadlineExceeded,
     Overloaded,
     ServerError,
+    ShmRegionInUse,
     ShuttingDown,
     SlotQuarantined,
     UnknownGeneration,
@@ -94,6 +95,10 @@ class InferRequest:
         self.inputs = inputs or {}  # name -> np.ndarray (BYTES as np.object_)
         self.requested_outputs = requested_outputs  # list[RequestedOutput]|None
         self.parameters = parameters or {}
+        # shm regions the frontend resolved inputs from: a decoupled
+        # model pins them for the stream's lifetime, so unregistering
+        # the region backing a live prompt view is a typed 409
+        self.shm_input_regions = ()
         # monotonic deadline: stamped by the gRPC frontend (context
         # deadline) and/or resolved from the 'timeout' parameter in
         # InferenceServer._resolve_deadline
@@ -783,6 +788,17 @@ class InferenceServer:
         self._system_shm = {}
         self._cuda_shm = {}  # parity only; registration succeeds, no CUDA io
         self._xla_shm = {}
+        # region name -> reference count of in-flight generations /
+        # token rings holding the region (guarded by _shm_lock):
+        # unregister of a pinned region is a typed 409 conflict, never
+        # a crash or silent corruption under the zero-copy data plane
+        self._shm_pins = {}
+        self._shm_lock = threading.Lock()
+        # generation id -> (region name, parked position, shape, wire
+        # dtype): the server-owned XLA-shm KV exports a parked
+        # generation leaves behind so a same-host resume re-scatters
+        # instead of re-prefilling  # guarded-by: _shm_lock
+        self._kv_exports = {}
         self._batchers = {}  # name -> _DynamicBatcher (lazily created;
         # double-checked locking — deliberately unannotated, see
         # docs/static_analysis.md R1)
@@ -826,6 +842,14 @@ class InferenceServer:
         # (verb, code) -> bound counter child; plain-dict cache so the
         # error path never re-pays the family lock
         self._metric_error_children = {}
+        # shared-memory data-plane traffic: bytes materialized from /
+        # written into registered regions (device-resident zero-copy
+        # transfers count their logical tensor size — the bytes that
+        # did NOT cross the wire)
+        self._m_shm_read = self.metrics.counter(
+            "tpu_shm_bytes_read_total").labels()
+        self._m_shm_written = self.metrics.counter(
+            "tpu_shm_bytes_written_total").labels()
         self.metrics.register_collector(self._collect_metrics)
         for m in models or []:
             self.register_model(m)
@@ -993,6 +1017,12 @@ class InferenceServer:
         with self._inflight_cond:
             inflight = self._inflight
         families = [("tpu_inflight_requests", [({}, inflight)])]
+        families.append((
+            "tpu_shm_regions",
+            [({"kind": "system"}, len(self._system_shm)),
+             ({"kind": "cuda"}, len(self._cuda_shm)),
+             ({"kind": "xla"}, len(self._xla_shm))],
+        ))
         with self._lock:
             items = list(self._models.items())
         per_family = {
@@ -1290,23 +1320,38 @@ class InferenceServer:
                 "shared memory region '{}' already in manager".format(name)
             )
         try:
-            self._system_shm[name] = _SystemShmRegion(
-                name, key, offset, byte_size
-            )
+            region = _SystemShmRegion(name, key, offset, byte_size)
         except OSError as e:
             raise ServerError(
                 "unable to open shared memory region '{}': {}".format(name, e)
             )
+        with self._shm_lock:  # publish atomically vs pin/unregister
+            if name in self._system_shm:
+                region.close()
+                raise ServerError(
+                    "shared memory region '{}' already in "
+                    "manager".format(name)
+                )
+            self._system_shm[name] = region
 
     def unregister_system_shm(self, name=""):
-        if name:
-            region = self._system_shm.pop(name, None)
+        # pin check and registry pop are ONE atomic step under
+        # _shm_lock: a pin taken concurrently (a generation starting)
+        # either lands before the pop — and the unregister conflicts —
+        # or after — and finds the region gone, a typed 400.  The
+        # close itself (syscalls) runs outside the lock.
+        with self._shm_lock:
+            if name:
+                self._check_unpinned_locked(name)
+                regions = [self._system_shm.pop(name, None)]
+            else:
+                for rname in self._system_shm:
+                    self._check_unpinned_locked(rname)
+                regions = list(self._system_shm.values())
+                self._system_shm.clear()
+        for region in regions:
             if region is not None:
                 region.close()
-        else:
-            for region in self._system_shm.values():
-                region.close()
-            self._system_shm.clear()
 
     def system_shm_status(self, name=""):
         regions = {}
@@ -1339,7 +1384,7 @@ class InferenceServer:
                 "shared memory region '{}' already in manager".format(name)
             )
         try:
-            self._xla_shm[name] = _XlaShmRegion(
+            region = _XlaShmRegion(
                 name, raw_handle, device_ordinal, byte_size
             )
         except Exception as e:
@@ -1348,16 +1393,33 @@ class InferenceServer:
                     name, e
                 )
             )
+        with self._shm_lock:  # publish atomically vs pin/unregister
+            if name in self._xla_shm:
+                region.close()
+                raise ServerError(
+                    "shared memory region '{}' already in "
+                    "manager".format(name)
+                )
+            self._xla_shm[name] = region
 
     def unregister_xla_shm(self, name=""):
-        if name:
-            region = self._xla_shm.pop(name, None)
+        # same atomicity as unregister_system_shm: check + pop under
+        # one _shm_lock hold, close/unlink outside it
+        with self._shm_lock:
+            if name:
+                self._check_unpinned_locked(name)
+                dropped = [(name, self._xla_shm.pop(name, None))]
+            else:
+                for rname in self._xla_shm:
+                    self._check_unpinned_locked(rname)
+                dropped = list(self._xla_shm.items())
+                self._xla_shm.clear()
+            for rname, _ in dropped:
+                self._drop_export_entry_locked(rname)
+        for _, region in dropped:
             if region is not None:
                 region.close()
-        else:
-            for region in self._xla_shm.values():
-                region.close()
-            self._xla_shm.clear()
+                self._destroy_owned(region)
 
     def xla_shm_status(self, name=""):
         regions = {}
@@ -1370,6 +1432,127 @@ class InferenceServer:
                 "byte_size": r.byte_size,
             }
         return regions
+
+    # -- region pinning (the in-flight-reference contract) -----------------
+
+    def pin_shm_region(self, name):
+        """Mark ``name`` as referenced by an in-flight generation or a
+        registered token ring.  While pinned, unregister is a typed
+        409 :class:`ShmRegionInUse` — never a crash mid-stream or a
+        silent write into freed memory.  Raises the usual 400 when the
+        region is not registered at all.  Pins nest (one per
+        referencing stream); pair every pin with :meth:`unpin_shm_region`."""
+        with self._shm_lock:
+            self._shm_region(name)  # existence check, typed 400
+            self._shm_pins[name] = self._shm_pins.get(name, 0) + 1
+
+    def unpin_shm_region(self, name):
+        with self._shm_lock:
+            count = self._shm_pins.get(name, 0) - 1
+            if count > 0:
+                self._shm_pins[name] = count
+            else:
+                self._shm_pins.pop(name, None)
+
+    def _check_unpinned_locked(self, name):
+        """Raise the typed 409 for a pinned region.  Called with
+        ``_shm_lock`` held (the unregister paths take it around the
+        check AND the registry pop, so a concurrent pin can never land
+        between the two)."""
+        pins = self._shm_pins.get(name, 0)
+        if pins > 0:
+            raise ShmRegionInUse(
+                "cannot unregister shared memory region '{}': {} "
+                "in-flight generation(s) or token ring(s) still "
+                "reference it; retry after they finish".format(name, pins)
+            )
+
+    # -- server-owned KV exports (park-attach resume) ----------------------
+
+    @staticmethod
+    def _kv_export_region_name(generation_id):
+        return "kvexport/{}".format(generation_id)
+
+    def export_kv_region(self, generation_id, cache, position):
+        """Park a finished-with-for-now generation's gathered KV pages
+        (a device-resident ``jax.Array``) as a server-owned XLA-shm
+        region keyed by the generation id.  A same-host resume (or a
+        restarted frontend over the same core) attaches the region and
+        re-scatters it instead of re-prefilling ``prompt + history`` —
+        token-identical by construction (greedy decode is
+        deterministic; pinned in tests/test_shm_data_plane.py)."""
+        from tritonclient.utils import xla_shared_memory as xshm
+
+        name = self._kv_export_region_name(generation_id)
+        byte_size = int(cache.size) * cache.dtype.itemsize
+        self.drop_kv_region(generation_id)  # a reused id supersedes
+        owner = xshm.create_shared_memory_region(name, byte_size)
+        try:
+            region = _XlaShmRegion(
+                name, xshm.get_raw_handle(owner), 0, byte_size)
+        except Exception:
+            xshm.destroy_shared_memory_region(owner)
+            raise
+        region._owner_handle = owner
+        region.put_device_array(0, cache)
+        with self._shm_lock:
+            self._xla_shm[name] = region
+            self._kv_exports[generation_id] = (
+                name, int(position), tuple(cache.shape), str(cache.dtype))
+
+    def import_kv_region(self, generation_id):
+        """``(device cache, parked position)`` of a prior export, or
+        None when the generation never exported, the region was
+        unregistered, or the device segment is no longer live (e.g. a
+        cross-process attach) — the caller then falls back to the
+        re-prefill path, gracefully."""
+        with self._shm_lock:
+            entry = self._kv_exports.get(generation_id)
+            if entry is None:
+                return None
+            name, position, _, _ = entry
+            region = self._xla_shm.get(name)
+        if region is None:
+            with self._shm_lock:
+                self._kv_exports.pop(generation_id, None)
+            return None
+        cache = region.handle.get_jax_segment(0)
+        if cache is None:
+            return None
+        return cache, position
+
+    def drop_kv_region(self, generation_id):
+        """Release a generation's KV export (resume consumed it, or its
+        replay entry aged out): region unregistered, host window
+        unlinked.  Idempotent."""
+        with self._shm_lock:
+            entry = self._kv_exports.pop(generation_id, None)
+            region = self._xla_shm.pop(entry[0], None) if entry else None
+        if region is not None:
+            region.close()
+            self._destroy_owned(region)
+
+    def _drop_export_entry_locked(self, region_name):
+        """Forget the export record pointing at ``region_name`` (the
+        region itself is being unregistered by the caller).  Called
+        with ``_shm_lock`` held."""
+        for gid, entry in list(self._kv_exports.items()):
+            if entry[0] == region_name:
+                self._kv_exports.pop(gid, None)
+
+    @staticmethod
+    def _destroy_owned(region):
+        """Unlink the owner handle of a server-created region (client
+        regions are owned by the client; their unregister only
+        detaches)."""
+        owner = getattr(region, "_owner_handle", None)
+        if owner is not None:
+            from tritonclient.utils import xla_shared_memory as xshm
+
+            try:
+                xshm.destroy_shared_memory_region(owner)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
 
     def _shm_region(self, name):
         region = self._system_shm.get(name) or self._xla_shm.get(name)
@@ -1442,7 +1625,12 @@ class InferenceServer:
         if isinstance(region, _XlaShmRegion):
             arr = region.get_device_array(offset, datatype, shape)
             if arr is not None:
+                # the zero-copy fast path: count the logical tensor
+                # size (the bytes that did NOT need to cross the host)
+                self._m_shm_read.inc(
+                    int(arr.size) * arr.dtype.itemsize)
                 return arr
+        self._m_shm_read.inc(byte_size)
         raw = region.read(offset, byte_size)
         if datatype == "BYTES":
             return deserialize_bytes_tensor(raw).reshape(
@@ -1459,7 +1647,16 @@ class InferenceServer:
         if isinstance(region, _XlaShmRegion) and not isinstance(
             array, np.ndarray
         ):
+            # the device-resident path is bounds-checked too (.nbytes
+            # is metadata on jax arrays — no transfer): a ring slot or
+            # output reference past the registered size must be the
+            # same typed 400 the host path raises, not a later silent
+            # overrun when the segment syncs to the host window
+            nbytes = int(array.size) * array.dtype.itemsize
+            _, offset = self._check_shm_bounds(region, nbytes, offset,
+                                               "output")
             if region.put_device_array(offset, array):
+                self._m_shm_written.inc(nbytes)
                 return
         if datatype == "BYTES":
             serialized = serialize_byte_tensor(np.asarray(array, dtype=object))
@@ -1469,6 +1666,30 @@ class InferenceServer:
         _, offset = self._check_shm_bounds(region, len(data), offset,
                                            "output")
         region.write(offset, data)
+        self._m_shm_written.inc(len(data))
+
+    #: bytes per token-ring slot: one int32 TOKEN + one fp32 LOGPROB,
+    #: little-endian, packed back to back — the whole per-step event
+    #: payload once the tensors travel through shared memory
+    SHM_RING_SLOT_BYTES = 8
+
+    def write_shm_ring_slot(self, region_name, offset, token, logprob):
+        """Write one generation step into its token-ring slot (the
+        shm-delivery twin of the TOKEN/LOGPROB decoupled response):
+        int32 token + fp32 logprob packed little-endian, ONE
+        bounds-checked region write per step — the same
+        :meth:`write_shm_output` plumbing (lookup, bounds, write,
+        byte accounting) without paying it twice on the per-token hot
+        path.  A ring descriptor pointing past the region is a typed
+        400 on THAT step, never an overrun."""
+        import struct
+
+        data = struct.pack("<if", int(token), float(logprob))
+        region = self._shm_region(region_name)
+        _, offset = self._check_shm_bounds(region, len(data), offset,
+                                           "output")
+        region.write(offset, data)
+        self._m_shm_written.inc(len(data))
 
     # -- inference ---------------------------------------------------------
 
@@ -1811,6 +2032,13 @@ class InferenceServer:
             closer = getattr(model, "close", None)
             if callable(closer):
                 closer()
+        # server-owned KV exports die with the server: their host
+        # windows unlink so healed replicas never inherit stale
+        # /dev/shm files (the chaos --shm zero-leak invariant)
+        with self._shm_lock:
+            export_ids = list(self._kv_exports)
+        for gid in export_ids:
+            self.drop_kv_region(gid)
 
     def _execute_sequence(self, model, inputs, request):
         if request.sequence_id == 0:

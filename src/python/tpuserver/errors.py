@@ -18,6 +18,7 @@ __all__ = [
     "DeadlineExceeded",
     "Overloaded",
     "ServerError",
+    "ShmRegionInUse",
     "ShuttingDown",
     "SlotQuarantined",
     "UnknownGeneration",
@@ -71,6 +72,18 @@ class SlotQuarantined(ServerError):
 
     def __init__(self, msg):
         super().__init__(msg, code=422)
+
+
+class ShmRegionInUse(ServerError):
+    """An unregister named a shared-memory region an in-flight
+    generation or registered token ring still references — HTTP 409 /
+    gRPC ABORTED.  The region stays registered; retry the unregister
+    after the generation finishes (or cancel it first).  Turning this
+    race into a typed conflict is what keeps a concurrent unregister
+    from crashing (or silently corrupting) the zero-copy data plane."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=409)
 
 
 class UnknownGeneration(ServerError):

@@ -67,6 +67,7 @@ class _CoreBridge:
 
     def _request_from_proto(self, request):
         inputs = {}
+        shm_input_regions = []
         raw_cursor = 0  # shm inputs do not consume raw_input_contents slots
         for tensor in request.inputs:
             shape = list(tensor.shape)
@@ -79,6 +80,8 @@ class _CoreBridge:
                     tensor.datatype,
                     shape,
                 )
+                shm_input_regions.append(
+                    tparams["shared_memory_region"])
             elif raw_cursor < len(request.raw_input_contents):
                 inputs[tensor.name] = _array_from_raw(
                     request.raw_input_contents[raw_cursor], tensor.datatype,
@@ -116,7 +119,7 @@ class _CoreBridge:
                         shm_offset=oparams.get("shared_memory_offset", 0),
                     )
                 )
-        return InferRequest(
+        core_request = InferRequest(
             request.model_name,
             request.model_version,
             request.id,
@@ -124,6 +127,10 @@ class _CoreBridge:
             requested,
             _params_dict(request.parameters),
         )
+        # decoupled models pin these for the stream's lifetime (409 on
+        # a concurrent unregister of a region backing a live view)
+        core_request.shm_input_regions = tuple(shm_input_regions)
+        return core_request
 
     def _response_to_proto(self, resp):
         out = pb.ModelInferResponse(
@@ -535,6 +542,7 @@ def _status_code(http_code):
     return {
         400: grpc.StatusCode.INVALID_ARGUMENT,
         404: grpc.StatusCode.NOT_FOUND,
+        409: grpc.StatusCode.ABORTED,  # shm region still referenced
         422: grpc.StatusCode.INVALID_ARGUMENT,  # quarantined slot
         429: grpc.StatusCode.RESOURCE_EXHAUSTED,
         500: grpc.StatusCode.INTERNAL,

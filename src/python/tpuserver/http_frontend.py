@@ -271,6 +271,7 @@ class _Handler(BaseHttpHandler):
                     except ValueError:
                         pass  # malformed id: treat as a fresh request
         inputs = {}
+        shm_input_regions = []
         for tin in request_json.get("inputs", []):
             datatype = tin.get("datatype")
             if not datatype:
@@ -278,13 +279,32 @@ class _Handler(BaseHttpHandler):
                     "generate input '{}' needs a datatype".format(
                         tin.get("name"))
                 )
-            inputs[tin["name"]] = _array_from_json_data(
-                tin.get("data"), datatype, tin["shape"]
-            )
+            tparams = tin.get("parameters", {})
+            if "shared_memory_region" in tparams:
+                # generation admissions accept PROMPT_IDS (and any
+                # other input) by shm region reference: resolved
+                # through the same bounds-checked core path as /infer;
+                # for an in-process XLA region the model consumes the
+                # device segment view directly — zero host staging
+                inputs[tin["name"]] = core.read_shm_input(
+                    tparams["shared_memory_region"],
+                    tparams.get("shared_memory_byte_size", 0),
+                    tparams.get("shared_memory_offset", 0),
+                    datatype,
+                    tin["shape"],
+                )
+                shm_input_regions.append(tparams["shared_memory_region"])
+            else:
+                inputs[tin["name"]] = _array_from_json_data(
+                    tin.get("data"), datatype, tin["shape"]
+                )
         request = InferRequest(
             model, version, request_json.get("id", ""), inputs, None,
             parameters,
         )
+        # the model pins these for the stream's lifetime: the region
+        # backing a live device view must conflict on unregister (409)
+        request.shm_input_regions = tuple(shm_input_regions)
 
         def response_json(resp):
             out = {

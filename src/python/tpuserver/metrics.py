@@ -76,6 +76,20 @@ CATALOG = {
         "shed, 504 = deadline, 503 = draining/shutdown, ...)."),
     "tpu_inflight_requests": (
         "gauge", "Requests currently executing in the core."),
+    # -- shared-memory data plane ------------------------------------------
+    "tpu_shm_regions": (
+        "gauge",
+        "Registered shared-memory regions, by kind (system / cuda / "
+        "xla; server-owned KV exports count as xla)."),
+    "tpu_shm_bytes_read_total": (
+        "counter",
+        "Bytes materialized from registered shared-memory regions "
+        "(request inputs resolved by reference; device-resident "
+        "zero-copy reads count their logical tensor size)."),
+    "tpu_shm_bytes_written_total": (
+        "counter",
+        "Bytes written into registered shared-memory regions (shm-"
+        "delivered outputs and token-ring slots)."),
     # -- decode scheduler (continuous batching) ----------------------------
     "tpu_scheduler_admissions_total": (
         "counter",

@@ -81,7 +81,7 @@ class LlamaGenerateModel(Model):
                  restart_window_s=60.0, restart_backoff_s=0.05,
                  replay_ttl_s=60.0, replay_capacity=256,
                  page_size=16, kv_pages=None, prefill_chunk_tokens=256,
-                 prefix_cache=True):
+                 prefix_cache=True, kv_export=False):
         self._cfg = cfg or llama.tiny(vocab=2048)
         # replica identity threaded to the scheduler's fault-injection
         # points (multi-replica chaos harnesses)
@@ -115,6 +115,11 @@ class LlamaGenerateModel(Model):
         self._kv_pages = kv_pages
         self._prefill_chunk_tokens = prefill_chunk_tokens
         self._prefix_cache = prefix_cache
+        # default for the per-request ``kv_park`` parameter: park a
+        # disconnected resumable generation's gathered KV pages as a
+        # server-owned XLA-shm region, so a same-host resume attaches
+        # and re-scatters instead of re-prefilling prompt + history
+        self._kv_export = bool(kv_export)
         self._scheduler = None  # DecodeScheduler when max_slots > 1
         # continuous-batching models interleave many streams' responses;
         # the frontends must not serialize their stream requests
@@ -179,9 +184,20 @@ class LlamaGenerateModel(Model):
                         page_size=self._page_size,
                         kv_pages=self._kv_pages,
                     )
+                    server = self._server
+                    kv_hooks = {}
+                    if server is not None:
+                        # the park-attach data plane rides the server's
+                        # XLA-shm registry; per-request ``kv_park``
+                        # (or the model-level default) turns it on
+                        kv_hooks = dict(
+                            kv_export=server.export_kv_region,
+                            kv_import=server.import_kv_region,
+                            kv_discard=server.drop_kv_region)
                     self._scheduler = DecodeScheduler(
                         fns, params, self._max_slots, self._max_seq,
                         max_pending=self._max_pending,
+                        **kv_hooks,
                         fault_scope=self._fault_scope,
                         step_timeout_s=self._step_timeout_s,
                         max_restarts=self._max_restarts,
@@ -269,25 +285,129 @@ class LlamaGenerateModel(Model):
             )
         return parked, int(request.parameters["kv_cache_position"])
 
+    def _ring_writer(self, request):
+        """``(region_name, write)`` for a request carrying a token-ring
+        descriptor (``shm_ring_region`` + ``shm_ring_slots`` [+
+        ``shm_ring_offset`` base]), or None.  ``write(seq, token,
+        logprob)`` lands the step in its ring slot (``seq %% slots``)
+        through the server's bounds-checked shm plumbing and returns
+        the slot's byte offset — the descriptor the decoupled event
+        carries instead of the tensors."""
+        name = request.parameters.get("shm_ring_region")
+        if not name:
+            return None
+        server = self._server
+        if server is None:
+            from tpuserver.core import ServerError
+
+            raise ServerError(
+                "model '{}' has no server attached; shm_ring_region "
+                "requires a registered shared-memory region".format(
+                    self.name)
+            )
+        slots = int(request.parameters.get("shm_ring_slots") or 0)
+        if slots < 1:
+            raise ValueError(
+                "shm_ring_region requires shm_ring_slots >= 1 (the "
+                "ring geometry travels with the request)")
+        base = int(request.parameters.get("shm_ring_offset") or 0)
+        slot_bytes = server.SHM_RING_SLOT_BYTES
+
+        def write(seq, token, logprob):
+            off = base + (seq % slots) * slot_bytes
+            server.write_shm_ring_slot(name, off, token, logprob)
+            return off
+
+        return name, write
+
+    @staticmethod
+    def _emit_token(token, logprob, seq, ring_write):
+        """One decoupled response: the TOKEN/LOGPROB tensors in-band,
+        or — on the shm token ring — just the slot descriptor (the
+        event shrinks to ``seq -> offset``; the tensors live in the
+        client-registered region)."""
+        if ring_write is None:
+            return {
+                "TOKEN": np.array([token], dtype=np.int32),
+                "LOGPROB": np.array([logprob], dtype=np.float32),
+            }
+        from tpuserver.core import RESPONSE_PARAMS_KEY
+
+        off = ring_write(seq, int(token), float(logprob))
+        params = {"seq": seq}
+        params["shm_ring_offset"] = off
+        return {RESPONSE_PARAMS_KEY: params}
+
     def execute_stream(self, inputs, request):
         import jax
-        import jax.numpy as jnp
 
         self._ensure_compiled()
-        prompt = np.asarray(inputs["PROMPT_IDS"]).reshape(-1).astype(np.int32)
+        raw_prompt = inputs["PROMPT_IDS"]
+        prompt_dev = None
+        if isinstance(raw_prompt, jax.Array):
+            # the zero-copy request plane: a device-resident XLA-shm
+            # segment view feeds prefill directly — the ids are never
+            # staged through the host on the single-stream path, and
+            # the scheduler's cold prefill consumes the view on device
+            prompt_dev = (raw_prompt if raw_prompt.ndim == 1
+                          else raw_prompt.reshape(-1))
+            prompt = None
+            prompt_len = int(prompt_dev.shape[0])
+        else:
+            prompt = np.asarray(raw_prompt).reshape(-1).astype(np.int32)
+            prompt_len = len(prompt)
         max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).reshape(-1)[0])
-        if len(prompt) == 0:
+        if prompt_len == 0:
             raise ValueError("PROMPT_IDS must be non-empty")
         eos_id = request.parameters.get("eos_id")
         eos_id = int(eos_id) if eos_id is not None else None
 
-        if self._scheduler is not None:
-            # continuous batching: hand the request to the shared decode
-            # loop; tokens stream back as the batched steps produce them
-            yield from self._execute_scheduled(
-                prompt, max_tokens, eos_id, request
-            )
-            return
+        ring = self._ring_writer(request)
+        ring_write = ring[1] if ring is not None else None
+        # pin every referenced region for the stream's lifetime: a
+        # concurrent unregister becomes a typed 409 conflict instead of
+        # a crash (or a silent write into freed memory) mid-generation
+        pinned = []
+        server = self._server
+        try:
+            if server is not None:
+                names = {n for n in (
+                    ring[0] if ring is not None else None,
+                    request.parameters.get("kv_cache_region"),
+                ) if n}
+                # regions the frontend resolved inputs from (the
+                # prompt's live device view) pin too
+                names.update(getattr(request, "shm_input_regions", ()))
+                for name in names:
+                    server.pin_shm_region(name)
+                    pinned.append(name)
+            if self._scheduler is not None:
+                # continuous batching: hand the request to the shared
+                # decode loop; tokens stream back as the batched steps
+                # produce them
+                if prompt is None:
+                    # the scheduler's bookkeeping (radix keys, replay
+                    # history) needs host ids; ONE device->host read —
+                    # the prefill itself still consumes the device view
+                    prompt = np.asarray(prompt_dev).reshape(-1).astype(
+                        np.int32)
+                yield from self._execute_scheduled(
+                    prompt, max_tokens, eos_id, request, ring_write,
+                    prompt_dev=prompt_dev,
+                )
+            else:
+                yield from self._execute_single(
+                    prompt, prompt_dev, prompt_len, max_tokens, eos_id,
+                    request, ring_write,
+                )
+        finally:
+            for name in pinned:
+                server.unpin_shm_region(name)
+
+    def _execute_single(self, prompt, prompt_dev, prompt_len, max_tokens,
+                        eos_id, request, ring_write):
+        import jax
+        import jax.numpy as jnp
 
         region = self._kv_region(request)
         parked, pos = self._resume_state(request, region)
@@ -300,21 +420,27 @@ class LlamaGenerateModel(Model):
         if cache is None:
             cache = self._init_cache()
             pos = 0
-        if pos + len(prompt) + max_tokens > self._max_seq:
+        if pos + prompt_len + max_tokens > self._max_seq:
             raise ValueError(
                 "position ({}) + prompt ({}) + max_tokens ({}) exceeds max "
                 "sequence {}".format(
-                    pos, len(prompt), max_tokens, self._max_seq
+                    pos, prompt_len, max_tokens, self._max_seq
                 )
             )
 
-        tokens = jnp.asarray(prompt)[None, :]
+        if prompt_dev is not None:
+            # zero-copy: the XLA-shm segment view IS the prefill input
+            # (row axis added on device; no host staging)
+            tokens = (prompt_dev if prompt_dev.dtype == jnp.int32
+                      else prompt_dev.astype(jnp.int32))[None, :]
+        else:
+            tokens = jnp.asarray(prompt)[None, :]
         if pos == 0:
             logits, cache = self._prefill(self._params, cache, tokens)
-            pos = len(prompt)
+            pos = prompt_len
         else:
             # resumed: feed the new prompt tokens one at a time from pos
-            for t in range(len(prompt)):
+            for t in range(prompt_len):
                 logits, cache = self._decode(
                     self._params, cache, tokens[:, t], pos
                 )
@@ -350,10 +476,7 @@ class LlamaGenerateModel(Model):
             inflight.append((tokens_dev, logps_dev,
                              self.decode_chunk - 1, True))
             t0, l0 = jax.device_get((early_tok, early_lp))
-            yield {
-                "TOKEN": np.array([t0[0]], dtype=np.int32),
-                "LOGPROB": np.array([l0[0]], dtype=np.float32),
-            }
+            yield self._emit_token(t0[0], l0[0], emitted, ring_write)
             emitted += 1
             if eos_id is not None and int(t0[0]) == eos_id:
                 if region is not None:
@@ -402,10 +525,8 @@ class LlamaGenerateModel(Model):
                 tokens_host = tokens_all[start:, 0]
                 logps_host = logps_all[start:, 0]
             for i in range(n):
-                yield {
-                    "TOKEN": np.array([tokens_host[i]], dtype=np.int32),
-                    "LOGPROB": np.array([logps_host[i]], dtype=np.float32),
-                }
+                yield self._emit_token(
+                    tokens_host[i], logps_host[i], emitted, ring_write)
                 emitted += 1
                 if eos_id is not None and int(tokens_host[i]) == eos_id:
                     # the EOS token is emitted, then generation stops;
@@ -423,7 +544,8 @@ class LlamaGenerateModel(Model):
             # parked array stays sharded across the mesh.
             region.put_device_array(0, cache)
 
-    def _execute_scheduled(self, prompt, max_tokens, eos_id, request):
+    def _execute_scheduled(self, prompt, max_tokens, eos_id, request,
+                           ring_write=None, prompt_dev=None):
         """Continuous-batching path: submit to the shared decode loop and
         fan its per-step tokens back out to this stream.
 
@@ -474,6 +596,7 @@ class LlamaGenerateModel(Model):
 
             gen_id = str(request.parameters.get("generation_id")
                          or uuid.uuid4().hex)
+            kv_park = request.parameters.get("kv_park")
             stream = scheduler.submit(
                 prompt, max_tokens, eos_id=eos_id,
                 resume_cache=(jnp.asarray(parked)
@@ -485,16 +608,32 @@ class LlamaGenerateModel(Model):
                 # past it
                 deadline=getattr(request, "deadline", None),
                 generation_id=gen_id,
+                prompt_dev=prompt_dev,
+                # park-export opt-in: the request's kv_park parameter,
+                # defaulting to the model-level kv_export flag
+                kv_export=(self._kv_export if kv_park is None
+                           else bool(kv_park)),
             )
             seq = 0
         for token, logprob in stream:
-            yield {
-                "TOKEN": np.array([token], dtype=np.int32),
-                "LOGPROB": np.array([logprob], dtype=np.float32),
-                RESPONSE_PARAMS_KEY: {
-                    "generation_id": gen_id, "seq": seq,
-                },
-            }
+            if ring_write is not None:
+                # the shm token ring: tensors land in the client's
+                # region slot; the event shrinks to its descriptor.
+                # Replayed tokens on resume REWRITE their slots (seq
+                # numbering is preserved), so the router's sticky-
+                # resume and handoff invariants hold unmodified.
+                off = ring_write(seq, int(token), float(logprob))
+                params = {"generation_id": gen_id, "seq": seq}
+                params["shm_ring_offset"] = off
+                yield {RESPONSE_PARAMS_KEY: params}
+            else:
+                yield {
+                    "TOKEN": np.array([token], dtype=np.int32),
+                    "LOGPROB": np.array([logprob], dtype=np.float32),
+                    RESPONSE_PARAMS_KEY: {
+                        "generation_id": gen_id, "seq": seq,
+                    },
+                }
             seq += 1
 
     def healthy(self):

@@ -139,10 +139,16 @@ class _Stream:
         # are tree pages, the rest up to span_pages are owned), and
         # the reserved span in pages
         "table", "radix_nodes", "span_pages",
+        # zero-copy data plane (ISSUE 12): the device-resident prompt
+        # view (an XLA-shm segment — cold prefills consume it without
+        # host staging), the park-export opt-in, and the attach-resume
+        # state a same-host resume scatters instead of re-prefilling
+        "prompt_dev", "kv_export", "attach_cache", "attach_pos",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
-                 resume_pos, on_finish, deadline=None, generation_id=None):
+                 resume_pos, on_finish, deadline=None, generation_id=None,
+                 prompt_dev=None, kv_export=False):
         import queue as _queue
 
         self.prompt = prompt
@@ -173,6 +179,10 @@ class _Stream:
         self.table = None        # np [pages_per_seq] page-table row
         self.radix_nodes = None  # pinned radix path (prefix pages)
         self.span_pages = 0      # reserved logical pages
+        self.prompt_dev = prompt_dev  # device prompt view, or None
+        self.kv_export = bool(kv_export)
+        self.attach_cache = None  # imported KV export (device array)
+        self.attach_pos = 0       # its valid-prefix end position
 
     def expired(self, now):
         return self.deadline is not None and now >= self.deadline
@@ -234,7 +244,8 @@ class DecodeScheduler:
                  restart_window_s=60.0, restart_backoff_s=0.05,
                  replay_ttl_s=60.0, replay_capacity=256,
                  metrics=None, metric_labels=None,
-                 prefill_chunk_tokens=256, prefix_cache=True):
+                 prefill_chunk_tokens=256, prefix_cache=True,
+                 kv_export=None, kv_import=None, kv_discard=None):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
@@ -322,6 +333,19 @@ class DecodeScheduler:
         self._prefix_hits = 0
         self._prefix_misses = 0
         self._prefix_evictions = 0
+        # park-attach KV export hooks (tentpole 3 of ISSUE 12): a
+        # disconnected resumable stream's gathered pages are handed to
+        # ``kv_export(generation_id, cache, valid_pos)`` (the server
+        # parks them in an XLA-shm region keyed by the id);
+        # ``kv_import(generation_id)`` -> (cache, valid_pos) | None is
+        # consulted on resume — hit means the re-admission SCATTERS the
+        # parked pages and force-feeds one token instead of
+        # re-prefilling prompt + history; ``kv_discard(generation_id)``
+        # releases the export when its replay entry dies.  All three
+        # optional: absent hooks keep the pre-export behavior exactly.
+        self._kv_export = kv_export
+        self._kv_import = kv_import
+        self._kv_discard = kv_discard
         # (allocator, radix) of the CURRENT loop, for stats/gauges
         # (a restart rebuilds both with the device pool)
         self._pager = None  # guarded-by: _cond
@@ -346,7 +370,7 @@ class DecodeScheduler:
 
     def submit(self, prompt, max_tokens, eos_id=None, resume_cache=None,
                resume_pos=0, on_finish=None, deadline=None,
-               generation_id=None):
+               generation_id=None, prompt_dev=None, kv_export=False):
         """Enqueue one generation; returns an iterator of
         ``(token, logprob)`` pairs that blocks as the decode loop
         produces them.
@@ -375,7 +399,9 @@ class DecodeScheduler:
             )
         stream = _Stream(prompt, int(max_tokens), eos_id,
                          resume_cache, int(resume_pos), on_finish,
-                         deadline=deadline, generation_id=generation_id)
+                         deadline=deadline, generation_id=generation_id,
+                         prompt_dev=prompt_dev,
+                         kv_export=kv_export and resume_cache is None)
         with self._cond:
             if self._closed:
                 raise SchedulerClosed("scheduler is shut down")
@@ -394,8 +420,11 @@ class DecodeScheduler:
                     "generations); retry later".format(len(self._pending))
                 )
             if generation_id is not None:
-                # a reused id supersedes any parked predecessor
-                self._replay.pop(generation_id, None)
+                # a reused id supersedes any parked predecessor (and
+                # its KV export)
+                if self._replay.pop(generation_id, None) is not None \
+                        and self._kv_discard is not None:
+                    self._kv_discard(generation_id)
             self._pending.append(stream)
             self._streams.add(stream)
             self._ensure_running_locked()
@@ -428,6 +457,7 @@ class DecodeScheduler:
         # ``deadline`` parameter, which is the RECONNECT's own request
         # bound (None = unbounded) stamped onto the re-admitted stream
         wait_deadline = time.monotonic() + float(wait_s)
+        discard_export = False
         with self._cond:
             while True:
                 if self._closed:
@@ -489,6 +519,24 @@ class DecodeScheduler:
                 stream.finished = False
                 stream.deadline = deadline  # the reconnect's own bound
                 self._reset_for_readmission(stream)
+                if (self._kv_import is not None and stream.kv_export
+                        and stream.resume_cache is None):
+                    # same-host attach: the park left the generation's
+                    # gathered KV in a server-owned XLA-shm region —
+                    # re-admission scatters it back and force-feeds one
+                    # token instead of re-prefilling prompt + history.
+                    # Import is one-shot (the export drops); any
+                    # failure below falls back to the re-prefill path.
+                    got = self._kv_import(generation_id)
+                    if got is not None:
+                        cache, valid = got
+                        known = len(stream.prompt) + len(stream.history)
+                        if 0 < valid <= known:
+                            stream.attach_cache = cache
+                            stream.attach_pos = int(valid)
+                        # one-shot: the region drops AFTER _cond
+                        # releases (unlink is syscall work)
+                        discard_export = self._kv_discard is not None
                 self._pending.append(stream)
                 self._streams.add(stream)
                 self._ensure_running_locked()
@@ -496,6 +544,8 @@ class DecodeScheduler:
             # counted only once every validation gate passed: a
             # malformed/rejected resume served nothing from the buffer
             self._replay_hits += 1
+        if discard_export:
+            self._kv_discard(generation_id)
 
         def gen():
             live = None if completed else self._drain(stream)
@@ -564,8 +614,12 @@ class DecodeScheduler:
             leftover = list(self._streams)
             self._streams.clear()
             self._pending.clear()
+            parked_ids = list(self._replay)
             self._replay.clear()
             self._cond.notify_all()
+        if self._kv_discard is not None:
+            for gid in parked_ids:
+                self._kv_discard(gid)
         err = SchedulerClosed("scheduler is shut down")
         for stream in leftover:
             stream.queue.put(("err", err, None))
@@ -794,6 +848,11 @@ class DecodeScheduler:
         stream.table = None
         stream.radix_nodes = None
         stream.span_pages = 0
+        # a pending attach-resume dies with the loop that would have
+        # scattered it: the salvage re-admission falls back to the
+        # re-prefill path (greedy decode makes both token-identical)
+        stream.attach_cache = None
+        stream.attach_pos = 0
 
     # -- replay buffer -----------------------------------------------------
 
@@ -804,6 +863,10 @@ class DecodeScheduler:
         ]
         for gid in expired:
             self._replay.pop(gid, None)
+            if self._kv_discard is not None:
+                # the KV export shares the replay entry's lifetime: an
+                # id nobody can resume anymore must not pin HBM/shm
+                self._kv_discard(gid)
 
     def _park_locked(self, stream, completed):
         """Retain a resumable generation's history for later resume.
@@ -815,15 +878,21 @@ class DecodeScheduler:
             # replays — drop the device state NOW, or up to
             # replay_capacity parked KV-cache copies (resume_cache) and
             # shm-pinning on_finish closures would sit in the buffer
-            # for the whole TTL
+            # for the whole TTL — and any KV export is dead weight (a
+            # finished generation never re-decodes)
             stream.resume_cache = None
             stream.on_finish = None
+            stream.attach_cache = None
+            if self._kv_discard is not None and stream.kv_export:
+                self._kv_discard(stream.generation_id)
         self._replay[stream.generation_id] = (
             stream, completed, now + self._replay_ttl_s
         )
         self._replay.move_to_end(stream.generation_id)
         while len(self._replay) > self._replay_capacity:
-            self._replay.popitem(last=False)  # evict oldest
+            gid, _ = self._replay.popitem(last=False)  # evict oldest
+            if self._kv_discard is not None:
+                self._kv_discard(gid)
 
     def _detach_locked(self, stream):
         """Retire a cancelled stream from the live registry; resumable
@@ -980,6 +1049,35 @@ class DecodeScheduler:
             stream.radix_nodes = None
             stream.span_pages = 0
 
+        def export_kv(stream):
+            """Park a reaped resumable stream's gathered KV through the
+            ``kv_export`` hook (the server owns it as an XLA-shm region
+            keyed by the generation id).  The valid prefix is exactly
+            ``prompt + history`` positions: every dispatched-but-
+            unfetched step's write lands beyond it, so the export can
+            never contain a token the client was not delivered.  Runs
+            BEFORE ``release_pages`` — the gather captures the current
+            pool value, so later page reuse cannot corrupt it.  Called
+            under the loop's ``_cond`` at both reap sites: the cost is
+            an async gather dispatch plus a few shm syscalls (the
+            export stores the device reference — no copy), paid only
+            on the rare cancel reap.  Export is an optimization: any
+            failure silently falls back to the re-prefill resume
+            path."""
+            if (self._kv_export is None or not stream.kv_export
+                    or stream.generation_id is None
+                    or stream.resume_cache is not None
+                    or stream.table is None):
+                return
+            valid = len(stream.prompt) + len(stream.history)
+            if valid <= 0:
+                return
+            try:
+                parked = fns["gather"](pages, stream.table)
+                self._kv_export(stream.generation_id, parked, valid)
+            except Exception:  # noqa: BLE001 — optimization only
+                pass
+
         def complete_admission(slot, stream, full):
             """Post-admit bookkeeping: donate the prompt's full pages
             to the radix tree NOW (pinned — siblings admitted next
@@ -1028,6 +1126,53 @@ class DecodeScheduler:
                 # new incarnation: step snapshots taken against a
                 # previous admission of this stream object become inert
                 stream.incarnation += 1
+                if stream.attach_cache is not None:
+                    # park-attach resume (tentpole 3): the generation's
+                    # exported KV pages scatter straight back into a
+                    # fresh page span and ONE token (the last of the
+                    # valid prefix, rewritten in place) force-feeds to
+                    # regenerate the logits — no re-prefill of
+                    # prompt + history.  Token-identical to the
+                    # re-prefill path by greedy determinism
+                    # (test-pinned in tests/test_shm_data_plane.py).
+                    known = [int(t_) for t_ in stream.prompt] + [
+                        t_ for t_, _ in stream.history]
+                    start = stream.attach_pos - 1
+                    span_end = len(stream.prompt) + stream.max_tokens
+                    span_pages = pages_for(span_end, page)
+                    stream.radix_nodes = []
+                    owned = alloc.alloc(span_pages)
+                    if owned is None and radix is not None:
+                        freed = radix.evict(span_pages - alloc.free_count)
+                        self._prefix_evictions += len(freed)
+                        alloc.free(freed)
+                        owned = alloc.alloc(span_pages)
+                    if owned is None:
+                        self._fail(stream, AdmissionQueueFull(
+                            "kv page pool exhausted: attach-resume "
+                            "needs {} pages but only {} are free; "
+                            "retry later".format(
+                                span_pages, alloc.free_count)), epoch)
+                        clear_slot(slot)
+                        return
+                    table = np.full((ppseq,), n_pages, np.int32)
+                    table[:span_pages] = owned
+                    stream.table = table
+                    stream.span_pages = span_pages
+                    t = self._step_timeout_s
+                    self._beat(epoch,
+                               time.monotonic() + 9 * t if t else None)
+                    slot_logits = jnp.zeros(
+                        (1, logits.shape[1]), logits.dtype)
+                    stream.forced.extend(known[start:])
+                    stream.pos = start
+                    attach_cache = stream.attach_cache
+                    stream.attach_cache = None  # consumed
+                    pages, logits = fns["admit"](
+                        pages, logits, jnp.asarray(attach_cache),
+                        slot_logits, table, slot)
+                    complete_admission(slot, stream, None)
+                    return
                 replayed = [t_ for t_, _ in stream.history]
                 start = (stream.resume_pos
                          if stream.resume_cache is not None else 0)
@@ -1158,12 +1303,24 @@ class DecodeScheduler:
                     # prefill, byte-for-byte (prefill_bucket keeps the
                     # kernel choice, padding rows stay masked)
                     bucket = fns["prefill_bucket"](suffix_len)
-                    padded = np.zeros((bucket,), np.int32)
-                    padded[:suffix_len] = suffix
+                    if (stream.prompt_dev is not None and not replayed
+                            and stream.resume_cache is None):
+                        # zero-copy data plane: the prompt is already a
+                        # device-resident XLA-shm segment view — pad it
+                        # on device (zeros + scatter of the view) so
+                        # the ids never stage through the host
+                        tokens_in = jnp.zeros(
+                            (bucket,), jnp.int32
+                        ).at[:suffix_len].set(
+                            stream.prompt_dev.astype(jnp.int32)
+                        )[None, :]
+                    else:
+                        padded = np.zeros((bucket,), np.int32)
+                        padded[:suffix_len] = suffix
+                        tokens_in = jnp.asarray(padded)[None, :]
                     slot_cache = fns["init_slot_cache"]()
                     slot_logits, slot_cache = fns["prefill"](
-                        self._params, slot_cache,
-                        jnp.asarray(padded)[None, :], suffix_len)
+                        self._params, slot_cache, tokens_in, suffix_len)
                     if superseded():
                         return  # demoted mid-dispatch: mutate nothing
                 stream.pos = prefill_len
@@ -1280,6 +1437,10 @@ class DecodeScheduler:
                 for i, st in enumerate(slots):
                     if st is not None and st.cancelled:
                         prefilling.pop(i, None)
+                        if ready[i]:
+                            # park-export before the pages free: the
+                            # resumable stream's attach-resume rides it
+                            export_kv(st)
                         release_pages(st)
                         self._detach_locked(st)
                         clear_slot(i)
@@ -1402,7 +1563,9 @@ class DecodeScheduler:
                             # consumer gone: free the slot (and its
                             # pages — full ones donate to the radix
                             # cache) AND retire the stream (parking
-                            # resumables)
+                            # resumables, with their KV exported for
+                            # attach-resume)
+                            export_kv(st)
                             release_pages(st)
                             self._detach_locked(st)
                             clear_slot(i)

@@ -979,21 +979,40 @@ class InferenceServerClient:
 
         from tritonclient.utils import np_to_triton_dtype
 
+        def _input_json(name, arr):
+            if isinstance(arr, dict) and "shared_memory_region" in arr:
+                # a shared-memory reference (the zero-copy data plane):
+                # the prompt ids live in a registered region; the wire
+                # carries only this descriptor
+                return {
+                    "name": name,
+                    "shape": list(arr["shape"]),
+                    "datatype": arr["datatype"],
+                    "parameters": {
+                        "shared_memory_region":
+                            arr["shared_memory_region"],
+                        "shared_memory_byte_size":
+                            arr["shared_memory_byte_size"],
+                        "shared_memory_offset":
+                            arr.get("shared_memory_offset", 0),
+                    },
+                }
+            return {
+                "name": name,
+                "shape": list(np.asarray(arr).shape),
+                "datatype": ("BYTES"
+                             if np.asarray(arr).dtype == np.object_
+                             else np_to_triton_dtype(
+                                 np.asarray(arr).dtype)),
+                "data": [
+                    v.decode("utf-8") if isinstance(v, bytes) else v
+                    for v in np.asarray(arr).reshape(-1).tolist()
+                ],
+            }
+
         body_json = {
             "inputs": [
-                {
-                    "name": name,
-                    "shape": list(np.asarray(arr).shape),
-                    "datatype": ("BYTES"
-                                 if np.asarray(arr).dtype == np.object_
-                                 else np_to_triton_dtype(
-                                     np.asarray(arr).dtype)),
-                    "data": [
-                        v.decode("utf-8") if isinstance(v, bytes) else v
-                        for v in np.asarray(arr).reshape(-1).tolist()
-                    ],
-                }
-                for name, arr in inputs.items()
+                _input_json(name, arr) for name, arr in inputs.items()
             ],
         }
         if request_id:

@@ -127,5 +127,17 @@ def test_llama_streaming_example():
         )
         assert result.returncode == 0, result.stdout + result.stderr
         assert "PASS" in result.stdout
+        # the zero-copy plane: prompt by shm reference, tokens read
+        # back from the region's ring — identical to the in-band run
+        result = subprocess.run(
+            [sys.executable,
+             os.path.join(EXAMPLES_DIR, "llama_streaming_client.py"),
+             "-u", "127.0.0.1:{}".format(frontend.port), "-n", "3",
+             "--shared-memory", "xla"],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert result.returncode == 0, result.stdout + result.stderr
+        assert "PASS: llama streaming (xla shared memory)" in \
+            result.stdout
     finally:
         frontend.stop()

@@ -623,3 +623,78 @@ def test_close_is_idempotent_and_drain_of_idle_scheduler_is_fast():
     sched.close()  # second close is safe
     with pytest.raises(SchedulerClosed):
         sched.submit(np.array([1], np.int32), 1)
+
+
+# -- shm data-plane conflict semantics (ISSUE 12) ---------------------------
+
+
+def test_unregister_pinned_shm_region_is_typed_409():
+    """Unregistering a region an in-flight generation or token ring
+    still references is a typed ShmRegionInUse (HTTP 409) — never a
+    crash or a silent write into freed memory; the region survives and
+    unregister succeeds once the pin releases."""
+    from tpuserver.core import ShmRegionInUse
+    from tritonclient.utils import shared_memory as sysshm
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    core = InferenceServer([SimpleModel()])
+    xh = xshm.create_shared_memory_region("xr", 256)
+    core.register_xla_shm("xr", xshm.get_raw_handle(xh), 0, 256)
+    sh = sysshm.create_shared_memory_region("sr", "/t1_sr_pin", 256)
+    core.register_system_shm("sr", "/t1_sr_pin", 0, 256)
+    try:
+        core.pin_shm_region("xr")  # what a live stream holds
+        core.pin_shm_region("sr")
+        for name in ("xr", "sr"):
+            with pytest.raises(ShmRegionInUse) as err:
+                (core.unregister_xla_shm if name == "xr"
+                 else core.unregister_system_shm)(name)
+            assert err.value.code == 409
+        # the unregister-all forms must conflict too
+        with pytest.raises(ShmRegionInUse):
+            core.unregister_xla_shm()
+        with pytest.raises(ShmRegionInUse):
+            core.unregister_system_shm()
+        assert "xr" in core.xla_shm_status()
+        assert "sr" in core.system_shm_status()
+        core.unpin_shm_region("xr")
+        core.unpin_shm_region("sr")
+        core.unregister_xla_shm("xr")
+        core.unregister_system_shm("sr")
+        assert core.xla_shm_status() == {}
+        assert core.system_shm_status() == {}
+    finally:
+        xshm.destroy_shared_memory_region(xh)
+        sysshm.destroy_shared_memory_region(sh)
+        core.close()
+
+
+def test_shm_conflict_maps_to_http_409():
+    import http.client
+
+    from tpuserver.http_frontend import HttpFrontend
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    core = InferenceServer([SimpleModel()])
+    xh = xshm.create_shared_memory_region("busy", 256)
+    core.register_xla_shm("busy", xshm.get_raw_handle(xh), 0, 256)
+    core.pin_shm_region("busy")
+    frontend = HttpFrontend(core, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", frontend.port)
+        try:
+            conn.request(
+                "POST", "/v2/xlasharedmemory/region/busy/unregister",
+                b"", {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            payload = resp.read()
+            assert resp.status == 409, payload
+            assert "reference it" in json.loads(payload)["error"]
+        finally:
+            conn.close()
+    finally:
+        frontend.stop()
+        core.unpin_shm_region("busy")
+        core.unregister_xla_shm("busy")
+        xshm.destroy_shared_memory_region(xh)
+        core.close()

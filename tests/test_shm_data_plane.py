@@ -1,0 +1,374 @@
+"""Zero-copy XLA-shm generation data plane (ISSUE 12).
+
+Pins the tentpole contracts end to end, in-process (CPU-sim):
+
+- the aliasing proof: an shm-referenced input resolves to the OWNER's
+  device segment (same buffer — no host round-trip), and the
+  single-stream prefill consumes exactly that ``jax.Array``;
+- the token ring: per-step TOKEN/LOGPROB land in client-readable ring
+  slots, events shrink to descriptors, tokens are identical to the
+  in-band path, slot writes are re-bounds-checked per step;
+- park-export attach-resume: a disconnected ``kv_park`` generation
+  leaves a server-owned ``kvexport/<id>`` region, resume re-scatters
+  it (token-identical to both re-prefill resume and an uninterrupted
+  run), and the export lifecycle never leaks regions;
+- perf_analyzer's ``--shared-memory`` mode drives the same plane.
+
+Budget: in-process cores only, tiny configs, pinned sizes
+(tests/fleet_stub.py-class discipline — no sockets except one http
+round-trip test, no real sleeps beyond park-reap waits).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tpuserver.core import (
+    InferenceServer,
+    InferRequest,
+    ServerError,
+    ShmRegionInUse,
+)
+from tpuserver.models import llama
+from tpuserver.models.llama_serving import LlamaGenerateModel
+from tritonclient.utils import xla_shared_memory as xshm
+
+
+def _llama_core(max_slots=2, max_seq=64, **kwargs):
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=256), max_seq=max_seq, max_slots=max_slots,
+        **kwargs)
+    return InferenceServer([model]), model
+
+
+def _tokens(core, inputs, parameters=None, take=None):
+    req = InferRequest("llama_generate", inputs=dict(inputs),
+                       parameters=dict(parameters or {}))
+    out = []
+    stream = core.infer_stream(req)
+    for resp in stream:
+        if resp.outputs:
+            out.append(int(resp.outputs[0][1][0]))
+        else:
+            out.append(resp.parameters)  # ring descriptor event
+        if take is not None and len(out) >= take:
+            stream.close()
+            break
+    return out
+
+
+PROMPT = np.array([5, 3, 7, 1], dtype=np.int32)
+MT = np.array([6], dtype=np.int32)
+
+
+def _staged_region(core, name="plane", byte_size=4096, values=None):
+    import jax.numpy as jnp
+
+    handle = xshm.create_shared_memory_region(name, byte_size)
+    if values is not None:
+        xshm.set_shared_memory_region(handle, [jnp.asarray(values)])
+    core.register_xla_shm(name, xshm.get_raw_handle(handle), 0, byte_size)
+    return handle
+
+
+def test_shm_input_aliases_owner_device_buffer():
+    """The acceptance aliasing proof: read_shm_input on an in-process
+    XLA region returns the owner's live device segment — the same
+    buffer, not a copy, and never a host round-trip."""
+    core, _ = _llama_core(max_slots=1)
+    handle = _staged_region(core, values=PROMPT)
+    try:
+        view = core.read_shm_input("plane", PROMPT.nbytes, 0,
+                                   "INT32", [len(PROMPT)])
+        seg = handle.get_jax_segment(0)
+        assert view is seg
+        assert view.unsafe_buffer_pointer() == seg.unsafe_buffer_pointer()
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_single_stream_prefill_consumes_device_view():
+    """max_slots=1: the prefill's tokens argument is a jax.Array built
+    from the region's segment — the prompt never staged through the
+    host (np.asarray would have made it an ndarray)."""
+    import jax
+
+    core, model = _llama_core(max_slots=1)
+    handle = _staged_region(core, values=PROMPT)
+    try:
+        baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+        view = core.read_shm_input("plane", PROMPT.nbytes, 0,
+                                   "INT32", [len(PROMPT)])
+        captured = {}
+        real_prefill = model._prefill
+
+        def spy(params, cache, tokens):
+            captured["tokens"] = tokens
+            return real_prefill(params, cache, tokens)
+
+        model._prefill = spy
+        try:
+            got = _tokens(core, {"PROMPT_IDS": view, "MAX_TOKENS": MT})
+        finally:
+            model._prefill = real_prefill
+        assert got == baseline
+        assert isinstance(captured["tokens"], jax.Array)
+        assert not isinstance(captured["tokens"], np.ndarray)
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_token_ring_tokens_identical_and_events_shrink():
+    """Scheduler path: shm prompt + token ring produce descriptor-only
+    events whose ring slots hold exactly the in-band token/logprob
+    sequence."""
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core, values=PROMPT)
+    try:
+        baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+        view = core.read_shm_input("plane", PROMPT.nbytes, 0,
+                                   "INT32", [len(PROMPT)])
+        events = _tokens(
+            core, {"PROMPT_IDS": view, "MAX_TOKENS": MT},
+            {"shm_ring_region": "plane", "shm_ring_slots": 8,
+             "shm_ring_offset": 64})
+        assert len(events) == len(baseline)
+        for seq, params in enumerate(events):
+            assert params["seq"] == seq
+            assert params["shm_ring_offset"] == 64 + 8 * seq
+        ring = [int(xshm.get_contents_as_numpy(
+            handle, "INT32", [1], 64 + 8 * i)[0])
+            for i in range(len(baseline))]
+        assert ring == baseline
+        logps = [float(xshm.get_contents_as_numpy(
+            handle, "FP32", [1], 64 + 8 * i + 4)[0])
+            for i in range(len(baseline))]
+        assert all(lp <= 0.0 for lp in logps)
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_ring_wraps_and_resume_rewrites_slots():
+    """A ring smaller than the generation wraps (slot = seq % slots);
+    a resumed stream REWRITES its replayed slots, keeping seq
+    numbering — the sticky-resume invariant on the shm plane."""
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core)
+    try:
+        ring_params = {"shm_ring_region": "plane", "shm_ring_slots": 4,
+                       "generation_id": "g"}
+        baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+        events = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT},
+                         ring_params)
+        assert [p["shm_ring_offset"] for p in events] == [
+            (s % 4) * 8 for s in range(6)]
+        # last 4 tokens live in the wrapped ring
+        ring = [int(xshm.get_contents_as_numpy(
+            handle, "INT32", [1], (s % 4) * 8)[0]) for s in (4, 5, 2, 3)]
+        assert ring == [baseline[4], baseline[5], baseline[2], baseline[3]]
+        # wipe the ring, resume the (completed) generation from seq 0:
+        # the replay rewrites every slot
+        xshm.set_shared_memory_region(
+            handle, [np.zeros(8, dtype=np.int32)])
+        replay = _tokens(
+            core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT},
+            dict(ring_params, resume_generation_id="g",
+                 resume_from_seq=0))
+        assert [p["seq"] for p in replay] == list(range(6))
+        ring = [int(xshm.get_contents_as_numpy(
+            handle, "INT32", [1], (s % 4) * 8)[0]) for s in (4, 5)]
+        assert ring == baseline[4:6]
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_ring_slot_writes_rebounds_checked():
+    """A ring descriptor pointing past the registered region fails the
+    offending step with the typed 400 — never an overrun."""
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core, byte_size=64)
+    try:
+        with pytest.raises(ServerError) as err:
+            _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT},
+                    {"shm_ring_region": "plane", "shm_ring_slots": 16,
+                     "shm_ring_offset": 32})  # slot 4+ exceeds 64 bytes
+        assert err.value.code == 400
+        assert "out of bounds" in str(err.value)
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_unregister_while_ring_in_flight_is_typed_409():
+    """Satellite: unregistering a region an in-flight generation still
+    references is a typed 409 conflict — the region stays registered
+    and the stream finishes unharmed; unregister succeeds after."""
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core)
+    try:
+        req = InferRequest(
+            "llama_generate",
+            inputs={"PROMPT_IDS": PROMPT,
+                    "MAX_TOKENS": np.array([12], np.int32)},
+            parameters={"shm_ring_region": "plane",
+                        "shm_ring_slots": 16})
+        stream = core.infer_stream(req)
+        first = next(stream)  # generation is now live and pinned
+        assert first.parameters["shm_ring_offset"] == 0
+        with pytest.raises(ShmRegionInUse) as err:
+            core.unregister_xla_shm("plane")
+        assert err.value.code == 409
+        # unregister-all must conflict too, not silently drop the ring
+        with pytest.raises(ShmRegionInUse):
+            core.unregister_xla_shm()
+        assert "plane" in core.xla_shm_status()
+        rest = list(stream)  # stream unharmed by the failed unregister
+        assert len(rest) == 11
+        core.unregister_xla_shm("plane")  # pin released: succeeds
+        assert core.xla_shm_status() == {}
+    finally:
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def _wait_replay_parked(model, count=1, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        stats = model.scheduler_stats() or {}
+        if stats.get("replay_entries", 0) >= count:
+            return
+        time.sleep(0.02)
+    raise AssertionError("disconnected stream never parked")
+
+
+def test_resume_attach_token_identical_to_reprefill_and_reference():
+    """The A/B pin: an interrupted kv_park generation resumed from its
+    server-owned KV export produces EXACTLY the tokens of (a) the
+    re-prefill resume path and (b) an uninterrupted run — and the
+    attach path provably skipped re-prefill (prefix-miss counter)."""
+    results = {}
+    for mode, park in (("reference", None), ("reprefill", False),
+                       ("attach", True)):
+        core, model = _llama_core(max_slots=2)
+        mt = np.array([10], np.int32)
+        if mode == "reference":
+            results[mode] = _tokens(
+                core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt})
+            core.close()
+            continue
+        head = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt},
+                       {"generation_id": "g", "kv_park": park}, take=4)
+        _wait_replay_parked(model)
+        if park:
+            assert "kvexport/g" in core.xla_shm_status()
+            misses_before = model.scheduler_stats()["prefix_misses"]
+        tail = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt},
+                       {"resume_generation_id": "g",
+                        "resume_from_seq": 4})
+        if park:
+            # the attach admission scattered the export: NO prompt
+            # tokens were re-prefilled, and the export was consumed
+            assert model.scheduler_stats()["prefix_misses"] == \
+                misses_before
+            assert core.xla_shm_status() == {}
+        results[mode] = head + tail
+        core.close()
+    assert results["attach"] == results["reprefill"] == \
+        results["reference"]
+
+
+def test_kv_export_lifecycle_never_leaks():
+    """Exports die with their replay entry (reused id, close) — the
+    zero-leak invariant the chaos --shm arm soaks."""
+    core, model = _llama_core(max_slots=2)
+    mt = np.array([8], np.int32)
+    _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt},
+            {"generation_id": "g", "kv_park": True}, take=3)
+    _wait_replay_parked(model)
+    assert list(core.xla_shm_status()) == ["kvexport/g"]
+    # a reused generation id supersedes the park AND its export
+    _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt},
+            {"generation_id": "g"})
+    assert core.xla_shm_status() == {}
+    _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": mt},
+            {"generation_id": "g2", "kv_park": True}, take=3)
+    _wait_replay_parked(model, count=2)  # completed "g" still parked
+    assert list(core.xla_shm_status()) == ["kvexport/g2"]
+    core.close()  # close drops every server-owned export
+    assert core.xla_shm_status() == {}
+
+
+def test_http_generate_stream_shm_refs_end_to_end():
+    """One real HTTP round trip: /generate_stream with a shared-memory
+    PROMPT_IDS reference + ring descriptor events, via the client's
+    generate_stream — the wire carries descriptors, the ring the
+    tokens."""
+    import tritonclient.http as httpclient
+    from tpuserver.http_frontend import HttpFrontend
+
+    core, _ = _llama_core(max_slots=2)
+    baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+    http = HttpFrontend(core).start()
+    handle = _staged_region(core, values=PROMPT)
+    client = httpclient.InferenceServerClient(http.url)
+    try:
+        events = list(client.generate_stream(
+            "llama_generate",
+            {"PROMPT_IDS": {
+                "shared_memory_region": "plane",
+                "shared_memory_byte_size": PROMPT.nbytes,
+                "shared_memory_offset": 0,
+                "datatype": "INT32",
+                "shape": [len(PROMPT)],
+            },
+             "MAX_TOKENS": MT},
+            parameters={"shm_ring_region": "plane",
+                        "shm_ring_slots": 8,
+                        "shm_ring_offset": 128}))
+        assert len(events) == len(baseline)
+        offs = [e["parameters"]["shm_ring_offset"] for e in events]
+        assert offs == [128 + 8 * i for i in range(len(baseline))]
+        ring = [int(xshm.get_contents_as_numpy(
+            handle, "INT32", [1], o)[0]) for o in offs]
+        assert ring == baseline
+    finally:
+        client.close()
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        http.stop()
+        core.close()
+
+
+@pytest.mark.perf
+def test_perf_analyzer_shared_memory_modes():
+    """The CLI's --shared-memory staging end to end (inprocess backend,
+    one tiny window each): both kinds run clean and leak no regions."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "perf_analyzer_cli_shm",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "perf_analyzer.py"))
+    pa = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pa)
+    for kind in ("system", "xla"):
+        rc = pa.main([
+            "-m", "simple", "--backend", "inprocess",
+            "--concurrency-range", "2", "--shared-memory", kind,
+            "--output-shared-memory-size", "4096",
+            "--measurement-interval", "200", "--max-trials", "3",
+            "--input-pool", "2", "--warmup", "0.05"])
+        assert rc == 0

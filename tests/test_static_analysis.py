@@ -297,11 +297,11 @@ def test_r8_protocol_parity_fixture():
     router-vs-frontend divergence cases the real tree must never
     grow."""
     findings = _lint_fixture("r8", "R8").new
-    assert len(findings) == 18
+    assert len(findings) == 19
     router = [f for f in findings if f.path.endswith("r8/router.py")]
     grpc = [f for f in findings if f.path.endswith("r8/grpc_frontend.py")]
     http = [f for f in findings if f.path.endswith("r8/http_frontend.py")]
-    assert len(router) == 15 and len(grpc) == 2 and len(http) == 1
+    assert len(router) == 16 and len(grpc) == 2 and len(http) == 1
     # surface-level router findings anchor at the route table
     assert all(f.lineno == 5 for f in router + http)
     msgs = sorted(f.message for f in router)
@@ -313,6 +313,11 @@ def test_r8_protocol_parity_fixture():
     # the fixture replica serves /metrics, the fixture router does not:
     # the telemetry-parity drift class fires exactly once
     assert sum("'/metrics' telemetry route" in m for m in msgs) == 1
+    # the fixture replica serves the shm register/unregister verbs;
+    # the fixture router never references them: the broadcast-parity
+    # drift class fires exactly once, naming every missing token
+    assert sum("shm verb token(s) sharedmemory/register/unregister" in m
+               for m in msgs) == 1
     assert sum("verb(s) GET" in m for m in msgs) == 1
     assert sum("missing code(s) 429, 503" in m for m in msgs) == 1
     assert sum("SSE id-line format" in m for m in msgs) == 1

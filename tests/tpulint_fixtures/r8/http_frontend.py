@@ -15,6 +15,12 @@ class _Handler:
         if method == "POST":
             if path.endswith("/generate_stream"):
                 return self._generate_stream()
+            # the shm data-plane mutation verbs the router must
+            # broadcast (drifted in the fixture router)
+            if path == "/v2/xlasharedmemory/register":
+                return "registered"
+            if path == "/v2/xlasharedmemory/unregister":
+                return "unregistered"
         return None
 
     def _generate_stream(self):

@@ -20,6 +20,7 @@ Usage:
     python tools/chaos_smoke.py [--rounds N] [--slots K] [--budget T]
     python tools/chaos_smoke.py --pool [--cycles N] [--soak M]
     python tools/chaos_smoke.py --kill-loop [--rounds N]
+    python tools/chaos_smoke.py --shm [--rounds N]
     python tools/chaos_smoke.py --router [--cycles N] [--soak M]
     python tools/chaos_smoke.py --fleet [--cycles N] [--soak M]
 
@@ -29,6 +30,13 @@ concurrent generations are in flight, and asserts the supervisor
 auto-restarted with ZERO lost or corrupted streams — every request
 completes with tokens identical to the fault-free reference, restart
 counters rise accordingly, and the scheduler never trips.
+
+``--shm`` soaks the shared-memory data plane (ISSUE 12): concurrent
+token-ring generations with the decode loop killed mid-traffic every
+round, plus a disconnect -> park-export -> attach-resume cycle.
+Invariants: rings token-identical to the fault-free reference after
+healing, ``xla_shm_status`` consistent (no stale ``kvexport/*``), and
+teardown leaves zero leaked regions.
 
 ``--router`` soaks the server-side fleet tier (ISSUE 7): PLAIN clients
 stream generations through a FleetRouter over two llama replicas while
@@ -916,6 +924,149 @@ def kill_loop_phase(rounds, slots, budget):
             core.server_state()))
 
 
+def shm_phase(rounds, slots, budget):
+    """Soak the shm data plane (ISSUE 12): concurrent token-ring
+    generations with the decode loop killed mid-traffic every round,
+    plus a disconnect -> park-export -> attach-resume cycle.
+    Invariants: every stream heals with ring content token-identical
+    to the fault-free reference, ``xla_shm_status`` stays consistent
+    after healing (exactly the client's ring region — no stale
+    ``kvexport/*``), and teardown leaves ZERO leaked regions."""
+    from tritonclient.utils import xla_shared_memory as xshm
+
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=slots,
+        max_restarts=rounds + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.01)
+    core = InferenceServer([model])
+    lane_bytes = budget * 8
+    ring_size = lane_bytes * (len(PROMPTS) + 1)
+    ring = xshm.create_shared_memory_region("chaos_ring", ring_size)
+    core.register_xla_shm(
+        "chaos_ring", xshm.get_raw_handle(ring), 0, ring_size)
+
+    def ring_tokens(lane, n):
+        return [int(xshm.get_contents_as_numpy(
+            ring, "INT32", [1], lane * lane_bytes + 8 * (s % budget))[0])
+            for s in range(n)]
+
+    print("warming up (compiles the scheduler fns)...")
+    reference = [generate(core, p, budget) for p in PROMPTS]
+    print("reference captured; {} shm-ring chaos rounds".format(rounds))
+
+    for rnd in range(rounds):
+        outcomes = [None] * len(PROMPTS)
+        started = threading.Event()
+
+        def worker(i, rnd=rnd):
+            if i == 0:
+                started.set()
+            try:
+                outcomes[i] = ("ok", generate(
+                    core, PROMPTS[i], budget,
+                    parameters={
+                        "generation_id": "shm-{}-{}".format(rnd, i),
+                        "shm_ring_region": "chaos_ring",
+                        "shm_ring_slots": budget,
+                        "shm_ring_offset": i * lane_bytes,
+                    }))
+            except ServerError as e:
+                outcomes[i] = ("err", e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), daemon=True)
+            for i in range(len(PROMPTS))
+        ]
+        for t in threads:
+            t.start()
+        started.wait(timeout=10)
+        time.sleep(0.01)  # streams in flight on the loop
+        # loop death mid-traffic: the supervised restart must heal the
+        # rings too (replayed slots rewrite, seq numbering preserved)
+        faults.install("scheduler.step", mode="raise", times=1)
+        for t in threads:
+            t.join(timeout=120)
+        faults.clear("scheduler.step")
+        for i, outcome in enumerate(outcomes):
+            if outcome is None:
+                fail("shm round {}: stream {} never terminated".format(
+                    rnd, i))
+            elif outcome[0] != "ok":
+                fail("shm round {}: stream {} failed instead of "
+                     "healing: {}".format(rnd, i, outcome[1]))
+            else:
+                got = ring_tokens(i, budget)
+                if got != reference[i]:
+                    fail("shm round {}: ring {} tokens corrupted after "
+                         "healing: {} != {}".format(
+                             rnd, i, got, reference[i]))
+        # disconnect -> park-export -> attach-resume, on the spare lane
+        lane = len(PROMPTS)
+        gid = "shm-park-{}".format(rnd)
+        params = {"generation_id": gid, "kv_park": True,
+                  "shm_ring_region": "chaos_ring",
+                  "shm_ring_slots": budget,
+                  "shm_ring_offset": lane * lane_bytes}
+        req = InferRequest(
+            "llama_generate",
+            inputs={"PROMPT_IDS": PROMPTS[0],
+                    "MAX_TOKENS": np.array([budget], np.int32)},
+            parameters=params)
+        stream = core.infer_stream(req)
+        for _ in range(max(1, budget // 2)):
+            next(stream)
+        stream.close()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "kvexport/" + gid in core.xla_shm_status():
+                break
+            time.sleep(0.02)
+        resume_req = InferRequest(
+            "llama_generate",
+            inputs={"PROMPT_IDS": PROMPTS[0],
+                    "MAX_TOKENS": np.array([budget], np.int32)},
+            parameters={"resume_generation_id": gid,
+                        "resume_from_seq": 0,
+                        "shm_ring_region": "chaos_ring",
+                        "shm_ring_slots": budget,
+                        "shm_ring_offset": lane * lane_bytes})
+        # ring-mode events carry only descriptors: token identity is
+        # judged on the ring lane; here pin gap-free seq numbering
+        seqs = [resp.parameters.get("seq")
+                for resp in core.infer_stream(resume_req)]
+        if seqs != list(range(budget)):
+            fail("shm round {}: attach-resume seqs not gap-free: "
+                 "{}".format(rnd, seqs))
+        if ring_tokens(lane, budget) != reference[0]:
+            fail("shm round {}: attach-resume ring lane not "
+                 "rewritten".format(rnd))
+        status = set(core.xla_shm_status())
+        if status != {"chaos_ring"}:
+            fail("shm round {}: xla_shm_status inconsistent after "
+                 "healing: {}".format(rnd, sorted(status)))
+        wait_no_leaks(model, "shm round {}".format(rnd))
+        stats = model._scheduler.stats()
+        print("round {:2d} restarts={} status ok".format(
+            rnd, stats["restarts"]))
+
+    core.drain(timeout=10.0)
+    if core.server_state() != "stopped":
+        fail("shm drain did not stop the server (state={})".format(
+            core.server_state()))
+    # drain dropped every server-owned export; only the client ring
+    # remains, and its unregister must now succeed (no lingering pins)
+    leftovers = set(core.xla_shm_status())
+    if leftovers != {"chaos_ring"}:
+        fail("shm teardown: leaked regions {}".format(sorted(leftovers)))
+    try:
+        core.unregister_xla_shm("chaos_ring")
+    except ServerError as e:
+        fail("shm teardown: ring still pinned after drain: {}".format(e))
+    if core.xla_shm_status() != {}:
+        fail("shm teardown: regions leaked past unregister")
+    xshm.destroy_shared_memory_region(ring)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -943,6 +1094,12 @@ def main():
                              "kill the decode loop mid-traffic every "
                              "round, assert auto-restart with zero lost "
                              "or corrupted streams")
+    parser.add_argument("--shm", action="store_true",
+                        help="soak the shm data plane instead: token-"
+                             "ring streams + park-export/attach-resume "
+                             "under decode-loop kills; asserts token-"
+                             "identical rings, consistent "
+                             "xla_shm_status, zero leaked regions")
     parser.add_argument("--cycles", type=int, default=4,
                         help="pool mode: drain/revive cycles (default 4)")
     parser.add_argument("--soak", type=int, default=None,
@@ -950,6 +1107,20 @@ def main():
                              "40 in pool mode, 6 full generations in "
                              "router mode)")
     args = parser.parse_args()
+
+    if args.shm:
+        t0 = time.monotonic()
+        shm_phase(args.rounds, args.slots, args.budget)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nshm chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nshm chaos smoke OK: {} rounds, {:.1f}s, token-"
+              "identical rings, consistent xla_shm_status, zero "
+              "leaked regions".format(args.rounds, elapsed))
+        return 0
 
     if args.fleet:
         t0 = time.monotonic()

@@ -104,6 +104,22 @@ def build_parser():
                          "repeatable)")
     ap.add_argument("--input-pool", type=int, default=16,
                     help="distinct random input sets rotated per context")
+    ap.add_argument("--shared-memory", default="none",
+                    choices=["none", "system", "xla"],
+                    help="stage request tensors in shared memory "
+                         "(reference InferDataManagerShm role): inputs "
+                         "are written into created-and-registered "
+                         "regions once, outside the timed path, and "
+                         "requests carry {region, offset} references; "
+                         "'xla' parks device segments too — against an "
+                         "--backend inprocess server the resolve path "
+                         "is zero-copy.  Generation mode adds a token "
+                         "ring: responses shrink to slot descriptors "
+                         "and TOKEN/LOGPROB land in the ring region")
+    ap.add_argument("--output-shared-memory-size", type=int, default=0,
+                    help="bytes reserved per declared output in a "
+                         "shared output region; 0 (default) keeps "
+                         "outputs in-band")
     ap.add_argument("--max-outstanding", type=int, default=512,
                     help="request-rate mode: backend executor/connection "
                          "capacity (the open-loop depth before the "
@@ -269,6 +285,7 @@ def run_worker(args):
     backend = create_backend("http", url=url, max_inflight=level)
     manager = None
     channel = None
+    shm = None
     try:
         metadata = backend.model_metadata(args.model)
         config = backend.model_config(args.model)
@@ -281,7 +298,25 @@ def run_worker(args):
             # distinct per-worker streams of inputs: no two workers
             # replay the same request sequence in lockstep
             seed=args.seed + 1000 * args.worker_id)
-        prepared = backend.prepare(args.model, pool)
+        if args.shared_memory != "none":
+            # per-worker region lifecycle: every worker process creates
+            # and registers its OWN regions (names carry its pid tag),
+            # and tears exactly those down on exit — N workers against
+            # one server never collide or leak
+            from perfanalyzer.client_backend import ShmInferDataManager
+
+            shm = ShmInferDataManager(
+                backend, args.shared_memory,
+                tag="w{}".format(args.worker_id))
+            refs = shm.stage_input_sets(pool)
+            out_refs = None
+            if args.output_shared_memory_size > 0:
+                out_refs = shm.stage_outputs(
+                    [o["name"] for o in metadata.get("outputs", [])],
+                    args.output_shared_memory_size)
+            prepared = backend.prepare_shm(args.model, refs, out_refs)
+        else:
+            prepared = backend.prepare(args.model, pool)
         manager = ConcurrencyManager(backend, args.model, prepared)
         manager.change_level(level)
         collector = manager.collector
@@ -311,6 +346,8 @@ def run_worker(args):
             channel.close()
         if manager is not None:
             manager.stop()
+        if shm is not None:
+            shm.close()
         backend.close()
     return 0
 
@@ -354,7 +391,10 @@ def run_coordinator(args):
             "-m", args.model, "--backend", "http", "-u", args.url,
             "--concurrency-range", str(level),
             "--input-pool", str(args.input_pool),
-            "-b", str(args.batch_size), "--seed", str(args.seed)]
+            "-b", str(args.batch_size), "--seed", str(args.seed),
+            "--shared-memory", args.shared_memory,
+            "--output-shared-memory-size",
+            str(args.output_shared_memory_size)]
     if args.urls:
         argv += ["--urls", args.urls]
     for entry in args.shape:
@@ -472,13 +512,50 @@ def main(argv=None):
               args.model, args.backend, mode, levels,
               args.measurement_interval, args.measurement_mode,
               args.stability_percentage, args.max_trials), flush=True)
+    if args.shared_memory != "none" and args.backend == "pool":
+        raise SystemExit(
+            "--shared-memory drives the http/grpc/inprocess backends; "
+            "the pool backend is in-band only")
+
     manager = None
+    shm = None
     try:
+        from perfanalyzer.client_backend import ShmInferDataManager
+
         metadata = backend.model_metadata(args.model)
+        if args.shared_memory != "none":
+            shm = ShmInferDataManager(backend, args.shared_memory)
         if args.generation:
+            pool = build_generation_pool(metadata, args)
+            gen_params = None
+            if shm is not None:
+                # prompts stage once into a shm region (requests carry
+                # references); every stream gets its own token-ring
+                # lane, so concurrent generations never share slots
+                refs = shm.stage_input_sets(
+                    [{"PROMPT_IDS": s["PROMPT_IDS"]} for s in pool])
+                pool = [dict(s, PROMPT_IDS=r["PROMPT_IDS"])
+                        for s, r in zip(pool, refs)]
+                import itertools
+
+                lanes = 2 * max(levels)
+                slots = max(1, args.max_tokens)
+                lane_bytes = slots * 8
+                ring_name, _ = shm.create_region(
+                    "ring", lanes * lane_bytes)
+                counter = itertools.count()
+                lane_lock = threading.Lock()
+
+                def gen_params():
+                    with lane_lock:
+                        lane = next(counter) % lanes
+                    return {"shm_ring_region": ring_name,
+                            "shm_ring_slots": slots,
+                            "shm_ring_offset": lane * lane_bytes}
+
             profiler = GenerationProfiler(
-                backend, args.model,
-                build_generation_pool(metadata, args),
+                backend, args.model, pool,
+                parameters=gen_params,
                 measurement_interval_s=interval_s,
                 stability_pct=args.stability_percentage,
                 max_trials=args.max_trials,
@@ -494,7 +571,18 @@ def main(argv=None):
                 shape_overrides=parse_shapes(args.shape),
                 const_overrides=parse_consts(args.input_const),
                 seed=args.seed)
-            prepared = backend.prepare(args.model, pool)
+            if shm is not None:
+                refs = shm.stage_input_sets(pool)
+                out_refs = None
+                if args.output_shared_memory_size > 0:
+                    out_refs = shm.stage_outputs(
+                        [o["name"]
+                         for o in metadata.get("outputs", [])],
+                        args.output_shared_memory_size)
+                prepared = backend.prepare_shm(
+                    args.model, refs, out_refs)
+            else:
+                prepared = backend.prepare(args.model, pool)
             if rate_mode:
                 manager = RequestRateManager(
                     backend, args.model, prepared,
@@ -521,6 +609,10 @@ def main(argv=None):
     finally:
         if manager is not None:
             manager.stop()
+        if shm is not None:
+            # the per-worker region lifecycle: unregister on the
+            # server, unlink the client windows
+            shm.close()
         backend.close()
         if core is not None:
             core.close()
