@@ -33,15 +33,27 @@ def percentile(values, pct, presorted=False):
     return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
 
 
-LATENCY_PERCENTILES = (50, 90, 95, 99)
+#: 99.9 rides along for tail-latency work (the "Tail at Scale" metric
+#: the router's gray-failure ejection defends): percentiles are always
+#: computed over POOLED raw samples — never an average of per-window
+#: percentiles, which has no statistical meaning (reference
+#: MergePerfStatusReports semantics, pinned against numpy in
+#: tests/test_perfanalyzer.py).
+LATENCY_PERCENTILES = (50, 90, 95, 99, 99.9)
+
+
+def _pct_key(p):
+    """``p99_usec`` / ``p99.9_usec``: integral percentiles render
+    without the float's trailing ``.0``."""
+    return "p{:g}_usec".format(p)
 
 
 def latency_summary(latencies_s):
-    """p50/p90/p95/p99 + avg/min/max of a latency sample, in
+    """p50/p90/p95/p99/p99.9 + avg/min/max of a latency sample, in
     microseconds (the unit every report row carries)."""
     if not latencies_s:
         return {"avg_usec": None, "min_usec": None, "max_usec": None,
-                **{"p{}_usec".format(p): None for p in LATENCY_PERCENTILES}}
+                **{_pct_key(p): None for p in LATENCY_PERCENTILES}}
     usec = sorted(v * 1e6 for v in latencies_s)
     out = {
         "avg_usec": sum(usec) / len(usec),
@@ -49,7 +61,7 @@ def latency_summary(latencies_s):
         "max_usec": usec[-1],
     }
     for p in LATENCY_PERCENTILES:
-        out["p{}_usec".format(p)] = percentile(usec, p, presorted=True)
+        out[_pct_key(p)] = percentile(usec, p, presorted=True)
     return out
 
 
@@ -209,6 +221,13 @@ def attach_router_delta(result, before, after):
         return
     for key in ("failovers", "handoffs", "resumed_streams", "shed"):
         result["router_" + key] = after[key] - before[key]
+    # tail-latency defense counters (gray-failure soft-ejections and
+    # hedge fires) diff the same way — guarded presence-in-both like
+    # the supervisor counters so a snapshot from a router predating
+    # them never fabricates a delta
+    for key in ("ejections", "hedges"):
+        if key in before and key in after:
+            result["router_" + key] = after[key] - before[key]
     for key in SUPERVISOR_COUNTERS:
         if key in before and key in after:
             result[key] = after[key] - before[key]

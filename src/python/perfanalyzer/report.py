@@ -20,6 +20,7 @@ _SCALAR_COLUMNS = [
     ("p90_usec", "{:.1f}"),
     ("p95_usec", "{:.1f}"),
     ("p99_usec", "{:.1f}"),
+    ("p99.9_usec", "{:.1f}"),
     ("queue_usec", "{:.1f}"),
     ("compute_infer_usec", "{:.1f}"),
     ("client_overhead_pct", "{:.1f}"),
@@ -29,8 +30,8 @@ _SCALAR_COLUMNS = [
 
 _SCALAR_HEADERS = [
     "Level", "infer/sec", "avg(us)", "p50(us)", "p90(us)", "p95(us)",
-    "p99(us)", "queue(us)", "compute(us)", "overhead%", "errors",
-    "stable",
+    "p99(us)", "p99.9(us)", "queue(us)", "compute(us)", "overhead%",
+    "errors", "stable",
 ]
 
 _GEN_COLUMNS = [
@@ -76,6 +77,7 @@ WINDOW_CSV_COLUMNS = [
     ("p90 latency", "p90_usec"),
     ("p95 latency", "p95_usec"),
     ("p99 latency", "p99_usec"),
+    ("p99.9 latency", "p99.9_usec"),
     ("TTFT avg ms", "ttft_avg_ms"),
     ("ITL p50 ms", "itl_p50_ms"),
     ("Tokens/Second", "tokens_per_sec"),
@@ -150,6 +152,15 @@ class ReportWriter:
                             r.get("router_handoffs"),
                             r.get("router_resumed_streams"),
                             r.get("router_shed")))
+                if r.get("router_ejections") is not None:
+                    # tail-latency defense: gray-failure soft-ejections
+                    # and hedge fires under this level — nonzero
+                    # ejections with flat errors means the router
+                    # routed around a slow replica without the client
+                    # noticing
+                    line += " ejections={} hedges={}".format(
+                        r.get("router_ejections"),
+                        r.get("router_hedges"))
                 if r.get("supervisor_replica_restarts") is not None:
                     # a supervised fleet sits behind the router: its
                     # per-window process-healing counters ride along —

@@ -1033,6 +1033,11 @@ class InferenceServer:
             "tpu_scheduler_replay_hits_total": "replay_hits",
             "tpu_scheduler_live_streams": "live_streams",
             "tpu_scheduler_pending": "pending",
+            # adaptive queue shedding (tail-latency defense): sheds by
+            # the sojourn controller + whether it is shedding NOW
+            # (bool coerces to the 0/1 gauge)
+            "tpu_scheduler_codel_sheds_total": "codel_sheds",
+            "tpu_scheduler_codel_shedding": "codel_shedding",
             # paged KV + radix prefix cache (PR 11): the counters
             # perfanalyzer's hit-rate column window-diffs, and the
             # page-utilization gauges
@@ -1840,7 +1845,13 @@ class InferenceServer:
             # admission-full -> 429 (+Retry-After), closed/draining ->
             # 503 — instead of the generic 500 wrap
             if isinstance(e, _scheduler.AdmissionQueueFull):
-                raise Overloaded("model '{}': {}".format(model.name, e))
+                # the adaptive shed controller computes Retry-After
+                # from its current control interval — the pace the
+                # queue is actually draining; the fixed-cliff shed
+                # keeps the 1s default
+                raise Overloaded(
+                    "model '{}': {}".format(model.name, e),
+                    retry_after=getattr(e, "retry_after", None) or 1)
             if isinstance(e, _scheduler.SchedulerClosed):
                 raise ShuttingDown("model '{}': {}".format(model.name, e))
             raise ServerError(

@@ -41,6 +41,15 @@ Registered injection points:
 point succeed before it starts firing — the knob chaos tests use to
 drop a connection *mid*-stream rather than before the first token.
 
+Beyond the per-point actions above, every point accepts the two
+**gray-failure latency modes**: ``mode="slow"`` (every fire sleeps
+``delay`` — a persistently degraded-but-alive replica) and
+``mode="jitter"`` (a deterministic pseudo-random delay in
+``[0, delay)`` from a seeded LCG, so soaks replay exactly).  Both stay
+armed until :func:`clear` and combine with ``@scope`` to degrade one
+replica of a fleet — the traffic shape the router's gray-failure
+ejection defends against (docs/resilience.md "Tail-latency defense").
+
 **Scopes** (multi-replica chaos): several in-process servers share this
 process-global registry, so a point armed with ``scope="replica-b"``
 fires only for the server constructed with
@@ -61,6 +70,7 @@ entries, e.g.::
 import os
 import threading
 import time
+import zlib
 
 __all__ = [
     "FaultInjected", "POINTS", "fire", "install", "clear", "fired",
@@ -105,23 +115,43 @@ class FaultInjected(RuntimeError):
         self.point = point
 
 
+#: LCG constants for ``mode="jitter"`` (glibc's rand() multiplier /
+#: increment over a 2^31 modulus): a tiny, dependency-free generator
+#: whose whole point is determinism — the same arming replays the exact
+#: same delay sequence, so a gray-failure soak is reproducible run to
+#: run (a ``random``-based jitter would not be, and seeding the global
+#: RNG from a fault hook would perturb every other consumer).
+_LCG_A = 1103515245
+_LCG_C = 12345
+_LCG_M = 1 << 31
+
+
 class _Fault:
     __slots__ = ("name", "mode", "remaining", "delay", "fired", "scope",
-                 "skip")
+                 "skip", "lcg")
 
     def __init__(self, name, mode, times, delay, scope=None, skip=0):
-        if mode not in ("raise", "sleep", "hang", "nan"):
+        if mode not in ("raise", "sleep", "hang", "nan", "slow", "jitter"):
             raise ValueError(
-                "fault mode must be 'raise', 'sleep', 'hang' or 'nan' "
-                "(got {!r})".format(mode)
+                "fault mode must be 'raise', 'sleep', 'hang', 'nan', "
+                "'slow' or 'jitter' (got {!r})".format(mode)
             )
         self.name = name
         self.mode = mode
-        self.remaining = int(times)
+        # 'slow' and 'jitter' model a DEGRADED-but-alive replica (the
+        # gray-failure shape): a latency fault that disarmed itself
+        # after N fires would read as a recovered replica mid-soak, so
+        # both are persistent until clear() regardless of ``times``
+        self.remaining = (-1 if mode in ("slow", "jitter")
+                          else int(times))
         self.delay = float(delay)
         self.fired = 0
         self.scope = scope
         self.skip = int(skip)
+        # jitter state: seeded from the point identity so two scoped
+        # armings of the same point draw distinct but stable sequences
+        self.lcg = zlib.crc32(
+            "{}@{}".format(name, scope or "").encode("utf-8")) % _LCG_M
 
 
 _lock = threading.Lock()
@@ -138,7 +168,20 @@ def install(name, mode="raise", times=1, delay=0.0, scope=None, skip=0):
     the point armed until :func:`clear`.  ``skip`` lets the first N
     passes through succeed before firing starts (mid-stream chaos).
     With a ``scope``, only :func:`fire` calls carrying that scope trip
-    the point (per-replica chaos); scope None matches every firer."""
+    the point (per-replica chaos); scope None matches every firer.
+
+    Two latency modes model a GRAY failure — a replica that still
+    answers everything, just slowly (thermal throttle, swap storm, a
+    co-tenant compile): ``mode="slow"`` sleeps ``delay`` seconds on
+    EVERY fire, and ``mode="jitter"`` sleeps a deterministic
+    pseudo-random duration in ``[0, delay)`` drawn from a per-fault
+    LCG seeded by the point identity — the same arming replays the
+    exact same delay sequence, so gray-failure soaks reproduce run to
+    run.  Both are persistent (``times`` is ignored: a latency fault
+    that disarmed itself would read as a recovery mid-soak) until
+    :func:`clear`, and both honor ``@scope`` per-replica targeting —
+    ``scheduler.step@replica-b:slow:-1:0.05`` degrades exactly one
+    replica of a fleet."""
     fault = _Fault(name, mode, times, delay, scope, skip=skip)
     with _lock:
         _points[(name, scope)] = fault
@@ -217,8 +260,17 @@ def fire(name, scope=None):
             fault.remaining -= 1
         fault.fired += 1
         mode, delay = fault.mode, fault.delay
-    if mode == "sleep":
+    if mode in ("sleep", "slow"):
         time.sleep(delay)
+        return None
+    if mode == "jitter":
+        # deterministic per-fire pseudo-random delay in [0, delay):
+        # advance the fault's own LCG under the lock (torn updates
+        # would fork the sequence), sleep outside it
+        with _lock:
+            fault.lcg = (_LCG_A * fault.lcg + _LCG_C) % _LCG_M
+            jittered = delay * fault.lcg / _LCG_M
+        time.sleep(jittered)
         return None
     if mode in ("nan", "hang"):
         return (mode, int(delay) if mode == "nan" else delay)

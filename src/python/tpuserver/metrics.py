@@ -119,6 +119,17 @@ CATALOG = {
     "tpu_scheduler_step_seconds": (
         "histogram",
         "Batched decode-step dispatch latency, per model, seconds."),
+    "tpu_scheduler_codel_sheds_total": (
+        "counter",
+        "Admissions shed by the adaptive (CoDel-style) queue "
+        "controller — sojourn above target for a full control "
+        "interval — per model.  The fixed max_pending cliff sheds "
+        "count in tpu_request_errors_total{code=429} as before."),
+    "tpu_scheduler_codel_shedding": (
+        "gauge",
+        "Whether the adaptive queue-shed controller is actively "
+        "shedding (1) or the admission queue's sojourn is under "
+        "target (0), per model."),
     # -- paged KV + radix prefix cache -------------------------------------
     "tpu_prefix_cache_hits_total": (
         "counter",
@@ -166,6 +177,28 @@ CATALOG = {
         "counter",
         "Generation admissions routed to their prompt prefix's warm "
         "(affine) replica — the radix cache was already primed."),
+    "tpu_router_ejections_total": (
+        "counter",
+        "Gray-failure soft-ejections: replicas routed around because "
+        "their recent p90 was an outlier against the fleet median "
+        "(they keep answering health probes — that is what makes the "
+        "failure gray)."),
+    "tpu_router_hedges_total": (
+        "counter",
+        "Hedged unary attempts by outcome: won = the hedge's response "
+        "was used, lost = the primary answered after the hedge fired, "
+        "cancelled = the hedge was abandoned in flight."),
+    "tpu_router_replica_state": (
+        "gauge",
+        "Routing state per replica: one sample per replica whose "
+        "'state' label is ok / soft-ejected / draining / unreachable "
+        "/ ineligible / removed (value always 1) — distinguishes a "
+        "gray incident from a planned drain from a dead process."),
+    "tpu_router_replica_p90_seconds": (
+        "gauge",
+        "Rolling per-verb p90 latency per replica from the router's "
+        "gray-failure digest (fixed-window, completed requests only; "
+        "hedge losers excluded), seconds."),
     # -- fleet supervisor (process-level healing) --------------------------
     "tpu_fleet_replica_restarts_total": (
         "counter", "Replica processes healed by the supervisor."),

@@ -81,7 +81,8 @@ class LlamaGenerateModel(Model):
                  restart_window_s=60.0, restart_backoff_s=0.05,
                  replay_ttl_s=60.0, replay_capacity=256,
                  page_size=16, kv_pages=None, prefill_chunk_tokens=256,
-                 prefix_cache=True, kv_export=False):
+                 prefix_cache=True, kv_export=False,
+                 target_queue_ms=None, shed_interval_ms=100.0):
         self._cfg = cfg or llama.tiny(vocab=2048)
         # replica identity threaded to the scheduler's fault-injection
         # points (multi-replica chaos harnesses)
@@ -99,6 +100,10 @@ class LlamaGenerateModel(Model):
                 "max_slots must be >= 1 (got {})".format(max_slots))
         self._max_slots = int(max_slots)
         self._max_pending = max_pending  # admission-queue bound override
+        # adaptive (CoDel-style) queue shedding, threaded to
+        # DecodeScheduler — None keeps the fixed max_pending cliff only
+        self._target_queue_ms = target_queue_ms
+        self._shed_interval_ms = shed_interval_ms
         # supervisor / replay-buffer knobs, threaded to DecodeScheduler
         # (docs/resilience.md "Self-healing & stream resume")
         self._step_timeout_s = step_timeout_s
@@ -207,6 +212,8 @@ class LlamaGenerateModel(Model):
                         replay_capacity=self._replay_capacity,
                         prefill_chunk_tokens=self._prefill_chunk_tokens,
                         prefix_cache=self._prefix_cache,
+                        target_queue_ms=self._target_queue_ms,
+                        shed_interval_ms=self._shed_interval_ms,
                         # queue-wait/step latency histograms land in
                         # the attached server's /metrics registry
                         # (lock-free observes — the decode loop never

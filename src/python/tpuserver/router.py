@@ -76,6 +76,7 @@ import random
 import re
 import socket
 import socketserver
+import statistics
 import sys
 import threading
 import time
@@ -237,11 +238,112 @@ def _request_deadline(body, headers):
         return None
 
 
+def _rewrite_timeout(body, headers, remaining_s):
+    """Rewrite a relayed request's ``timeout`` parameter (µs, Triton
+    semantics) to the REMAINING monotonic deadline budget, returning
+    ``(body, headers)``.
+
+    The router's failover/hedge loop can burn most of a request's
+    budget before an attempt ever reaches a replica; relaying the
+    ORIGINAL timeout would let that attempt occupy a replica slot for
+    the full budget again — a doomed request the caller already gave
+    up on.  The replica resolves its own deadline from this parameter
+    (``InferenceServer._resolve_deadline``), so shrinking it here is
+    the fleet-wide form of deadline propagation: the scheduler's
+    pending-admission expiry and mid-generation retirement fire at the
+    caller's true deadline, not a fresh one.  Bodies using the binary
+    extension carry the JSON object in their first
+    ``inference-header-content-length`` bytes — the rewrite re-frames
+    that prefix and updates the header.  Requests without a timeout
+    parameter relay untouched (no budget to propagate)."""
+    try:
+        hlen = headers.get("inference-header-content-length")
+        jlen = int(hlen) if hlen else None
+        blob = body[:jlen] if jlen is not None else body
+        obj = json.loads(blob)
+        params = obj.get("parameters")
+        if not isinstance(params, dict) or not params.get("timeout"):
+            return body, headers
+        # floor of 1µs: a non-positive timeout would be a malformed
+        # request, and the deadline-exhausted case answered 504 above
+        params["timeout"] = max(1, int(remaining_s * 1e6))
+        new_blob = json.dumps(obj).encode("utf-8")
+        if jlen is None:
+            return new_blob, headers
+        headers = dict(headers)
+        headers["inference-header-content-length"] = str(len(new_blob))
+        return new_blob + body[jlen:], headers
+    except (AttributeError, TypeError, ValueError, UnicodeDecodeError):
+        # malformed body: the replica owns the typed 400
+        return body, headers
+
+
+#: POST routes hedge-safe by construction — mirrors the client pool's
+#: hedgeable set (tritonclient._pool: infer / metadata / health): an
+#: infer executes the same computation on any replica, so a duplicate
+#: in-flight attempt is waste, never corruption.  Generations/streams
+#: never hedge (a duplicate stream emits duplicate tokens) and
+#: broadcast mutations never hedge (the broadcast path never reaches
+#: forward_unary).
+_HEDGE_URI = re.compile(r"^/v2/models/[^/]+(/versions/[^/]+)?/infer$")
+
+#: Digest verbs: the rolling latency rings key on a tiny closed verb
+#: set — per-path keys would make every model name a cardinality axis
+#: and cross-model latencies incomparable anyway.
+def _verb_of(path):
+    tail = path.rstrip("/").rsplit("/", 1)[-1]
+    if tail == "infer":
+        return "infer"
+    if "/health/" in path:
+        return "health"
+    return "meta"
+
+
+class _LatencyRing:
+    """Fixed-size ring of completed-request latencies (seconds): O(1)
+    memory, O(size·log size) on the rare percentile read.  NOT itself
+    thread-safe — lives under the owning ``_Replica``'s lock."""
+
+    __slots__ = ("_values", "_idx", "_count")
+
+    def __init__(self, size=64):
+        self._values = [0.0] * int(size)
+        self._idx = 0
+        self._count = 0
+
+    def record(self, value):
+        self._values[self._idx] = float(value)
+        self._idx = (self._idx + 1) % len(self._values)
+        if self._count < len(self._values):
+            self._count += 1
+
+    @property
+    def samples(self):
+        return self._count
+
+    def percentile(self, pct):
+        """Linear-interpolated percentile of the retained window, or
+        None when empty (matches perfanalyzer.metrics.percentile so
+        the serving side and the measuring side agree on what 'p90'
+        means)."""
+        if self._count == 0:
+            return None
+        ordered = sorted(self._values[:self._count])
+        if len(ordered) == 1:
+            return ordered[0]
+        rank = (pct / 100.0) * (len(ordered) - 1)
+        lo = int(rank)
+        hi = min(lo + 1, len(ordered) - 1)
+        frac = rank - lo
+        return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
+
+
 class _Replica:
     """One routed backend: its address plus the prober-fed routing
-    state (eligibility, load score, router-local in-flight count)."""
+    state (eligibility, load score, router-local in-flight count) and
+    the rolling per-verb latency digest gray-failure ejection reads."""
 
-    def __init__(self, url):
+    def __init__(self, url, digest_window=64):
         host, sep, port = url.rpartition(":")
         if not sep or not host:
             raise ValueError(
@@ -263,6 +365,19 @@ class _Replica:
         self._requests = 0          # guarded-by: _lock
         self._failures = 0          # guarded-by: _lock
         self._snapshot = None       # guarded-by: _lock
+        self._digest_window = int(digest_window)
+        # rolling per-verb latency digest (gray-failure signal):
+        # verb -> _LatencyRing of completed-request latencies.  Hedge
+        # losers and probe RPCs never record — only traffic the client
+        # actually waited on.  # guarded-by: _lock
+        self._digest = {}
+        # soft-ejected: health-eligible but routed around (except the
+        # probe fraction) because its recent latency is a fleet
+        # outlier.  Health/drain verdicts always dominate — this flag
+        # is only ever consulted among ELIGIBLE replicas.
+        # # guarded-by: _lock
+        self._ejected = False
+        self._ejections = 0         # guarded-by: _lock
 
     def update_snapshot(self, snap):
         eligible, load = _snapshot_signals(snap)
@@ -304,13 +419,90 @@ class _Replica:
             self._local_inflight -= 1
 
     def routable(self):
-        """``(eligible, effective_load)``: the probe's load score plus
-        the router's own in-flight count against this replica — the
-        between-probes signal that keeps routing least-loaded."""
+        """``(eligible, effective_load, soft_ejected)``: the probe's
+        load score plus the router's own in-flight count against this
+        replica — the between-probes signal that keeps routing
+        least-loaded — and the gray-failure ejection flag (meaningful
+        only when ``eligible``; health and drain always dominate)."""
         with self._lock:
-            return self._eligible, self._load + self._local_inflight
+            return (self._eligible, self._load + self._local_inflight,
+                    self._ejected)
+
+    # -- latency digest / gray-failure ejection ----------------------------
+
+    def note_latency(self, verb, seconds):
+        """Record one completed request the client actually waited on.
+        Hedge losers are excluded by the caller: a loser's latency is
+        the hedge's artifact (its connection was abandoned), not the
+        replica's service time."""
+        with self._lock:
+            ring = self._digest.get(verb)
+            if ring is None:
+                ring = self._digest[verb] = _LatencyRing(
+                    self._digest_window)
+            ring.record(seconds)
+
+    def digest_snapshot(self):
+        """``{verb: (p90, p95, samples)}`` of the rolling digest."""
+        with self._lock:
+            return {
+                verb: (ring.percentile(90), ring.percentile(95),
+                       ring.samples)
+                for verb, ring in self._digest.items()
+                if ring.samples
+            }
+
+    def hedge_delay(self, verb, min_samples):
+        """The replica's own rolling p95 for ``verb`` — the hedge
+        delay seed — or None below ``min_samples`` (an empty digest
+        would seed hedges from noise)."""
+        with self._lock:
+            ring = self._digest.get(verb)
+            if ring is None or ring.samples < min_samples:
+                return None
+            return ring.percentile(95)
+
+    def soft_eject(self):
+        """Latch the gray-failure ejection flag and RESET the digest:
+        re-admission is judged on what the probe-fraction traffic
+        measures from now on, not on the slow window that caused the
+        ejection (which would otherwise pin the replica out long after
+        it recovered).  Returns False when already ejected."""
+        with self._lock:
+            if self._ejected:
+                return False
+            self._ejected = True
+            self._ejections += 1
+            self._digest = {}
+            return True
+
+    def readmit(self):
+        """Clear the ejection flag (fresh probe-window samples came in
+        under the outlier bar).  Returns False when not ejected."""
+        with self._lock:
+            if not self._ejected:
+                return False
+            self._ejected = False
+            return True
+
+    def status(self):
+        """The one-word routing state ops dashboards key on — it is
+        what lets a scrape distinguish a gray incident (soft-ejected)
+        from a planned drain from a dead process, which raw
+        ineligibility collapses into one bit."""
+        with self._lock:
+            if self.removed.is_set():
+                return "removed"
+            if not self._eligible:
+                if self._snapshot is None:
+                    return "unreachable"
+                state = self._snapshot.get("state") \
+                    if isinstance(self._snapshot, dict) else None
+                return "draining" if state == "draining" else "ineligible"
+            return "soft-ejected" if self._ejected else "ok"
 
     def stats(self):
+        status = self.status()
         with self._lock:
             return {
                 "url": self.url,
@@ -318,6 +510,16 @@ class _Replica:
                 "load": self._load + self._local_inflight,
                 "requests": self._requests,
                 "failures": self._failures,
+                "status": status,
+                "ejected": self._ejected,
+                "ejections": self._ejections,
+                "digest": {
+                    verb: {"p90_s": ring.percentile(90),
+                           "p95_s": ring.percentile(95),
+                           "samples": ring.samples}
+                    for verb, ring in self._digest.items()
+                    if ring.samples
+                },
             }
 
 
@@ -335,6 +537,18 @@ class _Generation:
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.eos_id = eos_id
+        # the request's own monotonic deadline (its ``timeout``
+        # parameter, µs): every upstream (re)admission — failover,
+        # handoff, resume splice — relays the REMAINING budget, so a
+        # slow first home cannot grant its successor a fresh window
+        try:
+            t = (request_json.get("parameters") or {}).get("timeout")
+            self.deadline = (time.monotonic() + int(t) / 1e6
+                             if t else None)
+        except (AttributeError, TypeError, ValueError):
+            # AttributeError: valid-JSON non-dict "parameters" — the
+            # replica owns the typed 400, not the router
+            self.deadline = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # rendered SSE blocks, list index == router seq  # guarded-by: _lock
@@ -476,6 +690,7 @@ class _Generation:
             params.pop("resume_generation_id", None)
             params.pop("resume_from_seq", None)
             params["generation_id"] = self.gen_id
+            self._propagate_deadline(params)
             request["parameters"] = params
             headers = {"Content-Type": "application/json"}
             if resuming:
@@ -483,6 +698,16 @@ class _Generation:
                 headers["Last-Event-ID"] = "{}/{}".format(
                     self.gen_id, backend_last)
             return json.dumps(request).encode("utf-8"), headers
+
+    def _propagate_deadline(self, params):
+        """Rewrite ``timeout`` to the generation's REMAINING budget: a
+        failover/handoff admission must arrive at its new replica with
+        the caller's true deadline, not the original full window (the
+        replica resolves its own deadline from this parameter)."""
+        if self.deadline is None:
+            return
+        remaining = self.deadline - time.monotonic()
+        params["timeout"] = max(1, int(remaining * 1e6))
 
     def handoff_request(self):
         """The re-admission body for a healthy replica: the original
@@ -520,6 +745,7 @@ class _Generation:
             params.pop("resume_generation_id", None)
             params.pop("resume_from_seq", None)
             params["generation_id"] = self.gen_id
+            self._propagate_deadline(params)
             request["parameters"] = params
             return json.dumps(request).encode("utf-8")
 
@@ -677,23 +903,68 @@ class FleetRouter:
     read_timeout_s / stream_wait_s
         Upstream socket read timeout, and how long a resume waits for
         a previous relay of the same generation to release it.
+    outlier_factor / outlier_min_samples / min_eligible / probe_fraction
+        Gray-failure ejection (docs/resilience.md "Tail-latency
+        defense"): a replica whose recent per-verb p90 (over at least
+        ``outlier_min_samples`` of its own completed requests) exceeds
+        ``outlier_factor`` × the fleet median is soft-ejected — routed
+        around like drain but fed ``probe_fraction`` of real traffic
+        so it re-admits itself on recovery.  Ejection never shrinks
+        the healthy set below ``min_eligible``.
+    eject_interval_s / digest_window
+        Ejection-evaluation throttle and the per-verb latency ring
+        size (O(1) memory per replica per verb).
+    hedge_delay_s
+        Opt-in hedged unary requests (None = off): an idempotent
+        attempt still pending after the primary's rolling p95 —
+        floored at this value, which alone applies while the digest
+        is cold — races a duplicate on the next-ranked different
+        replica, first response wins.  Never streams, never
+        broadcasts.
     """
 
     def __init__(self, backends, host="127.0.0.1", port=0,
                  probe_interval_s=1.0, probe_timeout_s=2.0,
                  max_inflight=None, gen_ttl_s=60.0, gen_capacity=1024,
                  read_timeout_s=600.0, stream_wait_s=5.0, verbose=False,
-                 affinity_bonus=2.0, affinity_prefix_tokens=16):
+                 affinity_bonus=2.0, affinity_prefix_tokens=16,
+                 outlier_factor=3.0, outlier_min_samples=16,
+                 min_eligible=1, probe_fraction=1.0 / 16,
+                 eject_interval_s=0.5, digest_window=64,
+                 hedge_delay_s=None):
         if not backends:
             raise ValueError("FleetRouter requires at least one backend")
         if len(set(backends)) != len(backends):
             raise ValueError(
                 "FleetRouter backends must be unique: {}".format(backends))
         self._replicas_lock = threading.Lock()
+        # -- tail-latency defense knobs (docs/resilience.md) --------------
+        # gray-failure ejection: a replica whose recent per-verb p90
+        # exceeds outlier_factor x the fleet median (across at least
+        # outlier_min_samples of its own samples) is soft-ejected —
+        # routed around like drain, but probed with 1/probe_fraction of
+        # real traffic so it re-admits itself on recovery.  Ejection
+        # NEVER drops the eligible-and-not-ejected set below
+        # min_eligible: the fleet degrades to slow, never unavailable.
+        self._outlier_factor = float(outlier_factor)
+        self._outlier_min_samples = int(outlier_min_samples)
+        self._min_eligible = int(min_eligible)
+        self._probe_every = max(1, int(round(1.0 / probe_fraction))) \
+            if probe_fraction and probe_fraction > 0 else 0
+        self._eject_interval_s = float(eject_interval_s)
+        self._digest_window = int(digest_window)
+        # hedged unary requests: None = off.  When on, an idempotent
+        # unary attempt still pending after the primary replica's own
+        # rolling p95 — floored at hedge_delay_s, which alone applies
+        # while the digest is cold — gets a second attempt on the
+        # next-ranked DIFFERENT replica, first response wins.
+        self._hedge_delay_s = (float(hedge_delay_s)
+                               if hedge_delay_s is not None else None)
         # live membership: add_replica/remove_replica mutate it while
         # requests are in flight, so every consumer goes through
         # _replicas_snapshot()  # guarded-by: _replicas_lock
-        self._replicas = [_Replica(url) for url in backends]
+        self._replicas = [_Replica(url, digest_window=self._digest_window)
+                          for url in backends]
         # the policy is only the failure classifier here (classify /
         # should_failover are stateless); attempt budgets are sized
         # per request from the membership snapshot
@@ -716,6 +987,20 @@ class FleetRouter:
         self._failovers = 0  # guarded-by: _lock
         self._handoffs = 0   # guarded-by: _lock
         self._resumed = 0    # guarded-by: _lock
+        # gray-failure ejection events (soft-ejections applied) and
+        # hedge outcomes: won = the hedge's response was used, lost =
+        # the primary answered after the hedge was already issued,
+        # cancelled = the hedge was abandoned still in flight when the
+        # primary won  # guarded-by: _lock
+        self._ejections = 0
+        self._hedges = {"won": 0, "lost": 0, "cancelled": 0}
+        # rotation counter steering every probe_every'th pick onto a
+        # soft-ejected replica (its real-traffic probe)  # guarded-by: _lock
+        self._eject_tick = 0
+        # monotonic stamp of the last ejection evaluation (the
+        # throttle check-and-set is one atomic region under _lock —
+        # two racing callers cannot both pass)  # guarded-by: _lock
+        self._eject_eval_last = float("-inf")
         # prefix-affinity routing (the fleet half of the replicas'
         # radix prefix cache): prompt-prefix hash -> (replica url,
         # expires_monotonic).  A generation admission whose prefix was
@@ -815,7 +1100,7 @@ class FleetRouter:
         stall routing) so it either enters with real state or starts
         rotated-out until its prober sees it ready.  Raises
         ``ValueError`` on a malformed or duplicate url."""
-        rep = _Replica(url)  # validates host:port
+        rep = _Replica(url, digest_window=self._digest_window)  # validates host:port
         snap = self._fetch_snapshot(rep)
         if snap is None:
             rep.mark_unreachable()
@@ -896,6 +1181,11 @@ class FleetRouter:
                 rep.mark_unreachable()
             else:
                 rep.update_snapshot(snap)
+            # the ejection controller rides the probe cadence (itself
+            # throttled to eject_interval_s): gray verdicts update even
+            # when traffic is too sparse to trigger the request-path
+            # evaluation
+            self._evaluate_ejections()
             if self._stop.wait(interval * rng.uniform(0.9, 1.1)):
                 return
 
@@ -915,7 +1205,8 @@ class FleetRouter:
 
     # -- routing -----------------------------------------------------------
 
-    def pick_replica(self, exclude=(), replicas=None, prefer=None):
+    def pick_replica(self, exclude=(), replicas=None, prefer=None,
+                     healthy_only=False):
         """The least-loaded eligible replica (ties break on backend
         order), or — when nothing is eligible — the least-failed
         ineligible one as a last resort, so a fleet whose probes all
@@ -929,18 +1220,39 @@ class FleetRouter:
         bonus subtracted (its radix prefix cache is presumed warm for
         this request) — a bonus on an ELIGIBLE replica's score only,
         never an eligibility override: a draining, tripped or
-        much-busier preferred replica still loses."""
-        eligible, fallback = [], []
+        much-busier preferred replica still loses.
+
+        Soft-ejected replicas (gray-failure latency outliers) form a
+        middle pool: routed around while healthy capacity exists, but
+        every ``probe_every``'th pick lands on one as its real-traffic
+        probe (how an ejected replica re-earns a digest and re-admits
+        itself), and when NOTHING un-ejected is eligible they serve —
+        the fleet degrades to slow, never to unavailable."""
+        eligible, probation, fallback = [], [], []
         if replicas is None:
             replicas = self._replicas_snapshot()
         for idx, rep in enumerate(replicas):
             if rep.url in exclude or rep.removed.is_set():
                 continue
-            ok, load = rep.routable()
+            ok, load, ejected = rep.routable()
             if ok and prefer is not None and rep.url == prefer:
                 load -= self._affinity_bonus
-            (eligible if ok else fallback).append((load, idx, rep))
-        for pool in (eligible, fallback):
+            pool = (probation if ok and ejected
+                    else eligible if ok else fallback)
+            pool.append((load, idx, rep))
+        if healthy_only:
+            # the hedge/shadow BACKUP pick: racing a suspected-slow
+            # primary against another gray (or worse) replica would
+            # defeat the whole point — no healthy candidate means no
+            # backup, and the caller waits the primary out
+            return min(eligible)[2] if eligible else None
+        if eligible and probation and self._probe_every:
+            with self._lock:
+                self._eject_tick += 1
+                probe = self._eject_tick % self._probe_every == 0
+            if probe:
+                return min(probation)[2]
+        for pool in (eligible, probation, fallback):
             if pool:
                 return min(pool)[2]
         return None
@@ -1007,6 +1319,128 @@ class FleetRouter:
 
     def any_routable(self):
         return any(rep.routable()[0] for rep in self._replicas_snapshot())
+
+    # -- gray-failure ejection ---------------------------------------------
+
+    def _evaluate_ejections(self, force=False):
+        """Differential latency observation (the gray-failure signal):
+        compare every replica's recent per-verb p90 against the fleet
+        median and soft-eject the outliers.
+
+        Runs throttled to ``eject_interval_s`` (the check-and-stamp is
+        one atomic region under ``_lock``, so racing callers — probers
+        and request paths — cannot double-evaluate).  The decision
+        itself works off one consistent pass: per-replica digests are
+        snapshotted first, verdicts computed from the snapshot, then
+        applied through the replicas' own atomic
+        ``soft_eject``/``readmit`` latches — a replica whose state
+        changed concurrently simply reports False and nothing is
+        counted.  Invariants:
+
+        - each replica is judged against the median of the OTHER
+          covered replicas (leave-one-out: a median that included the
+          candidate would be dragged toward it — on a 2-replica fleet
+          median-of-2 is the mean, and a 6x outlier reads as under
+          2x); at least one OTHER replica must have
+          ``outlier_min_samples`` for the verb, so a lone replica (no
+          differential signal) and a uniformly slow fleet (load, not
+          gray failure) never eject;
+        - health/drain dominate: only currently-ELIGIBLE replicas are
+          ever ejected, and ineligible ones keep any ejection flag
+          (re-judged once they return);
+        - ejections never shrink the eligible-and-unejected set below
+          ``min_eligible`` — worst outliers go first, the rest stay
+          serving (degrade to slow, not to unavailable);
+        - re-admission is judged on POST-ejection samples only
+          (``soft_eject`` reset the digest): once the probe-fraction
+          traffic accumulates ``outlier_min_samples`` under the bar
+          for every verb, the replica returns.
+        """
+        now = time.monotonic()
+        with self._lock:
+            if not force and (now - self._eject_eval_last
+                              < self._eject_interval_s):
+                return
+            self._eject_eval_last = now
+        rows = []  # (rep, eligible, ejected, {verb: (p90, p95, n)})
+        for rep in self._replicas_snapshot():
+            if rep.removed.is_set():
+                continue
+            ok, _load, ejected = rep.routable()
+            rows.append((rep, ok, ejected, rep.digest_snapshot()))
+        # per-verb p90 coverage over eligible UN-EJECTED replicas: the
+        # population each candidate is judged against (a draining/dead
+        # replica's digest is history, and an ejected replica's probe
+        # samples must not drag the median it is judged against)
+        coverage = {}  # verb -> [(rep, p90)]
+        for rep, ok, ejected, digest in rows:
+            if not ok or ejected:
+                continue
+            for verb, (p90, _p95, n) in digest.items():
+                if n >= self._outlier_min_samples and p90 is not None:
+                    coverage.setdefault(verb, []).append((rep, p90))
+
+        def fleet_median(verb, exclude_rep=None):
+            """Median p90 of the OTHER covered replicas (leave-one-out:
+            a median including the candidate is dragged toward it — on
+            a 2-replica fleet median-of-2 is the mean and a 6x outlier
+            reads as under 2x), or None without a differential."""
+            vals = [p90 for rep, p90 in coverage.get(verb, ())
+                    if rep is not exclude_rep]
+            return statistics.median(vals) if vals else None
+
+        def worst_ratio(rep, digest):
+            """max over verbs of p90 / leave-one-out fleet median (0
+            when no verb has both enough own samples and at least one
+            OTHER covered replica)."""
+            worst = 0.0
+            for verb, (p90, _p95, n) in digest.items():
+                if n < self._outlier_min_samples:
+                    continue
+                med = fleet_median(verb, exclude_rep=rep)
+                if med:
+                    worst = max(worst, p90 / med)
+            return worst
+
+        # re-admissions first: they grow the healthy pool the
+        # min_eligible floor is measured against
+        for rep, ok, ejected, digest in rows:
+            if not (ok and ejected):
+                continue
+            judged = [
+                (verb, p90, fleet_median(verb))
+                for verb, (p90, _p95, n) in digest.items()
+                if n >= self._outlier_min_samples
+            ]
+            if not judged:
+                continue  # probe traffic still accumulating
+            if all(med is None or p90 <= self._outlier_factor * med
+                   for _verb, p90, med in judged):
+                if rep.readmit():
+                    self._log("gray: re-admitted {} (recent p90 back "
+                              "under the outlier bar)".format(rep.url))
+        healthy = sum(1 for rep, ok, _ej, _d in rows
+                      if ok and not rep.routable()[2])
+        candidates = sorted(
+            ((worst_ratio(rep, digest), rep) for rep, ok, ejected,
+             digest in rows if ok and not ejected),
+            key=lambda pair: -pair[0])
+        for ratio, rep in candidates:
+            if ratio <= self._outlier_factor:
+                break  # sorted: nothing further is an outlier
+            if healthy - 1 < self._min_eligible:
+                self._log(
+                    "gray: ejection of {} deferred — only {} healthy "
+                    "replica(s), min_eligible={}".format(
+                        rep.url, healthy, self._min_eligible))
+                break
+            if rep.soft_eject():
+                healthy -= 1
+                with self._lock:
+                    self._ejections += 1
+                self._log(
+                    "gray: soft-ejected {} (p90 {:.1f}x the fleet "
+                    "median)".format(rep.url, ratio))
 
     # -- router-level admission valve --------------------------------------
 
@@ -1111,6 +1545,12 @@ class FleetRouter:
                 "generations": len(self._gens),
                 "affinity_routed": self._affinity_routed,
                 "affinity_entries": len(self._affinity),
+                # tail-latency defense: soft-ejection events and hedge
+                # outcomes (the per-replica ejected/status/digest view
+                # rides each replica's own stats row below)
+                "ejections": self._ejections,
+                "hedges": sum(self._hedges.values()),
+                "hedges_by_outcome": dict(self._hedges),
             }
         out["replicas"] = [rep.stats() for rep in self._replicas_snapshot()]
         stats_fn = self._supervisor_stats
@@ -1138,15 +1578,33 @@ class FleetRouter:
             ("tpu_router_generations", [({}, snap["generations"])]),
             ("tpu_router_affinity_routed_total",
              [({}, snap["affinity_routed"])]),
+            ("tpu_router_ejections_total", [({}, snap["ejections"])]),
+            ("tpu_router_hedges_total",
+             [({"outcome": outcome}, count) for outcome, count
+              in sorted(snap["hedges_by_outcome"].items())]),
         ]
-        eligible, load = [], []
+        eligible, load, state, p90 = [], [], [], []
         for rep in snap["replicas"]:
             labels = {"replica": rep["url"]}
             eligible.append((labels, 1 if rep["eligible"] else 0))
             load.append((labels, rep["load"]))
+            # one sample per replica, the current state as the label
+            # value: a scrape distinguishes a gray incident
+            # (soft-ejected) from a planned drain from a dead process
+            # — raw ineligibility collapses all three
+            state.append((
+                {"replica": rep["url"], "state": rep["status"]}, 1))
+            for verb, digest in sorted(rep.get("digest", {}).items()):
+                if digest.get("p90_s") is not None:
+                    p90.append((
+                        {"replica": rep["url"], "verb": verb},
+                        digest["p90_s"]))
         if eligible:
             families.append(("tpu_router_replica_eligible", eligible))
             families.append(("tpu_router_replica_load", load))
+            families.append(("tpu_router_replica_state", state))
+        if p90:
+            families.append(("tpu_router_replica_p90_seconds", p90))
         sup = snap.get("supervisor")
         if isinstance(sup, dict):
             families.extend([
@@ -1254,6 +1712,151 @@ class FleetRouter:
         finally:
             conn.close()
 
+    def _attempt_unary(self, rep, method, path, body, headers, timeout_s):
+        """One upstream attempt with the router's failure
+        classification: ``(response, error, kind, elapsed_s)``."""
+        error = kind = None
+        response = None
+        start = time.monotonic()
+        rep.begin_request()
+        try:
+            response = self._upstream_once(
+                rep, method, path, body, headers, timeout_s)
+        except (ConnectionRefusedError, socket.gaierror) as e:
+            error, kind = e, FAILURE_CONNECT
+        except (ConnectionError, socket.timeout, OSError,
+                http.client.HTTPException) as e:
+            error, kind = e, FAILURE_INTERRUPTED
+        finally:
+            rep.end_request()
+        return response, error, kind, time.monotonic() - start
+
+    def _attempt_hedged(self, primary, replicas, tried, method, path,
+                        body, headers, timeout_s, verb, probe=False,
+                        deadline=None):
+        """The Tail-at-Scale hedge: run the primary attempt, and if it
+        is still pending after the hedge delay — the primary replica's
+        own rolling p95 for this verb, floored at the configured
+        ``hedge_delay_s`` (which alone applies while the digest is
+        cold) — issue the same request on the next-ranked DIFFERENT
+        replica.  First response
+        wins; the loser's connection is abandoned (its thread drains
+        on its own) and its latency sample is never recorded — a
+        loser's service time is the hedge's artifact, not the
+        replica's.
+
+        ``probe=True`` is the gray-failure re-admission path: the
+        primary is a soft-ejected replica taking its probe-fraction of
+        real traffic, so the backup launches IMMEDIATELY (delay zero —
+        the probed replica's suspected slowness must never reach the
+        client, or the probe fraction would reappear in fleet p99) and
+        the probe attempt's own service time records to the probed
+        replica's digest when it completes, win or lose, in the
+        background — that sample is exactly what re-admission is
+        judged on.  Probe pairs are not counted as hedges.
+
+        Returns ``(rep, response, error, kind, elapsed, recorded)``
+        for whichever attempt won (the primary's failure when both
+        lost), with the second replica added to ``tried``;
+        ``recorded`` tells the caller the winner's digest sample was
+        already handled here."""
+        import queue as _queue
+
+        results = _queue.Queue()
+
+        def run(rep, tag, done=None):
+            out = (tag, rep) + self._attempt_unary(
+                rep, method, path, body, headers, timeout_s)
+            if probe and tag == "primary":
+                _t, _r, resp_, err_, _kind, elapsed_ = out
+                if err_ is None and resp_[0] < 500 \
+                        and not self._policy.should_failover(
+                            self._policy.classify_http_status(resp_[0]),
+                            idempotent=True):
+                    # the probe's measurement, recorded even as a
+                    # hedge loser: this is the traffic the ejected
+                    # replica re-earns its digest with.  Typed
+                    # overload answers (429/503) are excluded exactly
+                    # as on the main recording path — a saturated
+                    # replica's fast shed responses must not read as a
+                    # recovered service time
+                    rep.note_latency(verb, elapsed_)
+            if done is not None:
+                done.set()
+            results.put(out)
+
+        threading.Thread(
+            target=run, args=(primary, "primary"),
+            name="fleet-router-hedge", daemon=True).start()
+        first = None
+        if not probe:
+            # the primary's rolling p95 seeds the delay, FLOORED at the
+            # configured hedge_delay_s (the operator's cap on duplicate
+            # traffic), which alone applies while the digest is cold
+            delay = primary.hedge_delay(verb, self._outlier_min_samples)
+            delay = (self._hedge_delay_s if delay is None
+                     else max(delay, self._hedge_delay_s))
+            delay = min(delay, timeout_s)
+            try:
+                first = results.get(timeout=delay)
+            except _queue.Empty:
+                first = None
+        if first is not None:
+            # the primary answered inside the hedge delay: no hedge
+            _tag, rep, response, error, kind, elapsed = first
+            return rep, response, error, kind, elapsed, False
+        backup = self.pick_replica(exclude=tried, replicas=replicas,
+                                   healthy_only=True)
+        if backup is None:
+            # nowhere healthy to hedge/shadow to: wait the primary out
+            _tag, rep, response, error, kind, elapsed = results.get()
+            return rep, response, error, kind, elapsed, probe
+        tried.add(backup.url)
+        backup_done = threading.Event()
+        threading.Thread(
+            target=run, args=(backup, "hedge", backup_done),
+            name="fleet-router-hedge", daemon=True).start()
+        winner = None
+        losers = []
+        for _ in range(2):
+            out = results.get()
+            if out[3] is None:  # a response (typed or not) wins
+                winner = out
+                break
+            losers.append(out)
+        budget_gone = (deadline is not None
+                       and deadline - time.monotonic() <= 0)
+        for _ltag, lrep, _lresp, lerr, lkind, _lel in losers:
+            if budget_gone and isinstance(lerr, socket.timeout):
+                # the loser's socket timeout was the CALLER's own
+                # deadline clamp (same no-blame rule as the unary
+                # path): an impatient request must not rotate healthy
+                # replicas out of the fleet
+                continue
+            if lkind in (FAILURE_CONNECT, FAILURE_INTERRUPTED):
+                # a loser that already failed in transport rotates out
+                # like any other unreachable peer
+                lrep.mark_unreachable()
+        if winner is None:
+            # both attempts died in transport: surface the primary's
+            # failure to the failover loop (the backup replica was
+            # rotated out above and sits in ``tried``)
+            for tag, rep, response, error, kind, elapsed in losers:
+                if tag == "primary":
+                    return rep, response, error, kind, elapsed, False
+        tag, rep, response, error, kind, elapsed = winner
+        if not probe:
+            outcome = ("won" if tag == "hedge"
+                       else "lost" if backup_done.is_set() else "cancelled")
+            with self._lock:
+                self._hedges[outcome] += 1
+            self._log("hedge {} (primary {}, hedge {})".format(
+                outcome, primary.url, backup.url))
+        # a probe-primary win was recorded in its own thread; a backup
+        # win records normally in the caller
+        return (rep, response, error, kind, elapsed,
+                probe and tag == "primary")
+
     def forward_unary(self, method, path, body, headers, idempotent=False):
         """One logical request with failover: connect-phase and typed-
         overload failures fall through to the next replica under the
@@ -1264,16 +1867,33 @@ class FleetRouter:
         over only when the caller marks it ``idempotent`` (GETs) —
         otherwise it surfaces as a typed 502 the client's retry policy
         will not blindly re-execute.  Returns
-        ``(status, headers, body)``."""
+        ``(status, headers, body)``.
+
+        Two tail-defense behaviors ride the loop (docs/resilience.md
+        "Tail-latency defense"): every attempt relays the REMAINING
+        deadline budget (the ``timeout`` parameter is rewritten per
+        attempt — a slow first attempt shrinks the second's budget),
+        and with hedging enabled the FIRST attempt of an idempotent
+        request races a delayed duplicate on a different replica."""
         deadline = _request_deadline(body, headers)
+        verb = _verb_of(path)
+        # the pool's idempotency classification: GETs plus the infer
+        # verb (re-executing either elsewhere is waste, never
+        # corruption) — the precondition for BOTH duplicate-in-flight
+        # shapes below (hedges and shadowed ejection probes)
+        hedge_safe = idempotent or (
+            method == "POST" and _HEDGE_URI.match(path) is not None)
+        hedge_ok = self._hedge_delay_s is not None and hedge_safe
         # ONE membership snapshot per logical request: a concurrent
         # remove_replica must not shrink the attempt budget mid-loop
         # or hand the loop a list whose indices shifted under it
         replicas = self._replicas_snapshot()
         tried = set()
         last_response = None
+        first_attempt = True
         for _ in range(max(1, 2 * len(replicas))):
             timeout_s = self._read_timeout_s
+            attempt_body, attempt_headers = body, headers
             if deadline is not None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -1282,34 +1902,64 @@ class FleetRouter:
                                  "failover"}).encode("utf-8"))
                 # each attempt gets at most the budget that is left: a
                 # replica that accepted the connection and then wedged
-                # must not hold the request past its own deadline
+                # must not hold the request past its own deadline —
+                # and the replica itself must see the SHRUNK budget,
+                # so its scheduler never queues work for a request
+                # whose caller already gave up (deadline propagation)
                 timeout_s = min(timeout_s, remaining)
+                attempt_body, attempt_headers = _rewrite_timeout(
+                    body, headers, remaining)
             rep = self.pick_replica(exclude=tried, replicas=replicas)
             if rep is None:
                 break
             tried.add(rep.url)
-            error = kind = None
-            response = None
-            rep.begin_request()
-            try:
-                response = self._upstream_once(
-                    rep, method, path, body, headers, timeout_s)
-            except (ConnectionRefusedError, socket.gaierror) as e:
-                error, kind = e, FAILURE_CONNECT
-            except (ConnectionError, socket.timeout, OSError,
-                    http.client.HTTPException) as e:
-                error, kind = e, FAILURE_INTERRUPTED
-            finally:
-                rep.end_request()
+            recorded = False
+            # a soft-ejected pick IS the re-admission probe: shadow it
+            # with an immediate backup when a duplicate is safe (the
+            # probed slowness must not reach the client); an unsafe
+            # verb probes unshadowed — slow for this one caller, but
+            # single-execution
+            probe = hedge_safe and rep.routable()[2]
+            if probe or (hedge_ok and first_attempt):
+                (rep, response, error, kind, elapsed,
+                 recorded) = self._attempt_hedged(
+                    rep, replicas, tried, method, path, attempt_body,
+                    attempt_headers, timeout_s, verb, probe=probe,
+                    deadline=deadline)
+            else:
+                response, error, kind, elapsed = self._attempt_unary(
+                    rep, method, path, attempt_body, attempt_headers,
+                    timeout_s)
+            first_attempt = False
             if error is None:
                 kind = self._policy.classify_http_status(response[0])
                 if not self._policy.should_failover(kind, idempotent):
+                    if response[0] < 500 and not recorded:
+                        # the gray-failure digest: completed requests
+                        # the client actually waited on (5xx answers
+                        # and failover casualties measure the failure
+                        # path, not the replica's service time)
+                        rep.note_latency(verb, elapsed)
+                    if response[0] < 500:
+                        self._evaluate_ejections()
                     return response
                 # typed overload: the replica did no work — another may
                 rep.note_typed_failure()
                 last_response = response
                 self.count_failover()
                 continue
+            if (isinstance(error, socket.timeout) and deadline is not None
+                    and deadline - time.monotonic() <= 0):
+                # the attempt's socket timeout was the CALLER's own
+                # deadline clamp, not replica sickness: answer the
+                # truthful typed 504 (the replica, which received the
+                # shrunk budget, is expiring the request on its own
+                # deadline path right now) and do not rotate a healthy
+                # replica out for our impatience
+                return (504, {}, json.dumps({
+                    "error": "router: request deadline exhausted during "
+                             "upstream attempt to {}".format(rep.url)
+                }).encode("utf-8"))
             # transport failure: rotate the replica out until a probe
             # sees it again; fail over when the classification allows
             rep.mark_unreachable()
@@ -1694,6 +2344,9 @@ class _RouterHandler(BaseHttpHandler):
             status_error = None
             conn = None
             rep.begin_request()
+            admitted_at = time.monotonic()
+            serving_rep = rep
+            ttft_fresh = not resuming and gen.emitted() == 0
             try:
                 conn = http.client.HTTPConnection(
                     rep.host, rep.port, timeout=router._read_timeout_s)
@@ -1703,7 +2356,18 @@ class _RouterHandler(BaseHttpHandler):
                     status_error = (
                         resp.status, dict(resp.headers), resp.read())
                 else:
-                    outcome = self._relay_events(gen, resp)
+                    # the stream tier's gray-failure sample is TTFT
+                    # (total stream time scales with max_tokens, so it
+                    # cannot be compared across replicas) — fresh
+                    # admissions only: a resume/handoff splice starts
+                    # mid-generation and would read artificially fast
+                    def _note_ttft():
+                        serving_rep.note_latency(
+                            "generate_stream",
+                            time.monotonic() - admitted_at)
+
+                    outcome = self._relay_events(
+                        gen, resp, _note_ttft if ttft_fresh else None)
             except (ConnectionError, socket.timeout, OSError,
                     http.client.HTTPException):
                 outcome = "died"
@@ -1792,13 +2456,15 @@ class _RouterHandler(BaseHttpHandler):
             headers = {"Content-Type": "application/json"}
             resuming = False
 
-    def _relay_events(self, gen, resp):
+    def _relay_events(self, gen, resp, on_first=None):
         """Relay one upstream SSE response: record + rewrite each event
         into router numbering and emit it.  Returns ``"final"``,
         ``"error"`` (typed in-band failure, already relayed), or
         ``"died"`` (EOF without a terminal event — the handoff
         trigger).  Upstream socket failures propagate to the caller's
-        transport handler; a dead client raises :class:`_ClientGone`."""
+        transport handler; a dead client raises :class:`_ClientGone`.
+        ``on_first`` fires once before the first data event relays —
+        the TTFT probe feeding the serving replica's latency digest."""
         for raw in resp:
             line = raw.rstrip(b"\r\n")
             if not line.startswith(b"data: "):
@@ -1811,6 +2477,13 @@ class _RouterHandler(BaseHttpHandler):
                 self._send_chunk(b"data: " + json.dumps(payload).encode("utf-8")
                            + b"\n\n")
                 return "error"
+            # TTFT samples only real token events: an in-band error
+            # answer measures the failure path, not service time (a
+            # fast-erroring replica must not read as a fast replica —
+            # the same exclusion the unary recording path applies)
+            if on_first is not None:
+                on_first()
+                on_first = None
             backend_seq = (payload.get("parameters") or {}).get("seq")
             if backend_seq is None:
                 # a non-resumable upstream (no scheduler ids): pure

@@ -88,6 +88,7 @@ Self-healing (tests/test_self_healing.py, docs/resilience.md):
   same-endpoint only.
 """
 
+import math
 import threading
 import time
 from collections import OrderedDict, deque
@@ -119,10 +120,97 @@ class SchedulerClosed(Exception):
 
 
 class AdmissionQueueFull(RuntimeError):
-    """Raised on submit when the pending queue is at capacity — the
-    scheduler-level overload signal (RuntimeError subclass for backward
-    compatibility; the core maps it to Overloaded — HTTP 429 /
-    RESOURCE_EXHAUSTED)."""
+    """Raised on submit when the pending queue is at capacity (the
+    hard ``max_pending`` backstop), when the KV page pool is
+    exhausted, or when the adaptive sojourn-time controller sheds —
+    the scheduler-level overload signal (RuntimeError subclass for
+    backward compatibility; the core maps it to Overloaded — HTTP 429
+    / RESOURCE_EXHAUSTED).  ``retry_after`` (seconds, or None for the
+    frontend default) rides into the Overloaded's ``Retry-After``
+    header: the adaptive controller computes it from its current
+    control interval, so clients back off at the pace the queue is
+    actually draining."""
+
+    def __init__(self, msg, retry_after=None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class _CodelShedController:
+    """Sojourn-time admission shedding — the CoDel control law applied
+    to the scheduler's pending queue (Nichols & Jacobson, "Controlling
+    Queue Delay"), replacing the *fixed* ``max_pending`` cliff with an
+    adaptive valve.
+
+    A long queue is not the problem — a queue that STAYS long is.  The
+    controller watches the admission queue's sojourn (the head
+    stream's wait, i.e. exactly what ``tpu_scheduler_queue_wait_-
+    seconds`` histograms at admission): once it has exceeded
+    ``target_s`` continuously for a full ``interval_s``, the scheduler
+    sheds the NEWEST arrival with the existing typed 429 and keeps
+    shedding one arrival per control interval, tightening the interval
+    as ``interval / sqrt(shed_count)`` while overload persists
+    (standard CoDel acceleration) and relaxing the moment sojourn
+    drops back under target.  ``Retry-After`` is the ceiling of the
+    current control interval — the pace the queue is draining at.
+
+    Plain state machine, no locking of its own: every method runs
+    under the scheduler's ``_cond`` (submit holds it to shed; the
+    decode loop holds it where it notes sojourn), and all time flows
+    in as ``now`` so unit tests drive it clock-free.  With the
+    controller off (``target_queue_ms=None``) the submit path is
+    byte-identical to the pre-controller scheduler; ``max_pending``
+    stays as the hard backstop either way."""
+
+    __slots__ = ("target_s", "interval_s", "above_since", "shedding",
+                 "shed_next", "shed_count")
+
+    def __init__(self, target_s, interval_s):
+        self.target_s = float(target_s)
+        self.interval_s = float(interval_s)
+        self.above_since = None  # first instant sojourn exceeded target
+        self.shedding = False
+        self.shed_next = 0.0     # next shed instant while shedding
+        self.shed_count = 0      # sheds in the current overload episode
+
+    def current_interval(self):
+        return self.interval_s / math.sqrt(max(1, self.shed_count))
+
+    def note_sojourn(self, sojourn_s, now):
+        """One queue-delay observation (the head-of-queue wait: the
+        FIFO maximum, so 'head under target' means the whole queue
+        is).  Below target ⇒ relax completely; above ⇒ start (or keep)
+        the overload clock."""
+        if sojourn_s < self.target_s:
+            self.above_since = None
+            self.shedding = False
+            self.shed_count = 0
+        elif self.above_since is None:
+            self.above_since = now
+
+    def on_arrival(self, now, queue_len):
+        """Shed verdict for one new submit: the ``Retry-After``
+        seconds to shed with, or None to admit.  Never sheds an empty
+        queue (nothing is waiting — sojourn is a stale signal), never
+        sheds before the sojourn has been above target for one full
+        interval, and while shedding drops one arrival per (shrinking)
+        control interval rather than every arrival — the valve sheds
+        at the rate that brings sojourn back to target, not to zero
+        throughput."""
+        if queue_len <= 0 or self.above_since is None:
+            return None
+        if now - self.above_since < self.interval_s:
+            return None
+        if not self.shedding:
+            self.shedding = True
+            self.shed_count = 1
+        elif now >= self.shed_next:
+            self.shed_count += 1
+        else:
+            return None
+        interval = self.current_interval()
+        self.shed_next = now + interval
+        return max(1, int(math.ceil(interval)))
 
 
 class _Stream:
@@ -245,7 +333,8 @@ class DecodeScheduler:
                  replay_ttl_s=60.0, replay_capacity=256,
                  metrics=None, metric_labels=None,
                  prefill_chunk_tokens=256, prefix_cache=True,
-                 kv_export=None, kv_import=None, kv_discard=None):
+                 kv_export=None, kv_import=None, kv_discard=None,
+                 target_queue_ms=None, shed_interval_ms=100.0):
         if max_slots < 1:
             raise ValueError(
                 "max_slots must be >= 1 (got {})".format(max_slots)
@@ -265,6 +354,21 @@ class DecodeScheduler:
         self._max_pending = (
             max_pending if max_pending is not None else max(32, 8 * max_slots)
         )
+        # adaptive queue shedding (docs/resilience.md "Tail-latency
+        # defense"): None = controller off, submit path byte-identical
+        # to the fixed-cliff scheduler.  When set, admissions shed
+        # (typed 429 + Retry-After from the control interval) once the
+        # queue's sojourn exceeds target_queue_ms for a sustained
+        # shed_interval_ms — max_pending stays as the hard backstop.
+        # State is written by submit and the decode loop, both under
+        # _cond (the loop notes sojourn inside its already-held locked
+        # region: zero new lock acquisitions).  # guarded-by: _cond
+        self._shed_ctl = (
+            _CodelShedController(float(target_queue_ms) / 1e3,
+                                 float(shed_interval_ms) / 1e3)
+            if target_queue_ms else None
+        )
+        self._codel_sheds = 0  # guarded-by: _cond
         self._step_timeout_s = step_timeout_s
         self._max_restarts = int(max_restarts)
         self._restart_window_s = float(restart_window_s)
@@ -414,6 +518,18 @@ class DecodeScheduler:
                 raise SchedulerClosed(
                     "scheduler is draining; not accepting new generations"
                 )
+            if self._shed_ctl is not None:
+                retry_after = self._shed_ctl.on_arrival(
+                    time.monotonic(), len(self._pending))
+                if retry_after is not None:
+                    self._codel_sheds += 1
+                    raise AdmissionQueueFull(
+                        "admission queue sojourn above target for a "
+                        "full control interval ({} waiting "
+                        "generations); retry later".format(
+                            len(self._pending)),
+                        retry_after=retry_after,
+                    )
             if len(self._pending) >= self._max_pending:
                 raise AdmissionQueueFull(
                     "scheduler admission queue is full ({} waiting "
@@ -689,6 +805,9 @@ class DecodeScheduler:
                 "admitted": self._admitted_total,
                 "tokens": self._tokens_total,
                 "replay_hits": self._replay_hits,
+                "codel_sheds": self._codel_sheds,
+                "codel_shedding": bool(
+                    self._shed_ctl is not None and self._shed_ctl.shedding),
                 "prefix_hits": self._prefix_hits,
                 "prefix_misses": self._prefix_misses,
                 "prefix_evictions": self._prefix_evictions,
@@ -1449,6 +1568,15 @@ class DecodeScheduler:
                 # on it); an in-flight one retires mid-generation, its
                 # slot and pages freeing for waiting work this iteration
                 now = time.monotonic()
+                if self._shed_ctl is not None:
+                    # adaptive-shed sojourn signal: the head stream's
+                    # wait is the FIFO maximum, so "head under target"
+                    # means the whole queue is.  Noted inside the
+                    # already-held _cond region — the controller costs
+                    # the loop zero new lock acquisitions.
+                    self._shed_ctl.note_sojourn(
+                        (now - self._pending[0].enqueued_at)
+                        if self._pending else 0.0, now)
                 if self._pending:
                     keep = deque()
                     for st in self._pending:

@@ -14,6 +14,8 @@ Control surface (what tests poke):
     POST /stub/state {"pending": 16}         # scheduler counters
     POST /stub/state {"tripped": true}       # alive-but-tripped
     POST /stub/state {"wedged": true}        # stop answering probes
+    POST /stub/state {"infer_delay_ms": 200} # gray failure: slow, not
+                                             # dead (probes still 200)
 
 ``--ttl S`` makes the process exit nonzero after S seconds — the
 always-crashing replica that exhausts a restart budget.
@@ -83,11 +85,35 @@ def main():
                          "(a start that never completes)")
     ap.add_argument("--infer-delay-ms", type=float, default=0.0,
                     help="synthetic latency floor per /infer request")
+    ap.add_argument("--infer-jitter-ms", type=float, default=0.0,
+                    help="deterministic pseudo-random extra latency in "
+                         "[0, this) per /infer, from an LCG seeded by "
+                         "the port — the stdlib twin of the faults.py "
+                         "'jitter' mode, so gray-failure tier-1 tests "
+                         "get realistic latency spread without jax "
+                         "replicas")
     args = ap.parse_args()
 
     lock = threading.Lock()
     state = {"state": "starting" if args.never_ready else "ready",
-             "ready": not args.never_ready, "wedged": False}
+             "ready": not args.never_ready, "wedged": False,
+             # runtime-adjustable latency (POST /stub/state): how gray
+             # tests make ONE replica of a stub fleet slow mid-soak
+             # (the process keeps answering probes — that is the gray
+             # shape) and then recover it
+             "infer_delay_ms": args.infer_delay_ms,
+             "infer_jitter_ms": args.infer_jitter_ms}
+    # glibc LCG constants over 2^31 — matches tpuserver.faults' jitter
+    # mode so stub soaks replay exactly run to run
+    lcg = {"state": (args.port * 2654435761) % (1 << 31)}
+
+    def next_jitter_ms():
+        with lock:
+            jitter = state["infer_jitter_ms"]
+            if jitter <= 0:
+                return 0.0
+            lcg["state"] = (1103515245 * lcg["state"] + 12345) % (1 << 31)
+            return jitter * lcg["state"] / (1 << 31)
     model = {
         "live_streams": 0, "pending": 0, "max_slots": 4,
         "max_pending": 16, "tripped": False, "draining": False,
@@ -153,6 +179,12 @@ def main():
             "stub_generations_total {}\n".format(count, gens))
 
     class Handler(BaseHTTPRequestHandler):
+        # the stub answers with several small writes (status, headers,
+        # body); Nagle + delayed-ACK turns those into occasional
+        # ~40-200ms stalls that would drown the latency signals the
+        # gray-failure tests measure
+        disable_nagle_algorithm = True
+
         def log_message(self, *a):
             pass
 
@@ -199,8 +231,11 @@ def main():
             body = self.rfile.read(length) if length else b""
             if self.path == "/v2/models/stub/infer":
                 t0 = time.perf_counter()
-                if args.infer_delay_ms > 0:
-                    time.sleep(args.infer_delay_ms / 1000.0)
+                with lock:
+                    delay_ms = state["infer_delay_ms"]
+                delay_ms += next_jitter_ms()
+                if delay_ms > 0:
+                    time.sleep(delay_ms / 1000.0)
                 with lock:
                     served["count"] += 1
                     served["ns"] += int(

@@ -59,9 +59,50 @@ def test_schedule_rejects_bad_args():
 def test_percentile_matches_numpy_linear():
     rng = np.random.RandomState(0)
     sample = list(rng.rand(257) * 1000)
-    for pct in (0, 10, 50, 90, 95, 99, 100):
+    for pct in (0, 10, 50, 90, 95, 99, 99.9, 100):
         assert metrics.percentile(sample, pct) == pytest.approx(
             float(np.percentile(sample, pct)))
+
+
+def test_p99_9_pools_raw_samples_and_matches_numpy():
+    """The tail column (p99.9) rests on POOLED raw samples, never on
+    averaged per-window percentiles: pooling two windows equals one
+    numpy computation over their concatenation, and the averaged-
+    percentile shortcut provably disagrees on a skewed tail."""
+    rng = np.random.RandomState(7)
+    win_a = list(rng.rand(1500) * 10.0)       # 0-10ms body
+    win_b = list(rng.rand(500) * 10.0) + [500.0, 900.0]  # tail spikes
+    pooled = win_a + win_b
+    summary = metrics.latency_summary([v / 1e6 for v in pooled])
+    assert summary["p99.9_usec"] == pytest.approx(
+        float(np.percentile(sorted(pooled), 99.9)))
+    averaged = (metrics.percentile(win_a, 99.9)
+                + metrics.percentile(win_b, 99.9)) / 2.0
+    assert summary["p99.9_usec"] != pytest.approx(averaged)
+
+
+def test_latency_summary_carries_p99_9_and_report_columns_render():
+    """Empty-sample summaries carry the p99.9 key (None), and both the
+    per-level table and the reference-schema window CSV grew the
+    column."""
+    from perfanalyzer.report import (
+        _SCALAR_COLUMNS,
+        _SCALAR_HEADERS,
+        WINDOW_CSV_COLUMNS,
+        ReportWriter,
+    )
+
+    assert metrics.latency_summary([])["p99.9_usec"] is None
+    assert ("p99.9_usec", "{:.1f}") in _SCALAR_COLUMNS
+    assert "p99.9(us)" in _SCALAR_HEADERS
+    assert ("p99.9 latency", "p99.9_usec") in WINDOW_CSV_COLUMNS
+    writer = ReportWriter("m", "inprocess")
+    table = writer.table([{
+        "mode": "concurrency", "level": 1, "throughput": 10.0,
+        **metrics.latency_summary([0.001] * 10), "errors": 0,
+        "stable": True,
+    }])
+    assert "p99.9(us)" in table and "1000.0" in table
 
 
 def test_percentile_edges():
@@ -574,3 +615,25 @@ def test_attach_router_delta_diffs_supervisor_counters():
     attach_router_delta(result, dict(base), dict(base, shed=2))
     assert result["router_shed"] == 2
     assert "supervisor_replica_restarts" not in result
+
+
+def test_attach_router_delta_diffs_ejections_and_hedges():
+    """The tail-defense counters window-diff like the rest — and only
+    when both snapshots carry them, so a router predating the counters
+    never fabricates a zero delta."""
+    from perfanalyzer.metrics import attach_router_delta
+
+    base = {"failovers": 0, "handoffs": 0, "resumed_streams": 0,
+            "shed": 0, "ejections": 1, "hedges": 10}
+    after = dict(base, ejections=3, hedges=14)
+    result = {}
+    attach_router_delta(result, base, after)
+    assert result["router_ejections"] == 2
+    assert result["router_hedges"] == 4
+    # old-router snapshots: the keys simply do not appear
+    old = {"failovers": 0, "handoffs": 0, "resumed_streams": 0,
+           "shed": 0}
+    result = {}
+    attach_router_delta(result, old, dict(old))
+    assert "router_ejections" not in result
+    assert "router_hedges" not in result

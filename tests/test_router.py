@@ -929,6 +929,10 @@ import sys as _sys
 
 from fleet_stub import free_port as _free_port  # noqa: E402
 from fleet_stub import wait_ready as _stub_wait_ready  # noqa: E402
+from http.server import (  # noqa: E402
+    BaseHTTPRequestHandler as _BaseHTTPRequestHandler,
+    ThreadingHTTPServer as _ThreadingHTTPServer,
+)
 
 _STUB = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
                       "fleet_stub.py")
@@ -1075,3 +1079,359 @@ def test_prefix_affinity_never_overrides_eligibility(stub_fleet):
         assert _stub_generations(ports[cold]) == cold_before + 1
     finally:
         router.stop()
+
+
+# -- tail-latency defense (ISSUE 13) ------------------------------------------
+#
+# Gray-failure ejection, hedged unary requests, and deadline-budget
+# propagation.  The ejection-policy tests drive the router CORE
+# directly (an unstarted FleetRouter: replicas are optimistic-eligible
+# and no prober threads spin) feeding the latency digests by hand, so
+# the decision logic is pinned clock-free; the wire-level tests use
+# tiny in-test stdlib replicas — no jax, per the tier-1 budget.
+# tools/chaos_smoke.py --gray soaks the full arc against stub replica
+# processes.
+
+
+class _MiniHandler(_BaseHTTPRequestHandler):
+    disable_nagle_algorithm = True  # multi-write responses vs Nagle
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self):
+        spec = self.server.spec
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if self.path.startswith("/v2/health"):
+            payload = json.dumps({
+                "state": "ready", "ready": True, "inflight": 0,
+                "models": {}}).encode("utf-8")
+            self.send_response(200)
+        else:
+            spec["requests"].append(body)
+            if spec["delay_s"]:
+                time.sleep(spec["delay_s"])
+            payload = json.dumps(
+                {"served_by": self.server.server_address[1],
+                 "error": "mini overload"}
+                if spec["status"] >= 400 else
+                {"served_by": self.server.server_address[1]}
+            ).encode("utf-8")
+            self.send_response(spec["status"])
+            if spec["status"] == 503:
+                self.send_header("Retry-After", "1")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    do_GET = do_POST = _reply
+
+
+@pytest.fixture
+def mini_replicas():
+    """Factory for tiny in-test HTTP replicas with a controllable
+    delay/status; yields (make, urls-so-far) and tears them down."""
+    servers = []
+
+    def make(delay_s=0.0, status=200):
+        server = _ThreadingHTTPServer(("127.0.0.1", 0), _MiniHandler)
+        server.daemon_threads = True
+        server.spec = {"delay_s": delay_s, "status": status,
+                       "requests": []}
+        thread = threading.Thread(target=server.serve_forever,
+                                  daemon=True)
+        thread.start()
+        servers.append((server, thread))
+        return ("127.0.0.1:{}".format(server.server_address[1]),
+                server.spec)
+
+    yield make
+    for server, thread in servers:
+        server.shutdown()
+        server.server_close()
+        thread.join(timeout=5)
+
+
+@pytest.fixture
+def make_router():
+    """Unstarted FleetRouters for the policy/wire tests (no prober
+    threads; the pre-bound admin socket still needs closing — stop()
+    would block on a server loop that never ran)."""
+    routers = []
+
+    def make(backends, **kwargs):
+        router = FleetRouter(backends, **kwargs)
+        routers.append(router)
+        return router
+
+    yield make
+    for router in routers:
+        router._httpd.server_close()
+
+
+def _feed(router, url, verb, value, n):
+    rep = router.replica_by_url(url)
+    for _ in range(n):
+        rep.note_latency(verb, value)
+
+
+def _status_of(router, url):
+    return [r for r in router.stats()["replicas"]
+            if r["url"] == url][0]
+
+
+def test_gray_outlier_soft_ejects_counts_and_readmits(make_router):
+    """The ejection core: a replica whose recent p90 is >3x the fleet
+    median soft-ejects (counted, visible in /router/stats and the
+    metrics families), stays HEALTH-eligible the whole time (gray is
+    not down), is routed around except for the probe fraction, and
+    re-admits once post-ejection samples come in under the bar."""
+    router = make_router(["127.0.0.1:11", "127.0.0.1:12", "127.0.0.1:13"],
+                         outlier_min_samples=4, probe_fraction=0.25,
+                         digest_window=8)
+    _feed(router, "127.0.0.1:11", "infer", 1.0, 8)   # the outlier
+    _feed(router, "127.0.0.1:12", "infer", 0.01, 8)
+    _feed(router, "127.0.0.1:13", "infer", 0.01, 8)
+    router._evaluate_ejections(force=True)
+    row = _status_of(router, "127.0.0.1:11")
+    assert row["status"] == "soft-ejected" and row["ejected"]
+    assert row["eligible"], "ejection must not leak into health"
+    stats = router.stats()
+    assert stats["ejections"] == 1
+    # routed around except every 4th pick (probe_fraction=1/4)
+    picked = [router.pick_replica().url for _ in range(8)]
+    assert picked.count("127.0.0.1:11") == 2, picked
+    # the exposition distinguishes the gray state per replica, and the
+    # ejection counter is a first-class family
+    text = router.metrics_text()
+    assert 'tpu_router_replica_state{replica="127.0.0.1:11",' \
+        'state="soft-ejected"} 1' in text
+    assert "tpu_router_ejections_total 1" in text
+    assert 'tpu_router_replica_p90_seconds{replica="127.0.0.1:12",' \
+        'verb="infer"}' in text
+    # ejection reset the digest: fresh (fast) probe samples re-admit
+    assert _status_of(router, "127.0.0.1:11")["digest"] == {}
+    _feed(router, "127.0.0.1:11", "infer", 0.01, 4)
+    router._evaluate_ejections(force=True)
+    row = _status_of(router, "127.0.0.1:11")
+    assert row["status"] == "ok" and not row["ejected"]
+    # re-admission is not a second ejection event
+    assert router.stats()["ejections"] == 1
+
+
+def test_ejection_defers_at_min_eligible_and_health_dominates(
+        make_router):
+    """Two pins: (a) an outlier is NOT ejected when ejection would
+    leave fewer than min_eligible healthy replicas — the fleet
+    degrades to slow, never to unavailable; (b) an ineligible
+    (draining/unreachable) replica is never gray-ejected — health
+    verdicts dominate, and its status stays diagnosable."""
+    router = make_router(["127.0.0.1:21", "127.0.0.1:22"],
+                         outlier_min_samples=4, min_eligible=2)
+    _feed(router, "127.0.0.1:21", "infer", 1.0, 8)
+    _feed(router, "127.0.0.1:22", "infer", 0.01, 8)
+    router._evaluate_ejections(force=True)
+    row = _status_of(router, "127.0.0.1:21")
+    assert row["status"] == "ok" and not row["ejected"]
+    assert router.stats()["ejections"] == 0
+    # (b) health dominance: the outlier goes unreachable — its status
+    # reports the HEALTH verdict, and no ejection ever applies
+    router.replica_by_url("127.0.0.1:21").mark_unreachable()
+    router._evaluate_ejections(force=True)
+    row = _status_of(router, "127.0.0.1:21")
+    assert row["status"] == "unreachable" and not row["ejected"]
+
+
+def test_ejection_needs_a_differential_signal(make_router):
+    """One replica alone (or one with samples) is its own median: no
+    ejection without >= 2 replicas of digest coverage — a uniformly
+    slow fleet is load, not a gray failure."""
+    router = make_router(["127.0.0.1:31", "127.0.0.1:32"],
+                         outlier_min_samples=4)
+    _feed(router, "127.0.0.1:31", "infer", 1.0, 8)
+    router._evaluate_ejections(force=True)
+    assert _status_of(router, "127.0.0.1:31")["status"] == "ok"
+    # both slow: still no outlier (the median IS the fleet)
+    _feed(router, "127.0.0.1:32", "infer", 1.0, 8)
+    router._evaluate_ejections(force=True)
+    assert router.stats()["ejections"] == 0
+
+
+def test_hedge_first_response_wins_loser_never_double_counted(
+        mini_replicas, make_router):
+    """Router-tier hedging: an idempotent unary attempt still pending
+    after the hedge delay races a duplicate on the next-ranked
+    replica; the fast replica's answer is relayed, the outcome counts
+    once under tpu_router_hedges_total{outcome=won}, and the loser's
+    latency sample never enters any digest."""
+    slow_url, _slow_spec = mini_replicas(delay_s=0.6)
+    fast_url, _fast_spec = mini_replicas(delay_s=0.0)
+    router = make_router([slow_url, fast_url], hedge_delay_s=0.05,
+                         read_timeout_s=5.0)
+    status, headers, body = router.forward_unary(
+        "POST", "/v2/models/stub/infer", b"{}",
+        {"Content-Type": "application/json"})
+    assert status == 200
+    assert json.loads(body)["served_by"] == int(fast_url.rsplit(":")[-1])
+    stats = router.stats()
+    assert stats["hedges"] == 1
+    assert stats["hedges_by_outcome"]["won"] == 1
+    # the winner's sample recorded, the loser's excluded — even after
+    # the loser's connection drains in the background
+    assert _status_of(router, fast_url)["digest"]["infer"]["samples"] == 1
+    time.sleep(0.8)
+    assert _status_of(router, slow_url)["digest"] == {}
+    text = router.metrics_text()
+    assert 'tpu_router_hedges_total{outcome="won"} 1' in text
+
+
+def test_hedge_primary_win_counts_lost_or_cancelled(mini_replicas,
+                                                      make_router):
+    """When the primary answers after the hedge fired, the hedge is
+    abandoned and counted (lost if it completed, cancelled if still
+    in flight) — never relayed, never double-answered."""
+    primary_url, _spec = mini_replicas(delay_s=0.15)
+    backup_url, backup_spec = mini_replicas(delay_s=3.0)
+    router = make_router([primary_url, backup_url], hedge_delay_s=0.05,
+                         read_timeout_s=5.0)
+    status, _headers, body = router.forward_unary(
+        "POST", "/v2/models/stub/infer", b"{}", {})
+    assert status == 200
+    assert json.loads(body)["served_by"] == int(
+        primary_url.rsplit(":")[-1])
+    outcomes = router.stats()["hedges_by_outcome"]
+    assert outcomes["lost"] + outcomes["cancelled"] == 1, outcomes
+    assert outcomes["won"] == 0
+    # the hedge really fired: the backup saw the duplicate request
+    assert len(backup_spec["requests"]) == 1
+
+
+def test_streams_and_broadcasts_never_hedge(mini_replicas, make_router):
+    """Hedging is unary-idempotent only: a generate_stream POST and a
+    broadcast mutation must never produce a duplicate in-flight
+    attempt, whatever the hedge knobs say."""
+    a_url, a_spec = mini_replicas(delay_s=0.2)
+    b_url, b_spec = mini_replicas(delay_s=0.2)
+    router = make_router([a_url, b_url], hedge_delay_s=0.01,
+                         read_timeout_s=5.0)
+    # a broadcast goes to EVERY replica once — one request each, no
+    # hedge accounting
+    router.forward_broadcast(
+        "POST", "/v2/systemsharedmemory/region/r/register", b"{}", {})
+    assert len(a_spec["requests"]) == 1 and len(b_spec["requests"]) == 1
+    assert router.stats()["hedges"] == 0
+    # a non-hedgeable POST (not the infer verb) never hedges even when
+    # slow
+    router.forward_unary("POST", "/v2/repository/index", b"{}", {})
+    assert router.stats()["hedges"] == 0
+
+
+def test_deadline_budget_shrinks_across_failover(mini_replicas,
+                                                   make_router):
+    """Deadline-budget propagation, wire-pinned: the first attempt
+    burns most of the request's ``timeout`` budget (slow typed-
+    overload answer), and the SECOND replica receives the request
+    with the timeout parameter rewritten to the remaining budget —
+    not the original."""
+    slow_url, slow_spec = mini_replicas(delay_s=0.3, status=503)
+    ok_url, ok_spec = mini_replicas()
+    router = make_router([slow_url, ok_url], read_timeout_s=5.0)
+    body = json.dumps({"parameters": {"timeout": 500000}}).encode()
+    status, _headers, _body = router.forward_unary(
+        "POST", "/v2/models/stub/infer", body,
+        {"Content-Type": "application/json"})
+    assert status == 200
+    first = json.loads(slow_spec["requests"][0])
+    second = json.loads(ok_spec["requests"][0])
+    # the first attempt carries (approximately) the full 500ms budget,
+    # the second only what the slow 503 left over
+    assert first["parameters"]["timeout"] > 400000
+    assert 0 < second["parameters"]["timeout"] < 250000
+    assert second["parameters"]["timeout"] < first["parameters"]["timeout"]
+
+
+def test_deadline_propagation_reaches_replica_expiry_path(
+        mini_replicas, make_router, fleet):
+    """End-to-end: a router-relayed request whose first attempt burned
+    most of its budget reaches the REAL replica with the shrunk
+    timeout and dies on the replica's own deadline-expiry path (504).
+    The control leg proves the same request succeeds on the full
+    budget — only the propagated shrink makes it expire."""
+    slow_url, _spec = mini_replicas(delay_s=0.45, status=503)
+    real_url = fleet["backends"][0]
+    # control: full budget straight at the real replica through a
+    # router with no budget burned — DELAY_US=80ms fits 500ms easily
+    request = {
+        "inputs": [
+            {"name": "INPUT0", "shape": [4], "datatype": "INT32",
+             "data": [1, 2, 3, 4]},
+            {"name": "DELAY_US", "shape": [1], "datatype": "UINT32",
+             "data": [80000]},
+        ],
+        "parameters": {"timeout": 500000},
+    }
+    body = json.dumps(request).encode()
+    control = make_router([real_url], read_timeout_s=5.0)
+    status, _h, _b = control.forward_unary(
+        "POST", "/v2/models/delayed_identity/infer", body,
+        {"Content-Type": "application/json"})
+    assert status == 200
+    # the pin: the slow 503 burns ~450ms of the 500ms budget, the
+    # failover lands on the real replica with ~50ms — the 80ms compute
+    # crosses the PROPAGATED deadline: the client gets a typed 504,
+    # and the REPLICA's own deadline-expiry path fires on the shrunk
+    # budget (its 504 error counter moves — without the rewrite the
+    # 80ms compute would sit comfortably inside the original 500ms)
+    def replica_504s():
+        host, _, port = real_url.rpartition(":")
+        conn = http_client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode("utf-8")
+        finally:
+            conn.close()
+        return sum(
+            float(line.rsplit(" ", 1)[1])
+            for line in text.splitlines()
+            if line.startswith("tpu_request_errors_total")
+            and 'code="504"' in line)
+
+    before_504 = replica_504s()
+    router = make_router([slow_url, real_url], read_timeout_s=5.0)
+    status, _h, resp_body = router.forward_unary(
+        "POST", "/v2/models/delayed_identity/infer", body,
+        {"Content-Type": "application/json"})
+    assert status == 504, resp_body
+    assert b"deadline" in resp_body.lower()
+    assert _wait_until(lambda: replica_504s() == before_504 + 1)
+
+
+def test_ejected_probe_is_shadowed_and_measures_the_gray_replica(
+        mini_replicas, make_router):
+    """A probe routed to a soft-ejected replica launches an immediate
+    backup on a healthy one: the client sees the healthy latency (the
+    probe fraction never reappears in fleet p99) while the probe's own
+    service time still lands in the ejected replica's digest — the
+    sample re-admission is judged on."""
+    gray_url, gray_spec = mini_replicas(delay_s=0.4)
+    ok_url, _ok_spec = mini_replicas()
+    router = make_router([gray_url, ok_url], probe_fraction=1.0,
+                         read_timeout_s=5.0)
+    router.replica_by_url(gray_url).soft_eject()
+    t0 = time.monotonic()
+    status, _headers, body = router.forward_unary(
+        "POST", "/v2/models/stub/infer", b"{}", {})
+    elapsed = time.monotonic() - t0
+    assert status == 200
+    assert json.loads(body)["served_by"] == int(ok_url.rsplit(":")[-1])
+    assert elapsed < 0.3, "probe slowness leaked to the client"
+    # the gray replica WAS probed with real traffic, and its sample
+    # lands once the abandoned connection drains
+    assert len(gray_spec["requests"]) == 1
+    assert _wait_until(
+        lambda: _status_of(router, gray_url)["digest"].get(
+            "infer", {}).get("samples") == 1, timeout_s=2.0)
+    # probes are not hedges: the outcome counters stay untouched
+    assert router.stats()["hedges"] == 0

@@ -23,6 +23,7 @@ Usage:
     python tools/chaos_smoke.py --shm [--rounds N]
     python tools/chaos_smoke.py --router [--cycles N] [--soak M]
     python tools/chaos_smoke.py --fleet [--cycles N] [--soak M]
+    python tools/chaos_smoke.py --gray [--cycles N] [--soak M]
 
 ``--kill-loop`` soaks the supervised-restart layer: every round kills
 the decode loop mid-traffic (injected step failure = loop death) while
@@ -57,6 +58,14 @@ tokens identical to the fault-free reference with gap-free
 duplicate-free seqs (the router's handoff absorbs the kill), and the
 supervisor restores the fleet to its target replica count — with live
 router membership — before the next cycle.
+
+``--gray`` soaks the tail-latency defense (ISSUE 13): a FleetRouter
+over stdlib stub replicas with one replica turned GRAY — alive to
+every health probe, two orders of magnitude slower to serve — each
+cycle.  Invariants: the router soft-ejects it on the latency
+differential alone, fleet p99 returns to within 2x of the healthy
+baseline while the fault is still active, zero user-visible errors,
+and the replica re-admits itself via probe traffic once it recovers.
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -1067,6 +1076,223 @@ def shm_phase(rounds, slots, budget):
     xshm.destroy_shared_memory_region(ring)
 
 
+def gray_phase(cycles, soak):
+    """``--gray``: gray-failure ejection soak (tail-latency defense).
+
+    A FleetRouter fronts three stdlib STUB replicas (tests/
+    fleet_stub.py — no jax import, per the tier-1 runtime budget) with
+    baseline latency jitter.  Each cycle one replica turns GRAY — it
+    keeps answering health probes but serves ``/infer`` two orders of
+    magnitude slower (``POST /stub/state {"infer_delay_ms": ...}``,
+    the stub twin of arming ``scheduler.step@scope`` with the
+    ``slow`` fault mode on a real replica) — while plain unary
+    traffic keeps flowing through the router.  Invariants:
+
+      1. the router SOFT-EJECTS the gray replica (its ``/router/stats``
+         row reads ``soft-ejected`` and ``tpu_router_ejections_total``
+         moves on ``/metrics``) without any health signal changing;
+      2. fleet p99 over the post-ejection window returns to within 2x
+         of the healthy baseline (ejected-replica probes are shadowed,
+         so the probe fraction never reappears in the tail);
+      3. ZERO user-visible errors at any point;
+      4. after the fault clears, probe traffic re-admits the replica
+         (status back to ``ok``) — no operator, no restart.
+    """
+    import http.client
+    import json as _json
+    import subprocess
+
+    from perfanalyzer.metrics import percentile
+    from tpuserver.router import FleetRouter
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub_path = os.path.join(repo, "tests", "fleet_stub.py")
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from fleet_stub import free_port, wait_ready
+
+    ports = [free_port() for _ in range(3)]
+    procs = [
+        subprocess.Popen([
+            sys.executable, stub_path, "--port", str(p),
+            "--infer-jitter-ms", "2",
+        ])
+        for p in ports
+    ]
+    urls = ["127.0.0.1:{}".format(p) for p in ports]
+    infer_body = _json.dumps({"inputs": [
+        {"name": "INPUT0", "datatype": "FP32", "shape": [8],
+         "data": [0.0] * 8}]}).encode("utf-8")
+
+    def set_state(port, **state):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+        try:
+            conn.request("POST", "/stub/state", _json.dumps(state),
+                         {"Content-Type": "application/json"})
+            if conn.getresponse().status != 200:
+                fail("gray: stub state update refused")
+        finally:
+            conn.close()
+
+    def infer_once(router):
+        """One unary infer through the router: latency seconds, or
+        None on a user-visible error (the invariant-3 signal)."""
+        host, _, port = router.url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=30)
+        t0 = time.monotonic()
+        try:
+            conn.request("POST", "/v2/models/stub/infer", infer_body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                fail("gray: user-visible error {}: {}".format(
+                    resp.status, body[:200]))
+                return None
+            return time.monotonic() - t0
+        except (OSError, http.client.HTTPException) as e:
+            fail("gray: user-visible transport error: {}".format(e))
+            return None
+        finally:
+            conn.close()
+
+    def drive(router, n, workers=4):
+        """``n`` requests spread over concurrent workers (sequential
+        clients all tie at load 0 and pile onto one replica — the
+        in-flight spread is what gives every replica digest coverage,
+        exactly like production concurrency would)."""
+        lats = []
+        lock = threading.Lock()
+
+        def worker(count):
+            for _ in range(count):
+                lat = infer_once(router)
+                if lat is not None:
+                    with lock:
+                        lats.append(lat)
+
+        per = max(1, n // workers)
+        threads = [threading.Thread(target=worker, args=(per,),
+                                    daemon=True)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return lats
+
+    def victim_row(router, url):
+        for row in router.stats()["replicas"]:
+            if row["url"] == url:
+                return row
+        return None
+
+    def ejections_metric(router):
+        text = router.metrics_text()
+        for line in text.splitlines():
+            if line.startswith("tpu_router_ejections_total"):
+                return float(line.split()[-1])
+        return None
+
+    try:
+        for p in ports:
+            if not wait_ready(p):
+                fail("gray: stub replica never became ready")
+                return
+        # fast knobs so each cycle's eject->recover->re-admit arc fits
+        # a soak budget: small digest, quarter probe fraction, 10 Hz
+        # probes driving the (0.1s-throttled) ejection evaluation
+        router = FleetRouter(
+            urls, probe_interval_s=0.1, outlier_factor=3.0,
+            outlier_min_samples=6, min_eligible=1,
+            probe_fraction=1.0 / 4, eject_interval_s=0.1,
+            digest_window=12).start()
+        try:
+            drive(router, 12)  # connection/thread warmup out of baseline
+            for cycle in range(cycles):
+                victim = ports[cycle % len(ports)]
+                victim_url = "127.0.0.1:{}".format(victim)
+                baseline = drive(router, soak)
+                if not baseline:
+                    return
+                healthy_p99 = percentile(baseline, 99)
+                ejections_before = ejections_metric(router)
+                set_state(victim, infer_delay_ms=200)
+                # traffic under the gray fault: the router needs
+                # enough completed requests to see the outlier
+                deadline = time.monotonic() + 30.0
+                ejected = False
+                while time.monotonic() < deadline:
+                    drive(router, 6)
+                    row = victim_row(router, victim_url)
+                    if row is not None and row["status"] == "soft-ejected":
+                        ejected = True
+                        break
+                if not ejected:
+                    fail("gray cycle {}: router never soft-ejected the "
+                         "slow replica".format(cycle))
+                    set_state(victim, infer_delay_ms=0)
+                    continue
+                row = victim_row(router, victim_url)
+                if not row["eligible"]:
+                    fail("gray cycle {}: ejection leaked into health "
+                         "eligibility (gray != down)".format(cycle))
+                after = ejections_metric(router)
+                if ejections_before is not None and (
+                        after is None or after <= ejections_before):
+                    fail("gray cycle {}: tpu_router_ejections_total did "
+                         "not move ({} -> {})".format(
+                             cycle, ejections_before, after))
+                # invariant 2: the tail recovers while the fault is
+                # STILL active — ejection (plus shadowed probes) is
+                # what defends p99, not the fault clearing
+                # within 2x of healthy (floored at 50ms of noise
+                # headroom) AND strictly under the injected 200ms
+                # delay — a single un-shadowed request to the gray
+                # replica in the window would break the latter, so a
+                # noisy healthy baseline can never mask a defense that
+                # is not actually working.  One re-measure absorbs a
+                # lone scheduler spike on a loaded CI box; a real
+                # defense failure repeats.
+                bound = min(max(2 * healthy_p99, 0.05), 0.18)
+                p99 = None
+                for _attempt in range(2):
+                    recovered = drive(router, soak)
+                    if not recovered:
+                        break
+                    p99 = percentile(recovered, 99)
+                    if p99 <= bound:
+                        break
+                if p99 is not None and p99 > bound:
+                    fail("gray cycle {}: fleet p99 {:.1f}ms did not "
+                         "recover (healthy baseline {:.1f}ms, bound "
+                         "{:.1f}ms)".format(
+                             cycle, p99 * 1e3, healthy_p99 * 1e3,
+                             bound * 1e3))
+                # recovery: clear the fault, probe traffic re-admits
+                set_state(victim, infer_delay_ms=0)
+                deadline = time.monotonic() + 30.0
+                readmitted = False
+                while time.monotonic() < deadline:
+                    drive(router, 8)
+                    row = victim_row(router, victim_url)
+                    if row is not None and row["status"] == "ok":
+                        readmitted = True
+                        break
+                if not readmitted:
+                    fail("gray cycle {}: replica never re-admitted "
+                         "after the fault cleared".format(cycle))
+                print("gray cycle {}: ejected + p99 recovered + "
+                      "re-admitted (healthy p99 {:.1f}ms)".format(
+                          cycle, healthy_p99 * 1e3), flush=True)
+        finally:
+            router.stop()
+    finally:
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            proc.wait(timeout=10)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -1094,6 +1320,13 @@ def main():
                              "kill the decode loop mid-traffic every "
                              "round, assert auto-restart with zero lost "
                              "or corrupted streams")
+    parser.add_argument("--gray", action="store_true",
+                        help="soak the gray-failure ejection layer "
+                             "instead: a stub-fleet router with one "
+                             "replica turned slow-but-alive mid-soak; "
+                             "asserts soft-ejection, p99 recovery "
+                             "within 2x of healthy, zero user-visible "
+                             "errors, and re-admission on recovery")
     parser.add_argument("--shm", action="store_true",
                         help="soak the shm data plane instead: token-"
                              "ring streams + park-export/attach-resume "
@@ -1107,6 +1340,24 @@ def main():
                              "40 in pool mode, 6 full generations in "
                              "router mode)")
     args = parser.parse_args()
+
+    if args.gray:
+        t0 = time.monotonic()
+        # a wide per-window sample keeps p99 meaningful: one stray
+        # scheduling spike on a loaded CI box must not be the 99th
+        # percentile of the whole window
+        gray_phase(args.cycles,
+                   args.soak if args.soak is not None else 160)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\ngray chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\ngray chaos smoke OK: {} gray cycles, {:.1f}s, "
+              "soft-ejection + p99 recovery + re-admission, zero "
+              "user-visible errors".format(args.cycles, elapsed))
+        return 0
 
     if args.shm:
         t0 = time.monotonic()

@@ -52,6 +52,29 @@ def main(argv=None):
                          "must not exceed the workload's SHARED prefix "
                          "length (default 16 = one KV page, the "
                          "smallest radix-shareable prefix)")
+    ap.add_argument("--outlier-factor", type=float, default=3.0,
+                    help="gray-failure ejection: soft-eject a replica "
+                         "whose recent p90 exceeds this multiple of "
+                         "the fleet median (default 3.0; <=0 keeps "
+                         "the default)")
+    ap.add_argument("--outlier-min-samples", type=int, default=16,
+                    help="digest samples required before a replica "
+                         "can be judged an outlier (default 16)")
+    ap.add_argument("--min-eligible", type=int, default=1,
+                    help="ejection never leaves fewer than this many "
+                         "healthy un-ejected replicas: degrade to "
+                         "slow, never to unavailable (default 1)")
+    ap.add_argument("--probe-fraction", type=float, default=1.0 / 16,
+                    help="share of traffic routed to a soft-ejected "
+                         "replica as its real-traffic re-admission "
+                         "probe (default 1/16)")
+    ap.add_argument("--hedge-delay", type=float, default=None,
+                    help="hedged unary requests (seconds; default "
+                         "off): an idempotent attempt still pending "
+                         "after the primary's rolling p95 — floored "
+                         "at this value, which alone applies while "
+                         "the digest is cold — races a duplicate on "
+                         "a different replica")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -69,6 +92,12 @@ def main(argv=None):
         gen_capacity=args.gen_capacity,
         affinity_bonus=args.affinity_bonus,
         affinity_prefix_tokens=args.affinity_prefix_tokens,
+        outlier_factor=(args.outlier_factor if args.outlier_factor > 0
+                        else 3.0),
+        outlier_min_samples=args.outlier_min_samples,
+        min_eligible=args.min_eligible,
+        probe_fraction=args.probe_fraction,
+        hedge_delay_s=args.hedge_delay,
         verbose=args.verbose,
     ).start()
 
