@@ -534,10 +534,11 @@ class HttpBackend(_TritonClientShmMixin, ClientBackend):
             "resumed_streams": _coerce_int(snap.get("resumed_streams")),
             "shed": _coerce_int(snap.get("shed")),
         }
-        # tail-latency defense counters: present only on routers that
-        # carry them, so the delta attach can tell "zero events" from
-        # "router predates the counters"
-        for key in ("ejections", "hedges"):
+        # tail-latency defense + router-HA counters: present only on
+        # routers that carry them, so the delta attach can tell "zero
+        # events" from "router predates the counters"
+        for key in ("ejections", "hedges", "takeovers",
+                    "recovered_generations"):
             if key in snap:
                 out[key] = _coerce_int(snap.get(key))
         supervisor = snap.get("supervisor")

@@ -221,11 +221,15 @@ def attach_router_delta(result, before, after):
         return
     for key in ("failovers", "handoffs", "resumed_streams", "shed"):
         result["router_" + key] = after[key] - before[key]
-    # tail-latency defense counters (gray-failure soft-ejections and
-    # hedge fires) diff the same way — guarded presence-in-both like
-    # the supervisor counters so a snapshot from a router predating
-    # them never fabricates a delta
-    for key in ("ejections", "hedges"):
+    # tail-latency defense (gray-failure soft-ejections, hedge fires)
+    # and router-HA (standby takeovers, journal-recovered generations)
+    # counters diff the same way — guarded presence-in-both like the
+    # supervisor counters so a snapshot from a router predating them
+    # never fabricates a delta.  A nonzero takeover delta means the
+    # FRONT TIER failed over under this level and every request still
+    # in the window rode it out.
+    for key in ("ejections", "hedges", "takeovers",
+                "recovered_generations"):
         if key in before and key in after:
             result["router_" + key] = after[key] - before[key]
     for key in SUPERVISOR_COUNTERS:

@@ -161,6 +161,14 @@ class ReportWriter:
                     line += " ejections={} hedges={}".format(
                         r.get("router_ejections"),
                         r.get("router_hedges"))
+                if r.get("router_takeovers") is not None:
+                    # router HA: a nonzero takeover delta means the
+                    # FRONT TIER itself failed over (standby promoted)
+                    # under this level; recovered counts the
+                    # generations the journal rebuilt for resumes
+                    line += " takeovers={} recovered={}".format(
+                        r.get("router_takeovers"),
+                        r.get("router_recovered_generations"))
                 if r.get("supervisor_replica_restarts") is not None:
                     # a supervised fleet sits behind the router: its
                     # per-window process-healing counters ride along —

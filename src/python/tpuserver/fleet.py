@@ -36,27 +36,43 @@ the unit that survives, not any single replica:
    *sustained* idleness drains one replica down, a middle-band reading
    resets both streaks, and a cooldown follows every action — a single
    noisy window can never flap the fleet.
+5. **A supervised front tier** (``router_command=``).  Every guarantee
+   above flows through the router — so the router process itself gets
+   the same treatment the replicas do: the supervisor spawns it (with
+   ``--journal`` for crash-durable sticky state), probes it, heals a
+   wedge drain-first under the same restart budget, and retires it on
+   exhaustion.  With ``router_standby=True`` a second router process
+   tails the same journal as a warm standby; on active-router death
+   the supervisor PROMOTES the standby (``POST /router/promote`` — one
+   reconnect for clients carrying both urls, never a lost stream) and
+   respawns the casualty as the new standby.  Without a standby the
+   active respawns on its own port with ``--journal``, recovering the
+   sticky registry from disk.  Router ports are stable across every
+   restart and role swap, so a client's url list never goes stale.
 
 ``tools/fleet.py`` is the CLI (and the default replica entry point);
-``tools/chaos_smoke.py --fleet`` soaks SIGKILL-mid-traffic healing;
-docs/resilience.md "Fleet supervisor & elastic scaling" has the full
-semantics.
+``tools/chaos_smoke.py --fleet`` / ``--router-kill`` soak
+SIGKILL-mid-traffic healing of replicas and the front tier;
+docs/resilience.md "Fleet supervisor & elastic scaling" and "Router HA
+& state durability" have the full semantics.
 """
 
 import http.client
 import json
 import os
+import shutil
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 from collections import deque
 
 from tpuserver.router import FleetRouter
 
-__all__ = ["FleetSupervisor", "ReplicaProcess"]
+__all__ = ["FleetSupervisor", "ReplicaProcess", "RouterProcess"]
 
 
 def _free_port(host):
@@ -172,6 +188,95 @@ class ReplicaProcess:
             }
 
 
+class RouterProcess:
+    """One supervised router process — the ACTIVE front tier or its
+    warm STANDBY.  Same healing state machine as a replica
+    (``starting`` → ``up`` → ``stopping``/``backoff`` → … →
+    ``retired``); the ``role`` swaps on takeover while the port stays
+    stable, so a client's url list never goes stale."""
+
+    def __init__(self, role, host, port):
+        self.host = host
+        self.port = port
+        self.url = "{}:{}".format(host, port)
+        self._lock = threading.Lock()
+        self.role = role           # guarded-by: _lock
+        self.proc = None           # guarded-by: _lock
+        self.state = "starting"    # guarded-by: _lock
+        self.restarts = 0          # guarded-by: _lock
+        self.started_at = 0.0      # guarded-by: _lock
+        self.stop_deadline = 0.0   # guarded-by: _lock
+        self.spawn_at = 0.0        # guarded-by: _lock
+        self.probe_failures = 0    # guarded-by: _lock
+        self.restart_times = deque()  # guarded-by: _lock
+
+    def stats(self):
+        with self._lock:
+            return {
+                "role": self.role,
+                "url": self.url,
+                "state": self.state,
+                "pid": self.proc.pid if self.proc is not None else None,
+                "restarts": self.restarts,
+            }
+
+
+class _RouterAdminClient:
+    """The in-process :class:`~tpuserver.router.FleetRouter` surface
+    the supervisor (and its tests/tools) use, spoken over HTTP to
+    supervised router PROCESSES: ``url`` tracks the active router
+    across takeovers, membership mutations broadcast to active and
+    standby (the standby keeps its membership live too), reads go to
+    the active."""
+
+    def __init__(self, supervisor):
+        self._sup = supervisor
+
+    @property
+    def url(self):
+        return self._sup.active_router_url()
+
+    @property
+    def port(self):
+        return int(self.url.rpartition(":")[2])
+
+    def start(self):
+        return self  # the supervisor owns the processes
+
+    def stop(self):
+        pass
+
+    def attach_supervisor(self, stats_fn):
+        pass  # cross-process: /router/stats cannot call back in-process
+
+    def _get(self, path):
+        host, _, port = self.url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return json.loads(resp.read())
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    def stats(self):
+        return self._get("/router/stats") or {}
+
+    def membership(self):
+        got = self._get("/router/replicas")
+        return (got or {}).get("replicas", [])
+
+    def add_replica(self, url):
+        self._sup._router_membership_post("add", url)
+
+    def remove_replica(self, url):
+        self._sup._router_membership_post("remove", url)
+
+
 class FleetSupervisor:
     """Own N replica server processes end-to-end and front them with a
     dynamically-membered :class:`~tpuserver.router.FleetRouter`.
@@ -208,7 +313,30 @@ class FleetSupervisor:
         next one may fire — boot transients never read as pressure.
     router_kwargs
         Extra :class:`FleetRouter` construction kwargs (e.g.
-        ``probe_interval_s``, ``max_inflight``, ``port``).
+        ``probe_interval_s``, ``max_inflight``, ``port``) — the
+        in-process router mode.
+    router_command
+        Opt-in SUPERVISED FRONT TIER: an argv template for a router
+        *process* (``{port}``, ``{backends}``, ``{journal}``
+        substituted per spawn — see ``tools/fleet.py
+        --router-processes`` for the default built on
+        ``tools/router.py``).  The supervisor spawns, probes, and
+        heals the router under the same drain-first, restart-budgeted,
+        retire-on-exhaustion policy replicas get; ``self.router``
+        becomes an HTTP admin shim with the same surface.  None
+        (default) keeps the in-process FleetRouter.
+    router_standby
+        With ``router_command``: also run a warm-standby router
+        process tailing the same journal; on active death the standby
+        is PROMOTED (and the casualty respawns as the new standby).
+    router_journal
+        The journal directory both router processes share.  Default: a
+        fresh temporary directory owned (and removed) by the
+        supervisor.
+    router_port / standby_port
+        Stable listen ports for the two router processes (0 = pick a
+        free one at construction; the port then stays stable across
+        restarts and role swaps).
     env
         Extra environment for replica processes (merged over
         ``os.environ``).
@@ -223,7 +351,9 @@ class FleetSupervisor:
                  scale_high=0.85, scale_low=0.10,
                  scale_up_windows=3, scale_down_windows=6,
                  scale_cooldown_s=2.0, scope_prefix="fleet-r",
-                 router_kwargs=None, env=None, verbose=False):
+                 router_kwargs=None, env=None, verbose=False,
+                 router_command=None, router_standby=False,
+                 router_journal=None, router_port=0, standby_port=0):
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
         if min_replicas < 1 or (max_replicas is not None
@@ -267,6 +397,10 @@ class FleetSupervisor:
         self._scale_ups = 0        # guarded-by: _lock
         self._scale_downs = 0      # guarded-by: _lock
         self._retired = 0          # guarded-by: _lock
+        # front-tier healing counters (router_command mode)
+        self._router_restarts = 0  # guarded-by: _lock
+        self._router_takeovers = 0  # guarded-by: _lock
+        self._router_retired = 0   # guarded-by: _lock
         self._up_streak = 0
         self._down_streak = 0
         self._cooldown_until = 0.0
@@ -274,14 +408,41 @@ class FleetSupervisor:
         self._monitor = None
         for _ in range(int(replicas)):
             self._register_handle()
-        self.router = FleetRouter(
-            [h.url for h in self._handles_snapshot()],
-            **dict(router_kwargs or {}))
-        self.router.attach_supervisor(self.stats)
-        # the initial handles ARE the router's constructed membership;
-        # record that so a replica dying before its first ready probe
-        # still leaves the routing set instead of lingering as a stale
-        # member
+        self._router_command = (list(router_command)
+                                if router_command else None)
+        self._router_standby = bool(router_standby)
+        self._journal_tmp = None
+        self._router_journal = router_journal
+        # router PROCESS handles (router_command mode); role swaps on
+        # takeover, the list itself is fixed at construction
+        # guarded-by: _lock
+        self._router_handles = []
+        if self._router_command is not None:
+            # the supervised front tier: router processes sharing one
+            # crash journal, fronted to callers by the admin shim
+            if self._router_journal is None:
+                self._journal_tmp = tempfile.mkdtemp(
+                    prefix="tpu-router-journal-")
+                self._router_journal = self._journal_tmp
+            handles = [RouterProcess(
+                "active", host, int(router_port) or _free_port(host))]
+            if self._router_standby:
+                handles.append(RouterProcess(
+                    "standby", host,
+                    int(standby_port) or _free_port(host)))
+            with self._lock:
+                self._router_handles = handles
+            self.router = _RouterAdminClient(self)
+        else:
+            self.router = FleetRouter(
+                [h.url for h in self._handles_snapshot()],
+                **dict(router_kwargs or {}))
+            self.router.attach_supervisor(self.stats)
+        # the initial handles ARE the router's constructed membership
+        # (in-process construction list / the spawned router's
+        # --backends); record that so a replica dying before its first
+        # ready probe still leaves the routing set instead of
+        # lingering as a stale member
         for handle in self._handles_snapshot():
             with handle._lock:
                 handle.in_router = True
@@ -308,6 +469,8 @@ class FleetSupervisor:
     def start(self):
         for handle in self._handles_snapshot():
             self._spawn(handle)
+        for rhandle in self._router_handles_snapshot():
+            self._spawn_router(rhandle)
         self.router.start()
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="fleet-supervisor",
@@ -316,21 +479,28 @@ class FleetSupervisor:
         return self
 
     def stop(self, drain_timeout_s=None):
-        """Stop the fleet: SIGTERM every live replica (drain-first),
-        SIGKILL whatever outlives the grace window, stop the router."""
+        """Stop the fleet: SIGTERM every live replica AND router
+        process (drain-first — the router flushes its journal inside
+        the grace window), SIGKILL whatever outlives it."""
         self._stop.set()
         if self._monitor is not None:
             self._monitor.join(timeout=10)
             self._monitor = None
         grace = (self._drain_grace_s if drain_timeout_s is None
                  else drain_timeout_s)
-        handles = self._handles_snapshot()
+        handles = self._handles_snapshot() + self._router_handles_snapshot()
         for handle in handles:
             self._signal(handle, signal.SIGTERM)
         deadline = time.monotonic() + grace
         for handle in handles:
             self._reap(handle, deadline - time.monotonic())
+        for handle in self._router_handles_snapshot():
+            # past-grace stragglers: the reap's kill covered them, but
+            # an unkillable process must not wedge shutdown
+            self._signal(handle, signal.SIGKILL)
         self.router.stop()
+        if self._journal_tmp is not None:
+            shutil.rmtree(self._journal_tmp, ignore_errors=True)
 
     def wait_ready(self, count=None, timeout_s=60.0):
         """Block until ``count`` replicas (default: every non-retired
@@ -425,6 +595,267 @@ class FleetSupervisor:
         except ValueError:
             pass  # already a member (initial membership)
 
+    # -- the supervised front tier (router_command mode) -------------------
+
+    def _router_handles_snapshot(self):
+        with self._lock:
+            return list(self._router_handles)
+
+    def active_router_url(self):
+        """The ACTIVE router's stable address (in-process mode: the
+        embedded router's).  When no handle holds the active role —
+        e.g. the active retired while its standby was down — prefer a
+        LIVE handle over list order: admin reads against a corpse
+        would answer nothing forever while a serving peer exists."""
+        handles = self._router_handles_snapshot()
+        if not handles:
+            return self.router.url  # in-process FleetRouter
+        rows = [(h, h.stats()) for h in handles]
+        for handle, st in rows:
+            if st["role"] == "active" and st["state"] != "retired":
+                return handle.url
+        for handle, st in rows:
+            if st["state"] == "up":
+                return handle.url
+        return handles[0].url
+
+    def router_urls(self):
+        """Every router address, active first — the url list clients
+        carry so a takeover costs one reconnect (the auto-resume
+        helpers' ``fallback_urls``)."""
+        handles = self._router_handles_snapshot()
+        if not handles:
+            return [self.router.url]
+        ordered = sorted(
+            handles, key=lambda h: h.stats()["role"] != "active")
+        return [h.url for h in ordered]
+
+    def _router_argv(self, handle):
+        backends = ",".join(
+            h.url for h in self._handles_snapshot()
+            if h.stats()["state"] != "retired")
+        argv = [
+            t.format(port=handle.port, backends=backends,
+                     journal=self._router_journal)
+            for t in self._router_command
+        ]
+        if handle.stats()["role"] == "standby":
+            argv.append("--standby")
+        return argv
+
+    def _spawn_router(self, handle):
+        argv = self._router_argv(handle)
+        env = dict(os.environ)
+        env.update(self._env)
+        try:
+            proc = subprocess.Popen(argv, env=env)
+        except OSError as e:
+            self._log("spawn of router {} failed: {}".format(
+                handle.url, e))
+            proc = None
+        now = time.monotonic()
+        with handle._lock:
+            role = handle.role
+            handle.proc = proc
+            handle.state = "starting"
+            handle.started_at = now
+            handle.probe_failures = 0
+        self._log("spawned {} router {} (pid {})".format(
+            role, handle.url, proc.pid if proc else "-"))
+
+    def _router_membership_post(self, action, url):
+        """Apply one membership mutation to EVERY live router process
+        (the standby keeps its membership warm too).  A router that is
+        down simply misses the post — its respawn rebuilds
+        ``--backends`` from the current handle set."""
+        body = json.dumps({"action": action, "url": url})
+        for handle in self._router_handles_snapshot():
+            if handle.stats()["state"] not in ("up", "starting"):
+                continue
+            conn = http.client.HTTPConnection(
+                handle.host, handle.port, timeout=self._probe_timeout_s)
+            try:
+                conn.request("POST", "/router/replicas", body,
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            except (OSError, http.client.HTTPException):
+                pass
+            finally:
+                conn.close()
+
+    def _promote_standby(self, handle):
+        """POST the takeover signal to a standby router; True when the
+        promotion was acknowledged."""
+        conn = http.client.HTTPConnection(
+            handle.host, handle.port, timeout=self._probe_timeout_s)
+        try:
+            conn.request("POST", "/router/promote", b"{}",
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            # a 200 from an already-active router counts too: the
+            # takeover's goal state (an active on that address) holds
+            return resp.status == 200
+        except (OSError, http.client.HTTPException):
+            return False
+        finally:
+            conn.close()
+
+    def _router_takeover(self, casualty, alive):
+        """The active router died (or wedged): promote the warm
+        standby when one is up — clients carrying both urls reconnect
+        once and resume against journal-recovered state — and re-roll
+        the casualty as the NEW standby; otherwise the casualty simply
+        respawns active with ``--journal`` and recovers from disk."""
+        standby = None
+        for handle in self._router_handles_snapshot():
+            if handle is casualty:
+                continue
+            st = handle.stats()
+            if st["role"] == "standby" and st["state"] == "up":
+                standby = handle
+                break
+        if standby is not None and alive:
+            # single-writer discipline: a wedged-but-RUNNING active
+            # may still be appending to the journal, and the promoted
+            # standby is about to open its own writer — draining the
+            # casualty here would interleave two writers in one
+            # directory.  A wedged router's streams are already lost
+            # to their clients (that is what the probe failures mean);
+            # resuming them through the new active IS the recovery
+            # path, so the casualty goes down hard, and the promote
+            # only fires once its process is provably gone.
+            self._begin_router_restart(casualty, "wedged", drain=False)
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                with casualty._lock:
+                    proc = casualty.proc
+                if proc is None or proc.poll() is not None:
+                    break
+                time.sleep(0.02)
+        if standby is not None and self._promote_standby(standby):
+            with standby._lock:
+                standby.role = "active"
+            with casualty._lock:
+                casualty.role = "standby"
+            with self._lock:
+                self._router_takeovers += 1
+            self._log(
+                "router takeover: standby {} promoted to active; {} "
+                "will respawn as the new standby".format(
+                    standby.url, casualty.url))
+        if alive:
+            if standby is None:
+                # no standby to protect: drain first (the router
+                # flushes its journal on SIGTERM), SIGKILL past the
+                # grace window — there is no second writer to race
+                self._begin_router_restart(casualty, "wedged",
+                                           drain=True)
+        else:
+            self._finish_router_stop(casualty, time.monotonic())
+
+    def _begin_router_restart(self, handle, reason, drain):
+        self._log("restarting router {} ({}{})".format(
+            handle.url, reason, ", drain-first" if drain else ""))
+        now = time.monotonic()
+        with handle._lock:
+            handle.state = "stopping"
+            handle.stop_deadline = now + (self._drain_grace_s
+                                          if drain else 0.0)
+        self._signal(handle,
+                     signal.SIGTERM if drain else signal.SIGKILL)
+
+    def _finish_router_stop(self, handle, now):
+        """The router process is gone: retire on an exhausted budget,
+        else schedule the respawn with backoff (same sliding-window
+        policy the replicas get)."""
+        with handle._lock:
+            window = handle.restart_times
+            while window and now - window[0] > self._restart_window_s:
+                window.popleft()
+            if len(window) >= self._max_restarts:
+                handle.state = "retired"
+                retired = True
+            else:
+                window.append(now)
+                handle.restarts += 1
+                handle.state = "backoff"
+                handle.spawn_at = now + self._restart_backoff_s * (
+                    2 ** max(0, len(window) - 1))
+                retired = False
+        with self._lock:
+            if retired:
+                self._router_retired += 1
+            else:
+                self._router_restarts += 1
+        if retired:
+            self._log(
+                "router {} exhausted its restart budget ({} in {}s) — "
+                "retired; the front tier degrades to its peer".format(
+                    handle.url, self._max_restarts,
+                    self._restart_window_s))
+
+    def _tick_routers(self, now):
+        for handle in self._router_handles_snapshot():
+            with handle._lock:
+                state = handle.state
+                role = handle.role
+                proc = handle.proc
+                stop_deadline = handle.stop_deadline
+                spawn_at = handle.spawn_at
+                started_at = handle.started_at
+            if state == "retired":
+                continue
+            exited = proc is None or proc.poll() is not None
+            if state == "stopping":
+                if exited:
+                    self._finish_router_stop(handle, now)
+                elif now >= stop_deadline:
+                    self._signal(handle, signal.SIGKILL)
+                continue
+            if state == "backoff":
+                if now >= spawn_at:
+                    self._spawn_router(handle)
+                continue
+            if exited:
+                # unplanned death (SIGKILL/crash): an active's standby
+                # promotes NOW — the takeover, the whole point of the
+                # warm copy — and the casualty respawns as standby
+                if role == "active":
+                    self._router_takeover(handle, alive=False)
+                else:
+                    self._finish_router_stop(handle, now)
+                continue
+            snap = _fetch_health(handle.host, handle.port,
+                                 self._probe_timeout_s)
+            if snap is None:
+                with handle._lock:
+                    handle.probe_failures += 1
+                    failures = handle.probe_failures
+                if state == "starting":
+                    if now - started_at > self._start_timeout_s:
+                        self._begin_router_restart(
+                            handle, "never came up", drain=False)
+                elif failures >= self._unhealthy_after:
+                    # alive but not answering: a wedged front tier is
+                    # a total outage — fail over to the standby first,
+                    # then drain-replace the process
+                    if role == "active":
+                        self._router_takeover(handle, alive=True)
+                    else:
+                        self._begin_router_restart(
+                            handle, "wedged", drain=True)
+                continue
+            with handle._lock:
+                handle.probe_failures = 0
+                if handle.state == "starting":
+                    handle.state = "up"
+                    came_up = True
+                else:
+                    came_up = False
+            if came_up:
+                self._log("{} router {} is up".format(role, handle.url))
+
     # -- healing -----------------------------------------------------------
 
     def _begin_restart(self, handle, reason, drain):
@@ -494,6 +925,7 @@ class FleetSupervisor:
 
     def _tick(self):
         now = time.monotonic()
+        self._tick_routers(now)
         utils = []
         for handle in self._handles_snapshot():
             with handle._lock:
@@ -644,6 +1076,16 @@ class FleetSupervisor:
                 "max_replicas": self._max_replicas,
             }
             handles = list(self._handles)
+            router_handles = list(self._router_handles)
+            router_restarts = self._router_restarts
+            router_takeovers = self._router_takeovers
+            router_retired = self._router_retired
         out["replicas"] = [h.stats() for h in handles]
         out["up"] = sum(1 for r in out["replicas"] if r["state"] == "up")
+        if router_handles:
+            # the supervised front tier (router_command mode)
+            out["router_restarts"] = router_restarts
+            out["router_takeovers"] = router_takeovers
+            out["router_retired"] = router_retired
+            out["routers"] = [h.stats() for h in router_handles]
         return out

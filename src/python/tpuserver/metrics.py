@@ -199,6 +199,31 @@ CATALOG = {
         "Rolling per-verb p90 latency per replica from the router's "
         "gray-failure digest (fixed-window, completed requests only; "
         "hedge losers excluded), seconds."),
+    # -- router HA: crash journal + warm standby ---------------------------
+    "tpu_router_journal_records_total": (
+        "counter",
+        "Records written to the crash-durable generation journal "
+        "(bind/home/ev/fin/drop; enqueued lock-free on the relay "
+        "path, framed + fsynced by the writer thread)."),
+    "tpu_router_journal_bytes_total": (
+        "counter",
+        "Bytes appended to the generation journal (length-prefixed + "
+        "checksummed frames)."),
+    "tpu_router_journal_fsyncs_total": (
+        "counter",
+        "fsync batches the journal writer issued (many records "
+        "amortize into one fsync)."),
+    "tpu_router_recovered_generations_total": (
+        "counter",
+        "Generations rebuilt from the journal: boot-time recovery on "
+        "a restarted router plus the warm standby's continuous "
+        "tailing — the state that turns a marked (gen~offset/seq) "
+        "resume from a typed 404 into a served splice."),
+    "tpu_router_takeovers_total": (
+        "counter",
+        "Standby-to-active promotions this router performed (the "
+        "warm-standby takeover signal: POST /router/promote, SIGUSR1, "
+        "or the fleet supervisor on active-router death)."),
     # -- fleet supervisor (process-level healing) --------------------------
     "tpu_fleet_replica_restarts_total": (
         "counter", "Replica processes healed by the supervisor."),

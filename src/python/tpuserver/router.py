@@ -85,6 +85,7 @@ import zlib
 from collections import OrderedDict
 
 from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
+from tpuserver.journal import JournalFollower, JournalWriter, read_journal
 from tpuserver.metrics import (
     MetricsRegistry,
     is_cumulative,
@@ -551,8 +552,24 @@ class _Generation:
             self.deadline = None
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # rendered SSE blocks, list index == router seq  # guarded-by: _lock
+        # rendered SSE blocks; router seq of _events[i] is _base + i.
+        # A LIVE generation always has _base == 0; a generation
+        # rebuilt from the journal may hold only the retained tail
+        # (_base = count of events that aged out with their segments)
+        # # guarded-by: _lock
         self._events = []
+        self._base = 0          # guarded-by: _lock
+        # rebuilt from the crash journal (recovery / standby tailing):
+        # the flag that authorizes fast_forward — a live router's
+        # watermark can never truthfully trail its client's
+        # # guarded-by: _lock
+        self._recovered = False
+        # the router's journal writer (None on journal-less routers
+        # and on standbys): set by register_generation / promote.
+        # Appends are a single lock-free deque.append — the relay hot
+        # path acquires no lock beyond the _lock it already holds.
+        self.journal = None
+        self._journaled_bind = False  # guarded-by: _lock
         # emitted TOKEN ints (None once an event arrives without one:
         # the generation is not handoff-capable)  # guarded-by: _lock
         self._tokens = [] if prompt is not None else None
@@ -595,7 +612,7 @@ class _Generation:
         token = _token_of(payload)
         with self._lock:
             seq = self._offset + int(backend_seq)
-            expected = len(self._events)
+            expected = self._base + len(self._events)
             if seq < expected:
                 return None, None  # upstream replayed an acked event
             params = payload.setdefault("parameters", {})
@@ -603,8 +620,8 @@ class _Generation:
             params["seq"] = expected
             # post-handoff events mark their id line with the handoff
             # epoch ("gen~offset/seq"): router seqs no longer equal the
-            # serving replica's own numbering, and a RESTARTED router
-            # (registry gone) must see that in the client's
+            # serving replica's own numbering, and a router holding no
+            # offset map for the epoch must see that in the client's
             # Last-Event-ID and fail the resume typed instead of
             # forwarding a misaligned replay point to a replica
             gid = (self.gen_id if not self._offset
@@ -614,11 +631,22 @@ class _Generation:
                 + b"data: " + json.dumps(payload).encode("utf-8") + b"\n\n"
             )
             self._events.append(block)
+            # a live relay just confirmed the watermark: fast_forward
+            # disarms — from here a resume point past the watermark is
+            # a client lying, not a crash's lost flush window
+            self._recovered = False
             if self._tokens is not None:
                 if token is None:
                     self._tokens = None  # not re-prefillable
                 else:
                     self._tokens.append(token)
+            journal = self.journal
+            if journal is not None:
+                # enqueue-only durability: one lock-free deque append
+                # under the _lock the relay already holds — framing,
+                # I/O, and fsync happen on the journal's writer thread
+                journal.append({"t": "ev", "gen": self.gen_id,
+                                "seq": expected, "id": gid, "p": payload})
             return expected, block
 
     def mark_unresumable(self):
@@ -628,13 +656,42 @@ class _Generation:
             self._tokens = None
 
     def replay_from(self, from_seq):
-        """``(blocks, completed, next_seq)`` for a client resume."""
+        """``(blocks, completed, next_seq, available)`` for a client
+        resume.  ``available`` is False when ``from_seq`` predates a
+        recovered generation's retained journal tail — the events
+        before ``_base`` aged out with their segments and cannot be
+        replayed."""
         with self._lock:
+            if from_seq < self._base:
+                return [], self._completed, \
+                    self._base + len(self._events), False
             return (
-                list(self._events[from_seq:]),
+                list(self._events[from_seq - self._base:]),
                 self._completed,
-                len(self._events),
+                self._base + len(self._events),
+                True,
             )
+
+    def fast_forward(self, to_seq):
+        """Advance a RECOVERED generation's watermark to a client's
+        resume point that is ahead of the journal's last record: the
+        crash lost the final flush window, but the client provably
+        received those events (its ``Last-Event-ID`` names them) and
+        the home replica still holds them — the upstream resume splice
+        continues from the client's own position.  The skipped span is
+        unreplayable afterwards (``_base`` jumps) and the token
+        history is no longer complete, so handoff capability drops.
+        Refused (False) on live generations — a live router's
+        watermark can never truthfully trail its client's."""
+        with self._lock:
+            if not self._recovered or self._completed:
+                return False
+            if to_seq <= self._base + len(self._events):
+                return False
+            self._base = to_seq
+            self._events = []
+            self._tokens = None
+            return True
 
     # -- home / lifecycle --------------------------------------------------
 
@@ -646,7 +703,19 @@ class _Generation:
             self._home = url
             self._home_lost = False
             if rebase:
-                self._offset = len(self._events)
+                self._offset = self._base + len(self._events)
+            journal = self.journal
+            if journal is not None:
+                if not self._journaled_bind:
+                    self._journaled_bind = True
+                    journal.append({
+                        "t": "bind", "gen": self.gen_id,
+                        "path": self.path, "req": self.request,
+                        "home": url, "offset": self._offset})
+                else:
+                    journal.append({
+                        "t": "home", "gen": self.gen_id,
+                        "home": url, "offset": self._offset})
 
     def home_removed(self, url):
         """The membership dropped ``url``: if it was this generation's
@@ -659,22 +728,80 @@ class _Generation:
 
     def complete(self):
         with self._lock:
+            already = self._completed
             self._completed = True
+            journal = self.journal
+            if journal is not None and not already:
+                journal.append({"t": "fin", "gen": self.gen_id})
 
     def emitted(self):
         with self._lock:
-            return len(self._events)
+            return self._base + len(self._events)
 
     def snapshot(self):
         with self._lock:
             return {
                 "home": self._home,
                 "home_lost": self._home_lost,
-                "seq": len(self._events),
+                "seq": self._base + len(self._events),
                 "offset": self._offset,
                 "completed": self._completed,
                 "handoff_capable": self._tokens is not None,
+                "recovered": self._recovered,
             }
+
+    # -- journal recovery --------------------------------------------------
+
+    @classmethod
+    def from_journal(cls, gen_id, path, request_json):
+        """Rebuild a generation from its journal ``bind`` record.  The
+        original request's deadline is NOT reconstructed — it was
+        anchored to a dead process's monotonic clock; the replicas
+        still enforce their own resolved deadlines."""
+        gen = cls(gen_id, path or "", request_json
+                  if isinstance(request_json, dict) else {})
+        gen.deadline = None
+        with gen._lock:
+            gen._recovered = True
+            # the bind is already durable; re-journaling it on the
+            # first post-recovery set_home would only duplicate it
+            gen._journaled_bind = True
+        return gen
+
+    def apply_home(self, url, offset):
+        """Apply a journal ``bind``/``home`` record: the owning
+        replica and the handoff offset at that point."""
+        with self._lock:
+            self._home = url or None
+            self._home_lost = url is None
+            self._offset = int(offset or 0)
+
+    def apply_event(self, seq, gid, payload):
+        """Apply a journal ``ev`` record, rebuilding the exact SSE
+        block the client saw.  A seq gap (older segments rotated out,
+        or records lost to a crash's final flush window) keeps only
+        the contiguous tail ending at ``seq`` — and drops the token
+        history, which is no longer complete enough to hand off."""
+        with self._lock:
+            watermark = self._base + len(self._events)
+            if seq < watermark:
+                return
+            if seq > watermark:
+                self._base = seq
+                self._events = []
+                self._tokens = None
+            block = (
+                "id: {}/{}\n".format(gid, seq).encode("utf-8")
+                + b"data: " + json.dumps(payload).encode("utf-8")
+                + b"\n\n"
+            )
+            self._events.append(block)
+            if self._tokens is not None:
+                token = _token_of(payload)
+                if token is None:
+                    self._tokens = None
+                else:
+                    self._tokens.append(token)
 
     # -- upstream request builders ----------------------------------------
 
@@ -694,7 +821,8 @@ class _Generation:
             request["parameters"] = params
             headers = {"Content-Type": "application/json"}
             if resuming:
-                backend_last = len(self._events) - self._offset - 1
+                backend_last = (self._base + len(self._events)
+                                - self._offset - 1)
                 headers["Last-Event-ID"] = "{}/{}".format(
                     self.gen_id, backend_last)
             return json.dumps(request).encode("utf-8"), headers
@@ -921,6 +1049,20 @@ class FleetRouter:
         is cold — races a duplicate on the next-ranked different
         replica, first response wins.  Never streams, never
         broadcasts.
+    journal
+        Directory of the crash-durable generation journal
+        (docs/resilience.md "Router HA & state durability").  On
+        :meth:`start` the router replays every retained record —
+        rebuilding sticky bindings, handoff offsets, watermarks, and
+        the relayed-event tail — so marked (``gen~offset/seq``)
+        resumes survive a router restart, then journals all new
+        resume-critical state off the hot relay path.  None (default)
+        keeps the pre-journal behavior.
+    standby
+        Run as a WARM STANDBY: tail ``journal`` (required) instead of
+        writing it, keep the replica membership + prober live, but
+        shed all /v2 traffic with a typed 503 until :meth:`promote`
+        (or ``POST /router/promote``) turns this router active.
     """
 
     def __init__(self, backends, host="127.0.0.1", port=0,
@@ -931,9 +1073,14 @@ class FleetRouter:
                  outlier_factor=3.0, outlier_min_samples=16,
                  min_eligible=1, probe_fraction=1.0 / 16,
                  eject_interval_s=0.5, digest_window=64,
-                 hedge_delay_s=None):
+                 hedge_delay_s=None, journal=None, standby=False,
+                 journal_flush_s=0.02):
         if not backends:
             raise ValueError("FleetRouter requires at least one backend")
+        if standby and not journal:
+            raise ValueError(
+                "a standby router needs the journal to tail: pass "
+                "journal=<directory> with standby=True")
         if len(set(backends)) != len(backends):
             raise ValueError(
                 "FleetRouter backends must be unique: {}".format(backends))
@@ -997,6 +1144,30 @@ class FleetRouter:
         # rotation counter steering every probe_every'th pick onto a
         # soft-ejected replica (its real-traffic probe)  # guarded-by: _lock
         self._eject_tick = 0
+        # -- router HA state (docs/resilience.md "Router HA") -------------
+        self._journal_dir = journal
+        self._journal_flush_s = float(journal_flush_s)
+        # the journal writer (active routers with a journal only);
+        # created in start()/promote(), closed in stop()
+        self._journal = None
+        self._follower = None
+        self._tail_thread = None
+        self._tail_stop = threading.Event()
+        # warm-standby flag: /v2 traffic sheds typed 503 while set;
+        # promote() clears it  # guarded-by: _lock
+        self._standby = bool(standby)
+        # promote() in-flight claim: the takeover signal can arrive
+        # from an admin POST and a process signal at once, and the
+        # promotion body blocks (thread join, file I/O) so it runs
+        # OUTSIDE any lock  # guarded-by: _lock
+        self._promoting = False
+        # SIGTERM drain latch: stop admitting, let in-flight finish
+        # # guarded-by: _lock
+        self._draining = False
+        # generations rebuilt from the journal (boot recovery + standby
+        # tailing) and standby->active promotions  # guarded-by: _lock
+        self._recovered = 0
+        self._takeovers = 0
         # monotonic stamp of the last ejection evaluation (the
         # throttle check-and-set is one atomic region under _lock —
         # two racing callers cannot both pass)  # guarded-by: _lock
@@ -1042,6 +1213,21 @@ class FleetRouter:
         return "{}:{}".format(self._httpd.server_address[0], self.port)
 
     def start(self):
+        # crash durability first: a journaled router replays its
+        # predecessor's resume-critical state BEFORE the first request
+        # can name a generation; a standby starts tailing instead
+        if self._journal_dir is not None:
+            with self._lock:
+                standby = self._standby
+            if standby:
+                self._follower = JournalFollower(self._journal_dir)
+                self._tail_thread = threading.Thread(
+                    target=self._tail_loop,
+                    name="fleet-router-journal-tail", daemon=True)
+                self._tail_thread.start()
+            else:
+                self._recover_journal()
+                self._open_journal_writer()
         # one synchronous probe round before serving: routing decisions
         # start from real replica state, not optimism
         self._probe_round()
@@ -1062,16 +1248,177 @@ class FleetRouter:
 
     def stop(self):
         self._stop.set()
+        self._tail_stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._tail_thread is not None:
+            self._tail_thread.join(timeout=5)
+            self._tail_thread = None
+        journal = self._journal
+        if journal is not None:
+            journal.close()
         with self._replicas_lock:
             self._started = False
             probers, self._probers = self._probers, []
         for t in probers:
             t.join(timeout=5)
+
+    # -- crash durability: journal recovery / standby / drain --------------
+
+    def _open_journal_writer(self):
+        """Open the append side and attach it to every registered
+        generation (recovered ones included): from here on, all
+        resume-critical state changes are journaled."""
+        self._journal = JournalWriter(
+            self._journal_dir,
+            rotate_interval_s=self._gen_ttl_s,
+            flush_interval_s=self._journal_flush_s)
+        with self._lock:
+            gens = [gen for gen, _ in self._gens.values()]
+        for gen in gens:
+            gen.journal = self._journal
+
+    def _recover_journal(self):
+        """Boot-time replay: rebuild the sticky registry from every
+        retained record.  A torn final record (crash mid-write) was
+        already truncated by the reader — recovery is never fatal."""
+        records, truncated = read_journal(self._journal_dir)
+        for rec in records:
+            self._apply_journal_record(rec)
+        with self._lock:
+            recovered = self._recovered
+        if records or truncated:
+            self._log(
+                "journal: replayed {} record(s), {} generation(s) "
+                "recovered{}".format(
+                    len(records), recovered,
+                    ", {} torn segment tail(s) truncated".format(
+                        truncated) if truncated else ""))
+
+    def _tail_loop(self):
+        """The standby's warm copy: apply journal records as the
+        active router writes them."""
+        while not self._tail_stop.is_set():
+            try:
+                for rec in self._follower.poll():
+                    self._apply_journal_record(rec)
+            except Exception as e:  # noqa: BLE001 — a bad record must
+                # not end the tail (the next poll continues past it)
+                self._log("journal tail error: {}".format(e))
+            if self._tail_stop.wait(0.05):
+                return
+
+    def _apply_journal_record(self, rec):
+        """Fold one journal record into the registry (shared by boot
+        recovery and the standby tail)."""
+        if not isinstance(rec, dict):
+            return
+        kind = rec.get("t")
+        gid = rec.get("gen")
+        if not gid or not isinstance(gid, str):
+            return
+        if kind == "bind":
+            gen = self.lookup_generation(gid)
+            if gen is None:
+                gen = _Generation.from_journal(
+                    gid, rec.get("path"), rec.get("req"))
+                if self.register_generation(gen, if_absent=True):
+                    with self._lock:
+                        self._recovered += 1
+                else:
+                    gen = self.lookup_generation(gid)
+            if gen is not None:
+                gen.apply_home(rec.get("home"), rec.get("offset"))
+            return
+        gen = self.lookup_generation(gid)
+        if gen is None:
+            return
+        if kind == "home":
+            gen.apply_home(rec.get("home"), rec.get("offset"))
+        elif kind == "ev":
+            payload = rec.get("p")
+            seq = rec.get("seq")
+            if isinstance(payload, dict) and isinstance(seq, int):
+                gen.apply_event(seq, rec.get("id") or gid, payload)
+        elif kind == "fin":
+            gen.complete()
+        elif kind == "drop":
+            self.drop_generation(gid)
+
+    def promote(self):
+        """Turn a standby active (the takeover signal): final journal
+        catch-up, open the append side, start serving.  Returns True
+        when a promotion happened (False on an already-active router,
+        or while another caller's promotion is in flight)."""
+        with self._lock:
+            # one atomic claim: the blocking promotion body (thread
+            # join, journal file I/O) must not run under a lock
+            if not self._standby or self._promoting:
+                return False
+            self._promoting = True
+        try:
+            self._tail_stop.set()
+            tail = self._tail_thread
+            if tail is not None:
+                tail.join(timeout=5)
+                self._tail_thread = None
+            if self._follower is not None:
+                # final catch-up: the dead active's last flushed
+                # records land before the first request is admitted
+                try:
+                    for rec in self._follower.poll():
+                        self._apply_journal_record(rec)
+                except Exception as e:  # noqa: BLE001
+                    self._log("journal catch-up error: {}".format(e))
+                self._follower = None
+            self._open_journal_writer()
+            with self._lock:
+                self._standby = False
+                self._takeovers += 1
+        finally:
+            with self._lock:
+                self._promoting = False
+        self._log("standby promoted to active (takeover)")
+        return True
+
+    def begin_drain(self):
+        """Stop admitting: /v2 traffic sheds typed 503 from here on;
+        in-flight requests and streams run to completion."""
+        with self._lock:
+            self._draining = True
+
+    def drain(self, timeout_s=10.0):
+        """SIGTERM drain: stop admitting, wait for in-flight work to
+        finish (streams hand off or complete on their own), then flush
+        + fsync the journal so a successor recovers everything this
+        process relayed.  Returns True when in-flight reached zero."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout_s
+        drained = False
+        while time.monotonic() < deadline:
+            with self._lock:
+                inflight = self._inflight
+            if inflight <= 0:
+                drained = True
+                break
+            time.sleep(0.05)
+        journal = self._journal
+        if journal is not None:
+            journal.flush()
+        return drained
+
+    def rejecting(self):
+        """Why /v2 traffic is being shed ("standby" / "draining"), or
+        None when serving."""
+        with self._lock:
+            if self._standby:
+                return "standby"
+            if self._draining:
+                return "draining"
+        return None
 
     def _spawn_prober(self, rep):
         thread = threading.Thread(
@@ -1498,6 +1845,11 @@ class FleetRouter:
         fresh admission must never clobber an existing replay
         buffer)."""
         now = time.monotonic()
+        # journaled routers persist every registered generation's
+        # resume-critical state (the writer is None on standbys and
+        # journal-less routers; recovered generations re-attach on
+        # promote via _open_journal_writer)
+        gen.journal = self._journal
         with self._lock:
             self._sweep_gens_locked(now)
             if if_absent and gen.gen_id in self._gens:
@@ -1524,7 +1876,10 @@ class FleetRouter:
 
     def drop_generation(self, gen_id):
         with self._lock:
-            self._gens.pop(gen_id, None)
+            entry = self._gens.pop(gen_id, None)
+        journal = self._journal
+        if journal is not None and entry is not None:
+            journal.append({"t": "drop", "gen": gen_id})
 
     def generation_snapshot(self, gen_id):
         with self._lock:
@@ -1551,7 +1906,14 @@ class FleetRouter:
                 "ejections": self._ejections,
                 "hedges": sum(self._hedges.values()),
                 "hedges_by_outcome": dict(self._hedges),
+                # router HA: journal recovery + warm-standby takeover
+                "recovered_generations": self._recovered,
+                "takeovers": self._takeovers,
+                "standby": self._standby,
+                "draining": self._draining,
             }
+        journal = self._journal
+        out["journal"] = journal.stats() if journal is not None else None
         out["replicas"] = [rep.stats() for rep in self._replicas_snapshot()]
         stats_fn = self._supervisor_stats
         if stats_fn is not None:
@@ -1582,7 +1944,20 @@ class FleetRouter:
             ("tpu_router_hedges_total",
              [({"outcome": outcome}, count) for outcome, count
               in sorted(snap["hedges_by_outcome"].items())]),
+            ("tpu_router_recovered_generations_total",
+             [({}, snap["recovered_generations"])]),
+            ("tpu_router_takeovers_total", [({}, snap["takeovers"])]),
         ]
+        journal = snap.get("journal")
+        if isinstance(journal, dict):
+            families.extend([
+                ("tpu_router_journal_records_total",
+                 [({}, journal.get("records", 0))]),
+                ("tpu_router_journal_bytes_total",
+                 [({}, journal.get("bytes", 0))]),
+                ("tpu_router_journal_fsyncs_total",
+                 [({}, journal.get("fsyncs", 0))]),
+            ])
         eligible, load, state, p90 = [], [], [], []
         for rep in snap["replicas"]:
             labels = {"replica": rep["url"]}
@@ -1688,12 +2063,16 @@ class FleetRouter:
     def health_snapshot(self):
         """The router's own replica-shaped ``/v2/health/stats`` answer,
         so routers stack (a router can front other routers) and pools
-        can probe them."""
+        can probe them.  A standby or draining router reports
+        not-ready (upstream routers and pools rotate it out) with its
+        shedding reason as the lifecycle state — the supervisor still
+        reads the 200 answer itself as process liveness."""
         routable = self.any_routable()
+        rejecting = self.rejecting()
         snap = self.stats()
         snap.update({
-            "state": "ready" if routable else "unavailable",
-            "ready": routable,
+            "state": rejecting or ("ready" if routable else "unavailable"),
+            "ready": routable and rejecting is None,
             "router": True,
             "models": {},
         })
@@ -2064,7 +2443,9 @@ class _RouterHandler(BaseHttpHandler):
         if path == "/v2/health/live":
             return self._send(200)
         if path == "/v2/health/ready":
-            return self._send(200 if router.any_routable() else 503)
+            return self._send(
+                200 if router.any_routable()
+                and router.rejecting() is None else 503)
         if path == "/v2/health/stats":
             return self._send_json(router.health_snapshot())
         if path == "/metrics":
@@ -2078,8 +2459,31 @@ class _RouterHandler(BaseHttpHandler):
             return self._send_json(router.stats())
         if path == "/router/replicas":
             return self._route_replicas_admin(method)
+        if path == "/router/promote":
+            # the takeover signal: a standby turns active (final
+            # journal catch-up included); idempotent on an active
+            if method != "POST":
+                return self._send_error_json(
+                    "/router/promote supports POST only", 400)
+            promoted = router.promote()
+            return self._send_json({
+                "promoted": promoted,
+                "standby": router.rejecting() == "standby",
+            })
         if not (path == "/v2" or path.startswith("/v2/")):
             return self._send_error_json("unknown endpoint: " + path, 404)
+        rejecting = router.rejecting()
+        if rejecting is not None:
+            # standby: the warm copy sheds until promoted; draining: a
+            # SIGTERM'd router stops admitting while in-flight streams
+            # finish.  Both are typed transitions the clients' resume
+            # retry path rides out against the active/peer router.
+            return self._send_error_json(
+                "router is {}; retry against the active router".format(
+                    rejecting)
+                if rejecting == "standby"
+                else "router is draining; retry later",
+                503, {"Retry-After": 1})
         if not router.enter_inflight():
             # the router-level shed valve: typed, with the backoff
             # contract the clients' retry policies key on
@@ -2179,12 +2583,24 @@ class _RouterHandler(BaseHttpHandler):
                 if tilde and base and off.isdigit():
                     handoff_marked = True
                     gen = router.lookup_generation(base)
+                    if (gen is not None
+                            and int(off) > gen.snapshot()["offset"]):
+                        # the client saw a handoff epoch this router's
+                        # journal never recorded (records lost past the
+                        # crash's final flush window): the offset map
+                        # for that epoch is unreconstructable, and a
+                        # guessed splice could gap or duplicate — the
+                        # honest typed 404 below.  A LOWER epoch is
+                        # fine: router seqs stayed continuous across
+                        # every handoff the registry does know.
+                        gen = None
             if gen is None:
                 if handoff_marked:
                     # the generation was handed off across replicas and
-                    # this router holds no offset map (restart / aged
-                    # out): router seqs are unreconstructable, and a
-                    # guessed replay point could silently gap or
+                    # this router holds no offset map for that epoch
+                    # (restart without a journal / aged out / lost
+                    # records): router seqs are unreconstructable, and
+                    # a guessed replay point could silently gap or
                     # duplicate tokens — fail typed instead
                     return self._send_error_json(
                         "generation '{}' was handed off across replicas "
@@ -2241,11 +2657,30 @@ class _RouterHandler(BaseHttpHandler):
                 "generation '{}' is busy on another connection".format(
                     gen.gen_id), 503, {"Retry-After": 1})
         try:
-            blocks, completed, next_seq = gen.replay_from(from_seq)
-            if from_seq > next_seq:
+            blocks, completed, next_seq, available = gen.replay_from(
+                from_seq)
+            if not available:
+                # a recovered generation holds only the retained
+                # journal tail; a resume point before it is
+                # unreplayable — typed, never a silent gap
                 return self._send_error_json(
-                    "resume point {} is beyond generation '{}' ({} events "
-                    "relayed)".format(from_seq, gen.gen_id, next_seq), 404)
+                    "resume point {} of generation '{}' predates the "
+                    "recovered journal tail".format(
+                        from_seq, gen.gen_id), 404)
+            if from_seq > next_seq:
+                # ahead of a RECOVERED watermark: the crash lost the
+                # final flush window's records, but the client provably
+                # received those events — fast-forward and splice from
+                # the client's own position (refused on live
+                # generations, where a watermark can never trail)
+                if gen.fast_forward(from_seq):
+                    blocks, completed, next_seq, _ = gen.replay_from(
+                        from_seq)
+                else:
+                    return self._send_error_json(
+                        "resume point {} is beyond generation '{}' ({} "
+                        "events relayed)".format(
+                            from_seq, gen.gen_id, next_seq), 404)
             snapshot = gen.snapshot()
             if (not completed and snapshot["home_lost"]
                     and not snapshot["handoff_capable"]

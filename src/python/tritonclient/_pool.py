@@ -799,11 +799,23 @@ class EndpointPool:
         """Run ONE resumable generation on one healthy endpoint, pinned
         for the generation's whole lifetime INCLUDING the client's
         auto-resume reconnects: generation replay state (token history,
-        re-prefill source) is **replica-local**, so a resume against
-        any other replica would fail with an unknown-generation error.
-        Never hedged, never failed over mid-generation — the pooled
-        client's own same-endpoint reconnect+resume handles transport
-        drops; only a FRESH generate_stream call routes anew.
+        re-prefill source) is **replica-local**, so a live resume
+        prefers the pinned endpoint.  Never hedged, never failed over
+        mid-generation — the pooled client's own reconnect+resume
+        handles transport drops; only a FRESH generate_stream call
+        routes anew.
+
+        One escape hatch rides the pinned client's reconnect loop: the
+        pool seeds the OTHER endpoints as ``fallback_urls``, so a
+        resume whose pinned endpoint refuses connections outright (a
+        SIGKILLed router, a not-yet-respawned process) rotates to a
+        peer under the same reconnect budget.  Behind fleet routers
+        seq continuity — not endpoint identity — is the resume
+        contract, so the peer serves the splice; a bare replica peer
+        answers the unknown-generation 404 the reconnect loop already
+        classifies as a transition, and the rotation returns to the
+        pinned endpoint on the next attempt.  Pass your own
+        ``fallback_urls`` (or ``fallback_urls=()``) to override.
 
         This is a generator: the endpoint is picked (and any half-open
         breaker probe slot consumed) only when iteration starts, so a
@@ -814,6 +826,14 @@ class EndpointPool:
         ep = self._pick()
         if ep is None:
             self._pool_unavailable(None)
+        if "fallback_urls" not in kwargs and not getattr(
+                ep.client, "_secure", False):
+            # never auto-inject for secure gRPC channels: per-url TLS
+            # material cannot be assumed to transfer, and the client
+            # refuses fallback rotation on them with a typed error —
+            # a secure pool keeps the plain same-endpoint pin
+            kwargs["fallback_urls"] = [
+                peer.url for peer in self._endpoints if peer is not ep]
         recorded = [False]
 
         def record_ok():

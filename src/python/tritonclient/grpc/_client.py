@@ -123,6 +123,9 @@ class InferenceServerClient:
         else:
             self._channel = grpc.insecure_channel(url, options=options)
         self._stub = ServiceStub(self._channel)
+        self._url = url
+        self._channel_options = options
+        self._secure = creds is not None or ssl
         self._verbose = verbose
         self._stream = None
         self._retry_policy = retry_policy
@@ -145,6 +148,24 @@ class InferenceServerClient:
         """Close the client: stop any active stream and the channel."""
         self.stop_stream()
         self._channel.close()
+
+    def _rebind(self, url):
+        """Re-point this client at ``url`` (insecure channels only):
+        close the current channel and open a fresh one.  The
+        ``generate_stream`` fallback rotation uses this between
+        reconnect attempts — the single bidi-stream slot is empty at
+        that point, so no in-flight RPC rides the old channel."""
+        if url == self._url:
+            return
+        if self._secure:
+            raise_error(
+                "fallback_urls requires insecure channels (per-url TLS "
+                "material cannot be assumed to transfer)")
+        self._channel.close()
+        self._channel = grpc.insecure_channel(
+            url, options=self._channel_options)
+        self._stub = ServiceStub(self._channel)
+        self._url = url
 
     # -- helpers -----------------------------------------------------------
 
@@ -740,11 +761,21 @@ class InferenceServerClient:
         reconnect_backoff_s=0.05,
         read_timeout=600.0,
         on_reconnect=None,
+        fallback_urls=None,
     ):
         """Synchronous generator over ONE decoupled generation with
         transparent reconnect+resume, yielding an ``InferResult`` per
         streamed response (the terminal empty-final response is
         consumed, not yielded).
+
+        ``fallback_urls`` (``host:port`` peers — a respawned server on
+        a new address, or sibling endpoints fronting the same fleet)
+        makes each reconnect attempt rotate through the target list by
+        re-binding the channel (insecure channels only): a
+        connect-refused primary retries the resume against the peer
+        under the same ``max_reconnects`` + backoff budget, because
+        behind a resilient fleet seq continuity — not endpoint
+        identity — is the resume contract.
 
         Owns the client's single bidi-stream slot for the call's
         duration (``start_stream`` semantics — raises if a stream is
@@ -761,23 +792,58 @@ class InferenceServerClient:
         are typed server failures (quarantined slot, expired resume
         id), not transport faults.  ``on_reconnect(attempt, exc)``
         fires before each reattempt."""
-        import queue as _queue
-
         if self._stream is not None:
             raise_error(
                 "cannot generate_stream with a stream already active"
             )
         base_params = dict(parameters or {})
         gen_id = base_params.get("generation_id")
-        last_seq = -1
-        yielded_any = False
-        attempt = 0
+        # reconnect target rotation (attempt N re-binds the channel to
+        # targets[N % len]); validated up front so a bad url fails the
+        # call, not a mid-generation reconnect
+        targets = [self._url]
+        for fb in fallback_urls or ():
+            if not isinstance(fb, str) or ":" not in fb:
+                raise_error(
+                    "fallback_urls entries must be host:port strings "
+                    "(got {!r})".format(fb))
+            targets.append(fb)
+        if len(targets) > 1 and self._secure:
+            raise_error(
+                "fallback_urls requires insecure channels (per-url TLS "
+                "material cannot be assumed to transfer)")
 
         class _StreamDropped(Exception):
             def __init__(self, error):
                 self.error = error
 
+        try:
+            yield from self._generate_stream_rotating(
+                targets, model_name, inputs, model_version, outputs,
+                request_id, base_params, headers, resume,
+                max_reconnects, reconnect_backoff_s, read_timeout,
+                on_reconnect, gen_id, _StreamDropped)
+        finally:
+            # the rotation must not outlive the call: a client left
+            # bound to the last fallback would silently route every
+            # later RPC (and its owner pool's breaker accounting) at
+            # the wrong endpoint
+            if len(targets) > 1:
+                self._rebind(targets[0])
+
+    def _generate_stream_rotating(
+            self, targets, model_name, inputs, model_version, outputs,
+            request_id, base_params, headers, resume, max_reconnects,
+            reconnect_backoff_s, read_timeout, on_reconnect, gen_id,
+            _StreamDropped):
+        import queue as _queue
+
+        last_seq = -1
+        yielded_any = False
+        attempt = 0
         while True:
+            if len(targets) > 1:
+                self._rebind(targets[attempt % len(targets)])
             responses = _queue.Queue()
             try:
                 try:

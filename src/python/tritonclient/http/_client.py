@@ -947,6 +947,7 @@ class InferenceServerClient:
         reconnect_backoff_s=0.05,
         read_timeout=600.0,
         on_reconnect=None,
+        fallback_urls=None,
     ):
         """Stream a decoupled generation over ``/generate_stream`` SSE,
         yielding one dict per event (the KServe generate-response JSON:
@@ -959,15 +960,27 @@ class InferenceServerClient:
         (``<generation_id>/<seq>`` of the last event received), the
         server replays the missed tokens from its replay buffer and
         splices the live continuation — no duplicated or missing
-        tokens.  Resume is **same-endpoint only** (generation replay
-        state is replica-local); ``EndpointPool.generate_stream`` pins
-        one endpoint for exactly this reason.  Up to ``max_reconnects``
-        reattempts with exponential backoff; ``on_reconnect(attempt,
-        exc)`` is called before each one (perf tooling counts resumes
-        through it).  In-band ``{"error": ...}`` events raise
-        InferenceServerException without reconnecting — those are
-        typed server-side failures (e.g. a quarantined slot), not
-        transport faults.
+        tokens.  Against a bare replica resume is same-endpoint only
+        (generation replay state is replica-local); behind a fleet
+        router the contract is **seq continuity, not endpoint
+        identity** — so ``fallback_urls`` (``host:port`` peers, e.g.
+        the warm-standby router or the supervisor's respawn address)
+        makes each reconnect rotate through the target list: a
+        connect-refused primary (router SIGKILLed) retries the resume
+        against the peer under the same ``max_reconnects`` + backoff
+        budget.  Up to ``max_reconnects`` reattempts with exponential
+        backoff; ``on_reconnect(attempt, exc)`` is called before each
+        one (perf tooling counts resumes through it).  In-band
+        ``{"error": ...}`` events raise InferenceServerException
+        without reconnecting — those are typed server-side failures
+        (e.g. a quarantined slot), not transport faults.
+
+        Typed-status handling across targets: 404 on a RESUME and
+        429/503 anywhere before the terminal event are transitions
+        (router restart, standby not yet promoted, momentary
+        saturation) and ride the reconnect path; a 404 on the FIRST
+        request stays terminal — the model/endpoint genuinely is not
+        there.
 
         ``inputs`` is a dict name -> numpy array (serialized as JSON
         data — generation prompts are small); ``parameters`` are the
@@ -1025,18 +1038,33 @@ class InferenceServerClient:
             "/versions/{}".format(model_version) if model_version else "",
         )
 
+        # reconnect target rotation: the primary first, then each
+        # fallback router in turn (attempt N dials targets[N % len]);
+        # validated up front — a malformed entry silently dropped
+        # would degrade the supposed HA rotation to no-failover with
+        # no signal until the first real outage
+        targets = [(self._host, self._port)]
+        for fb in fallback_urls or ():
+            fb_host, sep, fb_port = str(fb).rpartition(":")
+            if not (sep and fb_host and fb_port.isdigit()):
+                raise InferenceServerException(
+                    "fallback_urls entries must be host:port strings "
+                    "(got {!r})".format(fb))
+            targets.append((fb_host, int(fb_port)))
+
         last_event_id = None
         last_seq = -1
         yielded_any = False
         attempt = 0
         while True:
+            t_host, t_port = targets[attempt % len(targets)]
             conn = (
                 _http_client.HTTPSConnection(
-                    self._host, self._port, timeout=read_timeout,
+                    t_host, t_port, timeout=read_timeout,
                     context=self._ssl_context)
                 if self._scheme == "https"
                 else _http_client.HTTPConnection(
-                    self._host, self._port, timeout=read_timeout)
+                    t_host, t_port, timeout=read_timeout)
             )
             dropped = None
             try:
@@ -1052,22 +1080,32 @@ class InferenceServerClient:
                     dropped = e
                     resp = None
                 if resp is not None:
-                    if (resp.status in (404, 429, 503)
-                            and last_event_id is not None):
+                    transition = (
+                        resp.status == 404 and last_event_id is not None
+                    ) or (
+                        resp.status in (429, 503)
+                        and (last_event_id is not None or not yielded_any)
+                    )
+                    if transition:
                         # a RESUME answered 404 (server does not — yet —
                         # know this generation) or a typed overload
-                        # (429/503: a router's shed valve or busy
-                        # serving slot) — under a fleet router these are
-                        # transitions, not verdicts (router restart,
+                        # (429/503: a router's shed valve, a standby
+                        # router awaiting promotion, a busy serving
+                        # slot) — under a fleet these are transitions,
+                        # not verdicts (router restart/takeover,
                         # handoff in progress, momentary saturation):
-                        # the replay state still exists, so ride the
-                        # reconnect path and let the retries bound it.
-                        # The same statuses on the FIRST request (no
-                        # last_event_id) still raise typed below.
+                        # ride the reconnect path (rotating through
+                        # fallback targets) and let the retries bound
+                        # it.  429/503 retry even on a FIRST request
+                        # that delivered nothing — re-POSTing an
+                        # admission that never started cannot duplicate
+                        # tokens; a first-request 404 stays terminal
+                        # (the model/endpoint genuinely is not there).
                         reason = (
                             "resume target does not know generation"
                             if resp.status == 404
-                            else "resume target is overloaded")
+                            else "generation target is overloaded or "
+                                 "standby")
                         dropped = InferenceServerException(
                             "{}: {}".format(
                                 reason, _get_error_message(resp.read())),

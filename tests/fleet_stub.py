@@ -27,6 +27,21 @@ worker processes driving N stub replicas, zero jax imports): model
 stats / infer plus a ``/metrics`` Prometheus exposition whose
 ``stub_requests_total`` counter moves with served inferences
 (``--infer-delay-ms`` pins a synthetic latency floor).
+
+``/v2/models/stub/generate_stream`` emulates the scheduler-backed
+resumable SSE contract closely enough for router-HA tier-1 tests:
+
+- tokens are **autoregressive and continuation-consistent** —
+  ``next_token(fed) = (sum(fed)*31 + len(fed)) % 100`` over every fed
+  id (prompt + emitted history) — so the router's cross-replica
+  handoff re-prefill (``prompt + history``, shrunk ``MAX_TOKENS``)
+  continues token-identically, exactly like greedy llama decode;
+- each generation parks a replica-local replay record keyed by its
+  ``generation_id``: a reconnect with ``Last-Event-ID: <gid>/<seq>``
+  replays the gap and splices the live continuation, an unknown gid
+  answers the typed 404 the real scheduler would;
+- ``parameters.token_delay_ms`` stretches token cadence so kill tests
+  can land a SIGKILL provably mid-generation.
 """
 
 import argparse
@@ -122,6 +137,20 @@ def main():
     }
 
     served = {"count": 0, "ns": 0, "gen": 0}
+    # replica-local generation replay state: gid -> {"fed": [ids the
+    # virtual model consumed], "emitted": [tokens], "target": int,
+    # "delay_ms": float, "done": bool} — what makes Last-Event-ID
+    # resume and token-identical handoff continuations possible
+    gens = {}
+
+    def next_token(fed):
+        # deterministic autoregressive "model": the next token depends
+        # only on everything fed so far, so re-prefilling
+        # prompt+history anywhere continues the identical stream.
+        # Prime modulus + a position-squared term keep the sequence
+        # varied (a plain sum%100 collapses to a fixed point: the
+        # emitted token's contribution can cancel mod 100)
+        return (sum(fed) * 31 + len(fed) * len(fed) * 7 + 13) % 101
 
     def snapshot():
         with lock:
@@ -226,6 +255,101 @@ def main():
                 return
             self._json({"error": "unknown: " + self.path}, 404)
 
+        def _emit_event(self, gid, seq, token):
+            payload = {
+                "model_name": "stub",
+                "outputs": [{"name": "TOKEN", "datatype": "INT32",
+                             "shape": [1], "data": [int(token)]}],
+                "parameters": {"generation_id": gid, "seq": seq},
+            }
+            self.wfile.write(
+                "id: {}/{}\n".format(gid, seq).encode("ascii")
+                + b"data: " + json.dumps(payload).encode("ascii")
+                + b"\n\n")
+
+        def _generate_stream(self, body):
+            """The scheduler-backed SSE generate contract, stub-sized:
+            TOKEN events with generation_id/seq parameters, the
+            explicit terminal event, Last-Event-ID resume from a
+            replica-local replay record, and continuation-consistent
+            autoregressive tokens (handoff re-prefill lands on the
+            identical stream)."""
+            try:
+                request = json.loads(body or b"{}")
+                inputs = {t.get("name"): t.get("data") or []
+                          for t in request.get("inputs") or []}
+                prompt = [int(v) for v in inputs.get(
+                    "PROMPT_IDS") or [0]]
+                max_tokens = int((inputs.get("MAX_TOKENS") or [4])[0])
+                params = request.get("parameters") or {}
+                gid = str(params.get("generation_id") or "stubgen")
+                delay_ms = float(params.get("token_delay_ms") or 0.0)
+            except (TypeError, ValueError):
+                return self._json(
+                    {"error": "malformed generate request"}, 400)
+            from_seq = 0
+            resuming = False
+            last_id = self.headers.get("Last-Event-ID") or ""
+            if last_id:
+                rid, sep, seq = last_id.rpartition("/")
+                if sep and rid:
+                    resuming = True
+                    gid = rid
+                    try:
+                        from_seq = int(seq) + 1
+                    except ValueError:
+                        from_seq = 0
+            with lock:
+                entry = gens.get(gid)
+                if resuming:
+                    if entry is None:
+                        pass  # typed 404 below, outside the lock
+                else:
+                    # fresh admission (a handoff re-admission reusing
+                    # the id supersedes, scheduler-parity): the fed
+                    # sequence IS the replay/continuation state
+                    entry = gens[gid] = {
+                        "fed": list(prompt), "emitted": [],
+                        "target": max_tokens, "delay_ms": delay_ms,
+                        "done": False,
+                    }
+                    served["gen"] += 1
+            if resuming and entry is None:
+                return self._json(
+                    {"error": "unknown or expired generation id "
+                              "'{}'".format(gid)}, 404)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            try:
+                while True:
+                    with lock:
+                        emitted = list(entry["emitted"])
+                        done = entry["done"]
+                        delay = entry["delay_ms"]
+                    # replay the requester's gap, then splice live
+                    while from_seq < len(emitted):
+                        self._emit_event(
+                            gid, from_seq, emitted[from_seq])
+                        from_seq += 1
+                    if done:
+                        break
+                    with lock:
+                        if len(entry["emitted"]) >= entry["target"]:
+                            entry["done"] = True
+                            continue
+                        token = next_token(entry["fed"])
+                        entry["fed"].append(token)
+                        entry["emitted"].append(token)
+                    if delay > 0:
+                        time.sleep(delay / 1000.0)
+                self.wfile.write(b'data: {"final": true}\n\n')
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # requester hung up mid-stream (a severed router
+                # relay): the replay record stays for its resume
+                pass
+            self.close_connection = True
+
         def do_POST(self):
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else b""
@@ -246,45 +370,7 @@ def main():
                                  "shape": [1], "data": [0.0]}],
                 })
             if self.path == "/v2/models/stub/generate_stream":
-                # just enough of the scheduler-backed SSE generate
-                # contract (TOKEN events with generation_id/seq
-                # parameters + the explicit terminal event) for
-                # router-tier routing tests — prefix-affinity
-                # placement is observable via stub_generations_total
-                try:
-                    request = json.loads(body or b"{}")
-                    inputs = {t.get("name"): t.get("data") or []
-                              for t in request.get("inputs") or []}
-                    prompt = [int(v) for v in inputs.get(
-                        "PROMPT_IDS") or [0]]
-                    max_tokens = int(
-                        (inputs.get("MAX_TOKENS") or [4])[0])
-                    gid = str((request.get("parameters") or {}).get(
-                        "generation_id") or "stubgen")
-                except (TypeError, ValueError):
-                    return self._json(
-                        {"error": "malformed generate request"}, 400)
-                with lock:
-                    served["gen"] += 1
-                self.send_response(200)
-                self.send_header("Content-Type", "text/event-stream")
-                self.end_headers()
-                for i in range(max_tokens):
-                    token = (prompt[i % len(prompt)] + i) % 100
-                    payload = {
-                        "model_name": "stub",
-                        "outputs": [{"name": "TOKEN",
-                                     "datatype": "INT32", "shape": [1],
-                                     "data": [token]}],
-                        "parameters": {"generation_id": gid, "seq": i},
-                    }
-                    self.wfile.write(
-                        "id: {}/{}\n".format(gid, i).encode("ascii")
-                        + b"data: " + json.dumps(payload).encode("ascii")
-                        + b"\n\n")
-                self.wfile.write(b'data: {"final": true}\n\n')
-                self.close_connection = True
-                return
+                return self._generate_stream(body)
             if self.path != "/stub/state":
                 return self._json({"error": "unknown: " + self.path}, 404)
             update = json.loads(body or b"{}")

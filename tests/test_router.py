@@ -297,11 +297,22 @@ def test_least_loaded_spreads_concurrent_requests(fleet):
     t = threading.Thread(target=slow, daemon=True)
     t.start()
     try:
-        # wait until the slow request is counted against some replica
+        # identify the busy replica by its REQUEST counter (bumped the
+        # instant the router dials it) rather than the load score: the
+        # prober's load contribution can be stale — the previous
+        # test's request caught mid-flight by a probe reads as load on
+        # the wrong replica for up to a probe interval
         assert _wait_until(lambda: any(
-            r["load"] > 0 for r in router.stats()["replicas"]))
+            r["requests"] == before[r["url"]] + 1
+            for r in router.stats()["replicas"]))
         busy = next(r["url"] for r in router.stats()["replicas"]
-                    if r["load"] > 0)
+                    if r["requests"] == before[r["url"]] + 1)
+        # and let any stale probe load on the OTHER replica settle to
+        # zero before routing the probe request, or the least-loaded
+        # pick below would be comparing ghosts
+        assert _wait_until(lambda: all(
+            r["url"] == busy or r["load"] <= 0
+            for r in router.stats()["replicas"]))
         in0 = httpclient.InferInput("INPUT0", [16], "INT32")
         in0.set_data_from_numpy(np.arange(16, dtype=np.int32))
         in1 = httpclient.InferInput("INPUT1", [16], "INT32")

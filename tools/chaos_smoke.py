@@ -24,6 +24,7 @@ Usage:
     python tools/chaos_smoke.py --router [--cycles N] [--soak M]
     python tools/chaos_smoke.py --fleet [--cycles N] [--soak M]
     python tools/chaos_smoke.py --gray [--cycles N] [--soak M]
+    python tools/chaos_smoke.py --router-kill [--cycles N] [--soak M]
 
 ``--kill-loop`` soaks the supervised-restart layer: every round kills
 the decode loop mid-traffic (injected step failure = loop death) while
@@ -66,6 +67,16 @@ cycle.  Invariants: the router soft-ejects it on the latency
 differential alone, fleet p99 returns to within 2x of the healthy
 baseline while the fault is still active, zero user-visible errors,
 and the replica re-admits itself via probe traffic once it recovers.
+
+``--router-kill`` soaks router HA (ISSUE 15): a supervised stub fleet
+fronted by ACTIVE + STANDBY router processes sharing one crash
+journal, with the ACTIVE router SIGKILLed mid-traffic every cycle.
+Invariants: the supervisor promotes the standby (takeover counter
+moves) and respawns the casualty as the new standby, clients carrying
+both router urls see ZERO user-visible errors, every stream —
+including the ones severed by the kill — completes token-identical
+with gap-free seqs via journal-recovered resume state, and the
+promoted router's ``recovered_generations`` counter moves.
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -1293,6 +1304,215 @@ def gray_phase(cycles, soak):
             proc.wait(timeout=10)
 
 
+def router_kill_phase(cycles, soak, budget):
+    """``--router-kill``: router-HA soak (ISSUE 15).
+
+    A FleetSupervisor owns two stdlib stub replicas AND the front tier
+    itself: an active router process (``tools/router.py --journal``)
+    plus a warm standby tailing the same journal.  Each cycle, worker
+    clients — carrying BOTH router urls, the ``fallback_urls`` rotation
+    — stream slow generations while the ACTIVE router is SIGKILLed
+    mid-traffic.  Invariants:
+
+      1. the supervisor promotes the standby (``router_takeovers``
+         moves) and respawns the casualty as the new standby, ports
+         stable;
+      2. ZERO user-visible stream errors — the kill costs each live
+         stream one client reconnect, absorbed inside the resume
+         retry budget;
+      3. every stream's tokens are identical to the fault-free
+         reference with gap-free, duplicate-free seqs (the promoted
+         router's journal-recovered offset maps serve even
+         handoff-marked resumes);
+      4. journal recovery is observable: the new active's
+         ``recovered_generations`` counter is nonzero and its
+         ``tpu_router_journal_records_total`` family is live.
+    """
+    import http.client
+    import json as _json
+    import signal
+
+    import tritonclient.http as httpclient
+
+    from tpuserver.fleet import FleetSupervisor
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub_path = os.path.join(repo, "tests", "fleet_stub.py")
+    command = [sys.executable, stub_path, "--port", "{port}",
+               "--scope", "{scope}"]
+    router_command = [
+        sys.executable, os.path.join(repo, "tools", "router.py"),
+        "--backends", "{backends}", "--port", "{port}",
+        "--journal", "{journal}", "--probe-interval", "0.1",
+    ]
+    supervisor = FleetSupervisor(
+        command, replicas=2, min_replicas=2, max_replicas=2,
+        probe_interval_s=0.1, probe_timeout_s=2.0,
+        start_timeout_s=60.0, drain_grace_s=5.0,
+        max_restarts=cycles + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.05, scope_prefix="rk-stub-",
+        router_command=router_command, router_standby=True,
+        env={"PYTHONPATH": os.path.join(repo, "src", "python")},
+    ).start()
+
+    def routers_up(timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            routers = supervisor.stats().get("routers", [])
+            if routers and all(r["state"] == "up" for r in routers):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def active_router_stats():
+        url = supervisor.active_router_url()
+        host, _, port = url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/router/stats")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return {}
+            return _json.loads(resp.read())
+        except (OSError, ValueError, http.client.HTTPException):
+            return {}
+        finally:
+            conn.close()
+
+    def journal_records_metric():
+        url = supervisor.active_router_url()
+        host, _, port = url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            for line in resp.read().decode().splitlines():
+                if line.startswith("tpu_router_journal_records_total"):
+                    return float(line.split()[-1])
+            return None
+        except (OSError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    try:
+        if not supervisor.wait_ready(timeout_s=60.0):
+            fail("router-kill: stub replicas never became ready")
+            return
+        if not routers_up():
+            fail("router-kill: router processes never came up")
+            return
+        prompt = np.array([5, 7, 9], dtype=np.int32)
+
+        def run_stream(client, urls, cycle, wid, i):
+            tokens, seqs = [], []
+            try:
+                for event in client.generate_stream(
+                        "stub",
+                        {"PROMPT_IDS": prompt,
+                         "MAX_TOKENS": np.array([budget], np.int32)},
+                        parameters={"token_delay_ms": 25},
+                        fallback_urls=urls[1:], max_reconnects=10):
+                    for out in event.get("outputs", []):
+                        if out["name"] == "TOKEN":
+                            tokens.append(int(out["data"][0]))
+                    params = event.get("parameters") or {}
+                    if "seq" in params:
+                        seqs.append(params["seq"])
+            except Exception as e:  # noqa: BLE001 — the invariant
+                fail("router-kill cycle {}: user-visible stream error "
+                     "(worker {} stream {}: {}: {})".format(
+                         cycle, wid, i, type(e).__name__, e))
+                return None, None
+            return tokens, seqs
+
+        urls = supervisor.router_urls()
+        ref_client = httpclient.InferenceServerClient(urls[0])
+        reference, _ = run_stream(ref_client, urls, -1, 0, 0)
+        ref_client.close()
+        if reference is None:
+            return
+        print("reference tokens: {}; {} SIGKILL-the-active-router "
+              "cycles".format(reference, cycles), flush=True)
+
+        for cycle in range(cycles):
+            stats_before = supervisor.stats()
+            urls = supervisor.router_urls()
+
+            def worker(wid, cycle=cycle, urls=urls):
+                client = httpclient.InferenceServerClient(urls[0])
+                try:
+                    for i in range(soak):
+                        tokens, seqs = run_stream(
+                            client, urls, cycle, wid, i)
+                        if tokens is None:
+                            continue
+                        if tokens != reference:
+                            fail("router-kill cycle {}: stream tokens "
+                                 "diverged: {} != {}".format(
+                                     cycle, tokens, reference))
+                        if (seqs != list(range(len(seqs)))
+                                or len(seqs) != budget):
+                            fail("router-kill cycle {}: seq gap/"
+                                 "duplicate: {}".format(cycle, seqs))
+                finally:
+                    client.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(3)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # streams mid-generation on the router
+            active = [r for r in supervisor.stats().get("routers", [])
+                      if r["role"] == "active" and r["state"] == "up"
+                      and r["pid"]]
+            if not active:
+                fail("router-kill cycle {}: no live active router to "
+                     "kill".format(cycle))
+            else:
+                os.kill(active[0]["pid"], signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=300)
+            # recovery bar: takeover (or at minimum a healed restart)
+            # observed, both router processes back up
+            deadline = time.monotonic() + 60.0
+            healed = False
+            while time.monotonic() < deadline:
+                stats = supervisor.stats()
+                if (stats.get("router_takeovers", 0)
+                        > stats_before.get("router_takeovers", 0)
+                        and routers_up(timeout_s=0.1)):
+                    healed = True
+                    break
+                time.sleep(0.1)
+            if not healed:
+                fail("router-kill cycle {}: standby takeover never "
+                     "completed (stats={})".format(
+                         cycle, supervisor.stats()))
+            rstats = active_router_stats()
+            if not rstats.get("recovered_generations"):
+                fail("router-kill cycle {}: promoted router recovered "
+                     "zero generations from the journal".format(cycle))
+            records = journal_records_metric()
+            if not records:
+                fail("router-kill cycle {}: "
+                     "tpu_router_journal_records_total missing or zero "
+                     "on the active router".format(cycle))
+            stats = supervisor.stats()
+            print("cycle {:2d} takeovers={} router_restarts={} "
+                  "recovered={} journal_records={}".format(
+                      cycle, stats.get("router_takeovers"),
+                      stats.get("router_restarts"),
+                      rstats.get("recovered_generations"), records),
+                  flush=True)
+    finally:
+        supervisor.stop()
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -1320,6 +1540,16 @@ def main():
                              "kill the decode loop mid-traffic every "
                              "round, assert auto-restart with zero lost "
                              "or corrupted streams")
+    parser.add_argument("--router-kill", action="store_true",
+                        help="soak router HA instead: a supervised "
+                             "stub fleet with active + standby router "
+                             "processes sharing one crash journal; "
+                             "the ACTIVE router is SIGKILLed "
+                             "mid-traffic every cycle — asserts "
+                             "standby takeover, zero user-visible "
+                             "errors, token-identical gap-free "
+                             "streams, and journal recovery counters "
+                             "moving")
     parser.add_argument("--gray", action="store_true",
                         help="soak the gray-failure ejection layer "
                              "instead: a stub-fleet router with one "
@@ -1340,6 +1570,25 @@ def main():
                              "40 in pool mode, 6 full generations in "
                              "router mode)")
     args = parser.parse_args()
+
+    if args.router_kill:
+        t0 = time.monotonic()
+        # stub replicas + slowed token cadence: cycles are cheap, so
+        # the default soak covers several full generations per worker
+        router_kill_phase(args.cycles,
+                          args.soak if args.soak is not None else 3,
+                          args.budget * 2)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nrouter-kill chaos smoke FAILED: {} violation(s) "
+                  "in {:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nrouter-kill chaos smoke OK: {} active-router SIGKILL "
+              "cycles, {:.1f}s, standby takeover + journal recovery, "
+              "zero user-visible errors, zero lost or duplicated "
+              "tokens".format(args.cycles, elapsed))
+        return 0
 
     if args.gray:
         t0 = time.monotonic()

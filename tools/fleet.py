@@ -104,6 +104,24 @@ def main(argv=None):
                     help="sustained fleet utilization that scales UP")
     ap.add_argument("--scale-low", type=float, default=0.10,
                     help="sustained fleet utilization that scales DOWN")
+    ap.add_argument("--router-processes", action="store_true",
+                    help="supervise the router as its own PROCESS "
+                         "(tools/router.py with a crash journal) under "
+                         "the same drain-first restart budget the "
+                         "replicas get, instead of the in-process "
+                         "router")
+    ap.add_argument("--router-standby", action="store_true",
+                    help="with --router-processes: run a warm-standby "
+                         "router tailing the same journal; the "
+                         "supervisor promotes it on active-router "
+                         "death (clients carrying both urls reconnect "
+                         "once, streams resume)")
+    ap.add_argument("--router-journal", default=None, metavar="DIR",
+                    help="journal directory the router processes "
+                         "share (default: a supervisor-owned temp "
+                         "directory)")
+    ap.add_argument("--standby-port", type=int, default=0,
+                    help="standby router listen port (0 = pick free)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -118,6 +136,13 @@ def main(argv=None):
         "--models", args.models, "--slots", str(args.slots),
         "--drain-timeout", str(args.drain_timeout),
     ]
+    router_command = None
+    if args.router_processes or args.router_standby:
+        router_command = [
+            sys.executable, os.path.join(REPO, "tools", "router.py"),
+            "--backends", "{backends}", "--host", args.router_host,
+            "--port", "{port}", "--journal", "{journal}",
+        ]
     supervisor = FleetSupervisor(
         command,
         replicas=args.replicas,
@@ -129,6 +154,11 @@ def main(argv=None):
         scale_high=args.scale_high,
         scale_low=args.scale_low,
         router_kwargs={"host": args.router_host, "port": args.router_port},
+        router_command=router_command,
+        router_standby=args.router_standby,
+        router_journal=args.router_journal,
+        router_port=args.router_port,
+        standby_port=args.standby_port,
         env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
         verbose=args.verbose,
     ).start()
@@ -140,10 +170,10 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
-    print("fleet supervisor: router on {} over {} replica(s) "
+    print("fleet supervisor: router(s) on {} over {} replica(s) "
           "(min {}, max {})".format(
-              supervisor.router.url, args.replicas, args.min_replicas,
-              args.max_replicas), flush=True)
+              ", ".join(supervisor.router_urls()), args.replicas,
+              args.min_replicas, args.max_replicas), flush=True)
     supervisor.wait_ready(timeout_s=120.0)
     for rep in supervisor.stats()["replicas"]:
         print("  replica {url} [{scope}] pid={pid} state={state}".format(

@@ -10,7 +10,18 @@ and gets health-aware routing, typed shedding, sticky stream resume,
 and cross-replica resume handoff for free (docs/resilience.md "Fleet
 router").  Membership is live: GET/POST /router/replicas lists, adds,
 and removes replicas at runtime (the surface tools/fleet.py's
-supervisor drives scaling through).  SIGTERM/SIGINT stop it cleanly.
+supervisor drives scaling through).
+
+Router HA (docs/resilience.md "Router HA & state durability"):
+``--journal DIR`` makes the sticky registry crash-durable — the
+router replays the journal on boot, so marked (``gen~offset/seq``)
+resumes survive a restart — and ``--standby`` (same ``--journal``)
+runs a warm standby that tails the journal and sheds typed 503 until
+promoted (``POST /router/promote``, or SIGUSR1 to this process).
+
+SIGTERM drains first — stop admitting, let in-flight streams finish
+or hand off, flush + fsync the journal — exactly like the replica
+entrypoint's ``install_sigterm_drain``; SIGINT stops immediately.
 """
 
 import argparse
@@ -75,8 +86,24 @@ def main(argv=None):
                          "at this value, which alone applies while "
                          "the digest is cold — races a duplicate on "
                          "a different replica")
+    ap.add_argument("--journal", default=None, metavar="DIR",
+                    help="crash-durable generation journal directory: "
+                         "replayed on boot (marked resumes survive a "
+                         "router restart), appended off the hot relay "
+                         "path while serving")
+    ap.add_argument("--standby", action="store_true",
+                    help="run as a warm standby: tail --journal "
+                         "(required), keep membership/probing live, "
+                         "shed /v2 traffic typed-503 until promoted "
+                         "(POST /router/promote or SIGUSR1)")
+    ap.add_argument("--drain-timeout", type=float, default=10.0,
+                    help="SIGTERM drain budget in seconds (in-flight "
+                         "streams finish, journal flushes, then exit)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.standby and not args.journal:
+        ap.error("--standby requires --journal (the standby tails it)")
 
     from tpuserver.router import FleetRouter
 
@@ -98,20 +125,45 @@ def main(argv=None):
         min_eligible=args.min_eligible,
         probe_fraction=args.probe_fraction,
         hedge_delay_s=args.hedge_delay,
+        journal=args.journal,
+        standby=args.standby,
         verbose=args.verbose,
     ).start()
 
     stop = threading.Event()
+    drain_first = threading.Event()
 
     def _stop(signum, frame):
         stop.set()
 
-    signal.signal(signal.SIGTERM, _stop)
+    def _sigterm(signum, frame):
+        # the router's own install_sigterm_drain twin: stop admitting,
+        # let in-flight streams finish or hand off, flush + fsync the
+        # journal, then exit — the main thread runs the drain so the
+        # handler stays async-signal-trivial
+        drain_first.set()
+        stop.set()
+
+    def _promote(signum, frame):
+        # takeover signal for supervisor-less deployments; the HTTP
+        # twin is POST /router/promote
+        threading.Thread(target=router.promote,
+                         name="router-promote", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
     signal.signal(signal.SIGINT, _stop)
-    print("fleet router listening on {} over {} replica(s): {}".format(
-        router.url, len(backends), ", ".join(backends)), flush=True)
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, _promote)
+    print("fleet router {} on {} over {} replica(s): {}{}".format(
+        "STANDBY" if args.standby else "listening",
+        router.url, len(backends), ", ".join(backends),
+        " (journal: {})".format(args.journal) if args.journal else "",
+    ), flush=True)
     try:
         stop.wait()
+        if drain_first.is_set():
+            print("router draining...", flush=True)
+            router.drain(timeout_s=args.drain_timeout)
     finally:
         router.stop()
     print("router stopped", flush=True)
