@@ -235,3 +235,27 @@ def attach_router_delta(result, before, after):
     for key in SUPERVISOR_COUNTERS:
         if key in before and key in after:
             result[key] = after[key] - before[key]
+    # disaggregated prefill/decode: the phase-split orchestrator's
+    # counters ride the snapshot as a nested dict.  Diff the cumulative
+    # members and derive the per-phase averages the generation report
+    # renders (prefill-queue ms per split, KV-transfer ms per
+    # transfer) — all presence-guarded, so a router predating the
+    # split plane never fabricates a column.
+    disagg_before, disagg_after = before.get("disagg"), after.get("disagg")
+    if isinstance(disagg_before, dict) and isinstance(disagg_after, dict):
+        for key in ("splits", "transfers", "transfer_bytes",
+                    "transfer_ms_total", "prefill_queue_ms_total"):
+            if key in disagg_before and key in disagg_after:
+                result["disagg_" + key] = (
+                    disagg_after[key] - disagg_before[key])
+        result["disagg_fallbacks"] = (
+            sum((disagg_after.get("fallbacks") or {}).values())
+            - sum((disagg_before.get("fallbacks") or {}).values()))
+        splits = result.get("disagg_splits")
+        if splits:
+            result["prefill_queue_ms"] = (
+                result["disagg_prefill_queue_ms_total"] / splits)
+        transfers = result.get("disagg_transfers")
+        if transfers:
+            result["kv_transfer_ms"] = (
+                result["disagg_transfer_ms_total"] / transfers)

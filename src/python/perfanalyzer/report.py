@@ -45,6 +45,11 @@ _GEN_COLUMNS = [
     ("itl_p90_ms", "{:.2f}"),
     ("itl_p99_ms", "{:.2f}"),
     ("prefix_hit_pct", "{:.1f}"),
+    # per-phase columns from the router's disagg counters (set by
+    # attach_router_delta only when the target router runs the
+    # phase-split plane; absent fields render "-", never 0)
+    ("prefill_queue_ms", "{:.2f}"),
+    ("kv_transfer_ms", "{:.2f}"),
     ("errors", "{:d}"),
     ("stable", "{}"),
 ]
@@ -52,7 +57,7 @@ _GEN_COLUMNS = [
 _GEN_HEADERS = [
     "Streams", "tokens/sec", "gen/sec", "TTFT avg(ms)", "TTFT p50(ms)",
     "TTFT p99(ms)", "ITL p50(ms)", "ITL p90(ms)", "ITL p99(ms)",
-    "prefix-hit%", "errors", "stable",
+    "prefix-hit%", "prefill-q(ms)", "kv-xfer(ms)", "errors", "stable",
 ]
 
 #: Per-window CSV schema: the reference ReportWriter's columns

@@ -21,6 +21,8 @@ from tpuserver.metrics import MetricsRegistry
 from tpuserver.errors import (  # noqa: F401 — re-exported: the public
     # names every frontend/client/test imports from tpuserver.core
     DeadlineExceeded,
+    KvExportConflict,
+    KvExportNotFound,
     Overloaded,
     ServerError,
     ShmRegionInUse,
@@ -770,11 +772,15 @@ class InferenceServer:
     """
 
     def __init__(self, models=None, max_inflight=None, ready=True,
-                 fault_scope=None):
+                 fault_scope=None, role=None):
         # identifies this replica at shared fault-injection points, so
         # multi-server chaos harnesses can break ONE in-process replica
         # (tpuserver.faults scopes)
         self.fault_scope = fault_scope
+        # disaggregated-serving role ("prefill" | "decode" | None =
+        # fused): advertised in health_snapshot so a fleet router can
+        # partition its candidate pools by phase without configuration
+        self.role = role
         self._models = {}  # name -> Model
         self._ready = {}  # name -> bool
         self._stats = {}  # name -> _ModelStats
@@ -799,6 +805,11 @@ class InferenceServer:
         # generation leaves behind so a same-host resume re-scatters
         # instead of re-prefilling  # guarded-by: _shm_lock
         self._kv_exports = {}
+        # generation ids whose export descriptor was already handed out:
+        # the disaggregated transfer contract is one-shot (exactly one
+        # decode replica re-scatters a prefill leg), so a second fetch
+        # is a typed 409, not a silent double-attach  # guarded-by: _shm_lock
+        self._kv_export_claims = set()
         self._batchers = {}  # name -> _DynamicBatcher (lazily created;
         # double-checked locking — deliberately unannotated, see
         # docs/static_analysis.md R1)
@@ -968,8 +979,12 @@ class InferenceServer:
         sub-second probe cadence across a fleet costs nothing.  Shape::
 
             {"state": "ready", "ready": true, "inflight": 3,
-             "max_inflight": 64, "pid": 4242,
+             "max_inflight": 64, "pid": 4242, "role": null,
              "models": {"llama_generate": {<DecodeScheduler.stats()>}}}
+
+        ``role`` is the disaggregated-serving phase this replica is
+        dedicated to (``"prefill"`` / ``"decode"``, None = fused) — the
+        signal a phase-aware router partitions its candidate pools by.
 
         ``pid`` identifies the serving *process*: a fleet supervisor
         restarting replicas at a stable address can tell a healed
@@ -996,6 +1011,7 @@ class InferenceServer:
             "inflight": inflight,
             "max_inflight": max_inflight,
             "pid": os.getpid(),
+            "role": self.role,
             "models": models,
         }
 
@@ -1504,6 +1520,7 @@ class InferenceServer:
             self._xla_shm[name] = region
             self._kv_exports[generation_id] = (
                 name, int(position), tuple(cache.shape), str(cache.dtype))
+            self._kv_export_claims.discard(generation_id)
 
     def import_kv_region(self, generation_id):
         """``(device cache, parked position)`` of a prior export, or
@@ -1532,10 +1549,127 @@ class InferenceServer:
         unlinked.  Idempotent."""
         with self._shm_lock:
             entry = self._kv_exports.pop(generation_id, None)
+            self._kv_export_claims.discard(generation_id)
             region = self._xla_shm.pop(entry[0], None) if entry else None
         if region is not None:
             region.close()
             self._destroy_owned(region)
+
+    def kv_export_descriptor(self, generation_id):
+        """Wire descriptor of a live KV export — the transfer handle a
+        decode-role replica attaches to re-scatter a prefill leg's
+        pages instead of re-prefilling (docs/resilience.md
+        "Disaggregated prefill/decode").
+
+        The contract is **one-shot**: the first fetch claims the export
+        (the disagg orchestrator hands it to exactly one decode
+        replica), a second fetch for the same generation raises the
+        typed 409 ``KvExportConflict``, and a fetch for a generation
+        with no live export (never exported, dropped, or TTL-expired
+        with its replay entry) raises the typed 404 ``KvExportNotFound``
+        — the caller falls back to the fused re-prefill path instead of
+        crashing later inside the ``paged_gather`` scatter.
+
+        Fetching forces the device-resident pages into the region's
+        host staging window (one device→host sync, outside the shm
+        lock) so a cross-process attach reads real bytes.  Returns a
+        JSON-able dict::
+
+            {"generation_id", "name", "raw_handle", "position",
+             "shape", "dtype", "byte_size", "device_ordinal"}
+        """
+        from tritonclient.utils import xla_shared_memory as xshm
+
+        with self._shm_lock:
+            entry = self._kv_exports.get(generation_id)
+            region = self._xla_shm.get(entry[0]) if entry else None
+            if entry is None or region is None:
+                if entry is not None:
+                    # region unregistered under the record: forget it
+                    self._kv_exports.pop(generation_id, None)
+                    self._kv_export_claims.discard(generation_id)
+                raise KvExportNotFound(
+                    "no live KV export for generation '{}' (never "
+                    "exported, dropped, or expired); fall back to "
+                    "prefill".format(generation_id))
+            if generation_id in self._kv_export_claims:
+                raise KvExportConflict(
+                    "KV export for generation '{}' already claimed: the "
+                    "transfer contract is one-shot".format(generation_id))
+            self._kv_export_claims.add(generation_id)
+            name, position, shape, dtype = entry
+        try:
+            # device->host sync + handle serialization outside the lock
+            # (syscall/DMA work never holds _shm_lock)
+            owner = getattr(region, "_owner_handle", None)
+            handle = owner if owner is not None else region.handle
+            region.read(0, region.byte_size)
+            raw = xshm.get_raw_handle(handle)
+        except Exception:
+            with self._shm_lock:  # leave the export fetchable again
+                self._kv_export_claims.discard(generation_id)
+            raise
+        return {
+            "generation_id": generation_id,
+            "name": name,
+            "raw_handle": raw.decode("ascii"),
+            "position": int(position),
+            "shape": list(shape),
+            "dtype": dtype,
+            "byte_size": int(region.byte_size),
+            "device_ordinal": int(region.device_ordinal),
+        }
+
+    def import_kv_descriptor(self, descriptor):
+        """Attach a KV export published by another replica from its wire
+        descriptor: ``(device cache, parked position)`` ready for the
+        scheduler's attach-admission path.  In-process the device
+        segment aliases zero-copy; cross-process the host staging
+        window is read once and device_put.  A malformed or unreachable
+        descriptor raises the typed 404 ``KvExportNotFound`` — at
+        admission time, never a late crash inside the scatter."""
+        import jax.numpy as jnp
+        from tritonclient.utils import xla_shared_memory as xshm
+
+        try:
+            raw = descriptor["raw_handle"]
+            shape = tuple(int(d) for d in descriptor["shape"])
+            try:
+                dtype = np.dtype(descriptor["dtype"])
+            except TypeError:
+                # extension dtypes (bfloat16 — the default KV wire
+                # dtype) resolve only once ml_dtypes registers them
+                import ml_dtypes  # noqa: F401
+
+                dtype = np.dtype(descriptor["dtype"])
+            position = int(descriptor["position"])
+            byte_size = int(descriptor.get("byte_size")
+                            or int(np.prod(shape)) * dtype.itemsize)
+        except (KeyError, TypeError, ValueError) as e:
+            raise KvExportNotFound(
+                "malformed kv-export descriptor: {}".format(e))
+        try:
+            handle = xshm.attach_from_raw_handle(raw)
+        except Exception as e:
+            raise KvExportNotFound(
+                "kv export unreachable (region gone?): {}".format(e))
+        try:
+            cache = handle.get_jax_segment(0)
+            if cache is not None:  # in-process: zero-copy alias
+                if tuple(cache.shape) != shape:
+                    cache = cache.reshape(shape)
+                return cache, position
+            host = np.frombuffer(
+                handle.read_bytes(0, byte_size), dtype=dtype).reshape(shape)
+            return jnp.asarray(host), position
+        except KvExportNotFound:
+            raise
+        except Exception as e:
+            raise KvExportNotFound(
+                "kv export attach failed for region '{}': {}".format(
+                    descriptor.get("name", "?"), e))
+        finally:
+            handle.detach()
 
     def _drop_export_entry_locked(self, region_name):
         """Forget the export record pointing at ``region_name`` (the
@@ -1544,6 +1678,7 @@ class InferenceServer:
         for gid, entry in list(self._kv_exports.items()):
             if entry[0] == region_name:
                 self._kv_exports.pop(gid, None)
+                self._kv_export_claims.discard(gid)
 
     @staticmethod
     def _destroy_owned(region):

@@ -16,6 +16,8 @@ now both import from here).
 
 __all__ = [
     "DeadlineExceeded",
+    "KvExportConflict",
+    "KvExportNotFound",
     "Overloaded",
     "ServerError",
     "ShmRegionInUse",
@@ -81,6 +83,29 @@ class ShmRegionInUse(ServerError):
     after the generation finishes (or cancel it first).  Turning this
     race into a typed conflict is what keeps a concurrent unregister
     from crashing (or silently corrupting) the zero-copy data plane."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=409)
+
+
+class KvExportNotFound(ServerError):
+    """A KV-export descriptor fetch (or attach) named a generation id
+    with no live ``kvexport/<gen_id>`` region — never exported, already
+    dropped, or TTL-expired with its replay entry — HTTP 404 / gRPC
+    NOT_FOUND.  The caller falls back to the fused (re-prefill) path;
+    answering a typed 404 here is what keeps a dropped region from
+    surfacing later as a crash inside the ``paged_gather`` scatter."""
+
+    def __init__(self, msg):
+        super().__init__(msg, code=404)
+
+
+class KvExportConflict(ServerError):
+    """A KV export was claimed twice: the transfer contract is
+    one-shot (exactly one decode-role replica re-scatters a prefill
+    leg's pages), so a second descriptor fetch for the same generation
+    is a typed conflict — HTTP 409 / gRPC ABORTED — not a silent
+    double-attach racing the first consumer's drop."""
 
     def __init__(self, msg):
         super().__init__(msg, code=409)

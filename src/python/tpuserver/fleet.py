@@ -150,11 +150,16 @@ class ReplicaProcess:
     ``backoff`` → … → ``retired``).  All mutable state is owned by the
     supervisor's monitor thread; readers go through :meth:`stats`."""
 
-    def __init__(self, index, host, port, scope):
+    def __init__(self, index, host, port, scope, role=None):
         self.index = index
         self.host = host
         self.port = port
         self.scope = scope
+        # phase role ("prefill"/"decode") or None for a fused replica;
+        # immutable for the handle's lifetime — healing respawns the
+        # process with the same role, so a phase pool never shrinks
+        # because one of its members crashed
+        self.role = role
         self.url = "{}:{}".format(host, port)
         self._lock = threading.Lock()
         self.proc = None           # guarded-by: _lock
@@ -180,6 +185,7 @@ class ReplicaProcess:
                 "index": self.index,
                 "url": self.url,
                 "scope": self.scope,
+                "role": self.role,
                 "state": self.state,
                 "pid": self.proc.pid if self.proc is not None else None,
                 "restarts": self.restarts,
@@ -288,7 +294,16 @@ class FleetSupervisor:
         and ``{index}`` are substituted per spawn (see
         ``tools/fleet.py --serve-replica`` for the default server).
     replicas / min_replicas / max_replicas
-        Initial process count and the elastic-scaling bounds.
+        Initial process count and the elastic-scaling bounds.  With
+        role pools the bounds apply PER POOL (each phase scales
+        between them independently).
+    prefill_replicas / decode_replicas
+        Opt-in disaggregated prefill/decode: spawn this many replicas
+        per phase role (both must be >= 1 when either is set).  Each
+        role-tagged replica gets ``--role <role>`` appended to its
+        argv, advertises the role in its health snapshot, and is
+        healed/scaled within its own pool; ``replicas`` then only adds
+        extra fused capacity on top (its default is ignored).
     probe_interval_s / probe_timeout_s
         Monitor cadence and per-probe timeout.
     start_timeout_s
@@ -353,8 +368,22 @@ class FleetSupervisor:
                  scale_cooldown_s=2.0, scope_prefix="fleet-r",
                  router_kwargs=None, env=None, verbose=False,
                  router_command=None, router_standby=False,
-                 router_journal=None, router_port=0, standby_port=0):
-        if replicas < 1:
+                 router_journal=None, router_port=0, standby_port=0,
+                 prefill_replicas=0, decode_replicas=0):
+        prefill_replicas = int(prefill_replicas)
+        decode_replicas = int(decode_replicas)
+        role_mode = prefill_replicas > 0 or decode_replicas > 0
+        if role_mode and (prefill_replicas < 1 or decode_replicas < 1):
+            raise ValueError(
+                "a phase-split fleet needs at least one replica of "
+                "EACH role (got prefill={}, decode={}) — a missing "
+                "pool would silently serve every request fused"
+                .format(prefill_replicas, decode_replicas))
+        if role_mode:
+            # role mode: the per-role targets ARE the fleet; 'replicas'
+            # only adds extra fused capacity on top when given
+            replicas = max(0, int(replicas)) if replicas != 2 else 0
+        if replicas < 1 and not role_mode:
             raise ValueError("a fleet needs at least one replica")
         if min_replicas < 1 or (max_replicas is not None
                                 and max_replicas < min_replicas):
@@ -401,13 +430,22 @@ class FleetSupervisor:
         self._router_restarts = 0  # guarded-by: _lock
         self._router_takeovers = 0  # guarded-by: _lock
         self._router_retired = 0   # guarded-by: _lock
-        self._up_streak = 0
-        self._down_streak = 0
         self._cooldown_until = 0.0
         self._stop = threading.Event()
         self._monitor = None
+        # per-role scaling streaks (keys: None/"prefill"/"decode") —
+        # each phase pool accumulates pressure independently, so a
+        # decode-heavy workload grows decode capacity without touching
+        # the prefill pool (and vice versa)
+        self._role_up_streaks = {}
+        self._role_down_streaks = {}
+        self._role_mode = role_mode
         for _ in range(int(replicas)):
             self._register_handle()
+        for _ in range(prefill_replicas):
+            self._register_handle(role="prefill")
+        for _ in range(decode_replicas):
+            self._register_handle(role="decode")
         self._router_command = (list(router_command)
                                 if router_command else None)
         self._router_standby = bool(router_standby)
@@ -449,16 +487,17 @@ class FleetSupervisor:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def _register_handle(self):
+    def _register_handle(self, role=None):
         """Allocate a port + scope and register a fresh handle (called
-        from __init__ and scale-up)."""
+        from __init__ and scale-up; ``role`` tags a phase-pool
+        member)."""
         port = _free_port(self._host)
         with self._lock:
             index = self._next_index
             self._next_index += 1
             handle = ReplicaProcess(
                 index, self._host, port,
-                "{}{}".format(self._scope_prefix, index))
+                "{}{}".format(self._scope_prefix, index), role=role)
             self._handles.append(handle)
         return handle
 
@@ -530,6 +569,11 @@ class FleetSupervisor:
                      index=handle.index)
             for t in self._command
         ]
+        if handle.role:
+            # phase-pool member: the replica advertises its role in
+            # /v2/health/stats so the router's prober can partition
+            # the fleet into prefill/decode pools
+            argv += ["--role", handle.role]
         env = dict(os.environ)
         env.update(self._env)
         try:
@@ -998,25 +1042,48 @@ class FleetSupervisor:
                 self._begin_restart(
                     handle, "never became ready", drain=True)
         self._evaluate_scaling(
-            [u for h, u in utils if h.stats()["state"] == "up"], now)
+            [(h, u) for h, u in utils if h.stats()["state"] == "up"],
+            now)
 
     # -- elastic scaling ---------------------------------------------------
 
-    def _evaluate_scaling(self, utils, now):
-        if not utils:
+    def _evaluate_scaling(self, pairs, now):
+        """Role-aware elastic scaling: each phase pool (``prefill`` /
+        ``decode`` / fused ``None``) accumulates its own hysteresis
+        streaks from its own members' utilization and scales between
+        ``min_replicas``/``max_replicas`` (interpreted per pool)
+        independently — a prompt-heavy workload grows the prefill pool
+        without adding idle decode capacity, and vice versa.  Streak
+        accounting always runs; at most one scaling ACTION fires per
+        tick, and the global cooldown + settling gates cover every
+        pool (a booting prefill spawn also defers decode actions — the
+        fleet mean is in flux either way)."""
+        if not pairs:
             return
-        fleet_util = sum(utils) / len(utils)
-        if fleet_util >= self._scale_high:
-            self._up_streak += 1
-            self._down_streak = 0
-        elif fleet_util <= self._scale_low:
-            self._down_streak += 1
-            self._up_streak = 0
-        else:
-            # the hysteresis band: a noisy middle window resets both
-            # streaks — scaling only ever fires on SUSTAINED signal
-            self._up_streak = 0
-            self._down_streak = 0
+        by_role = {}
+        for handle, util in pairs:
+            by_role.setdefault(handle.role, []).append(util)
+        ready = []
+        for role in sorted(by_role,
+                           key=lambda r: (r is not None, r or "")):
+            utils = by_role[role]
+            pool_util = sum(utils) / len(utils)
+            up = self._role_up_streaks.get(role, 0)
+            down = self._role_down_streaks.get(role, 0)
+            if pool_util >= self._scale_high:
+                up += 1
+                down = 0
+            elif pool_util <= self._scale_low:
+                down += 1
+                up = 0
+            else:
+                # the hysteresis band: a noisy middle window resets
+                # both streaks — scaling only fires on SUSTAINED signal
+                up = 0
+                down = 0
+            self._role_up_streaks[role] = up
+            self._role_down_streaks[role] = down
+            ready.append((role, pool_util, up, down))
         if now < self._cooldown_until:
             return
         states = [h.stats()["state"] for h in self._handles_snapshot()]
@@ -1027,38 +1094,45 @@ class FleetSupervisor:
             # so acting again would double-fire — e.g. a scale-up's
             # replica boots slower than the streak re-accumulates
             return
-        active = [h for h in self._handles_snapshot()
-                  if h.stats()["state"] != "retired"]
-        if (self._up_streak >= self._scale_up_windows
-                and (self._max_replicas is None
-                     or len(active) < self._max_replicas)):
-            self._up_streak = 0
-            self._cooldown_until = now + self._scale_cooldown_s
-            with self._lock:
-                self._scale_ups += 1
-            handle = self._register_handle()
-            self._log(
-                "scale-up: fleet utilization {:.2f} sustained — "
-                "spawning replica {}".format(fleet_util, handle.url))
-            self._spawn(handle)
-        elif (self._down_streak >= self._scale_down_windows
-                and len(active) > self._min_replicas):
-            self._down_streak = 0
-            self._cooldown_until = now + self._scale_cooldown_s
-            ups = [h for h in active if h.stats()["state"] == "up"]
-            if not ups:
+        for role, pool_util, up, down in ready:
+            pool = [h for h in self._handles_snapshot()
+                    if h.role == role and h.stats()["state"] != "retired"]
+            label = role or "fused"
+            if (up >= self._scale_up_windows
+                    and (self._max_replicas is None
+                         or len(pool) < self._max_replicas)):
+                self._role_up_streaks[role] = 0
+                self._cooldown_until = now + self._scale_cooldown_s
+                with self._lock:
+                    self._scale_ups += 1
+                handle = self._register_handle(role=role)
+                self._log(
+                    "scale-up: {} pool utilization {:.2f} sustained — "
+                    "spawning replica {}".format(
+                        label, pool_util, handle.url))
+                self._spawn(handle)
                 return
-            # drain the least-loaded, youngest replica
-            victim = min(
-                ups, key=lambda h: (h.stats()["utilization"], -h.index))
-            with self._lock:
-                self._scale_downs += 1
-            with victim._lock:
-                victim.scale_down = True
-            self._log(
-                "scale-down: fleet utilization {:.2f} sustained — "
-                "draining replica {}".format(fleet_util, victim.url))
-            self._begin_restart(victim, "scale-down", drain=True)
+            if (down >= self._scale_down_windows
+                    and len(pool) > self._min_replicas):
+                self._role_down_streaks[role] = 0
+                self._cooldown_until = now + self._scale_cooldown_s
+                ups = [h for h in pool if h.stats()["state"] == "up"]
+                if not ups:
+                    continue
+                # drain the least-loaded, youngest replica of the pool
+                victim = min(
+                    ups,
+                    key=lambda h: (h.stats()["utilization"], -h.index))
+                with self._lock:
+                    self._scale_downs += 1
+                with victim._lock:
+                    victim.scale_down = True
+                self._log(
+                    "scale-down: {} pool utilization {:.2f} sustained "
+                    "— draining replica {}".format(
+                        label, pool_util, victim.url))
+                self._begin_restart(victim, "scale-down", drain=True)
+                return
 
     # -- observability -----------------------------------------------------
 
@@ -1082,6 +1156,16 @@ class FleetSupervisor:
             router_retired = self._router_retired
         out["replicas"] = [h.stats() for h in handles]
         out["up"] = sum(1 for r in out["replicas"] if r["state"] == "up")
+        if self._role_mode:
+            # phase-pool occupancy: up-replica counts per role (what
+            # tests/test_disagg.py asserts on after role-aware scaling
+            # and healing)
+            phase_up = {}
+            for row in out["replicas"]:
+                if row["state"] == "up":
+                    key = row["role"] or "fused"
+                    phase_up[key] = phase_up.get(key, 0) + 1
+            out["phase_replicas_up"] = phase_up
         if router_handles:
             # the supervised front tier (router_command mode)
             out["router_restarts"] = router_restarts

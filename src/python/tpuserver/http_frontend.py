@@ -46,6 +46,9 @@ _SHM_URI = re.compile(
 _REPO_URI = re.compile(
     r"^/v2/repository(/models/(?P<model>[^/]+)/(?P<verb>load|unload)|/index)$"
 )
+_KVEXPORT_URI = re.compile(
+    r"^/v2/kvexport/(?P<gen>[^/]+)(?P<release>/release)?$"
+)
 
 
 def _array_from_json_data(data, datatype, shape):
@@ -138,6 +141,25 @@ class _Handler(BaseHttpHandler):
                     )["settings"]
                 )
             return self._send_json(core.get_trace_settings()["settings"])
+
+        m = _KVEXPORT_URI.match(path)
+        if m:
+            # disaggregated transfer control plane: GET hands out the
+            # one-shot wire descriptor of a prefill leg's KV export
+            # (typed 404 when gone, 409 when already claimed); POST
+            # .../release drops it (idempotent) once the decode leg
+            # admitted — or never, and the replay TTL sweep reaps it
+            gen_id = unquote(m.group("gen"))
+            if m.group("release"):
+                if method != "POST":
+                    raise ServerError(
+                        "kvexport release requires POST", code=405)
+                core.drop_kv_region(gen_id)
+                return self._send_json({})
+            if method != "GET":
+                raise ServerError(
+                    "kvexport descriptor fetch requires GET", code=405)
+            return self._send_json(core.kv_export_descriptor(gen_id))
 
         m = _REPO_URI.match(path)
         if m:

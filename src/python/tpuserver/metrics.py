@@ -224,6 +224,41 @@ CATALOG = {
         "Standby-to-active promotions this router performed (the "
         "warm-standby takeover signal: POST /router/promote, SIGUSR1, "
         "or the fleet supervisor on active-router death)."),
+    # -- disaggregated prefill/decode (phase-split serving) ----------------
+    "tpu_disagg_splits_total": (
+        "counter",
+        "Generations served phase-split: prefill leg on a prefill "
+        "replica, KV pages exported, decode leg attached on a decode "
+        "replica (no re-prefill)."),
+    "tpu_disagg_fallbacks_total": (
+        "counter",
+        "Phase-split admissions that degraded to the fused path, by "
+        "reason (no_prefill_replica, prefill_rejected, prefill_died, "
+        "descriptor_missing, descriptor_conflict, "
+        "descriptor_unreachable, prefill_died_after_token). Every "
+        "fallback is token-identical to a fused run."),
+    "tpu_disagg_transfers_total": (
+        "counter",
+        "KV-export descriptors fetched for cross-replica attach "
+        "(one-shot claim per generation)."),
+    "tpu_disagg_transfer_bytes_total": (
+        "counter",
+        "Bytes of exported KV cache referenced by fetched descriptors "
+        "(host-synced on the prefill replica at descriptor time)."),
+    "tpu_disagg_transfer_seconds_total": (
+        "counter",
+        "Wall time spent fetching KV-export descriptors (includes the "
+        "prefill replica's device-to-host sync of the region)."),
+    "tpu_disagg_prefill_queue_seconds_total": (
+        "counter",
+        "Wall time from prefill-leg dispatch to its first (and only) "
+        "token — prefill queue + prefill compute as seen by the "
+        "router."),
+    "tpu_disagg_phase_queue_depth": (
+        "gauge",
+        "Queued plus live work per fleet phase ('phase' label: "
+        "prefill / decode / fused) from the router's health "
+        "snapshots."),
     # -- fleet supervisor (process-level healing) --------------------------
     "tpu_fleet_replica_restarts_total": (
         "counter", "Replica processes healed by the supervisor."),

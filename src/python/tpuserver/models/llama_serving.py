@@ -604,6 +604,14 @@ class LlamaGenerateModel(Model):
             gen_id = str(request.parameters.get("generation_id")
                          or uuid.uuid4().hex)
             kv_park = request.parameters.get("kv_park")
+            # disaggregated phase split: a prefill-leg admission
+            # (kv_phase=prefill) exports its KV when it finishes so a
+            # decode replica can attach it; a decode-leg admission
+            # (kv_attach=<descriptor>) imports that export and scatters
+            # instead of re-prefilling (docs/resilience.md
+            # "Disaggregated prefill/decode")
+            kv_prefill = request.parameters.get("kv_phase") == "prefill"
+            attach_cache, attach_pos = self._attach_from_params(request)
             stream = scheduler.submit(
                 prompt, max_tokens, eos_id=eos_id,
                 resume_cache=(jnp.asarray(parked)
@@ -618,8 +626,12 @@ class LlamaGenerateModel(Model):
                 prompt_dev=prompt_dev,
                 # park-export opt-in: the request's kv_park parameter,
                 # defaulting to the model-level kv_export flag
-                kv_export=(self._kv_export if kv_park is None
-                           else bool(kv_park)),
+                kv_export=(True if kv_prefill
+                           else (self._kv_export if kv_park is None
+                                 else bool(kv_park))),
+                kv_export_on_finish=kv_prefill,
+                attach_cache=attach_cache,
+                attach_pos=attach_pos,
             )
             seq = 0
         for token, logprob in stream:
@@ -642,6 +654,33 @@ class LlamaGenerateModel(Model):
                     },
                 }
             seq += 1
+
+    def _attach_from_params(self, request):
+        """``(imported cache, position)`` for a ``kv_attach``
+        descriptor — the decode leg of a phase-split admission — or
+        ``(None, 0)`` when the parameter is absent or the export is no
+        longer importable (dropped, expired, malformed): the admission
+        then runs the ordinary prefill path, token-identical, just
+        slower.  The typed 404/409 edges live on the descriptor FETCH
+        (``/v2/kvexport/<gid>``); by attach time the orchestrator
+        already holds a claim, so degrading gracefully here is what
+        makes a mid-handoff export death user-invisible."""
+        desc = request.parameters.get("kv_attach")
+        if not desc or self._server is None:
+            return None, 0
+        if isinstance(desc, (bytes, str)):
+            import json
+
+            try:
+                desc = json.loads(desc)
+            except ValueError:
+                return None, 0
+        from tpuserver.errors import KvExportNotFound
+
+        try:
+            return self._server.import_kv_descriptor(desc)
+        except KvExportNotFound:
+            return None, 0
 
     def healthy(self):
         """Readiness probe hook: False once the decode loop tripped

@@ -85,6 +85,7 @@ import zlib
 from collections import OrderedDict
 
 from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
+from tpuserver.disagg import PhaseSplitOrchestrator
 from tpuserver.journal import JournalFollower, JournalWriter, read_journal
 from tpuserver.metrics import (
     MetricsRegistry,
@@ -386,6 +387,21 @@ class _Replica:
             self._snapshot = snap
             self._eligible = eligible
             self._load = load
+
+    def health(self):
+        """The last probe's raw health snapshot (None while
+        unreachable) — where phase-aware consumers read role and
+        per-model queue depth from."""
+        with self._lock:
+            return self._snapshot
+
+    def role(self):
+        """The replica's advertised disaggregated-serving role
+        (``"prefill"`` / ``"decode"``), or None for fused replicas and
+        while no snapshot is held — an unreachable replica belongs to
+        no phase pool."""
+        snap = self.health()
+        return snap.get("role") if isinstance(snap, dict) else None
 
     def mark_unreachable(self):
         """A probe or request could not reach the replica: rotate it
@@ -1201,6 +1217,11 @@ class FleetRouter:
         self.metrics = MetricsRegistry()
         self.metrics.register_collector(self._collect_metrics)
         self._aggregator = _FleetMetricsAggregator()
+        # disaggregated prefill/decode admission (tpuserver.disagg):
+        # engages only when the prober sees BOTH role pools, so a
+        # role-less (or single-replica) fleet rides today's fused
+        # path byte-identically
+        self.disagg = PhaseSplitOrchestrator(self)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -1623,13 +1644,16 @@ class FleetRouter:
         return zlib.crc32(
             ",".join(str(int(t)) for t in head).encode("ascii"))
 
-    def pick_for_generation(self, gen, exclude=()):
+    def pick_for_generation(self, gen, exclude=(), replicas=None):
         """Route one generation admission (or handoff) with prefix
         affinity: siblings of a recently routed prompt prefix land on
         the replica whose radix cache already holds it, so the
         fleet-wide prefix-cache hit rate tracks the per-replica one.
         The chosen replica (affine or not) becomes the prefix's new
-        home, so a failover or handoff moves the warm set with it."""
+        home, so a failover or handoff moves the warm set with it.
+        ``replicas`` restricts the candidate set (the disagg
+        orchestrator passes the prefill pool — the radix caches the
+        affinity map points at live there)."""
         key = self._affinity_key(gen.prompt)
         prefer = None
         if key is not None:
@@ -1638,7 +1662,8 @@ class FleetRouter:
                 entry = self._affinity.get(key)
                 if entry is not None and entry[1] > now:
                     prefer = entry[0]
-        rep = self.pick_replica(exclude=exclude, prefer=prefer)
+        rep = self.pick_replica(exclude=exclude, replicas=replicas,
+                                prefer=prefer)
         if rep is None or key is None:
             return rep
         # the map update is last-writer-wins by design: two racing
@@ -1914,6 +1939,7 @@ class FleetRouter:
             }
         journal = self._journal
         out["journal"] = journal.stats() if journal is not None else None
+        out["disagg"] = self.disagg.stats()
         out["replicas"] = [rep.stats() for rep in self._replicas_snapshot()]
         stats_fn = self._supervisor_stats
         if stats_fn is not None:
@@ -1958,6 +1984,31 @@ class FleetRouter:
                 ("tpu_router_journal_fsyncs_total",
                  [({}, journal.get("fsyncs", 0))]),
             ])
+        disagg = snap.get("disagg")
+        if isinstance(disagg, dict):
+            families.extend([
+                ("tpu_disagg_splits_total", [({}, disagg["splits"])]),
+                ("tpu_disagg_transfers_total",
+                 [({}, disagg["transfers"])]),
+                ("tpu_disagg_transfer_bytes_total",
+                 [({}, disagg["transfer_bytes"])]),
+                ("tpu_disagg_transfer_seconds_total",
+                 [({}, disagg["transfer_ms_total"] / 1000.0)]),
+                ("tpu_disagg_prefill_queue_seconds_total",
+                 [({}, disagg["prefill_queue_ms_total"] / 1000.0)]),
+            ])
+            fallbacks = disagg.get("fallbacks") or {}
+            if fallbacks:
+                families.append((
+                    "tpu_disagg_fallbacks_total",
+                    [({"reason": reason}, count)
+                     for reason, count in sorted(fallbacks.items())]))
+            depths = disagg.get("phase_queue_depth") or {}
+            if depths:
+                families.append((
+                    "tpu_disagg_phase_queue_depth",
+                    [({"phase": phase}, depth)
+                     for phase, depth in sorted(depths.items())]))
         eligible, load, state, p90 = [], [], [], []
         for rep in snap["replicas"]:
             labels = {"replica": rep["url"]}
@@ -2714,6 +2765,10 @@ class _RouterHandler(BaseHttpHandler):
         router = self.router
         snapshot = gen.snapshot()
         rep = None
+        # armed by a phase-split plan: frees the prefill replica's KV
+        # export once the decode leg's first token proves the attach
+        # consumed it (the replay-TTL sweep is the backstop)
+        release_export = None
         if resuming and snapshot["home"] is not None:
             rep = router.replica_by_url(snapshot["home"])
         if resuming and rep is not None:
@@ -2761,12 +2816,39 @@ class _RouterHandler(BaseHttpHandler):
                 headers = {"Content-Type": "application/json"}
                 resuming = False
         else:
-            # fresh admission: prefix affinity steers siblings of a
-            # warm prompt prefix to the replica already holding it
-            rep = router.pick_for_generation(gen)
-            body, headers = gen.upstream_request(resuming=False)
-            if rep is not None:
-                gen.set_home(rep.url)
+            # fresh admission: phase-split it when both role pools are
+            # routable (tpuserver.disagg) — the prefill leg's token has
+            # already relayed by the time a plan comes back, and the
+            # decode leg below is handoff-shaped, so every later
+            # failure heals on the existing machinery
+            plan = router.disagg.try_admit(self, gen)
+            if plan is not None:
+                terminal = plan.get("terminal")
+                if terminal == "complete":
+                    gen.complete()
+                    self._ensure_started()
+                    self._send_chunk(b'data: {"final": true}\n\n')
+                    self._end_chunks()
+                    return
+                if terminal == "error":
+                    # typed in-band failure already relayed: terminal
+                    router.drop_generation(gen.gen_id)
+                    self._end_chunks()
+                    return
+                if terminal == "fail":
+                    return self._stream_fail(
+                        gen, "generation '{}' lost its prefill leg and "
+                             "is not handoff-capable".format(gen.gen_id))
+                rep = plan["rep"]
+                body, headers = plan["body"], plan["headers"]
+                release_export = plan.get("release")
+            else:
+                # prefix affinity steers siblings of a warm prompt
+                # prefix to the replica already holding it
+                rep = router.pick_for_generation(gen)
+                body, headers = gen.upstream_request(resuming=False)
+                if rep is not None:
+                    gen.set_home(rep.url)
         attempts = 0
         max_attempts = 2 * len(router._replicas_snapshot()) + 2
         while True:
@@ -2801,8 +2883,10 @@ class _RouterHandler(BaseHttpHandler):
                             "generate_stream",
                             time.monotonic() - admitted_at)
 
-                    outcome = self._relay_events(
-                        gen, resp, _note_ttft if ttft_fresh else None)
+                    on_first = (_note_ttft if ttft_fresh
+                                else release_export)
+                    release_export = None  # one-shot
+                    outcome = self._relay_events(gen, resp, on_first)
             except (ConnectionError, socket.timeout, OSError,
                     http.client.HTTPException):
                 outcome = "died"

@@ -232,11 +232,16 @@ class _Stream:
         # host staging), the park-export opt-in, and the attach-resume
         # state a same-host resume scatters instead of re-prefilling
         "prompt_dev", "kv_export", "attach_cache", "attach_pos",
+        # disaggregated prefill phase (ISSUE 16): export the KV on
+        # FINISH (not just cancel-reap) and keep the export alive past
+        # the completed park — a decode-role replica attaches it
+        "kv_export_on_finish",
     )
 
     def __init__(self, prompt, max_tokens, eos_id, resume_cache,
                  resume_pos, on_finish, deadline=None, generation_id=None,
-                 prompt_dev=None, kv_export=False):
+                 prompt_dev=None, kv_export=False,
+                 kv_export_on_finish=False):
         import queue as _queue
 
         self.prompt = prompt
@@ -269,6 +274,7 @@ class _Stream:
         self.span_pages = 0      # reserved logical pages
         self.prompt_dev = prompt_dev  # device prompt view, or None
         self.kv_export = bool(kv_export)
+        self.kv_export_on_finish = bool(kv_export_on_finish)
         self.attach_cache = None  # imported KV export (device array)
         self.attach_pos = 0       # its valid-prefix end position
 
@@ -474,7 +480,9 @@ class DecodeScheduler:
 
     def submit(self, prompt, max_tokens, eos_id=None, resume_cache=None,
                resume_pos=0, on_finish=None, deadline=None,
-               generation_id=None, prompt_dev=None, kv_export=False):
+               generation_id=None, prompt_dev=None, kv_export=False,
+               kv_export_on_finish=False, attach_cache=None,
+               attach_pos=0):
         """Enqueue one generation; returns an iterator of
         ``(token, logprob)`` pairs that blocks as the decode loop
         produces them.
@@ -489,7 +497,18 @@ class DecodeScheduler:
         generation *resumable*: its tokens are retained in the replay
         buffer after disconnect or completion and
         :meth:`resume` continues it with no duplicated or missing
-        tokens."""
+        tokens.
+
+        Disaggregated-serving hooks (ISSUE 16): ``kv_export_on_finish``
+        exports the KV through the ``kv_export`` hook when the
+        generation FINISHES (the prefill-phase leg completes after one
+        token) and keeps the export alive past the completed park so a
+        decode-role replica can attach it; ``attach_cache`` /
+        ``attach_pos`` admit over an imported KV export — the cache
+        scatters into a fresh page span and only ``prompt[attach_pos
+        - 1:]`` force-feeds, skipping the re-prefill entirely (the
+        decode-phase leg).  An out-of-range ``attach_pos`` falls back
+        to the ordinary prefill path, gracefully."""
         prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("PROMPT_IDS must be non-empty")
@@ -505,7 +524,18 @@ class DecodeScheduler:
                          resume_cache, int(resume_pos), on_finish,
                          deadline=deadline, generation_id=generation_id,
                          prompt_dev=prompt_dev,
-                         kv_export=kv_export and resume_cache is None)
+                         kv_export=kv_export and resume_cache is None,
+                         kv_export_on_finish=(
+                             kv_export_on_finish and kv_export
+                             and resume_cache is None
+                             and generation_id is not None))
+        if (attach_cache is not None and resume_cache is None
+                and 0 < int(attach_pos) <= len(prompt)):
+            # phase-split decode admission: scatter the imported export
+            # instead of prefilling; an out-of-range position falls
+            # back to the prefill path (token-identical, just slower)
+            stream.attach_cache = attach_cache
+            stream.attach_pos = int(attach_pos)
         with self._cond:
             if self._closed:
                 raise SchedulerClosed("scheduler is shut down")
@@ -1002,7 +1032,13 @@ class DecodeScheduler:
             stream.resume_cache = None
             stream.on_finish = None
             stream.attach_cache = None
-            if self._kv_discard is not None and stream.kv_export:
+            if (self._kv_discard is not None and stream.kv_export
+                    and not stream.kv_export_on_finish):
+                # a phase-export (kv_export_on_finish) OUTLIVES the
+                # completed park on purpose: the decode-role replica
+                # attaches it after this generation's prefill leg
+                # finished.  It still dies with the replay entry's TTL
+                # sweep (or an explicit drop), so nothing leaks.
                 self._kv_discard(stream.generation_id)
         self._replay[stream.generation_id] = (
             stream, completed, now + self._replay_ttl_s
@@ -1514,6 +1550,12 @@ class DecodeScheduler:
                     return
                 finally:
                     self._beat(epoch, None)
+            if stream.kv_export_on_finish:
+                # disaggregated prefill leg: the finished generation's
+                # KV (prompt + the one emitted token) exports BEFORE
+                # its pages free — the decode-role replica attaches
+                # this region instead of re-prefilling
+                export_kv(stream)
             release_pages(stream)
             self._deliver(stream, ("done", None, None), epoch)
             clear_slot(slot)

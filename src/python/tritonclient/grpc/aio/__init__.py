@@ -8,11 +8,16 @@ decoupled-model friendly (reference aio/__init__.py:729-829).
 """
 
 import asyncio
+import time
 
 import grpc
 
+from tritonclient._auxiliary import RetryPolicy  # noqa: F401
 from tritonclient.grpc import grpc_service_pb2 as pb
-from tritonclient.grpc._client import KeepAliveOptions  # noqa: F401
+from tritonclient.grpc._client import (  # noqa: F401
+    _RETRYABLE_CODES,
+    KeepAliveOptions,
+)
 from tritonclient.grpc._infer_input import (  # noqa: F401
     InferInput,
     InferRequestedOutput,
@@ -23,6 +28,7 @@ from tritonclient.grpc._utils import (
     _get_inference_request,
     get_error_grpc,
     raise_error_grpc,
+    retry_after_from_rpc_error,
 )
 from tritonclient.utils import InferenceServerException, raise_error
 
@@ -43,17 +49,11 @@ class InferenceServerClient:
         channel_args=None,
         retry_policy=None,
     ):
-        if retry_policy is not None:
-            # reject loudly instead of silently ignoring the kwarg —
-            # a caller passing a policy here believes they have retry
-            # protection they do not have
-            raise NotImplementedError(
-                "retry_policy / EndpointPool are not supported on the "
-                "asyncio gRPC client yet (ISSUE 3 'Health-aware "
-                "multi-replica client' covers the sync clients only); "
-                "use tritonclient.grpc.InferenceServerClient or an "
-                "asyncio-side retry wrapper"
-            )
+        # same unary-RPC classification the sync client applies
+        # (tritonclient.grpc._client._call): RESOURCE_EXHAUSTED always
+        # retries, UNAVAILABLE only when a retry-after trailer proves a
+        # typed shed or the detail string marks a connect-phase failure
+        self._retry_policy = retry_policy
         if keepalive_options is None:
             keepalive_options = KeepAliveOptions()
         options = [
@@ -114,18 +114,79 @@ class InferenceServerClient:
             return None
         return tuple(headers.items())
 
+    @staticmethod
+    def _is_connect_failure(rpc_error):
+        from tritonclient.grpc._client import InferenceServerClient as _Sync
+
+        return _Sync._is_connect_failure(rpc_error)
+
     async def _call(self, name, request, headers=None, timeout=None):
+        """One unary RPC with the opt-in retry policy applied — the
+        asyncio twin of the sync client's ``_call``: RESOURCE_EXHAUSTED
+        always retries (a typed shed), UNAVAILABLE only when the
+        retry-after trailer proves a shed or the detail marks a
+        connect-phase failure; DEADLINE_EXCEEDED and every other code
+        may have executed server-side and propagates immediately."""
         if self._verbose:
             print("{}, metadata {}\n{}".format(name, headers, request))
-        try:
-            response = await getattr(self._stub, name)(
-                request, metadata=self._metadata(headers), timeout=timeout
-            )
-            if self._verbose:
-                print(response)
-            return response
-        except grpc.RpcError as rpc_error:
-            raise_error_grpc(rpc_error)
+        policy = self._retry_policy
+        # the retry loop's wall-clock budget: the sooner of the
+        # caller's RPC timeout and the policy's max_total_s
+        budget_s = None
+        if policy is not None:
+            if timeout is not None:
+                budget_s = float(timeout)
+            if policy.max_total_s is not None:
+                budget_s = (
+                    policy.max_total_s
+                    if budget_s is None
+                    else min(budget_s, policy.max_total_s)
+                )
+        budget_deadline = (
+            time.monotonic() + budget_s if budget_s is not None else None
+        )
+        attempt = 0
+        while True:
+            try:
+                response = await getattr(self._stub, name)(
+                    request, metadata=self._metadata(headers),
+                    timeout=timeout,
+                )
+                if self._verbose:
+                    print(response)
+                return response
+            except grpc.RpcError as rpc_error:
+                code = rpc_error.code() if policy is not None else None
+                retry_after = (
+                    retry_after_from_rpc_error(rpc_error)
+                    if code in _RETRYABLE_CODES
+                    else None
+                )
+                if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                    retryable = True
+                elif code == grpc.StatusCode.UNAVAILABLE:
+                    retryable = retry_after is not None or (
+                        policy.retry_connection_errors
+                        and self._is_connect_failure(rpc_error)
+                    )
+                else:
+                    retryable = False
+                remaining = (
+                    budget_deadline - time.monotonic()
+                    if budget_deadline is not None
+                    else None
+                )
+                if (
+                    retryable
+                    and attempt + 1 < policy.max_attempts
+                    and (remaining is None or remaining > 0)
+                ):
+                    await asyncio.sleep(
+                        policy.backoff_s(attempt, retry_after, remaining)
+                    )
+                    attempt += 1
+                    continue
+                raise_error_grpc(rpc_error)
 
     @staticmethod
     def _as_json(message, as_json):

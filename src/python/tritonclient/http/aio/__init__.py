@@ -6,12 +6,14 @@ Shares the wire codec with the sync client: request bodies come from
 are parsed by ``InferResult.from_response_body``.
 """
 
+import asyncio
 import gzip
 import zlib
 from urllib.parse import quote
 
 import aiohttp
 
+from tritonclient._auxiliary import RetryPolicy  # noqa: F401
 from tritonclient.http._infer_input import InferInput  # noqa: F401
 from tritonclient.http._infer_result import InferResult
 from tritonclient.http._requested_output import (  # noqa: F401
@@ -36,17 +38,11 @@ class InferenceServerClient:
         ssl_context=None,
         retry_policy=None,
     ):
-        if retry_policy is not None:
-            # reject loudly instead of silently ignoring the kwarg —
-            # a caller passing a policy here believes they have retry
-            # protection they do not have
-            raise NotImplementedError(
-                "retry_policy / EndpointPool are not supported on the "
-                "asyncio HTTP client yet (ISSUE 3 'Health-aware "
-                "multi-replica client' covers the sync clients only); "
-                "use tritonclient.http.InferenceServerClient or an "
-                "asyncio-side retry wrapper"
-            )
+        # same retry-vs-failover classification the sync client applies
+        # (tritonclient.http._client._request): retry ONLY failures the
+        # server provably did not complete — connect-phase errors and
+        # typed overload statuses (429/503, honoring Retry-After)
+        self._retry_policy = retry_policy
         scheme = "https" if ssl else "http"
         self._base_url = "{}://{}".format(scheme, url)
         self._verbose = verbose
@@ -71,23 +67,83 @@ class InferenceServerClient:
 
     # -- plumbing ----------------------------------------------------------
 
-    async def _get(self, uri, headers=None, query_params=None):
+    async def _request_once(self, method, uri, body, headers, query_params):
         if self._verbose:
-            print("GET {}, headers {}".format(uri, headers))
-        async with self._session.get(
-            "/" + uri, headers=headers, params=query_params
-        ) as resp:
-            body = await resp.read()
-            return resp, body
-
-    async def _post(self, uri, body, headers=None, query_params=None):
-        if self._verbose:
-            print("POST {}, headers {}".format(uri, headers))
-        async with self._session.post(
-            "/" + uri, data=body, headers=headers, params=query_params
+            print("{} {}, headers {}".format(method, uri, headers))
+        async with self._session.request(
+            method, "/" + uri, data=body, headers=headers,
+            params=query_params,
         ) as resp:
             rbody = await resp.read()
             return resp, rbody
+
+    async def _request(self, method, uri, body=None, headers=None,
+                       query_params=None):
+        """One logical request with the opt-in retry policy applied —
+        the asyncio twin of the sync client's ``_request``: only
+        connect-phase failures (the server never saw the request) and
+        typed overload statuses (429/503, Retry-After honored) ever
+        retry; timeouts and mid-response drops propagate immediately
+        because the server may have executed the request."""
+        policy = self._retry_policy
+        if policy is None:
+            return await self._request_once(
+                method, uri, body, headers, query_params
+            )
+        import time
+
+        budget_deadline = (
+            time.monotonic() + policy.max_total_s
+            if policy.max_total_s is not None
+            else None
+        )
+
+        def _remaining():
+            if budget_deadline is None:
+                return None
+            return budget_deadline - time.monotonic()
+
+        attempt = 0
+        while True:
+            try:
+                resp, rbody = await self._request_once(
+                    method, uri, body, headers, query_params
+                )
+            except aiohttp.ClientConnectorError:
+                # connect-phase only (refused/unresolvable — aiohttp
+                # types DNS and TCP connect failures here); an error
+                # AFTER the request was sent is NOT retried
+                remaining = _remaining()
+                if (
+                    not policy.retry_connection_errors
+                    or attempt + 1 >= policy.max_attempts
+                    or (remaining is not None and remaining <= 0)
+                ):
+                    raise
+                await asyncio.sleep(
+                    policy.backoff_s(attempt, None, remaining)
+                )
+                attempt += 1
+                continue
+            remaining = _remaining()
+            if (
+                resp.status in policy.retryable_statuses
+                and attempt + 1 < policy.max_attempts
+                and (remaining is None or remaining > 0)
+            ):
+                retry_after = resp.headers.get("Retry-After")
+                await asyncio.sleep(
+                    policy.backoff_s(attempt, retry_after, remaining)
+                )
+                attempt += 1
+                continue
+            return resp, rbody
+
+    async def _get(self, uri, headers=None, query_params=None):
+        return await self._request("GET", uri, None, headers, query_params)
+
+    async def _post(self, uri, body, headers=None, query_params=None):
+        return await self._request("POST", uri, body, headers, query_params)
 
     @staticmethod
     def _raise_if_error(resp, body):

@@ -91,6 +91,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--scope", default="stub")
+    ap.add_argument("--role", default="",
+                    help="phase role advertised in /v2/health/stats "
+                         "(prefill/decode; empty = fused) — what "
+                         "role-aware supervisor/router tests partition "
+                         "stub fleets with")
     ap.add_argument("--drain-s", type=float, default=0.1)
     ap.add_argument("--marker", default="")
     ap.add_argument("--ttl", type=float, default=0.0,
@@ -142,6 +147,12 @@ def main():
     # "delay_ms": float, "done": bool} — what makes Last-Event-ID
     # resume and token-identical handoff continuations possible
     gens = {}
+    # stub twin of the server's KV-export registry: gid -> {"claimed",
+    # "position"}; populated when a kv_phase=prefill generation
+    # finishes, one-shot claimed by the first descriptor fetch (second
+    # fetch answers the typed 409), released/404 after drop — the
+    # lifetime edges disagg router tests exercise without jax
+    kvx = {}
 
     def next_token(fed):
         # deterministic autoregressive "model": the next token depends
@@ -160,6 +171,7 @@ def main():
                 "inflight": 0,
                 "max_inflight": None,
                 "pid": os.getpid(),
+                "role": args.role or None,
                 "models": {"stub": dict(model)},
             }
 
@@ -245,6 +257,41 @@ def main():
                 return self._json(STUB_CONFIG)
             if self.path in ("/v2/models/stats", "/v2/models/stub/stats"):
                 return self._json(model_statistics())
+            if self.path.startswith("/v2/kvexport/"):
+                from urllib.parse import unquote
+
+                gid = unquote(self.path[len("/v2/kvexport/"):])
+                with lock:
+                    entry = kvx.get(gid)
+                    if entry is None:
+                        pass  # typed 404 below, outside the lock
+                    elif entry["claimed"]:
+                        entry = "claimed"
+                    else:
+                        entry["claimed"] = True
+                        position = entry["position"]
+                if entry is None:
+                    return self._json(
+                        {"error": "no kv export for generation "
+                                  "'{}'".format(gid)}, 404)
+                if entry == "claimed":
+                    return self._json(
+                        {"error": "kv export for generation '{}' was "
+                                  "already claimed".format(gid)}, 409)
+                # shaped like InferenceServer.kv_export_descriptor;
+                # the raw handle is a placeholder (a stub has no
+                # device pages) — the decode stub ignores kv_attach
+                # and recomputes, which lands on the identical stream
+                return self._json({
+                    "generation_id": gid,
+                    "name": "kvexport/" + gid,
+                    "raw_handle": "c3R1Yi1rdi1leHBvcnQ=",
+                    "position": position,
+                    "shape": [1, 1, 1, 1],
+                    "dtype": "bfloat16",
+                    "byte_size": 4096,
+                    "device_ordinal": 0,
+                })
             if self.path == "/metrics":
                 body = metrics_text().encode("utf-8")
                 self.send_response(200)
@@ -284,6 +331,7 @@ def main():
                 params = request.get("parameters") or {}
                 gid = str(params.get("generation_id") or "stubgen")
                 delay_ms = float(params.get("token_delay_ms") or 0.0)
+                kv_prefill = params.get("kv_phase") == "prefill"
             except (TypeError, ValueError):
                 return self._json(
                     {"error": "malformed generate request"}, 400)
@@ -343,6 +391,15 @@ def main():
                         entry["emitted"].append(token)
                     if delay > 0:
                         time.sleep(delay / 1000.0)
+                if kv_prefill:
+                    # the prefill leg finished: publish the export the
+                    # router's KV transfer will claim (position = every
+                    # id the virtual model consumed, scheduler-parity)
+                    with lock:
+                        kvx.setdefault(gid, {
+                            "claimed": False,
+                            "position": len(entry["fed"]),
+                        })
                 self.wfile.write(b'data: {"final": true}\n\n')
             except (BrokenPipeError, ConnectionResetError, OSError):
                 # requester hung up mid-stream (a severed router
@@ -371,6 +428,15 @@ def main():
                 })
             if self.path == "/v2/models/stub/generate_stream":
                 return self._generate_stream(body)
+            if (self.path.startswith("/v2/kvexport/")
+                    and self.path.endswith("/release")):
+                from urllib.parse import unquote
+
+                gid = unquote(
+                    self.path[len("/v2/kvexport/"):-len("/release")])
+                with lock:
+                    kvx.pop(gid, None)  # idempotent, like the server
+                return self._json({})
             if self.path != "/stub/state":
                 return self._json({"error": "unknown: " + self.path}, 404)
             update = json.loads(body or b"{}")

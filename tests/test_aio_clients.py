@@ -108,6 +108,169 @@ def test_http_aio_error(http_url):
     asyncio.run(run())
 
 
+# -- aio retry policies (same classification as the sync clients) -----------
+
+
+class _FakeHttpResp:
+    def __init__(self, status, headers=None):
+        self.status = status
+        self.headers = headers or {}
+
+
+def test_http_aio_retry_policy_retries_overload_and_connect(http_url):
+    """The asyncio HTTP client's RetryPolicy mirrors the sync
+    classification: typed overload statuses (429/503, Retry-After
+    honored) and connect-phase errors retry; anything else returns."""
+    import aiohttp
+
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(
+            http_url,
+            retry_policy=aioclient.RetryPolicy(
+                max_attempts=4, initial_backoff_s=0.001, jitter=0.0),
+        ) as c:
+            calls = {"n": 0}
+            real_once = c._request_once
+
+            async def scripted(method, uri, body, headers, query_params):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise aiohttp.ClientConnectorError(
+                        None, OSError("connection refused"))
+                if calls["n"] == 2:
+                    return _FakeHttpResp(
+                        503, {"Retry-After": "0.001"}), b"shed"
+                return await real_once(
+                    method, uri, body, headers, query_params)
+
+            c._request_once = scripted
+            assert await c.is_server_live()
+            assert calls["n"] == 3  # connect error + shed + success
+
+            # a non-retryable status returns immediately
+            calls["n"] = 0
+
+            async def not_found(method, uri, body, headers, query_params):
+                calls["n"] += 1
+                return _FakeHttpResp(404), b'{"error": "nope"}'
+
+            c._request_once = not_found
+            assert not await c.is_server_live()
+            assert calls["n"] == 1
+
+    asyncio.run(run())
+
+
+def test_http_aio_retry_policy_exhausts_attempts(http_url):
+    import aiohttp
+
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        async with aioclient.InferenceServerClient(
+            http_url,
+            retry_policy=aioclient.RetryPolicy(
+                max_attempts=3, initial_backoff_s=0.001, jitter=0.0),
+        ) as c:
+            calls = {"n": 0}
+
+            async def refused(method, uri, body, headers, query_params):
+                calls["n"] += 1
+                raise aiohttp.ClientConnectorError(
+                    None, OSError("connection refused"))
+
+            c._request_once = refused
+            with pytest.raises(aiohttp.ClientConnectorError):
+                await c.is_server_live()
+            assert calls["n"] == 3
+
+    asyncio.run(run())
+
+
+class _FakeRpcError(Exception):
+    """Stand-in grpc.RpcError with the surface the retry loop reads."""
+
+    def __init__(self, code, details="", trailing=()):
+        self._code = code
+        self._details = details
+        self._trailing = tuple(trailing)
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+    def trailing_metadata(self):
+        return self._trailing
+
+
+def test_grpc_aio_retry_policy_classification(grpc_server):
+    """RESOURCE_EXHAUSTED always retries; UNAVAILABLE retries only
+    with a retry-after trailer or a connect-phase detail;
+    DEADLINE_EXCEEDED propagates immediately."""
+    import grpc
+
+    import tritonclient.grpc.aio as aioclient
+
+    # the retry loop catches grpc.RpcError
+    class _Rpc(_FakeRpcError, grpc.RpcError):
+        pass
+
+    def scripted_client(url, script):
+        c = aioclient.InferenceServerClient(
+            url,
+            retry_policy=aioclient.RetryPolicy(
+                max_attempts=4, initial_backoff_s=0.001, jitter=0.0),
+        )
+        calls = {"n": 0}
+        real = c._stub.ServerLive
+
+        async def fake(request, metadata=None, timeout=None):
+            calls["n"] += 1
+            if calls["n"] <= len(script):
+                raise script[calls["n"] - 1]
+            return await real(request, metadata=metadata, timeout=timeout)
+
+        c._stub.ServerLive = fake
+        return c, calls
+
+    async def run():
+        url = "127.0.0.1:{}".format(grpc_server.port)
+        # typed shed then success
+        c, calls = scripted_client(url, [
+            _Rpc(grpc.StatusCode.RESOURCE_EXHAUSTED, "shed"),
+            _Rpc(grpc.StatusCode.UNAVAILABLE, "shed",
+                 trailing=(("retry-after", "0.001"),)),
+            _Rpc(grpc.StatusCode.UNAVAILABLE, "failed to connect"),
+        ])
+        assert await c.is_server_live()
+        assert calls["n"] == 4
+        await c.close()
+
+        # bare UNAVAILABLE (possibly mid-call) must NOT retry
+        c, calls = scripted_client(url, [
+            _Rpc(grpc.StatusCode.UNAVAILABLE, "stream reset mid-call"),
+        ])
+        with pytest.raises(InferenceServerException):
+            await c.is_server_live()
+        assert calls["n"] == 1
+        await c.close()
+
+        # DEADLINE_EXCEEDED propagates immediately
+        c, calls = scripted_client(url, [
+            _Rpc(grpc.StatusCode.DEADLINE_EXCEEDED, "deadline"),
+        ])
+        with pytest.raises(InferenceServerException):
+            await c.is_server_live()
+        assert calls["n"] == 1
+        await c.close()
+
+    asyncio.run(run())
+
+
 # -- grpc.aio ---------------------------------------------------------------
 
 

@@ -1,0 +1,436 @@
+"""Disaggregated prefill/decode serving (ISSUE 16 acceptance).
+
+Pins the phase-split contracts at every layer:
+
+- **token identity** (the tentpole bar): prefill leg -> KV export ->
+  descriptor fetch -> cross-core import -> decode leg produces the
+  byte-identical token stream a fused run produces, in-process on
+  CPU-sim llama cores (one ``prefill``-role core, one ``decode``-role
+  core sharing the XLA-shm region registry);
+- **lifetime edges**: a never-exported / dropped generation answers
+  the typed 404 at descriptor-fetch time, the second fetch answers the
+  typed 409 (one-shot transfer claim), drop is idempotent, and a
+  STALE descriptor (region dropped between fetch and attach) degrades
+  the decode leg to a full fused re-prefill — token-identically, never
+  a late crash inside ``paged_gather``;
+- **router orchestration**: a role-tagged stub fleet behind a
+  FleetRouter serves a generation phase-split (prefill leg on the
+  prefill pool, KV claim, decode leg attached on the decode pool) with
+  the stream token-identical to a fused stub run, while a fleet with
+  no role pools falls back to the fused path with zero disagg
+  counters moved;
+- **role-aware supervision**: ``FleetSupervisor`` honors per-role
+  replica targets, heals a SIGKILL'd prefill replica back into the
+  prefill pool (the role survives the respawn), and scales the
+  pressured pool — only that pool — up.
+
+Budget: in-process cores + fleet_stub processes (tier-1 discipline:
+tiny configs, injectable pressure, no real model fleets —
+``tools/chaos_smoke.py --disagg`` soaks the real-replica version).
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fleet_stub import free_port, wait_ready  # noqa: E402
+
+from tpuserver.core import (  # noqa: E402
+    InferenceServer,
+    InferRequest,
+    KvExportConflict,
+    KvExportNotFound,
+)
+from tpuserver.fleet import FleetSupervisor  # noqa: E402
+from tpuserver.models import llama  # noqa: E402
+from tpuserver.models.llama_serving import LlamaGenerateModel  # noqa: E402
+from tpuserver.router import FleetRouter  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STUB = os.path.join(HERE, "fleet_stub.py")
+STREAM_PATH = "/v2/models/stub/generate_stream"
+PROMPT = list(range(1, 21))
+N_TOKENS = 10
+
+
+# -- plumbing ----------------------------------------------------------------
+
+
+def _phase_core(role):
+    model = LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=64, max_slots=4,
+        restart_backoff_s=0.01)
+    return InferenceServer([model], role=role)
+
+
+def _gen(core, prompt, max_tokens, params=None):
+    req = InferRequest(
+        "llama_generate",
+        inputs={"PROMPT_IDS": np.asarray(prompt, dtype=np.int32),
+                "MAX_TOKENS": np.asarray([max_tokens], dtype=np.int32)},
+        parameters=dict(params or {}))
+    return [int(arr[0]) for resp in core.infer_stream(req)
+            for spec, arr, _ in resp.outputs if spec["name"] == "TOKEN"]
+
+
+def _wait(predicate, timeout_s=20.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return predicate()
+
+
+def _stub_body(gid, n_tokens, prompt=None):
+    prompt = PROMPT if prompt is None else prompt
+    return json.dumps({"inputs": [
+        {"name": "PROMPT_IDS", "datatype": "INT32",
+         "shape": [len(prompt)], "data": prompt},
+        {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1],
+         "data": [n_tokens]},
+    ], "parameters": {"generation_id": gid}}).encode("utf-8")
+
+
+def _stub_stream(port, body):
+    """Consume one stub/router SSE stream: ``(tokens, saw_final)``."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    conn.request("POST", STREAM_PATH, body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200, (resp.status, resp.read())
+    tokens, final = [], False
+    try:
+        for raw in resp:
+            line = raw.rstrip(b"\r\n")
+            if not line.startswith(b"data: "):
+                continue
+            payload = json.loads(line[len(b"data: "):])
+            if payload.get("final"):
+                final = True
+                break
+            assert "error" not in payload, payload
+            tokens.append(payload["outputs"][0]["data"][0])
+    finally:
+        conn.close()
+    return tokens, final
+
+
+def _spawn_stub(role=None):
+    port = free_port()
+    cmd = [sys.executable, STUB, "--port", str(port)]
+    if role:
+        cmd += ["--role", role]
+    proc = subprocess.Popen(cmd)
+    assert wait_ready(port), "stub replica never became ready"
+    return port, proc
+
+
+def _kill_all(procs):
+    for proc in procs:
+        try:
+            proc.kill()
+        except OSError:
+            pass
+    for proc in procs:
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+# -- the tentpole: in-process phase-split token identity ---------------------
+
+
+def test_phase_split_token_identity_and_stale_attach_fallback():
+    """THE acceptance A/B: prefill-leg -> export -> descriptor ->
+    cross-core attach -> decode-leg tokens == fused tokens, exactly;
+    and a descriptor whose region died between fetch and attach
+    degrades the decode leg to a fused re-prefill, still
+    token-identical (the 404 surfaces at fetch/import time, never as a
+    crash inside the scatter)."""
+    prefill = _phase_core("prefill")
+    decode = _phase_core("decode")
+    try:
+        assert prefill.health_snapshot()["role"] == "prefill"
+        fused = _gen(decode, PROMPT, N_TOKENS)
+        assert len(fused) == N_TOKENS
+
+        gid = "disagg-ab"
+        tok0 = _gen(prefill, PROMPT, 1,
+                    {"generation_id": gid, "kv_phase": "prefill"})
+        assert tok0 == fused[:1]
+        desc = prefill.kv_export_descriptor(gid)
+        # position covers the prompt plus the one emitted token — the
+        # decode leg force-feeds exactly tok0 and streams from there
+        assert desc["position"] == len(PROMPT) + 1
+        assert desc["byte_size"] > 0
+        rest = _gen(decode, PROMPT + tok0, N_TOKENS - 1,
+                    {"generation_id": gid + "-d", "kv_attach": desc})
+        assert tok0 + rest == fused
+        prefill.drop_kv_region(gid)
+
+        # stale-descriptor edge: drop between fetch and attach
+        gid2 = "disagg-stale"
+        tok0b = _gen(prefill, PROMPT, 1,
+                     {"generation_id": gid2, "kv_phase": "prefill"})
+        desc2 = prefill.kv_export_descriptor(gid2)
+        prefill.drop_kv_region(gid2)
+        rest2 = _gen(decode, PROMPT + tok0b, N_TOKENS - 1,
+                     {"generation_id": gid2 + "-d", "kv_attach": desc2})
+        assert tok0b + rest2 == fused
+    finally:
+        prefill.close()
+        decode.close()
+
+
+def test_kvexport_descriptor_lifetime_edges():
+    """The typed lifetime contract: unknown gid -> 404, second fetch
+    -> 409 (one-shot claim), drop idempotent, post-drop fetch -> 404,
+    and importing a malformed descriptor -> 404 — every edge a typed
+    ServerError at the boundary, never a late scatter crash."""
+    core = _phase_core("prefill")
+    try:
+        with pytest.raises(KvExportNotFound):
+            core.kv_export_descriptor("never-exported")
+
+        gid = "disagg-edges"
+        _gen(core, PROMPT, 1,
+             {"generation_id": gid, "kv_phase": "prefill"})
+        desc = core.kv_export_descriptor(gid)
+        with pytest.raises(KvExportConflict):
+            core.kv_export_descriptor(gid)
+
+        core.drop_kv_region(gid)
+        core.drop_kv_region(gid)  # idempotent
+        with pytest.raises(KvExportNotFound):
+            core.kv_export_descriptor(gid)
+        with pytest.raises(KvExportNotFound):
+            core.import_kv_descriptor(desc)  # region is gone
+        with pytest.raises(KvExportNotFound):
+            core.import_kv_descriptor({"raw_handle": "not-a-handle"})
+    finally:
+        core.close()
+
+
+def test_kvexport_http_routes():
+    """The wire surface the router's KV transfer speaks: GET descriptor
+    (200 then typed 409), POST release (idempotent 200), post-release
+    GET answers the typed 404."""
+    from tpuserver.http_frontend import HttpFrontend
+
+    core = _phase_core("prefill")
+    frontend = HttpFrontend(core, port=0).start()
+    try:
+        gid = "disagg-http"
+        _gen(core, PROMPT, 1,
+             {"generation_id": gid, "kv_phase": "prefill"})
+
+        def req(method, path):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", frontend.port, timeout=10)
+            try:
+                conn.request(method, path)
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+            finally:
+                conn.close()
+
+        status, desc = req("GET", "/v2/kvexport/" + gid)
+        assert status == 200
+        assert desc["generation_id"] == gid
+        assert desc["position"] == len(PROMPT) + 1
+        status, body = req("GET", "/v2/kvexport/" + gid)
+        assert status == 409, body
+        status, body = req("POST", "/v2/kvexport/" + gid + "/release")
+        assert status == 200
+        status, body = req("POST", "/v2/kvexport/" + gid + "/release")
+        assert status == 200  # idempotent
+        status, body = req("GET", "/v2/kvexport/" + gid)
+        assert status == 404, body
+        status, body = req("GET", "/v2/kvexport/no-such-generation")
+        assert status == 404, body
+    finally:
+        frontend.stop()
+        core.close()
+
+
+# -- router orchestration over role-tagged stub fleets -----------------------
+
+
+@pytest.mark.router
+def test_router_phase_split_over_role_stub_fleet():
+    """A prefill+decode stub pair behind the router: the stream is
+    token-identical to a fused stub run, the split/transfer counters
+    move, the decode leg lands on the decode replica, and the new
+    metric families reach the exposition."""
+    procs = []
+    router = None
+    try:
+        fused_port, proc = _spawn_stub()
+        procs.append(proc)
+        fused_tokens, final = _stub_stream(
+            fused_port, _stub_body("ref", 8))
+        assert final and len(fused_tokens) == 8
+
+        prefill_port, proc = _spawn_stub("prefill")
+        procs.append(proc)
+        decode_port, proc = _spawn_stub("decode")
+        procs.append(proc)
+        router = FleetRouter(
+            ["127.0.0.1:{}".format(p)
+             for p in (prefill_port, decode_port)],
+            probe_interval_s=0.1).start()
+        assert _wait(lambda: all(router.disagg.pools())), \
+            "prober never partitioned the fleet into role pools"
+
+        tokens, final = _stub_stream(router.port, _stub_body("split", 8))
+        assert final
+        assert tokens == fused_tokens
+        snap = router.stats()["disagg"]
+        assert snap["splits"] == 1, snap
+        assert snap["transfers"] == 1, snap
+        assert snap["transfer_bytes"] > 0, snap
+        assert snap["prefill_replicas"] == 1
+        assert snap["decode_replicas"] == 1
+
+        # the decode leg ran on the decode stub (its generation counter
+        # moved), proving the phases really ran on different replicas
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", decode_port, timeout=5)
+        conn.request("GET", "/metrics")
+        body = conn.getresponse().read().decode("utf-8")
+        conn.close()
+        assert "stub_generations_total 1" in body, body
+
+        text = router.metrics_text()
+        for family in ("tpu_disagg_splits_total",
+                       "tpu_disagg_transfers_total",
+                       "tpu_disagg_transfer_bytes_total",
+                       "tpu_disagg_transfer_seconds_total",
+                       "tpu_disagg_prefill_queue_seconds_total",
+                       "tpu_disagg_phase_queue_depth"):
+            assert family in text, family
+    finally:
+        if router is not None:
+            router.stop()
+        _kill_all(procs)
+
+
+@pytest.mark.router
+def test_single_replica_fleet_falls_back_to_fused():
+    """No role pools (the single-replica / classic fleet): admissions
+    take today's fused path byte-identically — zero disagg counters
+    move, no phase legs, no KV traffic."""
+    procs = []
+    router = None
+    try:
+        port, proc = _spawn_stub()  # role-less
+        procs.append(proc)
+        router = FleetRouter(["127.0.0.1:{}".format(port)],
+                             probe_interval_s=0.1).start()
+        assert _wait(lambda: router.stats()["replicas"])
+        fused_tokens, final = _stub_stream(port, _stub_body("ref", 6))
+        tokens, final = _stub_stream(router.port, _stub_body("one", 6))
+        assert final
+        assert tokens == fused_tokens
+        snap = router.stats()["disagg"]
+        assert snap["splits"] == 0, snap
+        assert snap["transfers"] == 0, snap
+        assert snap["fallbacks"] == {}, snap
+    finally:
+        if router is not None:
+            router.stop()
+        _kill_all(procs)
+
+
+# -- role-aware supervision --------------------------------------------------
+
+
+def _role_supervisor(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 2)
+    kw.setdefault("probe_interval_s", 0.1)
+    kw.setdefault("probe_timeout_s", 0.5)
+    kw.setdefault("start_timeout_s", 10.0)
+    kw.setdefault("drain_grace_s", 3.0)
+    kw.setdefault("restart_backoff_s", 0.05)
+    kw.setdefault("scale_cooldown_s", 0.3)
+    kw.setdefault("scope_prefix", "disagg-r")
+    kw.setdefault("router_kwargs", {"probe_interval_s": 0.1})
+    return FleetSupervisor(
+        [sys.executable, STUB, "--port", "{port}", "--scope", "{scope}"],
+        prefill_replicas=1, decode_replicas=1, **kw)
+
+
+def _phase_up(supervisor):
+    return supervisor.stats().get("phase_replicas_up") or {}
+
+
+@pytest.mark.fleet
+def test_supervisor_role_targets_and_role_preserving_healing():
+    """Per-role targets spawn one replica per phase (``--role`` on its
+    argv, the role in its health snapshot and stats row), and a
+    SIGKILL'd prefill replica heals back INTO the prefill pool — the
+    respawn keeps the role, so the phase pool never shrinks because
+    one member crashed."""
+    supervisor = _role_supervisor().start()
+    try:
+        assert supervisor.wait_ready(count=2, timeout_s=30.0)
+        assert _phase_up(supervisor) == {"prefill": 1, "decode": 1}
+        rows = supervisor.stats()["replicas"]
+        assert sorted(r["role"] for r in rows) == ["decode", "prefill"]
+
+        victim = next(r for r in rows if r["role"] == "prefill")
+        os.kill(victim["pid"], signal.SIGKILL)
+        assert _wait(lambda: any(
+            r["role"] == "prefill" and r["state"] == "up"
+            and r["restarts"] >= 1
+            for r in supervisor.stats()["replicas"]), timeout_s=30.0), \
+            "prefill replica never healed back into its pool"
+        assert _wait(lambda: _phase_up(supervisor) ==
+                     {"prefill": 1, "decode": 1}, timeout_s=30.0)
+        assert supervisor.stats()["replica_restarts"] >= 1
+    finally:
+        supervisor.stop()
+
+
+@pytest.mark.fleet
+def test_supervisor_scales_only_the_pressured_pool():
+    """Sustained queue pressure on the prefill pool scales the PREFILL
+    pool up — the idle decode pool is untouched (role-aware elastic
+    scaling, not fleet-mean scaling)."""
+    supervisor = _role_supervisor(
+        scale_up_windows=2, scale_down_windows=1000).start()
+    try:
+        assert supervisor.wait_ready(count=2, timeout_s=30.0)
+        prefill = next(r for r in supervisor.stats()["replicas"]
+                       if r["role"] == "prefill")
+        host, _, port = prefill["url"].rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        conn.request("POST", "/stub/state",
+                     json.dumps({"pending": 16}).encode("utf-8"),
+                     {"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.close()
+        assert _wait(
+            lambda: _phase_up(supervisor).get("prefill", 0) == 2,
+            timeout_s=30.0), \
+            "pressured prefill pool never scaled up"
+        stats = supervisor.stats()
+        assert _phase_up(supervisor).get("decode") == 1
+        assert stats["scale_up_events"] == 1
+        roles = [r["role"] for r in stats["replicas"]]
+        assert roles.count("prefill") == 2 and roles.count("decode") == 1
+    finally:
+        supervisor.stop()

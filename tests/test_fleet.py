@@ -282,11 +282,12 @@ def test_single_noisy_window_never_scales(tmp_path):
         for handle in sup._handles_snapshot():
             with handle._lock:
                 handle.state = "up"
+        (member,) = sup._handles_snapshot()
         now = time.monotonic()
         for util in (0.9, 0.9, 0.5, 0.9, 0.9):
-            sup._evaluate_scaling([util], now)
+            sup._evaluate_scaling([(member, util)], now)
         assert sup.stats()["scale_up_events"] == 0  # reset by the dip
-        sup._evaluate_scaling([0.9], now)
+        sup._evaluate_scaling([(member, 0.9)], now)
         assert sup.stats()["scale_up_events"] == 1  # 3rd consecutive
     finally:
         # the one scale-up spawned a stub; reap it without a monitor

@@ -637,3 +637,47 @@ def test_attach_router_delta_diffs_ejections_and_hedges():
     attach_router_delta(result, old, dict(old))
     assert "router_ejections" not in result
     assert "router_hedges" not in result
+
+
+def test_attach_router_delta_derives_disagg_phase_columns():
+    """The phase-split counters window-diff from the nested ``disagg``
+    snapshot and yield the per-phase report columns (prefill-queue ms
+    per split, KV-transfer ms per transfer) — presence-guarded, so a
+    router without the split plane never fabricates them, and a window
+    with zero splits renders '-' instead of a division by zero."""
+    from perfanalyzer.metrics import attach_router_delta
+    from perfanalyzer.report import _GEN_COLUMNS, _GEN_HEADERS
+
+    base = {"failovers": 0, "handoffs": 0, "resumed_streams": 0,
+            "shed": 0}
+    before = dict(base, disagg={
+        "splits": 2, "transfers": 2, "transfer_bytes": 1000,
+        "transfer_ms_total": 4.0, "prefill_queue_ms_total": 10.0,
+        "fallbacks": {"prefill_died": 1}})
+    after = dict(base, disagg={
+        "splits": 6, "transfers": 5, "transfer_bytes": 4000,
+        "transfer_ms_total": 10.0, "prefill_queue_ms_total": 30.0,
+        "fallbacks": {"prefill_died": 1, "descriptor_missing": 2}})
+    result = {}
+    attach_router_delta(result, before, after)
+    assert result["disagg_splits"] == 4
+    assert result["disagg_transfers"] == 3
+    assert result["disagg_transfer_bytes"] == 3000
+    assert result["disagg_fallbacks"] == 2
+    assert result["prefill_queue_ms"] == pytest.approx(5.0)
+    assert result["kv_transfer_ms"] == pytest.approx(2.0)
+    # zero splits in the window: totals diff to 0, no averages
+    result = {}
+    attach_router_delta(result, before, dict(before))
+    assert result["disagg_splits"] == 0
+    assert "prefill_queue_ms" not in result
+    assert "kv_transfer_ms" not in result
+    # pre-disagg router: nothing fabricated
+    result = {}
+    attach_router_delta(result, dict(base), dict(base))
+    assert "disagg_splits" not in result
+    # and the generation report renders the columns ('-' when absent)
+    assert ("prefill_queue_ms", "{:.2f}") in _GEN_COLUMNS
+    assert ("kv_transfer_ms", "{:.2f}") in _GEN_COLUMNS
+    assert "prefill-q(ms)" in _GEN_HEADERS
+    assert "kv-xfer(ms)" in _GEN_HEADERS

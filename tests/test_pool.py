@@ -675,18 +675,37 @@ def test_undrain_aborts_inflight_drain_instead_of_closing():
     assert resp.outputs
 
 
-# -- aio clients reject the sync-only resilience kwargs ----------------------
+# -- aio clients accept RetryPolicy (classification: test_aio_clients) -------
 
 
-def test_http_aio_rejects_retry_policy():
+def test_http_aio_accepts_retry_policy():
+    import asyncio
+
     aio_http = pytest.importorskip("tritonclient.http.aio")
-    with pytest.raises(NotImplementedError, match="ISSUE 3"):
-        aio_http.InferenceServerClient(
-            "localhost:8000", retry_policy=RetryPolicy())
+
+    async def run():
+        policy = RetryPolicy(max_attempts=2)
+        async with aio_http.InferenceServerClient(
+            "localhost:8000", retry_policy=policy
+        ) as client:
+            assert client._retry_policy is policy
+        # the policy class is re-exported for aio-only callers
+        assert aio_http.RetryPolicy is RetryPolicy
+
+    asyncio.run(run())
 
 
-def test_grpc_aio_rejects_retry_policy():
+def test_grpc_aio_accepts_retry_policy():
+    import asyncio
+
     aio_grpc = pytest.importorskip("tritonclient.grpc.aio")
-    with pytest.raises(NotImplementedError, match="ISSUE 3"):
-        aio_grpc.InferenceServerClient(
-            "localhost:8001", retry_policy=RetryPolicy())
+
+    async def run():
+        policy = RetryPolicy(max_attempts=2)
+        async with aio_grpc.InferenceServerClient(
+            "localhost:8001", retry_policy=policy
+        ) as client:
+            assert client._retry_policy is policy
+        assert aio_grpc.RetryPolicy is RetryPolicy
+
+    asyncio.run(run())

@@ -25,6 +25,7 @@ Usage:
     python tools/chaos_smoke.py --fleet [--cycles N] [--soak M]
     python tools/chaos_smoke.py --gray [--cycles N] [--soak M]
     python tools/chaos_smoke.py --router-kill [--cycles N] [--soak M]
+    python tools/chaos_smoke.py --disagg [--cycles N] [--soak M]
 
 ``--kill-loop`` soaks the supervised-restart layer: every round kills
 the decode loop mid-traffic (injected step failure = loop death) while
@@ -77,6 +78,17 @@ both router urls see ZERO user-visible errors, every stream —
 including the ones severed by the kill — completes token-identical
 with gap-free seqs via journal-recovered resume state, and the
 promoted router's ``recovered_generations`` counter moves.
+
+``--disagg`` soaks disaggregated prefill/decode serving (ISSUE 16): a
+role fleet (one PREFILL + one DECODE stub replica under a
+FleetSupervisor) serves phase-split generations while the PREFILL
+replica is SIGKILLed mid-handoff every cycle — the window where its
+token has relayed but the KV descriptor claim / decode leg is still
+in flight.  Invariants: ZERO user-visible errors (every orphaned
+split degrades to the fused path), every stream token-identical to
+the fault-free reference with gap-free seqs, the supervisor heals the
+prefill pool back to target WITH its role, and the healed replica
+rejoins the split plane (``tpu_disagg_splits_total`` resumes moving).
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -1513,6 +1525,206 @@ def router_kill_phase(cycles, soak, budget):
         supervisor.stop()
 
 
+def disagg_phase(cycles, soak, budget):
+    """``--disagg``: disaggregated prefill/decode soak (ISSUE 16).
+
+    A FleetSupervisor owns a ROLE fleet of stdlib stub replicas — one
+    ``--role prefill``, one ``--role decode`` — fronted by its
+    in-process FleetRouter, whose PhaseSplitOrchestrator splits every
+    admission: prefill leg on the prefill replica, one-shot KV-export
+    descriptor claim, decode leg (handoff body + ``kv_attach``) on the
+    decode replica.  Each cycle, workers stream slowed generations
+    (every stream is mid-handoff for most of its life) while the
+    PREFILL replica is SIGKILLed.  Invariants:
+
+      1. ZERO user-visible stream errors — a split orphaned by the
+         kill (prefill leg dead, descriptor unreachable, release lost)
+         degrades to the fused path inside the router, invisibly;
+      2. every stream's tokens identical to the fault-free reference
+         with gap-free, duplicate-free seqs — across the prefill-leg
+         -> decode-leg seam AND across every fallback flavor;
+      3. the supervisor heals the prefill pool back to target WITH the
+         role (``phase_replicas_up`` restored, membership back to
+         full), never by stealing from the decode pool;
+      4. the healed replica rejoins the split plane: the router's
+         ``splits`` counter resumes moving after recovery, and the
+         disagg counters never move backwards.
+    """
+    import signal
+
+    import tritonclient.http as httpclient
+
+    from tpuserver.fleet import FleetSupervisor
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub_path = os.path.join(repo, "tests", "fleet_stub.py")
+    command = [sys.executable, stub_path, "--port", "{port}",
+               "--scope", "{scope}"]
+    # min == max pins both role pools at their targets: this soak is
+    # about HEALING a killed prefill replica back into its pool, not
+    # elastic scaling
+    supervisor = FleetSupervisor(
+        command, prefill_replicas=1, decode_replicas=1,
+        min_replicas=1, max_replicas=1,
+        probe_interval_s=0.1, probe_timeout_s=2.0,
+        start_timeout_s=60.0, drain_grace_s=5.0,
+        max_restarts=cycles + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.05, scope_prefix="disagg-stub-",
+        router_kwargs={"probe_interval_s": 0.05},
+        env={"PYTHONPATH": os.path.join(repo, "src", "python")},
+    ).start()
+    router = supervisor.router
+    prompt = np.array([5, 7, 9, 2, 4], dtype=np.int32)
+
+    def stream_once(client, cycle, wid, i):
+        tokens, seqs = [], []
+        try:
+            for event in client.generate_stream(
+                    "stub",
+                    {"PROMPT_IDS": prompt,
+                     "MAX_TOKENS": np.array([budget], np.int32)},
+                    parameters={"token_delay_ms": 25}):
+                for out in event.get("outputs", []):
+                    if out["name"] == "TOKEN":
+                        tokens.append(int(out["data"][0]))
+                params = event.get("parameters") or {}
+                if "seq" in params:
+                    seqs.append(params["seq"])
+        except Exception as e:  # noqa: BLE001 — the invariant
+            fail("disagg cycle {}: user-visible stream error "
+                 "(worker {} stream {}: {}: {})".format(
+                     cycle, wid, i, type(e).__name__, e))
+            return None, None
+        return tokens, seqs
+
+    def prefill_handle():
+        rows = [r for r in supervisor.stats()["replicas"]
+                if r.get("role") == "prefill"]
+        return rows[0] if rows else None
+
+    def disagg_stats():
+        return router.stats()["disagg"]
+
+    def fleet_recovered(restarts_before, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            stats = supervisor.stats()
+            member_urls = {r["url"] for r in router.membership()}
+            if (stats["replica_restarts"] > restarts_before
+                    and stats.get("phase_replicas_up")
+                    == {"prefill": 1, "decode": 1}
+                    and len(member_urls) == 2
+                    and stats["retired_replicas"] == 0):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def splits_resume(splits_before, client, cycle, timeout_s=30.0):
+        """The healed prefill replica must REJOIN the split plane:
+        drive streams until the router's splits counter moves past the
+        post-kill value (the prober re-admitting the respawn is part
+        of the recovery bar)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            tokens, _ = stream_once(client, cycle, "probe", 0)
+            if tokens is not None and tokens != reference:
+                fail("disagg cycle {}: post-heal tokens diverged: "
+                     "{} != {}".format(cycle, tokens, reference))
+                return False
+            if disagg_stats()["splits"] > splits_before:
+                return True
+        return False
+
+    try:
+        if not supervisor.wait_ready(timeout_s=60.0):
+            fail("disagg: role replicas never became ready")
+            return
+        client = httpclient.InferenceServerClient(router.url)
+        reference, ref_seqs = stream_once(client, -1, 0, 0)
+        if reference is None:
+            client.close()
+            return
+        if ref_seqs != list(range(budget)):
+            fail("disagg: reference stream seqs not gap-free: "
+                 "{}".format(ref_seqs))
+        if disagg_stats()["splits"] < 1:
+            fail("disagg: the reference stream did not take the "
+                 "phase-split path (stats={})".format(disagg_stats()))
+        print("reference tokens: {}; {} SIGKILL-the-prefill-replica "
+              "cycles".format(reference, cycles), flush=True)
+
+        for cycle in range(cycles):
+            restarts_before = supervisor.stats()["replica_restarts"]
+            before = disagg_stats()
+
+            def worker(wid, cycle=cycle):
+                wclient = httpclient.InferenceServerClient(router.url)
+                try:
+                    for i in range(soak):
+                        tokens, seqs = stream_once(
+                            wclient, cycle, wid, i)
+                        if tokens is None:
+                            continue
+                        if tokens != reference:
+                            fail("disagg cycle {}: stream tokens "
+                                 "diverged: {} != {}".format(
+                                     cycle, tokens, reference))
+                        if (seqs != list(range(len(seqs)))
+                                or len(seqs) != budget):
+                            fail("disagg cycle {}: seq gap/duplicate: "
+                                 "{}".format(cycle, seqs))
+                finally:
+                    wclient.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(3)
+            ]
+            for t in threads:
+                t.start()
+            # 25ms token cadence x `budget` tokens: by now every
+            # worker's stream is mid-handoff (prefill leg relayed,
+            # decode leg streaming) or about to re-admit one
+            time.sleep(0.3)
+            victim = prefill_handle()
+            if victim is None or victim["state"] != "up" \
+                    or not victim["pid"]:
+                fail("disagg cycle {}: no live prefill replica to "
+                     "kill".format(cycle))
+            else:
+                os.kill(victim["pid"], signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=300)
+            if not fleet_recovered(restarts_before):
+                fail("disagg cycle {}: prefill pool never healed back "
+                     "to target with its role (stats={})".format(
+                         cycle, supervisor.stats()))
+            healed = prefill_handle()
+            if healed is None or healed.get("role") != "prefill":
+                fail("disagg cycle {}: healed replica lost its role: "
+                     "{}".format(cycle, healed))
+            after = disagg_stats()
+            for key in ("splits", "transfers", "transfer_bytes"):
+                if after[key] < before[key]:
+                    fail("disagg cycle {}: counter {} moved backwards "
+                         "{} -> {}".format(
+                             cycle, key, before[key], after[key]))
+            if not splits_resume(after["splits"], client, cycle):
+                fail("disagg cycle {}: healed prefill replica never "
+                     "rejoined the split plane (stats={})".format(
+                         cycle, disagg_stats()))
+            stats = disagg_stats()
+            print("cycle {:2d} splits {} -> {} fallbacks={} "
+                  "restarts={}".format(
+                      cycle, before["splits"], stats["splits"],
+                      stats["fallbacks"],
+                      supervisor.stats()["replica_restarts"]),
+                  flush=True)
+        client.close()
+    finally:
+        supervisor.stop()
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -1550,6 +1762,16 @@ def main():
                              "errors, token-identical gap-free "
                              "streams, and journal recovery counters "
                              "moving")
+    parser.add_argument("--disagg", action="store_true",
+                        help="soak disaggregated prefill/decode "
+                             "serving instead: a role stub fleet "
+                             "(one prefill + one decode replica) "
+                             "with the PREFILL replica SIGKILLed "
+                             "mid-handoff every cycle — asserts zero "
+                             "user-visible errors, token-identical "
+                             "gap-free streams, role-preserving "
+                             "healing, and the healed replica "
+                             "rejoining the split plane")
     parser.add_argument("--gray", action="store_true",
                         help="soak the gray-failure ejection layer "
                              "instead: a stub-fleet router with one "
@@ -1588,6 +1810,26 @@ def main():
               "cycles, {:.1f}s, standby takeover + journal recovery, "
               "zero user-visible errors, zero lost or duplicated "
               "tokens".format(args.cycles, elapsed))
+        return 0
+
+    if args.disagg:
+        t0 = time.monotonic()
+        # stub replicas + slowed token cadence, like --router-kill:
+        # cycles are cheap and every stream spends most of its life
+        # mid-handoff
+        disagg_phase(args.cycles,
+                     args.soak if args.soak is not None else 3,
+                     args.budget * 2)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\ndisagg chaos smoke FAILED: {} violation(s) in "
+                  "{:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\ndisagg chaos smoke OK: {} prefill-SIGKILL cycles, "
+              "{:.1f}s, zero user-visible errors, token-identical "
+              "gap-free streams, role-preserving healing, split "
+              "plane re-armed every cycle".format(args.cycles, elapsed))
         return 0
 
     if args.gray:

@@ -88,6 +88,16 @@ def changed_paths():
         print("check.py: faults.py changed — registry checks are "
               "whole-program, linting the full tree", file=sys.stderr)
         return None
+    errors_mod = os.path.join(SRC_PY, "tpuserver", "errors.py")
+    if errors_mod in out:
+        # R4's wire-map completeness (every ServerError subclass's
+        # HTTP code in _STATUS_LINE, every code in the gRPC map) is
+        # cross-file: a diff touching errors.py without the transport
+        # maps reads as "no status map exists".  Same widening as the
+        # fault registry.
+        print("check.py: errors.py changed — wire-map checks are "
+              "whole-program, linting the full tree", file=sys.stderr)
+        return None
     return out
 
 

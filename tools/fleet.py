@@ -57,7 +57,8 @@ def serve_replica(args):
 
     core = InferenceServer(
         build_models(args.models.split(","), args.slots),
-        fault_scope=args.scope or None)
+        fault_scope=args.scope or None,
+        role=args.role or None)
     frontend = HttpFrontend(core, port=args.port).start()
     install_sigterm_drain(core, drain_timeout=args.drain_timeout)
     print("replica[{}] serving on {} (pid {})".format(
@@ -82,6 +83,10 @@ def main(argv=None):
                     help="(child mode) replica listen port")
     ap.add_argument("--scope", default="",
                     help="(child mode) fault-injection scope name")
+    ap.add_argument("--role", default="",
+                    help="(child mode) phase role the replica "
+                         "advertises in /v2/health/stats "
+                         "(prefill/decode; empty = fused)")
     ap.add_argument("--models", default="llama,simple",
                     help="comma list of replica models (llama, simple)")
     ap.add_argument("--slots", type=int, default=4,
@@ -90,6 +95,14 @@ def main(argv=None):
                     help="replica SIGTERM drain budget in seconds")
     ap.add_argument("--replicas", type=int, default=2,
                     help="initial replica process count (default 2)")
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="disaggregated serving: dedicated prefill "
+                         "replicas (requires --decode-replicas too; "
+                         "--replicas then only adds fused capacity)")
+    ap.add_argument("--decode-replicas", type=int, default=0,
+                    help="disaggregated serving: dedicated decode "
+                         "replicas the router attaches exported KV "
+                         "onto")
     ap.add_argument("--min-replicas", type=int, default=1)
     ap.add_argument("--max-replicas", type=int, default=4)
     ap.add_argument("--router-host", default="127.0.0.1")
@@ -146,6 +159,8 @@ def main(argv=None):
     supervisor = FleetSupervisor(
         command,
         replicas=args.replicas,
+        prefill_replicas=args.prefill_replicas,
+        decode_replicas=args.decode_replicas,
         min_replicas=args.min_replicas,
         max_replicas=args.max_replicas,
         probe_interval_s=args.probe_interval,

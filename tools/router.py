@@ -139,9 +139,16 @@ def main(argv=None):
     def _sigterm(signum, frame):
         # the router's own install_sigterm_drain twin: stop admitting,
         # let in-flight streams finish or hand off, flush + fsync the
-        # journal, then exit — the main thread runs the drain so the
-        # handler stays async-signal-trivial
-        drain_first.set()
+        # journal, then exit.  The admission latch flips HERE, not in
+        # the main thread's drain() — otherwise a request landing
+        # between signal delivery and the main thread waking out of
+        # stop.wait() is still admitted after SIGTERM.  Safe: the main
+        # thread (where handlers run) is parked in stop.wait() and
+        # never holds the router lock; the drain_first guard keeps a
+        # repeated SIGTERM from re-entering begin_drain mid-drain().
+        if not drain_first.is_set():
+            drain_first.set()
+            router.begin_drain()
         stop.set()
 
     def _promote(signum, frame):
