@@ -459,6 +459,17 @@ class _TritonClientShmMixin:
 
 # -- HTTP backend ----------------------------------------------------------
 
+#: under-chaos reconnect budget for generation streams: the client
+#: library's default 5-attempt budget backs off for ~1.5 s total,
+#: which a supervised fleet's process-heal window outlasts when kill
+#: faults COMPOSE (prefill + decode replica SIGKILLed in one campaign
+#: cycle: two serial respawns + router re-admission).  Perf streams
+#: must ride the heal out — the degradation is already reported as
+#: resumed_streams/resume_events, never as a user-visible error
+#: (found by tools/chaos_campaign.py --proof seed 10, pinned in
+#: tests/test_chaos_campaign.py).
+GENERATION_MAX_RECONNECTS = 10
+
 
 class HttpBackend(_TritonClientShmMixin, ClientBackend):
     """``tritonclient.http`` against a live frontend; generation rides
@@ -603,6 +614,7 @@ class HttpBackend(_TritonClientShmMixin, ClientBackend):
             for event in self.client.generate_stream(
                     model, dict(inputs),
                     parameters=dict(parameters or {}),
+                    max_reconnects=GENERATION_MAX_RECONNECTS,
                     on_reconnect=on_reconnect):
                 yield _response_token_count(event.get("outputs"))
         except InferenceServerException as e:
@@ -711,6 +723,7 @@ class GrpcBackend(_TritonClientShmMixin, ClientBackend):
             for result in client.generate_stream(
                     model, prepared,
                     parameters=dict(parameters) if parameters else None,
+                    max_reconnects=GENERATION_MAX_RECONNECTS,
                     on_reconnect=on_reconnect):
                 resp = result.get_response()
                 yield _response_token_count([

@@ -19,8 +19,9 @@ Protocol (newline-delimited JSON over one TCP connection per worker):
     worker -> parent   {"type": "window_result", "window": k,
                         "completed": n, "errors": e, "duration_s": d,
                         "latencies_s": [...], "tokens": t}
-                       # tokens: 0 from today's scalar workers —
-                       # reserved for generation-mode distribution
+                       # tokens: 0 from scalar workers; generation
+                       # workers fill it and add ttfts_s / itls_s /
+                       # generations / resumed_streams / resume_events
     parent -> workers  {"type": "shutdown"}
 
 The parent broadcasts ``start_window`` only after every worker's
@@ -113,6 +114,16 @@ def merge_worker_windows(worker_results):
         "latencies_s": latencies,
     }
     row.update(metrics.latency_summary(latencies))
+    # generation-mode workers additionally ship raw TTFT/ITL samples
+    # and stream counters; pool/sum them under the same raw-samples
+    # rule so the parent can compute fleet token percentiles
+    if any("ttfts_s" in r or "generations" in r for r in worker_results):
+        row["ttfts_s"] = [t for r in worker_results
+                          for t in r.get("ttfts_s", [])]
+        row["itls_s"] = [t for r in worker_results
+                         for t in r.get("itls_s", [])]
+        for key in ("generations", "resumed_streams", "resume_events"):
+            row[key] = sum(int(r.get(key, 0)) for r in worker_results)
     return row
 
 
@@ -134,6 +145,13 @@ def merge_windows(window_rows):
         "windows": len(window_rows),
     }
     merged.update(metrics.latency_summary(latencies))
+    if any("ttfts_s" in w or "generations" in w for w in window_rows):
+        merged["ttfts_s"] = [t for w in window_rows
+                             for t in w.get("ttfts_s", [])]
+        merged["itls_s"] = [t for w in window_rows
+                            for t in w.get("itls_s", [])]
+        for key in ("generations", "resumed_streams", "resume_events"):
+            merged[key] = sum(int(w.get(key, 0)) for w in window_rows)
     return merged
 
 

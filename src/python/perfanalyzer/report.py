@@ -108,8 +108,8 @@ class ReportWriter:
 
     @staticmethod
     def _is_generation(results):
-        return bool(results) and results[0].get("mode", "").startswith(
-            "generation")
+        # covers generation_concurrency AND distributed_generation
+        return bool(results) and "generation" in results[0].get("mode", "")
 
     def table(self, results):
         """The stdout table, as a string."""

@@ -862,6 +862,7 @@ class InferenceServer:
         self._m_shm_written = self.metrics.counter(
             "tpu_shm_bytes_written_total").labels()
         self.metrics.register_collector(self._collect_metrics)
+        self.metrics.register_collector(self._collect_shm_ring)
         for m in models or []:
             self.register_model(m)
 
@@ -1076,6 +1077,16 @@ class InferenceServer:
         families.extend(
             (name, rows) for name, rows in samples.items() if rows)
         return families
+
+    @staticmethod
+    def _collect_shm_ring():
+        """Scrape-time view of the process-wide seqlock torn-read
+        counter (tpuserver.shm_ring) — readers are client-side code
+        with no server handle, so the module counter is the single
+        account and this is its exposition."""
+        from tpuserver import shm_ring
+
+        return [("tpu_shm_ring_torn_total", [({}, shm_ring.torn_total())])]
 
     def metrics_text(self):
         """The replica's full ``/metrics`` exposition: the ``nv_*``
@@ -1825,6 +1836,20 @@ class InferenceServer:
         import struct
 
         data = struct.pack("<if", int(token), float(logprob))
+        region = self._shm_region(region_name)
+        _, offset = self._check_shm_bounds(region, len(data), offset,
+                                           "output")
+        region.write(offset, data)
+        self._m_shm_written.inc(len(data))
+
+    def write_shm_ring_seq_word(self, region_name, offset, word):
+        """Stamp one 4-byte seqlock word for a ring slot (requests
+        opting in via ``shm_ring_seq_base`` — see tpuserver.shm_ring).
+        Same bounds-checked plumbing as the slot write: a seq-word
+        array pointing past the region is a typed 400 on that step."""
+        from tpuserver import shm_ring
+
+        data = shm_ring.pack_word(word)
         region = self._shm_region(region_name)
         _, offset = self._check_shm_bounds(region, len(data), offset,
                                            "output")

@@ -66,11 +66,33 @@ def _coerce_int(value, default=0):
         return default
 
 
+#: Replica-side generation-id suffix for the prefill leg.  The leg's
+#: record on the prefill replica is a COMPLETED one-token generation
+#: (its ``MAX_TOKENS`` was rewritten to 1) — if it lived under the real
+#: generation id, a router that crashed mid-split and recovered
+#: ``home = prefill replica`` from its journal would resume against
+#: that record, get an instant ``final``, and silently truncate the
+#: stream to one token (chaos campaign seed 7: router_sigkill composed
+#: with replica churn).  Under a derived id, that stale resume answers
+#: typed-404 instead, which the relay loop already heals via a
+#: token-identical re-prefill handoff.  Never digits after the tilde,
+#: so the router's ``gen~offset`` handoff-epoch parsing cannot
+#: mistake it.
+PREFILL_LEG_ID_SUFFIX = "~prefill"
+
+
+def prefill_leg_id(gen_id):
+    """The replica-side generation id of ``gen_id``'s prefill leg."""
+    return gen_id + PREFILL_LEG_ID_SUFFIX
+
+
 def prefill_leg_body(body):
     """Rewrite a fresh admission body into its prefill leg: exactly one
     decode step (``MAX_TOKENS=1`` — the first token is the TTFT the
-    split exists to protect) and ``kv_phase=prefill`` so the replica
-    exports the KV when the leg finishes."""
+    split exists to protect), ``kv_phase=prefill`` so the replica
+    exports the KV when the leg finishes, and the leg's DERIVED
+    generation id (:func:`prefill_leg_id`) so the completed one-token
+    record can never satisfy a resume of the real generation."""
     request = json.loads(body)
     inputs = []
     for tin in request.get("inputs") or []:
@@ -81,6 +103,9 @@ def prefill_leg_body(body):
     request["inputs"] = inputs
     params = dict(request.get("parameters") or {})
     params["kv_phase"] = PREFILL_ROLE
+    gid = str(params.get("generation_id") or "")
+    if gid:
+        params["generation_id"] = prefill_leg_id(gid)
     request["parameters"] = params
     return json.dumps(request).encode("utf-8")
 
@@ -214,7 +239,10 @@ class PhaseSplitOrchestrator:
             return None
         descriptor = None
         if outcome == "final":
-            descriptor = self._fetch_descriptor(rep, gen.gen_id)
+            # the export is published under the LEG's derived id (that
+            # is the generation_id the prefill replica saw)
+            descriptor = self._fetch_descriptor(
+                rep, prefill_leg_id(gen.gen_id))
         else:
             # token 0 reached the client, then the leg died: the
             # export never finished — re-prefill handoff below
@@ -231,7 +259,7 @@ class PhaseSplitOrchestrator:
         release = None
         if descriptor is not None:
             handoff = attach_body(handoff, descriptor)
-            release = self._releaser(rep, gen.gen_id)
+            release = self._releaser(rep, prefill_leg_id(gen.gen_id))
             with self._lock:
                 self._splits += 1
         decode_rep = (router.pick_replica(replicas=decode_pool)

@@ -41,14 +41,18 @@ Registered injection points:
 point succeed before it starts firing — the knob chaos tests use to
 drop a connection *mid*-stream rather than before the first token.
 
-Beyond the per-point actions above, every point accepts the two
-**gray-failure latency modes**: ``mode="slow"`` (every fire sleeps
-``delay`` — a persistently degraded-but-alive replica) and
-``mode="jitter"`` (a deterministic pseudo-random delay in
-``[0, delay)`` from a seeded LCG, so soaks replay exactly).  Both stay
-armed until :func:`clear` and combine with ``@scope`` to degrade one
-replica of a fleet — the traffic shape the router's gray-failure
-ejection defends against (docs/resilience.md "Tail-latency defense").
+Beyond the per-point actions above, every point accepts three
+**gray-failure modes**: ``mode="slow"`` (every fire sleeps ``delay``
+— a persistently degraded-but-alive replica), ``mode="jitter"`` (a
+deterministic pseudo-random delay in ``[0, delay)`` from a seeded
+LCG, so soaks replay exactly), and ``mode="partition"`` (the
+half-open network shape: the connection is accepted and ``skip``
+passes flow normally, then reads stall — no bytes, no error — until
+:func:`clear`, or for ``delay`` seconds per fire when ``delay > 0``).
+All stay armed until :func:`clear` and combine with ``@scope`` to
+degrade one replica of a fleet — the traffic shapes the router's
+gray-failure ejection defends against (docs/resilience.md
+"Tail-latency defense").
 
 **Scopes** (multi-replica chaos): several in-process servers share this
 process-global registry, so a point armed with ``scope="replica-b"``
@@ -131,18 +135,20 @@ class _Fault:
                  "skip", "lcg")
 
     def __init__(self, name, mode, times, delay, scope=None, skip=0):
-        if mode not in ("raise", "sleep", "hang", "nan", "slow", "jitter"):
+        if mode not in ("raise", "sleep", "hang", "nan", "slow",
+                        "jitter", "partition"):
             raise ValueError(
                 "fault mode must be 'raise', 'sleep', 'hang', 'nan', "
-                "'slow' or 'jitter' (got {!r})".format(mode)
+                "'slow', 'jitter' or 'partition' (got {!r})".format(mode)
             )
         self.name = name
         self.mode = mode
-        # 'slow' and 'jitter' model a DEGRADED-but-alive replica (the
-        # gray-failure shape): a latency fault that disarmed itself
-        # after N fires would read as a recovered replica mid-soak, so
-        # both are persistent until clear() regardless of ``times``
-        self.remaining = (-1 if mode in ("slow", "jitter")
+        # 'slow', 'jitter' and 'partition' model a DEGRADED-but-alive
+        # replica (the gray-failure shape): a latency fault that
+        # disarmed itself after N fires would read as a recovered
+        # replica mid-soak, so all are persistent until clear()
+        # regardless of ``times``
+        self.remaining = (-1 if mode in ("slow", "jitter", "partition")
                           else int(times))
         self.delay = float(delay)
         self.fired = 0
@@ -170,16 +176,20 @@ def install(name, mode="raise", times=1, delay=0.0, scope=None, skip=0):
     With a ``scope``, only :func:`fire` calls carrying that scope trip
     the point (per-replica chaos); scope None matches every firer.
 
-    Two latency modes model a GRAY failure — a replica that still
-    answers everything, just slowly (thermal throttle, swap storm, a
-    co-tenant compile): ``mode="slow"`` sleeps ``delay`` seconds on
-    EVERY fire, and ``mode="jitter"`` sleeps a deterministic
-    pseudo-random duration in ``[0, delay)`` drawn from a per-fault
-    LCG seeded by the point identity — the same arming replays the
-    exact same delay sequence, so gray-failure soaks reproduce run to
-    run.  Both are persistent (``times`` is ignored: a latency fault
-    that disarmed itself would read as a recovery mid-soak) until
-    :func:`clear`, and both honor ``@scope`` per-replica targeting —
+    Three modes model a GRAY failure — a replica that still answers
+    probes while its data path misbehaves: ``mode="slow"`` sleeps
+    ``delay`` seconds on EVERY fire (thermal throttle, swap storm),
+    ``mode="jitter"`` sleeps a deterministic pseudo-random duration in
+    ``[0, delay)`` drawn from a per-fault LCG seeded by the point
+    identity — the same arming replays the exact same delay sequence,
+    so gray-failure soaks reproduce run to run — and
+    ``mode="partition"`` stalls the firing site entirely (the
+    half-open network shape: connection accepted, ``skip`` passes
+    flow, then no bytes and no error) until :func:`clear` releases it,
+    or for ``delay`` seconds per fire when ``delay > 0``.  All are
+    persistent (``times`` is ignored: a gray fault that disarmed
+    itself would read as a recovery mid-soak) until :func:`clear`, and
+    all honor ``@scope`` per-replica targeting —
     ``scheduler.step@replica-b:slow:-1:0.05`` degrades exactly one
     replica of a fleet."""
     fault = _Fault(name, mode, times, delay, scope, skip=skip)
@@ -263,6 +273,9 @@ def fire(name, scope=None):
     if mode in ("sleep", "slow"):
         time.sleep(delay)
         return None
+    if mode == "partition":
+        _stall_partitioned(fault)
+        return None
     if mode == "jitter":
         # deterministic per-fire pseudo-random delay in [0, delay):
         # advance the fault's own LCG under the lock (torn updates
@@ -275,6 +288,33 @@ def fire(name, scope=None):
     if mode in ("nan", "hang"):
         return (mode, int(delay) if mode == "nan" else delay)
     raise FaultInjected(name)
+
+
+#: partition-stall poll cadence: coarse enough to be free, fine enough
+#: that clear() releases a stalled fire within one human blink
+_PARTITION_POLL_S = 0.02
+
+
+def _stall_partitioned(fault):
+    """``mode="partition"``'s stall: the half-open network shape
+    ``slow`` doesn't model.  The connection was ACCEPTED and traffic
+    flowed (``skip`` passes), then reads stop — no bytes, no RST, no
+    error the firing site could surface — until the arming is
+    :func:`clear`-ed (or replaced), or ``delay`` seconds pass when
+    ``delay > 0`` (a bounded blackout).  Unlike ``raise`` the site
+    never sees an exception, and unlike ``slow`` nothing trickles
+    through while armed: the stall polls the registry OUTSIDE the lock
+    so a partitioned point never blocks arming/disarming others, and a
+    concurrent clear() releases every stalled fire promptly."""
+    deadline = (time.monotonic() + fault.delay
+                if fault.delay > 0 else None)
+    while True:
+        with _lock:
+            if _points.get((fault.name, fault.scope)) is not fault:
+                return  # healed: cleared or re-armed
+        if deadline is not None and time.monotonic() >= deadline:
+            return
+        time.sleep(_PARTITION_POLL_S)
 
 
 class injected:

@@ -90,6 +90,11 @@ CATALOG = {
         "counter",
         "Bytes written into registered shared-memory regions (shm-"
         "delivered outputs and token-ring slots)."),
+    "tpu_shm_ring_torn_total": (
+        "counter",
+        "Token-ring slot reads that observed a torn or stale seqlock "
+        "word and fell back to the event's in-band payload (requests "
+        "opting in via shm_ring_seq_base; process-wide)."),
     # -- decode scheduler (continuous batching) ----------------------------
     "tpu_scheduler_admissions_total": (
         "counter",

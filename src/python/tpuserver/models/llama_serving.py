@@ -293,13 +293,20 @@ class LlamaGenerateModel(Model):
         return parked, int(request.parameters["kv_cache_position"])
 
     def _ring_writer(self, request):
-        """``(region_name, write)`` for a request carrying a token-ring
-        descriptor (``shm_ring_region`` + ``shm_ring_slots`` [+
-        ``shm_ring_offset`` base]), or None.  ``write(seq, token,
-        logprob)`` lands the step in its ring slot (``seq %% slots``)
-        through the server's bounds-checked shm plumbing and returns
-        the slot's byte offset — the descriptor the decoupled event
-        carries instead of the tensors."""
+        """``(region_name, write, seq_guarded)`` for a request carrying
+        a token-ring descriptor (``shm_ring_region`` +
+        ``shm_ring_slots`` [+ ``shm_ring_offset`` base]), or None.
+        ``write(seq, token, logprob)`` lands the step in its ring slot
+        (``seq %% slots``) through the server's bounds-checked shm
+        plumbing and returns the slot's byte offset — the descriptor
+        the decoupled event carries instead of the tensors.
+
+        ``shm_ring_seq_base`` opts the request into seqlock write-
+        completeness markers (tpuserver.shm_ring): every payload write
+        is bracketed by a begin/commit word in the parallel seq-word
+        array at that base offset, so a reader can detect a torn or
+        stale slot and fall back to the in-band payload — which the
+        events then also carry (``seq_guarded=True``)."""
         name = request.parameters.get("shm_ring_region")
         if not name:
             return None
@@ -319,20 +326,40 @@ class LlamaGenerateModel(Model):
                 "ring geometry travels with the request)")
         base = int(request.parameters.get("shm_ring_offset") or 0)
         slot_bytes = server.SHM_RING_SLOT_BYTES
+        seq_base = request.parameters.get("shm_ring_seq_base")
+
+        if seq_base is None:
+            def write(seq, token, logprob):
+                off = base + (seq % slots) * slot_bytes
+                server.write_shm_ring_slot(name, off, token, logprob)
+                return off
+
+            return name, write, False
+
+        from tpuserver import shm_ring
+
+        seq_base = int(seq_base)
 
         def write(seq, token, logprob):
             off = base + (seq % slots) * slot_bytes
+            word_off = shm_ring.seq_word_offset(seq, slots, seq_base)
+            server.write_shm_ring_seq_word(
+                name, word_off, shm_ring.begin_word(seq))
             server.write_shm_ring_slot(name, off, token, logprob)
+            server.write_shm_ring_seq_word(
+                name, word_off, shm_ring.commit_word(seq))
             return off
 
-        return name, write
+        return name, write, True
 
     @staticmethod
-    def _emit_token(token, logprob, seq, ring_write):
+    def _emit_token(token, logprob, seq, ring_write, seq_guarded=False):
         """One decoupled response: the TOKEN/LOGPROB tensors in-band,
         or — on the shm token ring — just the slot descriptor (the
         event shrinks to ``seq -> offset``; the tensors live in the
-        client-registered region)."""
+        client-registered region).  A seq-guarded ring keeps the
+        tensors in-band too: the payload a reader that detects a torn
+        slot falls back to."""
         if ring_write is None:
             return {
                 "TOKEN": np.array([token], dtype=np.int32),
@@ -343,7 +370,11 @@ class LlamaGenerateModel(Model):
         off = ring_write(seq, int(token), float(logprob))
         params = {"seq": seq}
         params["shm_ring_offset"] = off
-        return {RESPONSE_PARAMS_KEY: params}
+        event = {RESPONSE_PARAMS_KEY: params}
+        if seq_guarded:
+            event["TOKEN"] = np.array([token], dtype=np.int32)
+            event["LOGPROB"] = np.array([logprob], dtype=np.float32)
+        return event
 
     def execute_stream(self, inputs, request):
         import jax
@@ -371,6 +402,7 @@ class LlamaGenerateModel(Model):
 
         ring = self._ring_writer(request)
         ring_write = ring[1] if ring is not None else None
+        seq_guarded = ring[2] if ring is not None else False
         # pin every referenced region for the stream's lifetime: a
         # concurrent unregister becomes a typed 409 conflict instead of
         # a crash (or a silent write into freed memory) mid-generation
@@ -400,19 +432,20 @@ class LlamaGenerateModel(Model):
                         np.int32)
                 yield from self._execute_scheduled(
                     prompt, max_tokens, eos_id, request, ring_write,
-                    prompt_dev=prompt_dev,
+                    prompt_dev=prompt_dev, seq_guarded=seq_guarded,
                 )
             else:
                 yield from self._execute_single(
                     prompt, prompt_dev, prompt_len, max_tokens, eos_id,
-                    request, ring_write,
+                    request, ring_write, seq_guarded,
                 )
         finally:
             for name in pinned:
                 server.unpin_shm_region(name)
 
     def _execute_single(self, prompt, prompt_dev, prompt_len, max_tokens,
-                        eos_id, request, ring_write):
+                        eos_id, request, ring_write,
+                        seq_guarded=False):
         import jax
         import jax.numpy as jnp
 
@@ -483,7 +516,8 @@ class LlamaGenerateModel(Model):
             inflight.append((tokens_dev, logps_dev,
                              self.decode_chunk - 1, True))
             t0, l0 = jax.device_get((early_tok, early_lp))
-            yield self._emit_token(t0[0], l0[0], emitted, ring_write)
+            yield self._emit_token(t0[0], l0[0], emitted, ring_write,
+                                   seq_guarded)
             emitted += 1
             if eos_id is not None and int(t0[0]) == eos_id:
                 if region is not None:
@@ -533,7 +567,8 @@ class LlamaGenerateModel(Model):
                 logps_host = logps_all[start:, 0]
             for i in range(n):
                 yield self._emit_token(
-                    tokens_host[i], logps_host[i], emitted, ring_write)
+                    tokens_host[i], logps_host[i], emitted, ring_write,
+                    seq_guarded)
                 emitted += 1
                 if eos_id is not None and int(tokens_host[i]) == eos_id:
                     # the EOS token is emitted, then generation stops;
@@ -552,7 +587,8 @@ class LlamaGenerateModel(Model):
             region.put_device_array(0, cache)
 
     def _execute_scheduled(self, prompt, max_tokens, eos_id, request,
-                           ring_write=None, prompt_dev=None):
+                           ring_write=None, prompt_dev=None,
+                           seq_guarded=False):
         """Continuous-batching path: submit to the shared decode loop and
         fan its per-step tokens back out to this stream.
 
@@ -644,7 +680,14 @@ class LlamaGenerateModel(Model):
                 off = ring_write(seq, int(token), float(logprob))
                 params = {"generation_id": gen_id, "seq": seq}
                 params["shm_ring_offset"] = off
-                yield {RESPONSE_PARAMS_KEY: params}
+                event = {RESPONSE_PARAMS_KEY: params}
+                if seq_guarded:
+                    # seqlock lane: keep the tensors in-band too — the
+                    # fallback a reader uses on a torn/stale slot
+                    event["TOKEN"] = np.array([token], dtype=np.int32)
+                    event["LOGPROB"] = np.array(
+                        [logprob], dtype=np.float32)
+                yield event
             else:
                 yield {
                     "TOKEN": np.array([token], dtype=np.int32),

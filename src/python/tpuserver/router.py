@@ -2842,6 +2842,20 @@ class _RouterHandler(BaseHttpHandler):
                 rep = plan["rep"]
                 body, headers = plan["body"], plan["headers"]
                 release_export = plan.get("release")
+                if rep is None:
+                    # the decode pool emptied AFTER the prefill leg
+                    # relayed token 0 (composed kills: decode replica
+                    # down while the prefill replica streams, then the
+                    # fallback picks find nothing).  The stream is
+                    # started and ``body`` is already the handoff
+                    # re-admission, so failing here is user-visible —
+                    # wait out the supervisor heal exactly like the
+                    # mid-stream handoff path (found by
+                    # tools/chaos_campaign.py --proof seed 10, pinned
+                    # in tests/test_chaos_campaign.py)
+                    rep = self._wait_for_handoff_replica(gen, None)
+                    if rep is not None:
+                        gen.set_home(rep.url, rebase=True)
             else:
                 # prefix affinity steers siblings of a warm prompt
                 # prefix to the replica already holding it
@@ -2851,9 +2865,27 @@ class _RouterHandler(BaseHttpHandler):
                     gen.set_home(rep.url)
         attempts = 0
         max_attempts = 2 * len(router._replicas_snapshot()) + 2
+        give_up_at = None  # armed mid-stream: wall-clock, not attempts
         while True:
             attempts += 1
-            if rep is None or attempts > max_attempts:
+            exhausted = attempts > max_attempts
+            if exhausted and self._started:
+                # mid-stream the attempt cap converts to a wall-clock
+                # budget: composed kills (prefill AND decode replica
+                # SIGKILLed inside one chaos cycle) can burn the whole
+                # cap on pick → dial → die rounds while the fleet is
+                # at zero capacity, and an in-band failure here is
+                # TERMINAL at the client — user-visible.  The fleet
+                # contract is that the supervisor heals within
+                # seconds; ride the heal out (found by
+                # tools/chaos_campaign.py --proof seed 10, pinned in
+                # tests/test_chaos_campaign.py).
+                if give_up_at is None:
+                    give_up_at = time.monotonic() + self.HANDOFF_WAIT_S
+                if time.monotonic() < give_up_at:
+                    exhausted = False
+                    time.sleep(0.05)
+            if rep is None or exhausted:
                 return self._stream_fail(
                     gen, "no replica available for generation '{}'".format(
                         gen.gen_id))
@@ -2964,6 +2996,19 @@ class _RouterHandler(BaseHttpHandler):
                 return
             new_rep = (router.pick_for_generation(gen, exclude={rep.url})
                        or router.pick_for_generation(gen))
+            if new_rep is None:
+                # mid-stream zero-capacity window: every routable
+                # replica is down at once (composed kills can land
+                # between supervisor heals — prefill AND decode
+                # SIGKILLed in one chaos cycle).  Tokens are already
+                # out, so a typed failure here is USER-VISIBLE and the
+                # in-band error event is terminal at the client; the
+                # fleet contract is that the supervisor heals the pool
+                # within seconds — wait for capacity instead of
+                # failing the stream (found by tools/chaos_campaign.py
+                # --proof seed 10, pinned in
+                # tests/test_chaos_campaign.py)
+                new_rep = self._wait_for_handoff_replica(gen, rep.url)
             if new_rep is None:
                 return self._stream_fail(
                     gen, "no replica available to hand off generation "
@@ -3086,6 +3131,26 @@ class _RouterHandler(BaseHttpHandler):
         return self._send_error_json(
             "unknown generation '{}' and no replica holds it".format(
                 resume_id), 404)
+
+    #: mid-stream zero-capacity wait: how long a handoff with tokens
+    #: already relayed polls for a healed replica before surfacing the
+    #: terminal in-band error.  The supervisor's SIGKILL → respawn →
+    #: probe re-admission cycle is seconds; this covers two serial
+    #: heals (the composed-kill worst case) with margin.
+    HANDOFF_WAIT_S = 15.0
+
+    def _wait_for_handoff_replica(self, gen, dead_url):
+        """Poll for a routable handoff target while the supervisor
+        heals a zero-capacity fleet; None only after HANDOFF_WAIT_S."""
+        router = self.router
+        deadline = time.monotonic() + self.HANDOFF_WAIT_S
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            rep = (router.pick_for_generation(gen, exclude={dead_url})
+                   or router.pick_for_generation(gen))
+            if rep is not None:
+                return rep
+        return None
 
     def _stream_fail(self, gen, message, status=503):
         """Terminal router-side stream failure: typed (503 by default,

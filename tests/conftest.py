@@ -39,7 +39,8 @@ def _thread_leak_guard(request):
     if not (request.node.get_closest_marker("chaos")
             or request.node.get_closest_marker("pool")
             or request.node.get_closest_marker("router")
-            or request.node.get_closest_marker("fleet")):
+            or request.node.get_closest_marker("fleet")
+            or request.node.get_closest_marker("campaign")):
         yield
         return
     before = {t.ident for t in threading.enumerate()}

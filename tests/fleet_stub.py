@@ -16,6 +16,17 @@ Control surface (what tests poke):
     POST /stub/state {"wedged": true}        # stop answering probes
     POST /stub/state {"infer_delay_ms": 200} # gray failure: slow, not
                                              # dead (probes still 200)
+    POST /stub/state {"sever_streams": 2}    # abruptly drop the next 2
+                                             # live generation streams
+                                             # mid-token (no terminal
+                                             # event; replay state kept
+                                             # so clients resume)
+    POST /stub/state {"partition_ms": 300}   # half-open partition: ONE
+                                             # live stream stalls that
+                                             # long with the connection
+                                             # open (reads hang, no
+                                             # error — the faults.py
+                                             # 'partition' shape)
 
 ``--ttl S`` makes the process exit nonzero after S seconds — the
 always-crashing replica that exhausts a restart budget.
@@ -42,6 +53,15 @@ resumable SSE contract closely enough for router-HA tier-1 tests:
   answers the typed 404 the real scheduler would;
 - ``parameters.token_delay_ms`` stretches token cadence so kill tests
   can land a SIGKILL provably mid-generation.
+
+Model ``stubgen`` is the same generation machinery behind
+generation-shaped KServe metadata (``PROMPT_IDS`` INT32[-1] +
+``MAX_TOKENS`` INT32[1] -> ``TOKEN`` INT32[-1]) so the distributed
+perf_analyzer's ``--generation`` pool builder can drive a stub fleet;
+``/metrics`` additionally exposes ``tpu_prefix_cache_hits_total`` /
+``tpu_prefix_cache_misses_total`` moved by longest-seen-prefix
+matching over generation prompts, giving chaos-campaign proof runs a
+real fleet prefix-hit%% column without jax replicas.
 """
 
 import argparse
@@ -122,7 +142,13 @@ def main():
              # (the process keeps answering probes — that is the gray
              # shape) and then recover it
              "infer_delay_ms": args.infer_delay_ms,
-             "infer_jitter_ms": args.infer_jitter_ms}
+             "infer_jitter_ms": args.infer_jitter_ms,
+             # one-shot chaos-campaign controls (POST /stub/state):
+             # a sever budget (next N live streams get dropped with no
+             # terminal event) and a half-open partition (ONE live
+             # stream stalls with its connection open)
+             "sever_streams": 0,
+             "partition_ms": 0.0}
     # glibc LCG constants over 2^31 — matches tpuserver.faults' jitter
     # mode so stub soaks replay exactly run to run
     lcg = {"state": (args.port * 2654435761) % (1 << 31)}
@@ -142,6 +168,12 @@ def main():
     }
 
     served = {"count": 0, "ns": 0, "gen": 0}
+    # longest-seen-prefix accounting over generation prompts: the stub
+    # twin of the radix prefix cache's hit/miss token counters, so a
+    # fleet /metrics view (and a perf proof run's prefix-hit%% column)
+    # has real numbers to aggregate.  "seen" holds every prefix tuple
+    # of every admitted prompt
+    prefix = {"seen": set(), "hits": 0, "misses": 0}
     # replica-local generation replay state: gid -> {"fed": [ids the
     # virtual model consumed], "emitted": [tokens], "target": int,
     # "delay_ms": float, "done": bool} — what makes Last-Event-ID
@@ -172,7 +204,8 @@ def main():
                 "max_inflight": None,
                 "pid": os.getpid(),
                 "role": args.role or None,
-                "models": {"stub": dict(model)},
+                "models": {"stub": dict(model),
+                           "stubgen": dict(model)},
             }
 
     STUB_METADATA = {
@@ -188,6 +221,27 @@ def main():
                    "dims": [8]}],
         "output": [{"name": "OUTPUT0", "data_type": "TYPE_FP32",
                     "dims": [1]}],
+    }
+    # the generation-shaped alias: same replay/resume machinery as
+    # /v2/models/stub/generate_stream, but with the dynamic-prompt
+    # metadata perf_analyzer's --generation pool builder synthesizes
+    # against (PROMPT_IDS gets --prompt-len ids, MAX_TOKENS is pinned)
+    STUBGEN_METADATA = {
+        "name": "stubgen", "versions": ["1"], "platform": "stub",
+        "inputs": [
+            {"name": "PROMPT_IDS", "datatype": "INT32", "shape": [-1]},
+            {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1]}],
+        "outputs": [
+            {"name": "TOKEN", "datatype": "INT32", "shape": [-1]}],
+    }
+    STUBGEN_CONFIG = {
+        "name": "stubgen", "platform": "stub", "max_batch_size": 0,
+        "input": [{"name": "PROMPT_IDS", "data_type": "TYPE_INT32",
+                   "dims": [-1]},
+                  {"name": "MAX_TOKENS", "data_type": "TYPE_INT32",
+                   "dims": [1]}],
+        "output": [{"name": "TOKEN", "data_type": "TYPE_INT32",
+                    "dims": [-1]}],
     }
 
     def model_statistics():
@@ -209,6 +263,7 @@ def main():
         with lock:
             count = served["count"]
             gens = served["gen"]
+            hits, misses = prefix["hits"], prefix["misses"]
         return (
             "# HELP stub_requests_total Inferences served by this "
             "stub replica.\n"
@@ -217,7 +272,16 @@ def main():
             "# HELP stub_generations_total Generation streams served "
             "by this stub replica.\n"
             "# TYPE stub_generations_total counter\n"
-            "stub_generations_total {}\n".format(count, gens))
+            "stub_generations_total {}\n"
+            "# HELP tpu_prefix_cache_hits_total Prompt tokens served "
+            "from the (stub) prefix cache.\n"
+            "# TYPE tpu_prefix_cache_hits_total counter\n"
+            "tpu_prefix_cache_hits_total {}\n"
+            "# HELP tpu_prefix_cache_misses_total Prompt tokens "
+            "prefilled cold by the (stub) prefix cache.\n"
+            "# TYPE tpu_prefix_cache_misses_total counter\n"
+            "tpu_prefix_cache_misses_total {}\n".format(
+                count, gens, hits, misses))
 
     class Handler(BaseHTTPRequestHandler):
         # the stub answers with several small writes (status, headers,
@@ -255,7 +319,12 @@ def main():
                 return self._json(STUB_METADATA)
             if self.path == "/v2/models/stub/config":
                 return self._json(STUB_CONFIG)
-            if self.path in ("/v2/models/stats", "/v2/models/stub/stats"):
+            if self.path == "/v2/models/stubgen":
+                return self._json(STUBGEN_METADATA)
+            if self.path == "/v2/models/stubgen/config":
+                return self._json(STUBGEN_CONFIG)
+            if self.path in ("/v2/models/stats", "/v2/models/stub/stats",
+                             "/v2/models/stubgen/stats"):
                 return self._json(model_statistics())
             if self.path.startswith("/v2/kvexport/"):
                 from urllib.parse import unquote
@@ -302,9 +371,9 @@ def main():
                 return
             self._json({"error": "unknown: " + self.path}, 404)
 
-        def _emit_event(self, gid, seq, token):
+        def _emit_event(self, gid, seq, token, model_name="stub"):
             payload = {
-                "model_name": "stub",
+                "model_name": model_name,
                 "outputs": [{"name": "TOKEN", "datatype": "INT32",
                              "shape": [1], "data": [int(token)]}],
                 "parameters": {"generation_id": gid, "seq": seq},
@@ -314,7 +383,7 @@ def main():
                 + b"data: " + json.dumps(payload).encode("ascii")
                 + b"\n\n")
 
-        def _generate_stream(self, body):
+        def _generate_stream(self, body, model_name="stub"):
             """The scheduler-backed SSE generate contract, stub-sized:
             TOKEN events with generation_id/seq parameters, the
             explicit terminal event, Last-Event-ID resume from a
@@ -329,7 +398,7 @@ def main():
                     "PROMPT_IDS") or [0]]
                 max_tokens = int((inputs.get("MAX_TOKENS") or [4])[0])
                 params = request.get("parameters") or {}
-                gid = str(params.get("generation_id") or "stubgen")
+                gid = str(params.get("generation_id") or "")
                 delay_ms = float(params.get("token_delay_ms") or 0.0)
                 kv_prefill = params.get("kv_phase") == "prefill"
             except (TypeError, ValueError):
@@ -348,6 +417,13 @@ def main():
                     except ValueError:
                         from_seq = 0
             with lock:
+                if not resuming and not gid:
+                    # anonymous fresh admission: assign a unique gid
+                    # (scheduler parity — the real server mints one),
+                    # so N concurrent perf streams never supersede
+                    # each other's replay records
+                    served["gidseq"] = served.get("gidseq", 0) + 1
+                    gid = "stubgen-{}".format(served["gidseq"])
                 entry = gens.get(gid)
                 if resuming:
                     if entry is None:
@@ -362,6 +438,18 @@ def main():
                         "done": False,
                     }
                     served["gen"] += 1
+                    # longest-seen-prefix hit/miss accounting (token
+                    # units, like the real radix cache's counters)
+                    t = tuple(prompt)
+                    best = 0
+                    for i in range(len(t), 0, -1):
+                        if t[:i] in prefix["seen"]:
+                            best = i
+                            break
+                    prefix["hits"] += best
+                    prefix["misses"] += len(t) - best
+                    for i in range(1, len(t) + 1):
+                        prefix["seen"].add(t[:i])
             if resuming and entry is None:
                 return self._json(
                     {"error": "unknown or expired generation id "
@@ -371,14 +459,36 @@ def main():
             self.end_headers()
             try:
                 while True:
+                    sever = False
+                    stall_ms = 0.0
                     with lock:
                         emitted = list(entry["emitted"])
                         done = entry["done"]
                         delay = entry["delay_ms"]
+                        if from_seq > 0:
+                            # one-shot chaos controls land only MID-
+                            # stream (at least one event already out on
+                            # THIS connection): a sever drops it with
+                            # no terminal event (replay state stays for
+                            # the client's resume); a partition stalls
+                            # it with the connection open — the
+                            # half-open shape (reads hang, no error)
+                            if state["sever_streams"] > 0:
+                                state["sever_streams"] -= 1
+                                sever = True
+                            elif state["partition_ms"] > 0:
+                                stall_ms = state["partition_ms"]
+                                state["partition_ms"] = 0.0
+                    if sever:
+                        self.close_connection = True
+                        return
+                    if stall_ms > 0:
+                        time.sleep(stall_ms / 1000.0)
                     # replay the requester's gap, then splice live
                     while from_seq < len(emitted):
                         self._emit_event(
-                            gid, from_seq, emitted[from_seq])
+                            gid, from_seq, emitted[from_seq],
+                            model_name)
                         from_seq += 1
                     if done:
                         break
@@ -428,6 +538,8 @@ def main():
                 })
             if self.path == "/v2/models/stub/generate_stream":
                 return self._generate_stream(body)
+            if self.path == "/v2/models/stubgen/generate_stream":
+                return self._generate_stream(body, "stubgen")
             if (self.path.startswith("/v2/kvexport/")
                     and self.path.endswith("/release")):
                 from urllib.parse import unquote

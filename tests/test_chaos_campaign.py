@@ -1,0 +1,519 @@
+"""Chaos campaign engine tests (tpuserver/chaoslib.py +
+tools/chaos_campaign.py):
+
+- unit tests for every named invariant checker — one violating and one
+  clean case each, asserting the typed :class:`chaoslib.Violation`
+  payload, not just a boolean;
+- the seeded fault scheduler: same seed => byte-identical schedule
+  (object-level AND through the ``--print-schedule`` CLI), serial-group
+  spacing, unknown-kind rejection, and the minimized single-command
+  repro a failing campaign prints;
+- seed-pinned campaign regressions (marked ``campaign``): the exact
+  seeds whose multi-fault compositions exposed the cross-fault bugs
+  this engine fixed — seed 4 (sever drew a same-cycle corpse), seeds
+  1/5/6 (metrics scrape racing a drain-exit/double-takeover) — must
+  stay green forever.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpuserver import chaoslib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAMPAIGN = os.path.join(REPO, "tools", "chaos_campaign.py")
+ENV = dict(os.environ,
+           PYTHONPATH=os.path.join(REPO, "src", "python"))
+
+
+def _load_campaign_module():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_campaign_under_test", CAMPAIGN)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- invariant library: one violating + one clean case per checker ----------
+
+
+def test_recorder_collects_and_sinks():
+    seen = []
+    recorder = chaoslib.InvariantRecorder(sink=seen.append)
+    assert recorder.ok
+    v = recorder.record("token_identity", "boom", context="c", a=1)
+    assert not recorder.ok and recorder.count == 1
+    assert seen == [v]
+    assert v.as_dict() == {
+        "invariant": "token_identity", "context": "c",
+        "message": "boom", "details": {"a": 1},
+    }
+
+
+def test_token_identity_clean_and_violation():
+    recorder = chaoslib.InvariantRecorder()
+    assert chaoslib.check_token_identity(recorder, [1, 2], [1, 2])
+    assert recorder.ok
+    assert not chaoslib.check_token_identity(
+        recorder, [1, 2], [1, 3], context="c0")
+    (v,) = recorder.violations
+    assert v.invariant == "token_identity"
+    assert v.details["expected"] == [1, 2]
+    assert v.details["actual"] == [1, 3]
+
+
+def test_seq_continuity_clean_gap_duplicate_and_short():
+    recorder = chaoslib.InvariantRecorder()
+    assert chaoslib.check_seq_continuity(recorder, [0, 1, 2])
+    assert chaoslib.check_seq_continuity(
+        recorder, [0, 1, 2], expected_len=3)
+    assert recorder.ok
+    assert not chaoslib.check_seq_continuity(recorder, [0, 2])   # gap
+    assert not chaoslib.check_seq_continuity(recorder, [0, 0, 1])  # dup
+    assert not chaoslib.check_seq_continuity(
+        recorder, [0, 1], expected_len=3)                     # truncated
+    assert recorder.count == 3
+    assert all(v.invariant == "seq_continuity"
+               for v in recorder.violations)
+
+
+def test_counters_monotonic_clean_and_violation():
+    recorder = chaoslib.InvariantRecorder()
+    assert chaoslib.check_counters_monotonic(
+        recorder, {"a": 1, "b": 5}, {"a": 1, "b": 9}, ("a", "b"))
+    assert recorder.ok
+    assert not chaoslib.check_counters_monotonic(
+        recorder, {"a": 4}, {"a": 2}, ("a",),
+        message_fmt=lambda k, p, n: "custom {} {} {}".format(k, p, n))
+    (v,) = recorder.violations
+    assert v.invariant == "counter_monotonicity"
+    assert v.message == "custom a 4 2"
+    assert v.details["before"] == 4 and v.details["after"] == 2
+
+
+def test_journal_single_writer_clean_and_violation():
+    recorder = chaoslib.InvariantRecorder()
+    routers = [
+        {"role": "active", "state": "up", "pid": 1},
+        {"role": "standby", "state": "up", "pid": 2},
+    ]
+    assert chaoslib.check_journal_single_writer(recorder, routers)
+    assert recorder.ok
+    routers[1]["role"] = "active"  # two live actives, one journal
+    assert not chaoslib.check_journal_single_writer(recorder, routers)
+    (v,) = recorder.violations
+    assert v.invariant == "journal_single_writer"
+    assert v.details["active"] == 2
+
+
+def test_shm_consistency_clean_and_violation():
+    recorder = chaoslib.InvariantRecorder()
+    assert chaoslib.check_shm_consistency(
+        recorder, {"ring"}, {"ring"})
+    assert recorder.ok
+    assert not chaoslib.check_shm_consistency(
+        recorder, {"ring", "kvexport/g1"}, {"ring", "other"})
+    (v,) = recorder.violations
+    assert v.invariant == "shm_consistency"
+    assert v.details["leaked"] == ["kvexport/g1"]
+    assert v.details["missing"] == ["other"]
+
+
+def test_wait_stream_drain_clean_and_timeout():
+    drained, stats = chaoslib.wait_stream_drain(
+        lambda: {"live_streams": 0, "pending": 0}, timeout_s=1.0)
+    assert drained and stats["live_streams"] == 0
+    drained, stats = chaoslib.wait_stream_drain(
+        lambda: {"live_streams": 2, "pending": 1}, timeout_s=0.1)
+    assert not drained and stats["live_streams"] == 2
+
+
+def test_wait_fleet_converged_clean_and_timeout():
+    calls = [0]
+
+    def stats_fn():
+        # converges on the third poll: restarts move AND the fleet is
+        # back at target with its per-role split
+        calls[0] += 1
+        healing = calls[0] < 3
+        return {
+            "replica_restarts": 0 if healing else 1,
+            "up": 1 if healing else 2,
+            "phase_replicas_up": ({"prefill": 0, "decode": 1} if healing
+                                  else {"prefill": 1, "decode": 1}),
+            "retired_replicas": 0,
+        }
+
+    assert chaoslib.wait_fleet_converged(
+        stats_fn, membership_fn=lambda: [{"url": "a"}, {"url": "b"}],
+        restarts_above=0, up=2,
+        phase_up={"prefill": 1, "decode": 1}, members=2,
+        timeout_s=5.0, poll_s=0.01)
+    assert not chaoslib.wait_fleet_converged(
+        lambda: {"replica_restarts": 0, "up": 1, "retired_replicas": 0},
+        up=2, timeout_s=0.1, poll_s=0.01)
+    # a retired replica (burned restart budget) can never converge
+    assert not chaoslib.wait_fleet_converged(
+        lambda: {"replica_restarts": 5, "up": 2, "retired_replicas": 1},
+        up=2, timeout_s=0.1, poll_s=0.01)
+
+
+def test_thread_leak_check_clean_and_violation():
+    recorder = chaoslib.InvariantRecorder()
+    baseline = chaoslib.thread_baseline()
+    assert chaoslib.check_no_thread_leaks(
+        recorder, baseline, grace_s=0.1)
+    assert recorder.ok
+    release = threading.Event()
+    leaker = threading.Thread(
+        target=release.wait, name="campaign-leaker", daemon=False)
+    leaker.start()
+    try:
+        assert not chaoslib.check_no_thread_leaks(
+            recorder, baseline, grace_s=0.2)
+        (v,) = recorder.violations
+        assert v.invariant == "thread_leak"
+        assert "campaign-leaker" in v.details["threads"]
+    finally:
+        release.set()
+        leaker.join(timeout=5)
+
+
+class _MetricsTarget:
+    """A stdlib HTTP /metrics endpoint whose exposition the test
+    mutates between scrapes."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        state = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                body = state.text.encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.text = ""
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.url = "127.0.0.1:{}".format(self.server.server_address[1])
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self.thread.join(timeout=5)
+
+
+def test_metrics_monotonicity_decrease_vanish_and_rebind():
+    target = _MetricsTarget()
+    recorder = chaoslib.InvariantRecorder()
+    check = chaoslib.MetricsMonotonicityCheck(
+        target.url, "t", recorder)
+    try:
+        target.text = "tpu_a_total 5\ntpu_b_total 1\n"
+        assert check.scrapeable()
+        check.check(0)          # seeds the baseline
+        target.text = "tpu_a_total 7\ntpu_b_total 1\n"
+        check.check(1)          # increase: clean
+        assert recorder.ok
+        target.text = "tpu_a_total 3\n"  # a decreased, b vanished
+        check.check(2)
+        kinds = sorted(v.details["kind"] for v in recorder.violations)
+        assert kinds == ["decreased", "vanished"]
+        assert all(v.invariant == "metric_monotonicity"
+                   for v in recorder.violations)
+        # rebind = new process: the dropped baseline makes the restart
+        # legitimate — no new violations
+        before = recorder.count
+        check.rebind(target.url)
+        check.check(3)
+        assert recorder.count == before
+    finally:
+        target.close()
+    # the target is gone now: probe-only scrapeable() stays silent,
+    # the recording check types it as unscrapeable
+    assert not check.scrapeable()
+    assert recorder.count == before
+    check.check(4)
+    assert recorder.violations[-1].details["kind"] == "unscrapeable"
+
+
+def test_metrics_monotonicity_require_prefix():
+    target = _MetricsTarget()
+    recorder = chaoslib.InvariantRecorder()
+    check = chaoslib.MetricsMonotonicityCheck(
+        target.url, "t", recorder, require_prefix=True)
+    try:
+        target.text = "tpu_a_total 5\n"
+        check.check(0)
+        assert recorder.violations[-1].details["kind"] == "prefix_missing"
+        target.text = ("tpu_a_total 5\n"
+                       "tpu_prefix_cache_hits_total 11\n")
+        before = recorder.count
+        check.check(1)
+        assert recorder.count == before
+        assert check.prefix_hits == 11
+    finally:
+        target.close()
+
+
+# -- seeded fault scheduler --------------------------------------------------
+
+
+def test_schedule_same_seed_identical_different_seed_not():
+    kinds = ["prefill_sigkill", "gray_slow", "stream_sever"]
+    a = chaoslib.FaultSchedule.compose(7, kinds, 3)
+    b = chaoslib.FaultSchedule.compose(7, kinds, 3)
+    assert a.to_json() == b.to_json()
+    assert a.describe() == b.describe()
+    c = chaoslib.FaultSchedule.compose(8, kinds, 3)
+    assert a.to_json() != c.to_json()
+
+
+def test_schedule_serial_groups_never_overlap():
+    # router_sigkill + router_sigterm share the "router" serial group;
+    # kills share "kill": within every cycle same-group entries must
+    # sit >= SERIAL_GAP_S apart
+    kinds = ["router_sigkill", "router_sigterm",
+             "replica_sigkill", "prefill_sigkill"]
+    schedule = chaoslib.FaultSchedule.compose(3, kinds, 4)
+    for cycle in range(4):
+        entries = schedule.for_cycle(cycle)
+        for group in ("router", "kill"):
+            offsets = sorted(
+                e.offset_s for e in entries
+                if chaoslib.FAULT_KINDS[e.kind][1] == group)
+            for lo, hi in zip(offsets, offsets[1:]):
+                assert hi - lo >= chaoslib.SERIAL_GAP_S - 1e-9
+
+
+def test_schedule_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        chaoslib.FaultSchedule.compose(0, ["nope"], 1)
+
+
+def test_kinds_through_restricts_to_fired_prefix():
+    schedule = chaoslib.FaultSchedule.compose(
+        5, ["gray_slow", "partition"], 3)
+    assert set(schedule.kinds_through(0)) == {"gray_slow", "partition"}
+    assert set(schedule.kinds_through(2)) == {"gray_slow", "partition"}
+
+
+def test_minimized_repro_single_command():
+    assert chaoslib.minimized_repro(9, 1, ["a", "b"]) == (
+        "python tools/chaos_campaign.py --seed 9 --cycles 2 "
+        "--faults a,b")
+    assert chaoslib.minimized_repro(
+        0, 0, ["x"], extra_args=("--quick",)) == (
+        "python tools/chaos_campaign.py --seed 0 --cycles 1 "
+        "--faults x --quick")
+
+
+def test_campaign_runner_records_injector_errors():
+    schedule = chaoslib.FaultSchedule.compose(
+        1, ["gray_slow"], 1, window_s=0.15)
+    recorder = chaoslib.InvariantRecorder()
+    fired = []
+
+    def broken(entry):
+        fired.append(entry.kind)
+        raise ValueError("stub exploded")
+
+    runner = chaoslib.CampaignRunner(
+        schedule, {"gray_slow": broken}, recorder)
+    runner.run_cycle(0)
+    assert fired == ["gray_slow"]
+    (v,) = recorder.violations
+    assert v.invariant == "injector_error"
+    assert "stub exploded" in v.message
+    with pytest.raises(ValueError, match="no injector"):
+        chaoslib.CampaignRunner(schedule, {}, recorder)
+
+
+# -- CLI: deterministic replay + minimized repro -----------------------------
+
+
+def _print_schedule(seed):
+    return subprocess.run(
+        [sys.executable, CAMPAIGN, "--print-schedule",
+         "--seed", str(seed), "--cycles", "2",
+         "--faults", "prefill_sigkill,gray_slow,stream_sever"],
+        capture_output=True, text=True, env=ENV, timeout=60)
+
+
+def test_cli_print_schedule_is_deterministic():
+    first = _print_schedule(11)
+    second = _print_schedule(11)
+    assert first.returncode == 0, first.stderr
+    assert first.stdout == second.stdout
+    assert "schedule seed=11 cycles=2" in first.stdout
+    other = _print_schedule(12)
+    assert first.stdout != other.stdout
+
+
+def test_cli_rejects_unknown_fault_kind():
+    proc = subprocess.run(
+        [sys.executable, CAMPAIGN, "--faults", "warp_core_breach",
+         "--print-schedule"],
+        capture_output=True, text=True, env=ENV, timeout=60)
+    assert proc.returncode == 2
+    assert "unknown fault kind" in proc.stderr
+
+
+def test_failing_campaign_prints_minimized_repro(capsys, monkeypatch):
+    """A violated invariant must come back as ONE replayable command:
+    same seed, cycles truncated to the first violating cycle, faults
+    restricted to the kinds that had fired by then."""
+    mod = _load_campaign_module()
+
+    def fake_run_campaign(args, schedule):
+        recorder = chaoslib.InvariantRecorder()
+        recorder.record(
+            "token_identity",
+            "campaign cycle 1 worker 0 stream 0: tokens diverged",
+            context="campaign cycle 1 worker 0 stream 0")
+        return recorder, {"cycles_run": 2, "streams": 4,
+                          "takeovers": 0}
+
+    monkeypatch.setattr(mod, "run_campaign", fake_run_campaign)
+    monkeypatch.setattr(sys, "argv", [
+        "chaos_campaign.py", "--seed", "9", "--cycles", "3",
+        "--faults", "prefill_sigkill,gray_slow"])
+    rc = mod.main()
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "chaos campaign FAILED: 1 invariant violation(s)" in out.err
+    schedule = chaoslib.FaultSchedule.compose(
+        9, ["prefill_sigkill", "gray_slow"], 3)
+    expected = chaoslib.minimized_repro(
+        9, 1, schedule.kinds_through(1))
+    assert "MINIMIZED REPRO: {}".format(expected) in out.out
+    # the repro really is truncated: cycles 2 (not 3), not the full run
+    assert "--cycles 2" in expected
+
+
+def test_passing_campaign_report_json(capsys, monkeypatch, tmp_path):
+    mod = _load_campaign_module()
+
+    def fake_run_campaign(args, schedule):
+        return chaoslib.InvariantRecorder(), {
+            "cycles_run": args.cycles, "streams": 6, "takeovers": 1}
+
+    report = tmp_path / "campaign.json"
+    monkeypatch.setattr(mod, "run_campaign", fake_run_campaign)
+    monkeypatch.setattr(sys, "argv", [
+        "chaos_campaign.py", "--seed", "2", "--cycles", "2",
+        "--faults", "gray_slow", "--json", str(report)])
+    rc = mod.main()
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "chaos campaign OK: seed 2" in out.out
+    data = json.loads(report.read_text())
+    assert data["seed"] == 2
+    assert data["violations"] == []
+    assert data["summary"]["streams"] == 6
+
+
+# -- seed-pinned campaign regressions (the bugs the engine exposed) ---------
+
+ALL_FAULTS = ("prefill_sigkill,stream_sever,router_sigkill,"
+              "replica_sigkill,partition,gray_slow,gray_jitter,"
+              "router_sigterm")
+
+
+def _run_campaign_cli(seed, cycles, faults, timeout=240):
+    return subprocess.run(
+        [sys.executable, CAMPAIGN, "--seed", str(seed),
+         "--cycles", str(cycles), "--faults", faults],
+        capture_output=True, text=True, env=ENV, timeout=timeout)
+
+
+@pytest.mark.campaign
+def test_campaign_seed1_composed_router_faults_regression():
+    """Seeds 1/5 found the one-shot metrics scrape racing a SIGTERMed
+    active's drain-exit (false "not scrapeable"); the takeover settle
+    (+ scrapeable() probe) must keep this composition green."""
+    proc = _run_campaign_cli(1, 1, ALL_FAULTS)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "chaos campaign OK" in proc.stdout
+    assert "INVARIANT VIOLATED" not in proc.stderr
+
+
+@pytest.mark.campaign
+def test_campaign_seed4_sever_draws_corpse_regression():
+    """Seed 4 found stream_sever drawing a victim a same-cycle kill
+    had already felled (supervisor stats lag the probe tick): the
+    injector must walk to the next live candidate, not fault."""
+    proc = _run_campaign_cli(
+        4, 2, "gray_slow,router_sigkill,prefill_sigkill,stream_sever,"
+              "router_sigterm,replica_sigkill,partition,gray_jitter")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "chaos campaign OK" in proc.stdout
+    assert "injector" not in proc.stderr
+
+
+@pytest.mark.campaign
+def test_campaign_seed10_proof_double_kill_regression(tmp_path):
+    """Seed 10's proof run found three composed-kill interaction bugs
+    (prefill AND decode replica SIGKILLed inside one campaign cycle
+    opens a zero-capacity window the supervisor needs seconds to
+    heal): the perf client's default 5-attempt reconnect budget backs
+    off for only ~1.5 s and gave up mid-heal
+    (client_backend.GENERATION_MAX_RECONNECTS); the router burned its
+    whole pick→dial→die attempt cap mid-stream and failed STARTED
+    streams with a terminal in-band error (the wall-clock
+    ``give_up_at`` budget + ``_wait_for_handoff_replica``); and a
+    phase-split admission whose decode pool emptied AFTER the prefill
+    token relayed returned ``plan["rep"] is None`` straight into that
+    same terminal fail.  The proof row's error budget must read
+    zero."""
+    row_path = tmp_path / "proof_row.json"
+    proc = subprocess.run(
+        [sys.executable, CAMPAIGN, "--proof", str(row_path),
+         "--seed", "10",
+         "--faults",
+         "prefill_sigkill,replica_sigkill,gray_slow,stream_sever",
+         "--workers", "2", "--concurrency", "32", "--cycles", "2"],
+        capture_output=True, text=True, env=ENV, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-4000:],
+                                  proc.stderr[-4000:])
+    row = json.loads(row_path.read_text())
+    assert row["error_budget"] == 0
+    assert row["streams"] == 64
+    assert row["resumed_streams"] > 0  # the campaign actually bit
+    # the prefix-hit%% column survives the zero-capacity window (the
+    # parent probe's graced snapshot; a None here means the before-
+    # scrape raced the cycle-0 double kill again)
+    assert row["prefix_hit_pct"] is not None
+    assert set(row["fault_kinds"]) == {
+        "prefill_sigkill", "replica_sigkill", "gray_slow",
+        "stream_sever"}
+
+
+@pytest.mark.campaign
+def test_campaign_seed6_double_takeover_same_port_regression():
+    """Seed 6 found a double takeover returning the active role to the
+    SAME port as a NEW process (fresh counters): rebinding on URL
+    comparison missed it and read a false DECREASED.  The rebind must
+    key on the takeover-count delta."""
+    proc = _run_campaign_cli(
+        6, 2, "prefill_sigkill,replica_sigkill,gray_slow,stream_sever,"
+              "router_sigkill,router_sigterm,partition,gray_jitter")
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "chaos campaign OK" in proc.stdout
+    assert "DECREASED" not in proc.stderr

@@ -434,3 +434,40 @@ def test_supervisor_scales_only_the_pressured_pool():
         assert roles.count("prefill") == 2 and roles.count("decode") == 1
     finally:
         supervisor.stop()
+
+
+def test_prefill_leg_uses_derived_generation_id():
+    """The prefill leg's replica-side record is a COMPLETED one-token
+    generation; under the REAL generation id, a router that crashed
+    mid-split and recovered home=prefill-replica would resume against
+    it, get an instant clean final, and silently truncate the stream
+    to one token (chaos campaign seed 7).  The leg must run under a
+    DERIVED id so that stale resume 404s and heals via handoff."""
+    from tpuserver import disagg
+
+    body = json.dumps({
+        "inputs": [
+            {"name": "PROMPT_IDS", "datatype": "INT32", "shape": [3],
+             "data": [5, 7, 9]},
+            {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1],
+             "data": [6]},
+        ],
+        "parameters": {"generation_id": "g-split-1"},
+    }).encode("utf-8")
+    leg = json.loads(disagg.prefill_leg_body(body))
+    params = leg["parameters"]
+    assert params["generation_id"] == disagg.prefill_leg_id("g-split-1")
+    assert params["generation_id"] != "g-split-1"
+    assert params["kv_phase"] == "prefill"
+    max_tokens = next(t for t in leg["inputs"]
+                      if t["name"] == "MAX_TOKENS")
+    assert max_tokens["data"] == [1]
+    # the suffix must never parse as a handoff epoch: the router
+    # splits resume ids on "~" and treats a digit tail as "gen~offset"
+    tail = disagg.PREFILL_LEG_ID_SUFFIX.rsplit("~", 1)[-1]
+    assert not tail.isdigit()
+    # an anonymous admission has no id to derive — the leg must not
+    # invent one
+    anon = json.loads(disagg.prefill_leg_body(json.dumps(
+        {"inputs": [], "parameters": {}}).encode("utf-8")))
+    assert "generation_id" not in anon["parameters"]

@@ -352,6 +352,128 @@ def test_http_generate_stream_shm_refs_end_to_end():
         core.close()
 
 
+# -- seqlock write-completeness markers (tpuserver.shm_ring) ----------------
+
+
+def _guarded_events(core, parameters):
+    """Seq-guarded streams carry BOTH the in-band TOKEN/LOGPROB (the
+    torn-reader fallback payload) and the ring descriptor params —
+    collect them as (token, params) pairs."""
+    req = InferRequest("llama_generate",
+                      inputs={"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT},
+                      parameters=dict(parameters))
+    out = []
+    for resp in core.infer_stream(req):
+        outputs = {meta["name"]: arr for meta, arr, _ in resp.outputs}
+        out.append((int(outputs["TOKEN"][0]), resp.parameters))
+    return out
+
+
+def _torn_metric(core):
+    for line in core.metrics_text().splitlines():
+        if line.startswith("tpu_shm_ring_torn_total"):
+            return int(float(line.rsplit(None, 1)[1]))
+    raise AssertionError("tpu_shm_ring_torn_total not in exposition")
+
+
+def test_seq_word_encoding_and_slot_committed():
+    """The module truth table: odd begin / even commit words, zero and
+    lapped words never commit, offsets wrap with the ring."""
+    from tpuserver import shm_ring
+
+    for seq in (0, 1, 7, 10**6):
+        b, c = shm_ring.begin_word(seq), shm_ring.commit_word(seq)
+        assert b % 2 == 1 and c % 2 == 0 and c == b + 1
+        assert shm_ring.slot_committed(c, seq)
+        assert not shm_ring.slot_committed(b, seq)  # in progress
+        assert not shm_ring.slot_committed(0, seq)  # never written
+        # stale (earlier lap) and lapped (later writer) words both fail
+        assert not shm_ring.slot_committed(
+            shm_ring.commit_word(seq + 8), seq)
+        if seq >= 8:
+            assert not shm_ring.slot_committed(
+                shm_ring.commit_word(seq - 8), seq)
+    # seq words live in a parallel array wrapped like the ring itself
+    assert shm_ring.seq_word_offset(0, 8, 512) == 512
+    assert shm_ring.seq_word_offset(10, 8, 512) == 512 + 2 * 4
+    assert shm_ring.unpack_word(shm_ring.pack_word(2 * 41 + 2)) == 84
+    before = shm_ring.torn_total()
+    shm_ring.note_torn()
+    shm_ring.note_torn(2)
+    assert shm_ring.torn_total() == before + 3
+
+
+def test_seq_guarded_ring_brackets_every_slot():
+    """shm_ring_seq_base opts the stream into the seqlock bracket:
+    every ring slot's seq word reads commit_word(seq) after the event,
+    events carry seq + offset AND the in-band fallback TOKEN, and the
+    ring payload is token-identical to the in-band run."""
+    from tpuserver import shm_ring
+
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core, values=PROMPT)
+    try:
+        baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+        events = _guarded_events(
+            core, {"shm_ring_region": "plane", "shm_ring_slots": 8,
+                   "shm_ring_offset": 64, "shm_ring_seq_base": 512})
+        assert [tok for tok, _ in events] == baseline  # in-band fallback
+        for seq, (_, params) in enumerate(events):
+            assert params["seq"] == seq
+            assert params["shm_ring_offset"] == 64 + 8 * seq
+            word = shm_ring.unpack_word(xshm.get_contents_as_numpy(
+                handle, "INT32", [1],
+                shm_ring.seq_word_offset(seq, 8, 512)).tobytes())
+            assert shm_ring.slot_committed(word, seq)
+        ring = [int(xshm.get_contents_as_numpy(
+            handle, "INT32", [1], 64 + 8 * i)[0])
+            for i in range(len(baseline))]
+        assert ring == baseline
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
+def test_torn_reader_falls_back_inband_and_counts():
+    """A reader that finds a non-commit seq word rejects the slot,
+    falls back to the event's in-band TOKEN, and the fallback shows up
+    in the server's tpu_shm_ring_torn_total exposition."""
+    from tpuserver import shm_ring
+
+    core, _ = _llama_core(max_slots=2)
+    handle = _staged_region(core, values=PROMPT)
+    try:
+        baseline = _tokens(core, {"PROMPT_IDS": PROMPT, "MAX_TOKENS": MT})
+        events = _guarded_events(
+            core, {"shm_ring_region": "plane", "shm_ring_slots": 8,
+                   "shm_ring_offset": 64, "shm_ring_seq_base": 512})
+        # corrupt slot 3's word back to its in-progress marker — the
+        # torn state a reader racing the writer would observe
+        core.write_shm_ring_seq_word(
+            "plane", shm_ring.seq_word_offset(3, 8, 512),
+            shm_ring.begin_word(3))
+        torn_before = _torn_metric(core)
+        got = []
+        for seq, (inband, params) in enumerate(events):
+            word = shm_ring.unpack_word(xshm.get_contents_as_numpy(
+                handle, "INT32", [1],
+                shm_ring.seq_word_offset(seq, 8, 512)).tobytes())
+            if shm_ring.slot_committed(word, seq):
+                got.append(int(xshm.get_contents_as_numpy(
+                    handle, "INT32", [1],
+                    params["shm_ring_offset"])[0]))
+            else:
+                shm_ring.note_torn()
+                got.append(inband)
+        assert got == baseline  # fallback kept the stream correct
+        assert _torn_metric(core) == torn_before + 1
+    finally:
+        core.unregister_xla_shm("plane")
+        xshm.destroy_shared_memory_region(handle)
+        core.close()
+
+
 @pytest.mark.perf
 def test_perf_analyzer_shared_memory_modes():
     """The CLI's --shared-memory staging end to end (inprocess backend,

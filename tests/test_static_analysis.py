@@ -599,6 +599,14 @@ def _resilience_text():
         return fh.read()
 
 
+def _doc_section(text, title):
+    """One `## title` section of a markdown doc (to its next `## `)."""
+    marker = "\n## {}\n".format(title)
+    start = text.index(marker)
+    end = text.find("\n## ", start + len(marker))
+    return text[start:end if end != -1 else len(text)]
+
+
 def test_fault_table_matches_points_registry():
     """docs/resilience.md's fault-injection table documents exactly the
     points registered in faults.POINTS (R6 pins code<->registry; this
@@ -607,13 +615,45 @@ def test_fault_table_matches_points_registry():
 
     from tpuserver import faults
 
-    text = _resilience_text()
+    text = _doc_section(_resilience_text(), "Fault injection")
     documented = set(re.findall(r"^\|\s*`([a-z_.]+)`\s*\|", text,
                                 flags=re.MULTILINE))
     assert documented == set(faults.POINTS), (
         "fault table drift: documented-only={}, registry-only={}".format(
             documented - set(faults.POINTS),
             set(faults.POINTS) - documented))
+
+
+def test_chaos_campaign_tables_match_chaoslib():
+    """docs/resilience.md's "Chaos campaigns" tables document exactly
+    chaoslib's surfaces: the fault-kind rows are FAULT_KINDS (with the
+    right serial-group column) and the invariant rows are the named
+    checks the module docstring catalogs — doc, registry, and library
+    cannot drift apart."""
+    import re
+
+    from tpuserver import chaoslib
+
+    section = _doc_section(_resilience_text(), "Chaos campaigns")
+    rows = re.findall(r"^\|\s*`([a-z_.]+)`\s*\|\s*([^|]*)\|", section,
+                      flags=re.MULTILINE)
+    documented = {name for name, _ in rows}
+    kinds = set(chaoslib.FAULT_KINDS)
+    invariants = set(re.findall(r"^``([a-z_]+)``\s", chaoslib.__doc__,
+                                flags=re.MULTILINE))
+    assert invariants, "chaoslib docstring catalog unparseable"
+    assert documented == kinds | invariants, (
+        "chaos-campaign table drift: documented-only={}, "
+        "library-only={}".format(documented - (kinds | invariants),
+                                 (kinds | invariants) - documented))
+    for name, group_cell in rows:
+        if name not in kinds:
+            continue
+        group = chaoslib.FAULT_KINDS[name][1]
+        expect = "`{}`".format(group) if group else "—"
+        assert expect in group_cell, (
+            "fault kind {} documents serial group {!r}, registry says "
+            "{!r}".format(name, group_cell.strip(), group))
 
 
 def test_scheduler_stats_keys_are_documented():

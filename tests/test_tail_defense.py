@@ -4,8 +4,9 @@ The router-tier half (gray-failure ejection, hedged unary requests,
 deadline-budget propagation) lives in tests/test_router.py; this file
 pins the pieces under it:
 
-- the two gray-failure fault modes (``slow`` persistent latency,
-  ``jitter`` deterministic seeded-LCG latency) chaos soaks arm;
+- the gray-failure fault modes chaos soaks arm (``slow`` persistent
+  latency, ``jitter`` deterministic seeded-LCG latency, ``partition``
+  half-open stall-until-clear);
 - the CoDel-style adaptive queue-shed controller — clock-driven unit
   pins of the control law, the byte-identical-off default, a real
   continuous-batching scheduler shedding typed 429s under sustained
@@ -74,6 +75,50 @@ def test_jitter_mode_is_deterministic_and_bounded():
     first = sequence("replica-a")
     assert sequence("replica-a") == first  # exact replay
     assert sequence("replica-b") != first  # scoped identity differs
+
+
+def test_partition_mode_stalls_until_clear_and_honors_skip():
+    """``partition`` is the half-open shape: ``skip`` passes flow
+    normally (the connection was accepted, traffic moved), then fires
+    stall — no bytes, no error — until clear() releases every stalled
+    fire promptly."""
+    import threading
+
+    faults.install("test.part", mode="partition", times=1, skip=2)
+    for _ in range(2):  # the skip budget: instant, untripped passes
+        t0 = time.monotonic()
+        assert faults.fire("test.part") is None
+        assert time.monotonic() - t0 < 0.05
+    released = threading.Event()
+
+    def firer():
+        assert faults.fire("test.part") is None  # stalls, never raises
+        released.set()
+
+    t = threading.Thread(target=firer, daemon=True)
+    t.start()
+    assert not released.wait(0.15)  # armed: the fire is stalled
+    assert faults.fired("test.part") == 1
+    assert faults.active("test.part")  # times=1 ignored: persistent
+    faults.clear("test.part")
+    assert released.wait(2.0)  # clear() healed the stalled fire
+    t.join(2.0)
+
+
+def test_partition_mode_bounded_blackout_and_scope():
+    """``delay > 0`` bounds the blackout (the fire returns after the
+    window with no exception), and ``@scope`` targeting confines the
+    stall to one replica — its pool siblings pass through untouched."""
+    faults.install("test.part", mode="partition", delay=0.05,
+                   scope="replica-a")
+    t0 = time.monotonic()
+    assert faults.fire("test.part", "replica-a") is None
+    assert time.monotonic() - t0 >= 0.045
+    t0 = time.monotonic()
+    assert faults.fire("test.part", "replica-b") is None  # unscoped firer
+    assert time.monotonic() - t0 < 0.04
+    assert faults.fired("test.part", "replica-a") == 1
+    assert faults.fired("test.part", "replica-b") == 0
 
 
 def test_latency_modes_reach_a_real_fire_site():

@@ -118,6 +118,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
 
+from tpuserver import chaoslib  # noqa: E402
 from tpuserver import faults  # noqa: E402
 from tpuserver.core import (  # noqa: E402
     DeadlineExceeded,
@@ -158,81 +159,28 @@ def fail(msg):
     print("INVARIANT VIOLATED: {}".format(msg), file=sys.stderr)
 
 
-class RouterMetricsCheck:
+#: Every mode's assertions run on the shared invariant library
+#: (``tpuserver.chaoslib``); this recorder's sink IS the historical
+#: ``fail()`` above, so the ``INVARIANT VIOLATED:`` stderr line, the
+#: ``_failures`` count, and the exit code stay byte-identical to the
+#: pre-extraction CLI.
+RECORDER = chaoslib.InvariantRecorder(sink=lambda v: fail(v.message))
+
+
+class RouterMetricsCheck(chaoslib.MetricsMonotonicityCheck):
     """Per-cycle telemetry invariant for the router/fleet soaks
-    (ISSUE 10): ``GET /metrics`` on the router must stay scrapeable
-    under chaos, and its cumulative families (counters, histogram
-    buckets, and the ``*_total``/``*_count`` compatibility gauges)
-    must NEVER decrease or vanish across cycles — the fleet-aggregated
-    view must survive replica restarts and membership churn without
-    resetting."""
+    (ISSUE 10), now the shared :class:`chaoslib.MetricsMonotonicityCheck`
+    wired to this CLI's recorder: ``GET /metrics`` on the router must
+    stay scrapeable under chaos, and its cumulative families must
+    NEVER decrease or vanish across cycles — the fleet-aggregated view
+    must survive replica restarts and membership churn without
+    resetting.  ``prefix_hits`` (PR 11) holds the last scraped
+    fleet-wide hit total so phases can assert a respawned replica's
+    cold radix cache RE-WARMS."""
 
     def __init__(self, router_url, context, require_prefix=False):
-        host, _, port = router_url.rpartition(":")
-        self.host, self.port = host, int(port)
-        self.context = context
-        self._prev = {}
-        # PR 11: the paged-KV prefix-cache counters must be present in
-        # the fleet view (and, like every cumulative family, monotonic
-        # across healing).  ``prefix_hits`` holds the last scraped
-        # fleet-wide hit total so phases can assert a respawned
-        # replica's cold radix cache RE-WARMS (the counter keeps
-        # moving) instead of just not regressing.
-        self.require_prefix = require_prefix
-        self.prefix_hits = None
-
-    def _scrape(self):
-        import http.client
-
-        conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=10)
-        try:
-            conn.request("GET", "/metrics")
-            resp = conn.getresponse()
-            if resp.status != 200:
-                return None
-            return resp.read().decode("utf-8", errors="replace")
-        except (OSError, http.client.HTTPException):
-            return None
-        finally:
-            conn.close()
-
-    def check(self, cycle):
-        from tpuserver.metrics import is_cumulative, parse_prometheus_text
-
-        text = self._scrape()
-        if text is None:
-            fail("{} cycle {}: router /metrics not scrapeable".format(
-                self.context, cycle))
-            return
-        current = {}
-        for name, fam in parse_prometheus_text(text).items():
-            # the SAME cumulative-family rule the router's aggregator
-            # folds by — the soak checks what the router aggregates
-            if not is_cumulative(name, fam["type"]):
-                continue
-            for sample_name, labels, value in fam["samples"]:
-                current[(sample_name,
-                         tuple(sorted(labels.items())))] = value
-        for key, prev in self._prev.items():
-            now = current.get(key)
-            if now is None:
-                fail("{} cycle {}: fleet counter {} vanished from "
-                     "/metrics (aggregation reset?)".format(
-                         self.context, cycle, key))
-            elif now < prev:
-                fail("{} cycle {}: fleet counter {} DECREASED {} -> "
-                     "{} across a replica restart".format(
-                         self.context, cycle, key, prev, now))
-        self._prev = current
-        hits = [v for (name, _labels), v in current.items()
-                if name == "tpu_prefix_cache_hits_total"]
-        if hits:
-            self.prefix_hits = sum(hits)
-        elif self.require_prefix:
-            fail("{} cycle {}: tpu_prefix_cache_hits_total missing "
-                 "from the fleet /metrics view".format(
-                     self.context, cycle))
+        super().__init__(router_url, context, RECORDER,
+                         require_prefix=require_prefix)
 
 
 def drive_shared_streams(url, context, cycle, shared_ref, budget, n=2):
@@ -261,10 +209,12 @@ def drive_shared_streams(url, context, cycle, shared_ref, budget, n=2):
                      "({}: {})".format(context, cycle,
                                        type(e).__name__, e))
                 continue
-            if tokens != shared_ref:
-                fail("{} cycle {}: shared-prefix tokens diverged: "
-                     "{} != {}".format(context, cycle, tokens,
-                                       shared_ref))
+            chaoslib.check_token_identity(
+                RECORDER, shared_ref, tokens,
+                context="{} cycle {}".format(context, cycle),
+                message="{} cycle {}: shared-prefix tokens diverged: "
+                        "{} != {}".format(context, cycle, tokens,
+                                          shared_ref))
     finally:
         client.close()
 
@@ -298,12 +248,11 @@ def generate(core, prompt, n_tokens, parameters=None):
 
 
 def wait_no_leaks(model, where, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        stats = model._scheduler.stats()
-        if stats["live_streams"] == 0 and stats["pending"] == 0:
-            return True
-    fail("{}: leaked streams {}".format(where, model._scheduler.stats()))
+    drained, stats = chaoslib.wait_stream_drain(
+        model._scheduler.stats, timeout_s=timeout)
+    if drained:
+        return True
+    fail("{}: leaked streams {}".format(where, stats))
     return False
 
 
@@ -340,11 +289,15 @@ def chaos_round(core, model, reference, budget, rnd):
         if outcome is None:
             fail("round {} ({}:{}): request {} never terminated".format(
                 rnd, name, mode, i))
-        elif outcome[0] == "ok" and outcome[1] != reference[i]:
+        elif outcome[0] == "ok":
             # a request that claims success must be token-exact
-            fail("round {} ({}:{}): request {} tokens diverged: "
-                 "{} != {}".format(
-                     rnd, name, mode, i, outcome[1], reference[i]))
+            chaoslib.check_token_identity(
+                RECORDER, reference[i], outcome[1],
+                context="round {}".format(rnd),
+                message="round {} ({}:{}): request {} tokens diverged: "
+                        "{} != {}".format(
+                            rnd, name, mode, i, outcome[1],
+                            reference[i]))
     if mode == "sleep" and outcomes[0] is not None:
         if outcomes[0][0] not in ("deadline", "ok"):
             fail("round {} deadline probe got {} instead of a typed "
@@ -356,9 +309,11 @@ def chaos_round(core, model, reference, budget, rnd):
             rnd, name, mode))
     # recovery bar: a clean run right after the chaos is token-identical
     clean = generate(core, PROMPTS[0], budget)
-    if clean != reference[0]:
-        fail("round {} ({}:{}): post-chaos tokens diverged: "
-             "{} != {}".format(rnd, name, mode, clean, reference[0]))
+    chaoslib.check_token_identity(
+        RECORDER, reference[0], clean,
+        context="round {}".format(rnd),
+        message="round {} ({}:{}): post-chaos tokens diverged: "
+                "{} != {}".format(rnd, name, mode, clean, reference[0]))
     kinds = [o[0] if o else "hang" for o in outcomes]
     print("round {:2d} fault={}:{} outcomes={} live={}".format(
         rnd, name, mode, kinds, model._scheduler.stats()["live_streams"]))
@@ -608,12 +563,17 @@ def router_phase(cycles, soak, budget):
                     fail("router cycle {}: user-visible stream error "
                          "({}: {})".format(cycle, type(e).__name__, e))
                     continue
-                if tokens != reference[which]:
-                    fail("router cycle {}: stream tokens diverged: "
-                         "{} != {}".format(cycle, tokens, reference[which]))
-                if seqs != list(range(len(seqs))) or len(seqs) != budget:
-                    fail("router cycle {}: seq gap/duplicate: {}".format(
-                        cycle, seqs))
+                chaoslib.check_token_identity(
+                    RECORDER, reference[which], tokens,
+                    context="router cycle {}".format(cycle),
+                    message="router cycle {}: stream tokens diverged: "
+                            "{} != {}".format(cycle, tokens,
+                                              reference[which]))
+                chaoslib.check_seq_continuity(
+                    RECORDER, seqs, expected_len=budget,
+                    context="router cycle {}".format(cycle),
+                    message="router cycle {}: seq gap/duplicate: "
+                            "{}".format(cycle, seqs))
         finally:
             client.close()
 
@@ -740,18 +700,12 @@ def fleet_phase(cycles, soak, budget):
         """Recovered = the kill was actually NOTICED (restart counter
         moved past the cycle's baseline — guards against polling a
         stale 'up' before the monitor's next tick) AND the fleet is
-        back at target count with full router membership."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            stats = supervisor.stats()
-            member_urls = {r["url"]
-                           for r in supervisor.router.membership()}
-            if (stats["replica_restarts"] > restarts_before
-                    and stats["up"] == 2 and len(member_urls) == 2
-                    and stats["retired_replicas"] == 0):
-                return True
-            time.sleep(0.1)
-        return False
+        back at target count with full router membership
+        (:func:`chaoslib.wait_fleet_converged`)."""
+        return chaoslib.wait_fleet_converged(
+            supervisor.stats, membership_fn=supervisor.router.membership,
+            restarts_above=restarts_before, up=2, members=2,
+            timeout_s=timeout_s)
 
     try:
         if not supervisor.wait_ready(timeout_s=180.0):
@@ -829,14 +783,18 @@ def fleet_phase(cycles, soak, budget):
                                  "error ({}: {})".format(
                                      cycle, type(e).__name__, e))
                             continue
-                        if tokens != reference[which]:
-                            fail("fleet cycle {}: stream tokens "
-                                 "diverged: {} != {}".format(
-                                     cycle, tokens, reference[which]))
-                        if (seqs != list(range(len(seqs)))
-                                or len(seqs) != budget):
-                            fail("fleet cycle {}: seq gap/duplicate: "
-                                 "{}".format(cycle, seqs))
+                        chaoslib.check_token_identity(
+                            RECORDER, reference[which], tokens,
+                            context="fleet cycle {}".format(cycle),
+                            message="fleet cycle {}: stream tokens "
+                                    "diverged: {} != {}".format(
+                                        cycle, tokens,
+                                        reference[which]))
+                        chaoslib.check_seq_continuity(
+                            RECORDER, seqs, expected_len=budget,
+                            context="fleet cycle {}".format(cycle),
+                            message="fleet cycle {}: seq gap/"
+                                    "duplicate: {}".format(cycle, seqs))
                 finally:
                     wclient.close()
 
@@ -937,9 +895,13 @@ def kill_loop_phase(rounds, slots, budget):
             elif outcome[0] != "ok":
                 fail("kill-loop round {}: request {} failed instead of "
                      "healing: {}".format(rnd, i, outcome[1]))
-            elif outcome[1] != reference[i]:
-                fail("kill-loop round {}: request {} tokens corrupted: "
-                     "{} != {}".format(rnd, i, outcome[1], reference[i]))
+            else:
+                chaoslib.check_token_identity(
+                    RECORDER, reference[i], outcome[1],
+                    context="kill-loop round {}".format(rnd),
+                    message="kill-loop round {}: request {} tokens "
+                            "corrupted: {} != {}".format(
+                                rnd, i, outcome[1], reference[i]))
         if stats["tripped"]:
             fail("kill-loop round {}: scheduler tripped inside the "
                  "budget".format(rnd))
@@ -1028,10 +990,12 @@ def shm_phase(rounds, slots, budget):
                      "healing: {}".format(rnd, i, outcome[1]))
             else:
                 got = ring_tokens(i, budget)
-                if got != reference[i]:
-                    fail("shm round {}: ring {} tokens corrupted after "
-                         "healing: {} != {}".format(
-                             rnd, i, got, reference[i]))
+                chaoslib.check_token_identity(
+                    RECORDER, reference[i], got,
+                    context="shm round {}".format(rnd),
+                    message="shm round {}: ring {} tokens corrupted "
+                            "after healing: {} != {}".format(
+                                rnd, i, got, reference[i]))
         # disconnect -> park-export -> attach-resume, on the spare lane
         lane = len(PROMPTS)
         gid = "shm-park-{}".format(rnd)
@@ -1066,16 +1030,22 @@ def shm_phase(rounds, slots, budget):
         # judged on the ring lane; here pin gap-free seq numbering
         seqs = [resp.parameters.get("seq")
                 for resp in core.infer_stream(resume_req)]
-        if seqs != list(range(budget)):
-            fail("shm round {}: attach-resume seqs not gap-free: "
-                 "{}".format(rnd, seqs))
-        if ring_tokens(lane, budget) != reference[0]:
-            fail("shm round {}: attach-resume ring lane not "
-                 "rewritten".format(rnd))
+        chaoslib.check_seq_continuity(
+            RECORDER, seqs, expected_len=budget,
+            context="shm round {}".format(rnd),
+            message="shm round {}: attach-resume seqs not gap-free: "
+                    "{}".format(rnd, seqs))
+        chaoslib.check_token_identity(
+            RECORDER, reference[0], ring_tokens(lane, budget),
+            context="shm round {}".format(rnd),
+            message="shm round {}: attach-resume ring lane not "
+                    "rewritten".format(rnd))
         status = set(core.xla_shm_status())
-        if status != {"chaos_ring"}:
-            fail("shm round {}: xla_shm_status inconsistent after "
-                 "healing: {}".format(rnd, sorted(status)))
+        chaoslib.check_shm_consistency(
+            RECORDER, status, {"chaos_ring"},
+            context="shm round {}".format(rnd),
+            message="shm round {}: xla_shm_status inconsistent after "
+                    "healing: {}".format(rnd, sorted(status)))
         wait_no_leaks(model, "shm round {}".format(rnd))
         stats = model._scheduler.stats()
         print("round {:2d} restarts={} status ok".format(
@@ -1088,8 +1058,10 @@ def shm_phase(rounds, slots, budget):
     # drain dropped every server-owned export; only the client ring
     # remains, and its unregister must now succeed (no lingering pins)
     leftovers = set(core.xla_shm_status())
-    if leftovers != {"chaos_ring"}:
-        fail("shm teardown: leaked regions {}".format(sorted(leftovers)))
+    chaoslib.check_shm_consistency(
+        RECORDER, leftovers, {"chaos_ring"}, context="shm teardown",
+        message="shm teardown: leaked regions {}".format(
+            sorted(leftovers)))
     try:
         core.unregister_xla_shm("chaos_ring")
     except ServerError as e:
@@ -1461,14 +1433,19 @@ def router_kill_phase(cycles, soak, budget):
                             client, urls, cycle, wid, i)
                         if tokens is None:
                             continue
-                        if tokens != reference:
-                            fail("router-kill cycle {}: stream tokens "
-                                 "diverged: {} != {}".format(
-                                     cycle, tokens, reference))
-                        if (seqs != list(range(len(seqs)))
-                                or len(seqs) != budget):
-                            fail("router-kill cycle {}: seq gap/"
-                                 "duplicate: {}".format(cycle, seqs))
+                        chaoslib.check_token_identity(
+                            RECORDER, reference, tokens,
+                            context="router-kill cycle {}".format(
+                                cycle),
+                            message="router-kill cycle {}: stream "
+                                    "tokens diverged: {} != {}".format(
+                                        cycle, tokens, reference))
+                        chaoslib.check_seq_continuity(
+                            RECORDER, seqs, expected_len=budget,
+                            context="router-kill cycle {}".format(
+                                cycle),
+                            message="router-kill cycle {}: seq gap/"
+                                    "duplicate: {}".format(cycle, seqs))
                 finally:
                     client.close()
 
@@ -1606,18 +1583,11 @@ def disagg_phase(cycles, soak, budget):
         return router.stats()["disagg"]
 
     def fleet_recovered(restarts_before, timeout_s=60.0):
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            stats = supervisor.stats()
-            member_urls = {r["url"] for r in router.membership()}
-            if (stats["replica_restarts"] > restarts_before
-                    and stats.get("phase_replicas_up")
-                    == {"prefill": 1, "decode": 1}
-                    and len(member_urls) == 2
-                    and stats["retired_replicas"] == 0):
-                return True
-            time.sleep(0.1)
-        return False
+        return chaoslib.wait_fleet_converged(
+            supervisor.stats, membership_fn=router.membership,
+            restarts_above=restarts_before,
+            phase_up={"prefill": 1, "decode": 1}, members=2,
+            timeout_s=timeout_s)
 
     def splits_resume(splits_before, client, cycle, timeout_s=30.0):
         """The healed prefill replica must REJOIN the split plane:
@@ -1627,9 +1597,12 @@ def disagg_phase(cycles, soak, budget):
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             tokens, _ = stream_once(client, cycle, "probe", 0)
-            if tokens is not None and tokens != reference:
-                fail("disagg cycle {}: post-heal tokens diverged: "
-                     "{} != {}".format(cycle, tokens, reference))
+            if tokens is not None and not chaoslib.check_token_identity(
+                    RECORDER, reference, tokens,
+                    context="disagg cycle {}".format(cycle),
+                    message="disagg cycle {}: post-heal tokens "
+                            "diverged: {} != {}".format(
+                                cycle, tokens, reference)):
                 return False
             if disagg_stats()["splits"] > splits_before:
                 return True
@@ -1665,14 +1638,17 @@ def disagg_phase(cycles, soak, budget):
                             wclient, cycle, wid, i)
                         if tokens is None:
                             continue
-                        if tokens != reference:
-                            fail("disagg cycle {}: stream tokens "
-                                 "diverged: {} != {}".format(
-                                     cycle, tokens, reference))
-                        if (seqs != list(range(len(seqs)))
-                                or len(seqs) != budget):
-                            fail("disagg cycle {}: seq gap/duplicate: "
-                                 "{}".format(cycle, seqs))
+                        chaoslib.check_token_identity(
+                            RECORDER, reference, tokens,
+                            context="disagg cycle {}".format(cycle),
+                            message="disagg cycle {}: stream tokens "
+                                    "diverged: {} != {}".format(
+                                        cycle, tokens, reference))
+                        chaoslib.check_seq_continuity(
+                            RECORDER, seqs, expected_len=budget,
+                            context="disagg cycle {}".format(cycle),
+                            message="disagg cycle {}: seq gap/"
+                                    "duplicate: {}".format(cycle, seqs))
                 finally:
                     wclient.close()
 
@@ -1704,11 +1680,13 @@ def disagg_phase(cycles, soak, budget):
                 fail("disagg cycle {}: healed replica lost its role: "
                      "{}".format(cycle, healed))
             after = disagg_stats()
-            for key in ("splits", "transfers", "transfer_bytes"):
-                if after[key] < before[key]:
-                    fail("disagg cycle {}: counter {} moved backwards "
-                         "{} -> {}".format(
-                             cycle, key, before[key], after[key]))
+            chaoslib.check_counters_monotonic(
+                RECORDER, before, after,
+                ("splits", "transfers", "transfer_bytes"),
+                context="disagg cycle {}".format(cycle),
+                message_fmt=lambda key, prev, now, cycle=cycle:
+                    "disagg cycle {}: counter {} moved backwards "
+                    "{} -> {}".format(cycle, key, prev, now))
             if not splits_resume(after["splits"], client, cycle):
                 fail("disagg cycle {}: healed prefill replica never "
                      "rejoined the split plane (stats={})".format(

@@ -6,6 +6,7 @@
     python tools/check.py --changed-only  # fast pre-commit loop
     python tools/check.py --t1-log PATH   # ratchet a named tier-1 log
     python tools/check.py --no-t1         # lint only, no noise ratchet
+    python tools/check.py --chaos-smoke   # + a --quick chaos campaign
 
 The default scope is the library tree AND the operational tooling
 (``src/python`` + ``tools``) — the chaos/perf/router CLIs spawn
@@ -29,6 +30,14 @@ see only the changed modules in that mode — cross-file findings can
 hide until the full-tree run, so the tier-1 gate always runs the full
 scope.  When git is unavailable (no repo, no ``main``), the flag falls
 back to the full tree with a notice.
+
+``--chaos-smoke`` opts into one ``tools/chaos_campaign.py --quick``
+run on top of the lint gate: a single-cycle seeded campaign against
+the in-process stub fleet (<=10 s, no accelerator) that exercises the
+chaos invariant library end to end (docs/resilience.md "Chaos
+campaigns").  Opt-in because it spawns a supervised fleet of
+subprocesses — too heavy for the implicit pre-commit loop, cheap
+enough to arm before touching the fault or router planes.
 
 tpulint always runs (it ships in-tree).  ruff is optional tooling the
 container may not have: when the binary is missing the ruff step is
@@ -154,6 +163,24 @@ def run_t1_noise(log_path, explicit):
     return proc.returncode
 
 
+def run_chaos_smoke():
+    """Opt-in (``--chaos-smoke``): one ``--quick`` seeded campaign
+    against the stub fleet — the end-to-end sanity pass over the
+    chaos invariant library.  A wedged fleet must fail the gate, not
+    hang it, so the subprocess gets a hard timeout."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(TOOLS, "chaos_campaign.py"), "--quick"],
+            cwd=REPO_ROOT, timeout=120,
+        )
+    except subprocess.TimeoutExpired:
+        print("check.py: chaos --quick campaign timed out",
+              file=sys.stderr)
+        return 1
+    return proc.returncode
+
+
 def run_ruff(paths):
     ruff = shutil.which("ruff")
     if ruff is None:
@@ -191,6 +218,8 @@ def main(argv=None):
         rc = run_ruff(paths) or rc
     if "--no-t1" not in argv:
         rc = run_t1_noise(t1_log, t1_explicit) or rc
+    if "--chaos-smoke" in argv:
+        rc = run_chaos_smoke() or rc
     if rc == 0:
         print("check.py: clean")
     return rc

@@ -229,22 +229,32 @@ def build_inprocess_core(args, levels):
     return InferenceServer(default_models())
 
 
-def build_generation_pool(metadata, args):
+def build_generation_pool(metadata, args, seed=None, shared_seed=None):
     """Prompt pool for generation mode: DISTINCT random prompts per
     stream; MAX_TOKENS pinned from the CLI.  With
     ``--shared-prefix-tokens N`` every prompt carries the SAME leading
     N tokens (seeded independently of the pool index) ahead of its
     unique suffix — the shared-system-prompt shape the radix prefix
-    cache and the router's prefix-affinity signal exist for."""
+    cache and the router's prefix-affinity signal exist for.
+
+    Distributed workers pass ``seed`` offset per worker (no two
+    workers replay the same suffix stream) while leaving
+    ``shared_seed`` at the run's base, so the shared system prompt is
+    the SAME across the whole worker fleet — what makes the merged
+    prefix-hit%% a fleet number."""
     import numpy as np
 
+    if seed is None:
+        seed = args.seed
+    if shared_seed is None:
+        shared_seed = args.seed + 7777
     shared = None
     if args.shared_prefix_tokens > 0:
-        shared = np.random.RandomState(args.seed + 7777).randint(
+        shared = np.random.RandomState(shared_seed).randint(
             1, 200, size=(args.shared_prefix_tokens,)).astype(np.int32)
     pool = []
     for i in range(args.input_pool):
-        rng = np.random.RandomState(args.seed + i)
+        rng = np.random.RandomState(seed + i)
         inputs = {}
         for spec in metadata.get("inputs", []):
             name = spec["name"]
@@ -286,8 +296,61 @@ def run_worker(args):
     manager = None
     channel = None
     shm = None
+    gen_profiler = None
     try:
         metadata = backend.model_metadata(args.model)
+        if args.generation:
+            from perfanalyzer.generation import GenerationProfiler
+
+            # per-worker suffix stream, run-wide shared prefix (see
+            # build_generation_pool): the merged prefix-hit%% is a
+            # fleet number, not N private caches
+            pool = build_generation_pool(
+                metadata, args, seed=args.seed + 1000 * args.worker_id,
+                shared_seed=args.seed + 7777)
+            gen_profiler = GenerationProfiler(
+                backend, args.model, pool,
+                measurement_interval_s=args.measurement_interval / 1000.0,
+                early_exit=EARLY_EXIT)
+            gen_profiler.change_level(level)
+            collector = gen_profiler.collector
+            # warmup gate before saying hello: the first barrier
+            # window must not eat this worker's cold-start (XLA
+            # compiles, cold prefix caches land outside measurement)
+            gate = time.monotonic() + 120.0
+            while (collector.lifetime_generations() == 0
+                   and time.monotonic() < gate
+                   and not EARLY_EXIT.is_set()):
+                time.sleep(0.02)
+            channel = WorkerChannel(args.worker_connect, args.worker_id)
+
+            def run_gen_window(duration_s, index):
+                collector.start_window()
+                t0 = time.perf_counter()
+                deadline = t0 + duration_s
+                while True:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0 or EARLY_EXIT.is_set():
+                        break
+                    time.sleep(min(0.05, remaining))
+                duration = time.perf_counter() - t0
+                window = collector.end_window()
+                # raw TTFT/ITL samples ship to the parent — the merge
+                # pools samples, never percentiles (same rule as the
+                # scalar latencies_s)
+                return {"completed": window["generations"],
+                        "errors": window["errors"],
+                        "duration_s": duration,
+                        "latencies_s": [],
+                        "tokens": window["tokens"],
+                        "ttfts_s": window["ttfts_s"],
+                        "itls_s": window["itls_s"],
+                        "generations": window["generations"],
+                        "resumed_streams": window["resumed_streams"],
+                        "resume_events": window["resume_events"]}
+
+            channel.serve(run_gen_window)
+            return 0
         config = backend.model_config(args.model)
         pool = build_input_pool(
             metadata, config,
@@ -346,10 +409,29 @@ def run_worker(args):
             channel.close()
         if manager is not None:
             manager.stop()
+        if gen_profiler is not None:
+            gen_profiler.stop()
         if shm is not None:
             shm.close()
         backend.close()
     return 0
+
+
+def _prefix_snapshot_with_grace(probe, grace_s=3.0):
+    """One ``/metrics`` prefix-counter snapshot, re-polled briefly
+    when the families are absent.  Against a router the counters are
+    the fleet aggregate, and its fold for a scrape round that found
+    NO live replica (a chaos campaign's zero-capacity window, or
+    every replica still booting) carries no prefix families — a
+    single-shot probe landing in that window would drop the
+    prefix-hit%% column from the whole run."""
+    deadline = time.monotonic() + grace_s
+    snap = probe.prefix_cache_snapshot()
+    while snap is None and time.monotonic() < deadline:
+        if EARLY_EXIT.wait(0.1):
+            break
+        snap = probe.prefix_cache_snapshot()
+    return snap
 
 
 def run_coordinator(args):
@@ -365,10 +447,14 @@ def run_coordinator(args):
     from perfanalyzer.profiler import ProfileResult, parse_range
     from perfanalyzer.report import ReportWriter
 
-    if args.generation or args.request_rate_range:
+    if args.request_rate_range:
         raise SystemExit(
-            "--workers drives the closed-loop concurrency mode; "
-            "generation and request-rate modes are single-process")
+            "--workers drives the closed-loop modes; the request-rate "
+            "mode is single-process")
+    if args.generation and args.shared_memory != "none":
+        raise SystemExit(
+            "--workers --generation is in-band only; drop "
+            "--shared-memory (token rings are a direct-replica mode)")
     if args.backend not in ("http",):
         raise SystemExit(
             "--workers spawns http worker processes; --backend {} is "
@@ -380,12 +466,14 @@ def run_coordinator(args):
             "(got sweep {})".format(levels))
     level = levels[0]
     window_s = args.measurement_interval / 1000.0
+    dist_mode = ("distributed_generation" if args.generation
+                 else "distributed_concurrency")
     coord = Coordinator(args.workers).listen()
     print("*** Measurement Settings ***\n"
-          "  model: {}  backend: http  mode: distributed_concurrency\n"
+          "  model: {}  backend: http  mode: {}\n"
           "  workers: {}  concurrency/worker: {}  windows: {} x {} ms "
           "(barrier-synchronized)".format(
-              args.model, args.workers, level, args.windows,
+              args.model, dist_mode, args.workers, level, args.windows,
               args.measurement_interval), flush=True)
     argv = [sys.executable, os.path.abspath(__file__),
             "-m", args.model, "--backend", "http", "-u", args.url,
@@ -397,12 +485,27 @@ def run_coordinator(args):
             str(args.output_shared_memory_size)]
     if args.urls:
         argv += ["--urls", args.urls]
+    if args.generation:
+        argv += ["--generation",
+                 "--max-tokens", str(args.max_tokens),
+                 "--prompt-len", str(args.prompt_len),
+                 "--shared-prefix-tokens",
+                 str(args.shared_prefix_tokens)]
     for entry in args.shape:
         argv += ["--shape", entry]
     for entry in args.input_const:
         argv += ["--input-const", entry]
     procs = []
     window_rows = []
+    # fleet prefix-hit%% is parent-side: one probe backend reads the
+    # target's /metrics prefix counters (the churn-safe fleet
+    # aggregate when -u fronts a router) before/after the windows
+    prefix_before = prefix_after = None
+    probe = None
+    if args.generation:
+        from perfanalyzer.client_backend import create_backend
+
+        probe = create_backend("http", url=args.url, max_inflight=1)
     try:
         for i in range(args.workers):
             procs.append(subprocess.Popen(
@@ -413,6 +516,15 @@ def run_coordinator(args):
             # load is already flowing (workers start their managers
             # before dialing in); the parent just waits it out
             EARLY_EXIT.wait(args.warmup)
+        if probe is not None:
+            # post-warmup baseline, like the single-process profiler:
+            # compile-time/cold admissions stay out of the hit rate.
+            # Re-polled briefly when the column is absent: under chaos
+            # a zero-capacity window (every replica killed at once)
+            # can make the router's aggregate fold come up empty, and
+            # one None here silently costs the whole run its
+            # prefix-hit%% column
+            prefix_before = _prefix_snapshot_with_grace(probe)
         for index in range(args.windows):
             if EARLY_EXIT.is_set():
                 break
@@ -425,16 +537,20 @@ def run_coordinator(args):
                 print("  window {:2d}: {:8.1f} infer/sec over {} "
                       "workers".format(index + 1, row["throughput"],
                                        row["workers"]), flush=True)
+        if probe is not None:
+            prefix_after = _prefix_snapshot_with_grace(probe)
     finally:
         coord.shutdown()
         reap_workers(procs)
+        if probe is not None:
+            probe.close()
     if not window_rows:
         print(json.dumps({"error": "no synchronized windows completed"}),
               flush=True)
         return 1
     merged = merge_windows(window_rows)
     result = ProfileResult(
-        mode="distributed_concurrency",
+        mode=dist_mode,
         level=level * args.workers,
         stable=True,
         interrupted=EARLY_EXIT.is_set(),
@@ -442,6 +558,40 @@ def run_coordinator(args):
         workers=args.workers,
     )
     result.update(merged)
+    if args.generation:
+        from perfanalyzer import metrics as _metrics
+
+        # token-rate throughput + TTFT/ITL percentiles over the POOLED
+        # raw samples of every worker and window — the same report
+        # columns the single-process generation profiler emits, at
+        # fleet scale (raw sample lists dropped from the report)
+        duration = merged.get("duration_s", 0.0)
+        result["throughput"] = (
+            merged.get("tokens", 0) / duration if duration > 0 else 0.0)
+        result["generations"] = merged.get("generations", 0)
+        result["gen_per_sec"] = (
+            merged.get("generations", 0) / duration
+            if duration > 0 else 0.0)
+        ttfts = result.pop("ttfts_s", None) or []
+        itls = result.pop("itls_s", None) or []
+        for prefix_key, sample in (("ttft", ttfts), ("itl", itls)):
+            if sample:
+                ms = sorted(v * 1e3 for v in sample)
+                result[prefix_key + "_avg_ms"] = sum(ms) / len(ms)
+                for p in (50, 90, 95, 99):
+                    result["{}_p{}_ms".format(prefix_key, p)] = (
+                        _metrics.percentile(ms, p, presorted=True))
+            else:
+                result[prefix_key + "_avg_ms"] = None
+                for p in (50, 90, 95, 99):
+                    result["{}_p{}_ms".format(prefix_key, p)] = None
+        if prefix_before is not None and prefix_after is not None:
+            dh = max(0, prefix_after["hits"] - prefix_before["hits"])
+            dm = max(0, prefix_after["misses"] - prefix_before["misses"])
+            result["prefix_cache_hits"] = dh
+            result["prefix_cache_misses"] = dm
+            result["prefix_hit_pct"] = (
+                100.0 * dh / (dh + dm) if dh + dm else None)
     writer = ReportWriter(
         args.model, "http-x{}".format(args.workers),
         extra_tags={"early_exit": True} if EARLY_EXIT.is_set() else None)
