@@ -558,7 +558,13 @@ class HttpBackend(_TritonClientShmMixin, ClientBackend):
             # healing/scaling counters window-diff exactly like the
             # router's own (metrics.SUPERVISOR_COUNTERS)
             for key in ("replica_restarts", "scale_up_events",
-                        "scale_down_events", "retired_replicas"):
+                        "scale_down_events", "retired_replicas",
+                        # crash-durability counters (ISSUE 18):
+                        # presence-guarded like the rest so a
+                        # supervisor predating the manifest never
+                        # fabricates a delta
+                        "adoptions", "clean_handovers",
+                        "stale_children_reaped", "manifest_records"):
                 if key in supervisor:
                     out["supervisor_" + key] = _coerce_int(
                         supervisor.get(key))

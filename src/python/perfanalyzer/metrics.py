@@ -196,6 +196,13 @@ SUPERVISOR_COUNTERS = (
     "supervisor_scale_up_events",
     "supervisor_scale_down_events",
     "supervisor_retired_replicas",
+    # crash durability (ISSUE 18): a nonzero per-window adoption delta
+    # means the SUPERVISOR itself restarted under this level and the
+    # fleet kept serving through it
+    "supervisor_adoptions",
+    "supervisor_clean_handovers",
+    "supervisor_stale_children_reaped",
+    "supervisor_manifest_records",
 )
 
 

@@ -185,6 +185,13 @@ class ReportWriter:
                                  r.get("supervisor_scale_up_events"),
                                  r.get("supervisor_scale_down_events"),
                                  r.get("supervisor_retired_replicas")))
+                if r.get("supervisor_adoptions") is not None:
+                    # crash durability: a nonzero adoption delta means
+                    # the SUPERVISOR itself restarted under this level
+                    # and adopted its children instead of respawning
+                    # them — serving never flinched
+                    line += " adoptions={}".format(
+                        r.get("supervisor_adoptions"))
                 print(line, file=file, flush=True)
 
     def write_csv(self, path, results):

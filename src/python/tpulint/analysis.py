@@ -81,12 +81,15 @@ class WithLock:
 class ThreadCreation:
     """One ``threading.Thread(...)`` call."""
 
-    __slots__ = ("node", "lineno", "daemon", "target_attr", "cls", "func")
+    __slots__ = ("node", "lineno", "daemon", "name", "target_attr",
+                 "cls", "func")
 
-    def __init__(self, node, lineno, daemon, target_attr, cls, func):
+    def __init__(self, node, lineno, daemon, name, target_attr, cls,
+                 func):
         self.node = node
         self.lineno = lineno
         self.daemon = daemon  # True / False / None (absent or dynamic)
+        self.name = name  # the name= kwarg when a string literal
         # the self attribute the Thread object lands in (best effort):
         # 'self.X = Thread(...)', 'self.X = [Thread(...) ...]', or
         # 'self.X.append(Thread(...))'
@@ -307,13 +310,19 @@ class _Walker(ast.NodeVisitor):
         ))
         if dotted in ("threading.Thread", "Thread", "_threading.Thread"):
             daemon = None
+            name = None
             for kw in node.keywords:
                 if kw.arg == "daemon":
                     daemon = (kw.value.value
                               if isinstance(kw.value, ast.Constant)
                               else None)
+                if (kw.arg == "name"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    name = kw.value.value
             self.info.thread_creations.append(ThreadCreation(
-                node, node.lineno, daemon, None, self.cls, self.func,
+                node, node.lineno, daemon, name, None, self.cls,
+                self.func,
             ))
         # R4: super().__init__(msg, code=N) inside an __init__
         if (dotted.endswith("super().__init__")

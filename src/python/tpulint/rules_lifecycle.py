@@ -8,6 +8,15 @@ shutdown path: a method of the same class named ``close``/``stop``/
 mentions the attribute the thread was stored into.  A non-daemon
 thread with neither wedges interpreter shutdown the first time its
 loop outlives the owner.
+
+Companion check — crash-log WRITER threads (``name=`` contains
+``"writer"``: the router-journal and fleet-manifest appenders) must
+be BOTH: ``daemon=True`` so a crashing owner dies instead of wedging
+on its writer (crash durability is the whole point of those logs —
+the torn tail is recoverable, a hung process is not), AND joined on a
+shutdown path so a CLEAN close drains the queued tail before the fd
+goes away.  Either half alone silently weakens a durability story the
+chaos suites depend on.
 """
 
 import ast
@@ -44,6 +53,9 @@ class ThreadLifecycleRule:
         findings = []
         for mod in modules:
             for tc in mod.thread_creations:
+                if tc.name is not None and "writer" in tc.name:
+                    findings.extend(self._check_writer(mod, tc))
+                    continue
                 if tc.daemon is True:
                     continue
                 if tc.cls is not None and tc.target_attr is not None:
@@ -68,3 +80,33 @@ class ThreadLifecycleRule:
                     .format(where, detail),
                 ))
         return findings
+
+    def _check_writer(self, mod, tc):
+        """A thread named ``*writer*`` appends a crash log: it must be
+        daemon=True AND joined — daemon alone drops the queued tail on
+        clean close, joined alone wedges a crashing owner on its
+        writer."""
+        joined = tc.cls is not None and tc.target_attr is not None and any(
+            _method_joins_attr(fn, tc.target_attr)
+            for name, fn in tc.cls.methods.items()
+            if name in _STOP_NAMES)
+        where = "{}.{}".format(
+            tc.cls.name if tc.cls else "<module>",
+            tc.func.name if tc.func else "<module>")
+        missing = []
+        if tc.daemon is not True:
+            missing.append(
+                "daemon=True (a crashing owner must die, not wedge on "
+                "its writer)")
+        if not joined:
+            missing.append(
+                "a join in a close()/stop()/drain() path (a clean "
+                "close must drain the queued tail)")
+        if not missing:
+            return []
+        return [Finding(
+            self.id, self.name, mod.relpath, tc.lineno,
+            "writer thread {!r} created in {}() needs BOTH halves of "
+            "the crash-log discipline; missing {}".format(
+                tc.name, where, " and ".join(missing)),
+        )]

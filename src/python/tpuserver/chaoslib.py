@@ -33,6 +33,10 @@ collects the same objects to print a minimized repro.  The catalog:
 ``shm_consistency``        ``xla_shm_status`` holds exactly the expected
                            regions (no stale ``kvexport/*`` leaks)
 ``thread_leak``            no non-daemon threads outlive the campaign
+``supervisor_restarts_clean``  a restarted supervisor ADOPTS every
+                           surviving child (same pid, same restart
+                           budget, same role) and respawns ONLY the
+                           actually-dead — :func:`check_supervisor_adoption`
 ========================  ==================================================
 
 **Seeded fault scheduler** — :meth:`FaultSchedule.compose` turns the
@@ -62,6 +66,7 @@ __all__ = [
     "check_counters_monotonic", "MetricsMonotonicityCheck",
     "wait_stream_drain", "wait_fleet_converged",
     "check_journal_single_writer", "check_shm_consistency",
+    "check_supervisor_adoption",
     "thread_baseline", "check_no_thread_leaks",
     "FAULT_KINDS", "ScheduledFault", "FaultSchedule",
     "minimized_repro", "CampaignRunner",
@@ -382,6 +387,93 @@ def check_journal_single_writer(recorder, routers, context="",
     return False
 
 
+def check_supervisor_adoption(recorder, before, survivors, stats,
+                              context="",
+                              invariant="supervisor_restarts_clean"):
+    """A restarted supervisor must ADOPT, not respawn: every replica
+    whose process survived the supervisor outage keeps its pid, its
+    restart count, and its role (a changed pid is a double-spawn; a
+    bumped restart count is a budget charged for a crash that never
+    happened), every replica that actually died gets a NEW pid with
+    exactly one restart charged, and the supervisor's ``adoptions``
+    counter covers every survivor.  ``before`` maps replica index to
+    its pre-outage ``stats()`` row, ``survivors`` is the set of
+    indices whose process outlived the outage, ``stats`` is the
+    successor's converged ``stats()``."""
+    after = {r["index"]: r for r in stats.get("replicas", [])}
+    ok = True
+    for index, row in before.items():
+        succ = after.get(index)
+        if succ is None:
+            ok = False
+            recorder.record(
+                invariant,
+                "{}: replica {} vanished across the supervisor "
+                "restart".format(context, index),
+                context=context, index=index)
+            continue
+        if index in survivors:
+            if succ.get("pid") != row.get("pid"):
+                ok = False
+                recorder.record(
+                    invariant,
+                    "{}: surviving replica {} was respawned (pid {} "
+                    "-> {}) instead of adopted".format(
+                        context, index, row.get("pid"),
+                        succ.get("pid")),
+                    context=context, index=index,
+                    before_pid=row.get("pid"), after_pid=succ.get("pid"))
+            if succ.get("restarts") != row.get("restarts"):
+                ok = False
+                recorder.record(
+                    invariant,
+                    "{}: surviving replica {} charged restart budget "
+                    "({} -> {}) for a crash that never happened".format(
+                        context, index, row.get("restarts"),
+                        succ.get("restarts")),
+                    context=context, index=index,
+                    before=row.get("restarts"),
+                    after=succ.get("restarts"))
+        else:
+            if succ.get("pid") == row.get("pid"):
+                ok = False
+                recorder.record(
+                    invariant,
+                    "{}: dead replica {} still shows its corpse pid "
+                    "{}".format(context, index, row.get("pid")),
+                    context=context, index=index, pid=row.get("pid"))
+            if succ.get("restarts") != row.get("restarts", 0) + 1:
+                ok = False
+                recorder.record(
+                    invariant,
+                    "{}: dead replica {} should be charged exactly "
+                    "one restart ({} -> {})".format(
+                        context, index, row.get("restarts"),
+                        succ.get("restarts")),
+                    context=context, index=index,
+                    before=row.get("restarts"),
+                    after=succ.get("restarts"))
+        if succ.get("role") != row.get("role"):
+            ok = False
+            recorder.record(
+                invariant,
+                "{}: replica {} changed role across the supervisor "
+                "restart ({} -> {})".format(
+                    context, index, row.get("role"), succ.get("role")),
+                context=context, index=index,
+                before_role=row.get("role"), after_role=succ.get("role"))
+    if stats.get("adoptions", 0) < len(survivors):
+        ok = False
+        recorder.record(
+            invariant,
+            "{}: adoptions counter {} does not cover the {} "
+            "surviving replica(s)".format(
+                context, stats.get("adoptions", 0), len(survivors)),
+            context=context, adoptions=stats.get("adoptions", 0),
+            survivors=sorted(survivors))
+    return ok
+
+
 def check_shm_consistency(recorder, status, expected, context="",
                           message=None, invariant="shm_consistency"):
     """Zero leaked kv-export regions/pages: ``xla_shm_status`` must
@@ -454,6 +546,11 @@ FAULT_KINDS = {
     "prefill_sigkill": (
         "SIGKILL the PREFILL-role replica mid-handoff; orphaned "
         "splits must degrade to the fused path invisibly", "kill"),
+    "supervisor_sigkill": (
+        "SIGKILL the SUPERVISOR itself mid-traffic; the fleet keeps "
+        "serving unsupervised, and the restarted supervisor must "
+        "ADOPT the survivors from its manifest (no double-spawn, no "
+        "budget burn)", "kill"),
     "router_sigkill": (
         "SIGKILL the ACTIVE router; the standby must take over and "
         "recover resume state from the journal", "router"),

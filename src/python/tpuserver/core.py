@@ -772,11 +772,16 @@ class InferenceServer:
     """
 
     def __init__(self, models=None, max_inflight=None, ready=True,
-                 fault_scope=None, role=None):
+                 fault_scope=None, role=None, spawn_nonce=None):
         # identifies this replica at shared fault-injection points, so
         # multi-server chaos harnesses can break ONE in-process replica
         # (tpuserver.faults scopes)
         self.fault_scope = fault_scope
+        # spawn identity nonce (fleet supervisor adoption): echoed in
+        # health_snapshot so a RESTARTED supervisor can prove the
+        # process on a recorded port is the exact child it spawned
+        # before claiming it (fleetmanifest adoption contract)
+        self.spawn_nonce = spawn_nonce
         # disaggregated-serving role ("prefill" | "decode" | None =
         # fused): advertised in health_snapshot so a fleet router can
         # partition its candidate pools by phase without configuration
@@ -991,6 +996,12 @@ class InferenceServer:
         restarting replicas at a stable address can tell a healed
         process from a survivor without tracking anything else.
 
+        ``spawn_nonce`` (when the spawner passed one) closes the
+        adoption loop: pid + start-time token prove "a process", the
+        echoed nonce proves "MY process" — a foreign server squatting
+        the recorded port can never be claimed by a restarted
+        supervisor.
+
         ``models`` maps each registered model to its scheduler stats
         dict (``None`` for models with no scheduler, or before first
         use) — ``tripped``/``restarts``/``replay_entries`` and the
@@ -1006,7 +1017,7 @@ class InferenceServer:
         for name, model in items:
             stats_fn = getattr(model, "scheduler_stats", None)
             models[name] = stats_fn() if callable(stats_fn) else None
-        return {
+        snap = {
             "state": state,
             "ready": self.server_ready(),
             "inflight": inflight,
@@ -1015,6 +1026,9 @@ class InferenceServer:
             "role": self.role,
             "models": models,
         }
+        if self.spawn_nonce is not None:
+            snap["spawn_nonce"] = self.spawn_nonce
+        return snap
 
     # -- telemetry ---------------------------------------------------------
 

@@ -276,6 +276,27 @@ CATALOG = {
         "Replicas retired after exhausting their restart budget."),
     "tpu_fleet_replicas_up": (
         "gauge", "Replica processes currently up and routed."),
+    # -- supervisor crash durability (manifest + adoption) -----------------
+    "tpu_supervisor_adoptions_total": (
+        "counter",
+        "Live children (replicas and routers) ADOPTED by a restarted "
+        "supervisor from its fleet-state manifest instead of being "
+        "respawned (pid + start token + spawn nonce all matched)."),
+    "tpu_supervisor_manifest_records_total": (
+        "counter",
+        "Records appended to the fleet-state manifest (spawn/restart/"
+        "retire/scale/promote/config/checkpoint) by the off-hot-path "
+        "writer thread."),
+    "tpu_supervisor_clean_handovers_total": (
+        "counter",
+        "Graceful supervisor handovers: manifest checkpointed, "
+        "single-writer lock released, children LEFT SERVING for a "
+        "successor to adopt."),
+    "tpu_supervisor_stale_children_reaped_total": (
+        "counter",
+        "Manifest rows whose process failed the adoption contract "
+        "(dead pid, reused pid, nonce mismatch, unreachable health) "
+        "and were reaped-then-respawned instead of adopted."),
 }
 
 #: Default latency buckets (seconds): spans the ~60us simple-model hot

@@ -1090,9 +1090,13 @@ class FleetRouter:
                  min_eligible=1, probe_fraction=1.0 / 16,
                  eject_interval_s=0.5, digest_window=64,
                  hedge_delay_s=None, journal=None, standby=False,
-                 journal_flush_s=0.02):
+                 journal_flush_s=0.02, spawn_nonce=None):
         if not backends:
             raise ValueError("FleetRouter requires at least one backend")
+        # spawn identity nonce (fleet supervisor adoption): echoed in
+        # health_snapshot so a restarted supervisor can claim this
+        # router process the same way it claims replicas
+        self.spawn_nonce = spawn_nonce
         if standby and not journal:
             raise ValueError(
                 "a standby router needs the journal to tail: pass "
@@ -2044,6 +2048,20 @@ class FleetRouter:
                  [({}, sup.get("retired_replicas", 0))]),
                 ("tpu_fleet_replicas_up", [({}, sup.get("up", 0))]),
             ])
+            if "adoptions" in sup:
+                # presence-guarded: a supervisor snapshot that
+                # predates the crash-durability counters (an external
+                # /router/stats shape) must not break the scrape
+                families.extend([
+                    ("tpu_supervisor_adoptions_total",
+                     [({}, sup.get("adoptions", 0))]),
+                    ("tpu_supervisor_manifest_records_total",
+                     [({}, sup.get("manifest_records", 0))]),
+                    ("tpu_supervisor_clean_handovers_total",
+                     [({}, sup.get("clean_handovers", 0))]),
+                    ("tpu_supervisor_stale_children_reaped_total",
+                     [({}, sup.get("stale_children_reaped", 0))]),
+                ])
         return families
 
     def _fetch_metrics(self, rep):
@@ -2127,6 +2145,8 @@ class FleetRouter:
             "router": True,
             "models": {},
         })
+        if self.spawn_nonce is not None:
+            snap["spawn_nonce"] = self.spawn_nonce
         return snap
 
     # -- unary forwarding --------------------------------------------------

@@ -116,6 +116,10 @@ def main():
                          "(prefill/decode; empty = fused) — what "
                          "role-aware supervisor/router tests partition "
                          "stub fleets with")
+    ap.add_argument("--spawn-nonce", default="",
+                    help="spawn identity nonce echoed in "
+                         "/v2/health/stats (the supervisor-adoption "
+                         "contract fleet HA tests pin)")
     ap.add_argument("--drain-s", type=float, default=0.1)
     ap.add_argument("--marker", default="")
     ap.add_argument("--ttl", type=float, default=0.0,
@@ -197,7 +201,7 @@ def main():
 
     def snapshot():
         with lock:
-            return {
+            snap = {
                 "state": state["state"],
                 "ready": state["ready"] and not model["tripped"],
                 "inflight": 0,
@@ -207,6 +211,9 @@ def main():
                 "models": {"stub": dict(model),
                            "stubgen": dict(model)},
             }
+            if args.spawn_nonce:
+                snap["spawn_nonce"] = args.spawn_nonce
+            return snap
 
     STUB_METADATA = {
         "name": "stub", "versions": ["1"], "platform": "stub",

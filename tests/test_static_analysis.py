@@ -215,12 +215,23 @@ def test_r4_missing_wire_map_is_a_finding_not_a_skip():
 
 def test_r5_thread_lifecycle_fixture():
     findings = _lint_fixture("r5", "R5").new
-    assert _lines(findings) == [44, 49]
+    assert _lines(findings) == [44, 49, 68, 75]
+    by_line = {f.lineno: f.message for f in findings}
     # DaemonOwner (daemon=True), JoinedOwner (join(timeout=5)),
     # JoinedPositionalOwner (join(5) positional), and AppendOwner
     # (`self._threads.append(Thread(...))` idiom, joined in close())
     # produced no findings
-    assert all("daemon=True" in f.message for f in findings)
+    assert "daemon=True" in by_line[44]
+    assert "daemon=True" in by_line[49]
+    # writer-thread companion (ISSUE 18): a `name="*writer*"` thread
+    # appends a crash log and needs BOTH halves — GoodWriter (daemon
+    # AND joined) is clean; daemon-only drops the queued tail on a
+    # clean close, joined-only wedges a crashing owner
+    assert "writer thread 'journal-writer'" in by_line[68]
+    assert "drain the queued tail" in by_line[68]
+    assert "daemon" not in by_line[68].split("missing", 1)[1]
+    assert "writer thread 'stats-writer'" in by_line[75]
+    assert "daemon=True" in by_line[75]
 
 
 def test_r6_fault_registry_fixture():

@@ -48,3 +48,33 @@ class Leaker:
 def module_level_leak():
     t = threading.Thread(target=lambda: None)  # FINDING (line 26)
     t.start()
+
+
+class GoodWriter:
+    def start(self):  # OK: a crash-log writer with BOTH halves —
+        # daemon (owner crash never wedges) AND joined (clean close
+        # drains the tail)
+        self._writer = threading.Thread(
+            target=lambda: None, name="fleet-manifest-writer",
+            daemon=True)
+        self._writer.start()
+
+    def close(self):
+        self._writer.join(timeout=5)
+
+
+class DaemonOnlyWriter:
+    def start(self):
+        self._writer = threading.Thread(  # FINDING: tail dropped
+            target=lambda: None, name="journal-writer", daemon=True)
+        self._writer.start()
+
+
+class JoinedOnlyWriter:
+    def start(self):
+        self._writer = threading.Thread(  # FINDING: owner wedges
+            target=lambda: None, name="stats-writer")
+        self._writer.start()
+
+    def close(self):
+        self._writer.join()

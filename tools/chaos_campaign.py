@@ -37,8 +37,10 @@ prefix-hit%) whose ``error_budget`` column must read zero.
 import argparse
 import json
 import os
+import shutil
 import signal
 import sys
+import tempfile
 import threading
 import time
 
@@ -54,9 +56,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: chaoslib.FAULT_KINDS: shm faults need a real core, so they stay
 #: with chaos_smoke --shm and the faults.py unit tier)
 INJECTABLE = (
-    "replica_sigkill", "prefill_sigkill", "router_sigkill",
-    "router_sigterm", "gray_slow", "gray_jitter", "stream_sever",
-    "partition",
+    "replica_sigkill", "prefill_sigkill", "supervisor_sigkill",
+    "router_sigkill", "router_sigterm", "gray_slow", "gray_jitter",
+    "stream_sever", "partition",
 )
 
 DEFAULT_FAULTS = "prefill_sigkill,gray_slow,stream_sever"
@@ -115,11 +117,13 @@ def build_parser():
 # -- fleet ------------------------------------------------------------------
 
 
-def start_fleet(cycles):
+def start_fleet(cycles, manifest_dir=None):
     """The campaign target: a role-split stub fleet (1 prefill + 1
     decode) supervised together with an active+standby router pair
     sharing one crash journal — every tier a scheduled fault can hit
-    is a real, supervised OS process."""
+    is a real, supervised OS process.  ``manifest_dir`` makes the
+    supervisor itself a target: ``supervisor_sigkill`` crashes it and
+    a successor built from the SAME manifest adopts the fleet."""
     from tpuserver.fleet import FleetSupervisor
 
     stub = os.path.join(REPO, "tests", "fleet_stub.py")
@@ -139,6 +143,7 @@ def start_fleet(cycles):
         restart_backoff_s=0.05, scope_prefix="campaign-stub-",
         router_command=router_command, router_standby=True,
         env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
+        manifest_dir=manifest_dir,
     ).start()
 
 
@@ -182,8 +187,12 @@ class FleetInjectors:
     cycle's latency injection never bleeds into the next cycle's
     measurements."""
 
-    def __init__(self, supervisor):
+    def __init__(self, supervisor, manifest_dir=None):
         self.supervisor = supervisor
+        self.manifest_dir = manifest_dir
+        # pre-crash replica rows, set by supervisor_sigkill; the cycle
+        # loop restarts the supervisor and runs the adoption check
+        self.supervisor_down = None
         self._grayed = []  # urls with nonzero delay/jitter this cycle
 
     # -- victim pools ------------------------------------------------------
@@ -262,6 +271,22 @@ class FleetInjectors:
         self._inject(lambda: self._up_replicas(role="prefill"),
                      entry.pick, "SIGKILL (prefill)",
                      lambda r: os.kill(r["pid"], signal.SIGKILL))
+
+    def supervisor_sigkill(self, entry):
+        """Crash the supervisor itself mid-traffic.  The campaign
+        supervisor is in-process, so the SIGKILL is emulated by
+        :meth:`FleetSupervisor.crash` — no checkpoint, no child
+        signals, flock released exactly as the kernel would.  Replicas
+        and router processes keep serving unsupervised; a later fault
+        in the same cycle (serial group ``kill``) lands while nobody
+        is healing."""
+        if self.manifest_dir is None:
+            raise RuntimeError(
+                "supervisor_sigkill needs a manifest-backed fleet")
+        before = {r["index"]: r
+                  for r in self.supervisor.stats()["replicas"]}
+        self.supervisor.crash()
+        self.supervisor_down = before
 
     def router_sigkill(self, entry):
         self._kill_router(signal.SIGKILL, "SIGKILL")
@@ -437,11 +462,15 @@ def run_campaign(args, schedule):
               file=sys.stderr, flush=True)
 
     recorder = chaoslib.InvariantRecorder(sink)
-    supervisor = start_fleet(args.cycles)
-    injectors = FleetInjectors(supervisor)
+    manifest_dir = None
+    if "supervisor_sigkill" in schedule.kinds:
+        manifest_dir = tempfile.mkdtemp(prefix="campaign-manifest-")
+    supervisor = start_fleet(args.cycles, manifest_dir=manifest_dir)
+    injectors = FleetInjectors(supervisor, manifest_dir=manifest_dir)
     runner = chaoslib.CampaignRunner(
         schedule, injectors.registry(), recorder)
-    summary = {"cycles_run": 0, "streams": 0, "takeovers": 0}
+    summary = {"cycles_run": 0, "streams": 0, "takeovers": 0,
+               "supervisor_restarts": 0, "adoptions": 0}
     try:
         if not supervisor.wait_ready(timeout_s=60.0):
             recorder.record(
@@ -505,7 +534,31 @@ def run_campaign(args, schedule):
                 t.join(timeout=300)
             stop.set()
             injectors.heal_grays()
-            wait_converged(supervisor, recorder, context)
+            if injectors.supervisor_down is not None:
+                # the supervisor was SIGKILLed this cycle (streams
+                # above ran unsupervised): restart it from the SAME
+                # manifest and prove it adopts the survivors instead
+                # of double-spawning a serving fleet
+                before_rows = injectors.supervisor_down
+                injectors.supervisor_down = None
+                from tpuserver import fleetmanifest
+                survivors = {
+                    index for index, row in before_rows.items()
+                    if row.get("pid") is not None
+                    and fleetmanifest.process_start_token(
+                        row["pid"]) is not None}
+                supervisor = start_fleet(
+                    args.cycles, manifest_dir=manifest_dir)
+                injectors.supervisor = supervisor
+                summary["supervisor_restarts"] += 1
+                wait_converged(supervisor, recorder, context)
+                chaoslib.check_supervisor_adoption(
+                    recorder, before_rows, survivors,
+                    supervisor.stats(), context=context)
+                summary["adoptions"] = supervisor.stats().get(
+                    "adoptions", 0)
+            else:
+                wait_converged(supervisor, recorder, context)
             # the router tier may have failed over (or still be mid
             # drain-exit): wait for every scheduled router fault's
             # promotion to LAND, rebind on ANY takeover — a double
@@ -537,6 +590,8 @@ def run_campaign(args, schedule):
                       recorder.count), flush=True)
     finally:
         supervisor.stop()
+        if manifest_dir is not None:
+            shutil.rmtree(manifest_dir, ignore_errors=True)
     chaoslib.check_no_thread_leaks(
         recorder, baseline_threads, grace_s=5.0, context="campaign end")
     return recorder, summary
@@ -763,10 +818,13 @@ def main():
         print("MINIMIZED REPRO: {}".format(repro), flush=True)
         return 1
     print("\nchaos campaign OK: seed {}, {} cycle(s) composing [{}], "
-          "{} streams, {} takeover(s), {:.1f}s, zero user-visible "
-          "errors, zero lost or duplicated tokens".format(
+          "{} streams, {} takeover(s), {} supervisor restart(s) "
+          "({} adoption(s)), {:.1f}s, zero user-visible errors, zero "
+          "lost or duplicated tokens".format(
               args.seed, summary["cycles_run"], ",".join(kinds),
-              summary["streams"], summary["takeovers"], elapsed),
+              summary["streams"], summary["takeovers"],
+              summary.get("supervisor_restarts", 0),
+              summary.get("adoptions", 0), elapsed),
           flush=True)
     return 0
 

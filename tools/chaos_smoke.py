@@ -26,6 +26,7 @@ Usage:
     python tools/chaos_smoke.py --gray [--cycles N] [--soak M]
     python tools/chaos_smoke.py --router-kill [--cycles N] [--soak M]
     python tools/chaos_smoke.py --disagg [--cycles N] [--soak M]
+    python tools/chaos_smoke.py --supervisor [--cycles N] [--soak M]
 
 ``--kill-loop`` soaks the supervised-restart layer: every round kills
 the decode loop mid-traffic (injected step failure = loop death) while
@@ -89,6 +90,18 @@ split degrades to the fused path), every stream token-identical to
 the fault-free reference with gap-free seqs, the supervisor heals the
 prefill pool back to target WITH its role, and the healed replica
 rejoins the split plane (``tpu_disagg_splits_total`` resumes moving).
+
+``--supervisor`` soaks supervisor crash durability (ISSUE 18): a REAL
+``tools/fleet.py`` supervisor process (stub replicas, a supervised
+router process, ``--manifest`` + ``--heartbeat-file``) is SIGKILLed
+mid-traffic every cycle while clients stream through the router
+process.  Invariants: ZERO user-visible errors while the fleet runs
+UNSUPERVISED and across the successor's adoption, the successor
+ADOPTS every survivor from the manifest (heartbeat ``adoptions``
+moves; every replica keeps its pid AND restart count — no
+double-spawn, no budget burn), the port-collision probe sees each
+replica port still served by the SAME pid, and the kernel-released
+flock lets the successor take the manifest without ``--takeover``.
 
 ``--pool`` soaks the multi-replica client layer instead: an
 EndpointPool over two in-process HTTP servers with one replica
@@ -1703,6 +1716,265 @@ def disagg_phase(cycles, soak, budget):
         supervisor.stop()
 
 
+def supervisor_phase(cycles, soak, budget):
+    """``--supervisor``: supervisor crash durability soak (ISSUE 18).
+
+    Unlike every other phase, the supervisor here is a REAL
+    ``tools/fleet.py`` PROCESS — crash durability is about the
+    supervisor process dying, so an in-process FleetSupervisor would
+    be cheating.  It runs stub replicas behind a supervised router
+    process, journaling fleet state to ``--manifest`` and stamping
+    liveness + adoption counters to ``--heartbeat-file``.  Each cycle,
+    workers stream slowed generations through the router process while
+    the SUPERVISOR ITSELF is SIGKILLed mid-traffic; the streams keep
+    flowing UNSUPERVISED (router and replicas are their own
+    processes), then a successor supervisor boots against the same
+    manifest under live traffic.  Invariants:
+
+      1. ZERO user-visible stream errors — while headless AND across
+         the successor's adoption;
+      2. the successor ADOPTS the survivors: the heartbeat
+         ``adoptions`` counter advances by at least the replica count,
+         and every replica keeps its pid AND its restart count — no
+         double-spawn, no budget burn for a crash that never happened;
+      3. port-collision probe: while headless, each replica's port
+         still serves ``/v2/health/stats`` from the SAME pid the last
+         heartbeat reported (no zombie twin fighting for the socket);
+      4. the kernel released the manifest flock with the SIGKILL: the
+         successor acquires it WITHOUT ``--takeover``.
+    """
+    import http.client
+    import json as _json
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import tempfile
+
+    import tritonclient.http as httpclient
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir = tempfile.mkdtemp(prefix="chaos-supervisor-")
+    manifest_dir = os.path.join(workdir, "manifest")
+    heartbeat = os.path.join(workdir, "heartbeat.json")
+
+    # pin the router port up front: the router PROCESS outlives every
+    # supervisor death, so clients keep one stable address all soak
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        router_port = probe.getsockname()[1]
+    router_url = "127.0.0.1:{}".format(router_port)
+
+    # --stop-fleet pins the FINAL SIGTERM to full teardown (this soak
+    # proves adoption via SIGKILL, which never reaches a handler; the
+    # SIGTERM-handover split is pinned by tests/test_fleet_ha.py)
+    argv = [
+        sys.executable, os.path.join(repo, "tools", "fleet.py"),
+        "--stub", "--replicas", "2", "--min-replicas", "2",
+        "--max-replicas", "2", "--router-processes",
+        "--router-port", str(router_port),
+        "--manifest", manifest_dir, "--heartbeat-file", heartbeat,
+        "--probe-interval", "0.1",
+        "--max-restarts", str(cycles + 4),
+        "--restart-window", "3600", "--stop-fleet",
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.join(repo, "src", "python")
+    generation = [0]
+
+    def spawn_supervisor():
+        generation[0] += 1
+        log = open(os.path.join(
+            workdir, "supervisor-{}.log".format(generation[0])), "wb")
+        try:
+            return subprocess.Popen(argv, env=env, stdout=log,
+                                    stderr=subprocess.STDOUT)
+        finally:
+            log.close()
+
+    def supervisor_log_tail():
+        path = os.path.join(
+            workdir, "supervisor-{}.log".format(generation[0]))
+        try:
+            with open(path, "rb") as fh:
+                return fh.read().decode(errors="replace")[-2000:]
+        except OSError:
+            return "<no log>"
+
+    def read_heartbeat():
+        try:
+            with open(heartbeat) as fh:
+                return _json.load(fh)
+        except (OSError, ValueError):
+            return None
+
+    def wait_heartbeat(predicate, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            beat = read_heartbeat()
+            if beat is not None and predicate(beat):
+                return beat
+            time.sleep(0.1)
+        return None
+
+    def replica_health(url):
+        host, _, port = url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/v2/health/stats")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return None
+            return _json.loads(resp.read())
+        except (OSError, ValueError, http.client.HTTPException):
+            return None
+        finally:
+            conn.close()
+
+    prompt = np.array([5, 7, 9], dtype=np.int32)
+
+    def run_stream(client, cycle, wid, i):
+        tokens, seqs = [], []
+        try:
+            for event in client.generate_stream(
+                    "stub",
+                    {"PROMPT_IDS": prompt,
+                     "MAX_TOKENS": np.array([budget], np.int32)},
+                    parameters={"token_delay_ms": 25},
+                    max_reconnects=10):
+                for out in event.get("outputs", []):
+                    if out["name"] == "TOKEN":
+                        tokens.append(int(out["data"][0]))
+                params = event.get("parameters") or {}
+                if "seq" in params:
+                    seqs.append(params["seq"])
+        except Exception as e:  # noqa: BLE001 — the invariant
+            fail("supervisor cycle {}: user-visible stream error "
+                 "(worker {} stream {}: {}: {})".format(
+                     cycle, wid, i, type(e).__name__, e))
+            return None, None
+        return tokens, seqs
+
+    sup = spawn_supervisor()
+    try:
+        beat = wait_heartbeat(
+            lambda b: b.get("replicas") and b.get("routers")
+            and all(r["state"] == "up" for r in b["replicas"])
+            and all(r["state"] == "up" for r in b["routers"]))
+        if beat is None:
+            fail("supervisor: fleet never became ready (heartbeat={} "
+                 "log tail: {})".format(
+                     read_heartbeat(), supervisor_log_tail()))
+            return
+
+        ref_client = httpclient.InferenceServerClient(router_url)
+        reference, _ = run_stream(ref_client, -1, 0, 0)
+        ref_client.close()
+        if reference is None:
+            return
+        print("reference tokens: {}; {} SIGKILL-the-SUPERVISOR "
+              "cycles".format(reference, cycles), flush=True)
+
+        for cycle in range(cycles):
+            before = read_heartbeat()
+            if not before or not before.get("replicas"):
+                fail("supervisor cycle {}: no heartbeat before the "
+                     "kill".format(cycle))
+                return
+
+            def worker(wid, cycle=cycle):
+                client = httpclient.InferenceServerClient(router_url)
+                try:
+                    for i in range(soak):
+                        tokens, seqs = run_stream(client, cycle, wid, i)
+                        if tokens is None:
+                            continue
+                        chaoslib.check_token_identity(
+                            RECORDER, reference, tokens,
+                            context="supervisor cycle {}".format(cycle),
+                            message="supervisor cycle {}: stream "
+                                    "tokens diverged: {} != {}".format(
+                                        cycle, tokens, reference))
+                        chaoslib.check_seq_continuity(
+                            RECORDER, seqs, expected_len=budget,
+                            context="supervisor cycle {}".format(cycle),
+                            message="supervisor cycle {}: seq gap/"
+                                    "duplicate: {}".format(cycle, seqs))
+                finally:
+                    client.close()
+
+            threads = [
+                threading.Thread(target=worker, args=(w,), daemon=True)
+                for w in range(3)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # streams mid-generation on the router
+            os.kill(sup.pid, signal.SIGKILL)
+            sup.wait(timeout=30)
+            # the fleet is now HEADLESS: keep streaming through it for
+            # a beat before anyone could possibly re-supervise it
+            time.sleep(0.4)
+            for row in before["replicas"]:
+                snap = replica_health(row["url"])
+                if snap is None:
+                    fail("supervisor cycle {}: replica {} ({}) stopped "
+                         "serving while unsupervised".format(
+                             cycle, row["index"], row["url"]))
+                elif snap.get("pid") != row["pid"]:
+                    fail("supervisor cycle {}: replica {} port {} "
+                         "served by pid {} != heartbeat pid {} — "
+                         "something double-spawned it".format(
+                             cycle, row["index"], row["url"],
+                             snap.get("pid"), row["pid"]))
+            # successor under LIVE traffic; the kernel released the
+            # flock with the SIGKILL, so no --takeover needed
+            sup = spawn_supervisor()
+            new_pid = sup.pid
+            for t in threads:
+                t.join(timeout=300)
+            beat = wait_heartbeat(
+                lambda b: b.get("pid") == new_pid and b.get("replicas")
+                and all(r["state"] == "up" for r in b["replicas"]))
+            if beat is None:
+                fail("supervisor cycle {}: successor never stamped a "
+                     "healthy heartbeat (heartbeat={} log tail: "
+                     "{})".format(cycle, read_heartbeat(),
+                                  supervisor_log_tail()))
+                return
+            chaoslib.check_supervisor_adoption(
+                RECORDER,
+                {r["index"]: r for r in before["replicas"]},
+                {r["index"] for r in before["replicas"]},
+                {"adoptions": beat["adoptions"] - before["adoptions"],
+                 "replicas": beat["replicas"]},
+                context="supervisor cycle {}".format(cycle))
+            print("cycle {:2d} adoptions {} -> {} replica pids {} "
+                  "restarts={}".format(
+                      cycle, before["adoptions"], beat["adoptions"],
+                      [r["pid"] for r in beat["replicas"]],
+                      beat["replica_restarts"]), flush=True)
+    finally:
+        if sup.poll() is None:
+            sup.terminate()  # --stop-fleet: SIGTERM = full teardown
+            try:
+                sup.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+                sup.wait(timeout=10)
+        # belt and braces: if a cycle failed while headless, reap
+        # whatever the last heartbeat still names
+        beat = read_heartbeat()
+        for row in ((beat or {}).get("replicas", [])
+                    + (beat or {}).get("routers", [])):
+            if row.get("pid"):
+                try:
+                    os.kill(row["pid"], signal.SIGKILL)
+                except OSError:
+                    pass
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--rounds", type=int, default=8,
@@ -1750,6 +2022,15 @@ def main():
                              "gap-free streams, role-preserving "
                              "healing, and the healed replica "
                              "rejoining the split plane")
+    parser.add_argument("--supervisor", action="store_true",
+                        help="soak supervisor crash durability "
+                             "instead: a real tools/fleet.py process "
+                             "(stub replicas, router process, manifest "
+                             "+ heartbeat) SIGKILLed mid-traffic every "
+                             "cycle — asserts error-free unsupervised "
+                             "streaming, live-child adoption by the "
+                             "successor (pids and restart budgets "
+                             "unchanged), and no double-spawn")
     parser.add_argument("--gray", action="store_true",
                         help="soak the gray-failure ejection layer "
                              "instead: a stub-fleet router with one "
@@ -1808,6 +2089,26 @@ def main():
               "{:.1f}s, zero user-visible errors, token-identical "
               "gap-free streams, role-preserving healing, split "
               "plane re-armed every cycle".format(args.cycles, elapsed))
+        return 0
+
+    if args.supervisor:
+        t0 = time.monotonic()
+        # stub replicas + slowed token cadence, like --router-kill:
+        # each cycle costs one supervisor-process respawn, and every
+        # stream spends most of its life headless on purpose
+        supervisor_phase(args.cycles,
+                         args.soak if args.soak is not None else 3,
+                         args.budget * 2)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nsupervisor chaos smoke FAILED: {} violation(s) "
+                  "in {:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nsupervisor chaos smoke OK: {} supervisor-SIGKILL "
+              "cycles, {:.1f}s, zero user-visible errors, every "
+              "survivor adopted (no double-spawn, no budget "
+              "burn)".format(args.cycles, elapsed))
         return 0
 
     if args.gray:

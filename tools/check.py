@@ -31,13 +31,17 @@ hide until the full-tree run, so the tier-1 gate always runs the full
 scope.  When git is unavailable (no repo, no ``main``), the flag falls
 back to the full tree with a notice.
 
-``--chaos-smoke`` opts into one ``tools/chaos_campaign.py --quick``
-run on top of the lint gate: a single-cycle seeded campaign against
+``--chaos-smoke`` opts into TWO ``tools/chaos_campaign.py --quick``
+runs on top of the lint gate: a single-cycle seeded campaign against
 the in-process stub fleet (<=10 s, no accelerator) that exercises the
-chaos invariant library end to end (docs/resilience.md "Chaos
-campaigns").  Opt-in because it spawns a supervised fleet of
-subprocesses — too heavy for the implicit pre-commit loop, cheap
-enough to arm before touching the fault or router planes.
+chaos invariant library end to end, then one supervisor-kill cycle
+(``--faults supervisor_sigkill,replica_sigkill``) proving the crash-
+durability story — the restarted supervisor ADOPTS the survivors from
+its manifest while respawning only the corpse (docs/resilience.md
+"Chaos campaigns", "Supervisor crash durability").  Opt-in because it
+spawns a supervised fleet of subprocesses — too heavy for the implicit
+pre-commit loop, cheap enough to arm before touching the fault or
+router planes.
 
 tpulint always runs (it ships in-tree).  ruff is optional tooling the
 container may not have: when the binary is missing the ruff step is
@@ -164,21 +168,35 @@ def run_t1_noise(log_path, explicit):
 
 
 def run_chaos_smoke():
-    """Opt-in (``--chaos-smoke``): one ``--quick`` seeded campaign
+    """Opt-in (``--chaos-smoke``): ``--quick`` seeded campaigns
     against the stub fleet — the end-to-end sanity pass over the
-    chaos invariant library.  A wedged fleet must fail the gate, not
-    hang it, so the subprocess gets a hard timeout."""
-    try:
-        proc = subprocess.run(
-            [sys.executable,
-             os.path.join(TOOLS, "chaos_campaign.py"), "--quick"],
-            cwd=REPO_ROOT, timeout=120,
-        )
-    except subprocess.TimeoutExpired:
-        print("check.py: chaos --quick campaign timed out",
-              file=sys.stderr)
-        return 1
-    return proc.returncode
+    chaos invariant library, plus one supervisor-kill cycle proving
+    adoption after a supervisor crash (ISSUE 18).  A wedged fleet
+    must fail the gate, not hang it, so each subprocess gets a hard
+    timeout."""
+    campaigns = (
+        [],
+        # one supervisor-crash cycle: SIGKILL the supervisor, SIGKILL
+        # a replica while the fleet is headless, and require the
+        # successor to adopt the survivors with error_budget 0
+        ["--seed", "7", "--faults", "supervisor_sigkill,replica_sigkill"],
+    )
+    for extra in campaigns:
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(TOOLS, "chaos_campaign.py"), "--quick"]
+                + extra,
+                cwd=REPO_ROOT, timeout=120,
+            )
+        except subprocess.TimeoutExpired:
+            print("check.py: chaos --quick campaign {} timed "
+                  "out".format(" ".join(extra) or "(default)"),
+                  file=sys.stderr)
+            return 1
+        if proc.returncode:
+            return proc.returncode
+    return 0
 
 
 def run_ruff(paths):

@@ -11,7 +11,19 @@ the supervisor keeps live, heals replica death (SIGKILL/crash) and
 wedges (SIGTERM-drain first) under a bounded restart budget, and
 scales the replica count with the fleet's queue pressure
 (docs/resilience.md "Fleet supervisor & elastic scaling").
-SIGTERM/SIGINT drains the whole fleet cleanly.
+
+``--manifest DIR`` makes the SUPERVISOR itself crash-durable
+(docs/resilience.md "Supervisor crash durability"): fleet state is
+journaled to an append-only manifest, and a restarted supervisor
+ADOPTS the still-running children instead of respawning a healthy
+fleet.  Signal dispositions split with it:
+
+- SIGTERM (manifest mode) = graceful HANDOVER — checkpoint the
+  manifest, release the single-writer lock, exit WITHOUT touching the
+  children; they keep serving until a successor adopts them.  Pass
+  ``--stop-fleet`` to keep SIGTERM as full fleet teardown.
+- SIGINT (and SIGTERM without a manifest) = stop the whole fleet,
+  drain-first, exactly as before.
 
 The hidden ``--serve-replica`` mode is the replica entry point the
 supervisor spawns: one InferenceServer + HttpFrontend on ``--port``
@@ -58,7 +70,8 @@ def serve_replica(args):
     core = InferenceServer(
         build_models(args.models.split(","), args.slots),
         fault_scope=args.scope or None,
-        role=args.role or None)
+        role=args.role or None,
+        spawn_nonce=args.spawn_nonce or None)
     frontend = HttpFrontend(core, port=args.port).start()
     install_sigterm_drain(core, drain_timeout=args.drain_timeout)
     print("replica[{}] serving on {} (pid {})".format(
@@ -71,6 +84,21 @@ def serve_replica(args):
     print("replica[{}] drained and stopped".format(args.scope or "-"),
           flush=True)
     return 0
+
+
+def signal_disposition(signum, manifest, stop_fleet):
+    """What one shutdown signal means for THIS supervisor process:
+    ``"handover"`` (checkpoint + release the manifest lock + leave the
+    children serving) or ``"stop"`` (full drain-first fleet teardown).
+    SIGTERM in manifest mode defaults to handover — the whole point of
+    the manifest is that restarting the supervisor must not restart
+    the fleet — unless ``--stop-fleet`` pins the old teardown
+    behaviour; SIGINT (and any signal without a manifest) always
+    stops."""
+    if (signum == signal.SIGTERM and manifest is not None
+            and not stop_fleet):
+        return "handover"
+    return "stop"
 
 
 def main(argv=None):
@@ -87,6 +115,10 @@ def main(argv=None):
                     help="(child mode) phase role the replica "
                          "advertises in /v2/health/stats "
                          "(prefill/decode; empty = fused)")
+    ap.add_argument("--spawn-nonce", default="",
+                    help="(child mode) spawn identity nonce echoed in "
+                         "/v2/health/stats — the supervisor's "
+                         "adoption contract after its own restart")
     ap.add_argument("--models", default="llama,simple",
                     help="comma list of replica models (llama, simple)")
     ap.add_argument("--slots", type=int, default=4,
@@ -135,6 +167,26 @@ def main(argv=None):
                          "directory)")
     ap.add_argument("--standby-port", type=int, default=0,
                     help="standby router listen port (0 = pick free)")
+    ap.add_argument("--manifest", default=None, metavar="DIR",
+                    help="supervisor crash durability: journal fleet "
+                         "state to this manifest directory; a "
+                         "restarted supervisor ADOPTS the running "
+                         "children instead of respawning them")
+    ap.add_argument("--takeover", action="store_true",
+                    help="with --manifest: wait (bounded) for the "
+                         "incumbent supervisor's lock instead of "
+                         "refusing when one is alive")
+    ap.add_argument("--heartbeat-file", default=None, metavar="FILE",
+                    help="stamp supervisor liveness + adoption "
+                         "counters to this file every monitor tick "
+                         "(atomic replace)")
+    ap.add_argument("--stop-fleet", action="store_true",
+                    help="with --manifest: keep SIGTERM as full fleet "
+                         "teardown instead of the default graceful "
+                         "handover that leaves children serving")
+    ap.add_argument("--stub", action="store_true",
+                    help=argparse.SUPPRESS)  # tests/fleet_stub.py
+    # replicas: chaos/CI harness mode, no model deps
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
@@ -143,12 +195,20 @@ def main(argv=None):
 
     from tpuserver.fleet import FleetSupervisor
 
-    command = [
-        sys.executable, os.path.abspath(__file__), "--serve-replica",
-        "--port", "{port}", "--scope", "{scope}",
-        "--models", args.models, "--slots", str(args.slots),
-        "--drain-timeout", str(args.drain_timeout),
-    ]
+    if args.stub:
+        # chaos/CI harness replicas: the pure-stdlib stub server keeps
+        # supervisor-kill campaigns fast and model-free
+        command = [
+            sys.executable, os.path.join(REPO, "tests", "fleet_stub.py"),
+            "--port", "{port}", "--scope", "{scope}",
+        ]
+    else:
+        command = [
+            sys.executable, os.path.abspath(__file__), "--serve-replica",
+            "--port", "{port}", "--scope", "{scope}",
+            "--models", args.models, "--slots", str(args.slots),
+            "--drain-timeout", str(args.drain_timeout),
+        ]
     router_command = None
     if args.router_processes or args.router_standby:
         router_command = [
@@ -176,19 +236,27 @@ def main(argv=None):
         standby_port=args.standby_port,
         env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
         verbose=args.verbose,
+        manifest_dir=args.manifest,
+        takeover=args.takeover,
+        heartbeat_file=args.heartbeat_file,
     ).start()
 
     stop = threading.Event()
+    disposition = {"action": "stop"}
 
-    def _stop(signum, frame):
+    def _signal(signum, frame):
+        disposition["action"] = signal_disposition(
+            signum, args.manifest, args.stop_fleet)
         stop.set()
 
-    signal.signal(signal.SIGTERM, _stop)
-    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _signal)
+    signal.signal(signal.SIGINT, _signal)
     print("fleet supervisor: router(s) on {} over {} replica(s) "
-          "(min {}, max {})".format(
+          "(min {}, max {}{})".format(
               ", ".join(supervisor.router_urls()), args.replicas,
-              args.min_replicas, args.max_replicas), flush=True)
+              args.min_replicas, args.max_replicas,
+              ", manifest {}".format(args.manifest)
+              if args.manifest else ""), flush=True)
     supervisor.wait_ready(timeout_s=120.0)
     for rep in supervisor.stats()["replicas"]:
         print("  replica {url} [{scope}] pid={pid} state={state}".format(
@@ -196,8 +264,14 @@ def main(argv=None):
     try:
         stop.wait()
     finally:
-        supervisor.stop()
-    print("fleet stopped", flush=True)
+        if disposition["action"] == "handover":
+            supervisor.handover()
+        else:
+            supervisor.stop()
+    print("fleet {}".format(
+        "handed over (children still serving)"
+        if disposition["action"] == "handover" else "stopped"),
+        flush=True)
     return 0
 
 

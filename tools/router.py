@@ -96,6 +96,10 @@ def main(argv=None):
                          "(required), keep membership/probing live, "
                          "shed /v2 traffic typed-503 until promoted "
                          "(POST /router/promote or SIGUSR1)")
+    ap.add_argument("--spawn-nonce", default=None,
+                    help="spawn identity nonce echoed in "
+                         "/v2/health/stats (fleet supervisor "
+                         "adoption after a supervisor restart)")
     ap.add_argument("--drain-timeout", type=float, default=10.0,
                     help="SIGTERM drain budget in seconds (in-flight "
                          "streams finish, journal flushes, then exit)")
@@ -127,6 +131,7 @@ def main(argv=None):
         hedge_delay_s=args.hedge_delay,
         journal=args.journal,
         standby=args.standby,
+        spawn_nonce=args.spawn_nonce,
         verbose=args.verbose,
     ).start()
 
