@@ -1078,7 +1078,18 @@ class InferenceServer:
             "tpu_kv_pages_total": "pages_total",
             "tpu_kv_pages_free": "pages_free",
             "tpu_kv_pages_cached": "pages_cached",
+            # speculative decoding (ISSUE 19): proposal/acceptance
+            # counters perfanalyzer's accept-rate columns window-diff,
+            # plus the lifetime accepted-per-step gauge
+            "tpu_spec_tokens_proposed_total": "spec_proposed",
+            "tpu_spec_tokens_accepted_total": "spec_accepted",
+            "tpu_spec_rollbacks_total": "spec_rollbacks",
+            "tpu_spec_steps_total": "spec_steps",
+            "tpu_spec_accept_per_step": "spec_accept_per_step",
         }
+        # the one non-integral family: a mean, exposed as-is (every
+        # other stats value is a count or 0/1 flag)
+        float_families = {"tpu_spec_accept_per_step"}
         samples = {name: [] for name in per_family}
         for model_name, model in items:
             stats_fn = getattr(model, "scheduler_stats", None)
@@ -1086,8 +1097,11 @@ class InferenceServer:
             if not isinstance(stats, dict):
                 continue
             for fam_name, key in per_family.items():
+                val = stats.get(key) or 0
                 samples[fam_name].append(
-                    ({"model": model_name}, int(stats.get(key) or 0)))
+                    ({"model": model_name},
+                     float(val) if fam_name in float_families
+                     else int(val)))
         families.extend(
             (name, rows) for name, rows in samples.items() if rows)
         return families

@@ -977,6 +977,90 @@ def paged_scheduler_step(params, pages, logits_all, page_tables,
     return tokens, tok_logp, new_logits, new_pages
 
 
+def paged_spec_step(params, pages, logits_all, page_tables, positions,
+                    active, forced, forced_mask, draft, draft_len, cfg):
+    """Multi-token speculative verify: :func:`paged_scheduler_step`
+    followed by up to K drafted continuation tokens, all inside ONE
+    dispatch (``tpuserver.speculative`` is the draft source).
+
+    ``draft`` [S, K] int32 holds each row's proposed continuation and
+    ``draft_len`` [S] int32 how many of those entries are real (0 =
+    no speculation for the row — forced-replay rows and throttled
+    streams).  The step is an unrolled chain of K+1 sub-steps, each
+    the *exact* op sequence of :func:`paged_scheduler_step`'s math
+    (log_softmax → argmax → :func:`paged_batched_decode_step`), so
+    every intermediate logits row is bitwise identical to what k
+    separate single-token steps would compute — the token-identity
+    contract holds by construction, not by tolerance (A/B-pinned in
+    tests/test_speculative.py).
+
+    Sub-step 0 feeds the ordinary greedy-or-forced token at
+    ``positions``; sub-step j >= 1 feeds ``draft[:, j-1]`` at
+    ``positions + j`` (rows past their ``draft_len`` feed at the
+    sentinel ``max_seq`` — writes drop, the row is inert for that
+    sub-step).  Greedy acceptance is computed in-graph: row ``i``
+    accepts the longest prefix of its drafts where the previous
+    sub-step's argmax equals the drafted token, and its returned
+    logits are the sub-step outputs at that acceptance depth —
+    selected by GATHER, never by masked arithmetic, so a poisoned
+    row's NaN logits reach the host quarantine path intact instead
+    of corrupting the select.
+
+    Rejected drafts leave garbage K/V at ``positions + accept + 1``
+    onward; those positions sit beyond the row's advanced write
+    cursor, so the next step (or the retirement donation's
+    ``min(pos, known)`` bound) overwrites or ignores them — the
+    rollback is a host-side cursor move, never a device copy.
+
+    Returns ``(tokens [S, K+1], logprobs [S, K+1], accept [S],
+    new_logits [S, vocab], new_pages)``: ``tokens[:, 0]`` is the
+    base token, ``tokens[:, j]`` the j-th draft, and the host emits
+    ``tokens[i, :1 + accept[i]]``.
+    """
+    S, K = draft.shape
+    page = pages.shape[3]
+    max_seq = page_tables.shape[1] * page
+    logp = jax.nn.log_softmax(logits_all, axis=-1)
+    greedy = jnp.argmax(logits_all, axis=-1).astype(jnp.int32)
+    t0 = jnp.where(forced_mask, forced, greedy)
+    lp0 = jnp.take_along_axis(logp, t0[:, None], axis=-1)[:, 0]
+    cur, new_pages = paged_batched_decode_step(
+        params, pages, t0, page_tables, positions, cfg
+    )
+    toks = [t0]
+    lps = [lp0]
+    stack = [cur]   # stack[j] = logits after feeding sub-step j
+    matches = []
+    for j in range(1, K + 1):
+        cand = draft[:, j - 1]
+        fed = j <= draft_len
+        logp_j = jax.nn.log_softmax(cur, axis=-1)
+        g = jnp.argmax(cur, axis=-1).astype(jnp.int32)
+        matches.append((g == cand) & fed)
+        lps.append(
+            jnp.take_along_axis(logp_j, cand[:, None], axis=-1)[:, 0]
+        )
+        toks.append(cand)
+        pos_j = jnp.where(fed, positions + j, max_seq)
+        cur, new_pages = paged_batched_decode_step(
+            params, new_pages, cand, page_tables, pos_j, cfg
+        )
+        stack.append(cur)
+    match_stack = jnp.stack(matches, axis=0).astype(jnp.int32)  # [K, S]
+    accept = jnp.sum(jnp.cumprod(match_stack, axis=0), axis=0)
+    accept = accept.astype(jnp.int32)
+    l_stack = jnp.stack(stack, axis=0)  # [K+1, S, vocab]
+    final = l_stack[accept, jnp.arange(S)]
+    final = jnp.where(active[:, None], final, logits_all)
+    return (
+        jnp.stack(toks, axis=1),
+        jnp.stack(lps, axis=1),
+        accept,
+        final,
+        new_pages,
+    )
+
+
 def paged_admit(pages, logits_all, slot_cache, slot_logits, dest_ids,
                 slot):
     """Admit one prefilled request into the paged pool: the single-row
@@ -1083,6 +1167,10 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False,
     - ``step(params, pages, logits, page_tables, positions, active,
       forced, forced_mask)`` — :func:`paged_scheduler_step`, pages and
       logits donated
+    - ``spec_step(params, pages, logits, page_tables, positions,
+      active, forced, forced_mask, draft, draft_len)`` —
+      :func:`paged_spec_step`, the multi-token speculative verify
+      (pages and logits donated; one compile per distinct K)
     - ``admit(pages, logits, slot_cache, slot_logits, dest_ids,
       slot)`` — :func:`paged_admit`, pages and logits donated
     - ``gather(pages, page_ids)`` — :func:`paged_gather`: the park
@@ -1132,6 +1220,10 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False,
             functools.partial(paged_scheduler_step, cfg=cfg),
             donate_argnums=(1, 2),
         )
+        spec_step = jax.jit(
+            functools.partial(paged_spec_step, cfg=cfg),
+            donate_argnums=(1, 2),
+        )
         admit = jax.jit(paged_admit, donate_argnums=(0, 1))
         gather = jax.jit(paged_gather)
         prefill_fn = jax.jit(functools.partial(prefill_to_length, cfg=cfg))
@@ -1157,6 +1249,13 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False,
             in_shardings=(param_sh, cache_sh, repl, repl, repl, repl,
                           repl, repl),
             out_shardings=(repl, repl, repl, cache_sh),
+            donate_argnums=(1, 2),
+        )
+        spec_step = jax.jit(
+            functools.partial(paged_spec_step, cfg=cfg),
+            in_shardings=(param_sh, cache_sh, repl, repl, repl, repl,
+                          repl, repl, repl, repl),
+            out_shardings=(repl, repl, repl, repl, cache_sh),
             donate_argnums=(1, 2),
         )
         admit = jax.jit(
@@ -1202,6 +1301,7 @@ def make_scheduler_fns(cfg, max_seq, max_slots, mesh=None, quantized=False,
         "prefill_span": prefill_span_fn,
         "prefill_bucket": functools.partial(prefill_bucket, cfg, max_seq),
         "step": step,
+        "spec_step": spec_step,
         "admit": admit,
         "gather": gather,
         "page_size": page_size,

@@ -82,7 +82,8 @@ class LlamaGenerateModel(Model):
                  replay_ttl_s=60.0, replay_capacity=256,
                  page_size=16, kv_pages=None, prefill_chunk_tokens=256,
                  prefix_cache=True, kv_export=False,
-                 target_queue_ms=None, shed_interval_ms=100.0):
+                 target_queue_ms=None, shed_interval_ms=100.0,
+                 spec_tokens=None):
         self._cfg = cfg or llama.tiny(vocab=2048)
         # replica identity threaded to the scheduler's fault-injection
         # points (multi-replica chaos harnesses)
@@ -125,6 +126,12 @@ class LlamaGenerateModel(Model):
         # server-owned XLA-shm region, so a same-host resume attaches
         # and re-scatters instead of re-prefilling prompt + history
         self._kv_export = bool(kv_export)
+        # speculative decoding: candidate tokens drafted (from the
+        # radix prefix cache) and verified per batched step; 0 is
+        # today's single-token path byte-for-byte, None defers to the
+        # TPUSERVER_SPEC_TOKENS environment variable (default 0) so a
+        # whole fleet — or an unmodified test run — can flip it on
+        self._spec_tokens = spec_tokens
         self._scheduler = None  # DecodeScheduler when max_slots > 1
         # continuous-batching models interleave many streams' responses;
         # the frontends must not serialize their stream requests
@@ -214,6 +221,7 @@ class LlamaGenerateModel(Model):
                         prefix_cache=self._prefix_cache,
                         target_queue_ms=self._target_queue_ms,
                         shed_interval_ms=self._shed_interval_ms,
+                        spec_tokens=self._spec_tokens,
                         # queue-wait/step latency histograms land in
                         # the attached server's /metrics registry
                         # (lock-free observes — the decode loop never

@@ -40,7 +40,8 @@ def _thread_leak_guard(request):
             or request.node.get_closest_marker("pool")
             or request.node.get_closest_marker("router")
             or request.node.get_closest_marker("fleet")
-            or request.node.get_closest_marker("campaign")):
+            or request.node.get_closest_marker("campaign")
+            or request.node.get_closest_marker("spec")):
         yield
         return
     before = {t.ident for t in threading.enumerate()}

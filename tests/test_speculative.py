@@ -1,0 +1,491 @@
+"""Speculative decoding engine tests (ISSUE 19 tentpole).
+
+The contracts under test:
+
+- the drafter (``tpuserver.speculative.NgramDrafter``) is a READ-ONLY
+  consumer of the radix prefix cache: lookups never pin ref-counts,
+  never bump the tree version, and never change what eviction may
+  reclaim; the tree-derived index rebuilds lazily (keyed on
+  ``radix.version``), and self-context prompt-lookup drafts from the
+  stream's own repetition;
+- **multi-token verify identity**: ``llama.paged_spec_step`` with a
+  perfect draft produces bitwise-identical tokens, logprobs, final
+  logits, and cache CONTENT to k+1 separate single-token
+  ``paged_scheduler_step`` calls — and with a corrupted draft it
+  accepts exactly the matching prefix and returns the logits of that
+  acceptance depth;
+- **end-to-end token identity**: ``DecodeScheduler(spec_tokens=K)``
+  emits byte-identical streams to ``spec_tokens=0`` on every prompt
+  (greedy acceptance is exact, not approximate), while
+  ``spec_accept_per_step > 1`` on repetitive traffic proves the
+  multi-token win;
+- rollback is a cursor move with balanced page accounting: an always-
+  wrong drafter forces a rollback every step and the page pool still
+  reconciles (free + cached == total, nothing leaked or
+  double-donated);
+- per-stream adaptive throttling stops paying for drafts on streams
+  whose acceptance is ~0;
+- ``spec_tokens=None`` defers to ``TPUSERVER_SPEC_TOKENS`` (how the
+  pinned suites run unmodified with speculation on), and a fns bundle
+  without ``spec_step`` degrades to the plain path instead of failing;
+- the fleet stub's speculative twin (``tests/fleet_stub.py
+  --spec-tokens``) streams token-identically to a plain stub and moves
+  the ``tpu_spec_*`` counter families on /metrics.
+
+Everything device-backed runs the tiny config on CPU-sim with small
+pinned geometry per the tier-1 runtime budget.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fleet_stub import free_port, wait_ready  # noqa: E402
+
+from tpuserver.models import llama  # noqa: E402
+from tpuserver.paging import RadixPrefixCache  # noqa: E402
+from tpuserver.scheduler import DecodeScheduler  # noqa: E402
+from tpuserver.speculative import NgramDrafter  # noqa: E402
+
+pytestmark = pytest.mark.spec
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STUB = os.path.join(HERE, "fleet_stub.py")
+
+CFG = llama.tiny(vocab=512)
+MAX_SEQ = 64
+PAGE = 16
+PPSEQ = MAX_SEQ // PAGE
+
+#: a prompt whose continuation the model itself keeps repeating (tiny
+#: random weights lock onto the 2-cycle), so real drafts get accepted
+REPETITIVE = [7, 9] * 6
+PLAIN = [3, 5, 11]
+
+
+# -- drafter (no device) -----------------------------------------------------
+
+
+def test_drafter_validates_knobs():
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(min_ngram=0)
+    with pytest.raises(ValueError, match="min_ngram"):
+        NgramDrafter(min_ngram=4, max_ngram=2)
+    with pytest.raises(ValueError, match="max_draft"):
+        NgramDrafter(max_draft=0)
+
+
+def test_drafter_self_context_prompt_lookup():
+    d = NgramDrafter(max_draft=8)
+    # [1 2 3 4 | 9 | 1 2 3 4] — suffix [3, 4] occurred before, followed
+    # by [9, 1, 2, 3, 4]: classic prompt-lookup
+    toks = [1, 2, 3, 4, 9, 1, 2, 3, 4]
+    assert d.draft(toks, 4) == [9, 1, 2, 3]
+    # nothing repeats: no draft (the scheduler then steps plainly)
+    assert d.draft([1, 2, 3, 4, 5, 6], 4) == []
+    # too short to match anything
+    assert d.draft([1], 4) == []
+    assert d.draft(toks, 0) == []
+
+
+def test_drafter_reads_tree_without_pinning_or_mutation():
+    radix = RadixPrefixCache(4)
+    seq = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+    radix.insert_tail([], seq, 0, [0, 1, 2], pin=False)
+    version = radix.version
+    unreferenced = radix.unreferenced
+    d = NgramDrafter(radix, max_draft=8)
+    # a querying stream whose suffix matches the cached sequence gets
+    # the continuation that followed it in the tree
+    assert d.draft([40, 41, 3, 4, 5, 6], 4) == [7, 8, 9, 10]
+    # STRICTLY read-only: no version bump, no ref-count pin — eviction
+    # sees the exact same tree as before the draft
+    assert radix.version == version
+    assert radix.unreferenced == unreferenced
+    assert sorted(radix.evict(3)) == [0, 1, 2]
+
+
+def test_drafter_index_rebuilds_lazily_on_version():
+    radix = RadixPrefixCache(4)
+    radix.insert_tail([], list(range(12)), 0, [0, 1, 2], pin=False)
+    d = NgramDrafter(radix, max_draft=4)
+    d.draft([2, 3, 4, 5], 2)
+    d.draft([6, 7, 8, 9], 2)
+    assert d.rebuilds == 1  # second draft was a pure dict probe
+    radix.insert_tail([], [100, 101, 102, 103, 104, 105, 106, 107],
+                      0, [3, 4], pin=False)
+    # not root-anchored (leading 41), so the exact-continuation walk
+    # misses and the n-gram index must serve — freshly rebuilt
+    assert d.draft([41, 100, 101, 102, 103], 2) == [104, 105]
+    assert d.rebuilds == 2  # version moved, index rebuilt once
+    # a root-anchored context is served by the tree walk itself: no
+    # index involvement, no rebuild
+    radix.insert_tail([], [50, 51, 52, 53, 54, 55, 56, 57],
+                      0, [5, 6], pin=False)
+    assert d.draft([50, 51, 52, 53], 2) == [54, 55]
+    assert d.rebuilds == 2
+
+
+def test_radix_continuation_exact_prefix():
+    radix = RadixPrefixCache(4)
+    seq = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12]
+    radix.insert_tail([], seq, 0, [0, 1, 2], pin=False)
+    version = radix.version
+    unreferenced = radix.unreferenced
+    # mid-page context: the walk matches one full page, then resolves
+    # the 2-token remainder inside the next page's key
+    assert radix.continuation([1, 2, 3, 4, 5, 6], 4) == [7, 8, 9, 10]
+    # page-aligned context: continuation is the child page verbatim
+    assert radix.continuation([1, 2, 3, 4], 8) == [5, 6, 7, 8, 9, 10,
+                                                   11, 12]
+    # the full cached sequence has nothing beyond it
+    assert radix.continuation(seq, 4) == []
+    # a context that is NOT a cached prefix draws a blank, even though
+    # its suffix appears in the tree (that's the n-gram index's job)
+    assert radix.continuation([9, 9, 3, 4, 5, 6], 4) == []
+    # STRICTLY read-only (same contract as iter_sequences)
+    assert radix.version == version
+    assert radix.unreferenced == unreferenced
+
+
+def test_drafter_prefers_exact_continuation_over_ngram():
+    # degenerate repetition: a run of one token aliases every n-gram
+    # key, and last-writer-wins would draft the run's EXIT (99, 98...)
+    # for a context still deep inside the run.  The root-anchored walk
+    # is unambiguous: only one tree path spells the full context.
+    radix = RadixPrefixCache(4)
+    seq = [5, 5, 5, 5, 5, 5, 5, 5, 5, 5, 99, 98]
+    radix.insert_tail([], seq, 0, [0, 1, 2], pin=False)
+    d = NgramDrafter(radix, max_draft=4)
+    assert d.draft([5, 5, 5, 5, 5, 5], 4) == [5, 5, 5, 5]
+    assert d.draft(seq[:9], 4) == [5, 99, 98]
+
+
+# -- kernel A/B (device-backed, tiny config on CPU-sim) ----------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def fns(params):
+    return llama.make_scheduler_fns(CFG, MAX_SEQ, 2)
+
+
+def _admitted_pool(params, prompt):
+    """One prefilled prompt admitted into a fresh paged pool (identity
+    page table for slot 0), plus the step-call scaffolding."""
+    import jax.numpy as jnp
+
+    slots = 2
+    slot_cache = llama.init_kv_cache(CFG, 1, MAX_SEQ)
+    logits_row, slot_cache = llama.prefill_to_length(
+        params, slot_cache, jnp.asarray(prompt)[None, :], len(prompt),
+        CFG)
+    pages = llama.init_paged_kv_cache(CFG, slots * PPSEQ, PAGE)
+    logits = jnp.zeros((slots, CFG.vocab), jnp.float32)
+    dest = np.arange(PPSEQ, dtype=np.int32)
+    pages, logits = llama.paged_admit(
+        pages, logits, slot_cache, logits_row, dest, 0)
+    tables = np.stack([np.arange(PPSEQ),
+                       np.arange(PPSEQ, 2 * PPSEQ)]).astype(np.int32)
+    return pages, logits, tables
+
+
+def test_spec_step_bitwise_matches_k_single_steps(params):
+    """The A/B pin of the token-identity contract: one
+    ``paged_spec_step`` with a perfect K-token draft == K+1 successive
+    ``paged_scheduler_step`` calls, bitwise, including the cache
+    content behind the advanced cursor."""
+    prompt = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+    K = 3
+    slots = 2
+    forced = np.zeros((slots,), np.int32)
+    fmask = np.zeros((slots,), bool)
+    active = np.array([True, False])
+
+    # reference: K+1 greedy single steps
+    pages_a, logits_a, tables = _admitted_pool(params, prompt)
+    positions = np.array([len(prompt), MAX_SEQ], np.int32)
+    ref_toks, ref_lps = [], []
+    for j in range(K + 1):
+        t, lp, logits_a, pages_a = llama.paged_scheduler_step(
+            params, pages_a, logits_a, tables,
+            positions + np.array([j, 0], np.int32), active, forced,
+            fmask, CFG)
+        ref_toks.append(int(np.asarray(t)[0]))
+        ref_lps.append(np.asarray(lp)[0])
+
+    # speculative: the draft IS the reference continuation
+    pages_b, logits_b, _ = _admitted_pool(params, prompt)
+    draft = np.zeros((slots, K), np.int32)
+    draft[0] = ref_toks[1:]
+    draft_len = np.array([K, 0], np.int32)
+    toks, lps, accept, final, pages_b = llama.paged_spec_step(
+        params, pages_b, logits_b, tables, positions, active, forced,
+        fmask, draft, draft_len, CFG)
+    assert int(np.asarray(accept)[0]) == K  # everything accepted
+    np.testing.assert_array_equal(np.asarray(toks)[0], ref_toks)
+    np.testing.assert_array_equal(np.asarray(lps)[0], ref_lps)
+    # the returned logits ARE the single-step chain's final logits
+    np.testing.assert_array_equal(np.asarray(final), np.asarray(logits_a))
+    # and so is the cache content the next step decodes against
+    np.testing.assert_array_equal(
+        np.asarray(llama.paged_gather(pages_b, tables[0])),
+        np.asarray(llama.paged_gather(pages_a, tables[0])))
+
+
+def test_spec_step_partial_acceptance_rolls_back(params):
+    """A draft corrupted at index 1 accepts exactly the matching
+    prefix (1 token) and returns the logits of that depth — the wrong
+    candidate and everything after it never reach the host stream."""
+    prompt = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+    K = 3
+    slots = 2
+    forced = np.zeros((slots,), np.int32)
+    fmask = np.zeros((slots,), bool)
+    active = np.array([True, False])
+
+    pages_a, logits_a, tables = _admitted_pool(params, prompt)
+    positions = np.array([len(prompt), MAX_SEQ], np.int32)
+    ref_toks = []
+    depth_logits = []
+    for j in range(K + 1):
+        t, _, logits_a, pages_a = llama.paged_scheduler_step(
+            params, pages_a, logits_a, tables,
+            positions + np.array([j, 0], np.int32), active, forced,
+            fmask, CFG)
+        ref_toks.append(int(np.asarray(t)[0]))
+        depth_logits.append(np.asarray(logits_a))
+
+    pages_b, logits_b, _ = _admitted_pool(params, prompt)
+    draft = np.zeros((slots, K), np.int32)
+    draft[0] = ref_toks[1:]
+    draft[0, 1] = (draft[0, 1] + 1) % CFG.vocab  # wrong at index 1
+    draft_len = np.array([K, 0], np.int32)
+    toks, _, accept, final, _ = llama.paged_spec_step(
+        params, pages_b, logits_b, tables, positions, active, forced,
+        fmask, draft, draft_len, CFG)
+    assert int(np.asarray(accept)[0]) == 1
+    # host emits 1 + accept tokens: the base and the one good draft
+    np.testing.assert_array_equal(np.asarray(toks)[0, :2], ref_toks[:2])
+    # gather-selected logits at the acceptance depth == the single-step
+    # chain after exactly those 2 tokens
+    np.testing.assert_array_equal(np.asarray(final), depth_logits[1])
+
+
+# -- scheduler end-to-end ----------------------------------------------------
+
+
+def _collect(sched, prompt, n):
+    return [t for t, _ in sched.submit(np.asarray(prompt, np.int32), n)]
+
+
+def test_scheduler_spec_token_identity_and_acceptance(fns, params):
+    """spec_tokens=4 streams byte-identically to spec_tokens=0 on
+    repetitive AND non-repetitive prompts, and the repetitive one
+    proves the win: more than one token emitted per verify step."""
+    plain = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=0)
+    spec = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=4)
+    try:
+        for prompt, n in ((REPETITIVE, 20), (PLAIN, 10)):
+            ref = _collect(plain, prompt, n)
+            got = _collect(spec, prompt, n)
+            assert got == ref and len(ref) == n
+        stats = spec.stats()
+        assert stats["spec_tokens"] == 4
+        assert stats["spec_proposed"] > 0
+        assert stats["spec_accepted"] > 0
+        assert stats["spec_accept_per_step"] > 1.0
+        assert stats["spec_accepted"] <= stats["spec_proposed"]
+        # the plain scheduler never speculated
+        assert plain.stats()["spec_steps"] == 0
+    finally:
+        plain.close()
+        spec.close()
+
+
+def test_spec_rollback_page_accounting(fns, params, monkeypatch):
+    """An always-wrong drafter forces a rollback EVERY speculative
+    step; the stream stays token-identical (rejected drafts never
+    reach the host) and the page pool reconciles exactly — the cursor
+    move leaks nothing and double-donates nothing."""
+    plain = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=0)
+    ref = _collect(plain, PLAIN, 12)
+    plain.close()
+    full = [int(t) for t in PLAIN] + ref
+
+    class WrongDrafter:
+        def __init__(self, *a, **k):
+            pass
+
+        def draft(self, ctx, k):
+            # the exact future continuation, each token off by one:
+            # every candidate is guaranteed to fail greedy verify
+            hist = len(ctx) - len(PLAIN)
+            future = full[len(PLAIN) + hist:len(PLAIN) + hist + k]
+            return [(t + 1) % CFG.vocab for t in future]
+
+    monkeypatch.setattr("tpuserver.scheduler.NgramDrafter", WrongDrafter)
+    sched = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=2,
+                            spec_throttle_after=10 ** 9)
+    try:
+        assert _collect(sched, PLAIN, 12) == ref
+        stats = sched.stats()
+        assert stats["spec_steps"] >= 1
+        assert stats["spec_accepted"] == 0
+        assert stats["spec_rollbacks"] == stats["spec_steps"]
+        # every page is either free or donated to the radix cache —
+        # speculative garbage beyond the cursor freed with its span
+        assert stats["live_streams"] == 0
+        assert (stats["pages_free"] + stats["pages_cached"]
+                == stats["pages_total"])
+    finally:
+        sched.close()
+
+
+def test_spec_adaptive_throttle_stops_hopeless_drafting(fns, params,
+                                                        monkeypatch):
+    """A stream whose drafts never verify stops paying for them:
+    after ``spec_throttle_after`` consecutive missed draft tokens the
+    stream skips drafting for ``spec_probe_interval`` steps, bounding
+    the wasted verify sub-steps."""
+    plain = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=0)
+    ref = _collect(plain, PLAIN, 20)
+    plain.close()
+    full = [int(t) for t in PLAIN] + ref
+
+    class WrongDrafter:
+        def __init__(self, *a, **k):
+            pass
+
+        def draft(self, ctx, k):
+            hist = len(ctx) - len(PLAIN)
+            future = full[len(PLAIN) + hist:len(PLAIN) + hist + k]
+            return [(t + 1) % CFG.vocab for t in future]
+
+    monkeypatch.setattr("tpuserver.scheduler.NgramDrafter", WrongDrafter)
+    sched = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=2,
+                            spec_throttle_after=2,
+                            spec_probe_interval=1000)
+    try:
+        assert _collect(sched, PLAIN, 20) == ref
+        stats = sched.stats()
+        # first step drafts 2, both miss, the threshold trips: every
+        # remaining step is throttled (probe interval outlasts the
+        # stream), so the waste is bounded at one step's drafts —
+        # NOT 2 drafts x 19 more steps
+        assert stats["spec_proposed"] == 2
+        assert stats["spec_steps"] == 1
+        assert stats["spec_accepted"] == 0
+    finally:
+        sched.close()
+
+
+def test_spec_tokens_env_var_and_degrade(fns, params, monkeypatch):
+    """``spec_tokens=None`` defers to TPUSERVER_SPEC_TOKENS (the knob
+    that runs unmodified suites with speculation on); an explicit
+    value wins over the env; a fns bundle without ``spec_step``
+    silently degrades to the plain path instead of failing
+    construction."""
+    monkeypatch.setenv("TPUSERVER_SPEC_TOKENS", "3")
+    sched = DecodeScheduler(fns, params, 2, MAX_SEQ)
+    try:
+        assert sched.stats()["spec_tokens"] == 3
+    finally:
+        sched.close()
+    sched = DecodeScheduler(fns, params, 2, MAX_SEQ, spec_tokens=0)
+    try:
+        assert sched.stats()["spec_tokens"] == 0  # explicit 0 wins
+    finally:
+        sched.close()
+    legacy = {k: v for k, v in fns.items() if k != "spec_step"}
+    sched = DecodeScheduler(legacy, params, 2, MAX_SEQ, spec_tokens=4)
+    try:
+        assert sched.stats()["spec_tokens"] == 0  # degraded, not dead
+        assert _collect(sched, PLAIN, 4) and True
+    finally:
+        sched.close()
+
+
+# -- fleet stub twin ---------------------------------------------------------
+
+
+def _stub_stream(port, prompt, n):
+    body = json.dumps({"inputs": [
+        {"name": "PROMPT_IDS", "datatype": "INT32",
+         "shape": [len(prompt)], "data": prompt},
+        {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1],
+         "data": [n]},
+    ]}).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("POST", "/v2/models/stub/generate_stream", body,
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        toks = []
+        for raw in resp:
+            line = raw.rstrip(b"\r\n").decode()
+            if not line.startswith("data: "):
+                continue
+            ev = json.loads(line[len("data: "):])
+            if ev.get("final"):
+                break
+            toks.append(ev["outputs"][0]["data"][0])
+        return toks
+    finally:
+        conn.close()
+
+
+@pytest.mark.fleet
+def test_fleet_stub_spec_twin_is_token_identical():
+    """The stub fleet's speculative twin: burst emission is token-
+    identical to a plain stub, and the ``tpu_spec_*`` counter families
+    move on /metrics (what chaos campaigns and the http perfanalyzer
+    backend scrape)."""
+    p_spec, p_plain = free_port(), free_port()
+    procs = [
+        subprocess.Popen([sys.executable, STUB, "--port", str(p_spec),
+                          "--spec-tokens", "4"]),
+        subprocess.Popen([sys.executable, STUB, "--port", str(p_plain)]),
+    ]
+    try:
+        for p in (p_spec, p_plain):
+            assert wait_ready(p), "stub replica never became ready"
+        for prompt, n in (([7, 9, 7, 9], 24), ([3, 5, 11], 10)):
+            a = _stub_stream(p_spec, prompt, n)
+            b = _stub_stream(p_plain, prompt, n)
+            assert a == b and len(a) == n
+        conn = http.client.HTTPConnection("127.0.0.1", p_spec, timeout=5)
+        try:
+            conn.request("GET", "/metrics")
+            text = conn.getresponse().read().decode()
+        finally:
+            conn.close()
+        fams = {ln.split()[0]: int(ln.split()[1])
+                for ln in text.splitlines()
+                if ln.startswith("tpu_spec")}
+        assert fams["tpu_spec_steps_total"] > 0
+        assert fams["tpu_spec_tokens_accepted_total"] > 0
+        assert fams["tpu_spec_rollbacks_total"] > 0
+        assert (fams["tpu_spec_tokens_accepted_total"]
+                <= fams["tpu_spec_tokens_proposed_total"])
+    finally:
+        for proc in procs:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+        for proc in procs:
+            proc.wait(timeout=10)

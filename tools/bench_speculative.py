@@ -1,0 +1,271 @@
+#!/usr/bin/env python3
+"""Speculative decoding benchmark (ISSUE 19 acceptance).
+
+CPU-sim (``JAX_PLATFORMS=cpu``) evidence for the PR's claims, written
+as BENCH-schema rows (default ``BENCH_r11.json``):
+
+1. **Repetitive decode: > 1 token per step, and per-stream tok/s
+   up.**  The repetitive traffic shape: one hot prompt the replica
+   has served before, re-requested by concurrent clients (the
+   retry / popular-prompt / regenerate pattern).  The radix tree
+   holds the exact continuation from the first service, so the
+   drafter's exact-prefix walk proposes it verbatim and
+   accepted-tokens-per-step approaches the draft budget; with the
+   batch loaded, per-stream tokens/sec of ``spec_tokens=4`` beats
+   ``spec_tokens=0`` on the identical (bitwise verified) output
+   streams.  On CPU-sim the win is per-iteration host+dispatch
+   amortization (one verify dispatch replaces up to K+1 scheduler
+   iterations); on real hardware the same acceptance additionally
+   amortizes HBM weight passes — the acceptance rate is the portable
+   number.
+2. **Agentic regenerate: the radix cache IS the draft model.**  The
+   same prompt re-submitted with a larger budget (the retry/extend
+   shape) re-decodes its first generation token-for-token; the radix
+   tree already holds that exact sequence from the first run's
+   retirement donation, so the drafter proposes it verbatim and
+   acceptance approaches the draft budget.
+3. **The perfanalyzer acceptance column.**  One generation-profiler
+   window against a speculating in-process model, proving the
+   ``accept/step`` / ``spec-hit%`` columns flow end-to-end (window-
+   delta'd from the scheduler's stats, satellite of this PR).
+
+Every speculative stream is A/B-checked against its plain twin before
+its timing is reported — a benchmark that broke token identity would
+be measuring a different contract.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "src", "python"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPETITIVE = [7, 9] * 6
+AGENTIC = [12, 34, 56, 78, 11, 22, 33, 44, 55, 66, 77, 88, 99, 111,
+           222, 333]
+
+
+def _build(spec_tokens, slots=2):
+    import jax
+
+    from tpuserver.models import llama
+    from tpuserver.scheduler import DecodeScheduler
+
+    cfg = llama.tiny(vocab=512)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    fns = llama.make_scheduler_fns(cfg, 128, max_slots=slots)
+    return DecodeScheduler(fns, params, slots, 128,
+                           spec_tokens=spec_tokens)
+
+
+def _run(sched, prompt, n):
+    t0 = time.perf_counter()
+    toks = [t for t, _ in sched.submit(np.asarray(prompt, np.int32), n)]
+    return toks, time.perf_counter() - t0
+
+
+def _run_concurrent(sched, prompt, n, streams):
+    """``streams`` clients submit the same prompt at once; returns
+    (per-stream token lists, wall seconds)."""
+    outs = [None] * streams
+
+    def worker(i):
+        outs[i] = [
+            t for t, _ in sched.submit(np.asarray(prompt, np.int32), n)]
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return outs, time.perf_counter() - t0
+
+
+def bench_repetitive(rows):
+    streams = 8
+    # prompt(12) + 52 = 64 tokens = 4 full pages: the first service
+    # donates the WHOLE stream to the radix tree (donation is
+    # page-granular), so re-service drafts have exact coverage
+    n = 52
+    plain = _build(0, slots=streams)
+    spec = _build(4, slots=streams)
+    try:
+        # compiles land outside the measurement: a same-bucket
+        # repetitive warm-up prompt forces every path — the prefill
+        # bucket, the plain step, AND the spec verify step (its first
+        # draft fires the spec_step compile)
+        warm = [21, 23] * 6
+        _run(plain, warm, 16)
+        _run(spec, warm, 16)
+        # first service of the hot prompt: retirement donates
+        # prompt + output to each scheduler's radix tree
+        ref, _ = _run(plain, REPETITIVE, n)
+        got, _ = _run(spec, REPETITIVE, n)
+        assert got == ref and len(ref) == n, "token identity broken"
+        before = spec.stats()
+        t_plain, t_spec = [], []
+        for _trial in range(3):
+            outs, dt = _run_concurrent(plain, REPETITIVE, n, streams)
+            assert all(o == ref for o in outs), "token identity broken"
+            t_plain.append(dt)
+            outs, dt = _run_concurrent(spec, REPETITIVE, n, streams)
+            assert all(o == ref for o in outs), "token identity broken"
+            t_spec.append(dt)
+        stats = spec.stats()
+    finally:
+        plain.close()
+        spec.close()
+    steps = stats["spec_steps"] - before["spec_steps"]
+    accepted = stats["spec_accepted"] - before["spec_accepted"]
+    accept_per_step = (steps + accepted) / steps if steps else 0.0
+    tps_plain = n / statistics.median(t_plain)
+    tps_spec = n / statistics.median(t_spec)
+    print("repetitive hot prompt, {} concurrent streams x{} tokens: "
+          "accept/step {:.2f}, per-stream {:.1f} -> {:.1f} tok/s "
+          "({:.2f}x), streams identical".format(
+              streams, n, accept_per_step, tps_plain, tps_spec,
+              tps_spec / tps_plain))
+    rows.append({
+        "config": "speculative", "metric": "accept_per_step_repetitive",
+        "value": round(accept_per_step, 3), "unit": "tokens/step",
+        "vs_baseline": 1.0, "spec_tokens": 4, "gen_tokens": n,
+        "streams": streams,
+        "rollbacks": stats["spec_rollbacks"] - before["spec_rollbacks"]})
+    rows.append({
+        "config": "speculative", "metric": "stream_tokens_per_sec",
+        "value": round(tps_spec, 1), "unit": "tokens/sec",
+        "vs_baseline": round(tps_plain, 1),
+        "speedup": round(tps_spec / tps_plain, 2),
+        "streams": streams, "trials": 3,
+        "token_identical": True})
+    rows.append({
+        # the hardware-portable number: scheduler iterations (each one
+        # dispatch + one host round) per emitted token — what HBM-bound
+        # decode actually pays per token
+        "config": "speculative", "metric": "steps_per_token_repetitive",
+        "value": round(1.0 / accept_per_step, 3) if accept_per_step
+        else None,
+        "unit": "steps/token", "vs_baseline": 1.0})
+
+
+def bench_agentic_regenerate(rows):
+    spec = _build(4)
+    plain = _build(0)
+    try:
+        warm = [21, 23] * 8  # same 16-token prefill bucket, drafts fire
+        _run(spec, warm, 16)
+        _run(plain, warm, 16)
+        # turn 1: cold generation; retirement donates prompt+output
+        # to the radix tree
+        _run(spec, AGENTIC, 20)
+        _run(plain, AGENTIC, 20)
+        before = spec.stats()
+        # turn 2: the regenerate/extend shape — greedy determinism
+        # re-decodes turn 1's tokens, which the tree now drafts
+        ref, t_plain = _run(plain, AGENTIC, 32)
+        got, t_spec = _run(spec, AGENTIC, 32)
+        stats = spec.stats()
+    finally:
+        spec.close()
+        plain.close()
+    assert got == ref, "token identity broken"
+    steps = stats["spec_steps"] - before["spec_steps"]
+    accepted = stats["spec_accepted"] - before["spec_accepted"]
+    proposed = stats["spec_proposed"] - before["spec_proposed"]
+    accept_per_step = (steps + accepted) / steps if steps else 0.0
+    print("agentic regenerate: accept/step {:.2f} ({}/{} drafts "
+          "accepted), {:.1f} -> {:.1f} tok/s".format(
+              accept_per_step, accepted, proposed, 32 / t_plain,
+              32 / t_spec))
+    rows.append({
+        "config": "speculative", "metric": "accept_per_step_regenerate",
+        "value": round(accept_per_step, 3), "unit": "tokens/step",
+        "vs_baseline": 1.0, "spec_tokens": 4,
+        "draft_hit_pct": round(100.0 * accepted / proposed, 1)
+        if proposed else None,
+        "tokens_per_sec": round(32 / t_spec, 1),
+        "baseline_tokens_per_sec": round(32 / t_plain, 1)})
+
+
+def bench_perfanalyzer_column(rows):
+    """The acceptance column end-to-end: GenerationProfiler against a
+    speculating in-process model reports spec_accept_per_step."""
+    from perfanalyzer.client_backend import create_backend
+    from perfanalyzer.generation import GenerationProfiler
+    from tpuserver.core import InferenceServer
+    from tpuserver.models import llama
+    from tpuserver.models.llama_serving import LlamaGenerateModel
+
+    core = InferenceServer([LlamaGenerateModel(
+        cfg=llama.tiny(vocab=512), max_seq=96, max_slots=4,
+        spec_tokens=4)])
+    backend = None
+    try:
+        pool = [{
+            "PROMPT_IDS": np.asarray(REPETITIVE, np.int32),
+            "MAX_TOKENS": np.array([24], np.int32),
+        }]
+        backend = create_backend("inprocess", core=core, max_inflight=2)
+        profiler = GenerationProfiler(
+            backend, "llama_generate", pool,
+            measurement_interval_s=2.0, max_trials=3, warmup_s=0.5)
+        result = profiler.profile_level(2)
+        profiler.stop()
+    finally:
+        if backend is not None:
+            backend.close()
+        core.close()
+    print("perfanalyzer columns: accept/step {} spec-hit% {} at "
+          "{:.0f} tok/s".format(
+              result.get("spec_accept_per_step"),
+              result.get("spec_hit_pct"), result["throughput"]))
+    rows.append({
+        "config": "speculative", "metric": "perfanalyzer_accept_per_step",
+        "value": round(result.get("spec_accept_per_step") or 0.0, 3),
+        "unit": "tokens/step", "vs_baseline": 1.0,
+        "spec_hit_pct": round(result.get("spec_hit_pct") or 0.0, 1),
+        "tokens_per_sec": round(result["throughput"], 1),
+        "streams": 2})
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_r11.json"))
+    args = ap.parse_args(argv)
+
+    rows = []
+    bench_repetitive(rows)
+    bench_agentic_regenerate(rows)
+    bench_perfanalyzer_column(rows)
+
+    payload = {
+        "n": 11,
+        "cmd": "JAX_PLATFORMS=cpu python tools/bench_speculative.py",
+        "rc": 0,
+        "note": "speculative decoding fed by the radix cache (PR 19); "
+                "CPU-sim numbers — acceptance rates are the portable "
+                "signal; the wall-clock win is host+dispatch "
+                "amortization under a loaded batch (real hardware "
+                "additionally amortizes HBM weight passes)",
+        "rows": rows,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(payload, fh, indent=1)
+        fh.write("\n")
+    print("wrote {} rows to {}".format(len(rows), args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

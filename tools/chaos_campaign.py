@@ -108,6 +108,10 @@ def build_parser():
     ap.add_argument("--concurrency", type=int, default=32,
                     help="--proof: generation streams per worker "
                          "(default 32 => 64 total)")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="run the stub replicas' speculative-decoding "
+                         "twin at this draft budget (0 = off); token "
+                         "identity must hold under every fault")
     ap.add_argument("--json", default=None,
                     help="write the campaign report (violations, "
                          "schedule, stats) here")
@@ -117,18 +121,23 @@ def build_parser():
 # -- fleet ------------------------------------------------------------------
 
 
-def start_fleet(cycles, manifest_dir=None):
+def start_fleet(cycles, manifest_dir=None, spec_tokens=0):
     """The campaign target: a role-split stub fleet (1 prefill + 1
     decode) supervised together with an active+standby router pair
     sharing one crash journal — every tier a scheduled fault can hit
     is a real, supervised OS process.  ``manifest_dir`` makes the
     supervisor itself a target: ``supervisor_sigkill`` crashes it and
-    a successor built from the SAME manifest adopts the fleet."""
+    a successor built from the SAME manifest adopts the fleet.
+    ``spec_tokens`` turns on the replicas' stub speculative-decoding
+    twin — burst emission must survive every scheduled fault with the
+    identical token streams."""
     from tpuserver.fleet import FleetSupervisor
 
     stub = os.path.join(REPO, "tests", "fleet_stub.py")
     command = [sys.executable, stub, "--port", "{port}",
                "--scope", "{scope}"]
+    if spec_tokens > 0:
+        command += ["--spec-tokens", str(spec_tokens)]
     router_command = [
         sys.executable, os.path.join(REPO, "tools", "router.py"),
         "--backends", "{backends}", "--port", "{port}",
@@ -465,7 +474,8 @@ def run_campaign(args, schedule):
     manifest_dir = None
     if "supervisor_sigkill" in schedule.kinds:
         manifest_dir = tempfile.mkdtemp(prefix="campaign-manifest-")
-    supervisor = start_fleet(args.cycles, manifest_dir=manifest_dir)
+    supervisor = start_fleet(args.cycles, manifest_dir=manifest_dir,
+                             spec_tokens=args.spec_tokens)
     injectors = FleetInjectors(supervisor, manifest_dir=manifest_dir)
     runner = chaoslib.CampaignRunner(
         schedule, injectors.registry(), recorder)
@@ -548,7 +558,8 @@ def run_campaign(args, schedule):
                     and fleetmanifest.process_start_token(
                         row["pid"]) is not None}
                 supervisor = start_fleet(
-                    args.cycles, manifest_dir=manifest_dir)
+                    args.cycles, manifest_dir=manifest_dir,
+                    spec_tokens=args.spec_tokens)
                 injectors.supervisor = supervisor
                 summary["supervisor_restarts"] += 1
                 wait_converged(supervisor, recorder, context)
@@ -620,7 +631,8 @@ def run_proof(args, schedule):
               file=sys.stderr, flush=True)
 
     recorder = chaoslib.InvariantRecorder(sink)
-    supervisor = start_fleet(args.cycles)
+    supervisor = start_fleet(args.cycles,
+                             spec_tokens=args.spec_tokens)
     injectors = FleetInjectors(supervisor)
     runner = chaoslib.CampaignRunner(
         schedule, injectors.registry(), recorder)

@@ -41,7 +41,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "src", "python"))
 
 
-def build_models(names, slots):
+def build_models(names, slots, spec_tokens=0):
     from tpuserver.models.simple import SimpleModel
 
     models = []
@@ -51,7 +51,7 @@ def build_models(names, slots):
 
         models.append(LlamaGenerateModel(
             cfg=llama.tiny(vocab=512), max_seq=64, max_slots=slots,
-            restart_backoff_s=0.01))
+            restart_backoff_s=0.01, spec_tokens=spec_tokens))
     if "simple" in names:
         models.append(SimpleModel())
     if not models:
@@ -68,7 +68,8 @@ def serve_replica(args):
     from tpuserver.http_frontend import HttpFrontend
 
     core = InferenceServer(
-        build_models(args.models.split(","), args.slots),
+        build_models(args.models.split(","), args.slots,
+                     spec_tokens=args.spec_tokens),
         fault_scope=args.scope or None,
         role=args.role or None,
         spawn_nonce=args.spawn_nonce or None)
@@ -123,6 +124,11 @@ def main(argv=None):
                     help="comma list of replica models (llama, simple)")
     ap.add_argument("--slots", type=int, default=4,
                     help="llama scheduler slots per replica (default 4)")
+    ap.add_argument("--spec-tokens", type=int, default=0,
+                    help="speculative decoding draft budget per replica "
+                         "scheduler step (0 = off; token streams are "
+                         "identical either way, docs/resilience.md "
+                         "'Speculative decoding')")
     ap.add_argument("--drain-timeout", type=float, default=10.0,
                     help="replica SIGTERM drain budget in seconds")
     ap.add_argument("--replicas", type=int, default=2,
@@ -202,12 +208,15 @@ def main(argv=None):
             sys.executable, os.path.join(REPO, "tests", "fleet_stub.py"),
             "--port", "{port}", "--scope", "{scope}",
         ]
+        if args.spec_tokens > 0:
+            command += ["--spec-tokens", str(args.spec_tokens)]
     else:
         command = [
             sys.executable, os.path.abspath(__file__), "--serve-replica",
             "--port", "{port}", "--scope", "{scope}",
             "--models", args.models, "--slots", str(args.slots),
             "--drain-timeout", str(args.drain_timeout),
+            "--spec-tokens", str(args.spec_tokens),
         ]
     router_command = None
     if args.router_processes or args.router_standby:
