@@ -37,7 +37,9 @@ surfaces statically and fails on divergence:
   producer publishes under ``core.RESPONSE_PARAMS_KEY`` must be among
   the keys both tiers read.
 - **Admin-surface coverage** — the router's own declared admin routes
-  (``ROUTER_ADMIN_ROUTES``: ``/router/stats``, ``/router/replicas``)
+  (``ROUTER_ADMIN_ROUTES``: ``/router/stats``, ``/router/replicas``,
+  ``/router/partition`` — the horizontal tier's map/epoch surface
+  every active must serve)
   must all be served, and the membership route must reference both
   ``add`` and ``remove`` verbs: the fleet supervisor and ops tooling
   drive elastic scaling and planned replacement through exactly this
@@ -92,7 +94,8 @@ METRICS_ROUTE = "/metrics"
 #: served by the real router module; ``/router/replicas`` must also
 #: reference both membership actions — the fleet supervisor
 #: (``tpuserver.fleet``) and ops tooling key on exactly this contract.
-ROUTER_ADMIN_ROUTES = ("/router/stats", "/router/replicas")
+ROUTER_ADMIN_ROUTES = ("/router/stats", "/router/replicas",
+                       "/router/partition")
 MEMBERSHIP_ROUTE = "/router/replicas"
 MEMBERSHIP_ACTIONS = ("add", "remove")
 

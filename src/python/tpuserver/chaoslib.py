@@ -30,6 +30,12 @@ collects the same objects to print a minimized repro.  The catalog:
 ``fleet_convergence``      the supervised fleet returns to its per-role
                            targets — :func:`wait_fleet_converged`
 ``journal_single_writer``  exactly one ACTIVE router process at a time
+                           per partition (a horizontal tier holds one
+                           active per journal subdirectory)
+``partition_blast_radius``  an active's death touches ONLY its own
+                           partition: sibling partitions' streams
+                           complete with zero reconnects and gap-free
+                           seqs — :func:`check_partition_blast_radius`
 ``shm_consistency``        ``xla_shm_status`` holds exactly the expected
                            regions (no stale ``kvexport/*`` leaks)
 ``thread_leak``            no non-daemon threads outlive the campaign
@@ -65,8 +71,8 @@ __all__ = [
     "check_token_identity", "check_seq_continuity",
     "check_counters_monotonic", "MetricsMonotonicityCheck",
     "wait_stream_drain", "wait_fleet_converged",
-    "check_journal_single_writer", "check_shm_consistency",
-    "check_supervisor_adoption",
+    "check_journal_single_writer", "check_partition_blast_radius",
+    "check_shm_consistency", "check_supervisor_adoption",
     "thread_baseline", "check_no_thread_leaks",
     "FAULT_KINDS", "ScheduledFault", "FaultSchedule",
     "minimized_repro", "CampaignRunner",
@@ -369,22 +375,73 @@ def wait_fleet_converged(stats_fn, membership_fn=None, restarts_above=None,
 def check_journal_single_writer(recorder, routers, context="",
                                 message=None,
                                 invariant="journal_single_writer"):
-    """Journal single-writer discipline: at most ONE router process
-    may hold the active role at a time — two actives appending to the
-    same crash journal would interleave frames and corrupt recovery.
-    ``routers`` is the supervisor's ``stats()["routers"]`` list."""
-    active = [r for r in routers
-              if r.get("role") == "active" and r.get("state") == "up"]
-    if len(active) <= 1:
+    """Journal single-writer discipline, PER PARTITION: at most ONE
+    router process may hold the active role for any one journal
+    directory at a time — two actives appending to the same directory
+    would interleave frames and corrupt recovery.  Unpartitioned rows
+    (``partition`` absent/None — the single-active tier) all share one
+    journal and form one group; a partitioned tier owns one journal
+    subdirectory per partition, so one active PER PARTITION is the
+    invariant.  ``routers`` is the supervisor's ``stats()["routers"]``
+    list."""
+    groups = {}
+    for r in routers:
+        if r.get("role") == "active" and r.get("state") == "up":
+            groups.setdefault(r.get("partition"), []).append(r)
+    bad = {part: rows for part, rows in groups.items()
+           if len(rows) > 1}
+    if not bad:
         return True
     recorder.record(
         invariant,
-        message or "{}: {} active routers sharing one journal "
-        "(single-writer discipline broken): {}".format(
-            context, len(active),
-            [(r.get("pid"), r.get("role")) for r in routers]),
-        context=context, active=len(active), routers=list(routers))
+        message or "{}: multiple active routers sharing one journal "
+        "(single-writer discipline broken) in partition(s) {}: "
+        "{}".format(
+            context, sorted(bad, key=str),
+            [(r.get("pid"), r.get("role"), r.get("partition"))
+             for r in routers]),
+        context=context,
+        active=sum(len(rows) for rows in bad.values()),
+        routers=list(routers))
     return False
+
+
+def check_partition_blast_radius(recorder, survivors, context="",
+                                 message=None,
+                                 invariant="partition_blast_radius"):
+    """An active-router SIGKILL must blast ONLY its own partition:
+    every stream homed on a SURVIVING partition rides through the
+    sibling's death with ZERO reconnects and gap-free, duplicate-free
+    seqs — the horizontal tier's whole point is that a front-door
+    failure is a partition-sized event, never a fleet-sized one.
+    ``survivors`` is a list of per-stream observation dicts:
+    ``{"partition": k, "reconnects": n, "seqs": [...]}`` (``seqs``
+    optional; when present it must be exactly ``0..n-1``)."""
+    ok = True
+    for i, row in enumerate(survivors):
+        part = row.get("partition")
+        reconnects = int(row.get("reconnects") or 0)
+        if reconnects:
+            ok = False
+            recorder.record(
+                invariant,
+                message or "{}: stream {} on surviving partition {} "
+                "reconnected {} time(s) during a sibling's kill — "
+                "the blast radius leaked across partitions".format(
+                    context, i, part, reconnects),
+                context=context, stream=i, partition=part,
+                reconnects=reconnects)
+        seqs = row.get("seqs")
+        if seqs is not None and list(seqs) != list(range(len(seqs))):
+            ok = False
+            recorder.record(
+                invariant,
+                message or "{}: stream {} on surviving partition {} "
+                "has a seq gap/duplicate during a sibling's kill: "
+                "{}".format(context, i, part, list(seqs)),
+                context=context, stream=i, partition=part,
+                seqs=list(seqs))
+    return ok
 
 
 def check_supervisor_adoption(recorder, before, survivors, stats,
@@ -557,6 +614,11 @@ FAULT_KINDS = {
     "router_sigterm": (
         "SIGTERM the ACTIVE router (drain-first path): in-flight "
         "streams finish or hand off before exit", "router"),
+    "active_router_sigkill": (
+        "SIGKILL one ACTIVE of a partitioned multi-router tier; the "
+        "standby must promote INTO the dead router's partition while "
+        "sibling partitions' streams ride through untouched "
+        "(partition_blast_radius)", "router"),
     "gray_slow": (
         "turn one replica gray: alive to probes, orders of magnitude "
         "slower to serve (faults 'slow' / stub infer_delay_ms)", None),

@@ -207,12 +207,15 @@ class RouterProcess:
     ``retired``); the ``role`` swaps on takeover while the port stays
     stable, so a client's url list never goes stale."""
 
-    def __init__(self, role, host, port):
+    def __init__(self, role, host, port, partition=None):
         self.host = host
         self.port = port
         self.url = "{}:{}".format(host, port)
         self._lock = threading.Lock()
         self.role = role           # guarded-by: _lock
+        # the generation-id partition this router OWNS (multi-active
+        # tier); moves with the role on takeover, None for the standby
+        self.partition = partition  # guarded-by: _lock
         self.proc = None           # guarded-by: _lock
         self.state = "starting"    # guarded-by: _lock
         self.restarts = 0          # guarded-by: _lock
@@ -232,6 +235,7 @@ class RouterProcess:
                 "state": self.state,
                 "pid": self.proc.pid if self.proc is not None else None,
                 "restarts": self.restarts,
+                "partition": self.partition,
             }
 
 
@@ -360,6 +364,16 @@ class FleetSupervisor:
         Stable listen ports for the two router processes (0 = pick a
         free one at construction; the port then stays stable across
         restarts and role swaps).
+    active_routers
+        Horizontal front tier (requires ``router_command``): run N
+        SIMULTANEOUSLY-ACTIVE routers, each owning a stable partition
+        of the generation-id space with its own journal subdirectory
+        (``p<index>`` under ``router_journal`` — single-writer stays
+        an invariant per partition) and peer-forwarding requests that
+        hash to a sibling.  On an active's death the standby promotes
+        INTO the dead router's partition; partition-map changes
+        broadcast to every router under a monotonically-bumped epoch.
+        1 (default) keeps the PR-15 single-active tier byte-identical.
     env
         Extra environment for replica processes (merged over
         ``os.environ``).
@@ -400,6 +414,7 @@ class FleetSupervisor:
                  router_kwargs=None, env=None, verbose=False,
                  router_command=None, router_standby=False,
                  router_journal=None, router_port=0, standby_port=0,
+                 active_routers=1,
                  prefill_replicas=0, decode_replicas=0,
                  manifest_dir=None, takeover=False,
                  takeover_timeout_s=30.0, heartbeat_file=None):
@@ -544,6 +559,21 @@ class FleetSupervisor:
         self._router_command = (list(router_command)
                                 if router_command else None)
         self._router_standby = bool(router_standby)
+        self._active_routers = max(1, int(active_routers))
+        if self._active_routers > 1 and self._router_command is None:
+            raise ValueError(
+                "active_routers > 1 needs router_command — only "
+                "supervised router PROCESSES can partition the "
+                "generation-id space (the in-process router is one "
+                "object)")
+        # partition-map epoch: bumps on every map change (takeover,
+        # member coming up); routers adopt only strictly newer maps,
+        # so a late broadcast can never roll ownership backwards.
+        # Recovery: the epoch only ever bumps alongside a broadcast,
+        # and takeovers are the floor — 1 + takeovers is >= any epoch
+        # a predecessor pushed for those takeovers, and the adopting
+        # supervisor re-broadcasts (bumping again) before it matters.
+        self._partition_epoch = 1 + self._router_takeovers  # guarded-by: _lock
         self._journal_tmp = None
         self._router_journal = router_journal
         # router PROCESS handles (router_command mode); role swaps on
@@ -574,7 +604,8 @@ class FleetSupervisor:
                             "role") != "active", p)):
                     row = recovered["routers"][port]
                     rhandle = RouterProcess(
-                        row.get("role") or "active", host, port)
+                        row.get("role") or "active", host, port,
+                        partition=row.get("partition"))
                     rhandle.restarts = int(row.get("restarts") or 0)
                     rhandle.restart_times = deque(
                         row.get("restart_times") or [])
@@ -582,12 +613,27 @@ class FleetSupervisor:
                         rhandle.state = "retired"
                     rhandle.adopt_row = dict(row)
                     handles.append(rhandle)
+                parts = [h.partition for h in handles
+                         if h.partition is not None]
+                if parts:
+                    # the manifest IS the partition map too: the
+                    # active-set width is however many partitions the
+                    # predecessor ran, whatever this process was told
+                    self._active_routers = max(
+                        self._active_routers, max(parts) + 1)
+                actives = sum(
+                    1 for h in handles if h.role == "active")
                 self._router_standby = (self._router_standby
-                                        or len(handles) > 1)
+                                        or len(handles) > actives)
             else:
                 handles = [RouterProcess(
                     "active", host,
-                    int(router_port) or _free_port(host))]
+                    int(router_port) or _free_port(host),
+                    partition=0 if self._active_routers > 1 else None)]
+                for part in range(1, self._active_routers):
+                    handles.append(RouterProcess(
+                        "active", host, _free_port(host),
+                        partition=part))
                 if self._router_standby:
                     handles.append(RouterProcess(
                         "standby", host,
@@ -887,6 +933,17 @@ class FleetSupervisor:
             handles, key=lambda h: h.stats()["role"] != "active")
         return [h.url for h in ordered]
 
+    def _partition_map_snapshot(self):
+        """url-by-partition for the active set ("" for a partition
+        with no live owner — retired, or mid-takeover)."""
+        urls = [""] * self._active_routers
+        for handle in self._router_handles_snapshot():
+            st = handle.stats()
+            part = st.get("partition")
+            if part is not None and st["state"] != "retired":
+                urls[int(part)] = handle.url
+        return urls
+
     def _router_argv(self, handle):
         backends = ",".join(
             h.url for h in self._handles_snapshot()
@@ -896,8 +953,21 @@ class FleetSupervisor:
                      journal=self._router_journal)
             for t in self._router_command
         ]
-        if handle.stats()["role"] == "standby":
+        st = handle.stats()
+        if st["role"] == "standby":
             argv.append("--standby")
+        if self._active_routers > 1:
+            # the partitioned tier: actives get their stable partition
+            # index, the standby only the count (it tails EVERY
+            # partition's journal until promoted into one); all carry
+            # the current map + epoch so a respawn rejoins current
+            argv += ["--partition-count", str(self._active_routers)]
+            if st.get("partition") is not None:
+                argv += ["--partition-index", str(st["partition"])]
+            with self._lock:
+                epoch = self._partition_epoch
+            argv += ["--peers", ",".join(self._partition_map_snapshot()),
+                     "--epoch", str(epoch)]
         return argv
 
     def _spawn_router(self, handle):
@@ -917,6 +987,7 @@ class FleetSupervisor:
         now = time.monotonic()
         with handle._lock:
             role = handle.role
+            partition = handle.partition
             handle.proc = proc
             handle.state = "starting"
             handle.started_at = now
@@ -926,6 +997,7 @@ class FleetSupervisor:
             self._manifest_append({
                 "type": "router_spawn",
                 "role": role,
+                "partition": partition,
                 "port": handle.port,
                 "pid": proc.pid,
                 "start_token": fleetmanifest.process_start_token(
@@ -955,13 +1027,17 @@ class FleetSupervisor:
             finally:
                 conn.close()
 
-    def _promote_standby(self, handle):
+    def _promote_standby(self, handle, payload=None):
         """POST the takeover signal to a standby router; True when the
-        promotion was acknowledged."""
+        promotion was acknowledged.  ``payload`` (partitioned tier)
+        names the partition the standby promotes INTO plus the new
+        map + epoch it should serve."""
+        body = (json.dumps(payload).encode("utf-8")
+                if payload else b"{}")
         conn = http.client.HTTPConnection(
             handle.host, handle.port, timeout=self._probe_timeout_s)
         try:
-            conn.request("POST", "/router/promote", b"{}",
+            conn.request("POST", "/router/promote", body,
                          {"Content-Type": "application/json"})
             resp = conn.getresponse()
             resp.read()
@@ -972,6 +1048,42 @@ class FleetSupervisor:
             return False
         finally:
             conn.close()
+
+    def _bump_partition_epoch(self):
+        """Mint the next partition-map epoch (bumped eagerly — a
+        broadcast/promote that then fails just skips a value; epochs
+        only need monotonicity, not density)."""
+        with self._lock:
+            self._partition_epoch += 1
+            return self._partition_epoch
+
+    def _broadcast_partition_map(self):
+        """Push the current partition map under a FRESH epoch to every
+        live router: actives peer-forward by it, the standby keeps it
+        warm for promotion.  Routers adopt only strictly newer epochs,
+        so a reordered/late post can never roll ownership backwards; a
+        router that is down simply misses the post — its respawn argv
+        carries the then-current map."""
+        if self._active_routers <= 1:
+            return
+        body = json.dumps({
+            "action": "set_map",
+            "map": self._partition_map_snapshot(),
+            "epoch": self._bump_partition_epoch(),
+        })
+        for handle in self._router_handles_snapshot():
+            if handle.stats()["state"] not in ("up", "starting"):
+                continue
+            conn = http.client.HTTPConnection(
+                handle.host, handle.port, timeout=self._probe_timeout_s)
+            try:
+                conn.request("POST", "/router/partition", body,
+                             {"Content-Type": "application/json"})
+                conn.getresponse().read()
+            except (OSError, http.client.HTTPException):
+                pass
+            finally:
+                conn.close()
 
     def _router_takeover(self, casualty, alive):
         """The active router died (or wedged): promote the warm
@@ -1005,22 +1117,47 @@ class FleetSupervisor:
                 if proc is None or proc.poll() is not None:
                     break
                 time.sleep(0.02)
-        if standby is not None and self._promote_standby(standby):
+        payload = None
+        part = None
+        if standby is not None:
+            with casualty._lock:
+                part = casualty.partition
+            if part is not None:
+                # partitioned tier: the standby promotes INTO the
+                # casualty's partition — scoped journal re-attach plus
+                # the map rebind, under a fresh epoch
+                peers = self._partition_map_snapshot()
+                peers[part] = standby.url
+                payload = {"partition": part, "peers": peers,
+                           "epoch": self._bump_partition_epoch()}
+        if standby is not None and self._promote_standby(standby,
+                                                         payload):
             with standby._lock:
                 standby.role = "active"
+                standby.partition = part
             with casualty._lock:
                 casualty.role = "standby"
+                casualty.partition = None
             with self._lock:
                 self._router_takeovers += 1
             self._manifest_append({
                 "type": "promote",
                 "active_port": standby.port,
                 "standby_port": casualty.port,
+                "partition": part,
             })
+            if part is not None:
+                # siblings (and the demoted slot, once respawned)
+                # learn the rebind; clients chase it via the epoch in
+                # /router/stats and resume answers
+                self._broadcast_partition_map()
             self._log(
-                "router takeover: standby {} promoted to active; {} "
-                "will respawn as the new standby".format(
-                    standby.url, casualty.url))
+                "router takeover: standby {} promoted to active"
+                "{}; {} will respawn as the new standby".format(
+                    standby.url,
+                    " (partition {})".format(part)
+                    if part is not None else "",
+                    casualty.url))
         if alive:
             if standby is None:
                 # no standby to protect: drain first (the router
@@ -1139,6 +1276,11 @@ class FleetSupervisor:
                     came_up = False
             if came_up:
                 self._log("{} router {} is up".format(role, handle.url))
+                # partitioned tier: a member coming up (respawned
+                # casualty, healed active) re-syncs everyone's map —
+                # its own argv carried the spawn-time map, but siblings
+                # may have learned it is back only just now
+                self._broadcast_partition_map()
 
     # -- healing -----------------------------------------------------------
 
@@ -1292,6 +1434,7 @@ class FleetSupervisor:
                 routers.append({
                     "port": handle.port,
                     "role": handle.role,
+                    "partition": handle.partition,
                     "pid": (handle.proc.pid
                             if handle.proc is not None else None),
                     "start_token": token,
@@ -1430,6 +1573,25 @@ class FleetSupervisor:
             handle.nonce = row["nonce"]
         with self._lock:
             self._adoptions += 1
+        if self._active_routers > 1:
+            # epoch floor: the live router may hold a higher epoch
+            # than 1 + takeovers (came-up broadcasts bump it too);
+            # adopting its value keeps our next broadcast adoptable
+            conn = http.client.HTTPConnection(
+                handle.host, handle.port, timeout=self._probe_timeout_s)
+            try:
+                conn.request("GET", "/router/stats")
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    got = json.loads(resp.read())
+                    with self._lock:
+                        self._partition_epoch = max(
+                            self._partition_epoch,
+                            int(got.get("epoch") or 0))
+            except (OSError, ValueError, http.client.HTTPException):
+                pass
+            finally:
+                conn.close()
         self._log("adopted {} router {} (pid {})".format(
             handle.role, handle.url, pid))
         return True
@@ -1681,4 +1843,8 @@ class FleetSupervisor:
             out["router_takeovers"] = router_takeovers
             out["router_retired"] = router_retired
             out["routers"] = [h.stats() for h in router_handles]
+            if self._active_routers > 1:
+                out["partition_map"] = self._partition_map_snapshot()
+                with self._lock:
+                    out["partition_epoch"] = self._partition_epoch
         return out

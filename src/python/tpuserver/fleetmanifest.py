@@ -343,6 +343,7 @@ def fold_manifest(records):
             row.update({
                 "port": port,
                 "role": rec.get("role"),
+                "partition": rec.get("partition"),
                 "pid": rec.get("pid"),
                 "start_token": rec.get("start_token"),
                 "nonce": rec.get("nonce"),
@@ -365,8 +366,14 @@ def fold_manifest(records):
             for row in state["routers"].values():
                 if row["port"] == rec.get("active_port"):
                     row["role"] = "active"
+                    # partitioned tier: the promotion moved the dead
+                    # active's partition onto the standby
+                    if rec.get("partition") is not None:
+                        row["partition"] = rec.get("partition")
                 elif row["port"] == rec.get("standby_port"):
                     row["role"] = "standby"
+                    if rec.get("partition") is not None:
+                        row["partition"] = None
             state["counters"]["router_takeovers"] += 1
         elif kind == "config":
             if "router_journal" in rec:

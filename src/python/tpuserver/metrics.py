@@ -251,6 +251,26 @@ CATALOG = {
         "Standby-to-active promotions this router performed (the "
         "warm-standby takeover signal: POST /router/promote, SIGUSR1, "
         "or the fleet supervisor on active-router death)."),
+    # -- horizontal router tier: gen-id partitioning -----------------------
+    "tpu_router_partition_owned_total": (
+        "counter",
+        "Generation admissions this router served because the "
+        "generation id hashed into its own partition."),
+    "tpu_router_partition_forwarded_total": (
+        "counter",
+        "Wrong-partition requests this router thin-proxied to the "
+        "owning peer (one extra in-tier hop; clients carrying the "
+        "full tier in fallback_urls mostly dial the owner directly)."),
+    "tpu_router_partition_moved_total": (
+        "counter",
+        "Generations whose owning partition URL changed under an "
+        "adopted partition-map epoch (standby promoted INTO a dead "
+        "active's partition, or a respawn on a new port)."),
+    "tpu_router_partition_epoch": (
+        "gauge",
+        "Monotonic epoch of the partition map this router is serving "
+        "under (bumped by the supervisor on every broadcast; routers "
+        "adopt strictly newer epochs only)."),
     # -- disaggregated prefill/decode (phase-split serving) ----------------
     "tpu_disagg_splits_total": (
         "counter",

@@ -72,6 +72,7 @@ docs/resilience.md "Fleet router" for the full semantics and
 
 import http.client
 import json
+import os
 import random
 import re
 import socket
@@ -84,7 +85,8 @@ import uuid
 import zlib
 from collections import OrderedDict
 
-from tpuserver._http_base import BaseHttpHandler, ClientGone as _ClientGone
+from tpuserver._http_base import (
+    BaseHttpHandler, ClientGone as _ClientGone, RelayStream, SseRelayLoop)
 from tpuserver.disagg import PhaseSplitOrchestrator
 from tpuserver.journal import JournalFollower, JournalWriter, read_journal
 from tpuserver.metrics import (
@@ -1079,6 +1081,26 @@ class FleetRouter:
         writing it, keep the replica membership + prober live, but
         shed all /v2 traffic with a typed 503 until :meth:`promote`
         (or ``POST /router/promote``) turns this router active.
+    partition_index / partition_count / peers / partition_epoch
+        The horizontal front tier (docs/resilience.md "Horizontal
+        router tier"): with ``partition_count > 1`` this router owns
+        only the generation ids hashing to ``partition_index``
+        (``crc32(bare_id) % count``), journals under its own
+        per-partition subdirectory (``<journal>/p<index>`` — the
+        single-writer discipline holds per partition), and
+        peer-forwards everything else to the owner in ``peers`` (the
+        url-by-partition map, rebindable at runtime via ``POST
+        /router/partition`` with a newer ``epoch``).  A partitioned
+        standby tails EVERY partition's journal and is promoted INTO
+        one dead active's partition (``promote(partition=k)``).
+        ``partition_count == 1`` (default) is the unpartitioned
+        single-active behavior, byte-identical to before.
+    relay_mode
+        ``"selector"`` hands every established token stream to one
+        event-loop thread (:class:`~tpuserver._http_base.SseRelayLoop`)
+        so thousands of idle streams do not pin a thread each;
+        ``"thread"`` keeps the classic thread-per-stream relay.  None
+        picks selector for partitioned routers and thread otherwise.
     """
 
     def __init__(self, backends, host="127.0.0.1", port=0,
@@ -1090,9 +1112,26 @@ class FleetRouter:
                  min_eligible=1, probe_fraction=1.0 / 16,
                  eject_interval_s=0.5, digest_window=64,
                  hedge_delay_s=None, journal=None, standby=False,
-                 journal_flush_s=0.02, spawn_nonce=None):
+                 journal_flush_s=0.02, spawn_nonce=None,
+                 partition_index=None, partition_count=1, peers=None,
+                 partition_epoch=0, relay_mode=None):
         if not backends:
             raise ValueError("FleetRouter requires at least one backend")
+        if relay_mode not in (None, "thread", "selector"):
+            raise ValueError(
+                "relay_mode must be 'thread' or 'selector' "
+                "(got {!r})".format(relay_mode))
+        partition_count = max(1, int(partition_count))
+        if partition_count > 1:
+            if partition_index is None and not standby:
+                raise ValueError(
+                    "a partitioned ACTIVE router needs its partition: "
+                    "pass partition_index with partition_count > 1")
+            if (partition_index is not None
+                    and not 0 <= int(partition_index) < partition_count):
+                raise ValueError(
+                    "partition_index {} out of range for {} "
+                    "partition(s)".format(partition_index, partition_count))
         # spawn identity nonce (fleet supervisor adoption): echoed in
         # health_snapshot so a restarted supervisor can claim this
         # router process the same way it claims replicas
@@ -1164,13 +1203,41 @@ class FleetRouter:
         # rotation counter steering every probe_every'th pick onto a
         # soft-ejected replica (its real-traffic probe)  # guarded-by: _lock
         self._eject_tick = 0
+        # -- horizontal front tier (docs/resilience.md "Horizontal
+        # router tier"): stable gen-id partitions across N actives ----------
+        self._partition_count = partition_count
+        # rebound by promote(partition=k)  # guarded-by: _lock
+        self._partition_index = (int(partition_index)
+                                 if partition_index is not None else None)
+        # url-by-partition owner map + its epoch: higher epochs win
+        # (supervisor broadcast after a takeover)  # guarded-by: _lock
+        self._partition_map = [str(u) for u in peers] if peers else []
+        self._partition_epoch = int(partition_epoch)  # guarded-by: _lock
+        self._partition_owned = 0      # guarded-by: _lock
+        self._partition_forwarded = 0  # guarded-by: _lock
+        self._partition_moved = 0      # guarded-by: _lock
+        self._relay_mode = relay_mode or (
+            "selector" if partition_count > 1 else "thread")
+        # the selector relay loop (created in start() when mode is
+        # "selector"; None keeps the thread-per-stream relay)
+        self._relay_loop = None
         # -- router HA state (docs/resilience.md "Router HA") -------------
-        self._journal_dir = journal
+        # partitioned actives journal under their own subdirectory —
+        # PR 15's single-writer discipline, per partition
+        self._journal_base = journal
+        if (journal is not None and partition_count > 1
+                and partition_index is not None):
+            self._journal_dir = os.path.join(
+                journal, "p{}".format(int(partition_index)))
+        else:
+            self._journal_dir = journal
         self._journal_flush_s = float(journal_flush_s)
         # the journal writer (active routers with a journal only);
         # created in start()/promote(), closed in stop()
         self._journal = None
         self._follower = None
+        # partitioned standby: one follower per partition journal
+        self._followers = None
         self._tail_thread = None
         self._tail_stop = threading.Event()
         # warm-standby flag: /v2 traffic sheds typed 503 while set;
@@ -1245,7 +1312,16 @@ class FleetRouter:
             with self._lock:
                 standby = self._standby
             if standby:
-                self._follower = JournalFollower(self._journal_dir)
+                if self._partition_count > 1:
+                    # a partitioned standby is the warm copy of EVERY
+                    # partition: it tails all N journals and only at
+                    # promotion binds to the dead active's partition
+                    self._followers = [
+                        JournalFollower(os.path.join(
+                            self._journal_base, "p{}".format(k)))
+                        for k in range(self._partition_count)]
+                else:
+                    self._follower = JournalFollower(self._journal_dir)
                 self._tail_thread = threading.Thread(
                     target=self._tail_loop,
                     name="fleet-router-journal-tail", daemon=True)
@@ -1253,6 +1329,8 @@ class FleetRouter:
             else:
                 self._recover_journal()
                 self._open_journal_writer()
+        if self._relay_mode == "selector":
+            self._relay_loop = SseRelayLoop(name="fleet-router-relay")
         # one synchronous probe round before serving: routing decisions
         # start from real replica state, not optimism
         self._probe_round()
@@ -1282,6 +1360,8 @@ class FleetRouter:
         if self._tail_thread is not None:
             self._tail_thread.join(timeout=5)
             self._tail_thread = None
+        if self._relay_loop is not None:
+            self._relay_loop.stop()
         journal = self._journal
         if journal is not None:
             journal.close()
@@ -1327,12 +1407,18 @@ class FleetRouter:
         """The standby's warm copy: apply journal records as the
         active router writes them."""
         while not self._tail_stop.is_set():
-            try:
-                for rec in self._follower.poll():
-                    self._apply_journal_record(rec)
-            except Exception as e:  # noqa: BLE001 — a bad record must
-                # not end the tail (the next poll continues past it)
-                self._log("journal tail error: {}".format(e))
+            followers = (self._followers if self._followers is not None
+                         else [self._follower])
+            for follower in followers:
+                if follower is None:
+                    continue
+                try:
+                    for rec in follower.poll():
+                        self._apply_journal_record(rec)
+                except Exception as e:  # noqa: BLE001 — a bad record
+                    # must not end the tail (the next poll continues
+                    # past it)
+                    self._log("journal tail error: {}".format(e))
             if self._tail_stop.wait(0.05):
                 return
 
@@ -1373,11 +1459,18 @@ class FleetRouter:
         elif kind == "drop":
             self.drop_generation(gid)
 
-    def promote(self):
+    def promote(self, partition=None, peers=None, epoch=None):
         """Turn a standby active (the takeover signal): final journal
         catch-up, open the append side, start serving.  Returns True
         when a promotion happened (False on an already-active router,
-        or while another caller's promotion is in flight)."""
+        or while another caller's promotion is in flight).
+
+        On a partitioned tier, ``partition`` names the dead active's
+        partition this standby is promoted INTO: the journal re-attach
+        is scoped to that partition's directory (single-writer holds
+        per partition), tailed state belonging to the surviving
+        actives' partitions is shed, and ``peers``/``epoch`` rebind
+        the ownership map the supervisor broadcast."""
         with self._lock:
             # one atomic claim: the blocking promotion body (thread
             # join, journal file I/O) must not run under a lock
@@ -1390,15 +1483,40 @@ class FleetRouter:
             if tail is not None:
                 tail.join(timeout=5)
                 self._tail_thread = None
-            if self._follower is not None:
+            followers = []
+            if self._followers is not None:
+                followers = list(self._followers)
+                self._followers = None
+            elif self._follower is not None:
+                followers = [self._follower]
+                self._follower = None
+            for follower in followers:
                 # final catch-up: the dead active's last flushed
                 # records land before the first request is admitted
                 try:
-                    for rec in self._follower.poll():
+                    for rec in follower.poll():
                         self._apply_journal_record(rec)
                 except Exception as e:  # noqa: BLE001
                     self._log("journal catch-up error: {}".format(e))
-                self._follower = None
+            if partition is not None and self._partition_count > 1:
+                partition = int(partition)
+                self._journal_dir = os.path.join(
+                    self._journal_base, "p{}".format(partition))
+                with self._lock:
+                    self._partition_index = partition
+                    if peers is not None:
+                        self._partition_map = [str(u) for u in peers]
+                    if epoch is not None:
+                        self._partition_epoch = max(
+                            self._partition_epoch, int(epoch))
+                    # shed tailed generations the surviving actives
+                    # own: their journals stay theirs (no drop record
+                    # is written — that would be a second writer)
+                    foreign = [
+                        gid for gid in self._gens
+                        if self._partition_of(gid) != partition]
+                    for gid in foreign:
+                        self._gens.pop(gid, None)
             self._open_journal_writer()
             with self._lock:
                 self._standby = False
@@ -1406,7 +1524,9 @@ class FleetRouter:
         finally:
             with self._lock:
                 self._promoting = False
-        self._log("standby promoted to active (takeover)")
+        self._log("standby promoted to active (takeover{})".format(
+            "" if partition is None
+            else ", partition {}".format(partition)))
         return True
 
     def begin_drain(self):
@@ -1859,6 +1979,98 @@ class FleetRouter:
             self._resumed += 1
         self._log("resume")
 
+    # -- horizontal partitioning (docs/resilience.md "Horizontal router
+    # tier"): stable gen-id ownership across N simultaneous actives ---------
+
+    @staticmethod
+    def partition_of(gen_id, count):
+        """The owning partition of a generation id: CRC32 over the
+        BARE id — a ``gen~offset`` handoff-epoch suffix is stripped so
+        every epoch of one generation hashes to the same owner."""
+        base, tilde, off = gen_id.rpartition("~")
+        if tilde and base and off.isdigit():
+            gen_id = base
+        return zlib.crc32(gen_id.encode("utf-8")) % count
+
+    def _partition_of(self, gen_id):
+        return self.partition_of(gen_id, self._partition_count)
+
+    def owns_generation(self, gen_id):
+        """``(owned, partition)`` for one generation id.  An
+        unpartitioned router owns everything."""
+        if self._partition_count <= 1:
+            return True, 0
+        part = self._partition_of(gen_id)
+        with self._lock:
+            return part == self._partition_index, part
+
+    def partition_peer(self, part):
+        """The owning peer's ``host:port`` for ``part`` per the
+        current map (None when the map holds no entry for it)."""
+        with self._lock:
+            if 0 <= part < len(self._partition_map):
+                return self._partition_map[part] or None
+        return None
+
+    def mint_generation_id(self):
+        """A fresh id that hashes into THIS router's partition, so an
+        admission landing here stays here (expected ~count draws; the
+        unpartitioned path is the plain uuid mint)."""
+        if self._partition_count <= 1:
+            return uuid.uuid4().hex
+        while True:
+            gid = uuid.uuid4().hex
+            owned, _ = self.owns_generation(gid)
+            if owned:
+                return gid
+
+    def partition_view(self):
+        """The partition surface of ``/router/stats`` and ``GET
+        /router/partition``: index/count/epoch, the url-by-partition
+        owner map, and the ownership counters."""
+        with self._lock:
+            return {
+                "index": self._partition_index,
+                "count": self._partition_count,
+                "epoch": self._partition_epoch,
+                "map": list(self._partition_map),
+                "owned": self._partition_owned,
+                "forwarded": self._partition_forwarded,
+                "moved": self._partition_moved,
+            }
+
+    def adopt_partition_map(self, new_map, epoch):
+        """Adopt a broadcast owner map when its epoch is NEWER (an
+        equal epoch is an idempotent re-broadcast; an older one is
+        stale and refused — the supervisor bumps the epoch on every
+        takeover rebind).  Returns the view after the call."""
+        epoch = int(epoch)
+        applied = False
+        with self._lock:
+            if epoch > self._partition_epoch:
+                old = self._partition_map
+                adopted = [str(u) for u in new_map]
+                moved = sum(
+                    1 for k in range(len(adopted))
+                    if k >= len(old) or old[k] != adopted[k])
+                self._partition_map = adopted
+                self._partition_epoch = epoch
+                self._partition_moved += moved
+                applied = True
+        if applied:
+            self._log("partition map epoch {} adopted".format(epoch))
+        return self.partition_view()
+
+    def count_partition_owned(self):
+        if self._partition_count <= 1:
+            return
+        with self._lock:
+            self._partition_owned += 1
+
+    def count_partition_forwarded(self):
+        with self._lock:
+            self._partition_forwarded += 1
+
     # -- generation registry -----------------------------------------------
 
     def _sweep_gens_locked(self, now):
@@ -1940,7 +2152,23 @@ class FleetRouter:
                 "takeovers": self._takeovers,
                 "standby": self._standby,
                 "draining": self._draining,
+                # horizontal front tier: this router's partition, the
+                # url-by-partition owner map, and the map epoch the
+                # clients' resume paths chase moved partitions with
+                "partition": {
+                    "index": self._partition_index,
+                    "count": self._partition_count,
+                    "owned": self._partition_owned,
+                    "forwarded": self._partition_forwarded,
+                    "moved": self._partition_moved,
+                },
+                "peers": list(self._partition_map),
+                "epoch": self._partition_epoch,
             }
+        relay = {"mode": self._relay_mode}
+        if self._relay_loop is not None:
+            relay.update(self._relay_loop.stats())
+        out["relay"] = relay
         journal = self._journal
         out["journal"] = journal.stats() if journal is not None else None
         out["disagg"] = self.disagg.stats()
@@ -1977,6 +2205,13 @@ class FleetRouter:
             ("tpu_router_recovered_generations_total",
              [({}, snap["recovered_generations"])]),
             ("tpu_router_takeovers_total", [({}, snap["takeovers"])]),
+            ("tpu_router_partition_owned_total",
+             [({}, snap["partition"]["owned"])]),
+            ("tpu_router_partition_forwarded_total",
+             [({}, snap["partition"]["forwarded"])]),
+            ("tpu_router_partition_moved_total",
+             [({}, snap["partition"]["moved"])]),
+            ("tpu_router_partition_epoch", [({}, snap["epoch"])]),
         ]
         journal = snap.get("journal")
         if isinstance(journal, dict):
@@ -2466,9 +2701,101 @@ class FleetRouter:
             {"error": "router: no replica reachable"}).encode("utf-8"))
 
 
+class _DetachedRelay:
+    """The per-stream adapter between :class:`SseRelayLoop` and the
+    router's generation bookkeeping — the event-loop mirror of
+    ``_RouterHandler._relay_events``.  ``on_line``/``on_upstream_end``
+    run on the relay loop's single thread; everything they touch
+    (``record_event``, ``complete``, ``drop_generation``) is its own
+    lock-protected state, and the journal append stays enqueue-only.
+
+    On upstream EOF without a terminal event ("died" in the threaded
+    relay) the loop closes the client abruptly: the client's
+    auto-resume reconnects, and the RESUME path — a short-lived
+    handler thread — performs the replay splice / cross-replica
+    handoff before detaching again.  ``on_closed`` settles the
+    accounting the detaching handler deferred: the replica's in-flight
+    slot, the generation's serving slot, and the router's own
+    inflight gauge."""
+
+    __slots__ = ("_router", "_gen", "_rep", "_on_first")
+
+    def __init__(self, router, gen, rep, on_first):
+        self._router = router
+        self._gen = gen
+        self._rep = rep
+        self._on_first = on_first
+
+    def on_line(self, line):
+        if not line.startswith(b"data: "):
+            # id lines are rebuilt from the payload's seq
+            return ("continue", [])
+        try:
+            payload = json.loads(line[len(b"data: "):])
+        except ValueError:
+            return ("continue", [])
+        if payload.get("final"):
+            self._gen.complete()
+            return ("final", [b'data: {"final": true}\n\n'])
+        if "error" in payload:
+            # a typed in-band failure is terminal fleet-wide
+            self._router.drop_generation(self._gen.gen_id)
+            return ("error", [b"data: " + json.dumps(
+                payload).encode("utf-8") + b"\n\n"])
+        if self._on_first is not None:
+            self._on_first()
+            self._on_first = None
+        backend_seq = (payload.get("parameters") or {}).get("seq")
+        if backend_seq is None:
+            # non-resumable upstream: pure passthrough, no replay
+            self._gen.mark_unresumable()
+            return ("continue", [b"data: " + json.dumps(
+                payload).encode("utf-8") + b"\n\n"])
+        seq, block = self._gen.record_event(backend_seq, payload)
+        if seq is None:
+            return ("continue", [])  # upstream replayed an acked event
+        return ("continue", [block])
+
+    def on_upstream_end(self):
+        pass  # the client's reconnect drives the handoff, see above
+
+    def on_closed(self, reason):
+        self._rep.end_request()
+        self._gen.release()
+        self._router.exit_inflight()
+
+
 class _RouterServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
+    # a horizontal tier takes connection bursts (10k-stream benches,
+    # whole-partition reconnect storms after a sibling's death): the
+    # stock backlog of 5 turns those into dial timeouts
+    request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._detached_lock = threading.Lock()
+        # requests whose sockets an SseRelayLoop adopted (via a dup):
+        # shutdown_request must NOT half-close these — a
+        # shutdown(SHUT_WR) on the original socket applies to the
+        # dup'd one too and would sever the live detached stream
+        self._detached = set()  # guarded-by: _detached_lock
+
+    def detach_request(self, request):
+        with self._detached_lock:
+            self._detached.add(request)
+
+    def shutdown_request(self, request):
+        with self._detached_lock:
+            detached = request in self._detached
+            self._detached.discard(request)
+        if detached:
+            # close only this server's fd; the relay loop's dup keeps
+            # the connection itself alive
+            self.close_request(request)
+        else:
+            super().shutdown_request(request)
 
 
 class _RouterHandler(BaseHttpHandler):
@@ -2530,13 +2857,30 @@ class _RouterHandler(BaseHttpHandler):
             return self._send_json(router.stats())
         if path == "/router/replicas":
             return self._route_replicas_admin(method)
+        if path == "/router/partition":
+            return self._route_partition_admin(method)
         if path == "/router/promote":
             # the takeover signal: a standby turns active (final
-            # journal catch-up included); idempotent on an active
+            # journal catch-up included); idempotent on an active.
+            # On a partitioned tier the body names the dead active's
+            # partition (+ the rebound owner map and its epoch) the
+            # standby is promoted INTO.
             if method != "POST":
                 return self._send_error_json(
                     "/router/promote supports POST only", 400)
-            promoted = router.promote()
+            try:
+                request = json.loads(self._read_body() or b"{}")
+            except ValueError:
+                request = {}
+            kwargs = {}
+            if isinstance(request, dict):
+                if request.get("partition") is not None:
+                    kwargs["partition"] = int(request["partition"])
+                if request.get("peers") is not None:
+                    kwargs["peers"] = list(request["peers"])
+                if request.get("epoch") is not None:
+                    kwargs["epoch"] = int(request["epoch"])
+            promoted = router.promote(**kwargs)
             return self._send_json({
                 "promoted": promoted,
                 "standby": router.rejecting() == "standby",
@@ -2581,7 +2925,10 @@ class _RouterHandler(BaseHttpHandler):
             }.get("content-type", "application/json")
             return self._send(status, resp_body, relay, content_type)
         finally:
-            router.exit_inflight()
+            if not self._detached:
+                # a detached stream's inflight slot is released by the
+                # relay adapter's on_closed, not this handler thread
+                router.exit_inflight()
 
     # -- membership admin surface ------------------------------------------
 
@@ -2619,6 +2966,35 @@ class _RouterHandler(BaseHttpHandler):
             return self._send_error_json(str(msg), 400)
         return self._send_json({"replicas": router.membership()})
 
+    def _route_partition_admin(self, method):
+        """``/router/partition``: GET returns this router's partition
+        view (index/count/epoch + the url-by-partition owner map and
+        ownership counters); POST ``{"action": "set_map", "map":
+        [...], "epoch": N}`` adopts a supervisor-broadcast map when
+        the epoch is newer — the rebind that repoints a dead active's
+        partition at its promoted standby on every router at once."""
+        router = self.router
+        if method == "GET":
+            return self._send_json(router.partition_view())
+        if method != "POST":
+            return self._send_error_json(
+                "/router/partition supports GET and POST only", 400)
+        try:
+            request = json.loads(self._read_body() or b"{}")
+            action = request.get("action")
+            new_map = request.get("map")
+            epoch = request.get("epoch")
+        except (ValueError, AttributeError):
+            return self._send_error_json(
+                "malformed /router/partition request: JSON object with "
+                "'action', 'map' and 'epoch' required", 400)
+        if (action != "set_map" or not isinstance(new_map, list)
+                or not isinstance(epoch, int)):
+            return self._send_error_json(
+                "bad partition request: action must be 'set_map' with "
+                "a 'map' list and an integer 'epoch'", 400)
+        return self._send_json(router.adopt_partition_map(new_map, epoch))
+
     # -- streaming: sticky resume + cross-replica handoff ------------------
 
     def _route_generate_stream(self, path):
@@ -2644,6 +3020,12 @@ class _RouterHandler(BaseHttpHandler):
             resume_id = str(parameters["resume_generation_id"])
             resume_from = _coerce_int(parameters.get("resume_from_seq"), 0)
         if resume_id is not None:
+            owned, part = router.owns_generation(resume_id)
+            if not owned:
+                # a sibling's partition: the thin peer hop — a plain
+                # client pointed at ANY active still lands correctly
+                return self._forward_to_partition(path, part, request_json)
+            router.count_partition_owned()
             gen = router.lookup_generation(resume_id)
             handoff_marked = False
             if gen is None:
@@ -2683,7 +3065,18 @@ class _RouterHandler(BaseHttpHandler):
                 return self._resume_passthrough(path, resume_id, resume_from)
             router.count_resume()
             return self._serve_resume(gen, resume_from)
-        gen_id = str(parameters.get("generation_id") or uuid.uuid4().hex)
+        explicit_id = parameters.get("generation_id")
+        if explicit_id:
+            gen_id = str(explicit_id)
+            owned, part = router.owns_generation(gen_id)
+            if not owned:
+                # the client pinned an id that hashes to a sibling's
+                # partition: the same thin hop as a resume
+                return self._forward_to_partition(path, part, request_json)
+        else:
+            # router-minted ids hash home by construction
+            gen_id = router.mint_generation_id()
+        router.count_partition_owned()
         gen = _Generation(gen_id, path, request_json)
         if not router.register_generation(gen, if_absent=True):
             # the id names a live or parked generation: a fresh
@@ -2716,7 +3109,10 @@ class _RouterHandler(BaseHttpHandler):
         try:
             return self._run_generation(gen, resuming=False)
         finally:
-            gen.release()
+            if not self._detached:
+                # a detached stream's serving slot is released by the
+                # relay adapter's on_closed, not this handler thread
+                gen.release()
 
     def _serve_resume(self, gen, from_seq):
         """Sticky resume: replay the client's gap from the router's own
@@ -2775,7 +3171,8 @@ class _RouterHandler(BaseHttpHandler):
                 return
             return self._run_generation(gen, resuming=True)
         finally:
-            gen.release()
+            if not self._detached:
+                gen.release()
 
     def _run_generation(self, gen, resuming):
         """Drive one generation to its terminal event, failing over
@@ -2938,14 +3335,23 @@ class _RouterHandler(BaseHttpHandler):
                     on_first = (_note_ttft if ttft_fresh
                                 else release_export)
                     release_export = None  # one-shot
-                    outcome = self._relay_events(gen, resp, on_first)
+                    if router._relay_loop is not None:
+                        outcome = self._detach_relay(
+                            gen, rep, conn, resp, on_first)
+                    if outcome is None:
+                        outcome = self._relay_events(gen, resp, on_first)
             except (ConnectionError, socket.timeout, OSError,
                     http.client.HTTPException):
                 outcome = "died"
             finally:
-                rep.end_request()
-                if conn is not None:
-                    conn.close()
+                if outcome != "detached":
+                    rep.end_request()
+                    if conn is not None:
+                        conn.close()
+            if outcome == "detached":
+                # the selector relay owns the stream (and the deferred
+                # generation/replica/inflight accounting) from here
+                return
             if outcome == "final":
                 gen.complete()
                 self._ensure_started()
@@ -3083,6 +3489,157 @@ class _RouterHandler(BaseHttpHandler):
             self._ensure_started()
             self._send_chunk(block)
         return "died"
+
+    def _forward_to_partition(self, path, part, request_json):
+        """The thin peer hop: a generate-stream request that hashes to
+        a sibling's partition relays raw through THIS router to its
+        owner, so a plain client pointed at ANY active lands
+        correctly.  An unreachable or unmapped owner (the takeover
+        window) answers a typed 503 carrying the partition and map
+        epoch — the client's reconnect rotation retries until the
+        supervisor's rebind lands the promoted standby in the map."""
+        router = self.router
+        peer = router.partition_peer(part)
+        epoch = router.partition_view()["epoch"]
+        if peer is None:
+            return self._send_json(
+                {"error": "partition {} has no live owner yet; "
+                          "retry".format(part),
+                 "partition": part, "owner": None, "epoch": epoch},
+                503, {"Retry-After": 1})
+        router.count_partition_forwarded()
+        host, _, port = peer.rpartition(":")
+        body = json.dumps(request_json).encode("utf-8")
+        headers = self._forward_headers()
+        headers["Content-Type"] = "application/json"
+        last_id = self.headers.get("last-event-id")
+        if last_id:
+            headers["Last-Event-ID"] = last_id
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                host, int(port), timeout=router._read_timeout_s)
+            conn.request("POST", path, body=body, headers=headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                # the owner's typed answer (404 resume-gone, 503
+                # takeover shed, ...) IS the fleet's answer: relay it
+                return self._send(
+                    resp.status, resp.read(),
+                    _relay_headers(dict(resp.headers)))
+            for raw in resp:
+                line = raw.rstrip(b"\r\n")
+                if not (line.startswith(b"id: ")
+                        or line.startswith(b"data: ")):
+                    continue
+                self._ensure_started()
+                self._send_chunk(line + b"\n\n"
+                                 if line.startswith(b"data: ")
+                                 else line + b"\n")
+            if self._started:
+                self._end_chunks()
+            else:
+                self._send_error_json(
+                    "partition {} owner {} produced no events".format(
+                        part, peer), 502)
+        except (ConnectionError, socket.timeout, OSError,
+                http.client.HTTPException):
+            if self._started:
+                raise _ClientGone()  # mid-hop loss: the client retries
+            return self._send_json(
+                {"error": "partition {} owner {} is unreachable; "
+                          "retry".format(part, peer),
+                 "partition": part, "owner": peer, "epoch": epoch},
+                503, {"Retry-After": 1})
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def _detach_relay(self, gen, rep, conn, resp, on_first):
+        """Hand an established upstream stream to the router's
+        selector relay loop: the handler thread returns once the
+        response headers are on the wire, and one event-loop thread
+        multiplexes the token relay for every detached stream (the
+        thread-per-connection ceiling, retired).  Returns
+        ``"detached"``, or None to fall back to the threaded relay."""
+        router = self.router
+        loop = router._relay_loop
+        if loop is None:
+            return None
+        upstream = getattr(conn, "sock", None)
+        dup = None
+        drain = upstream
+        if upstream is None:
+            # read-until-close framing (no Content-Length, not
+            # chunked): http.client hands the connection to the
+            # response (``will_close``) and drops ``conn.sock`` inside
+            # getresponse().  The fd is still open — the response's
+            # makefile holds the last io-ref — so adopt a dup: the
+            # relay loop owns an independent socket object and the
+            # response's eventual GC close cannot sever the stream.
+            raw = getattr(getattr(resp, "fp", None), "raw", None)
+            base = getattr(raw, "_sock", None)
+            if base is None:
+                return None
+            try:
+                upstream = socket.socket(
+                    base.family, base.type, base.proto,
+                    fileno=os.dup(base.fileno()))
+            except OSError:
+                return None
+            dup = upstream
+            drain = base
+        # pull the body bytes http.client buffered past the response
+        # headers — they belong to the relay stream, not the (about to
+        # be neutralized) HTTPResponse.  The non-blocking flip must
+        # land on the socket OBJECT the response reads through
+        # (``drain``): Python-level timeouts live on the object, not
+        # the fd, so flipping only a dup would leave ``read1`` parked
+        # in its 10-minute read timeout until the upstream closes.
+        saved_timeout = drain.gettimeout()
+        drain.setblocking(False)
+        leftover = []
+        try:
+            while True:
+                piece = resp.fp.read1(65536)
+                if not piece:
+                    break
+                leftover.append(piece)
+        except (BlockingIOError, InterruptedError):
+            pass
+        except (ValueError, OSError):
+            drain.settimeout(saved_timeout)
+            if dup is not None:
+                try:
+                    dup.close()
+                except OSError:
+                    pass
+            return None
+        self._ensure_started()
+        client = self._detach_socket()
+        stream = RelayStream(
+            upstream, client, _DetachedRelay(router, gen, rep, on_first),
+            leftover=b"".join(leftover),
+            chunked_in=bool(getattr(resp, "chunked", True)),
+            chunked_out=self._chunked_ok)
+        # neutralize http.client's ownership of the detached fd:
+        # conn.close()/garbage collection must not close a live socket
+        conn.sock = None
+        resp.fp = None
+        self.server.detach_request(self.connection)
+        try:
+            loop.adopt(stream)
+        except RuntimeError:
+            # loop already stopped (router shutdown): the stream dies
+            # with this handler — restore the deferred accounting path
+            self._detached = False
+            for sock in (upstream, client):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            raise _ClientGone()
+        return "detached"
 
     def _resume_passthrough(self, path, resume_id, resume_from):
         """Resume of a generation the router does not hold: one of the

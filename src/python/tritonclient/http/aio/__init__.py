@@ -45,6 +45,12 @@ class InferenceServerClient:
         self._retry_policy = retry_policy
         scheme = "https" if ssl else "http"
         self._base_url = "{}://{}".format(scheme, url)
+        # generate_stream dials absolute URLs (the primary plus each
+        # fallback router) so it cannot ride the base_url session;
+        # keep the pieces it needs to build per-target sessions
+        self._scheme = scheme
+        self._netloc = url
+        self._stream_ssl = ssl_context if ssl else False
         self._verbose = verbose
         timeout = aiohttp.ClientTimeout(
             connect=conn_timeout, total=network_timeout
@@ -458,3 +464,229 @@ class InferenceServerClient:
             self._verbose,
             int(header_length) if header_length is not None else None,
         )
+
+    async def generate_stream(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        parameters=None,
+        request_id="",
+        headers=None,
+        resume=True,
+        max_reconnects=5,
+        reconnect_backoff_s=0.05,
+        read_timeout=600.0,
+        on_reconnect=None,
+        fallback_urls=None,
+    ):
+        """Stream a decoupled generation over ``/generate_stream`` SSE —
+        the asyncio twin of the sync client's ``generate_stream``
+        (``async for event in client.generate_stream(...)``), with the
+        same resume contract: a connection dropped *mid-generation*
+        re-POSTs the body with ``Last-Event-ID`` and splices the
+        replayed continuation, each reconnect rotating through the
+        primary plus ``fallback_urls`` (``host:port`` peers — a warm
+        standby, the sibling actives of a partitioned router tier).
+        404 on a RESUME and 429/503 before the terminal event ride the
+        reconnect path; a first-request 404 and in-band
+        ``{"error": ...}`` events stay terminal.  ``on_reconnect``
+        may be a plain callable or a coroutine function."""
+        import json
+
+        import numpy as np
+
+        from tritonclient.utils import np_to_triton_dtype
+
+        def _input_json(name, arr):
+            if isinstance(arr, dict) and "shared_memory_region" in arr:
+                return {
+                    "name": name,
+                    "shape": list(arr["shape"]),
+                    "datatype": arr["datatype"],
+                    "parameters": {
+                        "shared_memory_region":
+                            arr["shared_memory_region"],
+                        "shared_memory_byte_size":
+                            arr["shared_memory_byte_size"],
+                        "shared_memory_offset":
+                            arr.get("shared_memory_offset", 0),
+                    },
+                }
+            return {
+                "name": name,
+                "shape": list(np.asarray(arr).shape),
+                "datatype": ("BYTES"
+                             if np.asarray(arr).dtype == np.object_
+                             else np_to_triton_dtype(
+                                 np.asarray(arr).dtype)),
+                "data": [
+                    v.decode("utf-8") if isinstance(v, bytes) else v
+                    for v in np.asarray(arr).reshape(-1).tolist()
+                ],
+            }
+
+        body_json = {
+            "inputs": [
+                _input_json(name, arr) for name, arr in inputs.items()
+            ],
+        }
+        if request_id:
+            body_json["id"] = request_id
+        if parameters:
+            body_json["parameters"] = dict(parameters)
+        body = json.dumps(body_json)
+        uri = "/v2/models/{}{}/generate_stream".format(
+            quote(model_name),
+            "/versions/{}".format(model_version) if model_version else "",
+        )
+
+        # reconnect target rotation, validated up front exactly like the
+        # sync helper: a malformed entry silently dropped would degrade
+        # the supposed HA rotation to no-failover with no signal
+        targets = [self._netloc]
+        for fb in fallback_urls or ():
+            fb_host, sep, fb_port = str(fb).rpartition(":")
+            if not (sep and fb_host and fb_port.isdigit()):
+                raise InferenceServerException(
+                    "fallback_urls entries must be host:port strings "
+                    "(got {!r})".format(fb))
+            targets.append("{}:{}".format(fb_host, int(fb_port)))
+
+        def _error_message(raw):
+            try:
+                return json.loads(raw)["error"]
+            except Exception:
+                return raw.decode("utf-8", errors="replace")
+
+        # a dedicated no-base_url session: generate_stream dials a
+        # different host per attempt, which the base_url session rejects
+        timeout = aiohttp.ClientTimeout(
+            total=None, sock_connect=read_timeout, sock_read=read_timeout)
+        session = aiohttp.ClientSession(timeout=timeout)
+        last_event_id = None
+        last_seq = -1
+        yielded_any = False
+        attempt = 0
+        try:
+            while True:
+                target = targets[attempt % len(targets)]
+                dropped = None
+                resp = None
+                try:
+                    hdrs = dict(headers) if headers else {}
+                    hdrs["Content-Type"] = "application/json"
+                    if last_event_id is not None:
+                        hdrs["Last-Event-ID"] = last_event_id
+                    try:
+                        resp = await session.post(
+                            "{}://{}{}".format(
+                                self._scheme, target, uri),
+                            data=body, headers=hdrs,
+                            ssl=self._stream_ssl)
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        dropped = e
+                        resp = None
+                    if resp is not None:
+                        transition = (
+                            resp.status == 404
+                            and last_event_id is not None
+                        ) or (
+                            resp.status in (429, 503)
+                            and (last_event_id is not None
+                                 or not yielded_any)
+                        )
+                        if transition:
+                            # same classification as the sync helper: a
+                            # RESUME 404 or a typed overload is a fleet
+                            # transition (router restart, standby not
+                            # yet promoted, momentary saturation), not
+                            # a verdict — ride the reconnect path
+                            reason = (
+                                "resume target does not know generation"
+                                if resp.status == 404
+                                else "generation target is overloaded "
+                                     "or standby")
+                            raw = await resp.read()
+                            dropped = InferenceServerException(
+                                "{}: {}".format(
+                                    reason, _error_message(raw)),
+                                status=str(resp.status),
+                            )
+                            resp.close()
+                            resp = None
+                        elif resp.status != 200:
+                            raw = await resp.read()
+                            raise InferenceServerException(
+                                "generate_stream failed: {}".format(
+                                    _error_message(raw)),
+                                status=str(resp.status),
+                            )
+                    if resp is not None:
+                        event_id = None
+                        try:
+                            async for line in resp.content:
+                                line = line.strip()
+                                if line.startswith(b"id: "):
+                                    event_id = line[4:].decode(
+                                        "utf-8", errors="replace")
+                                    continue
+                                if not line.startswith(b"data: "):
+                                    continue
+                                event = json.loads(line[len(b"data: "):])
+                                if "error" in event:
+                                    # typed server failure: terminal,
+                                    # never ridden out by reconnecting
+                                    raise InferenceServerException(
+                                        event["error"])
+                                if event.get("final"):
+                                    return  # in-band end
+                                seq = (event.get("parameters")
+                                       or {}).get("seq")
+                                if seq is not None and seq <= last_seq:
+                                    event_id = None
+                                    continue  # replayed duplicate
+                                if seq is not None:
+                                    last_seq = seq
+                                if event_id is not None:
+                                    last_event_id = event_id
+                                    event_id = None
+                                yielded_any = True
+                                yield event
+                            # stream ended WITHOUT the in-band terminal
+                            # event: a mid-generation connection drop
+                            dropped = ConnectionError(
+                                "stream ended without terminal event")
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError, OSError) as e:
+                            dropped = e
+                finally:
+                    if resp is not None:
+                        resp.close()
+                # reconnect path, same guard as the sync helper: resume
+                # only when the server issued SSE ids OR nothing was
+                # delivered yet (a fresh re-send cannot duplicate)
+                attempt += 1
+                if (not resume or attempt > max_reconnects
+                        or (yielded_any and last_event_id is None)):
+                    reason = (
+                        " (resume disabled)" if not resume
+                        else " (generation is not resumable: the server"
+                             " sent no event ids)"
+                        if yielded_any and last_event_id is None
+                        else ""
+                    )
+                    if isinstance(dropped, InferenceServerException):
+                        raise dropped
+                    raise InferenceServerException(
+                        "generate_stream connection lost{}: {}".format(
+                            reason, dropped))
+                if on_reconnect is not None:
+                    maybe = on_reconnect(attempt, dropped)
+                    if asyncio.iscoroutine(maybe):
+                        await maybe
+                await asyncio.sleep(
+                    min(reconnect_backoff_s * (2 ** (attempt - 1)), 2.0))
+        finally:
+            await session.close()

@@ -108,6 +108,162 @@ def test_http_aio_error(http_url):
     asyncio.run(run())
 
 
+# -- http.aio generate_stream (same resume contract as the sync client) -----
+
+
+import json as _json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from fleet_stub import free_port, wait_ready  # noqa: E402
+
+STUB = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fleet_stub.py")
+PROMPT = [5, 7, 9]
+
+
+def _stub_tokens(prompt, n):
+    """The stub's deterministic autoregressive chain (fleet_stub
+    next_token), recomputed client-side as the reference stream."""
+    fed = list(prompt)
+    out = []
+    for _ in range(n):
+        token = (sum(fed) * 31 + len(fed) * len(fed) * 7 + 13) % 101
+        fed.append(token)
+        out.append(token)
+    return out
+
+
+@pytest.fixture()
+def stub_replica():
+    port = free_port()
+    proc = subprocess.Popen([sys.executable, STUB, "--port", str(port)])
+    assert wait_ready(port), "stub replica never became ready"
+    yield port
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def _stub_state(port, update):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("POST", "/stub/state",
+                     _json.dumps(update).encode("utf-8"),
+                     {"Content-Type": "application/json"})
+        assert conn.getresponse().status == 200
+    finally:
+        conn.close()
+
+
+async def _collect(stream):
+    tokens, seqs = [], []
+    async for event in stream:
+        for out in event.get("outputs", []):
+            if out["name"] == "TOKEN":
+                tokens.append(int(out["data"][0]))
+        params = event.get("parameters") or {}
+        if "seq" in params:
+            seqs.append(params["seq"])
+    return tokens, seqs
+
+
+def test_http_aio_generate_stream_basic(stub_replica):
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        url = "127.0.0.1:{}".format(stub_replica)
+        async with aioclient.InferenceServerClient(url) as c:
+            tokens, seqs = await _collect(c.generate_stream(
+                "stub",
+                {"PROMPT_IDS": np.array(PROMPT, np.int32),
+                 "MAX_TOKENS": np.array([8], np.int32)},
+                parameters={"generation_id": "aio-basic"}))
+            assert tokens == _stub_tokens(PROMPT, 8)
+            assert seqs == list(range(8))
+
+    asyncio.run(run())
+
+
+def test_http_aio_generate_stream_resumes_after_sever(stub_replica):
+    """A mid-stream connection drop (no terminal event) reconnects
+    with Last-Event-ID and splices the continuation — token-identical
+    and gap-free, with on_reconnect observing the resume."""
+    import tritonclient.http.aio as aioclient
+
+    _stub_state(stub_replica, {"sever_streams": 1})
+    reconnects = []
+
+    async def run():
+        url = "127.0.0.1:{}".format(stub_replica)
+        async with aioclient.InferenceServerClient(url) as c:
+            tokens, seqs = await _collect(c.generate_stream(
+                "stub",
+                {"PROMPT_IDS": np.array(PROMPT, np.int32),
+                 "MAX_TOKENS": np.array([10], np.int32)},
+                parameters={"generation_id": "aio-sever",
+                            "token_delay_ms": 10},
+                max_reconnects=5, reconnect_backoff_s=0.01,
+                on_reconnect=lambda n, exc: reconnects.append(n)))
+            assert tokens == _stub_tokens(PROMPT, 10)
+            assert seqs == list(range(10))
+
+    asyncio.run(run())
+    assert len(reconnects) >= 1
+
+
+def test_http_aio_generate_stream_fallback_urls_rotate(stub_replica):
+    """A dead primary (connect-refused) rotates the dial to the
+    fallback url, exactly like the sync helper; malformed fallback
+    entries raise the typed validation error up front."""
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        dead = free_port()  # nothing listens here
+        async with aioclient.InferenceServerClient(
+                "127.0.0.1:{}".format(dead)) as c:
+            tokens, seqs = await _collect(c.generate_stream(
+                "stub",
+                {"PROMPT_IDS": np.array(PROMPT, np.int32),
+                 "MAX_TOKENS": np.array([6], np.int32)},
+                fallback_urls=[
+                    "127.0.0.1:{}".format(stub_replica)],
+                max_reconnects=4, reconnect_backoff_s=0.01))
+            assert tokens == _stub_tokens(PROMPT, 6)
+            assert seqs == list(range(6))
+            with pytest.raises(InferenceServerException,
+                               match="host:port"):
+                await _collect(c.generate_stream(
+                    "stub",
+                    {"PROMPT_IDS": np.array(PROMPT, np.int32),
+                     "MAX_TOKENS": np.array([2], np.int32)},
+                    fallback_urls=["not-a-url"]))
+
+    asyncio.run(run())
+
+
+def test_http_aio_generate_stream_first_404_is_terminal(stub_replica):
+    """A 404 on the FIRST request (the model genuinely is not there)
+    stays terminal — only a RESUME 404 rides the reconnect path."""
+    import tritonclient.http.aio as aioclient
+
+    async def run():
+        url = "127.0.0.1:{}".format(stub_replica)
+        async with aioclient.InferenceServerClient(url) as c:
+            with pytest.raises(InferenceServerException) as excinfo:
+                await _collect(c.generate_stream(
+                    "not_a_model",
+                    {"PROMPT_IDS": np.array(PROMPT, np.int32),
+                     "MAX_TOKENS": np.array([2], np.int32)},
+                    max_reconnects=2, reconnect_backoff_s=0.01))
+            assert excinfo.value.status() == "404"
+
+    asyncio.run(run())
+
+
 # -- aio retry policies (same classification as the sync clients) -----------
 
 

@@ -308,11 +308,11 @@ def test_r8_protocol_parity_fixture():
     router-vs-frontend divergence cases the real tree must never
     grow."""
     findings = _lint_fixture("r8", "R8").new
-    assert len(findings) == 19
+    assert len(findings) == 20
     router = [f for f in findings if f.path.endswith("r8/router.py")]
     grpc = [f for f in findings if f.path.endswith("r8/grpc_frontend.py")]
     http = [f for f in findings if f.path.endswith("r8/http_frontend.py")]
-    assert len(router) == 16 and len(grpc) == 2 and len(http) == 1
+    assert len(router) == 17 and len(grpc) == 2 and len(http) == 1
     # surface-level router findings anchor at the route table
     assert all(f.lineno == 5 for f in router + http)
     msgs = sorted(f.message for f in router)
@@ -335,9 +335,13 @@ def test_r8_protocol_parity_fixture():
     assert sum("terminal SSE event" in m for m in msgs) == 1
     assert sum("resume-grammar key" in m for m in msgs) == 2
     assert sum("'Last-Event-ID'" in m for m in msgs) == 1
-    # the router's own admin surface: /router/stats unserved, and the
-    # served membership route references neither add nor remove
+    # the router's own admin surface: /router/stats and
+    # /router/partition (the horizontal tier's map/epoch surface)
+    # unserved, and the served membership route references neither add
+    # nor remove
     assert sum("declared admin route '/router/stats'" in m
+               for m in msgs) == 1
+    assert sum("declared admin route '/router/partition'" in m
                for m in msgs) == 1
     assert sum("membership action" in m for m in msgs) == 2
     assert sum("checkpoint" in m for m in msgs) == 1  # producer key

@@ -10,7 +10,8 @@ class _RouterHandler:
             if path == "/router/replicas":
                 # admin drift: the membership route is served but
                 # neither 'add' nor 'remove' is ever referenced; and
-                # '/router/stats' is not served at all
+                # neither '/router/stats' nor '/router/partition' (the
+                # horizontal tier's map/epoch surface) is served at all
                 return self._relay()
             # route drift: health/live + health/stats unserved;
             # stream drift: no generate_stream surface

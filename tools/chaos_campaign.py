@@ -57,8 +57,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: with chaos_smoke --shm and the faults.py unit tier)
 INJECTABLE = (
     "replica_sigkill", "prefill_sigkill", "supervisor_sigkill",
-    "router_sigkill", "router_sigterm", "gray_slow", "gray_jitter",
-    "stream_sever", "partition",
+    "router_sigkill", "router_sigterm", "active_router_sigkill",
+    "gray_slow", "gray_jitter", "stream_sever", "partition",
 )
 
 DEFAULT_FAULTS = "prefill_sigkill,gray_slow,stream_sever"
@@ -66,7 +66,8 @@ DEFAULT_FAULTS = "prefill_sigkill,gray_slow,stream_sever"
 #: kinds that target the router tier: each one fired lands as exactly
 #: one standby promotion, which is what the per-cycle takeover settle
 #: waits for before the recording metrics scrape
-ROUTER_FAULTS = ("router_sigkill", "router_sigterm")
+ROUTER_FAULTS = ("router_sigkill", "router_sigterm",
+                 "active_router_sigkill")
 
 PROMPT = [5, 7, 9, 2, 4]
 
@@ -121,7 +122,8 @@ def build_parser():
 # -- fleet ------------------------------------------------------------------
 
 
-def start_fleet(cycles, manifest_dir=None, spec_tokens=0):
+def start_fleet(cycles, manifest_dir=None, spec_tokens=0,
+                active_routers=1):
     """The campaign target: a role-split stub fleet (1 prefill + 1
     decode) supervised together with an active+standby router pair
     sharing one crash journal — every tier a scheduled fault can hit
@@ -130,7 +132,10 @@ def start_fleet(cycles, manifest_dir=None, spec_tokens=0):
     a successor built from the SAME manifest adopts the fleet.
     ``spec_tokens`` turns on the replicas' stub speculative-decoding
     twin — burst emission must survive every scheduled fault with the
-    identical token streams."""
+    identical token streams.  ``active_routers=2`` (scheduled
+    automatically when ``active_router_sigkill`` is in the mix) runs
+    the PARTITIONED front tier — two actives with per-partition
+    journal subdirectories plus the standby."""
     from tpuserver.fleet import FleetSupervisor
 
     stub = os.path.join(REPO, "tests", "fleet_stub.py")
@@ -151,6 +156,7 @@ def start_fleet(cycles, manifest_dir=None, spec_tokens=0):
         max_restarts=2 * cycles + 6, restart_window_s=3600.0,
         restart_backoff_s=0.05, scope_prefix="campaign-stub-",
         router_command=router_command, router_standby=True,
+        active_routers=active_routers,
         env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
         manifest_dir=manifest_dir,
     ).start()
@@ -302,6 +308,30 @@ class FleetInjectors:
 
     def router_sigterm(self, entry):
         self._kill_router(signal.SIGTERM, "SIGTERM")
+
+    def active_router_sigkill(self, entry):
+        """SIGKILL one ACTIVE of the PARTITIONED tier (scheduling this
+        kind makes :func:`start_fleet` run ``active_routers=2``): the
+        entry's pick draws the victim partition deterministically; the
+        standby must promote INTO the dead active's partition while
+        ``journal_single_writer`` keeps holding per partition."""
+        deadline = time.monotonic() + 5.0
+        while True:
+            rows = [r for r in
+                    self.supervisor.stats().get("routers", [])
+                    if r["role"] == "active" and r["state"] == "up"
+                    and r.get("pid") and r.get("partition") is not None]
+            if rows:
+                victim = rows[entry.pick % len(rows)]
+                try:
+                    os.kill(victim["pid"], signal.SIGKILL)
+                    return
+                except ProcessLookupError:
+                    pass  # stats lag: re-resolve a fresher victim
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "no live partitioned active router to SIGKILL")
+            time.sleep(0.05)
 
     def _gray(self, entry, key):
         def act(replica):
@@ -474,8 +504,11 @@ def run_campaign(args, schedule):
     manifest_dir = None
     if "supervisor_sigkill" in schedule.kinds:
         manifest_dir = tempfile.mkdtemp(prefix="campaign-manifest-")
-    supervisor = start_fleet(args.cycles, manifest_dir=manifest_dir,
-                             spec_tokens=args.spec_tokens)
+    supervisor = start_fleet(
+        args.cycles, manifest_dir=manifest_dir,
+        spec_tokens=args.spec_tokens,
+        active_routers=(2 if "active_router_sigkill" in schedule.kinds
+                        else 1))
     injectors = FleetInjectors(supervisor, manifest_dir=manifest_dir)
     runner = chaoslib.CampaignRunner(
         schedule, injectors.registry(), recorder)
@@ -631,8 +664,10 @@ def run_proof(args, schedule):
               file=sys.stderr, flush=True)
 
     recorder = chaoslib.InvariantRecorder(sink)
-    supervisor = start_fleet(args.cycles,
-                             spec_tokens=args.spec_tokens)
+    supervisor = start_fleet(
+        args.cycles, spec_tokens=args.spec_tokens,
+        active_routers=(2 if "active_router_sigkill" in schedule.kinds
+                        else 1))
     injectors = FleetInjectors(supervisor)
     runner = chaoslib.CampaignRunner(
         schedule, injectors.registry(), recorder)

@@ -1518,6 +1518,330 @@ def router_kill_phase(cycles, soak, budget):
         supervisor.stop()
 
 
+def multi_router_phase(cycles, soak, budget):
+    """``--multi-router``: the horizontal front tier (ISSUE 20).
+
+    A FleetSupervisor owns two stub replicas and a PARTITIONED front
+    tier: TWO active routers (partitions 0 and 1, each with its own
+    journal subdirectory and the selector SSE relay) plus one warm
+    standby tailing every partition.  Each cycle, clients pinned to
+    BOTH partitions stream slow generations while partition 0's active
+    is SIGKILLed mid-traffic.  Invariants:
+
+      1. ``partition_blast_radius``: partition-1 streams — dialed at
+         their owner on a single connection with NO fallback urls —
+         ride through the sibling's kill with zero reconnects and
+         gap-free seqs;
+      2. the standby promotes INTO partition 0 (``router_takeovers``
+         and the partition-map epoch both advance) and the killed
+         partition's streams resume token-identically inside the
+         reconnect budget;
+      3. ``journal_single_writer`` holds PER PARTITION throughout;
+      4. peer handoff: a stream pinned to partition 1 but dialed at
+         partition 0's owner relays through the thin proxy hop
+         token-identically (the owner's ``partition.forwarded``
+         counter moves).
+    """
+    import http.client
+    import json as _json
+    import signal
+
+    import tritonclient.http as httpclient
+
+    from tpuserver.fleet import FleetSupervisor
+    from tpuserver.router import FleetRouter
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub_path = os.path.join(repo, "tests", "fleet_stub.py")
+    command = [sys.executable, stub_path, "--port", "{port}",
+               "--scope", "{scope}"]
+    router_command = [
+        sys.executable, os.path.join(repo, "tools", "router.py"),
+        "--backends", "{backends}", "--port", "{port}",
+        "--journal", "{journal}", "--probe-interval", "0.1",
+    ]
+    supervisor = FleetSupervisor(
+        command, replicas=2, min_replicas=2, max_replicas=2,
+        probe_interval_s=0.1, probe_timeout_s=2.0,
+        start_timeout_s=60.0, drain_grace_s=5.0,
+        max_restarts=cycles + 4, restart_window_s=3600.0,
+        restart_backoff_s=0.05, scope_prefix="mr-stub-",
+        router_command=router_command, router_standby=True,
+        active_routers=2,
+        env={"PYTHONPATH": os.path.join(repo, "src", "python")},
+    ).start()
+
+    def routers_up(timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            routers = supervisor.stats().get("routers", [])
+            if routers and all(r["state"] == "up" for r in routers):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def router_stats(url):
+        host, _, port = url.rpartition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=5)
+        try:
+            conn.request("GET", "/router/stats")
+            resp = conn.getresponse()
+            if resp.status != 200:
+                return {}
+            return _json.loads(resp.read())
+        except (OSError, ValueError, http.client.HTTPException):
+            return {}
+        finally:
+            conn.close()
+
+    def pin_gid(part, tag):
+        """A generation id that hashes into ``part`` (brute-forced —
+        the partition function is pure, so the draw is deterministic
+        per tag)."""
+        n = 0
+        while True:
+            gid = "mr-{}-{}".format(tag, n)
+            if FleetRouter.partition_of(gid, 2) == part:
+                return gid
+            n += 1
+
+    prompt = np.array([5, 7, 9], dtype=np.int32)
+
+    def run_stream(client, gid, urls, reconnects, cycle, what,
+                   max_reconnects=10):
+        """One pinned stream; returns (tokens, seqs) or (None, None)
+        on a user-visible error (recorded).  ``reconnects`` is a
+        per-stream observation list the blast-radius check reads."""
+        tokens, seqs = [], []
+        count = [0]
+
+        def on_reconnect(attempt, dropped):
+            count[0] += 1
+
+        try:
+            for event in client.generate_stream(
+                    "stub",
+                    {"PROMPT_IDS": prompt,
+                     "MAX_TOKENS": np.array([budget], np.int32)},
+                    parameters={"token_delay_ms": 25,
+                                "generation_id": gid},
+                    fallback_urls=urls, max_reconnects=max_reconnects,
+                    on_reconnect=on_reconnect):
+                for out in event.get("outputs", []):
+                    if out["name"] == "TOKEN":
+                        tokens.append(int(out["data"][0]))
+                params = event.get("parameters") or {}
+                if "seq" in params:
+                    seqs.append(params["seq"])
+        except Exception as e:  # noqa: BLE001 — the invariant
+            fail("multi-router cycle {}: user-visible stream error "
+                 "({}: {}: {})".format(cycle, what, type(e).__name__, e))
+            return None, None
+        finally:
+            reconnects.append(count[0])
+        return tokens, seqs
+
+    try:
+        if not supervisor.wait_ready(timeout_s=60.0):
+            fail("multi-router: stub replicas never became ready")
+            return
+        if not routers_up():
+            fail("multi-router: router processes never came up")
+            return
+
+        def owner_urls():
+            pmap = supervisor.stats().get("partition_map") or []
+            if len(pmap) != 2 or not all(pmap):
+                fail("multi-router: partition map incomplete: "
+                     "{}".format(pmap))
+                return None
+            return pmap
+
+        pmap = owner_urls()
+        if pmap is None:
+            return
+        scratch = []
+        ref_client = httpclient.InferenceServerClient(pmap[0])
+        reference, _ = run_stream(
+            ref_client, pin_gid(0, "ref"), [pmap[1]], scratch, -1,
+            "reference")
+        ref_client.close()
+        if reference is None:
+            return
+        print("reference tokens: {}; {} partitioned-tier SIGKILL "
+              "cycles".format(reference, cycles), flush=True)
+
+        for cycle in range(cycles):
+            stats_before = supervisor.stats()
+            pmap = owner_urls()
+            if pmap is None:
+                return
+            all_urls = supervisor.router_urls()
+            epoch_before = (router_stats(pmap[1]) or {}).get("epoch", 0)
+
+            # (4) peer handoff, fault-free: pinned to partition 1,
+            # dialed at partition 0's owner — the thin proxy hop
+            fwd_before = (router_stats(pmap[0]).get("partition") or
+                          {}).get("forwarded", 0)
+            hop_client = httpclient.InferenceServerClient(pmap[0])
+            hop_scratch = []
+            tokens, seqs = run_stream(
+                hop_client,
+                pin_gid(1, "hop-c{}".format(cycle)),
+                [u for u in all_urls if u != pmap[0]],
+                hop_scratch, cycle, "peer-hop")
+            hop_client.close()
+            if tokens is not None:
+                chaoslib.check_token_identity(
+                    RECORDER, reference, tokens,
+                    context="multi-router cycle {}".format(cycle),
+                    message="multi-router cycle {}: peer-forwarded "
+                            "stream tokens diverged: {} != {}".format(
+                                cycle, tokens, reference))
+                chaoslib.check_seq_continuity(
+                    RECORDER, seqs, expected_len=budget,
+                    context="multi-router cycle {}".format(cycle))
+            fwd_after = (router_stats(pmap[0]).get("partition") or
+                         {}).get("forwarded", 0)
+            if not fwd_after > fwd_before:
+                fail("multi-router cycle {}: partition.forwarded never "
+                     "moved across a peer-forwarded stream ({} -> {})"
+                     .format(cycle, fwd_before, fwd_after))
+
+            # main traffic: victim-partition streams carry the full
+            # fallback rotation; survivor streams get NO fallbacks —
+            # one unbroken connection or a recorded violation
+            survivor_obs = []
+            victim_results = []
+            survivor_lock = threading.Lock()
+
+            def victim_worker(wid, cycle=cycle, urls=all_urls):
+                client = httpclient.InferenceServerClient(pmap[0])
+                try:
+                    for i in range(soak):
+                        rec = []
+                        tokens, seqs = run_stream(
+                            client,
+                            pin_gid(0, "v-c{}-w{}-s{}".format(
+                                cycle, wid, i)),
+                            [u for u in urls if u != pmap[0]],
+                            rec, cycle, "victim w{} s{}".format(wid, i))
+                        if tokens is None:
+                            continue
+                        with survivor_lock:
+                            victim_results.append((tokens, seqs))
+                finally:
+                    client.close()
+
+            def survivor_worker(wid, cycle=cycle):
+                client = httpclient.InferenceServerClient(pmap[1])
+                try:
+                    for i in range(soak):
+                        rec = []
+                        tokens, seqs = run_stream(
+                            client,
+                            pin_gid(1, "s-c{}-w{}-s{}".format(
+                                cycle, wid, i)),
+                            [], rec, cycle,
+                            "survivor w{} s{}".format(wid, i),
+                            max_reconnects=0)
+                        if tokens is None:
+                            continue
+                        with survivor_lock:
+                            survivor_obs.append({
+                                "partition": 1,
+                                "reconnects": rec[0],
+                                "seqs": seqs,
+                            })
+                            victim_results.append((tokens, None))
+                finally:
+                    client.close()
+
+            threads = ([threading.Thread(target=victim_worker,
+                                         args=(w,), daemon=True)
+                        for w in range(2)]
+                       + [threading.Thread(target=survivor_worker,
+                                           args=(w,), daemon=True)
+                          for w in range(2)])
+            for t in threads:
+                t.start()
+            time.sleep(0.3)  # streams mid-generation on both actives
+            victims = [r for r in supervisor.stats().get("routers", [])
+                       if r.get("partition") == 0
+                       and r["state"] == "up" and r["pid"]]
+            if not victims:
+                fail("multi-router cycle {}: no live partition-0 "
+                     "active to kill".format(cycle))
+            else:
+                os.kill(victims[0]["pid"], signal.SIGKILL)
+            for t in threads:
+                t.join(timeout=300)
+
+            for tokens, seqs in victim_results:
+                chaoslib.check_token_identity(
+                    RECORDER, reference, tokens,
+                    context="multi-router cycle {}".format(cycle),
+                    message="multi-router cycle {}: stream tokens "
+                            "diverged: {} != {}".format(
+                                cycle, tokens, reference))
+                if seqs is not None:
+                    chaoslib.check_seq_continuity(
+                        RECORDER, seqs, expected_len=budget,
+                        context="multi-router cycle {}".format(cycle))
+            # (1) the blast radius stayed partition-sized
+            chaoslib.check_partition_blast_radius(
+                RECORDER, survivor_obs,
+                context="multi-router cycle {}".format(cycle))
+            if len(survivor_obs) < 2 * soak:
+                fail("multi-router cycle {}: only {}/{} survivor "
+                     "streams completed".format(
+                         cycle, len(survivor_obs), 2 * soak))
+
+            # (2) recovery bar: takeover INTO partition 0 observed,
+            # every router process back up, the map rebound under a
+            # newer epoch
+            deadline = time.monotonic() + 60.0
+            healed = False
+            while time.monotonic() < deadline:
+                stats = supervisor.stats()
+                if (stats.get("router_takeovers", 0)
+                        > stats_before.get("router_takeovers", 0)
+                        and routers_up(timeout_s=0.1)):
+                    healed = True
+                    break
+                time.sleep(0.1)
+            if not healed:
+                fail("multi-router cycle {}: takeover into the killed "
+                     "partition never completed (stats={})".format(
+                         cycle, supervisor.stats()))
+                return
+            pmap = owner_urls()
+            if pmap is None:
+                return
+            epoch_after = (router_stats(pmap[1]) or {}).get("epoch", 0)
+            if not epoch_after > epoch_before:
+                fail("multi-router cycle {}: partition-map epoch never "
+                     "advanced across the takeover ({} -> {})".format(
+                         cycle, epoch_before, epoch_after))
+            # (3) one journal writer per partition, throughout
+            stats = supervisor.stats()
+            chaoslib.check_journal_single_writer(
+                RECORDER, stats.get("routers", []),
+                context="multi-router cycle {}".format(cycle))
+            rstats = router_stats(pmap[0])
+            if not rstats.get("recovered_generations"):
+                fail("multi-router cycle {}: the promoted partition-0 "
+                     "owner recovered zero generations from its "
+                     "journal".format(cycle))
+            print("cycle {:2d} takeovers={} epoch={} survivors={} "
+                  "recovered={}".format(
+                      cycle, stats.get("router_takeovers"),
+                      epoch_after, len(survivor_obs),
+                      rstats.get("recovered_generations")), flush=True)
+    finally:
+        supervisor.stop()
+
+
 def disagg_phase(cycles, soak, budget):
     """``--disagg``: disaggregated prefill/decode soak (ISSUE 16).
 
@@ -2015,6 +2339,19 @@ def main():
                              "errors, token-identical gap-free "
                              "streams, and journal recovery counters "
                              "moving")
+    parser.add_argument("--multi-router", action="store_true",
+                        dest="multi_router",
+                        help="soak the horizontal front tier instead: "
+                             "a supervised stub fleet with TWO active "
+                             "partitioned routers + a warm standby; "
+                             "partition 0's active is SIGKILLed "
+                             "mid-traffic every cycle — asserts the "
+                             "sibling partition rides through with "
+                             "zero reconnects (partition blast "
+                             "radius), standby promotion INTO the "
+                             "killed partition, epoch advance, peer "
+                             "handoff, and per-partition journal "
+                             "single-writer discipline")
     parser.add_argument("--disagg", action="store_true",
                         help="soak disaggregated prefill/decode "
                              "serving instead: a role stub fleet "
@@ -2077,6 +2414,27 @@ def main():
               "cycles, {:.1f}s, standby takeover + journal recovery, "
               "zero user-visible errors, zero lost or duplicated "
               "tokens".format(args.cycles, elapsed))
+        return 0
+
+    if args.multi_router:
+        t0 = time.monotonic()
+        # stub replicas + slowed token cadence, like --router-kill:
+        # cycles are cheap, and each one proves the blast radius of an
+        # active's death stays partition-sized
+        multi_router_phase(args.cycles,
+                           args.soak if args.soak is not None else 2,
+                           args.budget * 2)
+        elapsed = time.monotonic() - t0
+        if _failures:
+            print("\nmulti-router chaos smoke FAILED: {} violation(s) "
+                  "in {:.1f}s".format(len(_failures), elapsed),
+                  file=sys.stderr)
+            return 1
+        print("\nmulti-router chaos smoke OK: {} partitioned-tier "
+              "SIGKILL cycles, {:.1f}s, surviving partition "
+              "uninterrupted (zero reconnects), standby promoted into "
+              "the killed partition, epoch advanced, peer handoff "
+              "token-identical".format(args.cycles, elapsed))
         return 0
 
     if args.disagg:

@@ -173,6 +173,13 @@ def main(argv=None):
                          "directory)")
     ap.add_argument("--standby-port", type=int, default=0,
                     help="standby router listen port (0 = pick free)")
+    ap.add_argument("--active-routers", type=int, default=1,
+                    help="with --router-processes: N simultaneously-"
+                         "active routers partitioning the generation-"
+                         "id space (each owns a journal subdirectory "
+                         "and peer-forwards siblings' requests); an "
+                         "active's death promotes the standby INTO "
+                         "its partition (default 1 = single active)")
     ap.add_argument("--manifest", default=None, metavar="DIR",
                     help="supervisor crash durability: journal fleet "
                          "state to this manifest directory; a "
@@ -219,7 +226,8 @@ def main(argv=None):
             "--spec-tokens", str(args.spec_tokens),
         ]
     router_command = None
-    if args.router_processes or args.router_standby:
+    if (args.router_processes or args.router_standby
+            or args.active_routers > 1):
         router_command = [
             sys.executable, os.path.join(REPO, "tools", "router.py"),
             "--backends", "{backends}", "--host", args.router_host,
@@ -243,6 +251,7 @@ def main(argv=None):
         router_journal=args.router_journal,
         router_port=args.router_port,
         standby_port=args.standby_port,
+        active_routers=args.active_routers,
         env={"PYTHONPATH": os.path.join(REPO, "src", "python")},
         verbose=args.verbose,
         manifest_dir=args.manifest,

@@ -96,6 +96,26 @@ def main(argv=None):
                          "(required), keep membership/probing live, "
                          "shed /v2 traffic typed-503 until promoted "
                          "(POST /router/promote or SIGUSR1)")
+    ap.add_argument("--partition-count", type=int, default=1,
+                    help="horizontal front tier: total active-router "
+                         "partitions over the generation-id space "
+                         "(default 1 = the single-active tier)")
+    ap.add_argument("--partition-index", type=int, default=None,
+                    help="the partition THIS active owns (0-based; "
+                         "required for an active when "
+                         "--partition-count > 1, omitted for the "
+                         "standby which tails every partition)")
+    ap.add_argument("--peers", default=None,
+                    help="comma list of router host:port by partition "
+                         "index (empty slot = no live owner yet); "
+                         "wrong-partition requests peer-forward here")
+    ap.add_argument("--epoch", type=int, default=0,
+                    help="partition-map epoch the --peers map carries "
+                         "(broadcasts with a newer epoch supersede)")
+    ap.add_argument("--relay", choices=("thread", "selector"),
+                    default=None,
+                    help="SSE relay mode (default: selector when "
+                         "partitioned, thread otherwise)")
     ap.add_argument("--spawn-nonce", default=None,
                     help="spawn identity nonce echoed in "
                          "/v2/health/stats (fleet supervisor "
@@ -131,6 +151,11 @@ def main(argv=None):
         hedge_delay_s=args.hedge_delay,
         journal=args.journal,
         standby=args.standby,
+        partition_index=args.partition_index,
+        partition_count=args.partition_count,
+        peers=(args.peers.split(",") if args.peers else None),
+        partition_epoch=args.epoch,
+        relay_mode=args.relay,
         spawn_nonce=args.spawn_nonce,
         verbose=args.verbose,
     ).start()
@@ -166,10 +191,13 @@ def main(argv=None):
     signal.signal(signal.SIGINT, _stop)
     if hasattr(signal, "SIGUSR1"):
         signal.signal(signal.SIGUSR1, _promote)
-    print("fleet router {} on {} over {} replica(s): {}{}".format(
+    print("fleet router {} on {} over {} replica(s): {}{}{}".format(
         "STANDBY" if args.standby else "listening",
         router.url, len(backends), ", ".join(backends),
         " (journal: {})".format(args.journal) if args.journal else "",
+        " (partition {}/{})".format(args.partition_index,
+                                    args.partition_count)
+        if args.partition_count > 1 else "",
     ), flush=True)
     try:
         stop.wait()
